@@ -1,0 +1,160 @@
+"""horovod_tpu: a TPU-native distributed deep-learning training framework.
+
+Capability surface of Horovod (reference: darkjh/horovod v0.22.0), re-designed
+TPU-first: XLA collectives over ICI/DCN on a `jax.sharding.Mesh` replace
+NCCL/MPI/Gloo; gradient sync is bucket-fused `psum` inside the jitted SPMD
+train step; `hvdrun` spawns per-host workers on TPU VM slices with an HTTP
+rendezvous; elastic training re-rendezvouses across preemptible slices.
+
+Public API parity (reference: horovod/torch/__init__.py,
+horovod/tensorflow/__init__.py):
+
+    import horovod_tpu as hvd
+    hvd.init()
+    hvd.rank(), hvd.size(), hvd.local_rank(), hvd.local_size()
+    hvd.allreduce / allgather / broadcast / alltoall / reducescatter
+    hvd.DistributedOptimizer(optax_opt, axis_name='hvd')
+    hvd.broadcast_parameters / broadcast_optimizer_state / broadcast_object
+    hvd.Compression, hvd.Average / Sum / Adasum / Min / Max / Product
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import runtime as _rt
+from .runtime import init, shutdown, is_initialized
+from .common.reduce_op import (ReduceOp, Average, Sum, Adasum, Min, Max,
+                               Product)
+from .common.exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
+                                TensorShapeMismatchError,
+                                TensorDtypeMismatchError,
+                                DuplicateTensorNameError, StallError)
+from .ops.collectives import (allreduce, allreduce_async, grouped_allreduce,
+                              allgather, allgather_async, allgather_ragged,
+                              broadcast, broadcast_async, alltoall,
+                              reducescatter, barrier, synchronize, poll,
+                              process_allgather, Handle)
+from .ops.compression import Compression
+from .ops import spmd
+from .optimizer import (DistributedOptimizer, distributed_optimizer,
+                        sync_gradients, distributed_grad)
+from .functions import (broadcast_parameters, broadcast_optimizer_state,
+                        broadcast_object, allgather_object)
+
+
+# ---------------------------------------------------------------- topology API
+def rank() -> int:
+    """Global worker (chip) rank of this process's first chip."""
+    return _rt.get().rank()
+
+
+def size() -> int:
+    """Total number of worker chips in the mesh."""
+    return _rt.get().size()
+
+
+def local_rank() -> int:
+    return _rt.get().local_rank()
+
+
+def local_size() -> int:
+    """Chips driven by this process."""
+    return _rt.get().local_size()
+
+
+def cross_rank() -> int:
+    """Host/process index (CROSS scope, reference: common.h:119-123)."""
+    return _rt.get().cross_rank()
+
+
+def cross_size() -> int:
+    return _rt.get().cross_size()
+
+
+def process_rank() -> int:
+    return _rt.get().process_rank()
+
+
+def process_size() -> int:
+    return _rt.get().process_size()
+
+
+def mesh():
+    """The global `jax.sharding.Mesh` collectives run over."""
+    return _rt.get().mesh
+
+
+def is_homogeneous() -> bool:
+    """True when all hosts drive the same number of chips (reference:
+    horovod_is_homogeneous, operations.cc:838)."""
+    rt = _rt.get()
+    return rt.size() == rt.local_size() * rt.process_size()
+
+
+# ----------------------------------------------------------- built/enabled API
+# Build-capability probes (reference: operations.cc:845-915 horovod_mpi_built
+# etc.).  This framework has exactly one data plane: XLA over ICI/DCN.
+def tpu_built() -> bool:
+    return True
+
+
+def xla_built() -> bool:
+    return True
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+# ---------------------------------------------------------------- timeline API
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Start writing the Chrome-trace timeline (reference:
+    horovod_start_timeline, operations.cc:740-769)."""
+    _rt.get().start_timeline(file_path, mark_cycles=mark_cycles)
+
+
+def stop_timeline() -> None:
+    _rt.get().stop_timeline()
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized",
+    "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
+    "process_rank", "process_size", "mesh", "is_homogeneous",
+    "allreduce", "allreduce_async", "grouped_allreduce", "allgather",
+    "allgather_async", "allgather_ragged", "broadcast", "broadcast_async",
+    "alltoall", "reducescatter", "barrier", "synchronize", "poll",
+    "process_allgather", "Handle",
+    "DistributedOptimizer", "distributed_optimizer", "sync_gradients",
+    "distributed_grad",
+    "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
+    "allgather_object",
+    "Compression", "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max",
+    "Product", "spmd",
+    "HorovodInternalError", "HostsUpdatedInterrupt",
+    "tpu_built", "xla_built", "mpi_built", "nccl_built", "gloo_built",
+    "ccl_built", "mpi_enabled", "mpi_threads_supported",
+    "start_timeline", "stop_timeline",
+    "__version__",
+]

@@ -1,0 +1,134 @@
+"""Central environment-knob registry for the TPU-native runtime.
+
+The reference funnels ~30 ``HOROVOD_*`` environment variables through a C++
+parser (reference: horovod/common/utils/env_parser.cc, horovod/common/common.h:66-96,
+horovod/common/operations.cc:395-540).  We keep the same three-layer config model
+(env vars <- CLI flags <- YAML config file) but the canonical knob table lives
+here in one place, shared by the Python runtime, the C++ core (which receives a
+serialized knob block at init), and the ``hvdrun`` launcher
+(reference: horovod/runner/launch.py:242-527, common/util/config_parser.py).
+
+Knobs keep the ``HOROVOD_`` prefix so users of the reference can switch without
+re-learning names; TPU-only knobs use the same prefix for uniformity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, Optional
+
+
+def _parse_bool(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    default: Any
+    parse: Callable[[str], Any]
+    help: str
+
+
+# Canonical knob table.  Mirrors the reference's knob surface
+# (horovod/common/common.h:66-96) with TPU-native additions.
+KNOBS: Dict[str, Knob] = {}
+
+
+def _knob(name: str, default: Any, parse: Callable[[str], Any], help: str) -> None:
+    KNOBS[name] = Knob(name, default, parse, help)
+
+
+# --- core cycle / fusion (reference: common.h:66-75, operations.cc:447-540) ---
+_knob("HOROVOD_FUSION_THRESHOLD", 128 * 1024 * 1024, int,
+      "Bucket (tensor-fusion) threshold in bytes; gradients are packed into "
+      "flat HBM buckets of at most this size before a single fused collective.")
+_knob("HOROVOD_CYCLE_TIME", 1.0, float,
+      "Background coordination cycle time in milliseconds (eager frontends).")
+_knob("HOROVOD_CACHE_CAPACITY", 1024, int,
+      "Response/bucket-plan cache capacity (entries). 0 disables caching.")
+_knob("HOROVOD_HIERARCHICAL_ALLREDUCE", False, _parse_bool,
+      "Force two-level allreduce: reduce-scatter over ICI, allreduce over DCN, "
+      "allgather over ICI.")
+_knob("HOROVOD_HIERARCHICAL_ALLGATHER", False, _parse_bool,
+      "Force two-level allgather across the DCN axis.")
+# --- autotune (reference: common.h:70-75) ---
+_knob("HOROVOD_AUTOTUNE", False, _parse_bool,
+      "Enable Bayesian autotuning of fusion threshold and cycle time.")
+_knob("HOROVOD_AUTOTUNE_LOG", "", str, "CSV log file for autotune samples.")
+_knob("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3, int, "Autotune warmup discard count.")
+_knob("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10, int, "Steps per autotune sample.")
+_knob("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20, int, "Max BO samples.")
+_knob("HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8, float, "GP noise level.")
+# --- timeline (reference: operations.cc:422-445) ---
+_knob("HOROVOD_TIMELINE", "", str,
+      "Path of the Chrome-trace timeline JSON; empty disables. 'DYNAMIC' "
+      "registers the file lazily on horovod_start_timeline().")
+_knob("HOROVOD_TIMELINE_MARK_CYCLES", False, _parse_bool,
+      "Mark coordination cycles in the timeline.")
+# --- stall inspector (reference: stall_inspector.h:70-82) ---
+_knob("HOROVOD_STALL_CHECK_DISABLE", False, _parse_bool,
+      "Disable the stalled-tensor watchdog.")
+_knob("HOROVOD_STALL_CHECK_TIME_SECONDS", 60, int,
+      "Warn when ranks disagree about a tensor for this long.")
+_knob("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0, int,
+      "Abort training when a stall exceeds this many seconds (0 = never).")
+# --- logging (reference: logging.cc:39-95) ---
+_knob("HOROVOD_LOG_LEVEL", "warning", str,
+      "trace|debug|info|warning|error|fatal")
+_knob("HOROVOD_LOG_HIDE_TIME", False, _parse_bool, "Hide timestamps in logs.")
+# --- elastic (reference: elastic/constants.py, driver.py:69-93) ---
+_knob("HOROVOD_ELASTIC_TIMEOUT", 600, int,
+      "Seconds to wait for the required number of slots in elastic mode.")
+_knob("HOROVOD_ELASTIC_RESET_LIMIT", 0, int,
+      "Max elastic reset rounds before giving up (0 = unlimited).")
+# --- TPU-native knobs (no reference equivalent) ---
+_knob("HOROVOD_TPU_MESH", "", str,
+      "Mesh spec, e.g. 'data=8' or 'data=4,model=2' or 'dcn.data=2,ici.data=8'. "
+      "Empty = 1-D 'hvd' mesh over all chips.")
+_knob("HOROVOD_TPU_DONATE_BUFFERS", True, _parse_bool,
+      "Donate input buffers of fused collectives so XLA reuses HBM in place "
+      "(the TPU analog of the reference's persistent fusion buffer).")
+_knob("HOROVOD_NUM_STREAMS", 1, int,
+      "Parallelism for eager collective dispatch (analog of "
+      "HOROVOD_NUM_NCCL_STREAMS, reference global_state.h:92-95).")
+# --- rendezvous / launcher (reference: gloo_run.py:187-212) ---
+_knob("HOROVOD_RENDEZVOUS_ADDR", "", str, "Rendezvous HTTP server address.")
+_knob("HOROVOD_RENDEZVOUS_PORT", 0, int, "Rendezvous HTTP server port.")
+_knob("HOROVOD_RANK", -1, int, "Global process rank assigned by the launcher.")
+_knob("HOROVOD_SIZE", -1, int, "Global process count assigned by the launcher.")
+_knob("HOROVOD_LOCAL_RANK", -1, int, "Process rank within its host.")
+_knob("HOROVOD_LOCAL_SIZE", -1, int, "Process count on this host.")
+_knob("HOROVOD_CROSS_RANK", -1, int, "Host index of this process.")
+_knob("HOROVOD_CROSS_SIZE", -1, int, "Number of hosts.")
+_knob("HOROVOD_HOSTNAME", "", str, "Hostname assigned by the launcher.")
+_knob("HOROVOD_COORDINATOR_ADDR", "", str,
+      "host:port of the jax.distributed coordinator for multi-host meshes.")
+
+
+class Knobs:
+    """A parsed snapshot of all knobs; values resolve env > override > default."""
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        self._values: Dict[str, Any] = {}
+        overrides = overrides or {}
+        for name, knob in KNOBS.items():
+            if name in os.environ and os.environ[name] != "":
+                self._values[name] = knob.parse(os.environ[name])
+            elif name in overrides:
+                self._values[name] = overrides[name]
+            else:
+                self._values[name] = knob.default
+
+    def __getitem__(self, name: str) -> Any:
+        return self._values[name]
+
+    def get(self, name: str, default: Any = None) -> Any:
+        return self._values.get(name, default)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Knobs({self._values!r})"

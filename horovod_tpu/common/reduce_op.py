@@ -1,0 +1,26 @@
+"""Reduction-op constants, matching the reference's ReduceOp surface
+(reference: horovod/common/basics.py:22-290 exposes Average/Sum/Adasum;
+Min/Max/Product added in the same enum family; operations.cc:911-913 maps
+hvd.Adasum)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.IntEnum):
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+# Module-level aliases mirroring `hvd.Average` / `hvd.Sum` / `hvd.Adasum`.
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT

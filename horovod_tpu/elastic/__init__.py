@@ -1,0 +1,1 @@
+"""horovod_tpu.elastic subpackage."""

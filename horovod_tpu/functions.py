@@ -1,0 +1,84 @@
+"""State synchronization helpers: broadcast parameters / objects.
+
+Mirrors the reference's ``hvd.broadcast_parameters`` /
+``broadcast_optimizer_state`` / ``broadcast_object`` / ``allgather_object``
+(reference: horovod/torch/functions.py:1-266, tensorflow/functions.py:1-177).
+These are the checkpoint-resume and startup-sync conventions: rank 0 loads,
+everyone else receives (reference: examples/pytorch/pytorch_mnist.py).
+
+In a single-controller JAX process the params are already consistent across
+local chips, so these ops matter for the multi-process (multi-host) path and
+for torch-frontend parity; they are correct (if trivial) in both cases.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import runtime as _rt
+from .ops import collectives as C
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Broadcast a parameter pytree from ``root_rank`` (chip) to all workers
+    (reference: torch/functions.py broadcast_parameters)."""
+    return jax.tree_util.tree_map(
+        lambda p: C.broadcast(p, root_rank=root_rank), params)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
+    """Broadcast optimizer state (reference: torch/functions.py
+    broadcast_optimizer_state).  optax states are pytrees, so this is
+    broadcast_parameters with non-array leaves passed through."""
+    def bc(leaf):
+        if isinstance(leaf, (jax.Array, np.ndarray)) or jnp.isscalar(leaf):
+            arr = jnp.asarray(leaf)
+            if arr.dtype == jnp.bool_:
+                return C.broadcast(arr.astype(jnp.int32),
+                                   root_rank=root_rank).astype(jnp.bool_)
+            return C.broadcast(arr, root_rank=root_rank)
+        return leaf
+    return jax.tree_util.tree_map(bc, opt_state)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Pickle-broadcast an arbitrary Python object from the root *process*
+    (reference: torch/functions.py:150-220 broadcast_object: serialize,
+    broadcast the byte length, then the padded byte tensor)."""
+    rt = _rt.get()
+    if rt.process_size() == 1:
+        return obj
+    is_root = rt.process_rank() == root_rank
+    payload = pickle.dumps(obj) if is_root else b""
+    sizes = C.process_allgather(np.array([len(payload)], np.int64))
+    size = int(np.max(sizes))
+    buf = np.zeros(size, np.uint8)
+    if is_root:
+        buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+    # Root process's chips hold the payload; broadcast from its first chip.
+    root_chip = root_rank * rt.local_size()
+    out = np.asarray(C.broadcast(jnp.asarray(buf), root_rank=root_chip))
+    return pickle.loads(out.tobytes())
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> list:
+    """Gather a Python object from every process into a list (reference:
+    torch/functions.py allgather_object)."""
+    rt = _rt.get()
+    if rt.process_size() == 1:
+        return [obj]
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    sizes = C.process_allgather(np.array([payload.size], np.int64)).reshape(-1)
+    size = int(np.max(sizes))
+    buf = np.zeros(size, np.uint8)
+    buf[:payload.size] = payload
+    gathered = C.process_allgather(buf)  # [nproc, size]
+    return [pickle.loads(np.asarray(gathered[i][:int(sizes[i])]).tobytes())
+            for i in range(rt.process_size())]

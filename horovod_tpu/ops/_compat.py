@@ -1,0 +1,10 @@
+"""Version-compat shims for jax APIs that moved between releases."""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: promoted to top level
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x / 0.5.x
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["shard_map"]

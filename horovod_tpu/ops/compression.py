@@ -1,0 +1,78 @@
+"""Gradient compression for collectives.
+
+Mirrors the reference's pluggable compressor surface (reference:
+horovod/torch/compression.py, horovod/tensorflow/compression.py:1-74):
+``Compression.none`` and ``Compression.fp16`` with
+``compress(tensor) -> (tensor, ctx)`` / ``decompress(tensor, ctx)``.
+
+On TPU the natural wire dtype is **bfloat16** (MXU/ICI native); fp16 is kept
+for parity.  Compression applies to the fused bucket, so one cast covers
+many tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface matching the reference's Compressor static methods."""
+
+    @staticmethod
+    def compress(tensor: jax.Array) -> Tuple[jax.Array, Any]:
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: jax.Array, ctx: Any) -> jax.Array:
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast floating tensors to fp16 for the wire (reference:
+    compression.py FP16Compressor)."""
+
+    @staticmethod
+    def compress(tensor):
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor.astype(jnp.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class BF16Compressor(Compressor):
+    """TPU-native wire compression: bfloat16 keeps fp32 range and is the
+    ICI/MXU native narrow type (no reference equivalent; TPU addition)."""
+
+    @staticmethod
+    def compress(tensor):
+        if jnp.issubdtype(tensor.dtype, jnp.floating) and \
+                tensor.dtype != jnp.bfloat16:
+            return tensor.astype(jnp.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class Compression:
+    """Namespace matching ``hvd.Compression`` (reference: compression.py)."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

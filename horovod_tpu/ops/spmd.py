@@ -1,0 +1,118 @@
+"""SPMD (in-jit) collectives over named mesh axes.
+
+These are the primitives the compiled data plane uses — thin, explicit
+wrappers over XLA's ICI collectives, replacing the reference's NCCL/MPI/Gloo
+execution backends (reference: horovod/common/ops/*).  They must be called
+inside a `shard_map` / `pjit` context that binds the axis name.
+
+Unlike the reference — where each backend reimplements
+allreduce/allgather/broadcast/alltoall per transport (reference:
+nccl_operations.cc, mpi_operations.cc, gloo_operations.cc) — one
+implementation serves every topology: the mesh axis determines whether the
+collective rides ICI (within a slice) or DCN (across slices).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common.reduce_op import ReduceOp
+
+AxisName = Union[str, Sequence[str]]
+
+
+def _axis_size(axis_name: AxisName) -> jax.Array:
+    return lax.psum(1, axis_name)
+
+
+def allreduce(x: jax.Array, axis_name: AxisName,
+              op: ReduceOp = ReduceOp.AVERAGE,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0) -> jax.Array:
+    """Allreduce over a mesh axis.
+
+    Average follows the reference's convert-to-postscale trick: SUM with a
+    1/size postscale (reference: operations.cc:948-1056 AVERAGE->postscale).
+    """
+    if prescale_factor != 1.0:
+        x = x * prescale_factor
+    if op == ReduceOp.SUM:
+        out = lax.psum(x, axis_name)
+    elif op == ReduceOp.AVERAGE:
+        out = lax.pmean(x, axis_name)
+    elif op == ReduceOp.MIN:
+        out = lax.pmin(x, axis_name)
+    elif op == ReduceOp.MAX:
+        out = lax.pmax(x, axis_name)
+    elif op == ReduceOp.PRODUCT:
+        # No hardware product-reduce; gather then multiply. Fine for the
+        # rare PRODUCT op (reference exposes it but no backend fast-paths it).
+        g = lax.all_gather(x, axis_name)
+        out = jnp.prod(g, axis=0)
+    elif op == ReduceOp.ADASUM:
+        from ..parallel.adasum import adasum_allreduce
+        out = adasum_allreduce(x, axis_name)
+    else:
+        raise ValueError(f"unknown ReduceOp {op!r}")
+    if postscale_factor != 1.0:
+        out = out * postscale_factor
+    return out
+
+
+def allgather(x: jax.Array, axis_name: AxisName, axis: int = 0) -> jax.Array:
+    """Concatenate per-worker tensors along ``axis`` (reference semantics:
+    allgather concatenates along the first dimension,
+    collective_operations.h:133-204)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def broadcast(x: jax.Array, axis_name: AxisName, root: int = 0) -> jax.Array:
+    """Broadcast the root worker's value to all workers on the axis.
+
+    Non-root contributions are replaced by zeros via ``where`` (not
+    multiplication) so NaN/Inf garbage on non-root workers — e.g.
+    uninitialized params awaiting a checkpoint broadcast — cannot poison
+    the psum."""
+    idx = lax.axis_index(axis_name)
+    contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(contrib, axis_name)
+
+
+def alltoall(x: jax.Array, axis_name: AxisName,
+             split_axis: int = 0, concat_axis: int = 0) -> jax.Array:
+    """Equal-split all-to-all (the sequence/expert-parallel primitive;
+    reference: operations.cc:1136-1198)."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def reducescatter(x: jax.Array, axis_name: AxisName,
+                  op: ReduceOp = ReduceOp.SUM,
+                  scatter_axis: int = 0) -> jax.Array:
+    """Reduce-scatter: each worker gets one reduced shard.  The building
+    block of hierarchical allreduce (reference: nccl_operations.cc:188-319)
+    and FSDP-style gradient sharding."""
+    out = lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
+                           tiled=True)
+    if op == ReduceOp.AVERAGE:
+        out = out / _axis_size(axis_name)
+    return out
+
+
+def barrier(axis_name: AxisName) -> jax.Array:
+    """A synchronization point: a zero-byte-ish psum all workers join."""
+    return lax.psum(jnp.zeros((), jnp.int32), axis_name)
+
+
+def ring_permute(x: jax.Array, axis_name: AxisName,
+                 shift: int = 1) -> jax.Array:
+    """Send to (i+shift) mod n on the axis ring — the primitive under ring
+    attention and Adasum's recursive halving (no reference equivalent;
+    SURVEY.md §5 long-context requirement)."""
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)

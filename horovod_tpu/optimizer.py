@@ -1,0 +1,175 @@
+"""DistributedOptimizer: gradient synchronization as an optax transform.
+
+The reference wraps framework optimizers so that every ``step()`` allreduces
+gradients first — via per-parameter hooks on torch (reference:
+horovod/torch/optimizer.py:128-333) or gradient-tape interposition on TF
+(reference: horovod/tensorflow/__init__.py:601-724), with local aggregation
+over ``backward_passes_per_step`` (gradient_aggregation.py:16) and optional
+grouped/fused buckets (optimizer.py ``num_groups``).
+
+TPU-native shape: gradient sync belongs *inside* the jitted SPMD train step,
+so ``DistributedOptimizer`` is an `optax.GradientTransformation` wrapper
+whose ``update`` (a) optionally accumulates ``backward_passes_per_step``
+micro-batches, (b) packs gradients into fusion buckets, (c) runs one fused
+``psum``/Adasum per bucket over the mesh axis with optional fp16/bf16 wire
+compression, then (d) delegates to the inner optimizer.  Used under
+`shard_map`/`pmap` binding ``axis_name`` — or with ``axis_name=None`` it
+degrades to the inner optimizer (single-chip).
+
+``sync_gradients`` is exposed standalone as the `DistributedGradientTape`
+analog (reference: tensorflow/__init__.py:726-816).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .common.reduce_op import ReduceOp, Average
+from .ops import spmd
+from .ops.compression import Compression, Compressor
+from .ops.fusion import make_plan, fused_apply
+
+AxisName = Union[str, Sequence[str]]
+
+DEFAULT_FUSION_BYTES = 128 * 1024 * 1024
+
+
+def sync_gradients(grads: Any,
+                   axis_name: Optional[AxisName],
+                   op: ReduceOp = Average,
+                   compression: type[Compressor] = Compression.none,
+                   prescale_factor: float = 1.0,
+                   postscale_factor: float = 1.0,
+                   fusion_threshold_bytes: Optional[int] = None) -> Any:
+    """Allreduce a gradient pytree over ``axis_name`` with bucket fusion.
+
+    The fusion plan is computed at trace time (static shapes), so the
+    compiled step contains a handful of large collectives — the XLA-era
+    equivalent of the reference's 128 MiB fusion buffer
+    (reference: controller.cc:778-915, fusion_buffer_manager.cc)."""
+    if axis_name is None:
+        return grads
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if not leaves:
+        return grads
+    threshold = fusion_threshold_bytes
+    if threshold is None:
+        from . import runtime as _rt
+        threshold = (_rt.get().knobs["HOROVOD_FUSION_THRESHOLD"]
+                     if _rt.is_initialized() else DEFAULT_FUSION_BYTES)
+    shapes = [l.shape for l in leaves]
+    dtypes = [l.dtype for l in leaves]
+    plan = make_plan(shapes, dtypes, threshold)
+
+    def reduce_bucket(buf: jax.Array) -> jax.Array:
+        buf, ctx = compression.compress(buf)
+        buf = spmd.allreduce(buf, axis_name, op=op,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor)
+        return compression.decompress(buf, ctx)
+
+    synced = fused_apply(leaves, plan, reduce_bucket)
+    return jax.tree_util.tree_unflatten(treedef, synced)
+
+
+class _AccState(NamedTuple):
+    inner: Any
+    counter: jax.Array          # micro-batch counter
+    acc: Any                    # accumulated (unsynced) gradients
+
+
+def distributed_optimizer(optimizer: optax.GradientTransformation,
+                          axis_name: Optional[AxisName] = "hvd",
+                          op: ReduceOp = Average,
+                          compression: type[Compressor] = Compression.none,
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0,
+                          backward_passes_per_step: int = 1,
+                          fusion_threshold_bytes: Optional[int] = None,
+                          ) -> optax.GradientTransformation:
+    """Wrap ``optimizer`` so updates see globally-synced gradients.
+
+    Parity map (reference: torch/optimizer.py:506 DistributedOptimizer):
+      * ``op=Average|Sum|Adasum``  — reduction op, incl. hvd.Adasum
+      * ``compression``            — wire compression of fused buckets
+      * ``backward_passes_per_step`` — local aggregation before sync
+        (reference: gradient_aggregation.py)
+      * bucket fusion replaces ``num_groups`` — automatic by byte threshold.
+    """
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+
+    def sync(grads):
+        return sync_gradients(grads, axis_name, op=op,
+                              compression=compression,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              fusion_threshold_bytes=fusion_threshold_bytes)
+
+    if backward_passes_per_step == 1:
+        def init_fn(params):
+            return optimizer.init(params)
+
+        def update_fn(grads, state, params=None, **extra):
+            return optimizer.update(sync(grads), state, params, **extra)
+
+        return optax.GradientTransformation(init_fn, update_fn)
+
+    n = backward_passes_per_step
+
+    def init_fn(params):
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return _AccState(inner=optimizer.init(params),
+                         counter=jnp.zeros((), jnp.int32),
+                         acc=zeros)
+
+    def update_fn(grads, state: _AccState, params=None, **extra):
+        acc = jax.tree_util.tree_map(lambda a, g: a + g, state.acc, grads)
+        is_sync_step = (state.counter + 1) % n == 0
+
+        def do_sync(_):
+            synced = sync(jax.tree_util.tree_map(lambda a: a / n, acc))
+            updates, inner = optimizer.update(synced, state.inner, params,
+                                              **extra)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return updates, _AccState(inner, state.counter + 1, zeros)
+
+        def skip(_):
+            updates = jax.tree_util.tree_map(jnp.zeros_like, grads)
+            return updates, _AccState(state.inner, state.counter + 1, acc)
+
+        return jax.lax.cond(is_sync_step, do_sync, skip, operand=None)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+# CamelCase alias matching the reference's public name.
+DistributedOptimizer = distributed_optimizer
+
+
+def distributed_grad(loss_fn, axis_name: Optional[AxisName] = "hvd",
+                     op: ReduceOp = Average,
+                     compression: type[Compressor] = Compression.none,
+                     has_aux: bool = False,
+                     fusion_threshold_bytes: Optional[int] = None):
+    """`DistributedGradientTape` analog (reference:
+    tensorflow/__init__.py:726-816): returns a grad function whose gradients
+    are already allreduced over ``axis_name``."""
+    gfn = jax.grad(loss_fn, has_aux=has_aux)
+
+    def wrapped(*args, **kwargs):
+        if has_aux:
+            g, aux = gfn(*args, **kwargs)
+            return sync_gradients(
+                g, axis_name, op=op, compression=compression,
+                fusion_threshold_bytes=fusion_threshold_bytes), aux
+        g = gfn(*args, **kwargs)
+        return sync_gradients(g, axis_name, op=op, compression=compression,
+                              fusion_threshold_bytes=fusion_threshold_bytes)
+
+    return wrapped

@@ -1,0 +1,240 @@
+"""Process-global runtime: the TPU-native analog of HorovodGlobalState.
+
+The reference keeps a process-wide singleton holding the background thread,
+controller, tensor queue, fusion buffers and knobs (reference:
+horovod/common/global_state.h:43-132, operations.cc:115) initialized once by
+``horovod_init`` (operations.cc:651-699).  On TPU the data plane is XLA SPMD
+over a `jax.sharding.Mesh`, so the runtime's job becomes:
+
+  * bring up the (optionally multi-host) JAX runtime and build the mesh,
+  * own the knob snapshot, bucket-plan cache, timeline and stall inspector,
+  * expose the rank/size topology API.
+
+Topology model (TPU-native reinterpretation of Horovod's 1-process-per-GPU):
+the *worker unit is the chip*.  ``size()`` is the number of chips in the mesh
+and ``local_size()`` the chips owned by this process.  A process controls
+``local_size()`` workers at once — eager collectives therefore accept a
+leading per-chip axis (see ops/collectives.py).  Process-level coordinates
+(``process_rank``/``process_size``) correspond to the reference's CROSS
+communicator scope, and local chips to the LOCAL scope
+(reference: common.h:119-123, mpi_context.cc:147-156).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .common import hvdlogging as log
+from .common.knobs import Knobs
+
+_lock = threading.Lock()
+_runtime: Optional["Runtime"] = None
+
+
+def _parse_mesh_spec(spec: str) -> List[Tuple[str, int]]:
+    """Parse 'data=4,model=2' into [('data', 4), ('model', 2)]."""
+    axes: List[Tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, size = part.partition("=")
+        axes.append((name.strip(), int(size)))
+    return axes
+
+
+class Runtime:
+    """Holds the mesh, knobs and auxiliary subsystems for this process."""
+
+    def __init__(self,
+                 knobs: Optional[Knobs] = None,
+                 devices: Optional[Sequence[Any]] = None,
+                 mesh_spec: Optional[str] = None):
+        import jax
+
+        self.knobs = knobs or Knobs()
+        self._shutdown = False
+
+        # Multi-host bring-up: the launcher (hvdrun) exports coordinator
+        # address + process coordinates (the analog of mpirun exporting
+        # HOROVOD_RANK/SIZE per slot, reference: gloo_run.py:65-77).
+        coord = self.knobs["HOROVOD_COORDINATOR_ADDR"]
+        if coord and jax.process_count() == 1 and self.knobs["HOROVOD_SIZE"] > 1:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=self.knobs["HOROVOD_SIZE"],
+                process_id=max(self.knobs["HOROVOD_RANK"], 0),
+            )
+
+        self.devices = list(devices if devices is not None else jax.devices())
+        self._process_index = jax.process_index()
+        self._process_count = jax.process_count()
+
+        spec = mesh_spec if mesh_spec is not None else self.knobs["HOROVOD_TPU_MESH"]
+        self.mesh = self._build_mesh(spec)
+        # Canonical worker numbering = flattened *mesh* position, which is
+        # what lax.axis_index sees inside collectives.  create_device_mesh
+        # may permute devices for ICI adjacency, so re-derive the ordered
+        # device list from the mesh rather than jax.devices().
+        self.devices = list(self.mesh.devices.flatten())
+        self.local_devices = [d for d in self.devices
+                              if d.process_index == self._process_index]
+
+        # Bucket-plan cache: the analog of the response cache — repeat steps
+        # skip re-planning (reference: response_cache.h:44-100).
+        from .ops.fusion import BucketPlanCache
+        self.plan_cache = BucketPlanCache(
+            capacity=self.knobs["HOROVOD_CACHE_CAPACITY"])
+
+        # Timeline + stall inspector are created lazily by their modules.
+        self.timeline = None
+        self._timeline_path = self.knobs["HOROVOD_TIMELINE"]
+        if self._timeline_path and self._timeline_path != "DYNAMIC":
+            from .utils.timeline import Timeline
+            self.timeline = Timeline(self._timeline_path,
+                                     mark_cycles=self.knobs[
+                                         "HOROVOD_TIMELINE_MARK_CYCLES"])
+
+        self.stall_inspector = None
+        if not self.knobs["HOROVOD_STALL_CHECK_DISABLE"]:
+            from .utils.stall import StallInspector
+            self.stall_inspector = StallInspector(
+                warn_seconds=self.knobs["HOROVOD_STALL_CHECK_TIME_SECONDS"],
+                shutdown_seconds=self.knobs[
+                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"])
+
+        # Native core (C++ controller/tensor-queue) attaches here when the
+        # eager multi-process frontend needs negotiation; SPMD paths don't.
+        self.core = None
+
+        log.debug("Runtime up: %d devices, %d local, mesh=%s",
+                  len(self.devices), len(self.local_devices),
+                  self.mesh.shape if self.mesh else None)
+
+    # ------------------------------------------------------------------ mesh
+    def _build_mesh(self, spec: str):
+        import jax
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh
+
+        n = len(self.devices)
+        if not spec:
+            axes = [("hvd", n)]
+        else:
+            axes = _parse_mesh_spec(spec)
+            # A single trailing -1 axis absorbs the remaining chips.
+            sizes = [s for _, s in axes]
+            if -1 in sizes:
+                known = int(np.prod([s for s in sizes if s != -1]))
+                axes = [(a, s if s != -1 else n // known) for a, s in axes]
+        shape = tuple(s for _, s in axes)
+        names = tuple(a for a, _ in axes)
+        if int(np.prod(shape)) != n:
+            raise ValueError(
+                f"mesh spec {spec!r} covers {int(np.prod(shape))} chips but "
+                f"{n} are visible")
+        try:
+            # ICI-topology-aware assignment: keeps high-traffic axes on
+            # physically adjacent chips so collectives ride ICI links.
+            devs = mesh_utils.create_device_mesh(shape, devices=self.devices)
+        except (ValueError, AssertionError, NotImplementedError):
+            devs = np.array(self.devices).reshape(shape)
+        return Mesh(devs, names)
+
+    # -------------------------------------------------------------- topology
+    # Chip-level coordinates ("rank" = chip, matching 1-process-per-GPU in
+    # the reference once you substitute chip for GPU).
+    def size(self) -> int:
+        return len(self.devices)
+
+    def local_size(self) -> int:
+        return len(self.local_devices)
+
+    def rank(self) -> int:
+        """Global index of this process's first chip."""
+        if not self.local_devices:
+            return 0
+        first = self.local_devices[0]
+        return self.devices.index(first)
+
+    def local_rank(self) -> int:
+        return 0
+
+    # Process-level coordinates: CROSS scope in the reference.
+    def process_rank(self) -> int:
+        return self._process_index
+
+    def process_size(self) -> int:
+        return self._process_count
+
+    def cross_rank(self) -> int:
+        return self._process_index
+
+    def cross_size(self) -> int:
+        return self._process_count
+
+    # ------------------------------------------------------------- lifecycle
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        if self.timeline is not None:
+            self.timeline.close()
+        if self.core is not None:
+            self.core.shutdown()
+
+    # ------------------------------------------------------------- timeline
+    def start_timeline(self, path: str, mark_cycles: bool = False) -> None:
+        """Runtime-activated timeline (reference: operations.cc:740-769)."""
+        from .utils.timeline import Timeline
+        if self.timeline is not None:
+            self.timeline.close()
+        self.timeline = Timeline(path, mark_cycles=mark_cycles)
+
+    def stop_timeline(self) -> None:
+        if self.timeline is not None:
+            self.timeline.close()
+            self.timeline = None
+
+
+# ----------------------------------------------------------------- module API
+def init(mesh_spec: Optional[str] = None,
+         devices: Optional[Sequence[Any]] = None,
+         **overrides: Any) -> Runtime:
+    """Initialize the process-global runtime (idempotent).
+
+    The analog of ``hvd.init()`` -> InitializeHorovodOnce (reference:
+    operations.cc:651-699); callers block until the runtime is usable.
+    """
+    global _runtime
+    with _lock:
+        if _runtime is None:
+            _runtime = Runtime(knobs=Knobs(overrides or None),
+                               devices=devices, mesh_spec=mesh_spec)
+            atexit.register(shutdown)
+        return _runtime
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def get() -> Runtime:
+    if _runtime is None:
+        raise RuntimeError(
+            "horovod_tpu has not been initialized; call hvd.init() first "
+            "(reference semantics: operations.cc:695-697 blocks until init)")
+    return _runtime
+
+
+def shutdown() -> None:
+    """The analog of ``hvd.shutdown()`` (reference: operations.cc:731-738)."""
+    global _runtime
+    with _lock:
+        if _runtime is not None:
+            _runtime.shutdown()
+            _runtime = None

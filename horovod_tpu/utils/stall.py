@@ -1,0 +1,57 @@
+"""Stall inspector: the deadlock watchdog.
+
+The reference's coordinator warns when some ranks submitted a tensor and
+others haven't for >60 s, and can shut the job down after a second threshold
+(reference: horovod/common/stall_inspector.{h,cc}; knobs
+HOROVOD_STALL_CHECK_TIME_SECONDS / HOROVOD_STALL_SHUTDOWN_TIME_SECONDS,
+stall_inspector.h:70-82; wired into the controller at controller.cc:126-135).
+
+In SPMD mode whole-program collectives can't partially stall, but the eager
+path (and multi-host rendezvous) can: a submitted-but-never-completed op
+means a peer process died or diverged.  This inspector tracks
+submit/complete pairs and raises/warns on the same thresholds.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+from ..common import hvdlogging as log
+from ..common.exceptions import StallError
+
+
+class StallInspector:
+    def __init__(self, warn_seconds: int = 60, shutdown_seconds: int = 0):
+        self.warn_seconds = warn_seconds
+        self.shutdown_seconds = shutdown_seconds
+        self._pending: Dict[str, float] = {}
+        self._warned: Dict[str, bool] = {}
+
+    def record_submit(self, name: str) -> None:
+        self._pending.setdefault(name, time.monotonic())
+        self.check()
+
+    def record_complete(self, name: str) -> None:
+        self._pending.pop(name, None)
+        self._warned.pop(name, None)
+
+    def check(self) -> None:
+        """Warn/abort on overdue tensors (reference:
+        StallInspector::CheckForStalledTensors)."""
+        now = time.monotonic()
+        stalled = [(n, now - t) for n, t in self._pending.items()
+                   if now - t > self.warn_seconds]
+        for name, age in stalled:
+            if not self._warned.get(name):
+                log.warning(
+                    "One or more tensors were submitted to be reduced/"
+                    "gathered but were not completed for %.0f seconds: %s. "
+                    "This may indicate a dead or diverged peer process.",
+                    age, name)
+                self._warned[name] = True
+            if self.shutdown_seconds and age > self.shutdown_seconds:
+                raise StallError(
+                    f"tensor {name} stalled for {age:.0f}s > "
+                    f"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
+                    f"{self.shutdown_seconds}")

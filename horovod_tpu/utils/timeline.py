@@ -1,0 +1,99 @@
+"""Chrome-trace timeline of collective activity.
+
+The reference writes a Chrome-trace JSON of every tensor's
+NEGOTIATE -> QUEUE -> EXEC lifecycle from a dedicated writer thread fed by a
+lock-free queue (reference: horovod/common/timeline.{h,cc}; tensors are
+modeled as chrome "pids", timeline.cc:244-254; activated by
+HOROVOD_TIMELINE, runtime start/stop operations.cc:740-769).
+
+Here the writer thread + queue survive; events come from the eager ops, the
+bucketed gradient sync, and (when enabled) cycle markers.  For deep XLA-level
+profiling users should additionally use ``jax.profiler`` (xprof) — this
+timeline covers the framework-level view the reference's does.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Dict, Optional
+
+
+class Timeline:
+    def __init__(self, path: str, mark_cycles: bool = False):
+        self.path = path
+        self.mark_cycles = mark_cycles
+        self._q: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self._pids: Dict[str, int] = {}
+        self._next_pid = 1
+        self._start = time.perf_counter_ns()
+        self._lock = threading.Lock()
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._closed = False
+        self._writer = threading.Thread(target=self._write_loop, daemon=True)
+        self._writer.start()
+
+    # ------------------------------------------------------------- internals
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._start) / 1e3
+
+    def _pid(self, tensor_name: str) -> int:
+        with self._lock:
+            pid = self._pids.get(tensor_name)
+            if pid is None:
+                pid = self._next_pid
+                self._next_pid += 1
+                self._pids[tensor_name] = pid
+                self._q.put({"name": "process_name", "ph": "M", "pid": pid,
+                             "args": {"name": tensor_name}})
+            return pid
+
+    def _write_loop(self) -> None:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                break
+            if not self._first:
+                self._file.write(",\n")
+            self._first = False
+            self._file.write(json.dumps(ev))
+        self._file.write("\n]\n")
+        self._file.close()
+
+    # ------------------------------------------------------------ public API
+    def begin(self, tensor_name: str, activity: str) -> None:
+        """Begin an activity phase for a tensor (B event)."""
+        self._q.put({"name": activity, "ph": "B", "pid": self._pid(tensor_name),
+                     "tid": 0, "ts": self._now_us()})
+
+    def end(self, tensor_name: str, activity: str) -> None:
+        self._q.put({"name": activity, "ph": "E", "pid": self._pid(tensor_name),
+                     "tid": 0, "ts": self._now_us()})
+
+    def record_op(self, tensor_name: str, op_type: str, size: int,
+                  duration_us: Optional[float] = None) -> None:
+        """Complete (X) event for one collective execution."""
+        self._q.put({"name": op_type, "ph": "X",
+                     "pid": self._pid(tensor_name), "tid": 0,
+                     "ts": self._now_us(),
+                     "dur": duration_us if duration_us is not None else 1.0,
+                     "args": {"size": int(size)}})
+
+    def mark_cycle(self) -> None:
+        """Negotiation-cycle tick (reference: HOROVOD_TIMELINE_MARK_CYCLES,
+        operations.cc:442-445)."""
+        if self.mark_cycles:
+            self._q.put({"name": "CYCLE", "ph": "i", "pid": 0, "tid": 0,
+                         "ts": self._now_us(), "s": "g"})
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._writer.join(timeout=5)

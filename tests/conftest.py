@@ -1,0 +1,41 @@
+"""Test fixtures: an 8-device virtual CPU mesh.
+
+The reference's "parallel" test tier runs every test file under a real
+launcher with 2 MPI/gloo ranks over localhost (reference:
+.buildkite/gen-pipeline.sh:128-151, test/utils/common.py:32-70).  The TPU
+analog is XLA host-platform device virtualization: one process, 8 virtual
+CPU devices, real collectives through the same shard_map/psum code paths
+that run on ICI.
+"""
+
+import os
+
+# Must be set before jax initializes its backends.  Force CPU: the ambient
+# environment may point JAX_PLATFORMS at real TPU hardware, which tests must
+# never touch.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Site customization on TPU images may have force-registered a hardware
+# backend and overridden jax_platforms via config (which beats the env var);
+# reset it before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def hvd():
+    import horovod_tpu as hvd
+    hvd.init()
+    yield hvd
+
+
+@pytest.fixture(scope="session")
+def eight_device_mesh(hvd):
+    return hvd.mesh()

@@ -1,0 +1,121 @@
+"""Auxiliary subsystem tests: timeline JSON structure (reference analog:
+test/parallel/test_timeline.py), stall inspector (reference:
+test/integration/test_stall.py), fusion planning, knob parsing."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.knobs import Knobs
+from horovod_tpu.common.exceptions import StallError
+from horovod_tpu.ops.fusion import make_plan, BucketPlanCache
+from horovod_tpu.utils.stall import StallInspector
+from horovod_tpu.utils.timeline import Timeline
+
+
+def test_timeline_json_structure(tmp_path):
+    """The timeline must be valid Chrome-trace JSON with per-tensor pids
+    (reference: timeline.cc:244-254 tensors as chrome pids)."""
+    path = str(tmp_path / "timeline.json")
+    tl = Timeline(path)
+    tl.begin("grad/w", "NEGOTIATE_ALLREDUCE")
+    tl.end("grad/w", "NEGOTIATE_ALLREDUCE")
+    tl.record_op("grad/w", "ALLREDUCE", 1024)
+    tl.record_op("grad/b", "ALLREDUCE", 64)
+    tl.close()
+    events = json.load(open(path))
+    names = {e["name"] for e in events}
+    assert "ALLREDUCE" in names
+    assert "process_name" in names  # pid metadata
+    pids = {e["pid"] for e in events if e["name"] == "process_name"}
+    assert len(pids) == 2  # one pid per tensor
+
+
+def test_timeline_via_eager_op(tmp_path, hvd):
+    """HOROVOD_TIMELINE runtime start/stop (reference: operations.cc:740-769)."""
+    path = str(tmp_path / "tl.json")
+    hvd.start_timeline(path)
+    hvd.allreduce(np.ones((hvd.local_size(), 4), np.float32), name="t0")
+    hvd.stop_timeline()
+    events = json.load(open(path))
+    assert any(e.get("name") == "ALLREDUCE" for e in events)
+
+
+def test_stall_inspector_warns_and_aborts():
+    si = StallInspector(warn_seconds=0, shutdown_seconds=0)
+    si.record_submit("g1")
+    time.sleep(0.01)
+    si.check()  # warns, no raise (shutdown disabled)
+    si.record_complete("g1")
+
+    si2 = StallInspector(warn_seconds=0, shutdown_seconds=0.005)
+    with pytest.raises(StallError):
+        si2.record_submit("g2")
+        time.sleep(0.01)
+        si2.check()
+
+
+def test_fusion_plan_threshold():
+    """Greedy same-dtype bucketing (reference: controller.cc:778-915)."""
+    shapes = [(1000,)] * 10
+    dtypes = [np.float32] * 10
+    plan = make_plan(shapes, dtypes, threshold_bytes=4000 * 3)
+    assert plan.num_buckets == 4  # 3+3+3+1
+    all_idx = sorted(i for b in plan.buckets for i in b.indices)
+    assert all_idx == list(range(10))
+
+
+def test_fusion_plan_dtype_separation():
+    """Mixed dtypes never share a bucket (reference dtype look-ahead)."""
+    shapes = [(10,), (10,), (10,)]
+    dtypes = [np.float32, np.int32, np.float32]
+    plan = make_plan(shapes, dtypes, threshold_bytes=1 << 20)
+    for b in plan.buckets:
+        assert len({str(b.dtype)}) == 1
+    assert plan.num_buckets == 2
+
+
+def test_fusion_oversized_tensor_own_bucket():
+    plan = make_plan([(100,), (10**6,), (100,)], [np.float32] * 3,
+                     threshold_bytes=1024)
+    assert plan.num_buckets >= 2
+
+
+def test_plan_cache_lru():
+    cache = BucketPlanCache(capacity=2)
+    p1 = cache.get([(4,)], [np.float32], 100)
+    p2 = cache.get([(4,)], [np.float32], 100)
+    assert p1 is p2 and cache.hits == 1
+    cache.get([(5,)], [np.float32], 100)
+    cache.get([(6,)], [np.float32], 100)  # evicts (4,)
+    cache.get([(4,)], [np.float32], 100)
+    assert cache.misses == 4
+
+
+def test_plan_cache_disabled():
+    cache = BucketPlanCache(capacity=0)
+    p1 = cache.get([(4,)], [np.float32], 100)
+    p2 = cache.get([(4,)], [np.float32], 100)
+    assert p1 is not p2
+    assert cache.hits == 0
+
+
+def test_knobs_env_parsing(monkeypatch):
+    """Env > default resolution (reference: utils/env_parser.cc)."""
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1024")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "true")
+    monkeypatch.setenv("HOROVOD_LOG_LEVEL", "debug")
+    k = Knobs()
+    assert k["HOROVOD_FUSION_THRESHOLD"] == 1024
+    assert k["HOROVOD_AUTOTUNE"] is True
+    assert k["HOROVOD_LOG_LEVEL"] == "debug"
+    assert k["HOROVOD_CACHE_CAPACITY"] == 1024  # default
+
+
+def test_knobs_overrides(monkeypatch):
+    monkeypatch.delenv("HOROVOD_CYCLE_TIME", raising=False)
+    k = Knobs({"HOROVOD_CYCLE_TIME": 5.0})
+    assert k["HOROVOD_CYCLE_TIME"] == 5.0

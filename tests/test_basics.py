@@ -1,0 +1,39 @@
+"""Init/topology API tests (reference analog: test/parallel/test_torch.py
+rank/size sanity via mpi_env_rank_and_size, test/utils/common.py:32-70)."""
+
+import numpy as np
+
+
+def test_init_idempotent(hvd):
+    rt1 = hvd.init()
+    rt2 = hvd.init()
+    assert rt1 is rt2
+
+
+def test_topology(hvd):
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.process_size() == 1
+    assert hvd.process_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.is_homogeneous()
+
+
+def test_built_flags(hvd):
+    assert hvd.tpu_built() and hvd.xla_built()
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+    assert not hvd.gloo_built()
+
+
+def test_mesh_shape(hvd):
+    assert hvd.mesh().devices.size == 8
+    assert hvd.mesh().axis_names == ("hvd",)
+
+
+def test_reduce_op_constants(hvd):
+    assert int(hvd.Average) == 0
+    assert int(hvd.Sum) == 1
+    assert int(hvd.Adasum) == 2
