@@ -1,0 +1,136 @@
+"""Benchmark: flagship training throughput on the available accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+Protocol mirrors the reference's synthetic benchmarks (reference:
+examples/pytorch/pytorch_synthetic_benchmark.py:104-109 — timed iterations
+of a full train step on synthetic data, mean over batches after warmup).
+
+``vs_baseline`` is model-FLOPs utilization (MFU) relative to the chip's
+bf16 peak — the hardware-normalized analog of the reference's
+scaling-efficiency-vs-ideal metric (BASELINE.md: >=90% scaling efficiency
+target).  MFU is computed from 6*N*tokens train FLOPs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+# bf16 peak TFLOP/s per chip by TPU generation (public spec sheets).
+PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+    "cpu": 0.5,  # nominal, so CPU smoke runs produce a finite ratio
+}
+
+
+def detect_chip() -> str:
+    import os
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    plat = jax.devices()[0].platform.lower()
+    if "cpu" in kind or plat == "cpu":
+        return "cpu"
+    for key in ("v6e", "v5p", "v5e", "v4"):
+        if key in kind:
+            return key
+    return os.environ.get("PALLAS_AXON_TPU_GEN", "") or "v5e"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--model", default="bench",
+                    choices=["bench", "tiny", "mini", "1b", "8b"])
+    ap.add_argument("--cpu", action="store_true",
+                    help="force CPU (smoke mode)")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import llama
+    from horovod_tpu.parallel.data_parallel import (make_train_step,
+                                                    replicate, shard_batch)
+
+    # ~350M-param decoder: big enough to keep the MXU busy on one chip,
+    # small enough to compile fast and fit HBM with optimizer state.
+    cfgs = dict(llama.CONFIGS)
+    cfgs["bench"] = llama.LlamaConfig(
+        vocab=32768, dim=1024, n_layers=8, n_heads=16, n_kv_heads=8,
+        ffn_dim=4096, max_seq=max(2048, args.seq),
+        dtype=jnp.bfloat16)
+    cfg = cfgs[args.model]
+    if args.cpu:
+        cfg = llama.CONFIGS["tiny"]
+        args.batch, args.seq = 4, 64
+
+    hvd.init()
+    mesh = hvd.mesh()
+    n_chips = hvd.size()
+
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    opt = optax.adamw(3e-4, weight_decay=0.01)
+    step = make_train_step(lambda p, ids: llama.loss_fn(p, ids, cfg),
+                           opt, mesh)
+    params = replicate(params, mesh)
+    opt_state = replicate(opt.init(params), mesh)
+
+    global_batch = args.batch * n_chips
+    rng = np.random.RandomState(0)
+    ids_host = rng.randint(0, cfg.vocab, (global_batch, args.seq + 1),
+                           dtype=np.int32)
+    ids = shard_batch(jnp.asarray(ids_host), mesh)
+
+    for _ in range(args.warmup):
+        params, opt_state, loss = step(params, opt_state, ids)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, ids)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = args.steps * global_batch * args.seq
+    tok_per_sec = tokens / dt
+    tok_per_sec_chip = tok_per_sec / n_chips
+
+    chip = detect_chip()
+    peak = PEAK_TFLOPS.get(chip, PEAK_TFLOPS["v5e"]) * 1e12
+    train_flops_per_token = 6.0 * n_params
+    mfu = (tok_per_sec_chip * train_flops_per_token) / peak
+
+    print(json.dumps({
+        "metric": f"llama-{n_params/1e6:.0f}M train tokens/sec/chip "
+                  f"({chip}, bf16, seq={args.seq})",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

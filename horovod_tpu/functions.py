@@ -57,13 +57,17 @@ def broadcast_object(obj: Any, root_rank: int = 0,
         return obj
     is_root = rt.process_rank() == root_rank
     payload = pickle.dumps(obj) if is_root else b""
-    sizes = C.process_allgather(np.array([len(payload)], np.int64))
-    size = int(np.max(sizes))
+    # Exchange (payload size, first-chip mesh position) from every process;
+    # the root chip must be looked up per-process because the mesh may
+    # permute device order (runtime.local_chip_positions).
+    meta = C.process_allgather(np.array(
+        [len(payload), rt.local_chip_positions()[0]], np.int64))
+    meta = np.asarray(meta).reshape(rt.process_size(), 2)
+    size = int(meta[:, 0].max())
+    root_chip = int(meta[root_rank, 1])
     buf = np.zeros(size, np.uint8)
     if is_root:
         buf[:len(payload)] = np.frombuffer(payload, np.uint8)
-    # Root process's chips hold the payload; broadcast from its first chip.
-    root_chip = root_rank * rt.local_size()
     out = np.asarray(C.broadcast(jnp.asarray(buf), root_rank=root_chip))
     return pickle.loads(out.tobytes())
 

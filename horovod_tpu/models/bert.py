@@ -1,0 +1,129 @@
+"""BERT encoder family (BERT-Large default).
+
+BASELINE config 4: "BERT-Large TF2 with tensor-fusion autotune +
+hvd.alltoall for seq-parallel".  Encoder-only transformer: learned position
+embeddings, post-norm residuals, GELU FFN, masked-LM head.  Written pure-JAX
+like the rest of the zoo; sequence parallelism applies via
+parallel/sequence.py's ulysses all_to_all attention wrapper.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab: int = 30522
+    dim: int = 1024          # BERT-Large hidden
+    n_layers: int = 24
+    n_heads: int = 16
+    ffn_dim: int = 4096
+    max_seq: int = 512
+    type_vocab: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+CONFIGS = {
+    "tiny": BertConfig(vocab=256, dim=64, n_layers=2, n_heads=4,
+                       ffn_dim=128, max_seq=64, dtype=jnp.float32),
+    "base": BertConfig(dim=768, n_layers=12, n_heads=12, ffn_dim=3072),
+    "large": BertConfig(),
+}
+
+
+def init_layer(key, cfg: BertConfig) -> Dict[str, Any]:
+    ks = jax.random.split(key, 6)
+    d = cfg.dim
+    s = 1.0 / math.sqrt(d)
+    return {
+        "wq": L.dense_init(ks[0], d, d, scale=s, dtype=cfg.dtype),
+        "wk": L.dense_init(ks[1], d, d, scale=s, dtype=cfg.dtype),
+        "wv": L.dense_init(ks[2], d, d, scale=s, dtype=cfg.dtype),
+        "wo": L.dense_init(ks[3], d, d, scale=s, dtype=cfg.dtype),
+        "ln1": L.layernorm_init(d),
+        "ffn_in": L.dense_init(ks[4], d, cfg.ffn_dim, scale=s,
+                               dtype=cfg.dtype),
+        "ffn_out": L.dense_init(ks[5], cfg.ffn_dim, d,
+                                scale=1.0 / math.sqrt(cfg.ffn_dim),
+                                dtype=cfg.dtype),
+        "ln2": L.layernorm_init(d),
+    }
+
+
+def init(key, cfg: BertConfig) -> Dict[str, Any]:
+    keys = jax.random.split(key, cfg.n_layers + 4)
+    return {
+        "tok_embed": L.embedding_init(keys[0], cfg.vocab, cfg.dim,
+                                      cfg.dtype),
+        "pos_embed": L.embedding_init(keys[1], cfg.max_seq, cfg.dim,
+                                      cfg.dtype),
+        "type_embed": L.embedding_init(keys[2], cfg.type_vocab, cfg.dim,
+                                       cfg.dtype),
+        "embed_ln": L.layernorm_init(cfg.dim),
+        "layers": [init_layer(keys[3 + i], cfg)
+                   for i in range(cfg.n_layers)],
+        "mlm_head": L.dense_init(keys[-1], cfg.dim, cfg.vocab,
+                                 scale=1.0 / math.sqrt(cfg.dim),
+                                 dtype=cfg.dtype),
+    }
+
+
+def apply_layer(p: Dict[str, Any], x: jax.Array, cfg: BertConfig,
+                pad_mask: Optional[jax.Array] = None,
+                attn_fn=None) -> jax.Array:
+    B, S, _ = x.shape
+    q = L.dense(p["wq"], x).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = L.dense(p["wk"], x).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    v = L.dense(p["wv"], x).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    mask = None
+    if pad_mask is not None:
+        mask = pad_mask[:, None, None, :]  # [B,1,1,S] keys
+    if attn_fn is None:
+        o = L.causal_attention(q, k, v, causal=False, mask=mask)
+    else:
+        o = attn_fn(q, k, v)
+    x = L.layernorm(p["ln1"],
+                    x + L.dense(p["wo"],
+                                o.reshape(B, S, cfg.dim)))
+    h = L.dense(p["ffn_out"], L.gelu(L.dense(p["ffn_in"], x)))
+    return L.layernorm(p["ln2"], x + h)
+
+
+def apply(params: Dict[str, Any], ids: jax.Array, cfg: BertConfig,
+          type_ids: Optional[jax.Array] = None,
+          pad_mask: Optional[jax.Array] = None,
+          attn_fn=None) -> jax.Array:
+    """ids: [B, S] -> MLM logits [B, S, vocab]."""
+    B, S = ids.shape
+    pos = jnp.arange(S)
+    x = (L.embedding(params["tok_embed"], ids)
+         + L.embedding(params["pos_embed"], pos)[None])
+    if type_ids is not None:
+        x = x + L.embedding(params["type_embed"], type_ids)
+    x = L.layernorm(params["embed_ln"], x).astype(cfg.dtype)
+    for p in params["layers"]:
+        x = apply_layer(p, x, cfg, pad_mask=pad_mask, attn_fn=attn_fn)
+    return L.dense(params["mlm_head"], x)
+
+
+def loss_fn(params, ids, labels, cfg: BertConfig,
+            mask: Optional[jax.Array] = None) -> jax.Array:
+    """Masked-LM cross-entropy; ``mask`` selects predicted positions."""
+    logits = apply(params, ids, cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)

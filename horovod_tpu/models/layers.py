@@ -1,0 +1,171 @@
+"""Minimal functional layer library for the bundled model zoo.
+
+The reference ships framework-native example models (reference:
+examples/pytorch/pytorch_mnist.py, examples/keras/..., tf_cnn_benchmarks via
+docs/benchmarks.rst).  Here the zoo is pure JAX: every layer is an
+``init(key, ...) -> params`` / ``apply(params, x, ...) -> y`` pair with
+params as plain dict pytrees, so models compose with pjit/shard_map sharding
+and optax without a framework dependency.
+
+TPU notes: matmul-heavy layers default to bfloat16-friendly shapes (multiples
+of 128 where it matters); convs use NHWC which XLA maps best onto the MXU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+# ------------------------------------------------------------------ dense/emb
+def dense_init(key, in_dim: int, out_dim: int, use_bias: bool = True,
+               scale: Optional[float] = None, dtype=jnp.float32) -> Params:
+    scale = scale if scale is not None else 1.0 / math.sqrt(in_dim)
+    p = {"kernel": jax.random.normal(key, (in_dim, out_dim), dtype) * scale}
+    if use_bias:
+        p["bias"] = jnp.zeros((out_dim,), dtype)
+    return p
+
+
+def dense(p: Params, x: jax.Array,
+          precision=None) -> jax.Array:
+    y = jnp.einsum("...i,io->...o", x, p["kernel"], precision=precision)
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+def embedding_init(key, vocab: int, dim: int, dtype=jnp.float32) -> Params:
+    return {"table": jax.random.normal(key, (vocab, dim), dtype) * 0.02}
+
+
+def embedding(p: Params, ids: jax.Array) -> jax.Array:
+    return jnp.take(p["table"], ids, axis=0)
+
+
+# ----------------------------------------------------------------- norms/acts
+def layernorm_init(dim: int, dtype=jnp.float32) -> Params:
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def layernorm(p: Params, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def rmsnorm_init(dim: int, dtype=jnp.float32) -> Params:
+    return {"scale": jnp.ones((dim,), dtype)}
+
+
+def rmsnorm(p: Params, x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    y = x32 * lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (y * p["scale"]).astype(x.dtype)
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    return jax.nn.gelu(x, approximate=True)
+
+
+# ----------------------------------------------------------------------- conv
+def conv_init(key, kh: int, kw: int, cin: int, cout: int,
+              dtype=jnp.float32) -> Params:
+    fan_in = kh * kw * cin
+    scale = math.sqrt(2.0 / fan_in)  # He init for ReLU nets
+    return {"kernel": jax.random.normal(key, (kh, kw, cin, cout),
+                                        dtype) * scale}
+
+
+def conv(p: Params, x: jax.Array, stride: int = 1,
+         padding: str = "SAME") -> jax.Array:
+    """NHWC conv — the layout XLA tiles onto the MXU."""
+    return lax.conv_general_dilated(
+        x, p["kernel"], window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def batchnorm_init(dim: int, dtype=jnp.float32) -> Params:
+    return {"scale": jnp.ones((dim,), dtype),
+            "bias": jnp.zeros((dim,), dtype),
+            "mean": jnp.zeros((dim,), dtype),
+            "var": jnp.ones((dim,), dtype)}
+
+
+def batchnorm(p: Params, x: jax.Array, training: bool = False,
+              momentum: float = 0.9, eps: float = 1e-5,
+              axis_name: Optional[str] = None
+              ) -> Tuple[jax.Array, Params]:
+    """BatchNorm over N,H,W.  With ``axis_name`` the batch statistics are
+    allreduced across the mesh axis — SyncBatchNorm (reference:
+    horovod/torch/sync_batch_norm.py, tensorflow sync_batch_norm.py:65
+    allreduce of batch mean/var)."""
+    if training:
+        x32 = x.astype(jnp.float32)
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x32, axis=axes)
+        var = jnp.mean(jnp.square(x32), axis=axes) - jnp.square(mean)
+        if axis_name is not None:
+            mean = lax.pmean(mean, axis_name)
+            var = lax.pmean(var, axis_name)
+        new_p = dict(p)
+        new_p["mean"] = momentum * p["mean"] + (1 - momentum) * mean
+        new_p["var"] = momentum * p["var"] + (1 - momentum) * var
+    else:
+        mean, var = p["mean"], p["var"]
+        new_p = p
+    y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype), new_p
+
+
+# ------------------------------------------------------------------ attention
+def rope_freqs(head_dim: int, max_len: int, theta: float = 10000.0,
+               dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    ang = jnp.outer(t, inv)  # [max_len, head_dim/2]
+    return jnp.cos(ang).astype(dtype), jnp.sin(ang).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               offset: int = 0) -> jax.Array:
+    """x: [..., seq, heads, head_dim]; rotary position embedding."""
+    seq = x.shape[-3]
+    c = lax.dynamic_slice_in_dim(cos, offset, seq, 0)[..., None, :]
+    s = lax.dynamic_slice_in_dim(sin, offset, seq, 0)[..., None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(x.dtype)
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     causal: bool = True,
+                     mask: Optional[jax.Array] = None) -> jax.Array:
+    """Multi-head attention core.  q: [B, S, H, D]; k/v: [B, S, Hkv, D]
+    (grouped-query when Hkv < H).  Softmax in fp32 for stability; einsum
+    contractions land on the MXU."""
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        Sk = k.shape[1]
+        causal_mask = jnp.tril(jnp.ones((S, Sk), jnp.bool_), k=Sk - S)
+        logits = jnp.where(causal_mask[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,163 @@
+"""Llama-3-style decoder transformer — the flagship model.
+
+BASELINE config 3: "Llama-3 8B torch FSDP-style shard with
+hvd.allgather/reduce_scatter + Adasum"; metric tokens/sec/chip.  This is a
+faithful Llama-3 architecture (RMSNorm pre-norm, RoPE theta=500000, GQA,
+SwiGLU), written pure-JAX so parallelism is applied from outside:
+
+  * DP:  shard batch over the mesh, sync grads via DistributedOptimizer.
+  * FSDP: shard params over the mesh axis; jax sharding constraints make XLA
+    insert all_gather on use + reduce_scatter on grads (parallel/fsdp.py).
+  * TP: head- and ffn-dim shardings (parallel/tensor.py).
+  * SP: sequence-sharded inputs with ulysses all_to_all or ring attention
+    (parallel/sequence.py).
+
+Sizes follow the published Llama-3 family; ``tiny``/``mini`` configs exist
+for tests and the single-chip bench.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+CONFIGS = {
+    "tiny": LlamaConfig(vocab=256, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, ffn_dim=128, max_seq=128,
+                        dtype=jnp.float32),
+    "mini": LlamaConfig(vocab=4096, dim=512, n_layers=4, n_heads=8,
+                        n_kv_heads=4, ffn_dim=1024, max_seq=1024),
+    "1b": LlamaConfig(vocab=128256, dim=2048, n_layers=16, n_heads=32,
+                      n_kv_heads=8, ffn_dim=8192, max_seq=8192),
+    "8b": LlamaConfig(),  # Llama-3-8B
+}
+
+
+def init_layer(key, cfg: LlamaConfig) -> Dict[str, Any]:
+    ks = jax.random.split(key, 7)
+    d, hd = cfg.dim, cfg.head_dim
+    scale = 1.0 / math.sqrt(d)
+    return {
+        "attn_norm": L.rmsnorm_init(d, cfg.dtype),
+        "wq": L.dense_init(ks[0], d, cfg.n_heads * hd, use_bias=False,
+                           scale=scale, dtype=cfg.dtype),
+        "wk": L.dense_init(ks[1], d, cfg.n_kv_heads * hd, use_bias=False,
+                           scale=scale, dtype=cfg.dtype),
+        "wv": L.dense_init(ks[2], d, cfg.n_kv_heads * hd, use_bias=False,
+                           scale=scale, dtype=cfg.dtype),
+        "wo": L.dense_init(ks[3], cfg.n_heads * hd, d, use_bias=False,
+                           scale=scale, dtype=cfg.dtype),
+        "ffn_norm": L.rmsnorm_init(d, cfg.dtype),
+        "w_gate": L.dense_init(ks[4], d, cfg.ffn_dim, use_bias=False,
+                               scale=scale, dtype=cfg.dtype),
+        "w_up": L.dense_init(ks[5], d, cfg.ffn_dim, use_bias=False,
+                             scale=scale, dtype=cfg.dtype),
+        "w_down": L.dense_init(ks[6], cfg.ffn_dim, d, use_bias=False,
+                               scale=1.0 / math.sqrt(cfg.ffn_dim),
+                               dtype=cfg.dtype),
+    }
+
+
+def init(key, cfg: LlamaConfig) -> Dict[str, Any]:
+    keys = jax.random.split(key, cfg.n_layers + 3)
+    params: Dict[str, Any] = {
+        "embed": L.embedding_init(keys[0], cfg.vocab, cfg.dim, cfg.dtype),
+        "final_norm": L.rmsnorm_init(cfg.dim, cfg.dtype),
+        "lm_head": L.dense_init(keys[1], cfg.dim, cfg.vocab, use_bias=False,
+                                scale=1.0 / math.sqrt(cfg.dim),
+                                dtype=cfg.dtype),
+        "layers": [init_layer(keys[2 + i], cfg)
+                   for i in range(cfg.n_layers)],
+    }
+    return params
+
+
+def _attn(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig,
+          cos: jax.Array, sin: jax.Array,
+          attn_fn=None) -> jax.Array:
+    B, S, _ = x.shape
+    q = L.dense(p["wq"], x).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = L.dense(p["wk"], x).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    v = L.dense(p["wv"], x).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    q = L.apply_rope(q, cos, sin)
+    k = L.apply_rope(k, cos, sin)
+    if attn_fn is None:
+        o = L.causal_attention(q, k, v, causal=True)
+    else:
+        o = attn_fn(q, k, v)
+    return L.dense(p["wo"], o.reshape(B, S, cfg.n_heads * cfg.head_dim))
+
+
+def _ffn(p: Dict[str, Any], x: jax.Array) -> jax.Array:
+    return L.dense(p["w_down"],
+                   jax.nn.silu(L.dense(p["w_gate"], x)) *
+                   L.dense(p["w_up"], x))
+
+
+def apply_layer(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig,
+                cos: jax.Array, sin: jax.Array,
+                attn_fn=None) -> jax.Array:
+    x = x + _attn(p, L.rmsnorm(p["attn_norm"], x), cfg, cos, sin, attn_fn)
+    x = x + _ffn(p, L.rmsnorm(p["ffn_norm"], x))
+    return x
+
+
+def apply(params: Dict[str, Any], ids: jax.Array, cfg: LlamaConfig,
+          attn_fn=None, remat: bool = False) -> jax.Array:
+    """Forward: token ids [B, S] -> logits [B, S, vocab].
+
+    ``remat=True`` wraps each layer in jax.checkpoint — rematerialization
+    trades FLOPs for HBM, the standard TPU memory lever."""
+    cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    x = L.embedding(params["embed"], ids).astype(cfg.dtype)
+    layer = apply_layer
+    if remat:
+        layer = jax.checkpoint(apply_layer, static_argnums=(2, 5))
+
+    for p in params["layers"]:
+        x = layer(p, x, cfg, cos, sin, attn_fn)
+    x = L.rmsnorm(params["final_norm"], x)
+    return L.dense(params["lm_head"], x)
+
+
+def loss_fn(params: Dict[str, Any], ids: jax.Array, cfg: LlamaConfig,
+            attn_fn=None, remat: bool = False) -> jax.Array:
+    """Next-token cross-entropy over shifted ids."""
+    logits = apply(params, ids[:, :-1], cfg, attn_fn=attn_fn, remat=remat)
+    targets = ids[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def param_count(cfg: LlamaConfig) -> int:
+    per_layer = (cfg.dim * cfg.n_heads * cfg.head_dim
+                 + 2 * cfg.dim * cfg.n_kv_heads * cfg.head_dim
+                 + cfg.n_heads * cfg.head_dim * cfg.dim
+                 + 3 * cfg.dim * cfg.ffn_dim + 2 * cfg.dim)
+    return (cfg.vocab * cfg.dim * 2 + cfg.dim
+            + cfg.n_layers * per_layer)

@@ -1,0 +1,36 @@
+"""MNIST MLP — the minimum end-to-end model (reference:
+examples/pytorch/pytorch_mnist.py Net: two conv layers in the reference's
+example; the BASELINE config 1 'pytorch MNIST with hvd.DistributedOptimizer'
+is matched by this classifier trained data-parallel)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+
+def init(key, in_dim: int = 784, hidden: int = 512, classes: int = 10,
+         dtype=jnp.float32) -> Dict[str, Any]:
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "fc1": L.dense_init(k1, in_dim, hidden, dtype=dtype),
+        "fc2": L.dense_init(k2, hidden, hidden, dtype=dtype),
+        "out": L.dense_init(k3, hidden, classes, dtype=dtype),
+    }
+
+
+def apply(params: Dict[str, Any], x: jax.Array) -> jax.Array:
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(L.dense(params["fc1"], x))
+    x = jax.nn.relu(L.dense(params["fc2"], x))
+    return L.dense(params["out"], x)
+
+
+def loss_fn(params: Dict[str, Any], x: jax.Array, y: jax.Array) -> jax.Array:
+    logits = apply(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))

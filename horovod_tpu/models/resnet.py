@@ -1,0 +1,121 @@
+"""ResNet v1.5 family (ResNet-50/101) in pure JAX, NHWC.
+
+The reference's headline benchmark is ResNet-101/Inception-V3 throughput via
+tf_cnn_benchmarks with ``--variable_update horovod`` (reference:
+docs/benchmarks.rst:12-43); the rebuild's BASELINE target is ResNet-50
+images/sec/chip.  Bottleneck blocks, stride-in-3x3 (v1.5), BatchNorm with
+optional cross-chip sync (reference: sync_batch_norm.py).
+
+TPU design: NHWC + bf16 activations keep convs on the MXU; BN statistics in
+fp32.  Params and BN state are separate pytrees so the train step stays
+functional.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+STAGES = {
+    18: (2, 2, 2, 2),
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+
+
+def _bottleneck_init(key, cin: int, width: int, stride: int,
+                     dtype) -> Dict[str, Any]:
+    ks = jax.random.split(key, 4)
+    cout = width * 4
+    p = {
+        "conv1": L.conv_init(ks[0], 1, 1, cin, width, dtype),
+        "bn1": L.batchnorm_init(width),
+        "conv2": L.conv_init(ks[1], 3, 3, width, width, dtype),
+        "bn2": L.batchnorm_init(width),
+        "conv3": L.conv_init(ks[2], 1, 1, width, cout, dtype),
+        "bn3": L.batchnorm_init(cout),
+    }
+    if stride != 1 or cin != cout:
+        p["proj"] = L.conv_init(ks[3], 1, 1, cin, cout, dtype)
+        p["bn_proj"] = L.batchnorm_init(cout)
+    return p
+
+
+def _bottleneck_apply(p, x, stride: int, training: bool,
+                      axis_name) -> Tuple[jax.Array, Dict[str, Any]]:
+    out = dict(p)
+    y = L.conv(p["conv1"], x)
+    y, out["bn1"] = L.batchnorm(p["bn1"], y, training, axis_name=axis_name)
+    y = jax.nn.relu(y)
+    y = L.conv(p["conv2"], y, stride=stride)
+    y, out["bn2"] = L.batchnorm(p["bn2"], y, training, axis_name=axis_name)
+    y = jax.nn.relu(y)
+    y = L.conv(p["conv3"], y)
+    y, out["bn3"] = L.batchnorm(p["bn3"], y, training, axis_name=axis_name)
+    if "proj" in p:
+        sc = L.conv(p["proj"], x, stride=stride)
+        sc, out["bn_proj"] = L.batchnorm(p["bn_proj"], sc, training,
+                                         axis_name=axis_name)
+    else:
+        sc = x
+    return jax.nn.relu(y + sc), out
+
+
+def init(key, depth: int = 50, classes: int = 1000,
+         dtype=jnp.float32) -> Dict[str, Any]:
+    if depth not in STAGES:
+        raise ValueError(f"unsupported depth {depth}")
+    blocks = STAGES[depth]
+    keys = jax.random.split(key, sum(blocks) + 2)
+    ki = iter(keys)
+    params: Dict[str, Any] = {
+        "stem": L.conv_init(next(ki), 7, 7, 3, 64, dtype),
+        "bn_stem": L.batchnorm_init(64),
+    }
+    cin = 64
+    for stage, nblocks in enumerate(blocks):
+        width = 64 * (2 ** stage)
+        for b in range(nblocks):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            params[f"s{stage}b{b}"] = _bottleneck_init(
+                next(ki), cin, width, stride, dtype)
+            cin = width * 4
+    params["head"] = L.dense_init(next(ki), cin, classes, dtype=dtype)
+    return params
+
+
+def apply(params: Dict[str, Any], x: jax.Array, depth: int = 50,
+          training: bool = False, axis_name: Optional[str] = None
+          ) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Forward pass.  x: [N, H, W, 3].  Returns (logits, new_params) where
+    new_params carries updated BN running stats when training."""
+    blocks = STAGES[depth]
+    out = dict(params)
+    y = L.conv(params["stem"], x, stride=2)
+    y, out["bn_stem"] = L.batchnorm(params["bn_stem"], y, training,
+                                    axis_name=axis_name)
+    y = jax.nn.relu(y)
+    y = jax.lax.reduce_window(y, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    for stage, nblocks in enumerate(blocks):
+        for b in range(nblocks):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            name = f"s{stage}b{b}"
+            y, out[name] = _bottleneck_apply(params[name], y, stride,
+                                             training, axis_name)
+    y = jnp.mean(y, axis=(1, 2))
+    return L.dense(params["head"], y), out
+
+
+def loss_fn(params, x, y_true, depth: int = 50, training: bool = True,
+            axis_name: Optional[str] = None):
+    logits, new_params = apply(params, x, depth=depth, training=training,
+                               axis_name=axis_name)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    loss = -jnp.mean(jnp.take_along_axis(logp, y_true[:, None], axis=1))
+    return loss, new_params

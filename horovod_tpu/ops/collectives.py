@@ -264,9 +264,15 @@ def allgather_ragged(tensors: Sequence[TensorLike],
     arrs = [jnp.asarray(t) for t in tensors]
     rows = [int(a.shape[0]) for a in arrs]
     # Host-side size exchange across processes (the negotiation analog).
+    # process_allgather is process-major; collectives number chips by mesh
+    # position, so re-index via the process->chip-position map.
     if rt.process_size() > 1:
-        all_rows = process_allgather(np.array(rows, np.int64))
-        all_rows = list(np.asarray(all_rows).reshape(-1))
+        per_proc = np.asarray(process_allgather(
+            np.array(rows, np.int64))).reshape(rt.process_size(), ls)
+        all_rows = [0] * rt.size()
+        for p, positions in enumerate(rt.chip_positions_by_process()):
+            for j, pos in enumerate(positions):
+                all_rows[pos] = int(per_proc[p, j])
     else:
         all_rows = rows
     max_rows = int(max(all_rows))
@@ -320,7 +326,12 @@ def alltoall(tensor: TensorLike,
     if sp.ndim == 1:
         sp = np.broadcast_to(sp[None], (rt.local_size(), n)).copy()
     if rt.process_size() > 1:
-        all_sp = np.asarray(process_allgather(sp)).reshape(n, n)
+        per_proc = np.asarray(process_allgather(sp)).reshape(
+            rt.process_size(), rt.local_size(), n)
+        all_sp = np.zeros((n, n), np.int64)  # [src_chip_pos, dst_chip_pos]
+        for p, positions in enumerate(rt.chip_positions_by_process()):
+            for j, pos in enumerate(positions):
+                all_sp[pos] = per_proc[p, j]
     else:
         all_sp = sp  # [size, size]: all_sp[src, dst]
     max_blk = int(all_sp.max())
@@ -340,9 +351,9 @@ def alltoall(tensor: TensorLike,
     g = _make_global(rt, padded)
     fn = _compiled(_mesh_key(rt), "alltoall")
     out = _to_local(rt, fn(g))  # [ls, n*max_blk, ...]
-    # recv_splits[i, src] = all_sp[src, global_chip_index(i)]
-    first = rt.rank()
-    recv_np = np.stack([all_sp[:, first + i] for i in range(ls)])
+    # recv_splits[i, src] = all_sp[src, mesh position of local chip i]
+    local_pos = rt.local_chip_positions()
+    recv_np = np.stack([all_sp[:, local_pos[i]] for i in range(ls)])
     outs = []
     for i in range(ls):
         blocks = [out[i, s * max_blk: s * max_blk + int(recv_np[i, s])]

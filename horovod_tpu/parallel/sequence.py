@@ -1,0 +1,143 @@
+"""Sequence / context parallelism: Ulysses all_to_all + ring attention.
+
+The reference stops at the ``alltoall`` primitive users build SP from
+(reference: operations.cc:1136-1198; SURVEY.md §5 — no built-in ring
+attention).  Long-context is first-class here:
+
+* **Ulysses** (all_to_all SP): inputs sharded over sequence; one all_to_all
+  re-shards to head-parallel, full attention runs locally on H/n heads, a
+  second all_to_all restores sequence sharding.  Cost: 2 all_to_alls per
+  attention; works while n_sp <= n_kv_heads.
+
+* **Ring attention**: k/v blocks rotate around the mesh axis ring via
+  `lax.ppermute` (ICI neighbor exchanges) while each chip accumulates its
+  queries' attention with an online-softmax (flash-style m/l/o running
+  state).  Supports causal masking by block index; sequence length scales
+  linearly with chips.
+
+Both are SPMD functions used inside shard_map with the ``sp`` axis, and
+slot into models via the ``attn_fn`` hook (models/llama.py, bert.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# ------------------------------------------------------------------- ulysses
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "sp",
+                      causal: bool = True) -> jax.Array:
+    """Attention over sequence-sharded q/k/v: [B, S/n, H, D] per chip.
+
+    all_to_all trades the sequence shard for a head shard so every chip
+    sees the full sequence for its H/n heads, then trades back."""
+    from ..models.layers import causal_attention
+    n = lax.psum(1, axis_name)
+    H = q.shape[2]
+    if H % n != 0:
+        raise ValueError(f"heads {H} not divisible by sp axis size {n}")
+    # [B, S/n, H, D] -> [B, S, H/n, D]: split heads (axis 2), concat seq (1)
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    o = causal_attention(qh, kh, vh, causal=causal)
+    # back: [B, S, H/n, D] -> [B, S/n, H, D]
+    return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+# -------------------------------------------------------------- ring attention
+def _block_attend(q, k, v, q_off, k_off, causal: bool,
+                  m, l, o):
+    """One flash-style accumulation step against a k/v block.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; m/l: [B, H, Sq]; o like q.
+    Returns updated (m, l, o).  Softmax statistics kept in fp32."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qi = q_off + jnp.arange(Sq)
+        ki = k_off + jnp.arange(Sk)
+        mask = qi[:, None] >= ki[None, :]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+    # guard fully-masked rows (m_new == -1e30): exp underflows to 0, fine.
+    p = jnp.exp(logits - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None].astype(o.dtype) + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp",
+                   causal: bool = True) -> jax.Array:
+    """Ring attention over a sequence-sharded batch: [B, S/n, H, D] per chip.
+
+    k/v blocks travel the ring (ppermute shift +1) for n steps; each chip
+    accumulates online-softmax partial attention for its query block.
+    GQA inputs (Hkv < H) are repeated up front."""
+    n = int(lax.psum(1, axis_name))
+    idx = lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    Sk = k.shape[1]
+
+    m0 = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
+    # The carries become device-varying inside the loop (they mix with q);
+    # mark the initial values varying so the fori_loop types line up.
+    if hasattr(lax, "pvary"):
+        axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+
+        def _varying(t):
+            vma = getattr(jax.typeof(t), "vma", frozenset())
+            missing = tuple(a for a in axes if a not in vma)
+            return lax.pvary(t, missing) if missing else t
+        m0, l0, o0 = _varying(m0), _varying(l0), _varying(o0)
+    q_off = idx * Sq
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(step, carry):
+        m, l, o, kk, vv = carry
+        # Block that started on chip (idx - step) mod n is now local.
+        src = (idx - step) % n
+        k_off = src * Sk
+        m, l, o = _block_attend(q, kk, vv, q_off, k_off, causal, m, l, o)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return m, l, o, kk, vv
+
+    m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attn_fn(axis_name: str = "sp", causal: bool = True):
+    """attn_fn hook for the model zoo (models/llama.py apply(attn_fn=...))."""
+    return functools.partial(ring_attention, axis_name=axis_name,
+                             causal=causal)
+
+
+def make_ulysses_attn_fn(axis_name: str = "sp", causal: bool = True):
+    return functools.partial(ulysses_attention, axis_name=axis_name,
+                             causal=causal)

@@ -62,13 +62,20 @@ class Runtime:
         # Multi-host bring-up: the launcher (hvdrun) exports coordinator
         # address + process coordinates (the analog of mpirun exporting
         # HOROVOD_RANK/SIZE per slot, reference: gloo_run.py:65-77).
+        # jax.distributed.initialize must run before ANY backend-touching
+        # call (including jax.process_count()), so gate purely on env/knobs.
         coord = self.knobs["HOROVOD_COORDINATOR_ADDR"]
-        if coord and jax.process_count() == 1 and self.knobs["HOROVOD_SIZE"] > 1:
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=self.knobs["HOROVOD_SIZE"],
-                process_id=max(self.knobs["HOROVOD_RANK"], 0),
-            )
+        if coord and self.knobs["HOROVOD_SIZE"] > 1:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=self.knobs["HOROVOD_SIZE"],
+                    process_id=max(self.knobs["HOROVOD_RANK"], 0),
+                )
+            except RuntimeError as e:
+                # Already initialized (e.g. by user code) is fine.
+                if "already" not in str(e).lower():
+                    raise
 
         self.devices = list(devices if devices is not None else jax.devices())
         self._process_index = jax.process_index()
@@ -162,7 +169,25 @@ class Runtime:
         return self.devices.index(first)
 
     def local_rank(self) -> int:
-        return 0
+        """Process index within its host when launched by hvdrun (reference
+        semantics: HOROVOD_LOCAL_RANK, gloo_run.py:65-77); 0 standalone."""
+        lr = self.knobs["HOROVOD_LOCAL_RANK"]
+        return lr if lr >= 0 else 0
+
+    def local_chip_positions(self) -> List[int]:
+        """Mesh-flattened positions of this process's chips, in the order
+        local data rows map to them (increasing mesh position)."""
+        return [i for i, d in enumerate(self.devices)
+                if d.process_index == self._process_index]
+
+    def chip_positions_by_process(self) -> List[List[int]]:
+        """For each process index, the mesh positions of its chips (in
+        increasing order) — the host-side map between process-major data
+        (process_allgather results) and chip-major collective numbering."""
+        out: List[List[int]] = [[] for _ in range(self._process_count)]
+        for i, d in enumerate(self.devices):
+            out[d.process_index].append(i)
+        return out
 
     # Process-level coordinates: CROSS scope in the reference.
     def process_rank(self) -> int:
@@ -184,6 +209,8 @@ class Runtime:
         self._shutdown = True
         if self.timeline is not None:
             self.timeline.close()
+        if self.stall_inspector is not None:
+            self.stall_inspector.close()
         if self.core is not None:
             self.core.shutdown()
 

@@ -22,26 +22,58 @@ from ..common.exceptions import StallError
 
 
 class StallInspector:
-    def __init__(self, warn_seconds: int = 60, shutdown_seconds: int = 0):
+    """Runs its periodic check on a daemon thread — the submitting thread is
+    blocked inside the hung collective when a stall actually happens, so it
+    cannot run the check itself (the reference's check runs on the C++
+    background coordination thread for the same reason).
+
+    The watcher thread can't raise into the blocked thread; past the
+    shutdown threshold it logs FATAL and hard-exits the process, matching
+    the reference's stall-shutdown behavior."""
+
+    def __init__(self, warn_seconds: int = 60, shutdown_seconds: int = 0,
+                 poll_interval: float = 1.0, hard_exit: bool = True):
+        import threading
         self.warn_seconds = warn_seconds
         self.shutdown_seconds = shutdown_seconds
+        self.hard_exit = hard_exit
         self._pending: Dict[str, float] = {}
         self._warned: Dict[str, bool] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._watch_loop, args=(poll_interval,), daemon=True)
+        self._thread.start()
+
+    def _watch_loop(self, interval: float) -> None:
+        import os
+        while not self._stop.wait(interval):
+            try:
+                self.check()
+            except StallError as e:
+                log.error("stall shutdown: %s", e)
+                if self.hard_exit:
+                    os._exit(42)
+
+    def close(self) -> None:
+        self._stop.set()
 
     def record_submit(self, name: str) -> None:
-        self._pending.setdefault(name, time.monotonic())
-        self.check()
+        with self._lock:
+            self._pending.setdefault(name, time.monotonic())
 
     def record_complete(self, name: str) -> None:
-        self._pending.pop(name, None)
-        self._warned.pop(name, None)
+        with self._lock:
+            self._pending.pop(name, None)
+            self._warned.pop(name, None)
 
     def check(self) -> None:
         """Warn/abort on overdue tensors (reference:
         StallInspector::CheckForStalledTensors)."""
         now = time.monotonic()
-        stalled = [(n, now - t) for n, t in self._pending.items()
-                   if now - t > self.warn_seconds]
+        with self._lock:
+            stalled = [(n, now - t) for n, t in self._pending.items()
+                       if now - t > self.warn_seconds]
         for name, age in stalled:
             if not self._warned.get(name):
                 log.warning(

@@ -45,17 +45,33 @@ def test_timeline_via_eager_op(tmp_path, hvd):
 
 
 def test_stall_inspector_warns_and_aborts():
-    si = StallInspector(warn_seconds=0, shutdown_seconds=0)
+    si = StallInspector(warn_seconds=0, shutdown_seconds=0, hard_exit=False)
     si.record_submit("g1")
     time.sleep(0.01)
     si.check()  # warns, no raise (shutdown disabled)
     si.record_complete("g1")
+    si.close()
 
-    si2 = StallInspector(warn_seconds=0, shutdown_seconds=0.005)
+    si2 = StallInspector(warn_seconds=0, shutdown_seconds=0.005,
+                         hard_exit=False)
     with pytest.raises(StallError):
         si2.record_submit("g2")
         time.sleep(0.01)
         si2.check()
+    si2.record_complete("g2")
+    si2.close()
+
+
+def test_stall_watchdog_fires_from_background_thread():
+    """The watchdog must detect a stall while the submitting thread is
+    blocked (reference: coordinator-side check, controller.cc:126-135)."""
+    fired = []
+    si = StallInspector(warn_seconds=0.01, shutdown_seconds=0,
+                        poll_interval=0.02, hard_exit=False)
+    si.record_submit("hung_op")
+    time.sleep(0.2)  # main thread "blocked"; watcher should warn meanwhile
+    assert si._warned.get("hung_op"), "background watchdog never warned"
+    si.close()
 
 
 def test_fusion_plan_threshold():
