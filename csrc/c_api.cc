@@ -1,0 +1,142 @@
+// extern "C" surface, loaded from Python via ctypes (the analog of the
+// reference's extern "C" init/rank/enqueue API, operations.cc:710-915,
+// consumed by horovod/common/basics.py).
+
+#include <cstring>
+#include <string>
+
+#include "core.h"
+
+using namespace hvdtpu;
+
+namespace {
+CoreOptions MakeOptions(double cycle_ms, long fusion_bytes, int cache_cap,
+                        double stall_warn_s) {
+  CoreOptions o;
+  o.cycle_time_ms = cycle_ms;
+  o.controller.fusion_threshold_bytes = fusion_bytes;
+  o.controller.cache_capacity = cache_cap;
+  o.controller.stall_warn_seconds = stall_warn_s;
+  return o;
+}
+
+// Copy a std::string into a caller buffer; returns needed size.
+int CopyOut(const std::string& s, char* buf, int buflen) {
+  int n = static_cast<int>(s.size());
+  if (buf && buflen > n) {
+    memcpy(buf, s.data(), n);
+    buf[n] = '\0';
+  }
+  return n;
+}
+
+// Response -> "TYPE|OP|total_bytes|err|name1,name2,..."
+std::string FormatResponse(const Response& r) {
+  static const char* kTypes[] = {"OK", "ERROR", "JOIN_DONE", "SHUTDOWN"};
+  std::string s = kTypes[static_cast<int>(r.type)];
+  s += "|";
+  s += std::to_string(static_cast<int>(r.op));
+  s += "|";
+  s += std::to_string(r.total_bytes);
+  s += "|";
+  s += r.error_message;
+  s += "|";
+  for (size_t i = 0; i < r.names.size(); i++) {
+    if (i) s += ",";
+    s += r.names[i];
+  }
+  return s;
+}
+}  // namespace
+
+extern "C" {
+
+void* hvd_loopback_hub_create(int size) { return new LoopbackHub(size); }
+void hvd_loopback_hub_destroy(void* hub) {
+  delete static_cast<LoopbackHub*>(hub);
+}
+
+void* hvd_core_create_loopback(void* hub, int rank, double cycle_ms,
+                               long fusion_bytes, int cache_cap,
+                               double stall_warn_s) {
+  auto t = std::unique_ptr<Transport>(
+      new LoopbackTransport(static_cast<LoopbackHub*>(hub), rank));
+  return new Core(std::move(t),
+                  MakeOptions(cycle_ms, fusion_bytes, cache_cap,
+                              stall_warn_s));
+}
+
+void* hvd_core_create_tcp(int rank, int size, const char* addr, int port,
+                          int timeout_ms, double cycle_ms, long fusion_bytes,
+                          int cache_cap, double stall_warn_s) {
+  auto t = std::unique_ptr<TcpTransport>(
+      new TcpTransport(rank, size, addr ? addr : "127.0.0.1", port,
+                       timeout_ms));
+  if (!t->ok()) {
+    return nullptr;
+  }
+  return new Core(std::unique_ptr<Transport>(std::move(t)),
+                  MakeOptions(cycle_ms, fusion_bytes, cache_cap,
+                              stall_warn_s));
+}
+
+void hvd_core_destroy(void* h) { delete static_cast<Core*>(h); }
+
+int hvd_core_rank(void* h) { return static_cast<Core*>(h)->rank(); }
+int hvd_core_size(void* h) { return static_cast<Core*>(h)->size(); }
+int hvd_core_healthy(void* h) {
+  return static_cast<Core*>(h)->healthy() ? 1 : 0;
+}
+
+// op: RequestType; returns 0 ok, -1 duplicate name, -2 shut down.
+int hvd_core_submit(void* h, const char* name, const char* signature,
+                    int op, long bytes) {
+  Core* core = static_cast<Core*>(h);
+  Request r;
+  r.rank = core->rank();
+  r.type = static_cast<RequestType>(op);
+  r.name = name ? name : "";
+  r.signature = signature ? signature : "";
+  r.bytes = bytes;
+  if (r.name.find('|') != std::string::npos ||
+      r.name.find(',') != std::string::npos)
+    return -3;  // reserved delimiters
+  return core->Submit(r);
+}
+
+int hvd_core_join(void* h) {
+  Core* core = static_cast<Core*>(h);
+  Request r;
+  r.rank = core->rank();
+  r.type = RequestType::JOIN;
+  r.name = "__join__";
+  return core->Submit(r);
+}
+
+// Non-blocking poll; returns formatted length (0 = none pending).
+int hvd_core_poll(void* h, char* buf, int buflen) {
+  Response r;
+  if (!static_cast<Core*>(h)->Poll(&r)) return 0;
+  return CopyOut(FormatResponse(r), buf, buflen);
+}
+
+// Blocking wait; returns length, 0 on timeout.
+int hvd_core_wait(void* h, double timeout_s, char* buf, int buflen) {
+  Response r;
+  if (!static_cast<Core*>(h)->Wait(&r, timeout_s)) return 0;
+  return CopyOut(FormatResponse(r), buf, buflen);
+}
+
+void hvd_core_shutdown(void* h) { static_cast<Core*>(h)->Shutdown(); }
+
+// stats: cycles, cache_hits, cache_misses, stall_warnings, responses
+void hvd_core_stats(void* h, unsigned long long* out5) {
+  ControllerStats s = static_cast<Core*>(h)->stats();
+  out5[0] = s.cycles;
+  out5[1] = s.cache_hits;
+  out5[2] = s.cache_misses;
+  out5[3] = s.stall_warnings;
+  out5[4] = s.responses;
+}
+
+}  // extern "C"

@@ -1,0 +1,213 @@
+#include "controller.h"
+
+#include <algorithm>
+#include <cstdio>
+
+namespace hvdtpu {
+
+namespace {
+// Leading token of the signature is the dtype (frontend contract:
+// "dtype:shape:op:..."), used for same-dtype fusion grouping like the
+// reference's dtype look-ahead (controller.cc:778-915).
+std::string SigDtype(const std::string& sig) {
+  auto pos = sig.find(':');
+  return pos == std::string::npos ? sig : sig.substr(0, pos);
+}
+}  // namespace
+
+bool Controller::CacheLookup(const std::string& name,
+                             const std::string& sig) {
+  if (opts_.cache_capacity <= 0) return false;
+  auto it = cache_map_.find(name);
+  if (it != cache_map_.end() && it->second->second == sig) {
+    cache_lru_.splice(cache_lru_.end(), cache_lru_, it->second);
+    stats_.cache_hits++;
+    return true;
+  }
+  stats_.cache_misses++;
+  if (it != cache_map_.end()) {
+    cache_lru_.erase(it->second);
+    cache_map_.erase(it);
+  }
+  cache_lru_.emplace_back(name, sig);
+  cache_map_[name] = std::prev(cache_lru_.end());
+  while (static_cast<int>(cache_lru_.size()) > opts_.cache_capacity) {
+    cache_map_.erase(cache_lru_.front().first);
+    cache_lru_.pop_front();
+  }
+  return false;
+}
+
+void Controller::Ingest(const Request& req, int /*rank*/) {
+  auto it = table_.find(req.name);
+  if (it == table_.end()) {
+    Entry e;
+    e.first_seen = std::chrono::steady_clock::now();
+    it = table_.emplace(req.name, std::move(e)).first;
+    arrival_order_.push_back(req.name);
+  }
+  it->second.requests.push_back(req);
+}
+
+void Controller::CheckStalls() {
+  auto now = std::chrono::steady_clock::now();
+  for (auto& kv : table_) {
+    double age = std::chrono::duration<double>(
+        now - kv.second.first_seen).count();
+    if (age > opts_.stall_warn_seconds && !kv.second.warned) {
+      kv.second.warned = true;
+      stats_.stall_warnings++;
+      fprintf(stderr,
+              "[hvd_tpu_core] WARNING: tensor %s submitted by %zu/%d ranks "
+              "for %.0fs — possible stalled or diverged peer\n",
+              kv.first.c_str(), kv.second.requests.size(), size(), age);
+    }
+  }
+}
+
+std::vector<Response> Controller::BuildResponses() {
+  int n = size();
+  if (joined_.empty()) joined_.assign(n, false);
+  int num_joined = static_cast<int>(
+      std::count(joined_.begin(), joined_.end(), true));
+
+  struct PreFused {
+    Response r;
+    std::string dtype;  // fusion group key
+  };
+  std::vector<PreFused> ready;  // per-tensor, pre-fusion
+  std::vector<std::string> done_names;
+  for (const auto& name : arrival_order_) {
+    auto it = table_.find(name);
+    if (it == table_.end()) continue;
+    auto& entry = it->second;
+    // Joined ranks implicitly contribute (reference: joined ranks feed
+    // zeros, controller.cc:254-307).
+    if (static_cast<int>(entry.requests.size()) + num_joined < n) continue;
+
+    const Request& first = entry.requests.front();
+    Response r;
+    r.op = first.type;
+    r.names = {name};
+    r.total_bytes = first.bytes;
+    bool consistent = true;
+    for (const auto& req : entry.requests) {
+      if (req.signature != first.signature || req.type != first.type) {
+        consistent = false;
+        r.type = ResponseType::ERROR_;
+        char buf[256];
+        snprintf(buf, sizeof(buf),
+                 "inconsistent submission for '%s': rank %d sent '%s', "
+                 "rank %d sent '%s'",
+                 name.c_str(), first.rank, first.signature.c_str(),
+                 req.rank, req.signature.c_str());
+        r.error_message = buf;
+        break;
+      }
+    }
+    if (consistent) {
+      r.type = ResponseType::OK;
+      CacheLookup(name, first.signature);
+    }
+    ready.push_back({std::move(r), SigDtype(first.signature)});
+    done_names.push_back(name);
+  }
+  for (const auto& name : done_names) {
+    table_.erase(name);
+    arrival_order_.erase(
+        std::find(arrival_order_.begin(), arrival_order_.end(), name));
+  }
+
+  // Fuse consecutive OK responses with same op + dtype under the threshold
+  // (reference: FuseResponses controller.cc:778-915).
+  std::vector<Response> fused;
+  std::string last_dtype;
+  for (auto& pf : ready) {
+    Response& r = pf.r;
+    bool can_fuse = false;
+    if (r.type == ResponseType::OK && !fused.empty()) {
+      Response& last = fused.back();
+      can_fuse = last.type == ResponseType::OK && last.op == r.op &&
+                 last_dtype == pf.dtype &&
+                 last.total_bytes + r.total_bytes <=
+                     opts_.fusion_threshold_bytes;
+    }
+    if (can_fuse) {
+      fused.back().names.push_back(r.names[0]);
+      fused.back().total_bytes += r.total_bytes;
+    } else {
+      fused.push_back(std::move(r));
+      last_dtype = pf.dtype;
+    }
+  }
+  return fused;
+}
+
+bool Controller::RunCycle(const std::vector<Request>& pending,
+                          bool shutdown_requested,
+                          std::vector<Response>* out) {
+  stats_.cycles++;
+  int n = size();
+  if (joined_.empty()) joined_.assign(n, false);
+  if (shutdown_.empty()) shutdown_.assign(n, false);
+
+  // 1. serialize + gather everyone's request list
+  Writer w;
+  w.u8(shutdown_requested ? 1 : 0);
+  w.u32(static_cast<uint32_t>(pending.size()));
+  for (const auto& r : pending) SerializeRequest(r, &w);
+
+  std::vector<std::string> all;
+  if (!transport_->Gather(w.data(), rank() == 0 ? &all : nullptr))
+    return false;
+
+  // 2. rank 0 ingests and builds the response list
+  std::string frame;
+  if (rank() == 0) {
+    for (int r = 0; r < n; r++) {
+      Reader rd(all[r]);
+      bool sd = rd.u8() != 0;
+      if (sd) shutdown_[r] = true;
+      uint32_t cnt = rd.u32();
+      for (uint32_t i = 0; i < cnt; i++) {
+        Request req = DeserializeRequest(&rd);
+        if (req.type == RequestType::JOIN) {
+          joined_[req.rank] = true;
+        } else {
+          Ingest(req, r);
+        }
+      }
+    }
+    CheckStalls();
+    std::vector<Response> resp = BuildResponses();
+    int num_joined = static_cast<int>(
+        std::count(joined_.begin(), joined_.end(), true));
+    if (num_joined == n) {
+      Response j;
+      j.type = ResponseType::JOIN_DONE;
+      resp.push_back(j);
+      joined_.assign(n, false);
+    }
+    if (std::count(shutdown_.begin(), shutdown_.end(), true) == n) {
+      Response s;
+      s.type = ResponseType::SHUTDOWN;
+      resp.push_back(s);
+    }
+    stats_.responses += resp.size();
+    Writer rw;
+    rw.u32(static_cast<uint32_t>(resp.size()));
+    for (const auto& r : resp) SerializeResponse(r, &rw);
+    frame = rw.data();
+  }
+
+  // 3. broadcast the agreed list
+  if (!transport_->Bcast(&frame)) return false;
+  Reader rd(frame);
+  uint32_t cnt = rd.u32();
+  out->clear();
+  out->reserve(cnt);
+  for (uint32_t i = 0; i < cnt; i++) out->push_back(DeserializeResponse(&rd));
+  return true;
+}
+
+}  // namespace hvdtpu

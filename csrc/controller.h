@@ -1,0 +1,90 @@
+// Coordinator: negotiates which named collectives are globally ready.
+//
+// Native re-implementation of the reference's controller (reference:
+// horovod/common/controller.{h,cc}): rank 0 gathers per-cycle request lists,
+// counts per-tensor readiness in a message table (controller.cc:943-966),
+// validates cross-rank consistency (controller.cc:472-749), fuses ready
+// tensors into batches under the fusion threshold with same-dtype grouping
+// (controller.cc:778-915), handles Join (controller.cc:254-307) and
+// broadcasts the agreed ResponseList.  A signature LRU cache plays the
+// response cache's role (reference: response_cache.h:44-100) and a stall
+// tracker the stall inspector's (stall_inspector.h:31-82).
+//
+// Why it exists on TPU: the XLA/SPMD path needs no negotiation (programs are
+// deterministic), but eager frontends (torch-style define-by-run) submit
+// collectives in nondeterministic order per process; this controller gives
+// all processes one agreed execution order, which is what prevents
+// cross-process deadlock (SURVEY.md §2.4).
+
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <list>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "transport.h"
+
+namespace hvdtpu {
+
+struct ControllerOptions {
+  int64_t fusion_threshold_bytes = 128LL * 1024 * 1024;
+  int cache_capacity = 1024;
+  double stall_warn_seconds = 60.0;
+};
+
+struct ControllerStats {
+  uint64_t cycles = 0;
+  uint64_t cache_hits = 0;
+  uint64_t cache_misses = 0;
+  uint64_t stall_warnings = 0;
+  uint64_t responses = 0;
+};
+
+class Controller {
+ public:
+  Controller(Transport* transport, const ControllerOptions& opts)
+      : transport_(transport), opts_(opts) {}
+
+  // One lock-step cycle: contribute `pending` local requests, receive the
+  // globally agreed response list (identical on every rank).
+  // shutdown_requested: this rank wants out; when all ranks do, a SHUTDOWN
+  // response is emitted.  Returns false on transport failure.
+  bool RunCycle(const std::vector<Request>& pending, bool shutdown_requested,
+                std::vector<Response>* out);
+
+  const ControllerStats& stats() const { return stats_; }
+  int rank() const { return transport_->rank(); }
+  int size() const { return transport_->size(); }
+
+ private:
+  // --- rank-0 state ---
+  struct Entry {
+    std::vector<Request> requests;       // one per contributing rank
+    std::chrono::steady_clock::time_point first_seen;
+    bool warned = false;
+  };
+  void Ingest(const Request& req, int rank);
+  std::vector<Response> BuildResponses();
+  void CheckStalls();
+  bool CacheLookup(const std::string& name, const std::string& sig);
+
+  Transport* transport_;
+  ControllerOptions opts_;
+  ControllerStats stats_;
+
+  std::unordered_map<std::string, Entry> table_;
+  std::vector<std::string> arrival_order_;
+  std::vector<bool> joined_;     // per-rank JOIN flags
+  std::vector<bool> shutdown_;   // per-rank shutdown flags
+  // signature LRU cache (name -> sig), most-recent at back
+  std::list<std::pair<std::string, std::string>> cache_lru_;
+  std::unordered_map<std::string,
+                     std::list<std::pair<std::string, std::string>>::iterator>
+      cache_map_;
+};
+
+}  // namespace hvdtpu

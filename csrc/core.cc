@@ -1,0 +1,103 @@
+#include "core.h"
+
+#include <chrono>
+
+namespace hvdtpu {
+
+Core::Core(std::unique_ptr<Transport> transport, const CoreOptions& opts)
+    : transport_(std::move(transport)), opts_(opts) {
+  controller_.reset(new Controller(transport_.get(), opts.controller));
+  thread_ = std::thread(&Core::Loop, this);
+}
+
+Core::~Core() {
+  Shutdown();
+  if (thread_.joinable()) thread_.join();
+}
+
+int Core::Submit(const Request& req) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (stopped_.load()) return -2;
+  if (req.type != RequestType::JOIN && inflight_.count(req.name))
+    return -1;  // reference: DUPLICATE_NAME_ERROR (tensor_queue.cc)
+  inflight_.insert(req.name);
+  pending_.push_back(req);
+  return 0;
+}
+
+bool Core::Poll(Response* out) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (responses_.empty()) return false;
+  *out = responses_.front();
+  responses_.pop();
+  return true;
+}
+
+bool Core::Wait(Response* out, double timeout_s) {
+  std::unique_lock<std::mutex> lk(mu_);
+  bool got = cv_.wait_for(
+      lk, std::chrono::duration<double>(timeout_s),
+      [&] { return !responses_.empty() || stopped_.load(); });
+  if (!got || responses_.empty()) return false;
+  *out = responses_.front();
+  responses_.pop();
+  return true;
+}
+
+void Core::Shutdown() { shutdown_requested_.store(true); }
+
+ControllerStats Core::stats() const { return controller_->stats(); }
+
+void Core::Loop() {
+  using clock = std::chrono::steady_clock;
+  while (!stopped_.load()) {
+    auto start = clock::now();
+    std::vector<Request> batch;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      batch.swap(pending_);
+    }
+    std::vector<Response> out;
+    if (!controller_->RunCycle(batch, shutdown_requested_.load(), &out)) {
+      // transport failure: a peer died mid-negotiation.  Surface as an
+      // ERROR response so the frontend raises HorovodInternalError
+      // (reference: SHUT_DOWN error surfacing, elastic.py:151-175).
+      healthy_.store(false);
+      Response r;
+      r.type = ResponseType::ERROR_;
+      r.error_message = "controller transport failure (peer died?)";
+      std::lock_guard<std::mutex> lk(mu_);
+      responses_.push(r);
+      stopped_.store(true);
+      cv_.notify_all();
+      return;
+    }
+    bool got_shutdown = false;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (auto& r : out) {
+        if (r.type == ResponseType::SHUTDOWN) {
+          got_shutdown = true;
+          continue;
+        }
+        for (const auto& n : r.names) inflight_.erase(n);
+        responses_.push(std::move(r));
+      }
+      if (!out.empty()) cv_.notify_all();
+    }
+    if (got_shutdown) {
+      stopped_.store(true);
+      cv_.notify_all();
+      return;
+    }
+    // sleep out the remainder of the cycle (reference: operations.cc:592)
+    auto elapsed = clock::now() - start;
+    auto cycle = std::chrono::duration<double, std::milli>(
+        opts_.cycle_time_ms);
+    if (elapsed < cycle) {
+      std::this_thread::sleep_for(cycle - elapsed);
+    }
+  }
+}
+
+}  // namespace hvdtpu

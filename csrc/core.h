@@ -1,0 +1,71 @@
+// Background cycle loop + submission/response queues.
+//
+// The reference runs one background thread per process that wakes every
+// cycle_time ms, negotiates, then executes fused collectives (reference:
+// operations.cc:589-647 RunLoopOnce, spawned at operations.cc:690-691).
+// Here the thread owns negotiation only — execution happens in the frontend
+// (XLA) in the agreed order — so the loop is: drain submit queue, RunCycle,
+// publish responses, sleep the remainder of the cycle.
+
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_set>
+#include <vector>
+
+#include "common.h"
+#include "controller.h"
+#include "transport.h"
+
+namespace hvdtpu {
+
+struct CoreOptions {
+  double cycle_time_ms = 1.0;
+  ControllerOptions controller;
+};
+
+class Core {
+ public:
+  Core(std::unique_ptr<Transport> transport, const CoreOptions& opts);
+  ~Core();
+
+  // Returns 0 on success, -1 duplicate in-flight name, -2 after shutdown.
+  // (duplicate rejection: reference DUPLICATE_NAME_ERROR, tensor_queue.cc)
+  int Submit(const Request& req);
+  // Non-blocking; returns true when a response was popped.
+  bool Poll(Response* out);
+  // Blocks until a response arrives or timeout; false on timeout/shutdown.
+  bool Wait(Response* out, double timeout_s);
+
+  void Shutdown();          // begin coordinated shutdown
+  bool healthy() const { return healthy_.load(); }
+  int rank() const { return controller_->rank(); }
+  int size() const { return controller_->size(); }
+  ControllerStats stats() const;
+
+ private:
+  void Loop();
+
+  std::unique_ptr<Transport> transport_;
+  std::unique_ptr<Controller> controller_;
+  CoreOptions opts_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<Request> pending_;
+  std::unordered_set<std::string> inflight_;
+  std::queue<Response> responses_;
+
+  std::atomic<bool> shutdown_requested_{false};
+  std::atomic<bool> stopped_{false};
+  std::atomic<bool> healthy_{true};
+  std::thread thread_;
+};
+
+}  // namespace hvdtpu

@@ -1,0 +1,218 @@
+#include "transport.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+namespace hvdtpu {
+
+// ------------------------------------------------------------------ loopback
+bool LoopbackHub::Gather(int rank, const std::string& mine,
+                         std::vector<std::string>* all) {
+  std::unique_lock<std::mutex> lk(mu_);
+  uint64_t gen = gather_gen_;
+  gathered_[rank] = mine;
+  gather_count_++;
+  if (gather_count_ == size_) {
+    gather_count_ = 0;
+    gather_gen_++;
+    if (rank == 0 && all) *all = gathered_;
+    cv_.notify_all();
+    if (rank != 0) {
+      // rank 0 may still be waiting; data already published.
+    }
+    if (rank == 0) return true;
+  }
+  if (rank == 0) {
+    cv_.wait(lk, [&] { return gather_gen_ != gen; });
+    if (all) *all = gathered_;
+  } else if (gather_gen_ == gen) {
+    cv_.wait(lk, [&] { return gather_gen_ != gen; });
+  }
+  return true;
+}
+
+bool LoopbackHub::Bcast(int rank, std::string* frame,
+                        uint64_t* consumed_rounds) {
+  std::unique_lock<std::mutex> lk(mu_);
+  if (rank == 0) {
+    bcast_frame_ = *frame;
+    bcast_reads_ = 0;
+    bcast_gen_++;
+    (*consumed_rounds)++;
+    cv_.notify_all();
+    // hold the round open until every worker has read it
+    cv_.wait(lk, [&] { return bcast_reads_ == size_ - 1; });
+  } else {
+    // lock-step cycle protocol: this caller has consumed *consumed_rounds
+    // rounds; wait for the next one (which may already be posted).
+    cv_.wait(lk, [&] { return bcast_gen_ > *consumed_rounds; });
+    *frame = bcast_frame_;
+    (*consumed_rounds)++;
+    bcast_reads_++;
+    cv_.notify_all();
+  }
+  return true;
+}
+
+// ----------------------------------------------------------------------- tcp
+namespace {
+// Resolve a hostname or numeric address to an IPv4 sockaddr; false on
+// failure (the launcher hands out hostnames, not just dotted quads).
+bool ResolveIPv4(const std::string& host, uint16_t port, sockaddr_in* out) {
+  memset(out, 0, sizeof(*out));
+  out->sin_family = AF_INET;
+  out->sin_port = htons(port);
+  if (inet_pton(AF_INET, host.c_str(), &out->sin_addr) == 1) return true;
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res)
+    return false;
+  out->sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+  freeaddrinfo(res);
+  return true;
+}
+}  // namespace
+
+TcpTransport::TcpTransport(int rank, int size, const std::string& addr,
+                           int port, int timeout_ms)
+    : rank_(rank), size_(size) {
+  if (size <= 1) { ok_ = true; return; }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  if (rank == 0) {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = INADDR_ANY;
+    sa.sin_port = htons(static_cast<uint16_t>(port));
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0)
+      return;
+    if (listen(listen_fd_, size) != 0) return;
+    worker_fds_.assign(size, -1);
+    for (int i = 1; i < size; i++) {
+      // bounded accept: a worker that never shows up must fail rank 0's
+      // bring-up within timeout_ms, not hang init forever.
+      auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+          deadline - std::chrono::steady_clock::now()).count();
+      if (left <= 0) return;
+      pollfd pfd{listen_fd_, POLLIN, 0};
+      int pr = poll(&pfd, 1, static_cast<int>(left));
+      if (pr <= 0) return;
+      int fd = accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;
+      int one2 = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
+      // first frame from each worker is its rank
+      std::string hello;
+      if (!RecvFrame(fd, &hello) || hello.size() != 4) return;
+      int r;
+      memcpy(&r, hello.data(), 4);
+      if (r <= 0 || r >= size || worker_fds_[r] != -1) return;
+      worker_fds_[r] = fd;
+    }
+    ok_ = true;
+  } else {
+    sockaddr_in sa{};
+    if (!ResolveIPv4(addr, static_cast<uint16_t>(port), &sa)) return;
+    while (std::chrono::steady_clock::now() < deadline) {
+      coord_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+      if (connect(coord_fd_, reinterpret_cast<sockaddr*>(&sa),
+                  sizeof(sa)) == 0) {
+        int one = 1;
+        setsockopt(coord_fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        std::string hello(4, '\0');
+        memcpy(&hello[0], &rank_, 4);
+        if (SendFrame(coord_fd_, hello)) { ok_ = true; return; }
+      }
+      close(coord_fd_);
+      coord_fd_ = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+  }
+}
+
+TcpTransport::~TcpTransport() {
+  if (listen_fd_ >= 0) close(listen_fd_);
+  if (coord_fd_ >= 0) close(coord_fd_);
+  for (int fd : worker_fds_)
+    if (fd >= 0) close(fd);
+}
+
+bool TcpTransport::SendFrame(int fd, const std::string& s) {
+  uint32_t len = static_cast<uint32_t>(s.size());
+  char hdr[4];
+  memcpy(hdr, &len, 4);
+  std::string buf(hdr, 4);
+  buf += s;
+  size_t off = 0;
+  while (off < buf.size()) {
+    ssize_t n = send(fd, buf.data() + off, buf.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool TcpTransport::RecvFrame(int fd, std::string* s) {
+  char hdr[4];
+  size_t off = 0;
+  while (off < 4) {
+    ssize_t n = recv(fd, hdr + off, 4 - off, 0);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  uint32_t len;
+  memcpy(&len, hdr, 4);
+  if (len > (1u << 30)) return false;
+  s->resize(len);
+  off = 0;
+  while (off < len) {
+    ssize_t n = recv(fd, &(*s)[off], len - off, 0);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool TcpTransport::Gather(const std::string& mine,
+                          std::vector<std::string>* all) {
+  if (size_ == 1) {
+    if (all) *all = {mine};
+    return true;
+  }
+  if (rank_ == 0) {
+    all->assign(size_, "");
+    (*all)[0] = mine;
+    for (int r = 1; r < size_; r++) {
+      if (!RecvFrame(worker_fds_[r], &(*all)[r])) return false;
+    }
+    return true;
+  }
+  return SendFrame(coord_fd_, mine);
+}
+
+bool TcpTransport::Bcast(std::string* frame) {
+  if (size_ == 1) return true;
+  if (rank_ == 0) {
+    for (int r = 1; r < size_; r++) {
+      if (!SendFrame(worker_fds_[r], *frame)) return false;
+    }
+    return true;
+  }
+  return RecvFrame(coord_fd_, frame);
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,104 @@
+// Controller transports: how coordination metadata moves between processes.
+//
+// The reference runs its coordination protocol over MPI or Gloo (reference:
+// mpi_controller.cc gather/bcast at :134-193, gloo_controller.cc:185-264).
+// Here the transport is an abstract gather/bcast pair with two built-ins:
+//   * LoopbackTransport — all ranks in one process (unit tests, and the
+//     single-controller JAX case where negotiation is trivial).
+//   * TcpTransport — zero-dependency sockets: rank 0 listens, workers
+//     connect; length-prefixed frames.  The gloo-rendezvous analog without
+//     the gloo dependency; TPU-VM pods have plain TCP between hosts.
+
+#pragma once
+
+#include <condition_variable>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+class Transport {
+ public:
+  virtual ~Transport() = default;
+  virtual int rank() const = 0;
+  virtual int size() const = 0;
+  // Coordinator (rank 0) receives every rank's frame, index = rank.
+  // Workers send theirs.  Returns false on peer failure.
+  virtual bool Gather(const std::string& mine,
+                      std::vector<std::string>* all) = 0;
+  // Coordinator sends one frame to every worker; workers receive it.
+  virtual bool Bcast(std::string* frame) = 0;
+};
+
+// All ranks share one object; per-rank handles carry the rank id.
+class LoopbackHub {
+ public:
+  explicit LoopbackHub(int size) : size_(size), gathered_(size) {}
+
+  bool Gather(int rank, const std::string& mine,
+              std::vector<std::string>* all);
+  // consumed_rounds: per-caller count of bcast rounds already read; lets a
+  // late worker recognize an already-posted round (lock-step protocol).
+  bool Bcast(int rank, std::string* frame, uint64_t* consumed_rounds);
+  int size() const { return size_; }
+
+ private:
+  int size_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<std::string> gathered_;
+  int gather_count_ = 0;
+  uint64_t gather_gen_ = 0;
+  std::string bcast_frame_;
+  uint64_t bcast_gen_ = 0;
+  int bcast_reads_ = 0;
+};
+
+class LoopbackTransport : public Transport {
+ public:
+  LoopbackTransport(LoopbackHub* hub, int rank) : hub_(hub), rank_(rank) {}
+  int rank() const override { return rank_; }
+  int size() const override { return hub_->size(); }
+  bool Gather(const std::string& mine,
+              std::vector<std::string>* all) override {
+    return hub_->Gather(rank_, mine, all);
+  }
+  bool Bcast(std::string* frame) override {
+    return hub_->Bcast(rank_, frame, &consumed_rounds_);
+  }
+
+ private:
+  LoopbackHub* hub_;
+  int rank_;
+  uint64_t consumed_rounds_ = 0;
+};
+
+class TcpTransport : public Transport {
+ public:
+  // rank 0 binds+listens on port and accepts size-1 workers; others connect
+  // to addr:port (retrying until timeout_ms).
+  TcpTransport(int rank, int size, const std::string& addr, int port,
+               int timeout_ms = 30000);
+  ~TcpTransport() override;
+
+  bool ok() const { return ok_; }
+  int rank() const override { return rank_; }
+  int size() const override { return size_; }
+  bool Gather(const std::string& mine,
+              std::vector<std::string>* all) override;
+  bool Bcast(std::string* frame) override;
+
+ private:
+  bool SendFrame(int fd, const std::string& s);
+  bool RecvFrame(int fd, std::string* s);
+
+  int rank_, size_;
+  bool ok_ = false;
+  int listen_fd_ = -1;
+  int coord_fd_ = -1;                // worker's socket to rank 0
+  std::vector<int> worker_fds_;      // rank 0: index = rank (0 unused)
+};
+
+}  // namespace hvdtpu

@@ -1,0 +1,205 @@
+"""ctypes binding to the native coordination core.
+
+The analog of the reference's HorovodBasics, which loads the per-framework
+.so via ctypes and exposes init/rank/size/... (reference:
+horovod/common/basics.py:22-290).  Here the native library carries the
+controller/cycle-loop/cache/stall machinery (csrc/); the data plane stays
+in XLA.
+
+The library is built on demand with `make` on first use (the reference
+builds via setup.py-driven CMake at install time; a source checkout should
+work without an install step).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_LIB_PATH = os.path.join(_CSRC, "libhvd_tpu_core.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+# RequestType values (must match csrc/common.h)
+OP_ALLREDUCE = 0
+OP_ALLGATHER = 1
+OP_BROADCAST = 2
+OP_ALLTOALL = 3
+OP_REDUCESCATTER = 4
+OP_BARRIER = 5
+OP_JOIN = 6
+
+
+def _build_library() -> None:
+    subprocess.run(["make", "-C", _CSRC], check=True,
+                   capture_output=True)
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            _build_library()
+        lib = ctypes.CDLL(_LIB_PATH)
+        # signatures
+        lib.hvd_loopback_hub_create.restype = ctypes.c_void_p
+        lib.hvd_loopback_hub_create.argtypes = [ctypes.c_int]
+        lib.hvd_loopback_hub_destroy.argtypes = [ctypes.c_void_p]
+        lib.hvd_core_create_loopback.restype = ctypes.c_void_p
+        lib.hvd_core_create_loopback.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.c_long,
+            ctypes.c_int, ctypes.c_double]
+        lib.hvd_core_create_tcp.restype = ctypes.c_void_p
+        lib.hvd_core_create_tcp.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_double, ctypes.c_long, ctypes.c_int,
+            ctypes.c_double]
+        lib.hvd_core_destroy.argtypes = [ctypes.c_void_p]
+        lib.hvd_core_rank.argtypes = [ctypes.c_void_p]
+        lib.hvd_core_size.argtypes = [ctypes.c_void_p]
+        lib.hvd_core_healthy.argtypes = [ctypes.c_void_p]
+        lib.hvd_core_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_long]
+        lib.hvd_core_join.argtypes = [ctypes.c_void_p]
+        lib.hvd_core_poll.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int]
+        lib.hvd_core_wait.argtypes = [ctypes.c_void_p, ctypes.c_double,
+                                      ctypes.c_char_p, ctypes.c_int]
+        lib.hvd_core_shutdown.argtypes = [ctypes.c_void_p]
+        lib.hvd_core_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong)]
+        _lib = lib
+        return lib
+
+
+class CoreResponse:
+    """Parsed controller verdict (see csrc/c_api.cc FormatResponse)."""
+
+    __slots__ = ("type", "op", "total_bytes", "error", "names")
+
+    def __init__(self, raw: str):
+        t, op, total, err, names = raw.split("|", 4)
+        self.type = t
+        self.op = int(op)
+        self.total_bytes = int(total)
+        self.error = err
+        self.names = names.split(",") if names else []
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"CoreResponse({self.type}, op={self.op}, "
+                f"names={self.names}, err={self.error!r})")
+
+
+class LoopbackHub:
+    """In-process multi-rank hub (tests / single-controller)."""
+
+    def __init__(self, size: int):
+        self._lib = load_library()
+        self.size = size
+        self._h = self._lib.hvd_loopback_hub_create(size)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_loopback_hub_destroy(self._h)
+            self._h = None
+
+
+class CoordinationCore:
+    """One rank's handle to the native controller core."""
+
+    def __init__(self, handle, lib):
+        if not handle:
+            raise RuntimeError("native core failed to initialize "
+                               "(transport bring-up failure?)")
+        self._h = handle
+        self._lib = lib
+        self._buf = ctypes.create_string_buffer(1 << 20)
+
+    # ------------------------------------------------------------ constructors
+    @classmethod
+    def loopback(cls, hub: LoopbackHub, rank: int, cycle_ms: float = 1.0,
+                 fusion_bytes: int = 128 << 20, cache_capacity: int = 1024,
+                 stall_warn_seconds: float = 60.0) -> "CoordinationCore":
+        lib = load_library()
+        h = lib.hvd_core_create_loopback(
+            hub._h, rank, cycle_ms, fusion_bytes, cache_capacity,
+            stall_warn_seconds)
+        return cls(h, lib)
+
+    @classmethod
+    def tcp(cls, rank: int, size: int, addr: str = "127.0.0.1",
+            port: int = 29499, timeout_ms: int = 30000,
+            cycle_ms: float = 1.0, fusion_bytes: int = 128 << 20,
+            cache_capacity: int = 1024,
+            stall_warn_seconds: float = 60.0) -> "CoordinationCore":
+        lib = load_library()
+        h = lib.hvd_core_create_tcp(
+            rank, size, addr.encode(), port, timeout_ms, cycle_ms,
+            fusion_bytes, cache_capacity, stall_warn_seconds)
+        return cls(h, lib)
+
+    # ----------------------------------------------------------------- methods
+    def rank(self) -> int:
+        return self._lib.hvd_core_rank(self._h)
+
+    def size(self) -> int:
+        return self._lib.hvd_core_size(self._h)
+
+    def healthy(self) -> bool:
+        return bool(self._lib.hvd_core_healthy(self._h))
+
+    def submit(self, name: str, signature: str, op: int = OP_ALLREDUCE,
+               nbytes: int = 0) -> None:
+        rc = self._lib.hvd_core_submit(self._h, name.encode(),
+                                       signature.encode(), op, nbytes)
+        if rc == -1:
+            from .exceptions import DuplicateTensorNameError
+            raise DuplicateTensorNameError(
+                f"tensor name {name!r} already submitted and not completed "
+                "(reference: DUPLICATE_NAME_ERROR)")
+        if rc == -3:
+            raise ValueError(f"tensor name {name!r} contains reserved "
+                             "delimiter '|' or ','")
+        if rc != 0:
+            raise RuntimeError(f"core submit failed rc={rc}")
+
+    def join(self) -> None:
+        self._lib.hvd_core_join(self._h)
+
+    def poll(self) -> Optional[CoreResponse]:
+        n = self._lib.hvd_core_poll(self._h, self._buf, len(self._buf))
+        if n <= 0:
+            return None
+        return CoreResponse(self._buf.value.decode())
+
+    def wait(self, timeout_s: float = 30.0) -> Optional[CoreResponse]:
+        n = self._lib.hvd_core_wait(self._h, timeout_s, self._buf,
+                                    len(self._buf))
+        if n <= 0:
+            return None
+        return CoreResponse(self._buf.value.decode())
+
+    def stats(self) -> dict:
+        arr = (ctypes.c_ulonglong * 5)()
+        self._lib.hvd_core_stats(self._h, arr)
+        return {"cycles": arr[0], "cache_hits": arr[1],
+                "cache_misses": arr[2], "stall_warnings": arr[3],
+                "responses": arr[4]}
+
+    def shutdown(self) -> None:
+        if self._h:
+            self._lib.hvd_core_shutdown(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_core_destroy(self._h)
+            self._h = None

@@ -105,6 +105,12 @@ _knob("HOROVOD_CROSS_SIZE", -1, int, "Number of hosts.")
 _knob("HOROVOD_HOSTNAME", "", str, "Hostname assigned by the launcher.")
 _knob("HOROVOD_COORDINATOR_ADDR", "", str,
       "host:port of the jax.distributed coordinator for multi-host meshes.")
+_knob("HOROVOD_CONTROLLER", "auto", str,
+      "Eager-mode coordination controller: 'auto' (tcp when multi-process, "
+      "none single-process), 'tcp', or 'none' "
+      "(reference: HOROVOD_CONTROLLER in {mpi,gloo}, operations.cc:654).")
+_knob("HOROVOD_CONTROLLER_PORT", 29499, int,
+      "TCP port of the rank-0 controller listener.")
 
 
 class Knobs:

@@ -114,9 +114,22 @@ class Runtime:
                 shutdown_seconds=self.knobs[
                     "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"])
 
-        # Native core (C++ controller/tensor-queue) attaches here when the
-        # eager multi-process frontend needs negotiation; SPMD paths don't.
+        # Native core (C++ controller/tensor-queue): negotiates a global
+        # execution order for eager multi-process collectives (SPMD paths
+        # don't need it — XLA programs are deterministic).  Reference:
+        # the MPI/Gloo controller choice at operations.cc:654-687.
+        # Created lazily by ensure_core(): only consumers that need
+        # negotiation (eager/torch frontends) pay the TCP bring-up.
         self.core = None
+        mode = str(self.knobs["HOROVOD_CONTROLLER"]).lower()
+        if mode not in ("auto", "tcp", "none"):
+            raise ValueError(
+                f"HOROVOD_CONTROLLER={mode!r} not supported; use 'auto', "
+                "'tcp' or 'none' (this framework's controller transport is "
+                "TCP; the reference's 'mpi'/'gloo' values do not apply)")
+        self._controller_mode = mode
+        if mode == "tcp":
+            self.ensure_core()
 
         log.debug("Runtime up: %d devices, %d local, mesh=%s",
                   len(self.devices), len(self.local_devices),
@@ -202,6 +215,34 @@ class Runtime:
     def cross_size(self) -> int:
         return self._process_count
 
+    # ------------------------------------------------------------------ core
+    def ensure_core(self):
+        """Bring up the native coordination core on first use (idempotent).
+
+        Consumers: eager frontends that need cross-process ordering (torch
+        bindings, negotiated grouped ops).  In 'auto' mode single-process
+        runs never create it; multi-process runs create it on demand using
+        the coordinator host from HOROVOD_COORDINATOR_ADDR."""
+        if self.core is not None:
+            return self.core
+        if self._controller_mode == "none":
+            return None
+        if self._controller_mode == "auto" and self._process_count <= 1:
+            return None
+        coord = self.knobs["HOROVOD_COORDINATOR_ADDR"]
+        coord_host = coord.split(":")[0] if coord else "127.0.0.1"
+        from .common.basics import CoordinationCore
+        self.core = CoordinationCore.tcp(
+            rank=self._process_index, size=self._process_count,
+            addr=coord_host,
+            port=self.knobs["HOROVOD_CONTROLLER_PORT"],
+            cycle_ms=self.knobs["HOROVOD_CYCLE_TIME"],
+            fusion_bytes=self.knobs["HOROVOD_FUSION_THRESHOLD"],
+            cache_capacity=self.knobs["HOROVOD_CACHE_CAPACITY"],
+            stall_warn_seconds=self.knobs[
+                "HOROVOD_STALL_CHECK_TIME_SECONDS"])
+        return self.core
+
     # ------------------------------------------------------------- lifecycle
     def shutdown(self) -> None:
         if self._shutdown:
@@ -213,6 +254,7 @@ class Runtime:
             self.stall_inspector.close()
         if self.core is not None:
             self.core.shutdown()
+            self.core.close()
 
     # ------------------------------------------------------------- timeline
     def start_timeline(self, path: str, mark_cycles: bool = False) -> None:

@@ -1,0 +1,187 @@
+"""Native coordination core tests (ctypes -> csrc/libhvd_tpu_core.so).
+
+Reference analogs: controller negotiation/consistency tests embedded in
+test/parallel/* error-path assertions; multi-rank protocol exercised with
+in-process loopback ranks (threads) and real TCP over localhost processes
+(the reference uses real gloo/MPI over loopback the same way, SURVEY.md §4).
+"""
+
+import json
+import multiprocessing
+import os
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.common.basics import (CoordinationCore, LoopbackHub,
+                                       OP_ALLREDUCE, OP_ALLGATHER)
+from horovod_tpu.common.exceptions import DuplicateTensorNameError
+
+
+@pytest.fixture
+def hub2():
+    hub = LoopbackHub(2)
+    cores = [CoordinationCore.loopback(hub, r, cycle_ms=0.2)
+             for r in range(2)]
+    yield cores
+    for c in cores:
+        c.shutdown()
+    for c in cores:
+        c.close()
+    hub.close()
+
+
+def test_loopback_negotiation_basic(hub2):
+    c0, c1 = hub2
+    assert c0.rank() == 0 and c0.size() == 2
+    c0.submit("grad/w", "f32:4x4:sum", OP_ALLREDUCE, 64)
+    # not globally ready until rank 1 submits
+    assert c0.poll() is None
+    time.sleep(0.05)
+    assert c0.poll() is None
+    c1.submit("grad/w", "f32:4x4:sum", OP_ALLREDUCE, 64)
+    r0 = c0.wait(5.0)
+    r1 = c1.wait(5.0)
+    assert r0 is not None and r1 is not None
+    assert r0.type == "OK" and r0.names == ["grad/w"]
+    assert r1.names == ["grad/w"]
+
+
+def test_ordering_agreement_under_reversed_submission(hub2):
+    """The controller's whole point: ranks submit in different orders but
+    receive one agreed order (deadlock avoidance, controller.cc:69-450)."""
+    c0, c1 = hub2
+    c0.submit("a", "f32:8:sum", OP_ALLREDUCE, 32)
+    c0.submit("b", "f32:8:sum", OP_ALLREDUCE, 32)
+    time.sleep(0.02)  # ensure rank 0's order is a,b before rank 1 submits
+    c1.submit("b", "f32:8:sum", OP_ALLREDUCE, 32)
+    c1.submit("a", "f32:8:sum", OP_ALLREDUCE, 32)
+    seq0, seq1 = [], []
+    deadline = time.time() + 5
+    while len(seq0) < 2 and time.time() < deadline:
+        r = c0.poll()
+        if r:
+            seq0.extend(r.names)
+        r = c1.poll()
+        if r:
+            seq1.extend(r.names)
+        time.sleep(0.005)
+    while len(seq1) < 2 and time.time() < deadline:
+        r = c1.poll()
+        if r:
+            seq1.extend(r.names)
+        time.sleep(0.005)
+    assert sorted(seq0) == ["a", "b"]
+    assert seq0 == seq1, "ranks disagreed on execution order"
+
+
+def test_signature_mismatch_yields_error(hub2):
+    """Shape/dtype mismatch across ranks becomes an ERROR response, not a
+    hang (reference: controller.cc:482-707)."""
+    c0, c1 = hub2
+    c0.submit("t", "f32:4x4:sum", OP_ALLREDUCE, 64)
+    c1.submit("t", "f32:2x2:sum", OP_ALLREDUCE, 16)
+    r = c0.wait(5.0)
+    assert r is not None and r.type == "ERROR"
+    assert "inconsistent" in r.error
+    assert "t" in r.names
+
+
+def test_fusion_groups_small_tensors(hub2):
+    """Small same-dtype tensors fuse into one response batch under the
+    threshold (reference: FuseResponses controller.cc:778-915)."""
+    c0, c1 = hub2
+    for c in (c0, c1):
+        for i in range(4):
+            c.submit(f"g{i}", "f32:10:sum", OP_ALLREDUCE, 40)
+    r = c0.wait(5.0)
+    assert r is not None and r.type == "OK"
+    assert len(r.names) == 4, r.names  # all fused
+    assert r.total_bytes == 160
+
+
+def test_fusion_respects_dtype_boundary(hub2):
+    c0, c1 = hub2
+    for c in (c0, c1):
+        c.submit("x", "f32:10:sum", OP_ALLREDUCE, 40)
+        c.submit("y", "f16:10:sum", OP_ALLREDUCE, 20)
+    names_batches = []
+    deadline = time.time() + 5
+    while len(names_batches) < 2 and time.time() < deadline:
+        r = c0.poll()
+        if r:
+            names_batches.append(r.names)
+        time.sleep(0.005)
+    assert ["x"] in names_batches and ["y"] in names_batches
+
+
+def test_duplicate_name_rejected(hub2):
+    c0, _ = hub2
+    c0.submit("dup", "f32:1:sum", OP_ALLREDUCE, 4)
+    with pytest.raises(DuplicateTensorNameError):
+        c0.submit("dup", "f32:1:sum", OP_ALLREDUCE, 4)
+
+
+def test_reserved_delimiters_rejected(hub2):
+    c0, _ = hub2
+    with pytest.raises(ValueError):
+        c0.submit("bad|name", "f32:1:sum", OP_ALLREDUCE, 4)
+
+
+def test_join_protocol(hub2):
+    """Joined rank auto-contributes; all-join emits JOIN_DONE (reference:
+    controller.cc:254-307, JoinOp collective_operations.cc:262-270)."""
+    c0, c1 = hub2
+    c1.join()                # rank 1 out of data
+    c0.submit("g", "f32:4:sum", OP_ALLREDUCE, 16)
+    r = c0.wait(5.0)
+    assert r is not None and r.type == "OK" and r.names == ["g"]
+    c0.join()                # now both joined
+    r = c0.wait(5.0)
+    assert r is not None and r.type == "JOIN_DONE"
+
+
+def test_cache_hits_on_repeat_steps(hub2):
+    c0, c1 = hub2
+    for step in range(3):
+        for c in (c0, c1):
+            c.submit("gw", "f32:100:sum", OP_ALLREDUCE, 400)
+        assert c0.wait(5.0) is not None
+        assert c1.wait(5.0) is not None
+    stats = c0.stats()
+    assert stats["cache_hits"] >= 2, stats
+    assert stats["cycles"] > 0
+
+
+def _tcp_worker(rank, size, port, results):
+    core = CoordinationCore.tcp(rank, size, "127.0.0.1", port,
+                                cycle_ms=0.2)
+    core.submit(f"t", "f32:8:sum", OP_ALLREDUCE, 32)
+    r = core.wait(10.0)
+    results[rank] = (r.type, tuple(r.names)) if r else None
+    core.shutdown()
+    # drain until shutdown completes so ranks exit cleanly
+    time.sleep(0.2)
+    core.close()
+
+
+def test_tcp_transport_two_processes():
+    """Real multi-process negotiation over localhost TCP (the reference's
+    'real gloo over loopback' test strategy, SURVEY.md §4)."""
+    port = 29517
+    # spawn, not fork: the test session has live jax/XLA threads and a
+    # forked child can deadlock on inherited lock state.
+    ctx = multiprocessing.get_context("spawn")
+    mgr = ctx.Manager()
+    results = mgr.dict()
+    procs = [ctx.Process(target=_tcp_worker, args=(r, 2, port, results))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=30)
+        assert not p.is_alive(), "tcp worker hung"
+        assert p.exitcode == 0
+    assert results[0] == ("OK", ("t",))
+    assert results[1] == ("OK", ("t",))
