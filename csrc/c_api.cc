@@ -3,6 +3,7 @@
 // consumed by horovod/common/basics.py).
 
 #include <cstring>
+#include <mutex>
 #include <string>
 
 #include "core.h"
@@ -10,6 +11,16 @@
 using namespace hvdtpu;
 
 namespace {
+// The C handle wraps the core plus a stash for responses that did not fit
+// the caller's buffer: a popped response must never be lost to truncation.
+struct ApiHandle {
+  explicit ApiHandle(Core* c) : core(c) {}
+  Core* core;
+  std::mutex mu;
+  bool has_stash = false;
+  Response stash;
+};
+
 CoreOptions MakeOptions(double cycle_ms, long fusion_bytes, int cache_cap,
                         double stall_warn_s) {
   CoreOptions o;
@@ -20,33 +31,52 @@ CoreOptions MakeOptions(double cycle_ms, long fusion_bytes, int cache_cap,
   return o;
 }
 
-// Copy a std::string into a caller buffer; returns needed size.
-int CopyOut(const std::string& s, char* buf, int buflen) {
+// Deliver a response through the caller buffer.  If it fits, consume and
+// return its length; otherwise stash it and return -(needed+1) so the
+// caller can retry with a larger buffer.
+int Deliver(ApiHandle* h, const Response& r, char* buf, int buflen) {
+  std::string s;
+  {
+    static const char* kTypes[] = {"OK", "ERROR", "JOIN_DONE", "SHUTDOWN"};
+    s = kTypes[static_cast<int>(r.type)];
+    s += "|";
+    s += std::to_string(static_cast<int>(r.op));
+    s += "|";
+    s += std::to_string(r.total_bytes);
+    s += "|";
+    std::string err = r.error_message;
+    for (auto& c : err)
+      if (c == '|' || c == '\n') c = ';';  // keep the frame parseable
+    s += err;
+    s += "|";
+    for (size_t i = 0; i < r.names.size(); i++) {
+      if (i) s += ",";
+      s += r.names[i];
+    }
+  }
   int n = static_cast<int>(s.size());
-  if (buf && buflen > n) {
-    memcpy(buf, s.data(), n);
-    buf[n] = '\0';
+  if (!buf || buflen <= n) {
+    std::lock_guard<std::mutex> lk(h->mu);
+    h->has_stash = true;
+    h->stash = r;
+    return -(n + 1);
+  }
+  memcpy(buf, s.data(), n);
+  buf[n] = '\0';
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    h->has_stash = false;
   }
   return n;
 }
 
-// Response -> "TYPE|OP|total_bytes|err|name1,name2,..."
-std::string FormatResponse(const Response& r) {
-  static const char* kTypes[] = {"OK", "ERROR", "JOIN_DONE", "SHUTDOWN"};
-  std::string s = kTypes[static_cast<int>(r.type)];
-  s += "|";
-  s += std::to_string(static_cast<int>(r.op));
-  s += "|";
-  s += std::to_string(r.total_bytes);
-  s += "|";
-  s += r.error_message;
-  s += "|";
-  for (size_t i = 0; i < r.names.size(); i++) {
-    if (i) s += ",";
-    s += r.names[i];
-  }
-  return s;
+bool TakeStash(ApiHandle* h, Response* out) {
+  std::lock_guard<std::mutex> lk(h->mu);
+  if (!h->has_stash) return false;
+  *out = h->stash;
+  return true;
 }
+
 }  // namespace
 
 extern "C" {
@@ -61,9 +91,9 @@ void* hvd_core_create_loopback(void* hub, int rank, double cycle_ms,
                                double stall_warn_s) {
   auto t = std::unique_ptr<Transport>(
       new LoopbackTransport(static_cast<LoopbackHub*>(hub), rank));
-  return new Core(std::move(t),
-                  MakeOptions(cycle_ms, fusion_bytes, cache_cap,
-                              stall_warn_s));
+  return new ApiHandle(new Core(std::move(t),
+                                MakeOptions(cycle_ms, fusion_bytes,
+                                            cache_cap, stall_warn_s)));
 }
 
 void* hvd_core_create_tcp(int rank, int size, const char* addr, int port,
@@ -75,37 +105,49 @@ void* hvd_core_create_tcp(int rank, int size, const char* addr, int port,
   if (!t->ok()) {
     return nullptr;
   }
-  return new Core(std::unique_ptr<Transport>(std::move(t)),
-                  MakeOptions(cycle_ms, fusion_bytes, cache_cap,
-                              stall_warn_s));
+  return new ApiHandle(new Core(
+      std::unique_ptr<Transport>(std::move(t)),
+      MakeOptions(cycle_ms, fusion_bytes, cache_cap, stall_warn_s)));
 }
 
-void hvd_core_destroy(void* h) { delete static_cast<Core*>(h); }
+void hvd_core_destroy(void* h) {
+  ApiHandle* ah = static_cast<ApiHandle*>(h);
+  delete ah->core;
+  delete ah;
+}
 
-int hvd_core_rank(void* h) { return static_cast<Core*>(h)->rank(); }
-int hvd_core_size(void* h) { return static_cast<Core*>(h)->size(); }
+int hvd_core_rank(void* h) {
+  return static_cast<ApiHandle*>(h)->core->rank();
+}
+int hvd_core_size(void* h) {
+  return static_cast<ApiHandle*>(h)->core->size();
+}
 int hvd_core_healthy(void* h) {
-  return static_cast<Core*>(h)->healthy() ? 1 : 0;
+  return static_cast<ApiHandle*>(h)->core->healthy() ? 1 : 0;
 }
 
-// op: RequestType; returns 0 ok, -1 duplicate name, -2 shut down.
+// op: RequestType; returns 0 ok, -1 duplicate name, -2 shut down,
+// -3 reserved delimiter in name/signature.
 int hvd_core_submit(void* h, const char* name, const char* signature,
                     int op, long bytes) {
-  Core* core = static_cast<Core*>(h);
+  Core* core = static_cast<ApiHandle*>(h)->core;
   Request r;
   r.rank = core->rank();
   r.type = static_cast<RequestType>(op);
   r.name = name ? name : "";
   r.signature = signature ? signature : "";
   r.bytes = bytes;
+  // '|' and ',' frame the C-API response format; reject them in both the
+  // name and the signature (signatures are echoed in error messages).
   if (r.name.find('|') != std::string::npos ||
-      r.name.find(',') != std::string::npos)
-    return -3;  // reserved delimiters
+      r.name.find(',') != std::string::npos ||
+      r.signature.find('|') != std::string::npos)
+    return -3;
   return core->Submit(r);
 }
 
 int hvd_core_join(void* h) {
-  Core* core = static_cast<Core*>(h);
+  Core* core = static_cast<ApiHandle*>(h)->core;
   Request r;
   r.rank = core->rank();
   r.type = RequestType::JOIN;
@@ -113,25 +155,30 @@ int hvd_core_join(void* h) {
   return core->Submit(r);
 }
 
-// Non-blocking poll; returns formatted length (0 = none pending).
+// Non-blocking poll; returns formatted length (0 = none pending,
+// negative = -(needed+1): retry with a bigger buffer, response retained).
 int hvd_core_poll(void* h, char* buf, int buflen) {
+  ApiHandle* ah = static_cast<ApiHandle*>(h);
   Response r;
-  if (!static_cast<Core*>(h)->Poll(&r)) return 0;
-  return CopyOut(FormatResponse(r), buf, buflen);
+  if (!TakeStash(ah, &r) && !ah->core->Poll(&r)) return 0;
+  return Deliver(ah, r, buf, buflen);
 }
 
-// Blocking wait; returns length, 0 on timeout.
+// Blocking wait; returns length, 0 on timeout, negative as above.
 int hvd_core_wait(void* h, double timeout_s, char* buf, int buflen) {
+  ApiHandle* ah = static_cast<ApiHandle*>(h);
   Response r;
-  if (!static_cast<Core*>(h)->Wait(&r, timeout_s)) return 0;
-  return CopyOut(FormatResponse(r), buf, buflen);
+  if (!TakeStash(ah, &r) && !ah->core->Wait(&r, timeout_s)) return 0;
+  return Deliver(ah, r, buf, buflen);
 }
 
-void hvd_core_shutdown(void* h) { static_cast<Core*>(h)->Shutdown(); }
+void hvd_core_shutdown(void* h) {
+  static_cast<ApiHandle*>(h)->core->Shutdown();
+}
 
 // stats: cycles, cache_hits, cache_misses, stall_warnings, responses
 void hvd_core_stats(void* h, unsigned long long* out5) {
-  ControllerStats s = static_cast<Core*>(h)->stats();
+  ControllerStats s = static_cast<ApiHandle*>(h)->core->stats();
   out5[0] = s.cycles;
   out5[1] = s.cache_hits;
   out5[2] = s.cache_misses;

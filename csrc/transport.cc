@@ -102,7 +102,8 @@ TcpTransport::TcpTransport(int rank, int size, const std::string& addr,
       return;
     if (listen(listen_fd_, size) != 0) return;
     worker_fds_.assign(size, -1);
-    for (int i = 1; i < size; i++) {
+    int connected = 0;
+    while (connected < size - 1) {
       // bounded accept: a worker that never shows up must fail rank 0's
       // bring-up within timeout_ms, not hang init forever.
       auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
@@ -115,13 +116,24 @@ TcpTransport::TcpTransport(int rank, int size, const std::string& addr,
       if (fd < 0) return;
       int one2 = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
-      // first frame from each worker is its rank
+      // first frame from each worker is its rank; a stray connection
+      // (port scanner, liveness probe, stale worker) is discarded rather
+      // than failing the whole bring-up.  Bound the hello read so a silent
+      // stray socket can't eat the bring-up budget.
+      timeval tv{2, 0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
       std::string hello;
-      if (!RecvFrame(fd, &hello) || hello.size() != 4) return;
-      int r;
-      memcpy(&r, hello.data(), 4);
-      if (r <= 0 || r >= size || worker_fds_[r] != -1) return;
+      int r = -1;
+      if (RecvFrame(fd, &hello) && hello.size() == 4)
+        memcpy(&r, hello.data(), 4);
+      if (r <= 0 || r >= size || worker_fds_[r] != -1) {
+        close(fd);
+        continue;
+      }
+      timeval tv0{0, 0};  // back to blocking for the cycle protocol
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv0, sizeof(tv0));
       worker_fds_[r] = fd;
+      connected++;
     }
     ok_ = true;
   } else {

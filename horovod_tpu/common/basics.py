@@ -41,12 +41,23 @@ def _build_library() -> None:
                    capture_output=True)
 
 
+def _needs_rebuild() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for f in os.listdir(_CSRC):
+        if f.endswith((".cc", ".h")) and \
+                os.path.getmtime(os.path.join(_CSRC, f)) > lib_mtime:
+            return True
+    return False
+
+
 def load_library() -> ctypes.CDLL:
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
+        if _needs_rebuild():
             _build_library()
         lib = ctypes.CDLL(_LIB_PATH)
         # signatures
@@ -175,8 +186,15 @@ class CoordinationCore:
     def join(self) -> None:
         self._lib.hvd_core_join(self._h)
 
+    def _grow(self, needed: int) -> None:
+        self._buf = ctypes.create_string_buffer(max(needed + 1,
+                                                    2 * len(self._buf)))
+
     def poll(self) -> Optional[CoreResponse]:
         n = self._lib.hvd_core_poll(self._h, self._buf, len(self._buf))
+        if n < 0:  # -(needed+1): response retained in the stash; retry
+            self._grow(-n)
+            n = self._lib.hvd_core_poll(self._h, self._buf, len(self._buf))
         if n <= 0:
             return None
         return CoreResponse(self._buf.value.decode())
@@ -184,6 +202,10 @@ class CoordinationCore:
     def wait(self, timeout_s: float = 30.0) -> Optional[CoreResponse]:
         n = self._lib.hvd_core_wait(self._h, timeout_s, self._buf,
                                     len(self._buf))
+        if n < 0:
+            self._grow(-n)
+            n = self._lib.hvd_core_wait(self._h, timeout_s, self._buf,
+                                        len(self._buf))
         if n <= 0:
             return None
         return CoreResponse(self._buf.value.decode())

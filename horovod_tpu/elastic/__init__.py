@@ -1,1 +1,27 @@
-"""horovod_tpu.elastic subpackage."""
+"""Elastic training: fault-tolerant, dynamically-resizable jobs.
+
+Public surface mirrors ``horovod.elastic`` (reference:
+horovod/common/elastic.py, horovod/runner/elastic/*):
+
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    state = elastic.JaxState(params=params, opt_state=opt_state, epoch=0)
+
+    @elastic.run
+    def train(state):
+        ...
+        state.commit()
+"""
+
+from .state import State, ObjectState, JaxState
+from .worker import run, WorkerNotificationManager
+from .discovery import (HostDiscovery, HostDiscoveryScript, FixedHosts,
+                        HostManager)
+from .driver import ElasticDriver, WorkerStateRegistry, run_elastic
+
+__all__ = [
+    "State", "ObjectState", "JaxState", "run", "WorkerNotificationManager",
+    "HostDiscovery", "HostDiscoveryScript", "FixedHosts", "HostManager",
+    "ElasticDriver", "WorkerStateRegistry", "run_elastic",
+]

@@ -1,0 +1,263 @@
+"""Elastic driver: discovery polling, rank-preserving reassignment, worker
+lifecycle, reset rounds.
+
+Reference: horovod/runner/elastic/driver.py:68-314 — a poll thread watches
+the discovery script (driver.py:181-202); on membership change or worker
+failure the driver recomputes slot assignments *preserving existing ranks*
+(driver.py:233-276), blacklists hosts whose workers failed
+(registration.py:51-130), bumps the rendezvous and restarts workers; it
+stops when min_np can't be met or the reset limit is hit.
+
+TPU adaptation: a membership change requires rebuilding the jax.distributed
+mesh, so every reset round restarts *all* worker processes with fresh
+HOROVOD_SIZE/RANK env (the reference restarts only affected workers because
+gloo can re-form in-process).  Worker state survives via
+JaxState(commit_path=...) disk commits plus rank-0 broadcast on sync.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..common import hvdlogging as log
+from ..common.knobs import Knobs
+from ..runner import hosts as hosts_mod
+from ..runner.http_server import RendezvousServer
+from .discovery import HostDiscovery, HostDiscoveryScript, HostManager
+from .worker import HOST_UPDATE_SCOPE, HOST_UPDATE_KEY
+
+
+class WorkerStateRegistry:
+    """Counts worker outcomes per reset round (reference:
+    registration.py:28-130)."""
+
+    SUCCESS, FAILURE = "success", "failure"
+
+    def __init__(self):
+        self._results: Dict[int, str] = {}
+        self._lock = threading.Lock()
+
+    def record(self, rank: int, outcome: str) -> None:
+        with self._lock:
+            self._results[rank] = outcome
+
+    def failures(self) -> List[int]:
+        with self._lock:
+            return [r for r, o in self._results.items()
+                    if o == self.FAILURE]
+
+    def successes(self) -> List[int]:
+        with self._lock:
+            return [r for r, o in self._results.items()
+                    if o == self.SUCCESS]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._results.clear()
+
+
+class ElasticDriver:
+    def __init__(self, discovery: HostDiscovery, min_np: int, max_np: int,
+                 command: List[str],
+                 env: Optional[Dict[str, str]] = None,
+                 elastic_timeout: float = 600.0,
+                 reset_limit: int = 0,
+                 coordinator_port: int = 29500,
+                 controller_port: int = 29499,
+                 discovery_interval: float = 1.0):
+        self.host_manager = HostManager(discovery)
+        self.min_np = min_np
+        self.max_np = max_np
+        self.command = command
+        self.extra_env = env or {}
+        self.elastic_timeout = elastic_timeout
+        self.reset_limit = reset_limit
+        self.coordinator_port = coordinator_port
+        self.controller_port = controller_port
+        self.discovery_interval = discovery_interval
+
+        self.registry = WorkerStateRegistry()
+        self.rendezvous = RendezvousServer()
+        self.rdv_port = self.rendezvous.start()
+        self._host_update_counter = 0
+        self._current_hosts: List[hosts_mod.HostInfo] = []
+        self._prev_assignment: Dict[str, List[int]] = {}
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._stop = threading.Event()
+        self._hosts_changed = threading.Event()
+        self._discovery_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- discovery
+    def start_discovery(self) -> None:
+        """Poll thread (reference: driver.py:181-202, 1 s interval)."""
+        def loop():
+            while not self._stop.wait(self.discovery_interval):
+                try:
+                    cur, changed = self.host_manager.update_available_hosts(
+                        self._current_hosts)
+                except Exception as e:
+                    log.warning("elastic discovery failed: %s", e)
+                    continue
+                if changed:
+                    self._current_hosts = cur
+                    self._hosts_changed.set()
+                    self._notify_host_update()
+        self._discovery_thread = threading.Thread(target=loop, daemon=True)
+        self._discovery_thread.start()
+
+    def _notify_host_update(self) -> None:
+        self._host_update_counter += 1
+        self.rendezvous.put(HOST_UPDATE_SCOPE, HOST_UPDATE_KEY,
+                            str(self._host_update_counter).encode())
+
+    def wait_for_available_slots(self, min_np: int) -> List[hosts_mod.HostInfo]:
+        """Block until enough slots exist (reference: driver.py:145-180)."""
+        deadline = time.time() + self.elastic_timeout
+        while time.time() < deadline:
+            hosts = self.host_manager.current_hosts()
+            if sum(h.slots for h in hosts) >= min_np:
+                self._current_hosts = hosts
+                return hosts
+            time.sleep(0.5)
+        raise TimeoutError(
+            f"timed out waiting for {min_np} slots "
+            f"(HOROVOD_ELASTIC_TIMEOUT={self.elastic_timeout:.0f}s)")
+
+    # ----------------------------------------------------------- assignment
+    def compute_assignments(
+            self, hosts: List[hosts_mod.HostInfo]) -> List[hosts_mod.SlotInfo]:
+        """Rank-preserving assignment (reference: driver.py:233-276): hosts
+        that already held ranks keep their previous *order* so rank 0 (the
+        broadcast root) stays on a surviving host when possible."""
+        order: Dict[str, int] = {}
+        for h, ranks in self._prev_assignment.items():
+            if ranks:
+                order[h] = min(ranks)
+        hosts_sorted = sorted(
+            hosts, key=lambda h: (order.get(h.hostname, 1 << 30),
+                                  h.hostname))
+        np_ = min(self.max_np, sum(h.slots for h in hosts_sorted))
+        slots = hosts_mod.get_host_assignments(hosts_sorted, np_)
+        self._prev_assignment = {}
+        for s in slots:
+            self._prev_assignment.setdefault(s.hostname, []).append(s.rank)
+        return slots
+
+    # -------------------------------------------------------------- workers
+    def _spawn_worker(self, slot: hosts_mod.SlotInfo,
+                      coord_host: str) -> subprocess.Popen:
+        from ..runner.launch import build_worker_command
+        updates = dict(self.extra_env)
+        updates.update(slot.to_env())
+        updates["HOROVOD_RENDEZVOUS_ADDR"] = coord_host
+        updates["HOROVOD_RENDEZVOUS_PORT"] = str(self.rdv_port)
+        updates["HOROVOD_CONTROLLER_PORT"] = str(self.controller_port)
+        if slot.size > 1:
+            updates["HOROVOD_COORDINATOR_ADDR"] = \
+                f"{coord_host}:{self.coordinator_port}"
+        env = dict(os.environ)
+        env.update(updates)
+        cmd = build_worker_command(slot, self.command, updates,
+                                   ssh_port=None, ssh_identity=None)
+        return subprocess.Popen(cmd, env=env)
+
+    def _terminate_all(self) -> None:
+        for p in self._procs.values():
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in self._procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self._procs.clear()
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> int:
+        """Reset-round loop (reference: driver.py run/reset +
+        launch.py:621-670 semantics)."""
+        self.start_discovery()
+        resets = 0
+        try:
+            while True:
+                hosts = self.wait_for_available_slots(self.min_np)
+                slots = self.compute_assignments(hosts)
+                coord_host = slots[0].hostname
+                if coord_host in ("localhost",):
+                    coord_host = "127.0.0.1"
+                self._hosts_changed.clear()
+                self.registry.reset()
+                log.info("elastic round %d: %d workers on %s", resets,
+                         len(slots),
+                         ",".join(h.hostname for h in hosts))
+                self._procs = {s.rank: self._spawn_worker(s, coord_host)
+                               for s in slots}
+
+                round_failed = False
+                while self._procs:
+                    done = [(r, p) for r, p in self._procs.items()
+                            if p.poll() is not None]
+                    for r, p in done:
+                        del self._procs[r]
+                        outcome = (WorkerStateRegistry.SUCCESS
+                                   if p.returncode == 0
+                                   else WorkerStateRegistry.FAILURE)
+                        self.registry.record(r, outcome)
+                        if outcome == WorkerStateRegistry.FAILURE:
+                            host = next((s.hostname for s in slots
+                                         if s.rank == r), None)
+                            if host:
+                                self.host_manager.blacklist(host)
+                                log.warning(
+                                    "elastic: rank %d on %s failed "
+                                    "(rc=%s); host blacklisted", r, host,
+                                    p.returncode)
+                            round_failed = True
+                    if round_failed or self._hosts_changed.is_set():
+                        break
+                    time.sleep(0.2)
+
+                if not self._procs and not round_failed and \
+                        not self._hosts_changed.is_set():
+                    return 0  # clean finish
+                # reset round: stop everything, re-rendezvous
+                self._terminate_all()
+                resets += 1
+                if self.reset_limit and resets > self.reset_limit:
+                    log.error("elastic: reset limit %d exceeded",
+                              self.reset_limit)
+                    return 1
+        finally:
+            self._stop.set()
+            self._terminate_all()
+            self.rendezvous.stop()
+
+
+def run_elastic(args, command: List[str]) -> int:
+    """CLI entry from hvdrun (reference: _run_elastic launch.py:621-670)."""
+    knobs = Knobs()
+    if not args.host_discovery_script:
+        raise SystemExit(
+            "elastic mode requires --host-discovery-script "
+            "(reference: launch.py elastic validation)")
+    discovery = HostDiscoveryScript(args.host_discovery_script)
+    min_np = args.min_np or args.num_proc or 1
+    max_np = args.max_np or args.num_proc or (1 << 30)
+    from ..runner.launch import args_to_env
+    driver = ElasticDriver(
+        discovery, min_np, max_np, command, env=args_to_env(args),
+        elastic_timeout=args.elastic_timeout or
+        knobs["HOROVOD_ELASTIC_TIMEOUT"],
+        reset_limit=args.reset_limit
+        if args.reset_limit is not None
+        else knobs["HOROVOD_ELASTIC_RESET_LIMIT"],
+        coordinator_port=args.coordinator_port,
+        controller_port=args.controller_port)
+    return driver.run()

@@ -1,0 +1,139 @@
+"""Elastic state: commit/restore/sync protocol.
+
+Mirrors the reference's elastic State machinery (reference:
+horovod/common/elastic.py:99-150 State.save/restore/commit/sync;
+torch/elastic/state.py:27-140 per-type handlers): user state (params,
+optimizer state, epoch/batch counters) is snapshotted on ``commit()``,
+restored after a hard reset, and broadcast from the new rank 0 on
+``sync()`` so late joiners converge.
+
+TPU caveat (SURVEY.md §7 hard part (c)): losing a chip usually kills the
+whole slice process, so a hard reset often means process restart — state
+therefore optionally persists to a host-local file on commit
+(``commit_to_disk``), which the reference leaves to user checkpoints.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..common.exceptions import HostsUpdatedInterrupt
+
+
+class State:
+    """Base elastic state (reference: common/elastic.py:99-150)."""
+
+    def __init__(self, **kwargs: Any):
+        self._saved: Dict[str, Any] = {}
+        self._host_updated: Callable[[], bool] = lambda: False
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._fields = list(kwargs.keys())
+
+    # -- reset plumbing -----------------------------------------------------
+    def register_host_update_check(self, fn: Callable[[], bool]) -> None:
+        self._host_updated = fn
+
+    def check_host_updates(self) -> None:
+        """Raise HostsUpdatedInterrupt when membership changed (reference:
+        common/elastic.py:60-97)."""
+        if self._host_updated():
+            raise HostsUpdatedInterrupt()
+
+    # -- snapshot protocol --------------------------------------------------
+    def save(self) -> None:
+        for f in self._fields:
+            self._saved[f] = copy.deepcopy(getattr(self, f))
+
+    def restore(self) -> None:
+        for f, v in self._saved.items():
+            setattr(self, f, copy.deepcopy(v))
+
+    def commit(self) -> None:
+        """Snapshot + host-update checkpoint boundary (reference:
+        common/elastic.py:118-131: commit then check_host_updates)."""
+        self.save()
+        self.on_commit()
+        self.check_host_updates()
+
+    def on_commit(self) -> None:
+        """Hook for subclasses (disk persistence etc.)."""
+
+    def sync(self) -> None:
+        """Broadcast state from rank 0 so all workers agree (reference:
+        broadcast-based sync, tensorflow/elastic.py:31-90).  Base class has
+        nothing to broadcast but must still snapshot: a hard reset right
+        after sync() must roll back to this point."""
+        self.save()
+
+
+class ObjectState(State):
+    """Arbitrary picklable attributes, synced via broadcast_object
+    (reference: horovod/common/elastic.py ObjectState)."""
+
+    def sync(self) -> None:
+        from ..functions import broadcast_object
+        values = {f: getattr(self, f) for f in self._fields}
+        values = broadcast_object(values, root_rank=0)
+        for f, v in values.items():
+            setattr(self, f, v)
+        self.save()
+
+
+class JaxState(State):
+    """Elastic state for jax training: params/opt_state pytrees + scalars.
+
+    The analog of TorchState's model/optimizer handlers (reference:
+    torch/elastic/state.py:27-140).  Pytrees are synced leaf-wise with
+    broadcast (root 0); plain attributes via broadcast_object.
+    """
+
+    PYTREE_FIELDS = ("params", "opt_state")
+
+    def __init__(self, params: Any = None, opt_state: Any = None,
+                 commit_path: Optional[str] = None, **scalars: Any):
+        self.commit_path = commit_path
+        super().__init__(params=params, opt_state=opt_state, **scalars)
+
+    def sync(self) -> None:
+        from ..functions import broadcast_parameters, broadcast_object
+        if self.params is not None:
+            self.params = broadcast_parameters(self.params, root_rank=0)
+        if self.opt_state is not None:
+            self.opt_state = broadcast_parameters(self.opt_state,
+                                                  root_rank=0)
+        scalars = {f: getattr(self, f) for f in self._fields
+                   if f not in ("params", "opt_state")}
+        if scalars:
+            synced = broadcast_object(scalars, root_rank=0)
+            for k, v in synced.items():
+                setattr(self, k, v)
+        self.save()
+
+    def on_commit(self) -> None:
+        if self.commit_path:
+            tmp = self.commit_path + ".tmp"
+            with open(tmp, "wb") as f:
+                host_state = {
+                    f2: jax.tree_util.tree_map(np.asarray, getattr(self, f2))
+                    for f2 in self._fields}
+                pickle.dump(host_state, f)
+            os.replace(tmp, self.commit_path)
+
+    def load_from_disk(self) -> bool:
+        """Restore a commit written by a previous incarnation of this
+        process (TPU slice restart path)."""
+        if not (self.commit_path and os.path.exists(self.commit_path)):
+            return False
+        with open(self.commit_path, "rb") as f:
+            host_state = pickle.load(f)
+        for k, v in host_state.items():
+            setattr(self, k, v)
+        self.save()
+        return True

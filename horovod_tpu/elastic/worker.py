@@ -1,0 +1,117 @@
+"""Worker-side elastic plumbing: host-update notifications + the run()
+wrapper.
+
+Reference: the WorkerNotificationManager listens for HostsUpdatedRequest
+(reference: horovod/runner/elastic/worker.py:32-119) and the
+`@hvd.elastic.run` decorator implements the reset loop (reference:
+horovod/common/elastic.py:151-175):
+
+  loop:
+    state.sync() after (re)init
+    try: user train fn
+    except HorovodInternalError: hard reset — shutdown, re-rendezvous,
+        re-init, state.restore()
+    except HostsUpdatedInterrupt: soft reset — keep live state, re-sync.
+
+Host updates arrive via the rendezvous KV store (the driver bumps a
+counter key) instead of a per-worker socket service.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..common import hvdlogging as log
+from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..common.knobs import Knobs
+from ..runner.http_client import get_kv
+
+HOST_UPDATE_SCOPE = "elastic"
+HOST_UPDATE_KEY = "host_update_counter"
+
+
+class WorkerNotificationManager:
+    """Polls the rendezvous KV for membership-change bumps (reference:
+    worker.py:46-118, transport swapped for the KV store)."""
+
+    def __init__(self, addr: Optional[str] = None,
+                 port: Optional[int] = None,
+                 poll_interval: float = 1.0):
+        knobs = Knobs()
+        self.addr = addr if addr is not None else \
+            knobs["HOROVOD_RENDEZVOUS_ADDR"]
+        self.port = port if port is not None else \
+            knobs["HOROVOD_RENDEZVOUS_PORT"]
+        self.poll_interval = poll_interval
+        self._last_seen = self._read()
+        self._updated = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.addr and self.port:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def _read(self) -> int:
+        if not (self.addr and self.port):
+            return 0
+        try:
+            v = get_kv(self.addr, self.port, HOST_UPDATE_SCOPE,
+                       HOST_UPDATE_KEY)
+            return int(v) if v else 0
+        except Exception:
+            return 0
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            if self._read() > self._last_seen:
+                self._updated.set()
+
+    def host_updated(self) -> bool:
+        return self._updated.is_set()
+
+    def acknowledge(self) -> None:
+        self._last_seen = self._read()
+        self._updated.clear()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def run(func: Callable) -> Callable:
+    """``@hvd.elastic.run`` (reference: common/elastic.py:151-175).
+
+    The wrapped function must take the elastic ``state`` as its first
+    argument.  On TPU, a hard reset usually arrives as a process restart
+    (slice loss); in-process HorovodInternalError still gets the
+    shutdown/re-init/restore treatment for surviving processes.
+    """
+    @functools.wraps(func)
+    def wrapper(state, *args: Any, **kwargs: Any):
+        from .. import runtime as _rt
+        notifier = WorkerNotificationManager()
+        state.register_host_update_check(notifier.host_updated)
+        reset_limit = Knobs()["HOROVOD_ELASTIC_RESET_LIMIT"]
+        resets = 0
+        state.sync()
+        while True:
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError as e:
+                log.warning("elastic: hard reset after internal error: %s",
+                            e)
+                _rt.shutdown()
+                _rt.init()
+                state.restore()
+            except HostsUpdatedInterrupt:
+                log.info("elastic: soft reset (hosts updated)")
+                notifier.acknowledge()
+            resets += 1
+            if reset_limit and resets > reset_limit:
+                raise RuntimeError(
+                    f"elastic reset limit {reset_limit} exceeded "
+                    "(reference: --reset-limit semantics)")
+            state.sync()
+    return wrapper

@@ -79,10 +79,14 @@ def _to_local(rt: "_rt.Runtime", global_arr: Array) -> Array:
     """Extract this process's [local_size, ...] slice of the result."""
     if rt.process_size() == 1:
         return global_arr
-    shards = sorted(global_arr.addressable_shards, key=lambda s: s.index)
-    return jnp.stack([jnp.squeeze(s.data, axis=0) if s.data.shape[0] == 1
-                      else s.data for s in shards]) \
-        if len(shards) > 1 else shards[0].data
+    shards = sorted(global_arr.addressable_shards,
+                    key=lambda s: (s.index[0].start or 0) if s.index else 0)
+    if len(shards) == 1:
+        return shards[0].data
+    # Shards live on different local devices; assemble on host (jnp.stack
+    # across device-committed arrays is rejected by jax).
+    return jnp.asarray(np.concatenate([np.asarray(s.data) for s in shards],
+                                      axis=0))
 
 
 # ----------------------------------------------------------------- jit caching

@@ -1,0 +1,47 @@
+"""Tiny HTTP KV client for the rendezvous server (reference:
+horovod/runner/http/http_client.py:1-45: read_data_from_kvstore /
+put_data_into_kvstore)."""
+
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+def put_kv(addr: str, port: int, scope: str, key: str,
+           value: bytes) -> None:
+    url = f"http://{addr}:{port}/{scope}/{key}"
+    req = urllib.request.Request(url, data=value, method="PUT")
+    with urllib.request.urlopen(req, timeout=10):
+        pass
+
+
+def get_kv(addr: str, port: int, scope: str, key: str,
+           timeout: float = 0.0,
+           poll_interval: float = 0.2) -> Optional[bytes]:
+    """GET with optional blocking-until-present semantics (workers wait for
+    the launcher to publish slot info)."""
+    url = f"http://{addr}:{port}/{scope}/{key}"
+    deadline = time.time() + timeout
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+            if time.time() >= deadline:
+                return None
+            time.sleep(poll_interval)
+
+
+def delete_kv(addr: str, port: int, scope: str, key: str) -> bool:
+    url = f"http://{addr}:{port}/{scope}/{key}"
+    req = urllib.request.Request(url, method="DELETE")
+    try:
+        with urllib.request.urlopen(req, timeout=10):
+            return True
+    except urllib.error.HTTPError:
+        return False

@@ -1,0 +1,102 @@
+"""Rendezvous: a threaded HTTP key-value store.
+
+Direct functional port of the reference's rendezvous server (reference:
+horovod/runner/http/http_server.py:35-201): PUT/GET on /scope/key paths
+backed by an in-memory dict.  Consumers: worker bootstrap (slot info),
+elastic host-change notifications, and anything that needs a tiny shared
+blackboard during launch.  The reference's C++ gloo HTTPStore speaks the
+same protocol; here the native core uses TCP directly, so this server
+serves the Python-side rendezvous and elastic signaling.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    server_version = "hvdtpu-rendezvous/1.0"
+
+    def _split(self) -> Tuple[str, str]:
+        parts = self.path.strip("/").split("/", 1)
+        scope = parts[0] if parts else ""
+        key = parts[1] if len(parts) > 1 else ""
+        return scope, key
+
+    def do_PUT(self) -> None:  # noqa: N802
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            self.server.kv.setdefault(scope, {})[key] = value  # type: ignore
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self) -> None:  # noqa: N802
+        scope, key = self._split()
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            value = self.server.kv.get(scope, {}).get(key)  # type: ignore
+        if value is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        scope, key = self._split()
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            existed = self.server.kv.get(scope, {}).pop(key, None)  # type: ignore
+        self.send_response(200 if existed is not None else 404)
+        self.end_headers()
+
+    def log_message(self, *args) -> None:  # silence per-request logging
+        pass
+
+
+class RendezvousServer:
+    """Threaded KV server; start() returns the bound port (reference:
+    http_server.py:174-201 RendezvousServer.start/init)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._httpd = ThreadingHTTPServer((self._host, self._port),
+                                          _KVHandler)
+        self._httpd.kv = {}  # type: ignore[attr-defined]
+        self._httpd.kv_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None
+        return self._httpd.server_address[1]
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        """Server-side direct write (launcher publishing slot info,
+        reference: http_server.py:134-172 init(host_alloc_plan))."""
+        assert self._httpd is not None
+        with self._httpd.kv_lock:  # type: ignore[attr-defined]
+            self._httpd.kv.setdefault(scope, {})[key] = value  # type: ignore
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        assert self._httpd is not None
+        with self._httpd.kv_lock:  # type: ignore[attr-defined]
+            return self._httpd.kv.get(scope, {}).get(key)  # type: ignore
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

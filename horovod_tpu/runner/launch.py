@@ -1,0 +1,300 @@
+"""hvdrun: the launcher CLI.
+
+The `horovodrun` equivalent (reference: horovod/runner/launch.py:242-771):
+parses ~CLI flags into HOROVOD_* env knobs, computes slot assignments,
+starts the rendezvous HTTP server, and spawns one worker process per slot —
+locally via subprocess, remotely via ssh (reference: gloo_run.py:114-273).
+Elastic mode (--min-np/--max-np + --host-discovery-script) delegates to
+horovod_tpu.elastic.driver.
+
+TPU specifics replacing the reference's machinery:
+  * workers get HOROVOD_COORDINATOR_ADDR so jax.distributed assembles the
+    global TPU mesh (replacing MPI_COMM_WORLD / gloo rendezvous contexts);
+  * one slot per TPU host is the norm (jax drives all local chips);
+  * no ssh NIC probing — TPU VM slices have flat reachable networking
+    (reference's driver_service ring check, driver_service.py:162-193,
+    is unnecessary).
+
+Usage:
+  python -m horovod_tpu.runner.launch -np 2 -H host1:1,host2:1 python train.py
+  hvdrun -np 4 python train.py          # via console entry point
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from . import hosts as hosts_mod
+from .http_server import RendezvousServer
+
+LOCAL_HOSTNAMES = {"localhost", "127.0.0.1", socket.gethostname()}
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch distributed training on TPU hosts "
+                    "(horovodrun equivalent)")
+    p.add_argument("-np", "--num-proc", type=int, required=False,
+                   help="total number of worker processes")
+    p.add_argument("-H", "--hosts", default=None,
+                   help="comma-separated host:slots, e.g. h1:1,h2:1")
+    p.add_argument("--hostfile", default=None,
+                   help="file with one host:slots per line")
+    p.add_argument("-p", "--ssh-port", type=int, default=None)
+    p.add_argument("--ssh-identity-file", default=None)
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--version", action="store_true")
+    # --- tunables -> env knobs (reference: config_parser.py:1-202) ---
+    p.add_argument("--fusion-threshold-mb", type=int, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--mesh", default=None,
+                   help="mesh spec, e.g. 'data=8' or 'data=4,model=2'")
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--no-stall-check", action="store_true")
+    p.add_argument("--stall-check-time-seconds", type=int, default=None)
+    p.add_argument("--stall-shutdown-time-seconds", type=int, default=None)
+    p.add_argument("--log-level", default=None,
+                   choices=["trace", "debug", "info", "warning", "error",
+                            "fatal"])
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--config-file", default=None,
+                   help="YAML config (reference schema: params/autotune/"
+                        "timeline/stall-check sections)")
+    # --- elastic (reference: launch.py:621-670) ---
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--elastic-timeout", type=int, default=None)
+    p.add_argument("--reset-limit", type=int, default=None)
+    # --- ports ---
+    p.add_argument("--coordinator-port", type=int, default=29500)
+    p.add_argument("--controller-port", type=int, default=29499)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command")
+    return p
+
+
+def config_file_to_env(path: str, env: Dict[str, str]) -> None:
+    """YAML config -> env knobs (reference: config_parser.py:202 schema,
+    single/data/config.test.yaml)."""
+    import yaml
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    params = cfg.get("params", {})
+    mapping = {
+        "fusion_threshold_mb": lambda v: ("HOROVOD_FUSION_THRESHOLD",
+                                          str(int(v) * 1024 * 1024)),
+        "cycle_time_ms": lambda v: ("HOROVOD_CYCLE_TIME", str(v)),
+        "cache_capacity": lambda v: ("HOROVOD_CACHE_CAPACITY", str(v)),
+        "mesh": lambda v: ("HOROVOD_TPU_MESH", str(v)),
+    }
+    for k, v in params.items():
+        if k in mapping:
+            name, val = mapping[k](v)
+            env[name] = val
+    tl = cfg.get("timeline", {})
+    if tl.get("filename"):
+        env["HOROVOD_TIMELINE"] = tl["filename"]
+    if tl.get("mark_cycles"):
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+    sc = cfg.get("stall_check", {})
+    if sc.get("disable"):
+        env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    if sc.get("warning_time_seconds") is not None:
+        env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = \
+            str(sc["warning_time_seconds"])
+    if sc.get("shutdown_time_seconds") is not None:
+        env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = \
+            str(sc["shutdown_time_seconds"])
+    at = cfg.get("autotune", {})
+    if at.get("enabled"):
+        env["HOROVOD_AUTOTUNE"] = "1"
+    if at.get("log_file"):
+        env["HOROVOD_AUTOTUNE_LOG"] = at["log_file"]
+
+
+def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
+    """CLI flags win over config file, which wins over ambient env
+    (reference: launch.py + config_parser layering)."""
+    env: Dict[str, str] = {}
+    if args.config_file:
+        config_file_to_env(args.config_file, env)
+    if args.fusion_threshold_mb is not None:
+        env["HOROVOD_FUSION_THRESHOLD"] = str(
+            args.fusion_threshold_mb * 1024 * 1024)
+    if args.cycle_time_ms is not None:
+        env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.mesh:
+        env["HOROVOD_TPU_MESH"] = args.mesh
+    if args.timeline_filename:
+        env["HOROVOD_TIMELINE"] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+    if args.no_stall_check:
+        env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    if args.stall_check_time_seconds is not None:
+        env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = \
+            str(args.stall_check_time_seconds)
+    if args.stall_shutdown_time_seconds is not None:
+        env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = \
+            str(args.stall_shutdown_time_seconds)
+    if args.log_level:
+        env["HOROVOD_LOG_LEVEL"] = args.log_level
+    if args.autotune:
+        env["HOROVOD_AUTOTUNE"] = "1"
+    if args.autotune_log_file:
+        env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.elastic_timeout is not None:
+        env["HOROVOD_ELASTIC_TIMEOUT"] = str(args.elastic_timeout)
+    if args.reset_limit is not None:
+        env["HOROVOD_ELASTIC_RESET_LIMIT"] = str(args.reset_limit)
+    return env
+
+
+def resolve_hosts(args: argparse.Namespace) -> List[hosts_mod.HostInfo]:
+    if args.hosts and args.hostfile:
+        raise ValueError("use either --hosts or --hostfile, not both")
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            spec = ",".join(line.strip() for line in f
+                            if line.strip() and not line.startswith("#"))
+        return hosts_mod.parse_hosts(spec)
+    if args.hosts:
+        return hosts_mod.parse_hosts(args.hosts)
+    return [hosts_mod.HostInfo("localhost", args.num_proc or 1)]
+
+
+def _is_local(hostname: str) -> bool:
+    return hostname in LOCAL_HOSTNAMES
+
+
+def build_worker_command(slot: hosts_mod.SlotInfo, command: List[str],
+                         env_updates: Dict[str, str],
+                         ssh_port: Optional[int],
+                         ssh_identity: Optional[str]) -> List[str]:
+    """The exec vector for one slot: plain command locally, ssh wrapper
+    remotely (reference: gloo_run.py:114-186)."""
+    if _is_local(slot.hostname):
+        return list(command)
+    exports = " ".join(f"{k}={shlex.quote(v)}"
+                       for k, v in sorted(env_updates.items()))
+    remote = (f"cd {shlex.quote(os.getcwd())} && env {exports} "
+              + " ".join(shlex.quote(c) for c in command))
+    ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh_cmd += ["-p", str(ssh_port)]
+    if ssh_identity:
+        ssh_cmd += ["-i", ssh_identity]
+    ssh_cmd += [slot.hostname, remote]
+    return ssh_cmd
+
+
+def launch_static(args: argparse.Namespace, command: List[str]) -> int:
+    """Static (non-elastic) run (reference: _run_static launch.py:528-618
+    + launch_gloo gloo_run.py:226-273)."""
+    host_infos = resolve_hosts(args)
+    np_ = args.num_proc or sum(h.slots for h in host_infos)
+    slots = hosts_mod.get_host_assignments(host_infos, np_)
+
+    rendezvous = RendezvousServer()
+    rdv_port = rendezvous.start()
+    for slot in slots:
+        rendezvous.put("rank", str(slot.rank),
+                       repr(slot.to_env()).encode())
+
+    coord_host = slots[0].hostname
+    if _is_local(coord_host):
+        coord_host = "127.0.0.1"
+    knob_env = args_to_env(args)
+
+    procs: List[subprocess.Popen] = []
+
+    def spawn(slot: hosts_mod.SlotInfo) -> subprocess.Popen:
+        # One env block serves both paths: local Popen env AND the ssh
+        # `env k=v` export list — remote workers must see the rendezvous/
+        # coordinator/controller addresses too.
+        updates = dict(knob_env)
+        updates.update(slot.to_env())
+        updates["HOROVOD_RENDEZVOUS_ADDR"] = coord_host
+        updates["HOROVOD_RENDEZVOUS_PORT"] = str(rdv_port)
+        updates["HOROVOD_CONTROLLER_PORT"] = str(args.controller_port)
+        if np_ > 1:
+            updates["HOROVOD_COORDINATOR_ADDR"] = \
+                f"{coord_host}:{args.coordinator_port}"
+        env = dict(os.environ)
+        env.update(updates)
+        cmd = build_worker_command(slot, command, updates,
+                                   args.ssh_port, args.ssh_identity_file)
+        if args.verbose:
+            print(f"[hvdrun] rank {slot.rank} on {slot.hostname}: "
+                  f"{' '.join(cmd)}", file=sys.stderr)
+        return subprocess.Popen(cmd, env=env)
+
+    try:
+        for slot in slots:
+            procs.append(spawn(slot))
+        exit_code = 0
+        for p in procs:
+            rc = p.wait()
+            if rc != 0 and exit_code == 0:
+                exit_code = rc
+                # fail fast: kill the rest (reference: gloo_run terminates
+                # remaining workers on first failure)
+                for q in procs:
+                    if q.poll() is None:
+                        q.terminate()
+        return exit_code
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in procs:
+            p.wait()
+        return 130
+    finally:
+        rendezvous.stop()
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    if args.version:
+        from .. import __version__
+        print(__version__)
+        return 0
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("hvdrun: no training command given", file=sys.stderr)
+        return 2
+    elastic = args.host_discovery_script or args.min_np or args.max_np
+    if args.num_proc is None and not (args.hosts or args.hostfile
+                                      or elastic):
+        print("hvdrun: -np required when no hosts are given",
+              file=sys.stderr)
+        return 2
+    if elastic:
+        from ..elastic.driver import run_elastic
+        return run_elastic(args, command)
+    return launch_static(args, command)
+
+
+def main() -> None:  # console entry point
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,200 @@
+"""Elastic subsystem tests (reference analogs: test/single/
+test_elastic_driver.py driver logic with fake discovery, integration/
+elastic_common.py mutable-discovery-file end-to-end)."""
+
+import os
+import stat
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           HostsUpdatedInterrupt)
+from horovod_tpu.elastic import (ElasticDriver, FixedHosts, HostManager,
+                                 JaxState, ObjectState, State,
+                                 WorkerNotificationManager, run)
+from horovod_tpu.runner import hosts as H
+from horovod_tpu.runner.http_server import RendezvousServer
+from horovod_tpu.runner.http_client import put_kv
+
+
+# ------------------------------------------------------------------- state
+def test_state_save_restore():
+    s = State(epoch=1, batch=5)
+    s.save()
+    s.epoch, s.batch = 9, 99
+    s.restore()
+    assert s.epoch == 1 and s.batch == 5
+
+
+def test_state_commit_checks_host_updates():
+    s = State(epoch=0)
+    s.register_host_update_check(lambda: True)
+    with pytest.raises(HostsUpdatedInterrupt):
+        s.commit()
+    # the commit still saved before raising (soft reset keeps progress)
+    s.epoch = 7
+    s.restore()
+    assert s.epoch == 0
+
+
+def test_object_state_sync_single_process(hvd):
+    s = ObjectState(epoch=3, note="hello")
+    s.sync()
+    assert s.epoch == 3 and s.note == "hello"
+
+
+def test_jax_state_sync_and_disk_commit(hvd, tmp_path):
+    import jax.numpy as jnp
+    params = {"w": jnp.arange(4.0)}
+    path = str(tmp_path / "state.pkl")
+    s = JaxState(params=params, opt_state={"m": jnp.zeros(4)},
+                 commit_path=path, epoch=2)
+    s.register_host_update_check(lambda: False)
+    s.sync()
+    s.commit()
+    assert os.path.exists(path)
+    # a fresh incarnation (process restart after slice loss) loads the commit
+    s2 = JaxState(params=None, opt_state=None, commit_path=path, epoch=0)
+    assert s2.load_from_disk()
+    np.testing.assert_allclose(np.asarray(s2.params["w"]),
+                               [0, 1, 2, 3])
+    assert s2.epoch == 2
+
+
+def test_run_wrapper_hard_reset(hvd):
+    """HorovodInternalError -> shutdown/re-init/restore/retry (reference:
+    common/elastic.py:151-175)."""
+    calls = {"n": 0}
+    state = State(counter=10)
+
+    @run
+    def train(st):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            st.counter = 999  # corrupted progress, must roll back
+            raise HorovodInternalError("simulated peer death")
+        return st.counter
+
+    assert train(state) == 10
+    assert calls["n"] == 2
+
+
+def test_run_wrapper_soft_reset(hvd):
+    calls = {"n": 0}
+    state = State(counter=0)
+
+    @run
+    def train(st):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise HostsUpdatedInterrupt()
+        st.counter += 1
+        return st.counter
+
+    assert train(state) == 1
+    assert calls["n"] == 2
+
+
+def test_run_wrapper_reset_limit(hvd, monkeypatch):
+    monkeypatch.setenv("HOROVOD_ELASTIC_RESET_LIMIT", "2")
+    state = State(x=0)
+
+    @run
+    def train(st):
+        raise HorovodInternalError("always broken")
+
+    with pytest.raises(RuntimeError, match="reset limit"):
+        train(state)
+
+
+# ------------------------------------------------------------ host manager
+def test_host_manager_blacklist_and_change():
+    fixed = FixedHosts(H.parse_hosts("a:1,b:1"))
+    mgr = HostManager(fixed)
+    assert [h.hostname for h in mgr.current_hosts()] == ["a", "b"]
+    mgr.blacklist("b")
+    assert [h.hostname for h in mgr.current_hosts()] == ["a"]
+    cur, changed = mgr.update_available_hosts(mgr.current_hosts())
+    assert not changed
+    fixed.set(H.parse_hosts("a:1,c:1"))
+    cur, changed = mgr.update_available_hosts(cur)
+    assert changed
+    assert [h.hostname for h in cur] == ["a", "c"]
+
+
+def test_driver_rank_preserving_assignment():
+    """Surviving hosts keep low ranks across resets (reference:
+    driver.py:233-276)."""
+    fixed = FixedHosts(H.parse_hosts("a:2,b:2"))
+    d = ElasticDriver(fixed, min_np=1, max_np=4, command=["true"])
+    try:
+        slots = d.compute_assignments(fixed.find_available_hosts())
+        assert [s.hostname for s in slots] == ["a", "a", "b", "b"]
+        # host 'a' dies; 'c' joins — 'b' must now own rank 0
+        fixed.set(H.parse_hosts("c:2,b:2"))
+        slots = d.compute_assignments(fixed.find_available_hosts())
+        assert [s.hostname for s in slots] == ["b", "b", "c", "c"]
+        assert slots[0].rank == 0 and slots[0].hostname == "b"
+    finally:
+        d.rendezvous.stop()
+
+
+def test_worker_notification_manager():
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        notifier = WorkerNotificationManager("127.0.0.1", port,
+                                             poll_interval=0.05)
+        assert not notifier.host_updated()
+        put_kv("127.0.0.1", port, "elastic", "host_update_counter", b"1")
+        deadline = time.time() + 3
+        while not notifier.host_updated() and time.time() < deadline:
+            time.sleep(0.05)
+        assert notifier.host_updated()
+        notifier.acknowledge()
+        assert not notifier.host_updated()
+        notifier.stop()
+    finally:
+        srv.stop()
+
+
+# -------------------------------------------------------------- end-to-end
+def _write_discovery(path, content):
+    path.write_text(f"#!/bin/sh\necho '{content}'\n")
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+
+
+def test_elastic_driver_end_to_end_success(tmp_path):
+    """Driver launches workers from a discovery script and finishes clean
+    (reference: integration elastic tests with localhost discovery files)."""
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    disc = tmp_path / "discover.sh"
+    _write_discovery(disc, "localhost:2")
+    marker = tmp_path / "ran"
+    cmd = [sys.executable, "-c",
+           f"import os; open(r'{marker}_'+os.environ['HOROVOD_RANK'],"
+           f"'w').write('ok')"]
+    d = ElasticDriver(HostDiscoveryScript(str(disc)), min_np=2, max_np=2,
+                      command=cmd, elastic_timeout=20)
+    rc = d.run()
+    assert rc == 0
+    assert (tmp_path / "ran_0").exists() and (tmp_path / "ran_1").exists()
+
+
+def test_elastic_driver_blacklists_failing_host(tmp_path):
+    """A failing worker blacklists its host; with no hosts left the driver
+    times out rather than spinning (reference: blacklist semantics,
+    discovery.py:80-134)."""
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    disc = tmp_path / "discover.sh"
+    _write_discovery(disc, "localhost:1")
+    cmd = [sys.executable, "-c", "import sys; sys.exit(1)"]
+    d = ElasticDriver(HostDiscoveryScript(str(disc)), min_np=1, max_np=1,
+                      command=cmd, elastic_timeout=2)
+    with pytest.raises(TimeoutError):
+        d.run()
+    assert d.host_manager.is_blacklisted("localhost")

@@ -1,0 +1,218 @@
+"""Launcher tests (reference analog: test/single/test_run.py:63-234 CLI/env
+construction, hosts tests, rendezvous KV tests)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner import hosts as H
+from horovod_tpu.runner.launch import (args_to_env, build_worker_command,
+                                       config_file_to_env, launch_static,
+                                       make_parser, run_commandline)
+from horovod_tpu.runner.http_server import RendezvousServer
+from horovod_tpu.runner.http_client import put_kv, get_kv, delete_kv
+
+
+# ------------------------------------------------------------------- hosts
+def test_parse_hosts():
+    infos = H.parse_hosts("h1:4,h2:2,h3")
+    assert [(h.hostname, h.slots) for h in infos] == \
+        [("h1", 4), ("h2", 2), ("h3", 1)]
+
+
+def test_parse_hosts_errors():
+    with pytest.raises(ValueError):
+        H.parse_hosts("")
+    with pytest.raises(ValueError):
+        H.parse_hosts("h1:2,h1:2")
+
+
+def test_host_assignments_single_host():
+    slots = H.get_host_assignments(H.parse_hosts("localhost:4"), 4)
+    assert [s.rank for s in slots] == [0, 1, 2, 3]
+    assert all(s.size == 4 and s.local_size == 4 and s.cross_size == 1
+               for s in slots)
+    assert [s.local_rank for s in slots] == [0, 1, 2, 3]
+
+
+def test_host_assignments_multi_host():
+    """LOCAL/CROSS coordinates (reference: hosts.py:100-155)."""
+    slots = H.get_host_assignments(H.parse_hosts("a:2,b:2"), 4)
+    assert [(s.hostname, s.rank, s.local_rank, s.cross_rank)
+            for s in slots] == \
+        [("a", 0, 0, 0), ("a", 1, 1, 0), ("b", 2, 0, 1), ("b", 3, 1, 1)]
+    assert all(s.cross_size == 2 for s in slots)
+
+
+def test_host_assignments_partial_last_host():
+    slots = H.get_host_assignments(H.parse_hosts("a:2,b:2"), 3)
+    assert [s.hostname for s in slots] == ["a", "a", "b"]
+    assert slots[2].local_size == 1
+
+
+def test_host_assignments_oversubscribe_rejected():
+    with pytest.raises(ValueError):
+        H.get_host_assignments(H.parse_hosts("a:2"), 3)
+
+
+def test_slot_env_block():
+    slot = H.get_host_assignments(H.parse_hosts("a:2,b:2"), 4)[2]
+    env = slot.to_env()
+    assert env["HOROVOD_RANK"] == "2"
+    assert env["HOROVOD_SIZE"] == "4"
+    assert env["HOROVOD_LOCAL_RANK"] == "0"
+    assert env["HOROVOD_CROSS_RANK"] == "1"
+
+
+# --------------------------------------------------------------- CLI -> env
+def test_args_to_env_flags():
+    args = make_parser().parse_args(
+        ["-np", "2", "--fusion-threshold-mb", "64", "--cycle-time-ms",
+         "2.5", "--timeline-filename", "/tmp/t.json", "--no-stall-check",
+         "--log-level", "debug", "--autotune", "--mesh", "data=8",
+         "python", "t.py"])
+    env = args_to_env(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(64 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "2.5"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/t.json"
+    assert env["HOROVOD_STALL_CHECK_DISABLE"] == "1"
+    assert env["HOROVOD_LOG_LEVEL"] == "debug"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert env["HOROVOD_TPU_MESH"] == "data=8"
+
+
+def test_config_file_to_env(tmp_path):
+    """YAML schema parity (reference: single/data/config.test.yaml,
+    config_parser.py:202)."""
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(textwrap.dedent("""
+        params:
+          fusion_threshold_mb: 32
+          cycle_time_ms: 3.0
+        timeline:
+          filename: /tmp/tl.json
+          mark_cycles: true
+        stall_check:
+          warning_time_seconds: 120
+        autotune:
+          enabled: true
+    """))
+    env = {}
+    config_file_to_env(str(cfg), env)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_TIMELINE"] == "/tmp/tl.json"
+    assert env["HOROVOD_TIMELINE_MARK_CYCLES"] == "1"
+    assert env["HOROVOD_STALL_CHECK_TIME_SECONDS"] == "120"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+
+
+def test_cli_flag_beats_config(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("params:\n  fusion_threshold_mb: 32\n")
+    args = make_parser().parse_args(
+        ["-np", "1", "--config-file", str(cfg),
+         "--fusion-threshold-mb", "8", "python", "t.py"])
+    env = args_to_env(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(8 * 1024 * 1024)
+
+
+def test_build_worker_command_local_vs_ssh():
+    slots = H.get_host_assignments(H.parse_hosts("localhost:1,remotehost:1"), 2)
+    local = build_worker_command(slots[0], ["python", "t.py"], {}, None,
+                                 None)
+    assert local == ["python", "t.py"]
+    remote = build_worker_command(slots[1], ["python", "t.py"],
+                                  {"HOROVOD_RANK": "1"}, 2222, None)
+    assert remote[0] == "ssh"
+    assert "-p" in remote and "2222" in remote
+    assert "HOROVOD_RANK=1" in remote[-1]
+
+
+# ----------------------------------------------------------------- rendezvous
+def test_rendezvous_kv_roundtrip():
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        put_kv("127.0.0.1", port, "scope", "key", b"value42")
+        assert get_kv("127.0.0.1", port, "scope", "key") == b"value42"
+        assert get_kv("127.0.0.1", port, "scope", "missing") is None
+        assert delete_kv("127.0.0.1", port, "scope", "key")
+        assert get_kv("127.0.0.1", port, "scope", "key") is None
+        # server-side direct put (launcher publishing slot info)
+        srv.put("rank", "0", b"{}")
+        assert get_kv("127.0.0.1", port, "rank", "0") == b"{}"
+    finally:
+        srv.stop()
+
+
+def test_rendezvous_blocking_get():
+    import threading
+    import time
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        def later():
+            time.sleep(0.3)
+            put_kv("127.0.0.1", port, "s", "k", b"eventually")
+        threading.Thread(target=later, daemon=True).start()
+        assert get_kv("127.0.0.1", port, "s", "k", timeout=5.0) == \
+            b"eventually"
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------- CLI behavior
+def test_cli_no_command():
+    assert run_commandline(["-np", "2"]) == 2
+
+
+def test_cli_version(capsys):
+    assert run_commandline(["--version"]) == 0
+    import horovod_tpu
+    assert horovod_tpu.__version__ in capsys.readouterr().out
+
+
+# --------------------------------------------------------------- integration
+def test_launch_static_two_local_processes(tmp_path, monkeypatch):
+    """End-to-end static run on localhost (reference analog:
+    test/integration/test_static_run.py): two processes check their env and
+    write rank files."""
+    import horovod_tpu
+    repo = os.path.dirname(os.path.dirname(horovod_tpu.__file__))
+    monkeypatch.setenv("PYTHONPATH", repo)
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os
+        rank = os.environ["HOROVOD_RANK"]
+        size = os.environ["HOROVOD_SIZE"]
+        assert size == "2"
+        assert os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+        # rendezvous reachable from the worker
+        from horovod_tpu.runner.http_client import get_kv
+        info = get_kv(os.environ["HOROVOD_RENDEZVOUS_ADDR"],
+                      int(os.environ["HOROVOD_RENDEZVOUS_PORT"]),
+                      "rank", rank)
+        assert info is not None
+        open(r"{tmp_path}/out_" + rank, "w").write(size)
+    """))
+    args = make_parser().parse_args(
+        ["-np", "2", "--controller-port", "29601",
+         sys.executable, str(script)])
+    rc = launch_static(args, [sys.executable, str(script)])
+    assert rc == 0
+    assert (tmp_path / "out_0").read_text() == "2"
+    assert (tmp_path / "out_1").read_text() == "2"
+
+
+def test_launch_static_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import os, sys; "
+                      "sys.exit(3 if os.environ['HOROVOD_RANK']=='1' "
+                      "else 0)")
+    args = make_parser().parse_args(
+        ["-np", "2", sys.executable, str(script)])
+    rc = launch_static(args, [sys.executable, str(script)])
+    assert rc == 3
