@@ -53,6 +53,11 @@ int Deliver(ApiHandle* h, const Response& r, char* buf, int buflen) {
       if (i) s += ",";
       s += r.names[i];
     }
+    s += "|";
+    for (size_t i = 0; i < r.sigs.size(); i++) {
+      if (i) s += ",";
+      s += r.sigs[i];
+    }
   }
   int n = static_cast<int>(s.size());
   if (!buf || buflen <= n) {
@@ -138,10 +143,11 @@ int hvd_core_submit(void* h, const char* name, const char* signature,
   r.signature = signature ? signature : "";
   r.bytes = bytes;
   // '|' and ',' frame the C-API response format; reject them in both the
-  // name and the signature (signatures are echoed in error messages).
+  // name and the signature (both are echoed back in responses).
   if (r.name.find('|') != std::string::npos ||
       r.name.find(',') != std::string::npos ||
-      r.signature.find('|') != std::string::npos)
+      r.signature.find('|') != std::string::npos ||
+      r.signature.find(',') != std::string::npos)
     return -3;
   return core->Submit(r);
 }

@@ -49,10 +49,14 @@ struct Request {
 };
 
 // Coordinator verdict for a fused batch (reference: Response, message.h:150+).
+// `sigs` carries each tensor's frontend signature so ranks that JOINed can
+// reconstruct zero dummy tensors of the right shape/dtype (reference:
+// Response carries tensor sizes for the same purpose, message.fbs:97-118).
 struct Response {
   ResponseType type = ResponseType::OK;
   RequestType op = RequestType::ALLREDUCE;
   std::vector<std::string> names;  // execution batch, globally ordered
+  std::vector<std::string> sigs;   // parallel to names
   std::string error_message;
   int64_t total_bytes = 0;
 };
@@ -119,6 +123,8 @@ inline void SerializeResponse(const Response& r, Writer* w) {
   w->u8(static_cast<uint8_t>(r.op));
   w->u32(static_cast<uint32_t>(r.names.size()));
   for (const auto& n : r.names) w->str(n);
+  w->u32(static_cast<uint32_t>(r.sigs.size()));
+  for (const auto& s : r.sigs) w->str(s);
   w->str(r.error_message);
   w->i64(r.total_bytes);
 }
@@ -130,6 +136,9 @@ inline Response DeserializeResponse(Reader* rd) {
   uint32_t n = rd->u32();
   r.names.reserve(n);
   for (uint32_t i = 0; i < n; i++) r.names.push_back(rd->str());
+  uint32_t m = rd->u32();
+  r.sigs.reserve(m);
+  for (uint32_t i = 0; i < m; i++) r.sigs.push_back(rd->str());
   r.error_message = rd->str();
   r.total_bytes = rd->i64();
   return r;

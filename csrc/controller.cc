@@ -89,6 +89,7 @@ std::vector<Response> Controller::BuildResponses() {
     Response r;
     r.op = first.type;
     r.names = {name};
+    r.sigs = {first.signature};
     r.total_bytes = first.bytes;
     bool consistent = true;
     for (const auto& req : entry.requests) {
@@ -134,6 +135,7 @@ std::vector<Response> Controller::BuildResponses() {
     }
     if (can_fuse) {
       fused.back().names.push_back(r.names[0]);
+      fused.back().sigs.push_back(r.sigs[0]);
       fused.back().total_bytes += r.total_bytes;
     } else {
       fused.push_back(std::move(r));
@@ -173,6 +175,7 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
         Request req = DeserializeRequest(&rd);
         if (req.type == RequestType::JOIN) {
           joined_[req.rank] = true;
+          last_joined_ = req.rank;
         } else {
           Ingest(req, r);
         }
@@ -185,8 +188,12 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
     if (num_joined == n) {
       Response j;
       j.type = ResponseType::JOIN_DONE;
+      // Last-joined rank rides in total_bytes (reference: join() returns
+      // the id of the last rank to join, torch/mpi_ops.py:882-897).
+      j.total_bytes = last_joined_;
       resp.push_back(j);
       joined_.assign(n, false);
+      last_joined_ = -1;
     }
     if (std::count(shutdown_.begin(), shutdown_.end(), true) == n) {
       Response s;

@@ -79,6 +79,7 @@ class Controller {
   std::unordered_map<std::string, Entry> table_;
   std::vector<std::string> arrival_order_;
   std::vector<bool> joined_;     // per-rank JOIN flags
+  int last_joined_ = -1;         // rank whose JOIN completed the set
   std::vector<bool> shutdown_;   // per-rank shutdown flags
   // signature LRU cache (name -> sig), most-recent at back
   std::list<std::pair<std::string, std::string>> cache_lru_;

@@ -93,17 +93,18 @@ def load_library() -> ctypes.CDLL:
 
 
 class CoreResponse:
-    """Parsed controller verdict (see csrc/c_api.cc FormatResponse)."""
+    """Parsed controller verdict (see csrc/c_api.cc Deliver)."""
 
-    __slots__ = ("type", "op", "total_bytes", "error", "names")
+    __slots__ = ("type", "op", "total_bytes", "error", "names", "sigs")
 
     def __init__(self, raw: str):
-        t, op, total, err, names = raw.split("|", 4)
+        t, op, total, err, names, sigs = raw.split("|", 5)
         self.type = t
         self.op = int(op)
         self.total_bytes = int(total)
         self.error = err
         self.names = names.split(",") if names else []
+        self.sigs = sigs.split(",") if sigs else []
 
     def __repr__(self) -> str:  # pragma: no cover
         return (f"CoreResponse({self.type}, op={self.op}, "

@@ -320,9 +320,11 @@ def alltoall(tensor: TensorLike,
                 f"by size ({n})")
         g = _make_global(rt, local)
         fn = _compiled(_mesh_key(rt), "alltoall")
-        out = fn(g)
+        out = _to_local(rt, fn(g))
         recv = jnp.full((rt.local_size(), n), rows // n, jnp.int32)
-        return _to_local(rt, out), recv
+        if not had:
+            return out[0], recv[0]
+        return out, recv
 
     # Uneven splits: pad each destination block to the global max block,
     # run the dense equal-split all_to_all, reassemble with recv splits.
@@ -363,6 +365,8 @@ def alltoall(tensor: TensorLike,
         blocks = [out[i, s * max_blk: s * max_blk + int(recv_np[i, s])]
                   for s in range(n)]
         outs.append(jnp.concatenate(blocks, axis=0))
+    if not had:
+        return outs[0], jnp.asarray(recv_np[0], jnp.int32)
     # Ragged per-chip outputs can differ in rows; return list if ragged.
     rows_per = {int(r.sum()) for r in recv_np}
     if len(rows_per) == 1:

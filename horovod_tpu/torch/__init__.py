@@ -1,1 +1,56 @@
-"""horovod_tpu.torch subpackage."""
+"""Torch frontend: the reference's ``horovod.torch`` surface on the TPU
+data plane (reference: horovod/torch/__init__.py, mpi_ops.py, optimizer.py,
+functions.py, sync_batch_norm.py, elastic/).
+
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(opt, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+Torch tensors stay on host; collectives bridge to XLA over the mesh chips.
+Worker unit is the chip (a process's value is held by each of its
+``local_size()`` chips), so ``Average`` matches per-process semantics and
+``size()`` counts chips.
+"""
+
+from __future__ import annotations
+
+# Topology + lifecycle re-exported from the package root.
+from .. import (init, shutdown, is_initialized, rank, size, local_rank,
+                local_size, cross_rank, cross_size, process_rank,
+                process_size, mesh, is_homogeneous)
+from ..common.reduce_op import ReduceOp, Average, Sum, Adasum, Min, Max, \
+    Product
+from ..common.exceptions import (HorovodInternalError,
+                                 HostsUpdatedInterrupt)
+
+from .compression import Compression
+from .mpi_ops import (allreduce, allreduce_, allreduce_async,
+                      allreduce_async_, grouped_allreduce,
+                      grouped_allreduce_, grouped_allreduce_async,
+                      grouped_allreduce_async_, allgather, allgather_async,
+                      broadcast, broadcast_, broadcast_async,
+                      broadcast_async_, alltoall, alltoall_async,
+                      synchronize, poll, join)
+from .optimizer import DistributedOptimizer
+from .functions import (broadcast_parameters, broadcast_optimizer_state,
+                        broadcast_object, allgather_object)
+from .sync_batch_norm import SyncBatchNorm
+from . import elastic
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "process_rank", "process_size",
+    "mesh", "is_homogeneous",
+    "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max", "Product",
+    "Compression",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_", "grouped_allreduce_async",
+    "grouped_allreduce_async_", "allgather", "allgather_async",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "alltoall", "alltoall_async", "synchronize", "poll", "join",
+    "DistributedOptimizer",
+    "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
+    "allgather_object", "SyncBatchNorm", "elastic",
+    "HorovodInternalError", "HostsUpdatedInterrupt",
+]

@@ -1,0 +1,74 @@
+"""Gradient compression for the torch frontend.
+
+Mirrors the reference's torch compressor surface (reference:
+horovod/torch/compression.py:1-74): ``Compression.none`` / ``Compression.fp16``
+with ``compress(tensor) -> (tensor, ctx)`` / ``decompress(tensor, ctx)``.
+Adds ``Compression.bf16`` — the TPU-native wire dtype (fp32 range, ICI/MXU
+native narrow type).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import torch
+
+
+class Compressor:
+    """Interface for compressing and decompressing a given tensor."""
+
+    @staticmethod
+    def compress(tensor: torch.Tensor) -> Tuple[torch.Tensor, Any]:
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx: Any) -> torch.Tensor:
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast floating tensors to fp16 for the wire (reference:
+    torch/compression.py FP16Compressor)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.to(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.to(ctx) if ctx is not None else tensor
+
+
+class BF16Compressor(Compressor):
+    """bfloat16 wire compression (TPU-native addition; no reference
+    equivalent — bf16 keeps fp32 exponent range on the MXU/ICI)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point and tensor.dtype != torch.bfloat16:
+            return tensor.to(torch.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.to(ctx) if ctx is not None else tensor
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce
+    (reference: horovod/torch/compression.py Compression namespace)."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,131 @@
+"""Torch broadcast helpers (reference: horovod/torch/functions.py:29-266).
+
+``broadcast_parameters`` / ``broadcast_optimizer_state`` / ``broadcast_object``
+sync model + optimizer state from a root worker — the canonical start-of-
+training and checkpoint-resume idiom (reference: examples/pytorch/
+pytorch_mnist.py usage; SURVEY.md §5 checkpoint conventions).
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+from typing import Any, Iterable, Mapping, Tuple, Union
+
+import cloudpickle
+import numpy as np
+import torch
+
+from . import mpi_ops
+
+
+def broadcast_parameters(params: Union[Mapping[str, torch.Tensor],
+                                       Iterable[Tuple[str, torch.Tensor]]],
+                         root_rank: int = 0) -> None:
+    """In-place broadcast of a state_dict or named_parameters iterable
+    (reference: torch/functions.py:29-72)."""
+    if isinstance(params, Mapping):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    scalars = {}
+    for name, p in items:
+        if p is None:
+            continue
+        if isinstance(p, torch.Tensor):
+            mpi_ops.broadcast_(p.data if hasattr(p, "data") else p,
+                               root_rank=root_rank, name=f"bcast.{name}")
+        else:
+            scalars[name] = p
+    if scalars:
+        synced = broadcast_object(scalars, root_rank=root_rank,
+                                  name="bcast.scalars")
+        if isinstance(params, Mapping) and not isinstance(
+                params, collections.abc.MutableMapping):
+            return
+        for name, v in synced.items():
+            if isinstance(params, collections.abc.MutableMapping):
+                params[name] = v
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast an optimizer's state from root (reference:
+    torch/functions.py:74-175).  Tensor state entries broadcast in place;
+    non-tensor entries (step counters etc.) via broadcast_object."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    state_dict = optimizer.state_dict()
+    if not state_dict.get("state"):
+        # Unmaterialized state: create it by stepping with zero grads, like
+        # the reference (torch/functions.py:104-118).
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = torch.zeros_like(p)
+        optimizer.step()
+        state_dict = optimizer.state_dict()
+
+    tensors = []
+    scalars = {}
+    for pid, pstate in state_dict["state"].items():
+        for key, value in pstate.items():
+            if isinstance(value, torch.Tensor):
+                tensors.append((f"opt.{pid}.{key}", value))
+            else:
+                scalars[f"{pid}/{key}"] = value
+    for name, t in tensors:
+        mpi_ops.broadcast_(t, root_rank=root_rank, name=name)
+    if scalars:
+        synced = broadcast_object(scalars, root_rank=root_rank,
+                                  name="opt.scalars")
+        for k, v in synced.items():
+            pid_s, key = k.split("/", 1)
+            pid = type(next(iter(state_dict["state"])))(pid_s) \
+                if state_dict["state"] else pid_s
+            state_dict["state"][pid][key] = v
+        optimizer.load_state_dict(state_dict)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: str = "broadcast_object") -> Any:
+    """Broadcast an arbitrary picklable object (reference:
+    torch/functions.py:177-231): serialize on root, broadcast the length,
+    then the payload bytes."""
+    from .. import rank as _rank
+    if _rank() == root_rank:
+        buf = io.BytesIO()
+        cloudpickle.dump(obj, buf)
+        payload = np.frombuffer(buf.getvalue(), dtype=np.uint8).copy()
+    else:
+        payload = np.zeros(1, np.uint8)
+    sz = torch.tensor([len(payload)], dtype=torch.int64)
+    sz = mpi_ops.broadcast(sz, root_rank=root_rank, name=f"{name}.sz")
+    n = int(sz.item())
+    t = torch.zeros(n, dtype=torch.uint8)
+    if _rank() == root_rank:
+        t = torch.from_numpy(payload)
+    t = mpi_ops.broadcast(t, root_rank=root_rank, name=f"{name}.data")
+    return cloudpickle.load(io.BytesIO(t.numpy().tobytes()))
+
+
+def allgather_object(obj: Any, name: str = "allgather_object") -> list:
+    """Gather a picklable object from every worker-chip (reference:
+    torch/functions.py:233-266)."""
+    buf = io.BytesIO()
+    cloudpickle.dump(obj, buf)
+    payload = np.frombuffer(buf.getvalue(), dtype=np.uint8).copy()
+    t = torch.from_numpy(payload)
+    sizes = mpi_ops.allgather(torch.tensor([t.numel()], dtype=torch.int64),
+                              name=f"{name}.sz")
+    # Pad to the max size for the dense gather, then slice per worker.
+    max_n = int(sizes.max().item())
+    padded = torch.zeros(max_n, dtype=torch.uint8)
+    padded[:t.numel()] = t
+    gathered = mpi_ops.allgather(padded.unsqueeze(0), name=f"{name}.data")
+    out = []
+    for i in range(sizes.numel()):
+        n = int(sizes[i].item())
+        out.append(cloudpickle.load(
+            io.BytesIO(gathered[i, :n].numpy().tobytes())))
+    return out

@@ -1,0 +1,657 @@
+"""Eager torch collectives over the TPU data plane.
+
+The torch-facing op surface of the reference (reference:
+horovod/torch/mpi_ops.py:95-897, torch/mpi_ops_v2.cc:64-514): sync + async +
+in-place variants of allreduce / grouped_allreduce / allgather / broadcast /
+alltoall, integer handles with ``synchronize``/``poll``, autograd support,
+and ``join``.
+
+Execution model (TPU-native): torch tensors live on host; each op bridges
+them to the XLA data plane (horovod_tpu.ops.collectives) where the
+collective runs over the mesh chips.  The worker unit is the **chip** —
+a process's tensor is held identically by each of its ``local_size()``
+chips, so Average matches the reference's per-process semantics exactly,
+while Sum sums over chips.
+
+Ordering (the reference's controller problem): torch code enqueues
+per-parameter allreduces from autograd hooks in nondeterministic order per
+process.  When multiple processes share the mesh, ops are *negotiated*
+through the native controller (csrc/): each op submits (name, signature) and
+executes only when its batch arrives in the globally agreed response order,
+which is identical on every process — preventing cross-process deadlock
+(reference: controller.cc:69-450).  Single-process runs skip negotiation.
+
+Joined ranks reconstruct zero dummy tensors from the response signatures and
+keep participating until JOIN_DONE (reference: Join protocol,
+controller.cc:254-307, collective_operations.cc:262-270).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import torch
+
+from .. import runtime as _rt
+from ..common import basics as _basics
+from ..common.exceptions import HorovodInternalError
+from ..common.reduce_op import ReduceOp, Average, Sum, Adasum
+from ..ops import collectives as _C
+
+__all__ = [
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_", "grouped_allreduce_async",
+    "grouped_allreduce_async_",
+    "allgather", "allgather_async", "broadcast", "broadcast_",
+    "broadcast_async", "broadcast_async_", "alltoall", "alltoall_async",
+    "synchronize", "poll", "join",
+]
+
+
+# ------------------------------------------------------------- dtype bridging
+class _ProcessTensor(np.ndarray):
+    """Marks a bridged tensor as *one value per process* so the eager layer
+    replicates it across local chips instead of interpreting a leading dim
+    that happens to equal local_size() as a per-chip axis (the torch API has
+    no per-chip axis; see ops/collectives._per_chip)."""
+    _hvd_per_chip = False
+
+
+def _np_from_torch(t: torch.Tensor) -> np.ndarray:
+    """torch -> numpy, keeping bf16 via ml_dtypes (numpy lacks bfloat16)."""
+    t = t.detach().contiguous().cpu()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+        arr = t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    else:
+        arr = t.numpy()
+    return arr.view(_ProcessTensor)
+
+
+def _torch_from_np(a: np.ndarray, like_dtype: torch.dtype) -> torch.Tensor:
+    a = np.ascontiguousarray(a)
+    if like_dtype == torch.bfloat16:
+        import ml_dtypes
+        if a.dtype != ml_dtypes.bfloat16:
+            a = a.astype(ml_dtypes.bfloat16)
+        return torch.from_numpy(a.view(np.uint16).copy()).view(torch.bfloat16)
+    t = torch.from_numpy(a.copy() if not a.flags.owndata else a)
+    return t.to(like_dtype)
+
+
+_SIG_DTYPE = {
+    torch.float32: "f32", torch.float64: "f64", torch.float16: "f16",
+    torch.bfloat16: "bf16", torch.int32: "i32", torch.int64: "i64",
+    torch.int16: "i16", torch.int8: "i8", torch.uint8: "u8",
+    torch.bool: "b1",
+}
+_SIG_DTYPE_INV = {v: k for k, v in _SIG_DTYPE.items()}
+
+
+def _signature(t: torch.Tensor, kind: str, extra: str = "") -> str:
+    """Consistency key checked across ranks by the controller (reference:
+    ConstructResponse shape/dtype/op validation, controller.cc:472-749).
+    Leading token is the dtype — the controller fuses same-dtype batches."""
+    shape = "x".join(str(s) for s in t.shape)
+    return f"{_SIG_DTYPE.get(t.dtype, str(t.dtype))}:{shape}:{kind}:{extra}"
+
+
+def _zeros_from_signature(sig: str) -> torch.Tensor:
+    """Rebuild a zero dummy tensor for a collective this (joined) rank never
+    submitted (reference: JoinOp zero tensor, collective_operations.cc:262)."""
+    dt, shape, _kind, _extra = sig.split(":", 3)
+    dims = tuple(int(s) for s in shape.split("x") if s)
+    return torch.zeros(dims, dtype=_SIG_DTYPE_INV.get(dt, torch.float32))
+
+
+# ------------------------------------------------------------- handle manager
+class _HandleManager:
+    """Integer handles for in-flight ops (reference: handle_manager.{h,cc}:
+    AllocateHandle / MarkDone / ReleaseHandle)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._results: Dict[int, Any] = {}
+
+    def allocate(self) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._results[h] = None
+            return h
+
+    def mark_done(self, handle: int, result: Any) -> None:
+        with self._lock:
+            if handle in self._results:
+                self._results[handle] = result
+
+    def done(self, handle: int) -> bool:
+        with self._lock:
+            if handle not in self._results:
+                raise ValueError(f"unknown handle {handle}")
+            return self._results[handle] is not None
+
+    def release(self, handle: int) -> Any:
+        with self._lock:
+            return self._results.pop(handle)
+
+
+_handles = _HandleManager()
+_pending_lock = threading.RLock()
+_pending: Dict[str, "_PendingOp"] = {}
+_name_counter = [0]
+
+
+def _auto_name(prefix: str) -> str:
+    _name_counter[0] += 1
+    return f"{prefix}.noname.{_name_counter[0]}"
+
+
+class _PendingOp:
+    """A locally submitted op waiting for its negotiated execution slot."""
+
+    __slots__ = ("name", "handle", "execute", "kind")
+
+    def __init__(self, name: str, handle: int, kind: str,
+                 execute: Callable[[], Any]):
+        self.name = name
+        self.handle = handle
+        self.kind = kind
+        self.execute = execute
+
+
+def _core():
+    rt = _rt.get()
+    return rt.ensure_core()
+
+
+def _dispatch(name: str, sig: str, op_type: int, nbytes: int, kind: str,
+              execute: Callable[[], Any]) -> int:
+    """Submit an op; either run it immediately (no negotiation needed) or
+    park it until the controller schedules its batch."""
+    handle = _handles.allocate()
+    core = _core()
+    if core is None:
+        _handles.mark_done(handle, execute())
+        return handle
+    with _pending_lock:
+        _pending[name] = _PendingOp(name, handle, kind, execute)
+    core.submit(name, sig, op_type, nbytes)
+    return handle
+
+
+def _execute_response(resp) -> None:
+    """Run one negotiated response batch, in coordinator order."""
+    if resp.type == "ERROR":
+        raise HorovodInternalError(
+            f"controller error: {resp.error} (reference: ERROR response, "
+            "controller.cc:482-707)")
+    for name, sig in zip(resp.names,
+                         resp.sigs or [""] * len(resp.names)):
+        with _pending_lock:
+            op = _pending.pop(name, None)
+        if op is not None:
+            _handles.mark_done(op.handle, op.execute())
+        else:
+            # We never submitted this tensor: we must have JOINed.
+            # Participate with zero dummies so peers' collective completes,
+            # honoring the negotiated op/root carried in the signature extra
+            # field (the compiled SPMD program must be identical on every
+            # process).
+            parts = sig.split("+") if sig else [""]
+            fields = parts[0].split(":", 3)
+            kind = fields[2] if len(fields) >= 3 else "allreduce"
+            extra = fields[3] if len(fields) >= 4 else ""
+            arrs = [_np_from_torch(_zeros_from_signature(p)) for p in parts]
+            if kind == "grouped_allreduce":
+                _C.grouped_allreduce(arrs,
+                                     op=ReduceOp(int(extra)) if extra
+                                     else Sum)
+            elif kind == "allreduce":
+                _C.allreduce(arrs[0],
+                             op=ReduceOp(int(extra)) if extra else Sum)
+            elif kind == "allgather":
+                _C.allgather(arrs[0])
+            elif kind == "broadcast":
+                _C.broadcast(arrs[0],
+                             root_rank=int(extra) if extra else 0)
+            else:
+                # alltoall with splits takes a host-side size-exchange
+                # barrier a joined rank cannot mirror; the reference
+                # restricts Join to allreduce-family ops too.
+                raise HorovodInternalError(
+                    f"collective kind {kind!r} is not supported while this "
+                    "rank has joined (reference: Join supports "
+                    "allreduce/allgather/broadcast)")
+
+
+def _drain(handle: Optional[int] = None, timeout_s: float = 300.0) -> None:
+    """Pump negotiated responses until `handle` completes (or queue empty)."""
+    core = _core()
+    if core is None:
+        return
+    import time
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if handle is not None and _handles.done(handle):
+            return
+        if handle is None:
+            with _pending_lock:
+                if not _pending:
+                    return
+        resp = core.wait(timeout_s=min(1.0, timeout_s))
+        if resp is not None:
+            _execute_response(resp)
+        elif time.monotonic() > deadline:
+            raise HorovodInternalError(
+                f"timed out after {timeout_s}s waiting for negotiated "
+                "collective (stalled peer?)")
+
+
+# --------------------------------------------------------------- op execution
+def _run_allreduce(tensor: torch.Tensor, op: ReduceOp,
+                   prescale_factor: float, postscale_factor: float,
+                   compression) -> torch.Tensor:
+    compressed, ctx = compression.compress(tensor)
+    arr = _np_from_torch(compressed)
+    out = np.asarray(_C.allreduce(
+        arr, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
+    res = _torch_from_np(out, compressed.dtype)
+    return compression.decompress(res, ctx)
+
+
+def _nbytes(t: torch.Tensor) -> int:
+    return t.numel() * t.element_size()
+
+
+# ------------------------------------------------------------------ allreduce
+def _allreduce_async_impl(tensor: torch.Tensor, name: str, op: ReduceOp,
+                          prescale_factor: float, postscale_factor: float,
+                          compression, output: Optional[torch.Tensor]) -> int:
+    sig = _signature(tensor, "allreduce", str(int(op)))
+
+    def execute():
+        res = _run_allreduce(tensor, op, prescale_factor, postscale_factor,
+                             compression)
+        if output is not None:
+            output.copy_(res)
+            return output
+        return res
+
+    return _dispatch(name, sig, _basics.OP_ALLREDUCE, _nbytes(tensor),
+                     "allreduce", execute)
+
+
+def _resolve_op(average: Optional[bool], op: Optional[ReduceOp]) -> ReduceOp:
+    """The deprecated `average` flag maps onto ReduceOp (reference:
+    torch/mpi_ops.py:60-94 handle_average_backwards_compatibility)."""
+    if average is not None:
+        if op is not None:
+            raise ValueError("cannot specify both average and op")
+        return Average if average else Sum
+    return op if op is not None else Average
+
+
+def allreduce_async(tensor: torch.Tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None,
+                    op: Optional[ReduceOp] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    compression=None) -> int:
+    """Async allreduce into a new tensor; returns a handle (reference:
+    torch/mpi_ops.py:162-186)."""
+    from .compression import Compression
+    compression = compression or Compression.none
+    rop = _resolve_op(average, op)
+    return _allreduce_async_impl(tensor, name or _auto_name("allreduce"),
+                                 rop, prescale_factor, postscale_factor,
+                                 compression, None)
+
+
+def allreduce_async_(tensor: torch.Tensor, average: Optional[bool] = None,
+                     name: Optional[str] = None,
+                     op: Optional[ReduceOp] = None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0) -> int:
+    """In-place async allreduce (reference: torch/mpi_ops.py:236-260)."""
+    from .compression import Compression
+    rop = _resolve_op(average, op)
+    return _allreduce_async_impl(tensor, name or _auto_name("allreduce"),
+                                 rop, prescale_factor, postscale_factor,
+                                 Compression.none, tensor)
+
+
+class _AllreduceFunction(torch.autograd.Function):
+    """Differentiable allreduce: grad flows through another allreduce with
+    the same op (reference: torch/mpi_ops.py:142-160 HorovodAllreduce)."""
+
+    @staticmethod
+    def forward(ctx, tensor, average, name, op, prescale_factor,
+                postscale_factor):
+        ctx.op = _resolve_op(average, op)
+        ctx.prescale_factor = prescale_factor
+        ctx.postscale_factor = postscale_factor
+        handle = allreduce_async(tensor, average, name, op, prescale_factor,
+                                 postscale_factor)
+        return synchronize(handle)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        op = Average if ctx.op == Adasum else ctx.op
+        reduced = allreduce(grad_output, op=op,
+                            prescale_factor=ctx.prescale_factor,
+                            postscale_factor=ctx.postscale_factor)
+        return reduced, None, None, None, None, None
+
+
+def allreduce(tensor: torch.Tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, compression=None,
+              op: Optional[ReduceOp] = None,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0) -> torch.Tensor:
+    """Synchronous differentiable allreduce (reference:
+    torch/mpi_ops.py:188-234)."""
+    from .compression import Compression
+    compression = compression or Compression.none
+    # compress/decompress are dtype casts (differentiable), so autograd
+    # survives compression by routing the compressed tensor through the
+    # differentiable allreduce.
+    compressed, ctx = compression.compress(tensor)
+    if compressed.requires_grad:
+        out = _AllreduceFunction.apply(compressed, average, name, op,
+                                       prescale_factor, postscale_factor)
+    else:
+        h = allreduce_async(compressed, average, name, op, prescale_factor,
+                            postscale_factor)
+        out = synchronize(h)
+    return compression.decompress(out, ctx)
+
+
+def allreduce_(tensor: torch.Tensor, average: Optional[bool] = None,
+               name: Optional[str] = None,
+               op: Optional[ReduceOp] = None,
+               prescale_factor: float = 1.0,
+               postscale_factor: float = 1.0) -> torch.Tensor:
+    """Synchronous in-place allreduce (reference: torch/mpi_ops.py:262-285)."""
+    h = allreduce_async_(tensor, average, name, op, prescale_factor,
+                         postscale_factor)
+    return synchronize(h)
+
+
+# ---------------------------------------------------------- grouped allreduce
+def _grouped_allreduce_async_impl(tensors: Sequence[torch.Tensor], name: str,
+                                  op: ReduceOp, prescale_factor: float,
+                                  postscale_factor: float,
+                                  outputs: Optional[Sequence[torch.Tensor]]
+                                  ) -> int:
+    # One negotiation entry for the whole group — grouped ops fuse atomically
+    # (reference: GroupTable, group_table.{h,cc}; controller.cc:199-223).
+    sig = "+".join(_signature(t, "grouped_allreduce", str(int(op)))
+                   for t in tensors)
+    total = sum(_nbytes(t) for t in tensors)
+
+    def execute():
+        arrs = [_np_from_torch(t) for t in tensors]
+        outs = [np.asarray(o) for o in _C.grouped_allreduce(
+            arrs, op=op, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)]
+        res = [_torch_from_np(o, t.dtype) for o, t in zip(outs, tensors)]
+        if outputs is not None:
+            for dst, src in zip(outputs, res):
+                dst.copy_(src)
+            return list(outputs)
+        return res
+
+    return _dispatch(name, sig, _basics.OP_ALLREDUCE, total,
+                     "grouped_allreduce", execute)
+
+
+def grouped_allreduce_async(tensors: Sequence[torch.Tensor],
+                            average: Optional[bool] = None,
+                            name: Optional[str] = None,
+                            op: Optional[ReduceOp] = None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0) -> int:
+    rop = _resolve_op(average, op)
+    return _grouped_allreduce_async_impl(
+        list(tensors), name or _auto_name("grouped_allreduce"), rop,
+        prescale_factor, postscale_factor, None)
+
+
+def grouped_allreduce_async_(tensors: Sequence[torch.Tensor],
+                             average: Optional[bool] = None,
+                             name: Optional[str] = None,
+                             op: Optional[ReduceOp] = None,
+                             prescale_factor: float = 1.0,
+                             postscale_factor: float = 1.0) -> int:
+    rop = _resolve_op(average, op)
+    ts = list(tensors)
+    return _grouped_allreduce_async_impl(
+        ts, name or _auto_name("grouped_allreduce"), rop, prescale_factor,
+        postscale_factor, ts)
+
+
+def grouped_allreduce(tensors: Sequence[torch.Tensor],
+                      average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      op: Optional[ReduceOp] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0) -> List[torch.Tensor]:
+    h = grouped_allreduce_async(tensors, average, name, op, prescale_factor,
+                                postscale_factor)
+    return synchronize(h)
+
+
+def grouped_allreduce_(tensors: Sequence[torch.Tensor],
+                       average: Optional[bool] = None,
+                       name: Optional[str] = None,
+                       op: Optional[ReduceOp] = None,
+                       prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0) -> List[torch.Tensor]:
+    h = grouped_allreduce_async_(tensors, average, name, op, prescale_factor,
+                                 postscale_factor)
+    return synchronize(h)
+
+
+# ------------------------------------------------------------------ allgather
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:
+    name = name or _auto_name("allgather")
+    sig = _signature(tensor, "allgather")
+
+    def execute():
+        out = np.asarray(_C.allgather(_np_from_torch(tensor)))
+        return _torch_from_np(out, tensor.dtype)
+
+    return _dispatch(name, sig, _basics.OP_ALLGATHER, _nbytes(tensor),
+                     "allgather", execute)
+
+
+class _AllgatherFunction(torch.autograd.Function):
+    """Backward: sum-allreduce the gathered grad, take this worker's rows
+    (reference: torch/mpi_ops.py:509-530 HorovodAllgather.backward)."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.rows = tensor.shape[0] if tensor.dim() else 1
+        handle = allgather_async(tensor, name)
+        return synchronize(handle)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        from .. import rank as _rank
+        grad_reduced = allreduce(grad_output.contiguous(), op=Sum)
+        offset = _rank() * ctx.rows
+        return grad_reduced.narrow(0, offset, ctx.rows), None
+
+
+def allgather(tensor: torch.Tensor,
+              name: Optional[str] = None) -> torch.Tensor:
+    """Concatenate every worker-chip's tensor along axis 0 (reference:
+    torch/mpi_ops.py:532-560).  A process's value counts once per chip it
+    drives (worker = chip)."""
+    if tensor.requires_grad:
+        return _AllgatherFunction.apply(tensor, name)
+    return synchronize(allgather_async(tensor, name))
+
+
+# ------------------------------------------------------------------ broadcast
+def _broadcast_async_impl(tensor: torch.Tensor, root_rank: int, name: str,
+                          output: Optional[torch.Tensor]) -> int:
+    sig = _signature(tensor, "broadcast", str(root_rank))
+
+    def execute():
+        out = np.asarray(_C.broadcast(_np_from_torch(tensor),
+                                      root_rank=root_rank))
+        res = _torch_from_np(out, tensor.dtype)
+        if output is not None:
+            output.copy_(res)
+            return output
+        return res
+
+    return _dispatch(name, sig, _basics.OP_BROADCAST, _nbytes(tensor),
+                     "broadcast", execute)
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int = 0,
+                    name: Optional[str] = None) -> int:
+    return _broadcast_async_impl(tensor, root_rank,
+                                 name or _auto_name("broadcast"), None)
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int = 0,
+                     name: Optional[str] = None) -> int:
+    return _broadcast_async_impl(tensor, root_rank,
+                                 name or _auto_name("broadcast"), tensor)
+
+
+class _BroadcastFunction(torch.autograd.Function):
+    """Backward: sum-allreduce grads; only root keeps them (reference:
+    torch/mpi_ops.py:606-626 HorovodBroadcast.backward)."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        from .. import rank as _rank
+        ctx.root_rank = root_rank
+        ctx.is_root = _rank() == root_rank
+        handle = broadcast_async(tensor, root_rank, name)
+        return synchronize(handle)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad_reduced = allreduce(grad_output.contiguous(), op=Sum)
+        if ctx.is_root:
+            return grad_reduced, None, None
+        return torch.zeros_like(grad_reduced), None, None
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int = 0,
+              name: Optional[str] = None) -> torch.Tensor:
+    """Broadcast from worker-chip ``root_rank`` (reference:
+    torch/mpi_ops.py:628-656)."""
+    if tensor.requires_grad:
+        return _BroadcastFunction.apply(tensor, root_rank, name)
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int = 0,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+# ------------------------------------------------------------------- alltoall
+def alltoall_async(tensor: torch.Tensor,
+                   splits: Optional[torch.Tensor] = None,
+                   name: Optional[str] = None) -> int:
+    name = name or _auto_name("alltoall")
+    sig = _signature(tensor, "alltoall")
+
+    def execute():
+        sp = None if splits is None else np.asarray(splits.cpu(), np.int64)
+        out, recv = _C.alltoall(_np_from_torch(tensor), splits=sp)
+        recv_t = torch.from_numpy(np.asarray(recv, np.int64).copy())
+        return (_torch_from_np(np.asarray(out), tensor.dtype), recv_t)
+
+    return _dispatch(name, sig, _basics.OP_ALLTOALL, _nbytes(tensor),
+                     "alltoall", execute)
+
+
+class _AlltoallFunction(torch.autograd.Function):
+    """Backward: alltoall the grad with received splits (reference:
+    torch/mpi_ops.py:703-737 HorovodAlltoall.backward)."""
+
+    @staticmethod
+    def forward(ctx, tensor, splits, name):
+        handle = alltoall_async(tensor, splits, name)
+        output, recv_splits = synchronize(handle)
+        ctx.recv_splits = recv_splits
+        ctx.needs_splits_grad = splits is not None
+        return output, recv_splits
+
+    @staticmethod
+    def backward(ctx, grad_output, _grad_splits):
+        out, _ = synchronize(alltoall_async(grad_output.contiguous(),
+                                            ctx.recv_splits))
+        return out, None, None
+
+
+def alltoall(tensor: torch.Tensor, splits: Optional[torch.Tensor] = None,
+             name: Optional[str] = None):
+    """Scatter rows to every worker-chip and gather their rows back; returns
+    ``(output, received_splits)`` when ``splits`` is given, else output
+    (reference: torch/mpi_ops.py:759-841)."""
+    if tensor.requires_grad:
+        output, recv = _AlltoallFunction.apply(tensor, splits, name)
+    else:
+        output, recv = synchronize(alltoall_async(tensor, splits, name))
+    return (output, recv) if splits is not None else output
+
+
+# --------------------------------------------------------------- sync helpers
+def synchronize(handle: int):
+    """Wait for an async op and return its result (reference:
+    torch/mpi_ops.py:843-867)."""
+    _drain(handle)
+    result = _handles.release(handle)
+    if result is None:  # single-process path marks done at dispatch
+        raise HorovodInternalError(f"handle {handle} never completed")
+    return result
+
+
+def poll(handle: int) -> bool:
+    """True when the op behind `handle` has finished (reference:
+    torch/mpi_ops.py:869-881)."""
+    core = _core()
+    if core is not None:
+        resp = core.poll()
+        while resp is not None:
+            _execute_response(resp)
+            resp = core.poll()
+    return _handles.done(handle)
+
+
+def join(device: int = -1) -> int:
+    """Signal no more collectives from this worker; block until all workers
+    join, participating in stragglers' collectives with zero dummies.
+    Returns the last rank to join (reference: torch/mpi_ops.py:882-897,
+    Join protocol controller.cc:254-307)."""
+    del device  # data plane placement is mesh-determined on TPU
+    rt = _rt.get()
+    core = rt.ensure_core()
+    if core is None:
+        return rt.size() - 1
+    _drain()  # finish everything we already submitted
+    core.join()
+    import time
+    deadline = time.monotonic() + 300.0
+    while time.monotonic() < deadline:
+        resp = core.wait(timeout_s=1.0)
+        if resp is None:
+            continue
+        if resp.type == "JOIN_DONE":
+            return resp.total_bytes
+        _execute_response(resp)
+    raise HorovodInternalError("join() timed out waiting for peers")

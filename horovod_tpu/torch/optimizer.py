@@ -1,0 +1,288 @@
+"""Torch DistributedOptimizer: gradient-hook driven data parallelism.
+
+Mirrors the reference's torch optimizer wrapper (reference:
+horovod/torch/optimizer.py:37-590): autograd post-accumulation hooks fire an
+async allreduce per parameter as gradients become ready;
+``synchronize()`` waits on all outstanding handles before ``step()``.
+Supports ``backward_passes_per_step`` local aggregation, grouped-allreduce
+bucketing (``num_groups`` / ``groups``), gradient compression and the
+Adasum variant.
+
+TPU note: the hooks bridge host gradients onto the XLA data plane per bucket;
+for jit-native training prefer ``horovod_tpu.DistributedOptimizer`` (optax),
+where the reduction fuses into the compiled step.  This wrapper exists for
+eager torch-style loops and exercises the negotiation path (SURVEY.md §7 M5).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import torch
+
+from ..common.reduce_op import ReduceOp, Average, Sum, Adasum
+from . import mpi_ops
+from .compression import Compression
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Wraps any torch.optim.Optimizer; reduces grads across workers before
+    each step (reference: torch/optimizer.py:37-333)."""
+
+    def __init__(self, params, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 op: ReduceOp = Average,
+                 gradient_predivide_factor: float = 1.0,
+                 num_groups: int = 0,
+                 groups: Optional[Sequence[Sequence[torch.Tensor]]] = None):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self._op = op
+        self._gradient_predivide_factor = gradient_predivide_factor
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}.{j}", v)
+                for i, group in enumerate(self.param_groups)
+                for j, v in enumerate(group["params"])]
+        # Reference validates names are unique & cover all params
+        # (optimizer.py:77-98).
+        all_params = {p for g in self.param_groups for p in g["params"]}
+        named = {v for _, v in named_parameters}
+        if len(named_parameters) != len({k for k, _ in named_parameters}):
+            raise ValueError("named_parameters contains duplicate names")
+        unnamed = all_params - named
+        if unnamed:
+            raise ValueError(
+                f"{len(unnamed)} parameters were not named by "
+                "named_parameters; name all parameters or pass none")
+
+        self._parameter_names = {v: k for k, v in named_parameters}
+        self._handles: Dict[torch.Tensor, Tuple[int, Any]] = {}
+        self._grad_accs: List[Any] = []
+        self._requires_update = set()
+        self._synchronized = False
+        self._should_synchronize = True
+        # Per-parameter countdown for backward_passes_per_step (reference:
+        # optimizer.py:119-127 _allreduce_delay).
+        self._allreduce_delay = {
+            v: self.backward_passes_per_step
+            for group in self.param_groups for v in group["params"]}
+
+        self._groups: Optional[Dict[torch.Tensor, int]] = None
+        self._group_buckets: Optional[List[List[torch.Tensor]]] = None
+        if groups is not None:
+            if num_groups:
+                raise ValueError("pass either num_groups or groups, not both")
+            self._group_buckets = [list(g) for g in groups]
+            self._groups = {p: i for i, g in enumerate(self._group_buckets)
+                            for p in g}
+        elif num_groups > 0:
+            ordered = [v for group in self.param_groups
+                       for v in group["params"]]
+            n = max(1, (len(ordered) + num_groups - 1) // num_groups)
+            self._group_buckets = [ordered[i:i + n]
+                                   for i in range(0, len(ordered), n)]
+            self._groups = {p: i for i, g in enumerate(self._group_buckets)
+                            for p in g}
+        self._group_pending: Dict[int, List[torch.Tensor]] = {}
+
+        self._register_hooks()
+
+    # ------------------------------------------------------------------ hooks
+    def _register_hooks(self) -> None:
+        """Post-grad-accumulation hooks (reference: optimizer.py:128-171 uses
+        the grad_fn/AccumulateGrad trick; torch>=2.1 exposes it directly)."""
+        for param_group in self.param_groups:
+            for p in param_group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    acc = p.register_post_accumulate_grad_hook(
+                        self._make_hook())
+                    self._grad_accs.append(acc)
+
+    def _make_hook(self):
+        def hook(p: torch.Tensor):
+            if p in self._handles and self._handles[p][0] is not None:
+                if self._allreduce_delay[p] <= 0:
+                    raise AssertionError(
+                        "Gradients were computed more than "
+                        "backward_passes_per_step times before call to "
+                        "step(). Increase backward_passes_per_step to "
+                        "accumulate gradients locally.")
+            assert not p.grad.requires_grad
+            self._allreduce_delay[p] -= 1
+            if self._allreduce_delay[p] == 0:
+                if self._groups is not None:
+                    self._enqueue_grouped(p)
+                else:
+                    handle, ctx = self._allreduce_grad_async(p)
+                    self._handles[p] = (handle, ctx)
+        return hook
+
+    def _allreduce_grad_async(self, p: torch.Tensor) -> Tuple[int, Any]:
+        """(reference: optimizer.py:173-207 _allreduce_grad_async)"""
+        name = self._parameter_names.get(p)
+        tensor = p.grad
+        if self._gradient_predivide_factor != 1.0:
+            tensor = tensor / self._gradient_predivide_factor
+        tensor_compressed, ctx = self._compression.compress(tensor)
+        handle = mpi_ops.allreduce_async_(
+            tensor_compressed, name=name, op=self._op)
+        return handle, (ctx, tensor_compressed)
+
+    def _enqueue_grouped(self, p: torch.Tensor) -> None:
+        """Buffer params of a bucket; fire one grouped allreduce when the
+        whole bucket's grads are ready (reference: optimizer.py num_groups
+        handling, grouped_allreduce buckets)."""
+        gid = self._groups[p]
+        pending = self._group_pending.setdefault(gid, [])
+        if not any(q is p for q in pending):  # tensor __eq__ is elementwise
+            pending.append(p)
+        bucket = [q for q in self._group_buckets[gid] if q.requires_grad]
+        if len(pending) == len(bucket):
+            # Fire in canonical bucket order, NOT hook-arrival order: hooks
+            # fire in nondeterministic order per process and grouped
+            # allreduce matches tensors positionally across ranks.
+            pending_ids = {id(q) for q in pending}
+            ready = [q for q in bucket if id(q) in pending_ids]
+            tensors = [q.grad for q in ready]
+            if self._gradient_predivide_factor != 1.0:
+                for t in tensors:
+                    t.div_(self._gradient_predivide_factor)
+            name = f"group.{gid}." + self._parameter_names.get(
+                ready[0], "noname")
+            handle = mpi_ops.grouped_allreduce_async_(
+                tensors, name=name, op=self._op)
+            for q in ready:
+                self._handles[q] = (handle, None)
+            self._group_pending[gid] = []
+
+    # ------------------------------------------------------------ synchronize
+    def synchronize(self) -> None:
+        """Wait on all outstanding reductions and write reduced grads back
+        (reference: optimizer.py:249-333)."""
+        # Partially-filled buckets (a bucket member was frozen or unused this
+        # step) fall back to per-parameter reduction via the missed-hook loop
+        # below; clear them so stale entries can't corrupt the next step.
+        self._group_pending.clear()
+        completed = set()
+        for p in list(self._requires_update - set(self._handles.keys())):
+            # Params whose hook never fired this step (e.g. frozen branch):
+            # reduce now so all workers agree (reference: optimizer.py
+            # missed-hook handling at synchronize time).
+            if p.grad is None:
+                continue
+            handle, ctx = self._allreduce_grad_async(p)
+            self._handles[p] = (handle, ctx)
+        for p, (handle, ctx) in list(self._handles.items()):
+            if handle in completed:
+                self._allreduce_delay[p] = self.backward_passes_per_step
+                continue
+            output = mpi_ops.synchronize(handle)
+            completed.add(handle)
+            self._allreduce_delay[p] = self.backward_passes_per_step
+            if ctx is not None:
+                cctx, compressed = ctx
+                p.grad.copy_(self._compression.decompress(compressed, cctx))
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextmanager
+    def skip_synchronize(self):
+        """For manual ``optimizer.synchronize()`` + clipping-then-step flows
+        (reference: optimizer.py:236-247)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings
+                warnings.warn(
+                    "optimizer.step() called without a prior backward; "
+                    "called synchronize() twice")
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() but "
+                "before optimizer.step() or optimizer.synchronize(). This is "
+                "prohibited as it can cause a race condition.")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+class _DistributedAdasumOptimizer(torch.optim.Optimizer):
+    """Adasum optimizer: applies the *delta* of a local step, combined
+    scale-adaptively across workers (reference: optimizer.py:335-504).
+
+    step() = param_before + adasum_allreduce(param_after_local_step −
+    param_before); the local optimizer's LR applies locally, Adasum decides
+    the global mixing coefficients.
+    """
+
+    def __init__(self, params, compression=Compression.none,
+                 backward_passes_per_step: int = 1):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+        self._step_count = 0
+
+    def step(self, closure=None):
+        self._step_count += 1
+        if self._step_count % self.backward_passes_per_step != 0:
+            return None
+        befores = {p: p.detach().clone()
+                   for group in self.param_groups
+                   for p in group["params"] if p.grad is not None}
+        # One local step with the wrapped optimizer's own update rule; then
+        # replace each local delta by the Adasum-mixed global delta.
+        loss = super(self.__class__, self).step(closure)
+        for p, before in befores.items():
+            delta = p.detach() - before
+            comp, cctx = self._compression.compress(delta)
+            mixed = mpi_ops.allreduce(comp, op=Adasum,
+                                      name=f"adasum.delta.{id(p)}")
+            mixed = self._compression.decompress(mixed, cctx)
+            with torch.no_grad():
+                p.copy_(before + mixed)
+        return loss
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: ReduceOp = Average,
+                         gradient_predivide_factor: float = 1.0,
+                         num_groups: int = 0,
+                         groups=None) -> torch.optim.Optimizer:
+    """Wrap a torch optimizer for distributed training (reference:
+    torch/optimizer.py:506-590).
+
+    Dynamically subclasses the wrapped optimizer's type so isinstance
+    checks keep working, exactly like the reference."""
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+    if op == Adasum:
+        cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+                   dict(_DistributedAdasumOptimizer.__dict__))
+        return cls(optimizer.param_groups, compression,
+                   backward_passes_per_step)
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step, op, gradient_predivide_factor,
+               num_groups, groups)
