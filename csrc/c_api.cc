@@ -192,4 +192,100 @@ void hvd_core_stats(void* h, unsigned long long* out5) {
   out5[4] = s.responses;
 }
 
+// ------------------------------------------------------------------ autotune
+void hvd_core_enable_autotune(void* h, int warmup_samples,
+                              int steps_per_sample, int max_samples,
+                              double gp_noise) {
+  ParameterManager::Options o;
+  if (warmup_samples >= 0) o.warmup_samples = warmup_samples;
+  if (steps_per_sample > 0) o.steps_per_sample = steps_per_sample;
+  if (max_samples > 0) o.bayes_opt_max_samples = max_samples;
+  if (gp_noise > 0) o.gp_noise = gp_noise;
+  static_cast<ApiHandle*>(h)->core->EnableAutotune(o);
+}
+
+// out4: threshold, cycle_ms, done, best_score.  Returns 0 when autotune is
+// not active on this rank.
+int hvd_core_autotune_state(void* h, double* out4) {
+  int64_t thr;
+  double cyc, best;
+  int done;
+  if (!static_cast<ApiHandle*>(h)->core->AutotuneState(&thr, &cyc, &done,
+                                                       &best))
+    return 0;
+  out4[0] = static_cast<double>(thr);
+  out4[1] = cyc;
+  out4[2] = done;
+  out4[3] = best;
+  return 1;
+}
+
+// Standalone GP regressor (tests + Python-side tuners).
+void* hvd_gp_create(double length, double sigma_f, double noise) {
+  return new GaussianProcessRegressor(length, sigma_f, noise);
+}
+void hvd_gp_destroy(void* h) {
+  delete static_cast<GaussianProcessRegressor*>(h);
+}
+// X: n*d row-major
+void hvd_gp_fit(void* h, const double* X, const double* y, int n, int d) {
+  std::vector<std::vector<double>> xs(n, std::vector<double>(d));
+  for (int i = 0; i < n; i++)
+    for (int j = 0; j < d; j++) xs[i][j] = X[i * d + j];
+  static_cast<GaussianProcessRegressor*>(h)->Fit(
+      xs, std::vector<double>(y, y + n));
+}
+void hvd_gp_predict(void* h, const double* x, int d, double* mean,
+                    double* variance) {
+  static_cast<GaussianProcessRegressor*>(h)->Predict(
+      std::vector<double>(x, x + d), mean, variance);
+}
+
+// Standalone Bayesian optimizer over [0,1]^d.
+void* hvd_bo_create(int dims, double xi, unsigned seed, double gp_noise) {
+  return new BayesianOptimizer(dims, xi, seed, gp_noise);
+}
+void hvd_bo_destroy(void* h) { delete static_cast<BayesianOptimizer*>(h); }
+void hvd_bo_add_sample(void* h, const double* x, int d, double y) {
+  static_cast<BayesianOptimizer*>(h)->AddSample(
+      std::vector<double>(x, x + d), y);
+}
+void hvd_bo_next_sample(void* h, double* out, int d) {
+  auto v = static_cast<BayesianOptimizer*>(h)->NextSample();
+  for (int i = 0; i < d && i < static_cast<int>(v.size()); i++) out[i] = v[i];
+}
+double hvd_bo_best_y(void* h) {
+  return static_cast<BayesianOptimizer*>(h)->best_y();
+}
+void hvd_bo_best_x(void* h, double* out, int d) {
+  const auto& v = static_cast<BayesianOptimizer*>(h)->best_x();
+  for (int i = 0; i < d; i++)
+    out[i] = i < static_cast<int>(v.size()) ? v[i] : 0.5;
+}
+
+// Standalone parameter manager (Python-side SPMD bucket tuner).
+void* hvd_pm_create(long long initial_threshold, double initial_cycle_ms,
+                    int warmup_samples, int steps_per_sample,
+                    int max_samples, double gp_noise) {
+  ParameterManager::Options o;
+  if (warmup_samples >= 0) o.warmup_samples = warmup_samples;
+  if (steps_per_sample > 0) o.steps_per_sample = steps_per_sample;
+  if (max_samples > 0) o.bayes_opt_max_samples = max_samples;
+  if (gp_noise > 0) o.gp_noise = gp_noise;
+  return new ParameterManager(initial_threshold, initial_cycle_ms, o);
+}
+void hvd_pm_destroy(void* h) { delete static_cast<ParameterManager*>(h); }
+// Returns 1 when tunables changed; out3 = threshold, cycle_ms, done.
+int hvd_pm_update(void* h, long long bytes, double seconds, double* out3) {
+  ParameterManager* pm = static_cast<ParameterManager*>(h);
+  int changed = pm->Update(bytes, seconds) ? 1 : 0;
+  out3[0] = static_cast<double>(pm->threshold());
+  out3[1] = pm->cycle_time_ms();
+  out3[2] = pm->done() ? 1 : 0;
+  return changed;
+}
+double hvd_pm_best_score(void* h) {
+  return static_cast<ParameterManager*>(h)->best_score();
+}
+
 }  // extern "C"

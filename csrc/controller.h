@@ -60,6 +60,12 @@ class Controller {
   int rank() const { return transport_->rank(); }
   int size() const { return transport_->size(); }
 
+  // Autotune hook: only rank 0 fuses, so retuning the threshold here is
+  // globally consistent (reference: rank-0 tunes then broadcasts,
+  // controller.cc:39-53 SynchronizeParameters).
+  void set_fusion_threshold(int64_t v) { opts_.fusion_threshold_bytes = v; }
+  int64_t fusion_threshold() const { return opts_.fusion_threshold_bytes; }
+
  private:
   // --- rank-0 state ---
   struct Entry {

@@ -48,6 +48,24 @@ void Core::Shutdown() { shutdown_requested_.store(true); }
 
 ControllerStats Core::stats() const { return controller_->stats(); }
 
+void Core::EnableAutotune(const ParameterManager::Options& opts) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (controller_->rank() != 0) return;  // rank 0 fuses + paces the job
+  pm_.reset(new ParameterManager(controller_->fusion_threshold(),
+                                 opts_.cycle_time_ms, opts));
+}
+
+bool Core::AutotuneState(int64_t* threshold, double* cycle_ms, int* done,
+                         double* best_score) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!pm_) return false;
+  *threshold = pm_->threshold();
+  *cycle_ms = pm_->cycle_time_ms();
+  *done = pm_->done() ? 1 : 0;
+  *best_score = pm_->best_score();
+  return true;
+}
+
 void Core::Loop() {
   using clock = std::chrono::steady_clock;
   while (!stopped_.load()) {
@@ -73,6 +91,7 @@ void Core::Loop() {
       return;
     }
     bool got_shutdown = false;
+    int64_t cycle_bytes = 0;
     {
       std::lock_guard<std::mutex> lk(mu_);
       for (auto& r : out) {
@@ -80,6 +99,7 @@ void Core::Loop() {
           got_shutdown = true;
           continue;
         }
+        if (r.type == ResponseType::OK) cycle_bytes += r.total_bytes;
         for (const auto& n : r.names) inflight_.erase(n);
         responses_.push(std::move(r));
       }
@@ -96,6 +116,22 @@ void Core::Loop() {
         opts_.cycle_time_ms);
     if (elapsed < cycle) {
       std::this_thread::sleep_for(cycle - elapsed);
+    }
+    // Autotune on total cycle wall time (reference scores bytes/sec over
+    // the sampled cycles, parameter_manager.cc Update).
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      // Only cycles that processed tensors advance the tuner: idle 1ms
+      // cycles would otherwise burn all samples on zero-score points
+      // (reference ParameterManager advances per processed tensor batch).
+      if (pm_ && !pm_->done() && cycle_bytes > 0) {
+        double secs = std::chrono::duration<double>(
+            clock::now() - start).count();
+        if (pm_->Update(cycle_bytes, secs)) {
+          controller_->set_fusion_threshold(pm_->threshold());
+          opts_.cycle_time_ms = pm_->cycle_time_ms();
+        }
+      }
     }
   }
 }

@@ -21,6 +21,7 @@
 
 #include "common.h"
 #include "controller.h"
+#include "optim.h"
 #include "transport.h"
 
 namespace hvdtpu {
@@ -49,6 +50,15 @@ class Core {
   int size() const { return controller_->size(); }
   ControllerStats stats() const;
 
+  // Turn on rank-0 autotuning of (fusion threshold, cycle time) scored by
+  // negotiated bytes/sec (reference: ParameterManager + HOROVOD_AUTOTUNE,
+  // parameter_manager.{h,cc}).  Rank 0 fuses and paces the lock-step
+  // gather, so tuning it alone retunes the whole job.
+  void EnableAutotune(const ParameterManager::Options& opts);
+  // Snapshot of the live tunables: (threshold, cycle_ms, done, best_score).
+  bool AutotuneState(int64_t* threshold, double* cycle_ms, int* done,
+                     double* best_score) const;
+
  private:
   void Loop();
 
@@ -56,8 +66,9 @@ class Core {
   std::unique_ptr<Controller> controller_;
   CoreOptions opts_;
 
-  std::mutex mu_;
+  mutable std::mutex mu_;
   std::condition_variable cv_;
+  std::unique_ptr<ParameterManager> pm_;  // guarded by mu_
   std::vector<Request> pending_;
   std::unordered_set<std::string> inflight_;
   std::queue<Response> responses_;

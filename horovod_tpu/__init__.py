@@ -85,6 +85,13 @@ def mesh():
     return _rt.get().mesh
 
 
+def autotuner():
+    """The live autotuner when HOROVOD_AUTOTUNE is enabled, else None
+    (reference: ParameterManager, parameter_manager.{h,cc}).  Feed it step
+    measurements via ``autotuner().measure(nbytes=...)``."""
+    return _rt.get().autotuner
+
+
 def is_homogeneous() -> bool:
     """True when all hosts drive the same number of chips (reference:
     horovod_is_homogeneous, operations.cc:838)."""

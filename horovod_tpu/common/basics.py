@@ -88,8 +88,146 @@ def load_library() -> ctypes.CDLL:
         lib.hvd_core_shutdown.argtypes = [ctypes.c_void_p]
         lib.hvd_core_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong)]
+        # autotune / optim surface
+        dptr = ctypes.POINTER(ctypes.c_double)
+        lib.hvd_core_enable_autotune.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double]
+        lib.hvd_core_autotune_state.argtypes = [ctypes.c_void_p, dptr]
+        lib.hvd_gp_create.restype = ctypes.c_void_p
+        lib.hvd_gp_create.argtypes = [ctypes.c_double, ctypes.c_double,
+                                      ctypes.c_double]
+        lib.hvd_gp_destroy.argtypes = [ctypes.c_void_p]
+        lib.hvd_gp_fit.argtypes = [ctypes.c_void_p, dptr, dptr,
+                                   ctypes.c_int, ctypes.c_int]
+        lib.hvd_gp_predict.argtypes = [ctypes.c_void_p, dptr, ctypes.c_int,
+                                       dptr, dptr]
+        lib.hvd_bo_create.restype = ctypes.c_void_p
+        lib.hvd_bo_create.argtypes = [ctypes.c_int, ctypes.c_double,
+                                      ctypes.c_uint, ctypes.c_double]
+        lib.hvd_bo_destroy.argtypes = [ctypes.c_void_p]
+        lib.hvd_bo_add_sample.argtypes = [ctypes.c_void_p, dptr,
+                                          ctypes.c_int, ctypes.c_double]
+        lib.hvd_bo_next_sample.argtypes = [ctypes.c_void_p, dptr,
+                                           ctypes.c_int]
+        lib.hvd_bo_best_y.restype = ctypes.c_double
+        lib.hvd_bo_best_y.argtypes = [ctypes.c_void_p]
+        lib.hvd_bo_best_x.argtypes = [ctypes.c_void_p, dptr, ctypes.c_int]
+        lib.hvd_pm_create.restype = ctypes.c_void_p
+        lib.hvd_pm_create.argtypes = [ctypes.c_longlong, ctypes.c_double,
+                                      ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_int, ctypes.c_double]
+        lib.hvd_pm_destroy.argtypes = [ctypes.c_void_p]
+        lib.hvd_pm_update.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                      ctypes.c_double, dptr]
+        lib.hvd_pm_best_score.restype = ctypes.c_double
+        lib.hvd_pm_best_score.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
+
+
+def _dbuf(vals):
+    return (ctypes.c_double * len(vals))(*vals)
+
+
+class GaussianProcess:
+    """Native RBF-kernel GP regressor (csrc/optim.cc; reference:
+    optim/gaussian_process.{h,cc})."""
+
+    def __init__(self, length: float = 1.0, sigma_f: float = 1.0,
+                 noise: float = 1e-4):
+        self._lib = load_library()
+        self._h = self._lib.hvd_gp_create(length, sigma_f, noise)
+
+    def fit(self, X, y) -> None:
+        import numpy as np
+        X = np.asarray(X, np.float64)
+        y = np.asarray(y, np.float64)
+        n, d = X.shape
+        self._lib.hvd_gp_fit(self._h, _dbuf(X.ravel().tolist()),
+                             _dbuf(y.tolist()), n, d)
+
+    def predict(self, x) -> Tuple[float, float]:
+        mean = ctypes.c_double()
+        var = ctypes.c_double()
+        x = list(map(float, x))
+        self._lib.hvd_gp_predict(self._h, _dbuf(x), len(x),
+                                 ctypes.byref(mean), ctypes.byref(var))
+        return mean.value, var.value
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.hvd_gp_destroy(self._h)
+            self._h = None
+
+
+class BayesianOptimizer:
+    """Native expected-improvement BO over [0,1]^d (csrc/optim.cc;
+    reference: optim/bayesian_optimization.{h,cc})."""
+
+    def __init__(self, dims: int, xi: float = 0.01, seed: int = 42,
+                 gp_noise: float = 1e-4):
+        self._lib = load_library()
+        self.dims = dims
+        self._h = self._lib.hvd_bo_create(dims, xi, seed, gp_noise)
+
+    def add_sample(self, x, y: float) -> None:
+        x = list(map(float, x))
+        self._lib.hvd_bo_add_sample(self._h, _dbuf(x), len(x), float(y))
+
+    def next_sample(self) -> List[float]:
+        out = (ctypes.c_double * self.dims)()
+        self._lib.hvd_bo_next_sample(self._h, out, self.dims)
+        return list(out)
+
+    @property
+    def best_y(self) -> float:
+        return self._lib.hvd_bo_best_y(self._h)
+
+    @property
+    def best_x(self) -> List[float]:
+        out = (ctypes.c_double * self.dims)()
+        self._lib.hvd_bo_best_x(self._h, out, self.dims)
+        return list(out)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.hvd_bo_destroy(self._h)
+            self._h = None
+
+
+class NativeParameterManager:
+    """Native autotuner of (fusion threshold bytes, cycle ms) scored by
+    bytes/sec (csrc/optim.cc ParameterManager; reference:
+    parameter_manager.{h,cc})."""
+
+    def __init__(self, initial_threshold: int, initial_cycle_ms: float,
+                 warmup_samples: int = 3, steps_per_sample: int = 10,
+                 max_samples: int = 20, gp_noise: float = 0.8):
+        self._lib = load_library()
+        self._h = self._lib.hvd_pm_create(
+            initial_threshold, initial_cycle_ms, warmup_samples,
+            steps_per_sample, max_samples, gp_noise)
+        self.threshold = initial_threshold
+        self.cycle_ms = initial_cycle_ms
+        self.done = False
+
+    def update(self, nbytes: int, seconds: float) -> bool:
+        out = (ctypes.c_double * 3)()
+        changed = self._lib.hvd_pm_update(self._h, nbytes, seconds, out)
+        self.threshold = int(out[0])
+        self.cycle_ms = out[1]
+        self.done = bool(out[2])
+        return bool(changed)
+
+    @property
+    def best_score(self) -> float:
+        return self._lib.hvd_pm_best_score(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.hvd_pm_destroy(self._h)
+            self._h = None
 
 
 class CoreResponse:
@@ -210,6 +348,23 @@ class CoordinationCore:
         if n <= 0:
             return None
         return CoreResponse(self._buf.value.decode())
+
+    def enable_autotune(self, warmup_samples: int = 3,
+                        steps_per_sample: int = 10,
+                        max_samples: int = 20,
+                        gp_noise: float = 0.8) -> None:
+        """Rank-0 autotuning of the controller's fusion threshold + cycle
+        time (reference: HOROVOD_AUTOTUNE, parameter_manager.{h,cc})."""
+        self._lib.hvd_core_enable_autotune(self._h, warmup_samples,
+                                           steps_per_sample, max_samples,
+                                           gp_noise)
+
+    def autotune_state(self) -> Optional[dict]:
+        out = (ctypes.c_double * 4)()
+        if not self._lib.hvd_core_autotune_state(self._h, out):
+            return None
+        return {"threshold": int(out[0]), "cycle_ms": out[1],
+                "done": bool(out[2]), "best_score": out[3]}
 
     def stats(self) -> dict:
         arr = (ctypes.c_ulonglong * 5)()

@@ -230,8 +230,7 @@ def grouped_allreduce(tensors: Sequence[TensorLike],
     shapes = [(int(np.prod(l.shape[1:])) if l.ndim > 1 else 1,)
               for l in locals_]
     dtypes = [l.dtype for l in locals_]
-    plan = rt.plan_cache.get(shapes, dtypes,
-                             rt.knobs["HOROVOD_FUSION_THRESHOLD"])
+    plan = rt.plan_cache.get(shapes, dtypes, rt.fusion_threshold())
     gs = [_make_global(rt, l) for l in locals_]
     fn = _compiled(_mesh_key(rt), "grouped_allreduce", op=int(op),
                    pre=float(prescale_factor), post=float(postscale_factor),

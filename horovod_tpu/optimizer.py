@@ -60,7 +60,9 @@ def sync_gradients(grads: Any,
     threshold = fusion_threshold_bytes
     if threshold is None:
         from . import runtime as _rt
-        threshold = (_rt.get().knobs["HOROVOD_FUSION_THRESHOLD"]
+        # fusion_threshold() tracks the autotuner when HOROVOD_AUTOTUNE is
+        # on; a threshold change re-traces with the new bucket plan.
+        threshold = (_rt.get().fusion_threshold()
                      if _rt.is_initialized() else DEFAULT_FUSION_BYTES)
     shapes = [l.shape for l in leaves]
     dtypes = [l.dtype for l in leaves]

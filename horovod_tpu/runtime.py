@@ -106,6 +106,16 @@ class Runtime:
                                      mark_cycles=self.knobs[
                                          "HOROVOD_TIMELINE_MARK_CYCLES"])
 
+        # Autotune (reference: HOROVOD_AUTOTUNE + ParameterManager,
+        # parameter_manager.{h,cc}): Bayesian optimization over (fusion
+        # threshold, cycle time), native math in csrc/optim.cc.
+        self.autotuner = None
+        if self.knobs["HOROVOD_AUTOTUNE"]:
+            from .utils.autotune import Autotuner
+            self.autotuner = Autotuner(self.knobs,
+                                       process_rank=self._process_index,
+                                       process_size=self._process_count)
+
         self.stall_inspector = None
         if not self.knobs["HOROVOD_STALL_CHECK_DISABLE"]:
             from .utils.stall import StallInspector
@@ -241,7 +251,22 @@ class Runtime:
             cache_capacity=self.knobs["HOROVOD_CACHE_CAPACITY"],
             stall_warn_seconds=self.knobs[
                 "HOROVOD_STALL_CHECK_TIME_SECONDS"])
+        if self.knobs["HOROVOD_AUTOTUNE"]:
+            self.core.enable_autotune(
+                warmup_samples=self.knobs["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"],
+                steps_per_sample=self.knobs[
+                    "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"],
+                max_samples=self.knobs[
+                    "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"],
+                gp_noise=self.knobs[
+                    "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"])
         return self.core
+
+    def fusion_threshold(self) -> int:
+        """Live fusion threshold: autotuned when enabled, knob otherwise."""
+        if self.autotuner is not None:
+            return self.autotuner.fusion_threshold
+        return self.knobs["HOROVOD_FUSION_THRESHOLD"]
 
     # ------------------------------------------------------------- lifecycle
     def shutdown(self) -> None:
@@ -250,6 +275,8 @@ class Runtime:
         self._shutdown = True
         if self.timeline is not None:
             self.timeline.close()
+        if self.autotuner is not None:
+            self.autotuner.close()
         if self.stall_inspector is not None:
             self.stall_inspector.close()
         if self.core is not None:

@@ -1,0 +1,133 @@
+"""Autotuning of fusion/cycle tunables for the SPMD path.
+
+The reference's ParameterManager runs Bayesian optimization over (fusion
+threshold, cycle time) scoring bytes/sec, warms up, samples every N steps,
+and logs to HOROVOD_AUTOTUNE_LOG (reference: parameter_manager.{h,cc},
+common.h:70-75 knobs).  The native math lives in csrc/optim.cc; this wrapper
+feeds it step measurements from the jax training loop and republishes the
+tuned fusion threshold to the bucket planner.
+
+Cross-process consistency: every process must hold the SAME threshold or
+their bucket plans (and therefore the SPMD programs) diverge.  Like the
+reference — rank 0 tunes, then broadcasts (controller.cc:39-53
+SynchronizeParameters) — only process 0 runs the optimizer here; tuned
+values are broadcast to all processes on every record() until tuning
+completes.  record() is therefore collective across processes in multi-host
+runs: call it once per step on every process.
+
+For the *eager/controller* path the same machinery runs inside the native
+core's cycle loop (csrc/core.cc), enabled by the HOROVOD_AUTOTUNE knob.
+
+Usage (jax SPMD path)::
+
+    hvd.init()                 # HOROVOD_AUTOTUNE=1 in env
+    tuner = hvd.autotuner()
+    for batch in data:
+        with tuner.measure(nbytes=grad_bytes):
+            step(...)          # jit'd train step, blocks until ready
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..common import hvdlogging as log
+from ..common.basics import NativeParameterManager
+
+
+class Autotuner:
+    """Feeds step measurements into the native parameter manager and exposes
+    the live fusion threshold (reference: ParameterManager::Update)."""
+
+    def __init__(self, knobs, process_rank: int = 0, process_size: int = 1):
+        self._process_rank = process_rank
+        self._process_size = process_size
+        self._threshold = int(knobs["HOROVOD_FUSION_THRESHOLD"])
+        self._cycle_ms = float(knobs["HOROVOD_CYCLE_TIME"])
+        self._done = False
+        self._pm = None
+        if process_rank == 0:
+            self._pm = NativeParameterManager(
+                initial_threshold=self._threshold,
+                initial_cycle_ms=self._cycle_ms,
+                warmup_samples=knobs["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"],
+                steps_per_sample=knobs["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"],
+                max_samples=knobs["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"],
+                gp_noise=knobs["HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"])
+        self._log_fh = None
+        log_path = knobs["HOROVOD_AUTOTUNE_LOG"]
+        if log_path and process_rank == 0:
+            fresh = not (os.path.exists(log_path) and
+                         os.path.getsize(log_path) > 0)
+            self._log_fh = open(log_path, "a")
+            if fresh:
+                self._log_fh.write(
+                    "threshold_bytes,cycle_ms,best_score_bytes_per_s\n")
+
+    @property
+    def fusion_threshold(self) -> int:
+        return self._threshold
+
+    @property
+    def cycle_ms(self) -> float:
+        return self._cycle_ms
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    @property
+    def best_score(self) -> float:
+        return self._pm.best_score if self._pm is not None else 0.0
+
+    def _sync(self) -> None:
+        """Broadcast (threshold, cycle, done) from process 0 so every
+        process plans identical buckets.  No-op single-process."""
+        if self._process_size <= 1:
+            return
+        from jax.experimental import multihost_utils
+        vals = multihost_utils.broadcast_one_to_all(
+            np.array([self._threshold, self._cycle_ms,
+                      1.0 if self._done else 0.0], np.float64))
+        self._threshold = int(vals[0])
+        self._cycle_ms = float(vals[1])
+        self._done = bool(vals[2])
+
+    def record(self, nbytes: int, seconds: float) -> bool:
+        """Record one step's traffic; returns True when tunables changed.
+        Collective across processes while tuning is live."""
+        if self._done:
+            return False
+        changed = False
+        if self._pm is not None:
+            changed = self._pm.update(nbytes, seconds)
+            self._threshold = self._pm.threshold
+            self._cycle_ms = self._pm.cycle_ms
+            self._done = self._pm.done
+            if changed and self._log_fh:
+                self._log_fh.write(
+                    f"{self._threshold},{self._cycle_ms:.3f},"
+                    f"{self._pm.best_score:.1f}\n")
+                self._log_fh.flush()
+            if changed:
+                log.debug("autotune: threshold=%d cycle=%.2fms done=%s",
+                          self._threshold, self._cycle_ms, self._done)
+        self._sync()
+        return changed
+
+    @contextlib.contextmanager
+    def measure(self, nbytes: int):
+        """Context manager timing one (blocking) training step."""
+        t0 = time.monotonic()
+        yield
+        self.record(nbytes, time.monotonic() - t0)
+
+    def close(self) -> None:
+        if self._log_fh:
+            self._log_fh.close()
+            self._log_fh = None
