@@ -193,15 +193,25 @@ void hvd_core_stats(void* h, unsigned long long* out5) {
 }
 
 // ------------------------------------------------------------------ autotune
-void hvd_core_enable_autotune(void* h, int warmup_samples,
-                              int steps_per_sample, int max_samples,
-                              double gp_noise) {
-  ParameterManager::Options o;
+namespace {
+hvdtpu::ParameterManager::Options MakePMOptions(int warmup_samples,
+                                                int steps_per_sample,
+                                                int max_samples,
+                                                double gp_noise) {
+  hvdtpu::ParameterManager::Options o;
   if (warmup_samples >= 0) o.warmup_samples = warmup_samples;
   if (steps_per_sample > 0) o.steps_per_sample = steps_per_sample;
   if (max_samples > 0) o.bayes_opt_max_samples = max_samples;
   if (gp_noise > 0) o.gp_noise = gp_noise;
-  static_cast<ApiHandle*>(h)->core->EnableAutotune(o);
+  return o;
+}
+}  // namespace
+
+void hvd_core_enable_autotune(void* h, int warmup_samples,
+                              int steps_per_sample, int max_samples,
+                              double gp_noise) {
+  static_cast<ApiHandle*>(h)->core->EnableAutotune(MakePMOptions(
+      warmup_samples, steps_per_sample, max_samples, gp_noise));
 }
 
 // out4: threshold, cycle_ms, done, best_score.  Returns 0 when autotune is
@@ -267,12 +277,9 @@ void hvd_bo_best_x(void* h, double* out, int d) {
 void* hvd_pm_create(long long initial_threshold, double initial_cycle_ms,
                     int warmup_samples, int steps_per_sample,
                     int max_samples, double gp_noise) {
-  ParameterManager::Options o;
-  if (warmup_samples >= 0) o.warmup_samples = warmup_samples;
-  if (steps_per_sample > 0) o.steps_per_sample = steps_per_sample;
-  if (max_samples > 0) o.bayes_opt_max_samples = max_samples;
-  if (gp_noise > 0) o.gp_noise = gp_noise;
-  return new ParameterManager(initial_threshold, initial_cycle_ms, o);
+  return new ParameterManager(
+      initial_threshold, initial_cycle_ms,
+      MakePMOptions(warmup_samples, steps_per_sample, max_samples, gp_noise));
 }
 void hvd_pm_destroy(void* h) { delete static_cast<ParameterManager*>(h); }
 // Returns 1 when tunables changed; out3 = threshold, cycle_ms, done.

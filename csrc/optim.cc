@@ -6,9 +6,8 @@
 namespace hvdtpu {
 
 // ------------------------------------------------------------------ cholesky
-bool CholeskySolve(std::vector<double> A, int n, std::vector<double> b,
-                   std::vector<double>* x) {
-  // In-place lower Cholesky of row-major A.
+bool CholeskyFactor(std::vector<double>* A_io, int n) {
+  std::vector<double>& A = *A_io;
   for (int j = 0; j < n; j++) {
     double d = A[j * n + j];
     for (int k = 0; k < j; k++) d -= A[j * n + k] * A[j * n + k];
@@ -21,19 +20,30 @@ bool CholeskySolve(std::vector<double> A, int n, std::vector<double> b,
       A[i * n + j] = s / d;
     }
   }
+  return true;
+}
+
+void CholeskySolveFactored(const std::vector<double>& L, int n,
+                           std::vector<double> b, std::vector<double>* x) {
   // Forward solve L z = b.
   for (int i = 0; i < n; i++) {
     double s = b[i];
-    for (int k = 0; k < i; k++) s -= A[i * n + k] * b[k];
-    b[i] = s / A[i * n + i];
+    for (int k = 0; k < i; k++) s -= L[i * n + k] * b[k];
+    b[i] = s / L[i * n + i];
   }
   // Back solve L^T x = z.
   for (int i = n - 1; i >= 0; i--) {
     double s = b[i];
-    for (int k = i + 1; k < n; k++) s -= A[k * n + i] * b[k];
-    b[i] = s / A[i * n + i];
+    for (int k = i + 1; k < n; k++) s -= L[k * n + i] * b[k];
+    b[i] = s / L[i * n + i];
   }
   *x = std::move(b);
+}
+
+bool CholeskySolve(std::vector<double> A, int n, std::vector<double> b,
+                   std::vector<double>* x) {
+  if (!CholeskyFactor(&A, n)) return false;
+  CholeskySolveFactored(A, n, std::move(b), x);
   return true;
 }
 
@@ -57,27 +67,34 @@ void GaussianProcessRegressor::Fit(const std::vector<std::vector<double>>& X,
   for (double v : y) y_mean_ += v;
   y_mean_ /= std::max(n, 1);
 
-  K_.assign(static_cast<size_t>(n) * n, 0.0);
+  std::vector<double> K(static_cast<size_t>(n) * n, 0.0);
   for (int i = 0; i < n; i++) {
     for (int j = 0; j < n; j++) {
-      K_[i * n + j] = Kernel(X[i], X[j]) + (i == j ? noise_ : 0.0);
+      K[i * n + j] = Kernel(X[i], X[j]) + (i == j ? noise_ : 0.0);
     }
   }
   std::vector<double> yc(n);
   for (int i = 0; i < n; i++) yc[i] = y[i] - y_mean_;
-  // Escalating regularization on numerical failure; if nothing makes K
-  // SPD, mark the model unfitted so Predict falls back to the prior.
+  // Factor once and cache; Predict reuses the factor for its O(n^2)
+  // variance solves.  Escalating regularization on numerical failure; if
+  // nothing makes K SPD, mark the model unfitted so Predict falls back to
+  // the prior.
+  L_ = K;
+  bool ok = CholeskyFactor(&L_, n);
   double reg = 1e-2;
-  bool ok = CholeskySolve(K_, n, yc, &alpha_);
   while (!ok && reg <= 1e2) {
-    for (int i = 0; i < n; i++) K_[i * n + i] += reg;
-    ok = CholeskySolve(K_, n, yc, &alpha_);
+    for (int i = 0; i < n; i++) K[i * n + i] += reg;
+    L_ = K;
+    ok = CholeskyFactor(&L_, n);
     reg *= 100.0;
   }
   if (!ok) {
     X_.clear();
     alpha_.clear();
+    L_.clear();
+    return;
   }
+  CholeskySolveFactored(L_, n, std::move(yc), &alpha_);
 }
 
 void GaussianProcessRegressor::Predict(const std::vector<double>& x,
@@ -94,15 +111,12 @@ void GaussianProcessRegressor::Predict(const std::vector<double>& x,
   double m = y_mean_;
   for (int i = 0; i < n; i++) m += k[i] * alpha_[i];
   *mean = m;
-  // var = k(x,x) - k^T K^-1 k
+  // var = k(x,x) - k^T K^-1 k, via the factor cached by Fit.
   std::vector<double> v;
-  if (CholeskySolve(K_, n, k, &v)) {
-    double q = 0.0;
-    for (int i = 0; i < n; i++) q += k[i] * v[i];
-    *variance = std::max(Kernel(x, x) - q, 1e-12);
-  } else {
-    *variance = Kernel(x, x);
-  }
+  CholeskySolveFactored(L_, n, k, &v);
+  double q = 0.0;
+  for (int i = 0; i < n; i++) q += k[i] * v[i];
+  *variance = std::max(Kernel(x, x) - q, 1e-12);
 }
 
 // ------------------------------------------------------------------------ BO

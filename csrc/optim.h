@@ -16,6 +16,14 @@
 
 namespace hvdtpu {
 
+// In-place lower Cholesky factorization of row-major SPD A (LL^T).
+// Returns false if the matrix is not SPD.
+bool CholeskyFactor(std::vector<double>* A, int n);
+
+// Solve L L^T x = b given a factor produced by CholeskyFactor.
+void CholeskySolveFactored(const std::vector<double>& L, int n,
+                           std::vector<double> b, std::vector<double>* x);
+
 // Dense symmetric positive-definite solve via Cholesky (LL^T).
 // Returns false if the matrix is not SPD.
 bool CholeskySolve(std::vector<double> A, int n, std::vector<double> b,
@@ -46,7 +54,7 @@ class GaussianProcessRegressor {
   double length_, sigma_f_, noise_;
   std::vector<std::vector<double>> X_;
   std::vector<double> alpha_;           // K^-1 y
-  std::vector<double> K_;               // training kernel matrix (chol use)
+  std::vector<double> L_;               // cached Cholesky factor of K+noise
   std::vector<double> y_;
   double y_mean_ = 0.0;
 };

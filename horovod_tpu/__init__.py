@@ -34,7 +34,7 @@ from .ops.collectives import (allreduce, allreduce_async, grouped_allreduce,
                               allgather, allgather_async, allgather_ragged,
                               broadcast, broadcast_async, alltoall,
                               reducescatter, barrier, synchronize, poll,
-                              process_allgather, Handle)
+                              process_allgather, process_local, Handle)
 from .ops.compression import Compression
 from .ops import spmd
 from .optimizer import (DistributedOptimizer, distributed_optimizer,
@@ -152,7 +152,7 @@ __all__ = [
     "allreduce", "allreduce_async", "grouped_allreduce", "allgather",
     "allgather_async", "allgather_ragged", "broadcast", "broadcast_async",
     "alltoall", "reducescatter", "barrier", "synchronize", "poll",
-    "process_allgather", "Handle",
+    "process_allgather", "process_local", "Handle",
     "DistributedOptimizer", "distributed_optimizer", "sync_gradients",
     "distributed_grad",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",

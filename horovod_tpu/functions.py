@@ -27,9 +27,12 @@ from .ops import collectives as C
 
 def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
     """Broadcast a parameter pytree from ``root_rank`` (chip) to all workers
-    (reference: torch/functions.py broadcast_parameters)."""
+    (reference: torch/functions.py broadcast_parameters).  Leaves are
+    process-level values (marked so a leading dim equal to local_size is
+    never misread as a per-chip axis)."""
     return jax.tree_util.tree_map(
-        lambda p: C.broadcast(p, root_rank=root_rank), params)
+        lambda p: C.broadcast(C.process_local(p), root_rank=root_rank),
+        params)
 
 
 def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
@@ -40,9 +43,10 @@ def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
         if isinstance(leaf, (jax.Array, np.ndarray)) or jnp.isscalar(leaf):
             arr = jnp.asarray(leaf)
             if arr.dtype == jnp.bool_:
-                return C.broadcast(arr.astype(jnp.int32),
-                                   root_rank=root_rank).astype(jnp.bool_)
-            return C.broadcast(arr, root_rank=root_rank)
+                return C.broadcast(
+                    C.process_local(arr.astype(jnp.int32)),
+                    root_rank=root_rank).astype(jnp.bool_)
+            return C.broadcast(C.process_local(arr), root_rank=root_rank)
         return leaf
     return jax.tree_util.tree_map(bc, opt_state)
 
@@ -68,7 +72,7 @@ def broadcast_object(obj: Any, root_rank: int = 0,
     buf = np.zeros(size, np.uint8)
     if is_root:
         buf[:len(payload)] = np.frombuffer(payload, np.uint8)
-    out = np.asarray(C.broadcast(jnp.asarray(buf), root_rank=root_chip))
+    out = np.asarray(C.broadcast(C.process_local(buf), root_rank=root_chip))
     return pickle.loads(out.tobytes())
 
 

@@ -1,0 +1,206 @@
+"""Keras callbacks for distributed training.
+
+Mirrors the reference's callback set (reference: horovod/_keras/callbacks.py
+:23-192, horovod/keras/callbacks.py): broadcast-at-start, metric averaging,
+LR scheduling with warmup and momentum correction.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Union
+
+import numpy as np
+import keras
+
+import horovod_tpu as _hvd
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast initial model + optimizer state from ``root_rank`` on the
+    first batch, after all variables exist (reference:
+    _keras/callbacks.py BroadcastGlobalVariablesCallbackImpl: broadcast at
+    on_batch_end of batch 0)."""
+
+    def __init__(self, root_rank: int = 0, device: str = ""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_train_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        from . import broadcast_global_variables
+        broadcast_global_variables(self.model, root_rank=self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch-end metric logs over all workers (reference:
+    _keras/callbacks.py MetricAverageCallbackImpl: allreduce of logs at
+    on_epoch_end)."""
+
+    def __init__(self, device: str = ""):
+        super().__init__()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs or _hvd.size() == 1:
+            return
+        keys = sorted(k for k, v in logs.items()
+                      if isinstance(v, (int, float, np.floating, np.integer)))
+        if not keys:
+            return
+        vec = np.asarray([float(logs[k]) for k in keys], np.float32)
+        avg = np.asarray(_hvd.allreduce(vec, op=_hvd.Average))
+        for k, v in zip(keys, avg):
+            logs[k] = float(v)
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Schedule LR as ``initial_lr * multiplier(epoch)``; per-batch
+    fractional epochs when ``steps_per_epoch`` is known (reference:
+    _keras/callbacks.py LearningRateScheduleCallbackImpl:23-110).
+
+    With ``momentum_correction``, when the LR changes the optimizer momentum
+    is temporarily rescaled by ``new_lr / old_lr`` for the first step at the
+    new LR, so the effective velocity stays continuous — the reference
+    applies the same correction (reference: _keras/callbacks.py:70-95).
+    """
+
+    def __init__(self, initial_lr: float,
+                 multiplier: Union[float, Callable[[float], float]],
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        super().__init__()
+        self.initial_lr = float(initial_lr)
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        self._saved_momentum = None
+        self._pending_restore = False
+        self._last_lr: Optional[float] = None
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+
+    def set_params(self, params):
+        super().set_params(params)
+        if self.steps_per_epoch is None and params:
+            self.steps_per_epoch = params.get("steps")
+
+    # -- lr plumbing --------------------------------------------------------
+    def _optimizer(self):
+        opt = getattr(self.model, "optimizer", None)
+        if opt is None:
+            raise ValueError("model has no optimizer; compile() first")
+        return opt
+
+    def _get_lr(self) -> float:
+        from . import sync_trainer_state
+        sync_trainer_state(self.model)
+        return float(np.asarray(self._optimizer().learning_rate))
+
+    def _set_lr(self, lr: float) -> None:
+        from . import sync_trainer_state
+        # Mid-epoch the live lr lives in the trainer's jax state; sync so
+        # the assignment isn't overwritten and is re-fetched next step.
+        sync_trainer_state(self.model)
+        opt = self._optimizer()
+        try:
+            opt.learning_rate.assign(lr)
+        except AttributeError:
+            opt.learning_rate = lr
+
+    def _in_range(self, epoch: float) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return False
+        return True
+
+    def _adjust(self, epoch: float) -> None:
+        if not self._in_range(epoch):
+            return
+        lr = self.initial_lr * self.multiplier(epoch)
+        old = self._last_lr if self._last_lr is not None else self._get_lr()
+        self._set_lr(lr)
+        if self.momentum_correction and old and not math.isclose(lr, old):
+            self._apply_momentum_correction(lr / old)
+        self._last_lr = lr
+
+    def _apply_momentum_correction(self, ratio: float) -> None:
+        opt = self._optimizer()
+        mom = getattr(opt, "momentum", None)
+        if mom is None:
+            return
+        if self._saved_momentum is None:
+            self._saved_momentum = float(np.asarray(mom))
+        opt.momentum = self._saved_momentum * ratio
+        self._pending_restore = True
+
+    def _restore_momentum(self) -> None:
+        if self._saved_momentum is not None and self._pending_restore:
+            self._optimizer().momentum = self._saved_momentum
+            self._pending_restore = False
+
+    # -- hooks --------------------------------------------------------------
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase or self.steps_per_epoch is None:
+            self._adjust(float(epoch))
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if not self.staircase and self.steps_per_epoch:
+            self._adjust(self.current_epoch + batch / self.steps_per_epoch)
+
+    def on_train_batch_end(self, batch, logs=None):
+        self._restore_momentum()
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._restore_momentum()
+        if logs is not None:
+            logs["lr"] = self._get_lr()
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Ramp LR linearly from ``initial_lr / size`` to ``initial_lr`` over
+    the first ``warmup_epochs`` (reference: _keras/callbacks.py
+    LearningRateWarmupCallbackImpl:112-192 — "gradual warmup" from the
+    1-hour-ImageNet recipe: start at the single-worker LR, end at the
+    size-scaled LR)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        size = _hvd.size()
+
+        def multiplier(epoch: float) -> float:
+            if warmup_epochs <= 0:
+                return 1.0
+            frac = min(epoch / float(warmup_epochs), 1.0)
+            return (1.0 / size) * (1 - frac) + 1.0 * frac
+
+        super().__init__(initial_lr=initial_lr, multiplier=multiplier,
+                         start_epoch=0, end_epoch=warmup_epochs + 1,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.warmup_epochs - 1 and self.verbose:
+            print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self._get_lr():.6g}.")
+
+
+__all__ = [
+    "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+    "LearningRateScheduleCallback", "LearningRateWarmupCallback",
+]

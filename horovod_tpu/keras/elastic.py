@@ -1,0 +1,125 @@
+"""Elastic training support for the Keras frontend.
+
+Mirrors the reference's keras elastic binding (reference:
+horovod/tensorflow/keras/elastic.py: KerasState, CommitStateCallback,
+UpdateBatchStateCallback, UpdateEpochStateCallback): model weights +
+optimizer variables are snapshotted/commit()ed between batches and
+broadcast-synced after a rendezvous reset.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+import keras
+
+import horovod_tpu as _hvd
+from ..elastic.state import State
+from ..functions import broadcast_object
+
+
+class KerasState(State):
+    """Elastic state wrapping a Keras model (+ its optimizer) and arbitrary
+    scalar attributes like ``epoch``/``batch`` (reference:
+    tensorflow/keras/elastic.py KerasState)."""
+
+    def __init__(self, model, optimizer=None, **scalars: Any):
+        self.model = model
+        self.optimizer = optimizer or getattr(model, "optimizer", None)
+        self._weight_snapshot = None
+        self._opt_snapshot = None
+        super().__init__(**scalars)
+
+    # -- variable access ----------------------------------------------------
+    def _opt_values(self):
+        if self.optimizer is None:
+            return []
+        return [np.asarray(v) for v in self.optimizer.variables]
+
+    def _set_opt_values(self, values) -> None:
+        if self.optimizer is None:
+            return
+        for var, val in zip(self.optimizer.variables, values):
+            var.assign(val)
+
+    # -- snapshot protocol --------------------------------------------------
+    def save(self) -> None:
+        from . import sync_trainer_state
+        sync_trainer_state(self.model)
+        super().save()
+        self._weight_snapshot = [np.copy(w) for w in self.model.get_weights()]
+        self._opt_snapshot = self._opt_values()
+
+    def restore(self) -> None:
+        from . import sync_trainer_state
+        sync_trainer_state(self.model)
+        super().restore()
+        if self._weight_snapshot is not None:
+            self.model.set_weights(self._weight_snapshot)
+        if self._opt_snapshot is not None:
+            self._set_opt_values(self._opt_snapshot)
+
+    def sync(self) -> None:
+        """Broadcast weights/optimizer/scalars from rank 0 so rejoining
+        workers converge (reference: keras/elastic.py sync via
+        broadcast_variables)."""
+        from . import broadcast_global_variables
+        broadcast_global_variables(self.model, root_rank=0)
+        scalars = {f: getattr(self, f) for f in self._fields}
+        if scalars and _hvd.size() > 1:
+            synced = broadcast_object(scalars, root_rank=0)
+            for k, v in synced.items():
+                setattr(self, k, v)
+        self.save()
+
+
+class CommitStateCallback(keras.callbacks.Callback):
+    """Commit the elastic state every ``batches_per_commit`` batches
+    (reference: tensorflow/keras/elastic.py CommitStateCallbackImpl)."""
+
+    def __init__(self, state: KerasState, batches_per_commit: int = 1):
+        super().__init__()
+        self.state = state
+        self.batches_per_commit = max(1, int(batches_per_commit))
+
+    def on_train_batch_end(self, batch, logs=None):
+        if (batch + 1) % self.batches_per_commit == 0:
+            self.state.commit()
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.commit()
+
+
+class UpdateBatchStateCallback(keras.callbacks.Callback):
+    """Track the current batch in the state so a restart resumes mid-epoch
+    (reference: tensorflow/keras/elastic.py UpdateBatchStateCallbackImpl)."""
+
+    def __init__(self, state: KerasState):
+        super().__init__()
+        self.state = state
+
+    def on_train_batch_end(self, batch, logs=None):
+        self.state.batch = batch + 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.batch = 0
+
+
+class UpdateEpochStateCallback(keras.callbacks.Callback):
+    """Track the current epoch in the state (reference:
+    tensorflow/keras/elastic.py UpdateEpochStateCallbackImpl)."""
+
+    def __init__(self, state: KerasState):
+        super().__init__()
+        self.state = state
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.state.epoch = epoch
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.epoch = epoch + 1
+
+
+__all__ = ["KerasState", "CommitStateCallback", "UpdateBatchStateCallback",
+           "UpdateEpochStateCallback"]

@@ -43,6 +43,22 @@ Array = jax.Array
 TensorLike = Union[jax.Array, np.ndarray, float, int]
 
 
+# --------------------------------------------------------------- input marking
+class ProcessLocalArray(np.ndarray):
+    """Marks an array as *one value per process*: the eager layer replicates
+    it across local chips instead of interpreting a leading dim that happens
+    to equal local_size() as a per-chip axis (see :func:`_per_chip`)."""
+    _hvd_per_chip = False
+
+
+def process_local(x: TensorLike) -> np.ndarray:
+    """View ``x`` as a process-level tensor with no per-chip leading axis."""
+    arr = np.asarray(x)
+    if not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
+    return arr.reshape(arr.shape).view(ProcessLocalArray)
+
+
 # --------------------------------------------------------------------- mesh IO
 def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)
