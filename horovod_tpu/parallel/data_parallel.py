@@ -90,17 +90,74 @@ def make_train_step(loss_fn: Callable,
     return step
 
 
-def shard_batch(batch: Any, mesh: Mesh,
-                axis_name: AxisName = "hvd") -> Any:
-    """Device-put a host batch sharded along axis 0 over the mesh axis."""
+def make_scanned_train_step(loss_fn: Callable,
+                            optimizer: optax.GradientTransformation,
+                            mesh: Mesh,
+                            axis_name: AxisName = "hvd",
+                            op: ReduceOp = Average,
+                            compression: type[Compressor] = Compression.none,
+                            fusion_threshold_bytes: Optional[int] = None,
+                            donate: bool = True,
+                            remat: bool = False) -> Callable:
+    """Build ``run(params, opt_state, batches) -> (params, opt_state, losses)``
+    executing ``batches.shape[0]`` optimizer steps inside ONE compiled program
+    via ``lax.scan``.
+
+    This is the honest-benchmark (and low-dispatch-overhead) variant of
+    :func:`make_train_step`: a single device dispatch covers K steps, so
+    host→device dispatch latency is amortized K-fold and a device-to-host
+    fetch of ``losses`` fences ALL K steps — timing cannot silently measure
+    an empty async queue.  The reference's analog is the timed-iteration
+    loop of examples/pytorch/pytorch_synthetic_benchmark.py:104-109; on TPU
+    the idiomatic form is scan-inside-jit, not a Python loop.
+
+    ``batches`` is a pytree whose leaves are stacked per-step inputs of
+    shape ``(K, global_batch, ...)``; each step's slice is sharded over the
+    data axis.  ``losses`` comes back with shape ``(K,)``.
+    """
+    dist_opt = distributed_optimizer(
+        optimizer, axis_name=axis_name, op=op, compression=compression,
+        fusion_threshold_bytes=fusion_threshold_bytes)
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
-    sharding = NamedSharding(mesh, P(axes))
+
+    fn = loss_fn if not remat else jax.checkpoint(loss_fn)
+
+    def body(params, opt_state, batches):
+        def one(carry, batch):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(fn)(params, batch)
+            updates, opt_state = dist_opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), jax.lax.pmean(loss, axis_name)
+
+        (params, opt_state), losses = jax.lax.scan(
+            one, (params, opt_state), batches)
+        return params, opt_state, losses
+
+    # batches: (K, batch, ...) — shard the *batch* dim (axis 1) per chip.
+    in_specs = (P(), P(), P(None, axes))
+    out_specs = (P(), P(), P())
+    f = shard_map(body, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_vma=False)
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(f, donate_argnums=donate_argnums)
+
+
+def shard_batch(batch: Any, mesh: Mesh,
+                axis_name: AxisName = "hvd", axis: int = 0) -> Any:
+    """Device-put a host batch sharded along ``axis`` over the mesh axis."""
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    sharding = NamedSharding(mesh, P(*((None,) * axis), axes))
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(x, sharding), batch)
 
 
 def replicate(tree: Any, mesh: Mesh) -> Any:
-    """Device-put a pytree fully replicated over the mesh."""
+    """Device-put a pytree fully replicated over the mesh.
+
+    Leaves are copied (not aliased): train steps donate their params, and a
+    donated buffer that aliased the caller's original array would delete it
+    out from under a later ``replicate`` of the same tree."""
     sharding = NamedSharding(mesh, P())
     return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, sharding), tree)
+        lambda x: jax.device_put(jnp.array(x, copy=True), sharding), tree)
