@@ -113,6 +113,22 @@ _knob("HOROVOD_CONTROLLER_PORT", 29499, int,
       "TCP port of the rank-0 controller listener.")
 
 
+def current(name: str) -> Any:
+    """Live value of a knob: env > initialized runtime's snapshot > default.
+
+    For code that must honor a knob at trace/call time without requiring an
+    initialized runtime (collective routing, donate defaults).  Env wins so
+    launchers and tests control behavior without re-initializing."""
+    knob = KNOBS[name]
+    v = os.environ.get(name, "")
+    if v != "":
+        return knob.parse(v)
+    from .. import runtime as _rt
+    if _rt.is_initialized():
+        return _rt.get().knobs[name]
+    return knob.default
+
+
 class Knobs:
     """A parsed snapshot of all knobs; values resolve env > override > default."""
 

@@ -127,27 +127,43 @@ def apply_layer(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig,
 
 
 def apply(params: Dict[str, Any], ids: jax.Array, cfg: LlamaConfig,
-          attn_fn=None, remat: bool = False) -> jax.Array:
+          attn_fn=None, remat: bool = False,
+          act_sharding=None) -> jax.Array:
     """Forward: token ids [B, S] -> logits [B, S, vocab].
 
     ``remat=True`` wraps each layer in jax.checkpoint — rematerialization
-    trades FLOPs for HBM, the standard TPU memory lever."""
+    trades FLOPs for HBM, the standard TPU memory lever.
+
+    ``act_sharding`` (a NamedSharding for the [B, S, D] residual stream)
+    pins activations between layers, e.g. batch-sharded over (dp, fsdp) and
+    replicated over tp.  Without it the GSPMD partitioner may pick a
+    feature-sharded residual layout it can only reach by full
+    rematerialization (the round-1 dryrun warning)."""
     cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     x = L.embedding(params["embed"], ids).astype(cfg.dtype)
+
+    def pin(x):
+        if act_sharding is not None:
+            return jax.lax.with_sharding_constraint(x, act_sharding)
+        return x
+
+    x = pin(x)
     layer = apply_layer
     if remat:
         layer = jax.checkpoint(apply_layer, static_argnums=(2, 5))
 
     for p in params["layers"]:
-        x = layer(p, x, cfg, cos, sin, attn_fn)
+        x = pin(layer(p, x, cfg, cos, sin, attn_fn))
     x = L.rmsnorm(params["final_norm"], x)
     return L.dense(params["lm_head"], x)
 
 
 def loss_fn(params: Dict[str, Any], ids: jax.Array, cfg: LlamaConfig,
-            attn_fn=None, remat: bool = False) -> jax.Array:
+            attn_fn=None, remat: bool = False,
+            act_sharding=None) -> jax.Array:
     """Next-token cross-entropy over shifted ids."""
-    logits = apply(params, ids[:, :-1], cfg, attn_fn=attn_fn, remat=remat)
+    logits = apply(params, ids[:, :-1], cfg, attn_fn=attn_fn, remat=remat,
+                   act_sharding=act_sharding)
     targets = ids[:, 1:]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)

@@ -29,6 +29,13 @@ def _axis_size(axis_name: AxisName) -> jax.Array:
     return lax.psum(1, axis_name)
 
 
+def _hier_knob(name: str) -> bool:
+    """Trace-time read of a HOROVOD_HIERARCHICAL_* knob (reference:
+    common.h:81-82)."""
+    from ..common.knobs import current
+    return bool(current(name))
+
+
 def allreduce(x: jax.Array, axis_name: AxisName,
               op: ReduceOp = ReduceOp.AVERAGE,
               prescale_factor: float = 1.0,
@@ -37,7 +44,18 @@ def allreduce(x: jax.Array, axis_name: AxisName,
 
     Average follows the reference's convert-to-postscale trick: SUM with a
     1/size postscale (reference: operations.cc:948-1056 AVERAGE->postscale).
+
+    On a two-level ``(dcn.X, ici.X)`` axis pair with
+    HOROVOD_HIERARCHICAL_ALLREDUCE set, routes through the two-stage
+    reduce_scatter/dcn-allreduce/all_gather algorithm (reference:
+    nccl_operations.cc:188-319) so DCN carries 1/ici_size of the bytes.
     """
+    from ..parallel.hierarchical import hierarchical_allreduce, split_hierarchy
+    pair = split_hierarchy(axis_name)
+    if pair is not None and _hier_knob("HOROVOD_HIERARCHICAL_ALLREDUCE"):
+        return hierarchical_allreduce(x, ici_axis=pair[1], dcn_axis=pair[0],
+                                      op=op, prescale_factor=prescale_factor,
+                                      postscale_factor=postscale_factor)
     if prescale_factor != 1.0:
         x = x * prescale_factor
     if op == ReduceOp.SUM:
@@ -66,7 +84,17 @@ def allreduce(x: jax.Array, axis_name: AxisName,
 def allgather(x: jax.Array, axis_name: AxisName, axis: int = 0) -> jax.Array:
     """Concatenate per-worker tensors along ``axis`` (reference semantics:
     allgather concatenates along the first dimension,
-    collective_operations.h:133-204)."""
+    collective_operations.h:133-204).
+
+    HOROVOD_HIERARCHICAL_ALLGATHER on a two-level axis pair gathers over
+    ICI first, then DCN (reference: MPIHierarchicalAllgather,
+    mpi_operations.cc)."""
+    from ..parallel.hierarchical import (hierarchical_allgather,
+                                         split_hierarchy)
+    pair = split_hierarchy(axis_name)
+    if pair is not None and _hier_knob("HOROVOD_HIERARCHICAL_ALLGATHER"):
+        return hierarchical_allgather(x, ici_axis=pair[1], dcn_axis=pair[0],
+                                      axis=axis)
     return lax.all_gather(x, axis_name, axis=axis, tiled=True)
 
 

@@ -54,6 +54,21 @@ def sync_gradients(grads: Any,
     (reference: controller.cc:778-915, fusion_buffer_manager.cc)."""
     if axis_name is None:
         return grads
+    # Resolve a logical axis against the global mesh so standalone callers
+    # (the DistributedGradientTape analog) get two-level dcn/ici routing on
+    # multi-slice meshes.  An axis already bound at the call site (the
+    # caller's own mesh) is left untouched — the binding context, not the
+    # global mesh, owns its meaning.
+    from . import runtime as _rt
+    if isinstance(axis_name, str) and _rt.is_initialized():
+        try:
+            jax.lax.axis_size(axis_name)   # bound in this trace?
+        except NameError:
+            from .parallel.hierarchical import resolve_axis
+            try:
+                axis_name = resolve_axis(axis_name, _rt.get().mesh)
+            except ValueError:
+                pass
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads

@@ -26,8 +26,19 @@ from ..common.reduce_op import ReduceOp, Average
 from ..ops._compat import shard_map
 from ..ops.compression import Compression, Compressor
 from ..optimizer import distributed_optimizer
+from .hierarchical import resolve_axis
 
 AxisName = Union[str, Sequence[str]]
+
+
+def _resolve_donate(donate: Optional[bool]) -> bool:
+    """HOROVOD_TPU_DONATE_BUFFERS is the default when the caller doesn't
+    say — the TPU analog of the reference's persistent fusion-buffer
+    residency (knob registered in common/knobs.py)."""
+    if donate is not None:
+        return donate
+    from ..common.knobs import current
+    return bool(current("HOROVOD_TPU_DONATE_BUFFERS"))
 
 
 def make_train_step(loss_fn: Callable,
@@ -38,7 +49,7 @@ def make_train_step(loss_fn: Callable,
                     compression: type[Compressor] = Compression.none,
                     backward_passes_per_step: int = 1,
                     fusion_threshold_bytes: Optional[int] = None,
-                    donate: bool = True,
+                    donate: Optional[bool] = None,
                     has_aux: bool = False) -> Callable:
     """Build ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
 
@@ -47,8 +58,13 @@ def make_train_step(loss_fn: Callable,
     identically everywhere (params replicated).
 
     ``donate=True`` donates params/opt_state so XLA updates them in place in
-    HBM — the analog of the reference's persistent fusion buffer residency.
+    HBM — the analog of the reference's persistent fusion buffer residency
+    (default: the HOROVOD_TPU_DONATE_BUFFERS knob).  ``axis_name`` may be a
+    logical name that resolves to a two-level dcn/ici axis pair on
+    multi-slice meshes (parallel/hierarchical.py).
     """
+    axis_name = resolve_axis(axis_name, mesh)
+    donate = _resolve_donate(donate)
     dist_opt = distributed_optimizer(
         optimizer, axis_name=axis_name, op=op, compression=compression,
         backward_passes_per_step=backward_passes_per_step,
@@ -97,7 +113,7 @@ def make_scanned_train_step(loss_fn: Callable,
                             op: ReduceOp = Average,
                             compression: type[Compressor] = Compression.none,
                             fusion_threshold_bytes: Optional[int] = None,
-                            donate: bool = True,
+                            donate: Optional[bool] = None,
                             remat: bool = False) -> Callable:
     """Build ``run(params, opt_state, batches) -> (params, opt_state, losses)``
     executing ``batches.shape[0]`` optimizer steps inside ONE compiled program
@@ -115,6 +131,8 @@ def make_scanned_train_step(loss_fn: Callable,
     shape ``(K, global_batch, ...)``; each step's slice is sharded over the
     data axis.  ``losses`` comes back with shape ``(K,)``.
     """
+    axis_name = resolve_axis(axis_name, mesh)
+    donate = _resolve_donate(donate)
     dist_opt = distributed_optimizer(
         optimizer, axis_name=axis_name, op=op, compression=compression,
         fusion_threshold_bytes=fusion_threshold_bytes)
@@ -146,6 +164,7 @@ def make_scanned_train_step(loss_fn: Callable,
 def shard_batch(batch: Any, mesh: Mesh,
                 axis_name: AxisName = "hvd", axis: int = 0) -> Any:
     """Device-put a host batch sharded along ``axis`` over the mesh axis."""
+    axis_name = resolve_axis(axis_name, mesh)
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     sharding = NamedSharding(mesh, P(*((None,) * axis), axes))
     return jax.tree_util.tree_map(

@@ -78,7 +78,13 @@ def llama_param_specs(params: Any, tp_axis: Optional[str] = "tp",
         if "lm_head" in path:
             return P(fs, tp)
         if "embed" in path:       # [vocab, dim]
-            return P(tp, fs)
+            # Vocab-parallel over BOTH axes, dim replicated: the lookup
+            # lowers to local-gather + mask + psum, and its output reshards
+            # to the batch-sharded residual with a plain slice.  Sharding
+            # dim over fsdp here instead hands GSPMD a transposed-order
+            # layout it can only reach by full rematerialization.
+            vocab_axes = tuple(a for a in (fs, tp) if a is not None)
+            return P(vocab_axes if vocab_axes else None, None)
         return P()
 
     def walk(tree, path=""):
@@ -96,6 +102,32 @@ def named_shardings(specs: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P))
+
+
+def opt_state_shardings(optimizer: optax.GradientTransformation,
+                        params: Any, mesh: Mesh, param_specs: Any) -> Any:
+    """Shardings for the optimizer state: params-shaped moment buffers get
+    the matching PARAM sharding (ZeRO-style optimizer-state sharding);
+    scalars (step counts etc.) replicate.
+
+    Leaving the state sharding to the compiler (out_shardings=None on init)
+    lets XLA pick layouts the train step then has to reshard — round 1's
+    multichip dryrun logged an "Involuntary full rematerialization" from
+    exactly that mismatch.  ``optax.tree_map_params`` maps the params-like
+    subtrees of any optax state, so this works for chained transforms too.
+    """
+    abstract = jax.eval_shape(optimizer.init, params)
+    p_shard = named_shardings(param_specs, mesh)
+    repl = NamedSharding(mesh, P())
+    return optax.tree_map_params(optimizer, lambda _, s: s, abstract,
+                                 p_shard, transform_non_params=lambda _: repl)
+
+
+def init_opt_state(optimizer: optax.GradientTransformation,
+                   params: Any, mesh: Mesh, param_specs: Any) -> Any:
+    """Create optimizer state directly in its final sharded layout."""
+    shardings = opt_state_shardings(optimizer, params, mesh, param_specs)
+    return jax.jit(optimizer.init, out_shardings=shardings)(params)
 
 
 def make_fsdp_train_step(loss_fn: Callable,
@@ -122,16 +154,24 @@ def make_fsdp_train_step(loss_fn: Callable,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    # Optimizer-state sharding: opt_state is created by optimizer.init on
-    # already-sharded params, so its moment buffers inherit the param
-    # shardings; `None` in in/out_shardings keeps whatever the arg carries
-    # (ZeRO-2/3 optimizer-state sharding for free).
-    jitted = jax.jit(
-        step,
-        in_shardings=(p_shard, None, b_shard),
-        out_shardings=(p_shard, None, repl),
-        donate_argnums=(0, 1) if donate else ())
-    return jitted
+    # Optimizer-state shardings depend on the state's tree structure, which
+    # needs param shapes — resolved lazily from the first call's params.
+    cache: Dict[str, Any] = {}
+
+    def wrapped(params, opt_state, batch):
+        jitted = cache.get("jit")
+        if jitted is None:
+            s_shard = opt_state_shardings(optimizer, params, mesh,
+                                          param_specs)
+            jitted = jax.jit(
+                step,
+                in_shardings=(p_shard, s_shard, b_shard),
+                out_shardings=(p_shard, s_shard, repl),
+                donate_argnums=(0, 1) if donate else ())
+            cache["jit"] = jitted
+        return jitted(params, opt_state, batch)
+
+    return wrapped
 
 
 def shard_params(params: Any, mesh: Mesh, param_specs: Any) -> Any:

@@ -1,0 +1,220 @@
+"""Hierarchical (two-level ICI/DCN) collectives.
+
+Numerics contract: the two-level algorithm must equal the flat collective
+over the combined axes (reference: NCCLHierarchicalAllreduce is a drop-in
+for NCCLAllreduce, nccl_operations.cc:188-319), and the
+HOROVOD_HIERARCHICAL_* knobs must actually switch the algorithm
+(round-1 VERDICT flagged them as dead).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.common.reduce_op import ReduceOp
+from horovod_tpu.ops import spmd
+from horovod_tpu.ops._compat import shard_map
+from horovod_tpu.parallel.hierarchical import (hierarchical_allgather,
+                                               hierarchical_allreduce,
+                                               resolve_axis, split_hierarchy)
+
+DCN, ICI = "dcn.data", "ici.data"
+
+
+@pytest.fixture(scope="module")
+def mesh2x4():
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    return Mesh(devs, (DCN, ICI))
+
+
+def _run(mesh, fn, x, in_spec=None, out_spec=None):
+    f = shard_map(fn, mesh=mesh,
+                  in_specs=in_spec if in_spec is not None else P((DCN, ICI)),
+                  out_specs=out_spec if out_spec is not None else P(),
+                  check_vma=False)
+    return np.asarray(jax.jit(f)(x))
+
+
+@pytest.mark.parametrize("n", [16, 21])  # 21: exercises ici padding
+@pytest.mark.parametrize("op", [ReduceOp.SUM, ReduceOp.AVERAGE])
+def test_allreduce_matches_flat(mesh2x4, n, op):
+    x = jnp.arange(8 * n, dtype=jnp.float32) * 0.25 - 3.0
+
+    def flat(v):
+        out = lax.psum(v, (DCN, ICI))
+        return out / 8.0 if op == ReduceOp.AVERAGE else out
+
+    def hier(v):
+        return hierarchical_allreduce(v, ici_axis=ICI, dcn_axis=DCN, op=op)
+
+    np.testing.assert_allclose(_run(mesh2x4, hier, x),
+                               _run(mesh2x4, flat, x), rtol=1e-6)
+
+
+def test_allreduce_scaling_factors(mesh2x4):
+    x = jnp.arange(32, dtype=jnp.float32)
+
+    def hier(v):
+        return hierarchical_allreduce(v, ici_axis=ICI, dcn_axis=DCN,
+                                      op=ReduceOp.SUM, prescale_factor=0.5,
+                                      postscale_factor=0.25)
+
+    def flat(v):
+        return lax.psum(v * 0.5, (DCN, ICI)) * 0.25
+
+    np.testing.assert_allclose(_run(mesh2x4, hier, x),
+                               _run(mesh2x4, flat, x), rtol=1e-6)
+
+
+def test_allreduce_min_falls_back(mesh2x4):
+    x = jnp.arange(32, dtype=jnp.float32)
+
+    def hier(v):
+        return hierarchical_allreduce(v, ici_axis=ICI, dcn_axis=DCN,
+                                      op=ReduceOp.MIN)
+
+    def flat(v):
+        return lax.pmin(v, (DCN, ICI))
+
+    np.testing.assert_allclose(_run(mesh2x4, hier, x),
+                               _run(mesh2x4, flat, x), rtol=1e-6)
+
+
+def test_allgather_order_matches_flat(mesh2x4):
+    # Per-worker distinct rows; global order must be dcn-major = flat order.
+    x = jnp.arange(8 * 3, dtype=jnp.float32).reshape(8, 3)
+
+    def flat(v):
+        return lax.all_gather(v, (DCN, ICI), axis=0, tiled=True)
+
+    def hier(v):
+        return hierarchical_allgather(v, ici_axis=ICI, dcn_axis=DCN, axis=0)
+
+    spec = P((DCN, ICI), None)
+    np.testing.assert_allclose(
+        _run(mesh2x4, hier, x, in_spec=spec),
+        _run(mesh2x4, flat, x, in_spec=spec), rtol=1e-6)
+
+
+# ---------------------------------------------------------------- knob routing
+def _jaxpr_of_spmd_allreduce(mesh):
+    def f(v):
+        return spmd.allreduce(v, (DCN, ICI), op=ReduceOp.SUM)
+    g = shard_map(f, mesh=mesh, in_specs=P((DCN, ICI)), out_specs=P(),
+                  check_vma=False)
+    return str(jax.make_jaxpr(g)(jnp.arange(32, dtype=jnp.float32)))
+
+
+def test_knob_toggles_allreduce_path(mesh2x4, monkeypatch):
+    monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE", raising=False)
+    assert "reduce_scatter" not in _jaxpr_of_spmd_allreduce(mesh2x4)
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    assert "reduce_scatter" in _jaxpr_of_spmd_allreduce(mesh2x4)
+
+
+def test_knob_routing_preserves_numerics(mesh2x4, monkeypatch):
+    x = jnp.linspace(-2, 2, 40, dtype=jnp.float32)
+
+    def f(v):
+        return spmd.allreduce(v, (DCN, ICI), op=ReduceOp.AVERAGE)
+
+    monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE", raising=False)
+    flat = _run(mesh2x4, f, x)
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    hier = _run(mesh2x4, f, x)
+    np.testing.assert_allclose(hier, flat, rtol=1e-6)
+
+
+def test_knob_toggles_allgather_path(mesh2x4, monkeypatch):
+    x = jnp.arange(16, dtype=jnp.float32).reshape(8, 2)
+
+    def f(v):
+        return spmd.allgather(v, (DCN, ICI))
+
+    spec = P((DCN, ICI), None)
+    monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLGATHER", raising=False)
+    flat = _run(mesh2x4, f, x, in_spec=spec)
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLGATHER", "1")
+    hier = _run(mesh2x4, f, x, in_spec=spec)
+    np.testing.assert_allclose(hier, flat, rtol=1e-6)
+
+
+# ------------------------------------------------------------- axis resolution
+def test_resolve_axis(mesh2x4):
+    assert resolve_axis("data", mesh2x4) == (DCN, ICI)
+    assert resolve_axis(DCN, mesh2x4) == DCN
+    assert resolve_axis((DCN, ICI), mesh2x4) == (DCN, ICI)
+    with pytest.raises(ValueError, match="not in mesh axes"):
+        resolve_axis("model", mesh2x4)
+
+
+def test_split_hierarchy():
+    assert split_hierarchy((DCN, ICI)) == (DCN, ICI)
+    # A reversed (ici-major) tuple is NOT recognized: hierarchical allgather
+    # is dcn-major, so rewriting a reversed tuple would permute results.
+    assert split_hierarchy((ICI, DCN)) is None
+    assert split_hierarchy("hvd") is None
+    assert split_hierarchy(("a", "b")) is None
+
+
+def test_min_op_with_knob_on_no_recursion(mesh2x4, monkeypatch):
+    """MIN/MAX fall back to flat primitives without re-entering the
+    hierarchical router (regression: infinite mutual recursion)."""
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    x = jnp.arange(32, dtype=jnp.float32)
+
+    def f(v):
+        return spmd.allreduce(v, (DCN, ICI), op=ReduceOp.MIN)
+
+    def flat(v):
+        return lax.pmin(v, (DCN, ICI))
+
+    np.testing.assert_allclose(_run(mesh2x4, f, x),
+                               _run(mesh2x4, flat, x), rtol=1e-6)
+
+
+# ----------------------------------------------------- end-to-end on dcn mesh
+def test_mesh_spec_and_train_step(monkeypatch):
+    """A Runtime built from the documented 'dcn.data=2,ici.data=4' spec
+    trains identically to a flat mesh, logical axis_name='data'."""
+    import optax
+    from horovod_tpu.runtime import Runtime
+    from horovod_tpu.common.knobs import Knobs
+    from horovod_tpu.parallel.data_parallel import (make_train_step,
+                                                    replicate, shard_batch)
+
+    rt = Runtime(knobs=Knobs(), mesh_spec="dcn.data=2,ici.data=4")
+    assert rt.mesh.axis_names == (DCN, ICI)
+    assert dict(rt.mesh.shape) == {DCN: 2, ICI: 4}
+
+    def loss_fn(params, batch):
+        x, y = batch[..., :4], batch[..., 4:]
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    params = {"w": jnp.ones((4, 2)) * 0.1}
+    opt = optax.sgd(0.1)
+    rng = np.random.RandomState(1)
+    data = rng.randn(16, 6).astype(np.float32)
+
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    step = make_train_step(loss_fn, opt, rt.mesh, axis_name="data")
+    p = replicate(params, rt.mesh)
+    s = replicate(opt.init(params), rt.mesh)
+    b = shard_batch(jnp.asarray(data), rt.mesh, axis_name="data")
+    p, s, loss_hier = step(p, s, b)
+
+    # flat single-axis mesh reference
+    flat_mesh = Mesh(np.array(jax.devices()[:8]), ("hvd",))
+    step2 = make_train_step(loss_fn, opt, flat_mesh, axis_name="hvd")
+    p2 = replicate(params, flat_mesh)
+    s2 = replicate(opt.init(params), flat_mesh)
+    b2 = shard_batch(jnp.asarray(data), flat_mesh, axis_name="hvd")
+    p2, s2, loss_flat = step2(p2, s2, b2)
+
+    np.testing.assert_allclose(float(loss_hier), float(loss_flat), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(p["w"]), np.asarray(p2["w"]),
+                               rtol=1e-6)
