@@ -182,14 +182,19 @@ void hvd_core_shutdown(void* h) {
   static_cast<ApiHandle*>(h)->core->Shutdown();
 }
 
-// stats: cycles, cache_hits, cache_misses, stall_warnings, responses
-void hvd_core_stats(void* h, unsigned long long* out5) {
+// stats: cycles, cache_hits, cache_misses, stall_warnings, responses,
+//        cached_responses, bytes_gathered, bytes_broadcast, last_cycle_bytes
+void hvd_core_stats(void* h, unsigned long long* out9) {
   ControllerStats s = static_cast<ApiHandle*>(h)->core->stats();
-  out5[0] = s.cycles;
-  out5[1] = s.cache_hits;
-  out5[2] = s.cache_misses;
-  out5[3] = s.stall_warnings;
-  out5[4] = s.responses;
+  out9[0] = s.cycles;
+  out9[1] = s.cache_hits;
+  out9[2] = s.cache_misses;
+  out9[3] = s.stall_warnings;
+  out9[4] = s.responses;
+  out9[5] = s.cached_responses;
+  out9[6] = s.bytes_gathered;
+  out9[7] = s.bytes_broadcast;
+  out9[8] = s.last_cycle_bytes;
 }
 
 // ------------------------------------------------------------------ autotune

@@ -57,6 +57,10 @@ struct Response {
   RequestType op = RequestType::ALLREDUCE;
   std::vector<std::string> names;  // execution batch, globally ordered
   std::vector<std::string> sigs;   // parallel to names
+  std::vector<int64_t> sizes;      // per-tensor payload bytes, parallel to
+                                   // names (reference: Response tensor_sizes,
+                                   // message.fbs:97-118); feeds every rank's
+                                   // response-cache replica
   std::string error_message;
   int64_t total_bytes = 0;
 };
@@ -125,6 +129,8 @@ inline void SerializeResponse(const Response& r, Writer* w) {
   for (const auto& n : r.names) w->str(n);
   w->u32(static_cast<uint32_t>(r.sigs.size()));
   for (const auto& s : r.sigs) w->str(s);
+  w->u32(static_cast<uint32_t>(r.sizes.size()));
+  for (const auto& b : r.sizes) w->i64(b);
   w->str(r.error_message);
   w->i64(r.total_bytes);
 }
@@ -139,6 +145,9 @@ inline Response DeserializeResponse(Reader* rd) {
   uint32_t m = rd->u32();
   r.sigs.reserve(m);
   for (uint32_t i = 0; i < m; i++) r.sigs.push_back(rd->str());
+  uint32_t k = rd->u32();
+  r.sizes.reserve(k);
+  for (uint32_t i = 0; i < k; i++) r.sizes.push_back(rd->i64());
   r.error_message = rd->str();
   r.total_bytes = rd->i64();
   return r;

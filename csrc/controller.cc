@@ -6,6 +6,7 @@
 namespace hvdtpu {
 
 namespace {
+
 // Leading token of the signature is the dtype (frontend contract:
 // "dtype:shape:op:..."), used for same-dtype fusion grouping like the
 // reference's dtype look-ahead (controller.cc:778-915).
@@ -13,31 +14,145 @@ std::string SigDtype(const std::string& sig) {
   auto pos = sig.find(':');
   return pos == std::string::npos ? sig : sig.substr(0, pos);
 }
-}  // namespace
 
-bool Controller::CacheLookup(const std::string& name,
-                             const std::string& sig) {
-  if (opts_.cache_capacity <= 0) return false;
-  auto it = cache_map_.find(name);
-  if (it != cache_map_.end() && it->second->second == sig) {
-    cache_lru_.splice(cache_lru_.end(), cache_lru_, it->second);
-    stats_.cache_hits++;
-    return true;
+// Greedy fusion of consecutive OK responses with the same op + dtype under
+// the byte threshold (reference: FuseResponses controller.cc:778-915).
+class Fuser {
+ public:
+  explicit Fuser(int64_t threshold) : threshold_(threshold) {}
+
+  void Add(Response r, const std::string& dtype) {
+    bool can_fuse = false;
+    if (r.type == ResponseType::OK && !out_.empty()) {
+      Response& last = out_.back();
+      can_fuse = last.type == ResponseType::OK && last.op == r.op &&
+                 last_dtype_ == dtype &&
+                 last.total_bytes + r.total_bytes <= threshold_;
+    }
+    if (can_fuse) {
+      Response& last = out_.back();
+      last.names.insert(last.names.end(), r.names.begin(), r.names.end());
+      last.sigs.insert(last.sigs.end(), r.sigs.begin(), r.sigs.end());
+      last.sizes.insert(last.sizes.end(), r.sizes.begin(), r.sizes.end());
+      last.total_bytes += r.total_bytes;
+    } else {
+      out_.push_back(std::move(r));
+      last_dtype_ = dtype;
+    }
   }
-  stats_.cache_misses++;
-  if (it != cache_map_.end()) {
-    cache_lru_.erase(it->second);
-    cache_map_.erase(it);
-  }
-  cache_lru_.emplace_back(name, sig);
-  cache_map_[name] = std::prev(cache_lru_.end());
-  while (static_cast<int>(cache_lru_.size()) > opts_.cache_capacity) {
-    cache_map_.erase(cache_lru_.front().first);
-    cache_lru_.pop_front();
-  }
-  return false;
+
+  std::vector<Response>& out() { return out_; }
+
+ private:
+  int64_t threshold_;
+  std::string last_dtype_;
+  std::vector<Response> out_;
+};
+
+// Fixed-size bit-vector helpers (bit i = cache slot i).
+std::string PackBits(const std::vector<char>& bits) {
+  std::string out((bits.size() + 7) / 8, '\0');
+  for (size_t i = 0; i < bits.size(); i++)
+    if (bits[i]) out[i / 8] |= static_cast<char>(1 << (i % 8));
+  return out;
 }
 
+std::vector<char> UnpackBits(const std::string& s, size_t n) {
+  std::vector<char> bits(n, 0);
+  for (size_t i = 0; i < n && i / 8 < s.size(); i++)
+    bits[i] = (s[i / 8] >> (i % 8)) & 1;
+  return bits;
+}
+
+}  // namespace
+
+// ------------------------------------------------------------- cache replica
+void Controller::ReplicaInsert(const std::string& name, const std::string& sig,
+                               RequestType op, int64_t bytes) {
+  if (opts_.cache_capacity <= 0) return;
+  auto it = slot_of_.find(name);
+  if (it != slot_of_.end()) {  // re-negotiated (e.g. after invalidation race)
+    CacheSlot& s = replica_[it->second];
+    s.sig = sig;
+    s.op = op;
+    s.bytes = bytes;
+    s.valid = true;
+    return;
+  }
+  // Reuse an invalid slot if any; else grow; else evict the oldest (FIFO) —
+  // every rank performs the same sequence on the same broadcast data, so
+  // slot assignment stays identical everywhere.
+  int slot = -1;
+  for (size_t i = 0; i < replica_.size(); i++) {
+    if (!replica_[i].valid) {
+      slot = static_cast<int>(i);
+      break;
+    }
+  }
+  if (slot < 0) {
+    if (static_cast<int>(replica_.size()) < opts_.cache_capacity) {
+      slot = static_cast<int>(replica_.size());
+      replica_.emplace_back();
+      local_hits_.push_back(0);
+      local_inv_.push_back(0);
+      partial_since_.emplace_back();
+      partial_warned_.push_back(0);
+    } else {
+      while (!fifo_.empty()) {
+        auto [s, n] = fifo_.front();
+        fifo_.pop_front();
+        if (replica_[s].valid && replica_[s].name == n) {
+          ReplicaErase(s);
+          slot = s;
+          break;
+        }
+      }
+      if (slot < 0) return;  // capacity 0 edge; nothing to evict into
+    }
+  }
+  CacheSlot& s = replica_[slot];
+  s.name = name;
+  s.sig = sig;
+  s.op = op;
+  s.bytes = bytes;
+  s.valid = true;
+  slot_of_[name] = slot;
+  fifo_.emplace_back(slot, name);
+}
+
+void Controller::ReplicaErase(int slot) {
+  CacheSlot& s = replica_[slot];
+  if (!s.valid) return;
+  // A request of ours may be riding this slot's hit bit, still awaiting
+  // global agreement.  Re-materialize it for the full path so the erase
+  // (invalidation OR capacity eviction) can never drop an in-flight
+  // collective — the submitter cannot resubmit (DUPLICATE_NAME guard).
+  if (local_hits_[slot]) {
+    Request r;
+    r.rank = rank();
+    r.type = s.op;
+    r.name = s.name;
+    r.signature = s.sig;
+    r.bytes = s.bytes;
+    carry_.push_back(std::move(r));
+  }
+  // Purge this slot's FIFO entry: a stale entry would later evict whatever
+  // tensor reuses the slot as if it were the oldest.
+  const std::string name = s.name;
+  fifo_.remove_if([&](const std::pair<int, std::string>& e) {
+    return e.first == slot && e.second == name;
+  });
+  slot_of_.erase(s.name);
+  s.valid = false;
+  s.name.clear();
+  s.sig.clear();
+  local_hits_[slot] = 0;
+  local_inv_[slot] = 0;
+  partial_warned_[slot] = 0;
+  partial_since_[slot] = std::chrono::steady_clock::time_point();
+}
+
+// ----------------------------------------------------------------- rank0 side
 void Controller::Ingest(const Request& req, int /*rank*/) {
   auto it = table_.find(req.name);
   if (it == table_.end()) {
@@ -71,11 +186,7 @@ std::vector<Response> Controller::BuildResponses() {
   int num_joined = static_cast<int>(
       std::count(joined_.begin(), joined_.end(), true));
 
-  struct PreFused {
-    Response r;
-    std::string dtype;  // fusion group key
-  };
-  std::vector<PreFused> ready;  // per-tensor, pre-fusion
+  Fuser fuser(opts_.fusion_threshold_bytes);
   std::vector<std::string> done_names;
   for (const auto& name : arrival_order_) {
     auto it = table_.find(name);
@@ -90,6 +201,7 @@ std::vector<Response> Controller::BuildResponses() {
     r.op = first.type;
     r.names = {name};
     r.sigs = {first.signature};
+    r.sizes = {first.bytes};
     r.total_bytes = first.bytes;
     bool consistent = true;
     for (const auto& req : entry.requests) {
@@ -106,11 +218,8 @@ std::vector<Response> Controller::BuildResponses() {
         break;
       }
     }
-    if (consistent) {
-      r.type = ResponseType::OK;
-      CacheLookup(name, first.signature);
-    }
-    ready.push_back({std::move(r), SigDtype(first.signature)});
+    if (consistent) r.type = ResponseType::OK;
+    fuser.Add(std::move(r), SigDtype(first.signature));
     done_names.push_back(name);
   }
   for (const auto& name : done_names) {
@@ -118,58 +227,83 @@ std::vector<Response> Controller::BuildResponses() {
     arrival_order_.erase(
         std::find(arrival_order_.begin(), arrival_order_.end(), name));
   }
-
-  // Fuse consecutive OK responses with same op + dtype under the threshold
-  // (reference: FuseResponses controller.cc:778-915).
-  std::vector<Response> fused;
-  std::string last_dtype;
-  for (auto& pf : ready) {
-    Response& r = pf.r;
-    bool can_fuse = false;
-    if (r.type == ResponseType::OK && !fused.empty()) {
-      Response& last = fused.back();
-      can_fuse = last.type == ResponseType::OK && last.op == r.op &&
-                 last_dtype == pf.dtype &&
-                 last.total_bytes + r.total_bytes <=
-                     opts_.fusion_threshold_bytes;
-    }
-    if (can_fuse) {
-      fused.back().names.push_back(r.names[0]);
-      fused.back().sigs.push_back(r.sigs[0]);
-      fused.back().total_bytes += r.total_bytes;
-    } else {
-      fused.push_back(std::move(r));
-      last_dtype = pf.dtype;
-    }
-  }
-  return fused;
+  return std::move(fuser.out());
 }
 
+// ------------------------------------------------------------------ the cycle
 bool Controller::RunCycle(const std::vector<Request>& pending,
                           bool shutdown_requested,
                           std::vector<Response>* out) {
   stats_.cycles++;
   int n = size();
+  size_t nslots = replica_.size();
   if (joined_.empty()) joined_.assign(n, false);
   if (shutdown_.empty()) shutdown_.assign(n, false);
 
-  // 1. serialize + gather everyone's request list
+  // 1. Split local submissions: cache hits flip a bit; signature changes
+  //    request invalidation and renegotiate; the rest go the full path.
+  std::vector<Request> uncached = std::move(carry_);
+  carry_.clear();
+  for (const auto& req : pending) {
+    if (req.type == RequestType::JOIN || opts_.cache_capacity <= 0) {
+      uncached.push_back(req);
+      continue;
+    }
+    auto it = slot_of_.find(req.name);
+    if (it != slot_of_.end()) {
+      const CacheSlot& s = replica_[it->second];
+      if (s.sig == req.signature && s.op == req.type) {
+        local_hits_[it->second] = 1;
+        stats_.cache_hits++;
+        continue;
+      }
+      local_inv_[it->second] = 1;  // applied when globally agreed
+    }
+    stats_.cache_misses++;
+    uncached.push_back(req);
+  }
+
+  // 2. Serialize + gather: [shutdown][nslots][hit bits][inv bits][requests]
   Writer w;
   w.u8(shutdown_requested ? 1 : 0);
-  w.u32(static_cast<uint32_t>(pending.size()));
-  for (const auto& r : pending) SerializeRequest(r, &w);
+  w.u32(static_cast<uint32_t>(nslots));
+  w.str(PackBits(local_hits_));
+  w.str(PackBits(local_inv_));
+  w.u32(static_cast<uint32_t>(uncached.size()));
+  for (const auto& r : uncached) SerializeRequest(r, &w);
+  stats_.bytes_gathered += w.data().size();
+  uint64_t cycle_bytes = w.data().size();
 
   std::vector<std::string> all;
   if (!transport_->Gather(w.data(), rank() == 0 ? &all : nullptr))
     return false;
 
-  // 2. rank 0 ingests and builds the response list
+  // 3. Rank 0: AND the hit bits (joined ranks count as all-ones), OR the
+  //    invalidation bits, ingest uncached requests, build responses.
   std::string frame;
   if (rank() == 0) {
+    std::vector<char> agreed(nslots, 1);
+    std::vector<char> inv(nslots, 0);
+    std::vector<char> any_hit(nslots, 0);
     for (int r = 0; r < n; r++) {
       Reader rd(all[r]);
       bool sd = rd.u8() != 0;
       if (sd) shutdown_[r] = true;
+      uint32_t peer_slots = rd.u32();
+      std::vector<char> hits = UnpackBits(rd.str(), nslots);
+      std::vector<char> invs = UnpackBits(rd.str(), nslots);
+      if (peer_slots != nslots) {
+        // Lock-step protocol violation; degrade safely: no agreement.
+        std::fill(hits.begin(), hits.end(), 0);
+        std::fill(invs.begin(), invs.end(), 0);
+      }
+      bool is_joined = joined_[r];
+      for (size_t i = 0; i < nslots; i++) {
+        char h = is_joined ? 1 : hits[i];
+        agreed[i] = agreed[i] & h;
+        any_hit[i] = any_hit[i] | hits[i];
+        inv[i] = inv[i] | invs[i];
+      }
       uint32_t cnt = rd.u32();
       for (uint32_t i = 0; i < cnt; i++) {
         Request req = DeserializeRequest(&rd);
@@ -181,6 +315,41 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
         }
       }
     }
+    // Partial-hit stall detection: some ranks hit a cached tensor, others
+    // have not submitted it for too long -> warn and invalidate so the
+    // tensor renegotiates through the full path, where per-tensor stall
+    // reporting names the laggard (reference: stall-driven cache
+    // invalidation, controller.cc:126-135).
+    auto now = std::chrono::steady_clock::now();
+    for (size_t i = 0; i < nslots; i++) {
+      if (!replica_[i].valid) continue;
+      if (agreed[i] || !any_hit[i]) {
+        partial_since_[i] = std::chrono::steady_clock::time_point();
+        continue;
+      }
+      if (partial_since_[i] == std::chrono::steady_clock::time_point()) {
+        partial_since_[i] = now;
+      } else if (!partial_warned_[i] &&
+                 std::chrono::duration<double>(now - partial_since_[i])
+                         .count() > opts_.stall_warn_seconds) {
+        partial_warned_[i] = 1;
+        stats_.stall_warnings++;
+        fprintf(stderr,
+                "[hvd_tpu_core] WARNING: cached tensor %s ready on some "
+                "ranks only for %.0fs — invalidating for renegotiation\n",
+                replica_[i].name.c_str(),
+                std::chrono::duration<double>(now - partial_since_[i])
+                    .count());
+        inv[i] = 1;
+      }
+    }
+    for (size_t i = 0; i < nslots; i++) {
+      // Agreement needs every rank hit-or-joined AND at least one real hit
+      // (all-joined ranks must not spuriously fire every cached tensor),
+      // and no pending invalidation.
+      agreed[i] = agreed[i] & any_hit[i] & static_cast<char>(!inv[i]);
+    }
+
     CheckStalls();
     std::vector<Response> resp = BuildResponses();
     int num_joined = static_cast<int>(
@@ -200,20 +369,67 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
       s.type = ResponseType::SHUTDOWN;
       resp.push_back(s);
     }
-    stats_.responses += resp.size();
+    // 4. Broadcast: [nslots][agreed bits][inv bits][negotiated responses]
     Writer rw;
+    rw.u32(static_cast<uint32_t>(nslots));
+    rw.str(PackBits(agreed));
+    rw.str(PackBits(inv));
     rw.u32(static_cast<uint32_t>(resp.size()));
     for (const auto& r : resp) SerializeResponse(r, &rw);
     frame = rw.data();
   }
 
-  // 3. broadcast the agreed list
   if (!transport_->Bcast(&frame)) return false;
+  stats_.bytes_broadcast += frame.size();
+  cycle_bytes += frame.size();
+  stats_.last_cycle_bytes = cycle_bytes;
+
+  // 5. Every rank applies the broadcast identically: invalidations first,
+  //    then cached responses in slot order, then negotiated responses, then
+  //    replica insertion of newly negotiated tensors.
   Reader rd(frame);
-  uint32_t cnt = rd.u32();
+  uint32_t bc_slots = rd.u32();
+  std::vector<char> agreed = UnpackBits(rd.str(), bc_slots);
+  std::vector<char> inv = UnpackBits(rd.str(), bc_slots);
+
+  for (uint32_t i = 0; i < bc_slots && i < replica_.size(); i++) {
+    if (!inv[i] || !replica_[i].valid) continue;
+    // ReplicaErase re-materializes any request riding this slot's hit bit.
+    ReplicaErase(static_cast<int>(i));
+  }
+
   out->clear();
-  out->reserve(cnt);
-  for (uint32_t i = 0; i < cnt; i++) out->push_back(DeserializeResponse(&rd));
+  Fuser cached(opts_.fusion_threshold_bytes);
+  for (uint32_t i = 0; i < bc_slots && i < replica_.size(); i++) {
+    if (!agreed[i] || !replica_[i].valid) continue;
+    const CacheSlot& s = replica_[i];
+    Response r;
+    r.type = ResponseType::OK;
+    r.op = s.op;
+    r.names = {s.name};
+    r.sigs = {s.sig};
+    r.sizes = {s.bytes};
+    r.total_bytes = s.bytes;
+    cached.Add(std::move(r), SigDtype(s.sig));
+    local_hits_[i] = 0;
+    local_inv_[i] = 0;
+    stats_.cached_responses++;
+  }
+  *out = std::move(cached.out());
+
+  uint32_t cnt = rd.u32();
+  out->reserve(out->size() + cnt);
+  for (uint32_t i = 0; i < cnt; i++) {
+    Response r = DeserializeResponse(&rd);
+    if (r.type == ResponseType::OK) {
+      for (size_t t = 0; t < r.names.size(); t++) {
+        ReplicaInsert(r.names[t], t < r.sigs.size() ? r.sigs[t] : "",
+                      r.op, t < r.sizes.size() ? r.sizes[t] : 0);
+      }
+    }
+    out->push_back(std::move(r));
+  }
+  if (rank() == 0) stats_.responses += out->size();
   return true;
 }
 

@@ -15,6 +15,14 @@
 // collectives in nondeterministic order per process; this controller gives
 // all processes one agreed execution order, which is what prevents
 // cross-process deadlock (SURVEY.md §2.4).
+//
+// Cache fast path (reference: response_cache.h:44-100 + CoordinateCacheAndState
+// controller.cc:751-776): every rank keeps an IDENTICAL cache replica of
+// previously negotiated tensors, updated only from broadcast data so replicas
+// never diverge.  A steady-state cycle ships fixed-size hit/invalidate
+// bit-vectors instead of full request lists; agreed hits are reconstructed
+// locally from the replica and fused, collapsing per-cycle coordination
+// bytes to ~2*ceil(slots/8) once a workload repeats.
 
 #pragma once
 
@@ -38,10 +46,14 @@ struct ControllerOptions {
 
 struct ControllerStats {
   uint64_t cycles = 0;
-  uint64_t cache_hits = 0;
-  uint64_t cache_misses = 0;
+  uint64_t cache_hits = 0;       // requests served via the bit-vector path
+  uint64_t cache_misses = 0;     // requests that took the full gather path
   uint64_t stall_warnings = 0;
   uint64_t responses = 0;
+  uint64_t cached_responses = 0; // responses reconstructed from the replica
+  uint64_t bytes_gathered = 0;   // this rank's outbound gather frame bytes
+  uint64_t bytes_broadcast = 0;  // broadcast frame bytes seen by this rank
+  uint64_t last_cycle_bytes = 0; // gather+bcast bytes of the last cycle
 };
 
 class Controller {
@@ -76,7 +88,20 @@ class Controller {
   void Ingest(const Request& req, int rank);
   std::vector<Response> BuildResponses();
   void CheckStalls();
-  bool CacheLookup(const std::string& name, const std::string& sig);
+
+  // --- replicated cache (identical on every rank) ---
+  struct CacheSlot {
+    std::string name;
+    std::string sig;
+    RequestType op = RequestType::ALLREDUCE;
+    int64_t bytes = 0;
+    bool valid = false;
+  };
+  // Allocate/overwrite a slot for a negotiated tensor; replica-deterministic
+  // (called only with broadcast data, in broadcast order).
+  void ReplicaInsert(const std::string& name, const std::string& sig,
+                     RequestType op, int64_t bytes);
+  void ReplicaErase(int slot);
 
   Transport* transport_;
   ControllerOptions opts_;
@@ -87,11 +112,16 @@ class Controller {
   std::vector<bool> joined_;     // per-rank JOIN flags
   int last_joined_ = -1;         // rank whose JOIN completed the set
   std::vector<bool> shutdown_;   // per-rank shutdown flags
-  // signature LRU cache (name -> sig), most-recent at back
-  std::list<std::pair<std::string, std::string>> cache_lru_;
-  std::unordered_map<std::string,
-                     std::list<std::pair<std::string, std::string>>::iterator>
-      cache_map_;
+
+  std::vector<CacheSlot> replica_;
+  std::unordered_map<std::string, int> slot_of_;
+  std::list<std::pair<int, std::string>> fifo_;  // (slot, name) insert order
+  std::vector<char> local_hits_;     // this rank's pending cache-hit bits
+  std::vector<char> local_inv_;      // invalidations this rank wants
+  std::vector<Request> carry_;       // re-materialized after invalidation
+  // rank-0: per-slot first-partial-hit time for stall detection (0 = none)
+  std::vector<std::chrono::steady_clock::time_point> partial_since_;
+  std::vector<char> partial_warned_;
 };
 
 }  // namespace hvdtpu

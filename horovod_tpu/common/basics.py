@@ -367,11 +367,13 @@ class CoordinationCore:
                 "done": bool(out[2]), "best_score": out[3]}
 
     def stats(self) -> dict:
-        arr = (ctypes.c_ulonglong * 5)()
+        arr = (ctypes.c_ulonglong * 9)()
         self._lib.hvd_core_stats(self._h, arr)
         return {"cycles": arr[0], "cache_hits": arr[1],
                 "cache_misses": arr[2], "stall_warnings": arr[3],
-                "responses": arr[4]}
+                "responses": arr[4], "cached_responses": arr[5],
+                "bytes_gathered": arr[6], "bytes_broadcast": arr[7],
+                "last_cycle_bytes": arr[8]}
 
     def shutdown(self) -> None:
         if self._h:

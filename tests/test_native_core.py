@@ -154,6 +154,140 @@ def test_cache_hits_on_repeat_steps(hub2):
     assert stats["cycles"] > 0
 
 
+def test_cache_fast_path_collapses_cycle_bytes(hub2):
+    """Steady-state cycles ship fixed-size bit-vectors, not request lists:
+    after the first negotiation of a repeated workload, coordination bytes
+    per step collapse (reference: response_cache.h:44-100, bit-vector sync
+    at controller.cc:751-776)."""
+    c0, c1 = hub2
+    names = [f"layer_{i:03d}/kernel/gradient" for i in range(50)]
+
+    def one_step():
+        for c in (c0, c1):
+            for n in names:
+                c.submit(n, "f32:128x128:sum", OP_ALLREDUCE, 65536)
+        for c in (c0, c1):
+            got = []
+            deadline = time.time() + 5
+            while len(got) < len(names) and time.time() < deadline:
+                r = c.poll()
+                if r:
+                    assert r.type == "OK"
+                    got.extend(r.names)
+                time.sleep(0.002)
+            assert sorted(got) == sorted(names)
+
+    one_step()
+    s1 = c0.stats()
+    step1_bytes = s1["bytes_gathered"] + s1["bytes_broadcast"]
+    assert step1_bytes > 2000, step1_bytes  # full request lists went out
+    one_step()
+    one_step()
+    s3 = c0.stats()
+    delta_bytes = (s3["bytes_gathered"] + s3["bytes_broadcast"]
+                   - step1_bytes)
+    delta_cycles = s3["cycles"] - s1["cycles"]
+    # Every post-negotiation cycle — the two cached steps AND the idle
+    # cycles between them — costs bit-vector bytes (~60 for 50 slots), never
+    # request-list bytes (~7KB for 50 tensors).
+    avg = delta_bytes / max(delta_cycles, 1)
+    assert avg < 150, (step1_bytes, delta_bytes, delta_cycles, avg)
+    assert s3["cached_responses"] > 0
+    assert s3["cache_hits"] >= 2 * len(names)
+
+
+def test_cache_invalidation_on_signature_change(hub2):
+    """A resubmission with a new signature invalidates the cached entry and
+    renegotiates cleanly (reference: ResponseCache INVALID state)."""
+    c0, c1 = hub2
+    for c in (c0, c1):
+        c.submit("t", "f32:4:sum", OP_ALLREDUCE, 16)
+    assert c0.wait(5.0).sigs == ["f32:4:sum"]
+    assert c1.wait(5.0) is not None
+    # repeat -> cached
+    for c in (c0, c1):
+        c.submit("t", "f32:4:sum", OP_ALLREDUCE, 16)
+    assert c0.wait(5.0).sigs == ["f32:4:sum"]
+    assert c1.wait(5.0) is not None
+    # shape change -> invalidate + renegotiate, new signature wins
+    for c in (c0, c1):
+        c.submit("t", "f32:8:sum", OP_ALLREDUCE, 32)
+    r = c0.wait(5.0)
+    assert r is not None and r.type == "OK" and r.sigs == ["f32:8:sum"]
+    assert c1.wait(5.0) is not None
+    # and the new signature is cacheable again
+    for c in (c0, c1):
+        c.submit("t", "f32:8:sum", OP_ALLREDUCE, 32)
+    assert c0.wait(5.0).sigs == ["f32:8:sum"]
+    assert c1.wait(5.0) is not None
+    assert c0.stats()["cached_responses"] >= 2
+
+
+def test_cache_agreement_with_joined_rank(hub2):
+    """A joined rank counts as agreeing with every cached tensor
+    (reference: joined ranks set all cache bits, controller.cc:254-307)."""
+    c0, c1 = hub2
+    for c in (c0, c1):
+        c.submit("g", "f32:4:sum", OP_ALLREDUCE, 16)
+    assert c0.wait(5.0) is not None
+    assert c1.wait(5.0) is not None
+    c1.join()
+    c0.submit("g", "f32:4:sum", OP_ALLREDUCE, 16)  # cache hit on rank 0
+    r = c0.wait(5.0)
+    assert r is not None and r.type == "OK" and r.names == ["g"]
+
+
+def test_eviction_recovers_inflight_hit_request():
+    """Capacity eviction of a slot whose hit bit is still awaiting agreement
+    must re-materialize the request, not drop it (regression: the submitter
+    cannot resubmit past the DUPLICATE_NAME guard, so a dropped request
+    hangs the collective forever)."""
+    hub = LoopbackHub(2)
+    cap = 4
+    c0, c1 = [CoordinationCore.loopback(hub, r, cycle_ms=0.2,
+                                        cache_capacity=cap)
+               for r in range(2)]
+    try:
+        def drain(c, want):
+            got = []
+            deadline = time.time() + 5
+            while len(got) < want and time.time() < deadline:
+                r = c.poll()
+                if r:
+                    assert r.type == "OK", r
+                    got.extend(r.names)
+                time.sleep(0.002)
+            return got
+
+        # Fill the replica: a,b,c,d negotiated and cached on both ranks.
+        for nm in "abcd":
+            for c in (c0, c1):
+                c.submit(nm, "f32:4:sum", OP_ALLREDUCE, 16)
+        assert sorted(drain(c0, 4)) == list("abcd")
+        assert sorted(drain(c1, 4)) == list("abcd")
+
+        # Rank 0 hits cached 'a'; rank 1 stays silent so no agreement.
+        c0.submit("a", "f32:4:sum", OP_ALLREDUCE, 16)
+        time.sleep(0.05)
+        # Both ranks negotiate new tensors that force FIFO eviction of 'a'.
+        for nm in ("e0", "e1", "e2"):
+            for c in (c0, c1):
+                c.submit(nm, "f32:4:sum", OP_ALLREDUCE, 16)
+        assert sorted(drain(c0, 3)) == ["e0", "e1", "e2"]
+        assert sorted(drain(c1, 3)) == ["e0", "e1", "e2"]
+        # Now rank 1 submits 'a': rank 0's evicted-but-rematerialized
+        # request must meet it on the full path.
+        c1.submit("a", "f32:4:sum", OP_ALLREDUCE, 16)
+        assert drain(c0, 1) == ["a"]
+        assert drain(c1, 1) == ["a"]
+    finally:
+        for c in (c0, c1):
+            c.shutdown()
+        for c in (c0, c1):
+            c.close()
+        hub.close()
+
+
 def _tcp_worker(rank, size, port, results):
     core = CoordinationCore.tcp(rank, size, "127.0.0.1", port,
                                 cycle_ms=0.2)
