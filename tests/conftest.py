@@ -29,6 +29,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "integration: multi-process launcher-in-the-loop tests (reference: "
+        "test/integration/ tier)")
+
+
 @pytest.fixture(scope="session")
 def hvd():
     import horovod_tpu as hvd

@@ -1,0 +1,25 @@
+"""Shared worker bootstrap: CPU virtualization BEFORE jax backend init.
+
+Import this as the first statement of every integration worker:
+
+    import _env_setup  # noqa: F401
+
+Each worker process drives 4 virtual CPU chips; with -np 2 the mesh is
+8 chips across 2 real processes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass  # other jax versions: default implementation already works

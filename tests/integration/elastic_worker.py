@@ -1,0 +1,48 @@
+"""Per-rank worker for the elastic reset + mesh rebuild integration test.
+
+First incarnation: both processes bring up jax.distributed, build the
+8-chip mesh, run a verified allreduce — then rank 1 exits non-zero once
+(simulating a lost slice).  The elastic driver blacklists nothing (the
+host stays), runs a reset round, and restarts BOTH workers with fresh
+rendezvous env — the TPU elastic model where a chip loss kills the whole
+slice process group and the mesh must be rebuilt, not just the comm
+(SURVEY.md §7 hard part (c)).  Second incarnation repeats the allreduce on
+the rebuilt mesh and records success.
+"""
+
+import os
+import sys
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main() -> int:
+    state_dir = os.environ["ELASTIC_TEST_DIR"]
+    hvd.init()
+    assert hvd.size() == 8 and hvd.process_size() == 2
+    rt = hvd.runtime.get()
+    positions = rt.local_chip_positions()
+
+    x = np.stack([np.full((2,), float(pos), np.float32)
+                  for pos in positions])
+    out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+    assert np.allclose(out, float(sum(range(8)))), out
+
+    rank = hvd.process_rank()
+    fail_marker = os.path.join(state_dir, "failed_once")
+    if rank == 1 and not os.path.exists(fail_marker):
+        open(fail_marker, "w").write("x")
+        print("elastic worker rank 1 simulating slice loss", flush=True)
+        return 1  # driver must reset-round and rebuild the mesh
+
+    open(os.path.join(state_dir, f"ok_{rank}"), "w").write("done")
+    print(f"elastic worker process {rank} OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
