@@ -1,0 +1,348 @@
+"""TensorFlow (TF2-first) frontend over the TPU data plane.
+
+The reference's largest frontend (reference: horovod/tensorflow/__init__.py
+816 LoC + mpi_ops.cc 952 LoC of AsyncOpKernels).  TPU-native rethink:
+
+  * Ops bridge eager tf.Tensors to the XLA/ICI data plane
+    (horovod_tpu.ops.collectives) as host arrays — the same chip-worker
+    model as the torch frontend (one process drives local_size() chips,
+    each holding the process's value).
+  * No controller negotiation: a TF2 eager/`GradientTape` program applies
+    gradients in deterministic variable order on one thread, so every
+    process submits collectives in the same order by construction.  The
+    reference needed negotiated ordering because its TF kernels complete on
+    nondeterministic GPU streams (reference: mpi_ops.cc:383-412
+    AsyncOpKernel + controller.cc:69-450); a synchronous host-driven data
+    plane has no such reordering.  (The torch frontend DOES negotiate — its
+    autograd hooks genuinely fire in per-process nondeterministic order.)
+  * Sparse gradients: ``tf.IndexedSlices`` allreduce follows the
+    reference's gather path (reference: tensorflow/__init__.py:54-155
+    IndexedSlices -> allgather of values+indices), contributed exactly once
+    per process via the ragged allgather.
+
+Public surface parity: allreduce / grouped_allreduce / allgather /
+broadcast / alltoall / reducescatter, ``DistributedOptimizer`` (keras-3
+optimizer wrap incl. ``backward_passes_per_step``, compression,
+``sparse_as_dense``), ``DistributedGradientTape``, ``broadcast_variables``
+/ ``broadcast_global_variables``, ``broadcast_object`` /
+``allgather_object``, ``SyncBatchNormalization``, elastic
+``TensorFlowKerasState``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+import tensorflow as tf
+
+from .. import runtime as _rt
+from ..common.reduce_op import (ReduceOp, Average, Sum, Adasum, Min, Max,
+                                Product)
+from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..ops import collectives as _C
+from ..runtime import init, shutdown, is_initialized
+from .compression import Compression
+from .functions import (broadcast_object, broadcast_variables,
+                        broadcast_global_variables, allgather_object)
+from .sync_batch_norm import SyncBatchNormalization
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "process_rank", "process_size",
+    "mesh", "allreduce", "grouped_allreduce", "allgather", "broadcast",
+    "alltoall", "reducescatter", "DistributedOptimizer",
+    "DistributedGradientTape", "broadcast_variables",
+    "broadcast_global_variables", "broadcast_object", "allgather_object",
+    "SyncBatchNormalization", "Compression", "ReduceOp", "Average", "Sum",
+    "Adasum", "Min", "Max", "Product",
+]
+
+
+def rank() -> int:
+    return _rt.get().rank()
+
+
+def size() -> int:
+    return _rt.get().size()
+
+
+def local_rank() -> int:
+    return _rt.get().local_rank()
+
+
+def local_size() -> int:
+    return _rt.get().local_size()
+
+
+def cross_rank() -> int:
+    return _rt.get().cross_rank()
+
+
+def cross_size() -> int:
+    return _rt.get().cross_size()
+
+
+def process_rank() -> int:
+    return _rt.get().process_rank()
+
+
+def process_size() -> int:
+    return _rt.get().process_size()
+
+
+def mesh():
+    return _rt.get().mesh
+
+
+# ------------------------------------------------------------- tensor bridging
+def _np_from_tf(t: tf.Tensor) -> np.ndarray:
+    """tf -> numpy (bf16 arrives as ml_dtypes.bfloat16, which jax accepts).
+    The result is marked process-local so a leading dim equal to
+    local_size() is never misread as a per-chip axis."""
+    return _C.process_local(t.numpy() if hasattr(t, "numpy")
+                            else np.asarray(t))
+
+
+def _tf_from_np(a: Any, like_dtype: tf.DType) -> tf.Tensor:
+    arr = np.asarray(a)
+    return tf.cast(tf.convert_to_tensor(arr), like_dtype)
+
+
+# --------------------------------------------------------------------- the ops
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None,
+              op: ReduceOp = Average,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0,
+              compression=Compression.none):
+    """``hvd.allreduce`` incl. the sparse IndexedSlices->allgather path
+    (reference: tensorflow/__init__.py:54-155)."""
+    if average is not None:
+        op = ReduceOp.AVERAGE if average else ReduceOp.SUM
+    if isinstance(tensor, tf.IndexedSlices):
+        # Compression is a dense-wire concern; the reference's sparse path
+        # ignores it too (tensorflow/__init__.py:87-115).  Scale factors DO
+        # apply, to the gathered values.
+        return _allreduce_sparse(tensor, op=op,
+                                 prescale_factor=prescale_factor,
+                                 postscale_factor=postscale_factor)
+    wire, ctx = compression.compress(tensor)
+    out = _C.allreduce(_np_from_tf(wire), op=op, name=name,
+                       prescale_factor=prescale_factor,
+                       postscale_factor=postscale_factor)
+    return compression.decompress(_tf_from_np(out, wire.dtype), ctx)
+
+
+def _allreduce_sparse(slices: tf.IndexedSlices, op: ReduceOp,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    """Sparse allreduce = allgather values+indices, one contribution per
+    process; Average divides by the number of contributing processes
+    (reference: tensorflow/__init__.py:87-115)."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise NotImplementedError(
+            "sparse allreduce supports Sum/Average (reference restriction)")
+    rt = _rt.get()
+    ls = rt.local_size()
+    values = np.asarray(slices.values.numpy())
+    if prescale_factor != 1.0:
+        values = values * prescale_factor
+    indices = np.asarray(slices.indices.numpy())
+    # One real contribution (chip 0), empty on the other local chips so the
+    # ragged allgather yields exactly one copy per process.
+    empty_v = np.zeros((0,) + values.shape[1:], values.dtype)
+    empty_i = np.zeros((0,), indices.dtype)
+    vs = [values] + [empty_v] * (ls - 1)
+    is_ = [indices] + [empty_i] * (ls - 1)
+    g_values = np.asarray(_C.allgather_ragged(vs))
+    g_indices = np.asarray(_C.allgather_ragged(is_))
+    if op == ReduceOp.AVERAGE:
+        g_values = g_values / float(rt.process_size())
+    if postscale_factor != 1.0:
+        g_values = g_values * postscale_factor
+    return tf.IndexedSlices(
+        values=tf.convert_to_tensor(g_values, slices.values.dtype),
+        indices=tf.convert_to_tensor(g_indices, slices.indices.dtype),
+        dense_shape=slices.dense_shape)
+
+
+def grouped_allreduce(tensors: Sequence[tf.Tensor],
+                      average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      op: ReduceOp = Average):
+    if average is not None:
+        op = ReduceOp.AVERAGE if average else ReduceOp.SUM
+    arrs = [_np_from_tf(t) for t in tensors]
+    outs = _C.grouped_allreduce(arrs, op=op, name=name)
+    return [_tf_from_np(o, t.dtype) for o, t in zip(outs, tensors)]
+
+
+def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
+    """Concatenate along axis 0 across all chip-workers (reference:
+    tensorflow/__init__.py allgather)."""
+    out = _C.allgather(_np_from_tf(tensor))
+    return _tf_from_np(out, tensor.dtype)
+
+
+def broadcast(tensor: tf.Tensor, root_rank: int = 0,
+              name: Optional[str] = None) -> tf.Tensor:
+    out = _C.broadcast(_np_from_tf(tensor), root_rank=root_rank)
+    return _tf_from_np(out, tensor.dtype)
+
+
+def alltoall(tensor: tf.Tensor, splits=None, name: Optional[str] = None):
+    """Returns (output, received_splits) like the reference
+    (reference: tensorflow/__init__.py alltoall)."""
+    sp = None if splits is None else np.asarray(splits)
+    out, recv = _C.alltoall(_np_from_tf(tensor), splits=sp)
+    return (_tf_from_np(out, tensor.dtype),
+            tf.convert_to_tensor(np.asarray(recv), tf.int32))
+
+
+def reducescatter(tensor: tf.Tensor, op: ReduceOp = Average,
+                  name: Optional[str] = None) -> tf.Tensor:
+    """Reduce then scatter row-shards.  The process-level result is the
+    concatenation of this process's chips' shards (its chips' mesh
+    positions determine WHICH rows; contiguous on a standard mesh), so a
+    reducescatter+allgather round-trip reconstructs the full reduction."""
+    out = np.asarray(_C.reducescatter(_np_from_tf(tensor), op=op))
+    # [local_size, shard_rows, ...] -> concat of this process's shards.
+    out = out.reshape((-1,) + out.shape[2:])
+    return _tf_from_np(out, tensor.dtype)
+
+
+# ----------------------------------------------------------- gradient plumbing
+def _sync_grads(grads: List[Any], variables, op: ReduceOp,
+                compression, sparse_as_dense: bool) -> List[Any]:
+    """Allreduce a gradient list: dense grads ride one fused grouped
+    allreduce; sparse grads take the gather path (or densify first with
+    ``sparse_as_dense``, reference: DistributedOptimizer arg)."""
+    dense_idx, dense_arrs, dense_ctx = [], [], []
+    out: List[Any] = [None] * len(grads)
+    for i, g in enumerate(grads):
+        if g is None:
+            continue
+        if isinstance(g, tf.IndexedSlices):
+            if sparse_as_dense:
+                g = tf.convert_to_tensor(g)
+            else:
+                out[i] = _allreduce_sparse(g, op=op)
+                continue
+        wire, ctx = compression.compress(g)
+        dense_idx.append(i)
+        dense_arrs.append(_np_from_tf(wire))
+        dense_ctx.append((wire.dtype, ctx))
+    if dense_arrs:
+        synced = _C.grouped_allreduce(dense_arrs, op=op)
+        for i, s, (wdt, ctx) in zip(dense_idx, synced, dense_ctx):
+            out[i] = compression.decompress(_tf_from_np(s, wdt), ctx)
+    return out
+
+
+class DistributedGradientTape:
+    """Wrap ``tf.GradientTape`` so ``gradient()`` returns allreduced grads
+    (reference: tensorflow/__init__.py:726-816)."""
+
+    def __init__(self, tape: tf.GradientTape, op: ReduceOp = Average,
+                 compression=Compression.none,
+                 sparse_as_dense: bool = False):
+        self.tape = tape
+        self._op = op
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+
+    def __enter__(self):
+        self.tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self.tape.__exit__(*exc)
+
+    def __getattr__(self, item):
+        return getattr(self.tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self.tape.gradient(target, sources, output_gradients)
+        # tf.GradientTape supports arbitrary nests (dicts, nested lists);
+        # flatten, sync, re-pack (the reference flattens with tf.nest too).
+        flat = tf.nest.flatten(grads)
+        synced = _sync_grads(flat, tf.nest.flatten(sources), self._op,
+                             self._compression, self._sparse_as_dense)
+        return tf.nest.pack_sequence_as(grads, synced)
+
+
+class DistributedOptimizer:
+    """Wrap a keras-3 optimizer so every ``apply_gradients`` sees globally
+    averaged gradients (reference: tensorflow/__init__.py:601-724), with
+    ``backward_passes_per_step`` local aggregation (reference:
+    gradient_aggregation.py:16)."""
+
+    def __init__(self, optimizer, op: ReduceOp = Average,
+                 compression=Compression.none,
+                 sparse_as_dense: bool = False,
+                 backward_passes_per_step: int = 1,
+                 name: Optional[str] = None):
+        if backward_passes_per_step < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        self._opt = optimizer
+        self._op = op
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+        self._bpps = backward_passes_per_step
+        self._acc: Optional[List[Any]] = None
+        self._counter = 0
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    @property
+    def inner(self):
+        return self._opt
+
+    def apply_gradients(self, grads_and_vars, **kwargs):
+        gv = list(grads_and_vars)
+        grads = [g for g, _ in gv]
+        tvars = [v for _, v in gv]
+        if not gv:
+            return None  # keras's own apply_gradients rejects empty input
+        if self._bpps > 1:
+            dense = [tf.convert_to_tensor(g) if isinstance(
+                g, tf.IndexedSlices) else g for g in grads]
+            if self._acc is None:
+                self._acc = [None] * len(dense)
+            for i, g in enumerate(dense):
+                if g is None:
+                    continue  # unused this pass; may contribute next pass
+                a = np.asarray(g.numpy())
+                self._acc[i] = a if self._acc[i] is None else self._acc[i] + a
+            self._counter += 1
+            if self._counter < self._bpps:
+                return  # aggregate locally; no sync, no apply
+            grads = [None if a is None else
+                     tf.convert_to_tensor(a / self._bpps)
+                     for a in self._acc]
+            self._acc, self._counter = None, 0
+        synced = _sync_grads(grads, tvars, self._op, self._compression,
+                             self._sparse_as_dense)
+        return self._opt.apply_gradients(
+            [(g, v) for g, v in zip(synced, tvars) if g is not None],
+            **kwargs)
+
+    def apply(self, grads, trainable_variables=None, **kwargs):
+        """Keras-3 style ``optimizer.apply(grads, trainable_variables)``.
+
+        With ``trainable_variables=None``, keras pairs grads with the
+        variables the optimizer was built on — NOT ``optimizer.variables``
+        (those are the slot/iteration variables)."""
+        grads = list(grads)
+        variables = trainable_variables
+        if variables is None:
+            variables = list(getattr(self._opt, "_trainable_variables",
+                                     None) or [])
+            if len(variables) != len(grads):
+                raise ValueError(
+                    "optimizer not built; pass trainable_variables "
+                    "explicitly or call opt.build(model.trainable_variables)"
+                    " first")
+        return self.apply_gradients(zip(grads, variables), **kwargs)

@@ -1,0 +1,74 @@
+"""Gradient compression for the TF frontend.
+
+Mirrors the reference's TF compressor surface (reference:
+horovod/tensorflow/compression.py:1-74): ``Compression.none`` /
+``Compression.fp16`` with ``compress(tensor) -> (tensor, ctx)`` /
+``decompress(tensor, ctx)``.  Adds ``Compression.bf16`` — the TPU-native
+wire dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import tensorflow as tf
+
+
+class Compressor:
+    """Interface for compressing and decompressing a given tensor."""
+
+    @staticmethod
+    def compress(tensor: tf.Tensor) -> Tuple[tf.Tensor, Any]:
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: tf.Tensor, ctx: Any) -> tf.Tensor:
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast floating tensors to fp16 for the wire (reference:
+    tensorflow/compression.py FP16Compressor)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating:
+            return tf.cast(tensor, tf.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tf.cast(tensor, ctx) if ctx is not None else tensor
+
+
+class BF16Compressor(Compressor):
+    """bfloat16 wire compression (TPU-native addition: fp32 exponent range
+    on the MXU/ICI)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating and tensor.dtype != tf.bfloat16:
+            return tf.cast(tensor, tf.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tf.cast(tensor, ctx) if ctx is not None else tensor
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce
+    (reference: horovod/tensorflow/compression.py Compression)."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,61 @@
+"""Elastic state for the TF frontend.
+
+Reference: horovod/tensorflow/elastic.py:31-90 — ``TensorFlowKerasState``
+snapshots model + optimizer variables in memory, ``sync()`` broadcasts
+rank 0's values after a reset, ``run`` re-enters training after
+HorovodInternalError / HostsUpdatedInterrupt.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import numpy as np
+import tensorflow as tf
+
+from ..elastic.state import State
+from ..elastic.worker import run  # re-export: @hvd.elastic.run
+from .functions import broadcast_variables
+
+__all__ = ["TensorFlowKerasState", "run"]
+
+
+class TensorFlowKerasState(State):
+    """Tracks a keras model (+ optimizer) as elastic state.
+
+    ``commit()`` snapshots weights to host memory; ``restore()`` reloads the
+    last commit; ``sync()`` broadcasts rank 0's current weights to everyone
+    (new workers join with fresh processes and receive state here)."""
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self._model_snap = None
+        self._opt_snap = None
+        super().__init__(**kwargs)
+        self.save()
+
+    def _opt_vars(self):
+        if self.optimizer is None:
+            return []
+        return list(getattr(self.optimizer, "variables", []) or [])
+
+    # ---- snapshot protocol (base handles the scalar kwargs fields) -------
+    def save(self) -> None:
+        super().save()
+        self._model_snap = [np.copy(np.asarray(w))
+                            for w in self.model.get_weights()]
+        self._opt_snap = [np.asarray(v.numpy()) for v in self._opt_vars()]
+
+    def restore(self) -> None:
+        super().restore()
+        if self._model_snap is not None:
+            self.model.set_weights(self._model_snap)
+        for var, val in zip(self._opt_vars(), self._opt_snap or []):
+            var.assign(val)
+
+    def sync(self) -> None:
+        broadcast_variables(self.model.variables, root_rank=0)
+        if self._opt_vars():
+            broadcast_variables(self._opt_vars(), root_rank=0)
+        self.save()

@@ -1,0 +1,61 @@
+"""TF state-sync helpers: broadcast variables / objects.
+
+Reference: horovod/tensorflow/functions.py (broadcast_object,
+broadcast_variables) and the BroadcastGlobalVariablesHook convention
+(tensorflow/__init__.py:263-333) — rank 0 loads, everyone receives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import numpy as np
+import tensorflow as tf
+
+from .. import functions as _F
+from ..ops import collectives as _C
+
+
+def broadcast_variables(variables: Iterable[tf.Variable],
+                        root_rank: int = 0) -> None:
+    """Assign every variable the value held by ``root_rank``'s chip
+    (reference: tensorflow/functions.py broadcast_variables).
+
+    Variables are fused per dtype into ONE flat buffer per dtype and
+    broadcast in a single collective each — elastic resets sync every
+    model+optimizer variable through here, so per-variable dispatch would
+    cost hundreds of collective launches."""
+    vs = list(variables)
+    by_dtype = {}
+    for v in vs:
+        by_dtype.setdefault(v.dtype, []).append(v)
+    for dtype, group in by_dtype.items():
+        flats = [np.ravel(np.asarray(v.numpy())) for v in group]
+        fused = np.concatenate(flats) if len(flats) > 1 else flats[0]
+        out = np.asarray(_C.broadcast(_C.process_local(fused),
+                                      root_rank=root_rank))
+        off = 0
+        for v, f in zip(group, flats):
+            piece = out[off:off + f.size].reshape(v.shape)
+            v.assign(tf.cast(tf.convert_to_tensor(piece), dtype))
+            off += f.size
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    """TF1-compat name (reference: tensorflow/__init__.py:263): broadcasts
+    every variable tf is currently tracking in eager mode."""
+    # Eager TF2 has no global collection; mirror the reference's intent for
+    # programs that still call it by raising a actionable error.
+    raise NotImplementedError(
+        "TF2 has no global variable collection; call "
+        "broadcast_variables(model.variables, root_rank) "
+        "(reference: tensorflow/functions.py broadcast_variables)")
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    return _F.broadcast_object(obj, root_rank=root_rank, name=name)
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> list:
+    return _F.allgather_object(obj, name=name)

@@ -59,6 +59,12 @@ def test_torch_frontend_two_processes():
 
 
 @pytest.mark.integration
+def test_tf_frontend_two_processes():
+    proc = run_hvdrun("tf_worker.py")
+    assert proc.stdout.count("OK") >= 2, proc.stdout
+
+
+@pytest.mark.integration
 def test_elastic_reset_rebuilds_mesh(tmp_path):
     """A worker failure triggers a driver reset round that restarts all
     workers with fresh rendezvous env; the second incarnation re-runs

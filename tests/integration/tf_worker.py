@@ -1,0 +1,58 @@
+"""Per-rank TF-frontend worker: sparse + dense gradient sync across 2 real
+processes (the IndexedSlices once-per-process gather path and
+broadcast_variables only mean something with process_size > 1)."""
+
+import sys
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+def main() -> int:
+    hvd.init()
+    pr = hvd.process_rank()
+    assert hvd.process_size() == 2
+
+    # dense allreduce: average over chips == average over processes
+    out = hvd.allreduce(tf.constant([float(pr)]), op=hvd.Average)
+    np.testing.assert_allclose(out.numpy(), [0.5])
+
+    # sparse: each process contributes 2 distinct rows exactly once
+    slices = tf.IndexedSlices(
+        values=tf.constant([[1.0 + pr], [10.0 + pr]]),
+        indices=tf.constant([2 * pr, 2 * pr + 1], tf.int64),
+        dense_shape=tf.constant([4, 1], tf.int64))
+    g = hvd.allreduce(slices, op=hvd.Sum)
+    assert isinstance(g, tf.IndexedSlices)
+    vals = g.values.numpy().ravel().tolist()
+    idxs = g.indices.numpy().tolist()
+    got = dict(zip(idxs, vals))
+    assert got == {0: 1.0, 1: 10.0, 2: 2.0, 3: 11.0}, got
+
+    # broadcast_variables: rank 1 starts different, ends with rank 0 values
+    v = tf.Variable([float(pr + 1), float(pr + 5)])
+    hvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), [1.0, 5.0])
+
+    # DistributedGradientTape with a sparse embedding grad, cross-process
+    table = tf.Variable(np.zeros((4, 2), np.float32))
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        rows = tf.gather(table, [pr])  # each process touches its own row
+        loss = tf.reduce_sum(rows)
+    (grad,) = tape.gradient(loss, [table])
+    assert isinstance(grad, tf.IndexedSlices)
+    # Average divides by process count; both processes see both rows.
+    got = dict(zip(grad.indices.numpy().tolist(),
+                   grad.values.numpy().sum(axis=1).tolist()))
+    assert got == {0: 1.0, 1: 1.0}, got
+
+    print(f"tf worker process {pr} OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
