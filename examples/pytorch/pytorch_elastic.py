@@ -1,0 +1,58 @@
+"""Elastic torch training (reference: examples/elastic/pytorch/
+pytorch_synthetic_benchmark_elastic.py): survives host membership changes
+via @hvd.elastic.run + TorchState commit/restore.
+
+    hvdrun -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover_hosts.sh \
+        python examples/pytorch/pytorch_elastic.py
+"""
+
+import argparse
+
+import torch
+import torch.nn.functional as Fn
+
+import horovod_tpu.torch as hvd
+import horovod_tpu.torch.elastic as hvd_elastic
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batches-per-epoch", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=32)
+    args = ap.parse_args()
+
+    hvd.init()
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(16, 64), torch.nn.ReLU(), torch.nn.Linear(64, 4))
+    opt = torch.optim.SGD(model.parameters(), lr=0.01)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+
+    @hvd_elastic.run
+    def train(state):
+        for epoch in range(state.epoch, args.epochs):
+            for b in range(state.batch, args.batches_per_epoch):
+                data = torch.randn(args.batch_size, 16)
+                target = torch.randint(0, 4, (args.batch_size,))
+                opt.zero_grad()
+                loss = Fn.cross_entropy(model(data), target)
+                loss.backward()
+                opt.step()
+                state.batch = b + 1
+                if b % 5 == 0:
+                    state.commit()   # checkpoint boundary + host check
+            state.epoch, state.batch = epoch + 1, 0
+            state.commit()
+            if hvd.process_rank() == 0:
+                print(f"epoch {epoch}: loss={float(loss):.4f}")
+
+    state = hvd_elastic.TorchState(model=model, optimizer=opt,
+                                   epoch=0, batch=0)
+    train(state)
+
+
+if __name__ == "__main__":
+    main()

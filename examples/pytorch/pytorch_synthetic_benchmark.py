@@ -1,0 +1,70 @@
+"""Synthetic throughput benchmark for the torch frontend.
+
+Mirrors the reference's protocol (reference:
+examples/pytorch/pytorch_synthetic_benchmark.py:104-109): timed iterations
+of a full train step on synthetic data, img/sec aggregated over workers.
+
+    hvdrun -np 2 python examples/pytorch/pytorch_synthetic_benchmark.py
+"""
+
+import argparse
+import time
+
+import numpy as np
+import torch
+import torch.nn.functional as Fn
+
+import horovod_tpu.torch as hvd
+
+
+def make_model(num_classes=10):
+    return torch.nn.Sequential(
+        torch.nn.Conv2d(3, 32, 3, stride=2, padding=1), torch.nn.ReLU(),
+        torch.nn.Conv2d(32, 64, 3, stride=2, padding=1), torch.nn.ReLU(),
+        torch.nn.AdaptiveAvgPool2d(1), torch.nn.Flatten(),
+        torch.nn.Linear(64, num_classes))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--num-warmup", type=int, default=3)
+    ap.add_argument("--image-size", type=int, default=64)
+    args = ap.parse_args()
+
+    hvd.init()
+    torch.manual_seed(0)
+    model = make_model()
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = torch.optim.SGD(model.parameters(), lr=0.01)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+
+    data = torch.randn(args.batch_size, 3, args.image_size, args.image_size)
+    target = torch.randint(0, 10, (args.batch_size,))
+
+    def step():
+        opt.zero_grad()
+        loss = Fn.cross_entropy(model(data), target)
+        loss.backward()
+        opt.step()
+        return float(loss)
+
+    for _ in range(args.num_warmup):
+        step()
+    t0 = time.time()
+    for _ in range(args.num_iters):
+        loss = step()
+    dt = time.time() - t0
+
+    img_sec = args.batch_size * args.num_iters / dt
+    if hvd.process_rank() == 0:
+        print(f"Img/sec per worker process: {img_sec:.1f}")
+        print(f"Total img/sec on {hvd.process_size()} processes "
+              f"({hvd.size()} chips): {img_sec * hvd.process_size():.1f} "
+              f"(final loss {loss:.4f})")
+
+
+if __name__ == "__main__":
+    main()

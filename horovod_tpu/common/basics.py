@@ -19,8 +19,11 @@ import subprocess
 import threading
 from typing import List, Optional, Tuple
 
-_CSRC = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CSRC = os.path.join(os.path.dirname(_PKG_ROOT), "csrc")
+# Installed packages carry the prebuilt library (setup.py BuildPyWithNative);
+# source checkouts build csrc/ on demand.
+_INSTALLED_LIB = os.path.join(_PKG_ROOT, "_native", "libhvd_tpu_core.so")
 _LIB_PATH = os.path.join(_CSRC, "libhvd_tpu_core.so")
 
 _lib = None
@@ -37,11 +40,19 @@ OP_JOIN = 6
 
 
 def _build_library() -> None:
-    subprocess.run(["make", "-C", _CSRC], check=True,
-                   capture_output=True)
+    proc = subprocess.run(["make", "-C", _CSRC], capture_output=True,
+                          text=True)
+    if proc.returncode != 0:
+        # Surface the compiler output: an opaque CalledProcessError hides
+        # the actual error (round-1 ADVICE: build hygiene).
+        raise RuntimeError(
+            f"native core build failed (make -C {_CSRC}):\n"
+            f"{proc.stdout}\n{proc.stderr}")
 
 
 def _needs_rebuild() -> bool:
+    if not os.path.isdir(_CSRC):
+        return False  # installed package: no source tree to rebuild from
     if not os.path.exists(_LIB_PATH):
         return True
     lib_mtime = os.path.getmtime(_LIB_PATH)
@@ -57,9 +68,18 @@ def load_library() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if _needs_rebuild():
-            _build_library()
-        lib = ctypes.CDLL(_LIB_PATH)
+        if os.path.isdir(_CSRC):
+            # Source checkout: csrc/ is authoritative (rebuilds on edit).
+            if _needs_rebuild():
+                _build_library()
+            path = _LIB_PATH
+        elif os.path.exists(_INSTALLED_LIB):
+            path = _INSTALLED_LIB
+        else:
+            raise RuntimeError(
+                "libhvd_tpu_core.so not found: neither a csrc/ source tree "
+                f"nor the installed library at {_INSTALLED_LIB}")
+        lib = ctypes.CDLL(path)
         # signatures
         lib.hvd_loopback_hub_create.restype = ctypes.c_void_p
         lib.hvd_loopback_hub_create.argtypes = [ctypes.c_int]

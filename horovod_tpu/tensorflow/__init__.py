@@ -213,7 +213,7 @@ def reducescatter(tensor: tf.Tensor, op: ReduceOp = Average,
 
 
 # ----------------------------------------------------------- gradient plumbing
-def _sync_grads(grads: List[Any], variables, op: ReduceOp,
+def _sync_grads(grads: List[Any], op: ReduceOp,
                 compression, sparse_as_dense: bool) -> List[Any]:
     """Allreduce a gradient list: dense grads ride one fused grouped
     allreduce; sparse grads take the gather path (or densify first with
@@ -267,8 +267,8 @@ class DistributedGradientTape:
         # tf.GradientTape supports arbitrary nests (dicts, nested lists);
         # flatten, sync, re-pack (the reference flattens with tf.nest too).
         flat = tf.nest.flatten(grads)
-        synced = _sync_grads(flat, tf.nest.flatten(sources), self._op,
-                             self._compression, self._sparse_as_dense)
+        synced = _sync_grads(flat, self._op, self._compression,
+                             self._sparse_as_dense)
         return tf.nest.pack_sequence_as(grads, synced)
 
 
@@ -296,9 +296,58 @@ class DistributedOptimizer:
     def __getattr__(self, item):
         return getattr(self._opt, item)
 
+    def __setattr__(self, name, value):
+        # Hyperparameter writes (opt.learning_rate = ...) must reach the
+        # INNER optimizer: a shadow attribute on the wrapper would leave
+        # training at the old value while reads report the new one.
+        if not name.startswith("_") and "_opt" in self.__dict__ and \
+                hasattr(self._opt, name):
+            setattr(self._opt, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
     @property
     def inner(self):
         return self._opt
+
+    def _accumulate(self, grads: List[Any]) -> Optional[List[Any]]:
+        """Local aggregation for backward_passes_per_step: dense grads sum
+        into host arrays; IndexedSlices accumulate SPARSELY (concatenated
+        values+indices) so a huge embedding gradient is never densified."""
+        if self._acc is None:
+            self._acc = [None] * len(grads)
+        for i, g in enumerate(grads):
+            if g is None:
+                continue  # unused this pass; may contribute next pass
+            if isinstance(g, tf.IndexedSlices):
+                entry = self._acc[i]
+                if entry is None:
+                    entry = ("sparse", [], [], g.dense_shape)
+                    self._acc[i] = entry
+                entry[1].append(np.asarray(g.values.numpy()))
+                entry[2].append(np.asarray(g.indices.numpy()))
+            else:
+                a = np.asarray(g.numpy())
+                self._acc[i] = a if self._acc[i] is None \
+                    else self._acc[i] + a
+        self._counter += 1
+        if self._counter < self._bpps:
+            return None
+        out: List[Any] = []
+        for a in self._acc:
+            if a is None:
+                out.append(None)
+            elif isinstance(a, tuple):
+                values = np.concatenate(a[1]) / self._bpps
+                indices = np.concatenate(a[2])
+                out.append(tf.IndexedSlices(
+                    values=tf.convert_to_tensor(values),
+                    indices=tf.convert_to_tensor(indices),
+                    dense_shape=a[3]))
+            else:
+                out.append(tf.convert_to_tensor(a / self._bpps))
+        self._acc, self._counter = None, 0
+        return out
 
     def apply_gradients(self, grads_and_vars, **kwargs):
         gv = list(grads_and_vars)
@@ -307,23 +356,10 @@ class DistributedOptimizer:
         if not gv:
             return None  # keras's own apply_gradients rejects empty input
         if self._bpps > 1:
-            dense = [tf.convert_to_tensor(g) if isinstance(
-                g, tf.IndexedSlices) else g for g in grads]
-            if self._acc is None:
-                self._acc = [None] * len(dense)
-            for i, g in enumerate(dense):
-                if g is None:
-                    continue  # unused this pass; may contribute next pass
-                a = np.asarray(g.numpy())
-                self._acc[i] = a if self._acc[i] is None else self._acc[i] + a
-            self._counter += 1
-            if self._counter < self._bpps:
-                return  # aggregate locally; no sync, no apply
-            grads = [None if a is None else
-                     tf.convert_to_tensor(a / self._bpps)
-                     for a in self._acc]
-            self._acc, self._counter = None, 0
-        synced = _sync_grads(grads, tvars, self._op, self._compression,
+            grads = self._accumulate(grads)
+            if grads is None:
+                return None  # aggregate locally; no sync, no apply
+        synced = _sync_grads(grads, self._op, self._compression,
                              self._sparse_as_dense)
         return self._opt.apply_gradients(
             [(g, v) for g, v in zip(synced, tvars) if g is not None],

@@ -2,12 +2,19 @@
 
 Reference: horovod/tensorflow/sync_batch_norm.py (65 LoC) — batch
 statistics are allreduced across workers so small per-worker batches
-normalize with global statistics.  Keras 3 computes moments inline in
-``BatchNormalization.call`` (no overridable ``_moments`` hook as in
-tf-keras 2), so this subclass overrides ``call`` for the training path:
-group mean via allreduce of local means, group variance via allreduce of
-local squared deviations from the group mean — the reference's exact
-two-pass decomposition (sync_batch_norm.py:28-52).
+normalize with global statistics.
+
+Keras 3 exposes the ``_moments(inputs, mask)`` hook that
+``BatchNormalization.call`` uses for the training path; overriding ONLY it
+keeps every base behavior — the ``training and self.trainable`` guard, the
+float32 upcast of low-precision inputs, mask support, and the
+moving-average update.  Group variance is reassembled from local
+(mean, E[x^2]) via E_g[x^2] - mean_g^2.  Because the allreduce round-trips
+through the host (no gradient), group statistics use the
+local + stop_gradient(group - local) identity: value = group statistic,
+gradient = local statistic — whose cross-worker average equals the true
+group gradient (the torch frontend's differentiable allreduce achieves the
+same, torch/sync_batch_norm.py).
 """
 
 from __future__ import annotations
@@ -20,7 +27,7 @@ from ..ops import collectives as _C
 
 
 def _group_average(t: tf.Tensor) -> tf.Tensor:
-    out = _C.allreduce(_C.process_local(t.numpy()), op=ReduceOp.AVERAGE)
+    out = _C.allreduce(_C.process_local(np.asarray(t)), op=ReduceOp.AVERAGE)
     return tf.cast(tf.convert_to_tensor(np.asarray(out)), t.dtype)
 
 
@@ -31,48 +38,13 @@ class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
         kwargs.pop("synchronized", None)  # we are the synchronization
         super().__init__(*args, **kwargs)
 
-    def call(self, inputs, training=None, mask=None):
-        if not training:
-            return super().call(inputs, training=training)
-
-        inputs = tf.convert_to_tensor(inputs)
-        ndims = inputs.shape.rank
-        axis = self.axis if self.axis >= 0 else ndims + self.axis
-        reduction_axes = [i for i in range(ndims) if i != axis]
-
-        local_mean = tf.reduce_mean(inputs, axis=reduction_axes)
-        # The allreduce round-trips through numpy and so carries no
-        # gradient; keep gradient flow through the LOCAL statistics with
-        # the standard local + stop_gradient(group - local) identity: the
-        # value is the group statistic, the gradient is the local one —
-        # whose cross-worker average equals the true group-statistic
-        # gradient (the torch frontend's differentiable allreduce achieves
-        # the same, torch/sync_batch_norm.py).
-        group_mean = local_mean + tf.stop_gradient(
-            _group_average(local_mean) - local_mean)
-        shape = [1] * ndims
-        shape[axis] = -1
-        mean_b = tf.reshape(group_mean, shape)
-        local_var = tf.reduce_mean(tf.math.squared_difference(
-            inputs, mean_b), axis=reduction_axes)
-        group_var = local_var + tf.stop_gradient(
-            _group_average(local_var) - local_var)
-        var_b = tf.reshape(group_var, shape)
-
-        # moving statistics update (same EMA rule as the base layer)
-        if self.moving_mean is not None:
-            m = tf.cast(self.momentum, self.moving_mean.dtype)
-            self.moving_mean.assign(
-                self.moving_mean * m
-                + tf.cast(group_mean, self.moving_mean.dtype) * (1.0 - m))
-            self.moving_variance.assign(
-                self.moving_variance * m
-                + tf.cast(group_var, self.moving_variance.dtype) * (1.0 - m))
-
-        out = (inputs - mean_b) * tf.math.rsqrt(
-            var_b + tf.cast(self.epsilon, inputs.dtype))
-        if self.scale and self.gamma is not None:
-            out = out * tf.cast(tf.reshape(self.gamma, shape), inputs.dtype)
-        if self.center and self.beta is not None:
-            out = out + tf.cast(tf.reshape(self.beta, shape), inputs.dtype)
-        return out
+    def _moments(self, inputs, mask):
+        mean, var = super()._moments(inputs, mask)
+        mean = tf.convert_to_tensor(mean)
+        var = tf.convert_to_tensor(var)
+        local_second = var + tf.math.square(mean)
+        group_mean = mean + tf.stop_gradient(_group_average(mean) - mean)
+        group_second = local_second + tf.stop_gradient(
+            _group_average(local_second) - local_second)
+        group_var = group_second - tf.math.square(group_mean)
+        return group_mean, group_var

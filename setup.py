@@ -1,0 +1,34 @@
+"""Build hooks: compile the native coordination core into the wheel.
+
+The reference's setup.py drives CMake per framework binding
+(reference: setup.py:29-40, 197-199).  Here the native surface is one
+dependency-free C++17 shared library (csrc/), compiled with g++ into
+``horovod_tpu/_native/`` so installed packages don't need the source tree;
+a source checkout still works without installing (basics.py falls back to
+make-on-demand in csrc/).
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+CSRC = os.path.join(ROOT, "csrc")
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        super().run()
+        # csrc/Makefile is the single source of truth for the build recipe
+        # (source list, flags); reuse it and copy the artifact.
+        subprocess.run(["make", "-C", CSRC], check=True)
+        out_dir = os.path.join(self.build_lib, "horovod_tpu", "_native")
+        os.makedirs(out_dir, exist_ok=True)
+        shutil.copy2(os.path.join(CSRC, "libhvd_tpu_core.so"),
+                     os.path.join(out_dir, "libhvd_tpu_core.so"))
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

@@ -287,6 +287,63 @@ def test_bpps_none_then_grad():
     np.testing.assert_allclose(v.numpy(), [-2.0], rtol=1e-6)  # 4/2 applied
 
 
+def test_optimizer_setattr_reaches_inner():
+    """opt.learning_rate = x must update the INNER optimizer (regression:
+    wrapper shadow attribute left training at the old rate)."""
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+    opt.learning_rate = 0.5
+    assert abs(float(opt.inner.learning_rate) - 0.5) < 1e-7
+
+
+def test_sync_bn_respects_trainable_and_dtype():
+    """A frozen SyncBatchNormalization must behave like the frozen stock
+    layer (moving stats, no mutation), via the inherited call()."""
+    layer = hvd.SyncBatchNormalization(axis=-1)
+    x = tf.constant(np.random.RandomState(0).randn(8, 3), tf.float32)
+    layer(x, training=True)  # build + one update
+    mm = np.copy(layer.moving_mean.numpy())
+    layer.trainable = False
+    out_frozen = layer(x, training=True)
+    np.testing.assert_allclose(layer.moving_mean.numpy(), mm)  # unchanged
+    # frozen path normalizes with moving stats — not batch stats
+    ref = tf.keras.layers.BatchNormalization(axis=-1)
+    ref(x, training=True)
+    ref.set_weights(layer.get_weights())
+    ref.trainable = False
+    np.testing.assert_allclose(out_frozen.numpy(),
+                               ref(x, training=True).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bpps_sparse_stays_sparse():
+    """backward_passes_per_step must not densify IndexedSlices (regression:
+    huge embedding grads were materialized dense on the host)."""
+    table = tf.Variable(np.zeros((100, 2), np.float32))
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                   backward_passes_per_step=2)
+    captured = {}
+    orig = opt.inner.apply_gradients
+
+    def spy(gv, **kw):
+        gv = list(gv)
+        captured["grads"] = [g for g, _ in gv]
+        return orig(gv, **kw)
+
+    opt.inner.apply_gradients = spy
+    mk = lambda idx, val: tf.IndexedSlices(
+        values=tf.constant([[val, val]]),
+        indices=tf.constant([idx], tf.int64),
+        dense_shape=tf.constant([100, 2], tf.int64))
+    opt.apply_gradients([(mk(3, 2.0), table)])
+    assert "grads" not in captured  # aggregated, not applied
+    opt.apply_gradients([(mk(7, 4.0), table)])
+    (g,) = captured["grads"]
+    assert isinstance(g, tf.IndexedSlices)  # stayed sparse end-to-end
+    got = dict(zip(g.indices.numpy().tolist(),
+                   g.values.numpy()[:, 0].tolist()))
+    assert got == {3: 1.0, 7: 2.0}, got  # averaged over 2 passes
+
+
 def test_sparse_allreduce_scaling():
     slices = tf.IndexedSlices(values=tf.constant([[2.0]]),
                               indices=tf.constant([1], tf.int64),
