@@ -1,1 +1,9 @@
-"""horovod_tpu.data subpackage."""
+"""Data loader utilities (reference: horovod/data/data_loader_base.py)."""
+
+from .loader import (AsyncDataLoaderMixin, AsyncNumpyDataLoader,
+                     AsyncParquetDataLoader, BaseDataLoader,
+                     NumpyDataLoader, ParquetDataLoader, shard_indices)
+
+__all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "NumpyDataLoader",
+           "AsyncNumpyDataLoader", "ParquetDataLoader",
+           "AsyncParquetDataLoader", "shard_indices"]

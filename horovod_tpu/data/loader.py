@@ -1,0 +1,277 @@
+"""Data-loader utilities: async queue-backed loading + sharded sources.
+
+TPU-native rethink of the reference's loader stack (reference:
+horovod/data/data_loader_base.py:20-130 BaseDataLoader +
+AsyncDataLoaderMixin; spark/data_loaders/pytorch_data_loaders.py): the
+host must keep batches flowing into HBM while the chips run the previous
+step, so the async mixin's producer thread is the core utility.  Instead
+of petastorm, the parquet reader is a thin pyarrow wrapper
+(ParquetDataLoader) and sharding is explicit (shard_indices — one shard
+per worker, the ElasticSampler convention).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class BaseDataLoader:
+    """Iteration contract (reference: data_loader_base.py:20-45)."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def _iterate(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def _process_batch(self, batch: Any) -> Any:
+        """Hook for trainers to reshape batches (reference semantics)."""
+        return batch
+
+    def __iter__(self) -> Iterator[Any]:
+        for batch in self._iterate():
+            yield self._process_batch(batch)
+
+
+class AsyncDataLoaderMixin:
+    """Producer-thread async loading (reference: data_loader_base.py:48-130).
+
+    Mix in FRONT of a BaseDataLoader implementation:
+
+        class AsyncNumpyLoader(AsyncDataLoaderMixin, NumpyDataLoader): ...
+
+    ``async_loader_queue_size=0`` disables the thread (synchronous mode).
+    Exceptions in the producer surface in the consumer.  Unlike the
+    reference (whose producer loops forever and replays epochs), one
+    ``__iter__`` == one epoch — the thread parks between epochs.
+    """
+
+    def __init__(self, *args, async_loader_queue_size: int = 64, **kwargs):
+        self.async_loader_queue_size = async_loader_queue_size
+        super().__init__(*args, **kwargs)
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._finished = threading.Event()
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._finished.set()
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _safe_put(self, item) -> bool:
+        """put() that aborts when the consumer closed the loader — a plain
+        blocking put on a full queue after close() would deadlock the
+        producer thread forever."""
+        while True:
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                if self._finished.is_set():
+                    return False
+
+    def _producer(self) -> None:
+        try:
+            for batch in self._iterate():
+                if self._finished.is_set() or not self._safe_put(batch):
+                    return
+        except Exception as e:  # surface in the consumer
+            self._safe_put(e)
+        self._safe_put(None)
+
+    def __iter__(self) -> Iterator[Any]:
+        if self.async_loader_queue_size <= 0:
+            yield from super().__iter__()
+            return
+        self._finished.clear()
+        self._queue = queue.Queue(self.async_loader_queue_size)
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+        try:
+            while True:
+                batch = self._queue.get()
+                if batch is None:
+                    break
+                if isinstance(batch, Exception):
+                    raise batch
+                yield self._process_batch(batch)
+        finally:
+            # Abandoned iteration (break / consumer exception / GC of the
+            # generator) must stop the producer — otherwise it spins in
+            # _safe_put forever with queue_size batches pinned.
+            self.close()
+
+
+def shard_indices(n: int, rank: int, num_workers: int,
+                  shuffle: bool = False, seed: int = 0) -> np.ndarray:
+    """Rank's index shard with wrap-padding so every worker sees the same
+    number of samples (the reference's DistributedSampler/ElasticSampler
+    convention, torch/elastic/sampler.py:24-131)."""
+    idx = np.arange(n)
+    if shuffle:
+        np.random.RandomState(seed).shuffle(idx)
+    per = -(-n // num_workers)  # ceil
+    pad = per * num_workers - n
+    if pad:
+        idx = np.concatenate([idx, idx[:pad]])
+    return idx[rank::num_workers]
+
+
+class NumpyDataLoader(BaseDataLoader):
+    """In-memory arrays -> batches, optionally sharded per worker."""
+
+    def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
+                 rank: int = 0, num_workers: int = 1,
+                 shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = False):
+        self.arrays = [np.asarray(a) for a in arrays]
+        n = len(self.arrays[0])
+        for a in self.arrays:
+            if len(a) != n:
+                raise ValueError("arrays must share the first dimension")
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._epoch = 0
+        self._base = dict(n=n, rank=rank, num_workers=num_workers,
+                          shuffle=shuffle, seed=seed)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle per epoch (DistributedSampler convention)."""
+        self._epoch = epoch
+
+    def _indices(self) -> np.ndarray:
+        b = self._base
+        return shard_indices(b["n"], b["rank"], b["num_workers"],
+                             shuffle=b["shuffle"],
+                             seed=b["seed"] + self._epoch)
+
+    def __len__(self) -> int:
+        n = len(self._indices())
+        return n // self.batch_size if self.drop_last else \
+            -(-n // self.batch_size)
+
+    def _iterate(self):
+        idx = self._indices()
+        end = (len(idx) // self.batch_size * self.batch_size
+               if self.drop_last else len(idx))
+        for s in range(0, end, self.batch_size):
+            sel = idx[s:s + self.batch_size]
+            yield tuple(a[sel] for a in self.arrays)
+
+
+class AsyncNumpyDataLoader(AsyncDataLoaderMixin, NumpyDataLoader):
+    """The standard composition (reference: PytorchAsyncDataLoader)."""
+
+
+def list_parquet_files(path: str) -> List[str]:
+    """A dataset path is either one .parquet file or a directory of them
+    (single definition shared by ParquetDataLoader and the Store)."""
+    import os
+    if os.path.isfile(path):
+        return [path]
+    return sorted(os.path.join(path, f) for f in os.listdir(path)
+                  if f.endswith(".parquet"))
+
+
+def decode_table(table) -> dict:
+    """pyarrow Table -> {column: np.ndarray}, restoring multi-dim columns
+    flattened by FilesystemStore.write_parquet (the single decoder for the
+    horovod_tpu_shapes metadata scheme — store.read_parquet uses it too)."""
+    import json
+    md = table.schema.metadata or {}
+    shapes = (json.loads(md[b"horovod_tpu_shapes"])
+              if b"horovod_tpu_shapes" in md else {})
+    out = {}
+    for name in table.column_names:
+        col = table.column(name).to_numpy(zero_copy_only=False)
+        if name in shapes:  # multi-dim column stored as flat lists
+            col = np.stack([np.asarray(r) for r in col]).reshape(
+                (-1,) + tuple(shapes[name]))
+        out[name] = col
+    return out
+
+
+class ParquetDataLoader(BaseDataLoader):
+    """Batches from a parquet file/directory (the petastorm-reader analog
+    backing the Estimator/Store path; reference: spark/data_loaders/).
+
+    Sharding is by CONTIGUOUS row block: worker r of W owns rows
+    [r*ceil(n/W), (r+1)*ceil(n/W)) (wrapping at the end like
+    shard_indices), and only the row groups overlapping that block are
+    read — workers never materialize each other's data.  Columns are
+    decoded once at construction, not per epoch."""
+
+    def __init__(self, path: str, batch_size: int, columns=None,
+                 rank: int = 0, num_workers: int = 1):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        self.path = path
+        self.batch_size = batch_size
+        self.columns = list(columns) if columns else None
+        self.rank = rank
+        self.num_workers = num_workers
+
+        readers = [pq.ParquetFile(f) for f in list_parquet_files(path)]
+        total = sum(r.metadata.num_rows for r in readers)
+        if total == 0:
+            raise ValueError(f"empty parquet dataset at {path}")
+        # Balanced contiguous blocks: floor boundaries guarantee every
+        # worker a non-empty block whenever total >= num_workers; tiny
+        # datasets (total < num_workers) give spare workers one wrapped
+        # row so collectives never lose a participant.
+        start = rank * total // num_workers
+        stop = (rank + 1) * total // num_workers
+        if stop <= start:
+            start = rank % total
+            stop = start + 1
+        per = -(-total // num_workers)  # ceil: common per-worker row count
+
+        # Read only the row groups overlapping [start, stop).
+        pieces, offset = [], 0
+        for r in readers:
+            for g in range(r.num_row_groups):
+                rows = r.metadata.row_group(g).num_rows
+                g_start, g_stop = offset, offset + rows
+                if g_stop > start and g_start < stop:
+                    t = r.read_row_group(g, columns=self.columns)
+                    lo = max(start - g_start, 0)
+                    hi = min(stop - g_start, rows)
+                    pieces.append(t.slice(lo, hi - lo))
+                offset += rows
+        self._cols = decode_table(pa.concat_tables(pieces))
+        self._n = stop - start
+        # Wrap-pad short shards to `per` rows from own data so every worker
+        # yields the same number of batches (collective-friendly, the
+        # ElasticSampler convention).
+        if self._n < per:
+            reps = -(-per // self._n)
+            self._cols = {k: np.concatenate([v] * reps)[:per]
+                          for k, v in self._cols.items()}
+            self._n = per
+
+    def __len__(self) -> int:
+        return -(-self._n // self.batch_size)
+
+    def _iterate(self):
+        for s in range(0, self._n, self.batch_size):
+            yield {name: col[s:s + self.batch_size]
+                   for name, col in self._cols.items()}
+
+
+class AsyncParquetDataLoader(AsyncDataLoaderMixin, ParquetDataLoader):
+    pass

@@ -1,0 +1,8 @@
+"""Ray integration (reference: horovod/ray/runner.py:128 RayExecutor,
+strategy.py placement, elastic.py)."""
+
+from .runner import (BaseWorkerPool, LocalWorkerPool, RayExecutor,
+                     RayWorkerPool)
+
+__all__ = ["RayExecutor", "BaseWorkerPool", "LocalWorkerPool",
+           "RayWorkerPool"]

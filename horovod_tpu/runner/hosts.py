@@ -99,3 +99,41 @@ def get_host_assignments(hosts: List[HostInfo], np_: int,
                 cross_rank=cross_rank, cross_size=len(used_hosts)))
             rank += 1
     return slots
+
+
+def env_for_tasks(hostnames: List[str],
+                  coordinator_port: int = 29500) -> List[Dict[str, str]]:
+    """Per-task HOROVOD_* env blocks for schedulers that report one
+    hostname per task (Spark barrier stages, Ray actors): tasks on the
+    same host get consecutive LOCAL ranks, hosts get CROSS ranks in
+    first-seen order, and the returned list aligns with the INPUT order.
+
+    The jax.distributed coordinator must live where PROCESS 0 runs (it
+    binds the address) — so the coordinator host is rank 0's host, never
+    the driver's (reference/hvdrun convention: launch.py uses
+    slots[0].hostname).
+
+    One assignment implementation serves the launcher, Spark and Ray — the
+    reference's Coordinator re-derives this per integration
+    (ray/runner.py:41-127, spark driver rank-by-partition)."""
+    order: List[str] = []
+    members: Dict[str, List[int]] = {}
+    for i, h in enumerate(hostnames):
+        if h not in members:
+            members[h] = []
+            order.append(h)
+        members[h].append(i)
+    hosts = [HostInfo(hostname=h, slots=len(members[h])) for h in order]
+    slots = get_host_assignments(hosts, len(hostnames))
+    coordinator_addr = f"{slots[0].hostname}:{coordinator_port}"
+    envs: List[Dict[str, str]] = [dict() for _ in hostnames]
+    by_host: Dict[str, List[SlotInfo]] = {}
+    for s in slots:
+        by_host.setdefault(s.hostname, []).append(s)
+    for h in order:
+        for idx, slot in zip(members[h], by_host[h]):
+            env = slot.to_env()
+            env["HOROVOD_COORDINATOR_ADDR"] = (
+                coordinator_addr if len(hostnames) > 1 else "")
+            envs[idx] = env
+    return envs

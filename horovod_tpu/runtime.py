@@ -55,9 +55,24 @@ class Runtime:
                  devices: Optional[Sequence[Any]] = None,
                  mesh_spec: Optional[str] = None):
         import jax
+        import os
 
         self.knobs = knobs or Knobs()
         self._shutdown = False
+
+        # Honor an EXPLICIT JAX_PLATFORMS env even when site customization
+        # (TPU images force-registering a hardware backend) overrode the
+        # jax_platforms CONFIG, which beats the env var.  Worker processes
+        # spawned by launchers/executors inherit the env but not the
+        # parent's config, so without this a CPU-forced worker silently
+        # lands on the hardware backend — and multi-process CPU meshes
+        # (jax.distributed over gloo) never form.
+        env_plat = os.environ.get("JAX_PLATFORMS", "")
+        if env_plat and jax.config.jax_platforms != env_plat:
+            try:
+                jax.config.update("jax_platforms", env_plat)
+            except Exception:
+                pass  # backends already initialized; nothing to rescue
 
         # Multi-host bring-up: the launcher (hvdrun) exports coordinator
         # address + process coordinates (the analog of mpirun exporting

@@ -1,0 +1,18 @@
+"""Spark integration (reference: horovod/spark/, ~7k LoC).
+
+``horovod_tpu.spark.run(fn, num_proc)`` runs a training function on
+cluster tasks; Estimators persist datasets through a Store and return
+servable models.  pyspark is required only for real-cluster placement —
+the orchestration core and local mode work without it (the reference's
+test strategy runs Spark in local mode the same way).
+"""
+
+from .runner import (LocalTaskExecutor, SparkTaskExecutor, TaskExecutor,
+                     run)
+from .store import FilesystemStore, LocalStore, Store
+from .estimator import (Estimator, EstimatorModel, KerasEstimator,
+                        LinearEstimator)
+
+__all__ = ["run", "TaskExecutor", "LocalTaskExecutor", "SparkTaskExecutor",
+           "Store", "FilesystemStore", "LocalStore", "Estimator",
+           "EstimatorModel", "LinearEstimator", "KerasEstimator"]

@@ -1,0 +1,142 @@
+"""Store: where prepared training data and checkpoints live.
+
+Reference: horovod/spark/common/store.py:36-530 — FilesystemStore keeps
+train/val parquet, per-run checkpoints and logs under a base directory;
+HDFS/DBFS variants change only path handling.  Here the filesystem store
+is the core implementation (TPU VMs mount GCS via fuse or use local SSD;
+remote-blob variants slot in by overriding ``fs`` path joins).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Store:
+    """Abstract store surface (reference: store.py:36-100)."""
+
+    def get_train_data_path(self, idx: Optional[str] = None) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, idx: Optional[str] = None) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path: str, **kwargs) -> "Store":
+        """Factory (reference: store.py Store.create chooses by scheme)."""
+        return FilesystemStore(prefix_path, **kwargs)
+
+
+class FilesystemStore(Store):
+    """Local/NFS/fuse-mounted storage (reference: store.py:103-330)."""
+
+    def __init__(self, prefix_path: str,
+                 train_path: Optional[str] = None,
+                 val_path: Optional[str] = None,
+                 checkpoint_path: Optional[str] = None,
+                 logs_path: Optional[str] = None):
+        self.prefix_path = prefix_path
+        self._train = train_path or os.path.join(prefix_path,
+                                                 "intermediate_train_data")
+        self._val = val_path or os.path.join(prefix_path,
+                                             "intermediate_val_data")
+        self._ckpt = checkpoint_path or os.path.join(prefix_path,
+                                                     "checkpoints")
+        self._logs = logs_path or os.path.join(prefix_path, "logs")
+        os.makedirs(prefix_path, exist_ok=True)
+
+    def get_train_data_path(self, idx: Optional[str] = None) -> str:
+        return self._train if idx is None else f"{self._train}.{idx}"
+
+    def get_val_data_path(self, idx: Optional[str] = None) -> str:
+        return self._val if idx is None else f"{self._val}.{idx}"
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self._ckpt, run_id)
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self._logs, run_id)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        if not os.path.isdir(path):
+            return os.path.isfile(path) and path.endswith(".parquet")
+        return any(f.endswith(".parquet") for f in os.listdir(path))
+
+    # ---- data prep -------------------------------------------------------
+    def write_parquet(self, path: str, columns: Dict[str, np.ndarray],
+                      overwrite: bool = True) -> str:
+        """Persist a column dict as a parquet dataset (the prepare_data
+        step of Estimator.fit, reference: spark/common/util.py)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        if overwrite and os.path.isdir(path):
+            shutil.rmtree(path)
+        os.makedirs(path, exist_ok=True)
+        flat = {}
+        meta: Dict[str, Any] = {}
+        for name, arr in columns.items():
+            arr = np.asarray(arr)
+            if arr.ndim > 1:  # parquet columns are 1-D; flatten + remember
+                meta[name] = list(arr.shape[1:])
+                flat[name] = list(arr.reshape(arr.shape[0], -1))
+            else:
+                flat[name] = arr
+        table = pa.table(flat)
+        import json
+        table = table.replace_schema_metadata(
+            {b"horovod_tpu_shapes": json.dumps(meta).encode()})
+        out = os.path.join(path, "part-00000.parquet")
+        pq.write_table(table, out)
+        return path
+
+    def read_parquet(self, path: str) -> Dict[str, np.ndarray]:
+        """Read back a dataset written by write_parquet, restoring shapes
+        (decoder shared with ParquetDataLoader: data/loader.decode_table)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from ..data.loader import decode_table, list_parquet_files
+        return decode_table(pa.concat_tables(
+            [pq.read_table(f) for f in list_parquet_files(path)]))
+
+    # ---- checkpoints -----------------------------------------------------
+    def save_checkpoint(self, run_id: str, payload: bytes,
+                        name: str = "checkpoint.bin") -> str:
+        d = self.get_checkpoint_path(run_id)
+        os.makedirs(d, exist_ok=True)
+        p = os.path.join(d, name)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, p)
+        return p
+
+    def read_checkpoint(self, run_id: str,
+                        name: str = "checkpoint.bin") -> Optional[bytes]:
+        p = os.path.join(self.get_checkpoint_path(run_id), name)
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+
+# DBFS/HDFS naming parity: same behavior, fuse-mounted paths.
+LocalStore = FilesystemStore

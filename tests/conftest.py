@@ -14,6 +14,10 @@ import os
 # environment may point JAX_PLATFORMS at real TPU hardware, which tests must
 # never touch.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Keras 3's backend is process-global and fixed at first keras import; pin
+# it for the whole suite so collection order can't flip it (the TF
+# frontend's suite runs in its own subprocess with backend=tensorflow).
+os.environ.setdefault("KERAS_BACKEND", "jax")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
