@@ -55,7 +55,7 @@ class AsyncDataLoaderMixin:
         super().__init__(*args, **kwargs)
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
-        self._finished = threading.Event()
+        self._finished: Optional[threading.Event] = None
 
     def close(self) -> None:
         if self._thread is not None:
@@ -65,6 +65,9 @@ class AsyncDataLoaderMixin:
             except queue.Empty:
                 pass
             self._thread.join(timeout=5)
+            # Even if the join timed out, the producer owns THIS epoch's
+            # queue/event objects only (passed by argument), so a straggler
+            # can never inject stale batches into a later epoch.
             self._thread = None
 
     def __del__(self):  # pragma: no cover - best effort
@@ -73,38 +76,43 @@ class AsyncDataLoaderMixin:
         except Exception:
             pass
 
-    def _safe_put(self, item) -> bool:
-        """put() that aborts when the consumer closed the loader — a plain
+    @staticmethod
+    def _safe_put(q: queue.Queue, finished: threading.Event, item) -> bool:
+        """put() that aborts when the consumer closed the epoch — a plain
         blocking put on a full queue after close() would deadlock the
         producer thread forever."""
         while True:
             try:
-                self._queue.put(item, timeout=0.1)
+                q.put(item, timeout=0.1)
                 return True
             except queue.Full:
-                if self._finished.is_set():
+                if finished.is_set():
                     return False
 
-    def _producer(self) -> None:
+    def _producer(self, q: queue.Queue, finished: threading.Event) -> None:
         try:
             for batch in self._iterate():
-                if self._finished.is_set() or not self._safe_put(batch):
+                if finished.is_set() or not self._safe_put(q, finished,
+                                                           batch):
                     return
         except Exception as e:  # surface in the consumer
-            self._safe_put(e)
-        self._safe_put(None)
+            self._safe_put(q, finished, e)
+        self._safe_put(q, finished, None)
 
     def __iter__(self) -> Iterator[Any]:
         if self.async_loader_queue_size <= 0:
             yield from super().__iter__()
             return
-        self._finished.clear()
-        self._queue = queue.Queue(self.async_loader_queue_size)
-        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self.close()  # retire any straggler from an abandoned epoch
+        finished = threading.Event()
+        q = queue.Queue(self.async_loader_queue_size)
+        self._finished, self._queue = finished, q
+        self._thread = threading.Thread(target=self._producer,
+                                        args=(q, finished), daemon=True)
         self._thread.start()
         try:
             while True:
-                batch = self._queue.get()
+                batch = q.get()
                 if batch is None:
                     break
                 if isinstance(batch, Exception):

@@ -192,6 +192,22 @@ def _mesh_key(rt) -> int:
     return id(rt.mesh)
 
 
+def _tl(rt, name: Optional[str], kind: str, nbytes: int) -> None:
+    """Timeline emit for one eager collective (reference: per-op activities
+    from every backend, e.g. nccl_operations.cc:144-181).  X events; the
+    negotiated torch path adds NEGOTIATE/QUEUE phases around these.
+
+    Auto-generated names ('x.noname.N') collapse to their prefix: each
+    unique name allocates a chrome pid + metadata entry forever, so
+    per-call unique names would leak memory and bloat the trace."""
+    if rt.timeline is not None:
+        if not name:
+            name = kind.lower()
+        elif ".noname." in name:
+            name = name.split(".noname.")[0]
+        rt.timeline.record_op(name, kind, nbytes)
+
+
 # ------------------------------------------------------------------ public API
 def allreduce(tensor: TensorLike,
               average: Optional[bool] = None,
@@ -213,9 +229,7 @@ def allreduce(tensor: TensorLike,
     fn = _compiled(_mesh_key(rt), "allreduce", op=int(op),
                    pre=float(prescale_factor), post=float(postscale_factor))
     out = fn(g)
-    if rt.timeline is not None:
-        rt.timeline.record_op(name or "allreduce", "ALLREDUCE",
-                              int(np.prod(local.shape)))
+    _tl(rt, name, "ALLREDUCE", int(local.nbytes))
     if rt.stall_inspector is not None and name:
         # The watchdog must observe actual completion, not async dispatch:
         # block before clearing the pending entry (the sync allreduce API is
@@ -252,6 +266,7 @@ def grouped_allreduce(tensors: Sequence[TensorLike],
                    pre=float(prescale_factor), post=float(postscale_factor),
                    plan=plan, n_leaves=len(gs))
     outs = fn(*gs)
+    _tl(rt, name, "GROUPED_ALLREDUCE", int(sum(l.nbytes for l in locals_)))
     res = [_to_local(rt, o) for o in outs]
     return [r if h else r[0] for r, h in zip(res, had)]
 
@@ -266,6 +281,7 @@ def allgather(tensor: TensorLike, name: Optional[str] = None) -> Array:
     g = _make_global(rt, local)
     fn = _compiled(_mesh_key(rt), "allgather")
     out = fn(g)  # replicated full concat [size, rows, ...]
+    _tl(rt, name, "ALLGATHER", int(local.nbytes))
     out = jnp.reshape(out, (-1,) + out.shape[2:])
     return out
 
@@ -313,6 +329,7 @@ def broadcast(tensor: TensorLike, root_rank: int = 0,
     g = _make_global(rt, local)
     fn = _compiled(_mesh_key(rt), "broadcast", root=int(root_rank))
     out = fn(g)
+    _tl(rt, name, "BROADCAST", int(local.nbytes))
     res = _to_local(rt, out)
     return res if had else res[0]
 
@@ -336,6 +353,7 @@ def alltoall(tensor: TensorLike,
         g = _make_global(rt, local)
         fn = _compiled(_mesh_key(rt), "alltoall")
         out = _to_local(rt, fn(g))
+        _tl(rt, name, "ALLTOALL", int(local.nbytes))
         recv = jnp.full((rt.local_size(), n), rows // n, jnp.int32)
         if not had:
             return out[0], recv[0]
@@ -372,6 +390,7 @@ def alltoall(tensor: TensorLike,
     g = _make_global(rt, padded)
     fn = _compiled(_mesh_key(rt), "alltoall")
     out = _to_local(rt, fn(g))  # [ls, n*max_blk, ...]
+    _tl(rt, name, "ALLTOALL", int(local.nbytes))
     # recv_splits[i, src] = all_sp[src, mesh position of local chip i]
     local_pos = rt.local_chip_positions()
     recv_np = np.stack([all_sp[:, local_pos[i]] for i in range(ls)])
@@ -397,7 +416,9 @@ def reducescatter(tensor: TensorLike, op: ReduceOp = Average,
     local, had = _per_chip(rt, tensor)
     g = _make_global(rt, local)
     fn = _compiled(_mesh_key(rt), "reducescatter", op=int(op))
-    return _to_local(rt, fn(g))
+    out = _to_local(rt, fn(g))
+    _tl(rt, name, "REDUCESCATTER", int(local.nbytes))
+    return out
 
 
 def barrier() -> None:
@@ -407,6 +428,7 @@ def barrier() -> None:
     g = _make_global(rt, jnp.zeros((rt.local_size(), 1), jnp.int32))
     fn = _compiled(_mesh_key(rt), "barrier")
     jax.block_until_ready(fn(g))
+    _tl(rt, None, "BARRIER", 0)
 
 
 def process_allgather(x: np.ndarray) -> np.ndarray:

@@ -101,7 +101,15 @@ def make_train_step(loss_fn: Callable,
         f = cache.get(len(batch))
         if f is None:
             f = cache[len(batch)] = build(len(batch))
-        return f(params, opt_state, *batch)
+        out = f(params, opt_state, *batch)
+        # Framework-level timeline mark for the compiled step (the in-jit
+        # collectives are XLA-fused; per-op detail lives in xprof).
+        from .. import runtime as _rt
+        if _rt.is_initialized() and _rt.get().timeline is not None:
+            nbytes = sum(int(getattr(b, "nbytes", 0))
+                         for b in jax.tree_util.tree_leaves(batch))
+            _rt.get().timeline.record_op("spmd/train_step", "STEP", nbytes)
+        return out
 
     return step
 

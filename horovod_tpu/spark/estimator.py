@@ -26,15 +26,18 @@ from .runner import TaskExecutor, run as spark_run
 from .store import Store
 
 
-def _as_columns(df, feature_cols, label_cols) -> Dict[str, np.ndarray]:
-    """Accept a column dict, or a pyspark/pandas DataFrame."""
+def _as_columns(df, feature_cols=None, label_cols=None
+                ) -> Dict[str, np.ndarray]:
+    """Accept a column dict, or a pyspark/pandas DataFrame.  With no column
+    lists, ALL columns convert (transform() must not drop id/label columns
+    the caller wants to keep alongside predictions)."""
     if isinstance(df, dict):
         return {k: np.asarray(v) for k, v in df.items()}
     if hasattr(df, "toPandas"):  # pyspark DataFrame
         df = df.toPandas()
-    # pandas DataFrame
-    return {c: np.stack(df[c].to_numpy())
-            for c in (list(feature_cols) + list(label_cols))}
+    cols = (list(feature_cols or []) + list(label_cols or [])) or \
+        list(df.columns)
+    return {c: np.stack(df[c].to_numpy()) for c in cols}
 
 
 class EstimatorModel:
@@ -48,7 +51,7 @@ class EstimatorModel:
         self.output_col = output_col
 
     def transform(self, df):
-        cols = _as_columns(df, self.feature_cols, [])
+        cols = _as_columns(df)  # keep every input column in the output
         x = np.concatenate(
             [cols[c].reshape(len(cols[c]), -1) for c in self.feature_cols],
             axis=1)
@@ -215,9 +218,9 @@ class KerasEstimator(Estimator):
     def _load_model(self, payload: bytes) -> Callable:
         weights = pickle.loads(payload)
         model = self.model_fn()
+        model.set_weights(weights)  # once, not per predict call
 
         def predict(x: np.ndarray) -> np.ndarray:
-            model.set_weights(weights)
             return np.asarray(model(x))
         return predict
 

@@ -71,8 +71,24 @@ class LocalTaskExecutor(TaskExecutor):
             p.start()
         results: List[Any] = [None] * self._n
         error = None
-        for _ in range(self._n):
-            i, (status, val) = q.get()
+        got = 0
+        while got < self._n:
+            try:
+                i, (status, val) = q.get(timeout=1.0)
+            except Exception:  # queue.Empty: check worker liveness
+                dead = [i for i, p in enumerate(procs)
+                        if not p.is_alive() and p.exitcode not in (0, None)
+                        and results[i] is None]
+                if dead:
+                    for p in procs:
+                        p.terminate()
+                    raise RuntimeError(
+                        f"task(s) {dead} died without reporting a result "
+                        f"(exitcodes "
+                        f"{[procs[i].exitcode for i in dead]}) — native "
+                        "crash or OOM kill?")
+                continue
+            got += 1
             if status == "error" and error is None:
                 error = (i, val)
             results[i] = val

@@ -9,12 +9,23 @@ DistributedOptimizer step and a broadcast_parameters sync.  Reference
 strategy: test/integration/test_static_run.py + parallel/test_torch.py.
 """
 
+import json
+import os
 import sys
+import tempfile
 
 import _env_setup  # noqa: F401  (must run before other jax imports)
 
 import numpy as np  # noqa: E402
 import torch  # noqa: E402
+
+# Per-process timeline so the negotiated lifecycle (NEGOTIATE -> QUEUE ->
+# EXEC) can be asserted after the run; must be set before hvd.init reads
+# the knobs.
+_TL_PATH = os.path.join(
+    tempfile.gettempdir(),
+    f"hvd_tl_{os.environ.get('HOROVOD_RANK', '0')}_{os.getpid()}.json")
+os.environ["HOROVOD_TIMELINE"] = _TL_PATH
 
 import horovod_tpu.torch as hvd  # noqa: E402
 
@@ -74,6 +85,28 @@ def main() -> int:
     for c in range(8):
         assert np.allclose(per_chip[c], per_chip[0], atol=1e-6), c
     assert not np.allclose(w_now, w0.numpy()), "weights never updated"
+
+    # ---- timeline lifecycle: per-tensor NEGOTIATE -> QUEUE -> EXEC -----
+    import horovod_tpu.runtime as rt
+    rt.get().timeline.close()
+    events = json.load(open(_TL_PATH))
+    by_pid = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            by_pid[e["pid"]] = e["args"]["name"]
+    for name in names:  # the negotiated tensors from the ordering test
+        pid = next(p for p, n in by_pid.items() if n == name)
+        phases = [(e["name"], e["ph"]) for e in events
+                  if e.get("pid") == pid and e.get("ph") in "BEX"]
+        assert ("NEGOTIATE", "B") in phases and \
+               ("NEGOTIATE", "E") in phases, (name, phases)
+        assert ("QUEUE", "B") in phases and ("QUEUE", "E") in phases, \
+            (name, phases)
+        assert ("ALLREDUCE", "X") in phases, (name, phases)
+        # ordering: negotiate ends before queue ends; exec inside queue
+        seq = [p for p in phases if p[0] in ("NEGOTIATE", "QUEUE")]
+        assert seq.index(("NEGOTIATE", "E")) < seq.index(("QUEUE", "E"))
+    os.unlink(_TL_PATH)
 
     print(f"torch worker process {pr} OK", flush=True)
     return 0
