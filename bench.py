@@ -118,9 +118,11 @@ def main() -> int:
     n_params = sum(int(np.prod(l.shape))
                    for l in jax.tree_util.tree_leaves(params))
     opt = optax.adamw(3e-4, weight_decay=0.01)
+    # --remat uses the model's PER-LAYER checkpointing (the standard TPU
+    # memory lever); whole-loss jax.checkpoint wouldn't reduce the peak.
     run = make_scanned_train_step(
-        lambda p, ids: llama.loss_fn(p, ids, cfg), opt, mesh,
-        remat=args.remat)
+        lambda p, ids: llama.loss_fn(p, ids, cfg, remat=args.remat),
+        opt, mesh)
     params = replicate(params, mesh)
     opt_state = replicate(opt.init(params), mesh)
 

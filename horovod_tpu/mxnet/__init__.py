@@ -1,0 +1,332 @@
+"""MXNet frontend over the TPU data plane.
+
+Mirrors the reference's mxnet binding surface (reference:
+horovod/mxnet/__init__.py:40-182 + mpi_ops.py): eager ``allreduce[_]`` /
+``grouped_allreduce[_]`` / ``allgather`` / ``broadcast[_]`` on NDArrays,
+``DistributedOptimizer`` (wraps an mx optimizer, allreduces grads in
+``update``), ``DistributedTrainer`` (gluon Trainer whose
+``_allreduce_grads`` rides the data plane instead of kvstore), and
+``broadcast_parameters``.
+
+mxnet is imported lazily: topology/introspection APIs work without it;
+tensor ops raise an actionable ImportError when mxnet is absent (the
+frontend is near-EOL upstream, but it is part of the capability surface).
+NDArrays bridge through numpy to the shared XLA path like the torch
+frontend's tensors do.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import runtime as _rt
+from ..common.reduce_op import ReduceOp, Average, Sum
+from ..ops import collectives as _C
+from ..runtime import init, shutdown, is_initialized
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size",
+    "allreduce", "allreduce_", "grouped_allreduce", "grouped_allreduce_",
+    "allgather", "broadcast", "broadcast_", "alltoall",
+    "DistributedOptimizer", "DistributedTrainer", "broadcast_parameters",
+]
+
+
+def rank() -> int:
+    return _rt.get().rank()
+
+
+def size() -> int:
+    return _rt.get().size()
+
+
+def local_rank() -> int:
+    return _rt.get().local_rank()
+
+
+def local_size() -> int:
+    return _rt.get().local_size()
+
+
+def cross_rank() -> int:
+    return _rt.get().cross_rank()
+
+
+def cross_size() -> int:
+    return _rt.get().cross_size()
+
+
+def _mx():
+    try:
+        import mxnet
+        return mxnet
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.mxnet tensor ops require the mxnet package "
+            "(reference frontend horovod/mxnet); install mxnet or use the "
+            "torch/tensorflow/jax frontends") from e
+
+
+def _np_from_nd(t) -> np.ndarray:
+    return _C.process_local(t.asnumpy())
+
+
+# --------------------------------------------------------------------- ops
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op: ReduceOp = Average,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """(reference: mxnet/mpi_ops.py allreduce)"""
+    mx = _mx()
+    if average is not None:
+        op = ReduceOp.AVERAGE if average else ReduceOp.SUM
+    out = np.asarray(_C.allreduce(_np_from_nd(tensor), op=op, name=name,
+                                  prescale_factor=prescale_factor,
+                                  postscale_factor=postscale_factor))
+    return mx.nd.array(out, dtype=tensor.dtype)
+
+
+def allreduce_(tensor, average: Optional[bool] = None,
+               name: Optional[str] = None, op: ReduceOp = Average,
+               priority: int = 0,
+               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """In-place allreduce (reference: mxnet allreduce_)."""
+    _mx()
+    if average is not None:
+        op = ReduceOp.AVERAGE if average else ReduceOp.SUM
+    out = np.asarray(_C.allreduce(_np_from_nd(tensor), op=op, name=name,
+                                  prescale_factor=prescale_factor,
+                                  postscale_factor=postscale_factor))
+    tensor[:] = out
+    return tensor
+
+
+def grouped_allreduce(tensors, average: Optional[bool] = None,
+                      name: Optional[str] = None, op: ReduceOp = Average,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    mx = _mx()
+    if average is not None:
+        op = ReduceOp.AVERAGE if average else ReduceOp.SUM
+    outs = _C.grouped_allreduce([_np_from_nd(t) for t in tensors],
+                                op=op, name=name,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor)
+    return [mx.nd.array(np.asarray(o), dtype=t.dtype)
+            for o, t in zip(outs, tensors)]
+
+
+def grouped_allreduce_(tensors, average: Optional[bool] = None,
+                       name: Optional[str] = None, op: ReduceOp = Average,
+                       priority: int = 0,
+                       prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0):
+    _mx()
+    if average is not None:
+        op = ReduceOp.AVERAGE if average else ReduceOp.SUM
+    outs = _C.grouped_allreduce([_np_from_nd(t) for t in tensors],
+                                op=op, name=name,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor)
+    for t, o in zip(tensors, outs):
+        t[:] = np.asarray(o)
+    return tensors
+
+
+def allgather(tensor, name: Optional[str] = None):
+    mx = _mx()
+    out = np.asarray(_C.allgather(_np_from_nd(tensor), name=name))
+    return mx.nd.array(out, dtype=tensor.dtype)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    mx = _mx()
+    out = np.asarray(_C.broadcast(_np_from_nd(tensor), root_rank=root_rank,
+                                  name=name))
+    return mx.nd.array(out, dtype=tensor.dtype)
+
+
+def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None):
+    _mx()
+    out = np.asarray(_C.broadcast(_np_from_nd(tensor), root_rank=root_rank,
+                                  name=name))
+    tensor[:] = out
+    return tensor
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    """No-splits calls return the bare output; with splits, the
+    (output, received_splits) pair — matching the reference binding and
+    the sibling torch frontend."""
+    mx = _mx()
+    out, recv = _C.alltoall(_np_from_nd(tensor),
+                            splits=None if splits is None
+                            else np.asarray(splits), name=name)
+    out_nd = mx.nd.array(np.asarray(out), dtype=tensor.dtype)
+    if splits is None:
+        return out_nd
+    return out_nd, mx.nd.array(np.asarray(recv), dtype="int32")
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         prefix: Optional[str] = None) -> None:
+    """Broadcast a gluon ParameterDict / dict of NDArrays from root
+    (reference: mxnet/__init__.py:191-207).  ``prefix`` disambiguates
+    names across multiple calls.  Deferred-init parameters get their
+    broadcast hooked to run right after initialization (reference wraps
+    _init_impl for the same reason)."""
+    mx = _mx()
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        raise ValueError("invalid params of type: %s" % type(params))
+    prefix = prefix or ""
+    try:
+        deferred_error = mx.gluon.parameter.DeferredInitializationError
+    except AttributeError:  # very old/new mxnet layouts
+        deferred_error = ()
+    for name, p in items:
+        full = prefix + str(name)
+        if hasattr(p, "data"):
+            try:
+                nd = p.data()
+            except deferred_error:
+                # shape not inferred yet: broadcast right after init
+                def _hooked(self, init, ctx, default_init, data,
+                            _full=full, _orig=type(p)._init_impl):
+                    _orig(self, init, ctx, default_init, data)
+                    broadcast_(self.data(), root_rank=root_rank, name=_full)
+                p._init_impl = _hooked.__get__(p, type(p))
+                continue
+        else:
+            nd = p
+        broadcast_(nd, root_rank=root_rank, name=full)
+
+
+# ---------------------------------------------------------------- optimizer
+def DistributedOptimizer(optimizer, gradient_predivide_factor: float = 1.0,
+                         num_groups: int = 0):
+    """Wrap an mx.optimizer.Optimizer: every update allreduces the grads
+    first (reference: mxnet/__init__.py:40-93).  SUM on the wire with
+    rescale_grad normalized by size() — the reference's exact trick.
+
+    Returns an ``mx.optimizer.Optimizer`` SUBCLASS instance (built lazily
+    so the module imports without mxnet): gluon Trainer and Module
+    isinstance-check the optimizer and would otherwise reject it."""
+    mx = _mx()
+
+    class _DistributedOptimizer(mx.optimizer.Optimizer):
+        def __init__(self):
+            self._optimizer = optimizer
+            self._optimizer.rescale_grad *= \
+                gradient_predivide_factor / size()
+            self._gradient_predivide_factor = gradient_predivide_factor
+            self._num_groups = num_groups
+
+        def __getattr__(self, item):
+            return getattr(self._optimizer, item)
+
+        def create_state_multi_precision(self, index, weight):
+            return self._optimizer.create_state_multi_precision(index,
+                                                                weight)
+
+        def _do_allreduce(self, index, grad):
+            if size() == 1:
+                return
+            pre = 1.0 / self._gradient_predivide_factor
+            if isinstance(index, (tuple, list)):
+                if self._num_groups > 0:
+                    n = max(1, -(-len(grad) // self._num_groups))
+                    for i in range(0, len(grad), n):
+                        grouped_allreduce_(
+                            grad[i:i + n], average=False,
+                            name=f"{index[i]}:"
+                                 f"{index[min(i + n, len(index)) - 1]}",
+                            prescale_factor=pre)
+                else:
+                    for i, idx in enumerate(index):
+                        allreduce_(grad[i], average=False, name=str(idx),
+                                   prescale_factor=pre)
+            else:
+                allreduce_(grad, average=False, name=str(index),
+                           prescale_factor=pre)
+
+        def update(self, index, weight, grad, state):
+            self._do_allreduce(index, grad)
+            self._optimizer.update(index, weight, grad, state)
+
+        def update_multi_precision(self, index, weight, grad, state):
+            self._do_allreduce(index, grad)
+            self._optimizer.update_multi_precision(index, weight, grad,
+                                                   state)
+
+        def set_learning_rate(self, lr):
+            self._optimizer.set_learning_rate(lr)
+
+        def set_lr_mult(self, args_lr_mult):
+            self._optimizer.set_lr_mult(args_lr_mult)
+
+        def set_wd_mult(self, args_wd_mult):
+            self._optimizer.set_wd_mult(args_wd_mult)
+
+    return _DistributedOptimizer()
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None,
+                       gradient_predivide_factor: float = 1.0,
+                       prefix: Optional[str] = None,
+                       num_groups: int = 0):
+    """gluon Trainer whose gradient reduction rides the data plane
+    (reference: mxnet/__init__.py:102-182).  Returns an instance of a
+    dynamically created mx.gluon.Trainer subclass (created lazily so this
+    module imports without mxnet)."""
+    mx = _mx()
+
+    class _DistributedTrainer(mx.gluon.Trainer):
+        def __init__(self):
+            opt = optimizer
+            if isinstance(opt, DistributedOptimizer):
+                import warnings
+                warnings.warn("DistributedTrainer does not take "
+                              "DistributedOptimizer; unwrapped it for you")
+                opt = opt._optimizer
+            prm = params
+            if isinstance(prm, dict):
+                prm = OrderedDict(prm)
+            elif isinstance(prm, (list, tuple)):
+                prm = sorted(prm)
+            super().__init__(prm, opt, optimizer_params=optimizer_params,
+                             kvstore=None)
+            # average via rescale normalization (reference trick)
+            self._scale *= gradient_predivide_factor / size()
+            self._gradient_predivide_factor = gradient_predivide_factor
+            self._prefix = prefix or ""
+            self._num_groups = num_groups
+
+        def _allreduce_grads(self):
+            if size() == 1:
+                return
+            pre = 1.0 / self._gradient_predivide_factor
+            grads, names = [], []
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    grads.append(param.list_grad()[0])
+                    names.append(self._prefix + str(i))
+            if not grads:
+                return
+            if self._num_groups > 0:
+                n = max(1, -(-len(grads) // self._num_groups))
+                for i in range(0, len(grads), n):
+                    grouped_allreduce_(
+                        grads[i:i + n], average=False,
+                        name=f"{names[i]}:{names[min(i+n, len(names))-1]}",
+                        prescale_factor=pre)
+            else:
+                for g, nm in zip(grads, names):
+                    allreduce_(g, average=False, name=nm,
+                               prescale_factor=pre)
+
+    return _DistributedTrainer()

@@ -1,0 +1,70 @@
+"""MXNet frontend: full op coverage when mxnet is installed, gating
+behavior when it is not (reference: test/parallel/test_mxnet.py)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu.mxnet as hmx
+
+
+def test_topology_without_mxnet(hvd):
+    # topology APIs never need mxnet
+    assert hmx.size() == 8
+    assert hmx.local_size() == 8
+
+
+def _have_mxnet():
+    try:
+        import mxnet  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+@pytest.mark.skipif(_have_mxnet(), reason="mxnet installed; gate not hit")
+def test_ops_raise_actionable_importerror(hvd):
+    with pytest.raises(ImportError, match="mxnet"):
+        hmx.allreduce(np.ones(3))
+    with pytest.raises(ImportError, match="mxnet"):
+        hmx.DistributedOptimizer(object())
+
+
+@pytest.mark.skipif(not _have_mxnet(), reason="mxnet not installed")
+class TestWithMXNet:
+    def test_allreduce_sum_average(self, hvd):
+        import mxnet as mx
+        t = mx.nd.array([1.0, 2.0])
+        out = hmx.allreduce(t, op=hmx.Sum)
+        np.testing.assert_allclose(out.asnumpy(), [8.0, 16.0])
+        out = hmx.allreduce(t, average=True)
+        np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])
+
+    def test_inplace_and_grouped(self, hvd):
+        import mxnet as mx
+        t = mx.nd.array([2.0])
+        hmx.allreduce_(t, average=True)
+        np.testing.assert_allclose(t.asnumpy(), [2.0])
+        ts = [mx.nd.array([float(i)]) for i in range(3)]
+        hmx.grouped_allreduce_(ts, average=False)
+        for i, t in enumerate(ts):
+            np.testing.assert_allclose(t.asnumpy(), [8.0 * i])
+
+    def test_broadcast_and_allgather(self, hvd):
+        import mxnet as mx
+        t = mx.nd.array([[5.0]])
+        np.testing.assert_allclose(
+            hmx.broadcast(t, root_rank=2).asnumpy(), [[5.0]])
+        g = hmx.allgather(mx.nd.array([[1.0, 2.0]]))
+        assert g.shape == (8, 2)
+
+    def test_distributed_trainer_step(self, hvd):
+        import mxnet as mx
+        net = mx.gluon.nn.Dense(1)
+        net.initialize()
+        x = mx.nd.random.normal(shape=(4, 3))
+        with mx.autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        trainer = hmx.DistributedTrainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.1})
+        trainer.step(4)
