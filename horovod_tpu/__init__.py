@@ -41,6 +41,9 @@ from .optimizer import (DistributedOptimizer, distributed_optimizer,
                         sync_gradients, distributed_grad)
 from .functions import (broadcast_parameters, broadcast_optimizer_state,
                         broadcast_object, allgather_object)
+from .checkpoint import (CheckpointManager, save_checkpoint,
+                         restore_checkpoint)
+from .ops.flash_attention import flash_attention
 
 
 # ---------------------------------------------------------------- topology API
@@ -163,5 +166,7 @@ __all__ = [
     "tpu_built", "xla_built", "mpi_built", "nccl_built", "gloo_built",
     "ccl_built", "mpi_enabled", "mpi_threads_supported",
     "start_timeline", "stop_timeline",
+    "CheckpointManager", "save_checkpoint", "restore_checkpoint",
+    "flash_attention",
     "__version__",
 ]

@@ -97,8 +97,16 @@ class JaxState(State):
     PYTREE_FIELDS = ("params", "opt_state")
 
     def __init__(self, params: Any = None, opt_state: Any = None,
-                 commit_path: Optional[str] = None, **scalars: Any):
+                 commit_path: Optional[str] = None,
+                 sharded_commit_dir: Optional[str] = None,
+                 **scalars: Any):
         self.commit_path = commit_path
+        # Orbax-backed sharded commits: every host writes ITS HBM shards in
+        # parallel instead of pickling a full host copy (the scalable path
+        # SURVEY §5 calls for; commit_path's pickle stays for tiny states).
+        self.sharded_commit_dir = sharded_commit_dir
+        self._ckpt_mgr = None
+        self._commit_step = 0
         super().__init__(params=params, opt_state=opt_state, **scalars)
 
     def sync(self) -> None:
@@ -116,7 +124,24 @@ class JaxState(State):
                 setattr(self, k, v)
         self.save()
 
+    def _manager(self):
+        if self._ckpt_mgr is None:
+            from ..checkpoint import CheckpointManager
+            self._ckpt_mgr = CheckpointManager(self.sharded_commit_dir,
+                                               max_to_keep=2)
+        return self._ckpt_mgr
+
     def on_commit(self) -> None:
+        if self.sharded_commit_dir:
+            scalars = {f: getattr(self, f) for f in self._fields
+                       if f not in ("params", "opt_state")}
+            mgr = self._manager()
+            mgr.save(self._commit_step, params=self.params,
+                     opt_state=self.opt_state, meta=scalars, force=True)
+            # commit() promises durability: a preemption right after this
+            # call must restore THIS step, so flush the async writers.
+            mgr.wait()
+            self._commit_step += 1
         if self.commit_path:
             tmp = self.commit_path + ".tmp"
             with open(tmp, "wb") as f:
@@ -128,7 +153,24 @@ class JaxState(State):
 
     def load_from_disk(self) -> bool:
         """Restore a commit written by a previous incarnation of this
-        process (TPU slice restart path)."""
+        process (TPU slice restart path).  The sharded orbax commit wins
+        when both stores exist; the current params/opt_state act as the
+        restore templates (shapes + shardings)."""
+        if self.sharded_commit_dir:
+            mgr = self._manager()
+            step = mgr.latest_step()
+            if step is not None:
+                out = mgr.restore(step, params=self.params,
+                                  opt_state=self.opt_state)
+                if "params" in out:
+                    self.params = out["params"]
+                if "opt_state" in out:
+                    self.opt_state = out["opt_state"]
+                for k, v in (out.get("meta") or {}).items():
+                    setattr(self, k, v)
+                self._commit_step = step + 1
+                self.save()
+                return True
         if not (self.commit_path and os.path.exists(self.commit_path)):
             return False
         with open(self.commit_path, "rb") as f:

@@ -1,0 +1,160 @@
+"""Pallas flash attention for TPU — the framework's hot-op kernel.
+
+The reference ships CUDA kernels for its hot paths (reference:
+horovod/common/ops/cuda/cuda_kernels.cu — batched memcpy + scale); this
+framework's hot op is model attention, so the native kernel is a
+blockwise online-softmax attention (flash attention) written in Pallas
+for the MXU:
+
+  * grid over (batch, q-head, q-block); K/V stream through VMEM in
+    blocks with running (max, sum, accumulator) state — no [S, S] score
+    matrix ever materializes in HBM;
+  * fp32 accumulation regardless of input dtype (bf16 in, bf16 out);
+  * causal masking skips fully-masked K blocks; GQA maps q-heads onto
+    shared KV heads via the BlockSpec index map;
+  * same signature as layers.causal_attention ([B, S, H, D], GQA by
+    head-count ratio) so models swap it in via ``attn_fn``.
+
+Off-TPU (tests, CPU smoke) the kernel runs in Pallas interpret mode —
+same code path, numerics checked against the XLA reference
+implementation.  Ring attention (parallel/sequence.py) composes with it:
+each ring step's local block attention can use this kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
+                 block_k: int, seq_len: int, scale: float):
+    # q_ref: [BQ, D]; k_ref/v_ref: [S, D]; o_ref: [BQ, D]
+    qi = pl.program_id(2)
+    bq = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    m = jnp.full((bq, 1), NEG_INF, jnp.float32)       # running max
+    l = jnp.zeros((bq, 1), jnp.float32)               # running sum
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    q_start = qi * bq
+    num_kb = pl.cdiv(seq_len, block_k)
+    # causal: K blocks strictly after this q block contribute nothing
+    kb_hi = jnp.minimum(num_kb,
+                        pl.cdiv(q_start + bq, block_k)) if causal else num_kb
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_start = kb * block_k
+        k = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, kb_hi, body, (m, l, acc))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _reference_attention(q, k, v, causal):
+    """XLA attention (same math) — the backward rule recomputes through
+    this, so training gets the Pallas forward + a compiler-derived
+    backward without a hand-written bwd kernel."""
+    from ..models import layers as L
+    return L.causal_attention(q, k, v, causal=causal)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    block_q: int = 256, block_k: int = 256,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Blockwise attention, model layout [B, S, H, D] with GQA.
+
+    ``interpret=None`` auto-selects: compiled on TPU backends, Pallas
+    interpreter elsewhere (numerics-identical, for tests/CPU smoke)."""
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = True,
+                   block_q: int = 256, block_k: int = 256,
+                   interpret: Optional[bool] = None) -> jax.Array:
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, S, H, D = q.shape
+    HK = k.shape[2]
+    if H % HK:
+        raise ValueError(
+            f"q heads ({H}) must be a multiple of kv heads ({HK}) for GQA")
+    group = H // HK
+
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(f"seq len {S} must divide block sizes "
+                         f"({block_q}, {block_k})")
+
+    # kernel layout [B, H, S, D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    scale = 1.0 / (D ** 0.5)
+
+    kernel = functools.partial(_attn_kernel, causal=causal,
+                               block_k=block_k, seq_len=S, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, S // block_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, D),
+                         lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, S, D),
+                         lambda b, h, i, g=group: (b, h // g, 0, 0)),
+            pl.BlockSpec((None, None, S, D),
+                         lambda b, h, i, g=group: (b, h // g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, D),
+                               lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2)
