@@ -44,6 +44,7 @@ from .functions import (broadcast_parameters, broadcast_optimizer_state,
 from .checkpoint import (CheckpointManager, save_checkpoint,
                          restore_checkpoint)
 from .ops.flash_attention import flash_attention
+from .runner.api import run
 
 
 # ---------------------------------------------------------------- topology API
@@ -167,6 +168,6 @@ __all__ = [
     "ccl_built", "mpi_enabled", "mpi_threads_supported",
     "start_timeline", "stop_timeline",
     "CheckpointManager", "save_checkpoint", "restore_checkpoint",
-    "flash_attention",
+    "flash_attention", "run",
     "__version__",
 ]

@@ -1,0 +1,54 @@
+"""Programmatic launcher: ``horovod_tpu.run(func, np=N)``.
+
+Reference: ``horovod.run`` (horovod/__init__.py -> runner/launch.py:763) —
+run a function on N distributed workers from inside a Python program and
+get the per-rank results back, no CLI involved.
+
+Local (single-host) placement runs through the same task machinery as the
+Spark integration; multi-host programmatic launch goes through ``hvdrun``
+(the reference's multi-host programmatic path also shells out to its
+launcher infrastructure).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+
+def run(func: Callable, args: Sequence[Any] = (),
+        kwargs: Optional[Dict] = None, np: Optional[int] = None,
+        hosts: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        coordinator_port: int = 29515,
+        verbose: bool = False) -> List[Any]:
+    """Run ``func(*args, **kwargs)`` on ``np`` workers; returns one result
+    per rank (reference semantics: horovod.run returns the list of
+    results in rank order).
+
+    ``np`` defaults to the total slots of ``hosts`` (else 1).  ``hosts``
+    other than localhost requires the CLI launcher (`hvdrun`), which
+    handles ssh spawn; programmatic multi-host would need a result
+    channel the HTTP rendezvous doesn't carry yet."""
+    if hosts is not None:
+        from .hosts import parse_hosts
+        infos = parse_hosts(hosts)
+        if not all(h.hostname in ("localhost", "127.0.0.1")
+                   for h in infos):
+            raise NotImplementedError(
+                "programmatic run() supports localhost placement; use "
+                "hvdrun for multi-host jobs (reference: horovodrun CLI)")
+        slots = sum(h.slots for h in infos)
+        if np is None:
+            np = slots
+        elif np > slots:
+            raise ValueError(
+                f"np={np} exceeds the {slots} slots of hosts={hosts!r}")
+    if np is None:
+        np = 1
+    if verbose:
+        print(f"[horovod_tpu.run] launching {np} local worker "
+              f"process(es), coordinator port {coordinator_port}")
+    from ..spark.runner import LocalTaskExecutor, run as _run
+    return _run(func, args=args, kwargs=kwargs or {}, num_proc=np,
+                executor=LocalTaskExecutor(np), env=env,
+                coordinator_port=coordinator_port)

@@ -11,8 +11,9 @@ from .runner import (LocalTaskExecutor, SparkTaskExecutor, TaskExecutor,
                      run)
 from .store import FilesystemStore, LocalStore, Store
 from .estimator import (Estimator, EstimatorModel, KerasEstimator,
-                        LinearEstimator)
+                        LinearEstimator, TorchEstimator)
 
 __all__ = ["run", "TaskExecutor", "LocalTaskExecutor", "SparkTaskExecutor",
            "Store", "FilesystemStore", "LocalStore", "Estimator",
-           "EstimatorModel", "LinearEstimator", "KerasEstimator"]
+           "EstimatorModel", "LinearEstimator", "KerasEstimator",
+           "TorchEstimator"]

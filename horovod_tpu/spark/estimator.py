@@ -117,15 +117,29 @@ def _grad_sync_fn():
     runs skip the bring-up."""
     size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
     if size <= 1:
-        return lambda g: g
+        return lambda gs: gs
     import horovod_tpu as hvd
     from horovod_tpu.ops.collectives import process_local
     hvd.init()
 
-    def sync(g: np.ndarray) -> np.ndarray:
-        return np.asarray(hvd.allreduce(process_local(np.asarray(g)),
-                                        op=hvd.Average), dtype=g.dtype)
+    def sync(gs):
+        """Average a LIST of arrays in one fused grouped collective (one
+        dispatch per batch, not one per parameter)."""
+        outs = hvd.grouped_allreduce(
+            [process_local(np.asarray(g)) for g in gs], op=hvd.Average)
+        return [np.asarray(o, dtype=np.asarray(g).dtype)
+                for o, g in zip(outs, gs)]
     return sync
+
+
+def _assemble_batch(batch, feature_cols, label_cols):
+    """Stack feature columns into a 2-D x and the (first) label column into
+    a 2-D y — the one batch-assembly implementation every train task
+    shares."""
+    x = np.concatenate([batch[c].reshape(len(batch[c]), -1)
+                        for c in feature_cols], axis=1)
+    y = batch[label_cols[0]].reshape(len(x), -1)
+    return x, y
 
 
 class _SGDTrainTask:
@@ -151,19 +165,16 @@ class _SGDTrainTask:
         loader = ParquetDataLoader(train_path, self.batch_size,
                                    rank=rank, num_workers=size)
         first = next(iter(loader))
-        x0 = np.concatenate([first[c].reshape(len(first[c]), -1)
-                             for c in self.feature_cols], axis=1)
-        y0 = first[self.label_cols[0]].reshape(len(x0), -1)
+        x0, y0 = _assemble_batch(first, self.feature_cols, self.label_cols)
         w = np.zeros((x0.shape[1], y0.shape[1]), np.float64)
         b = np.zeros((y0.shape[1],), np.float64)
         for _ in range(self.epochs):
             for batch in loader:
-                x = np.concatenate([batch[c].reshape(len(batch[c]), -1)
-                                    for c in self.feature_cols], axis=1)
-                y = batch[self.label_cols[0]].reshape(len(x), -1)
+                x, y = _assemble_batch(batch, self.feature_cols,
+                                       self.label_cols)
                 pred = x @ w + b
-                gw = sync(x.T @ (pred - y) / len(x))
-                gb = sync((pred - y).mean(axis=0))
+                gw, gb = sync([x.T @ (pred - y) / len(x),
+                               (pred - y).mean(axis=0)])
                 w -= self.lr * gw
                 b -= self.lr * gb
         if rank == 0:
@@ -225,6 +236,96 @@ class KerasEstimator(Estimator):
         return predict
 
 
+class TorchEstimator(Estimator):
+    """Torch estimator (reference: spark/torch/ TorchEstimator): the model
+    is built by a factory, trained per-worker on parquet shards with
+    per-batch gradient averaging over the data plane, checkpointed via
+    state_dict bytes."""
+
+    def __init__(self, store: Store, model_fn: Callable, num_proc: int = 1,
+                 lr: float = 1e-3, **kwargs):
+        super().__init__(store, num_proc=num_proc, **kwargs)
+        self.model_fn = model_fn
+        self.lr = lr
+
+    def _make_train_task(self) -> Callable:
+        return _TorchTrainTask(self.store, self.run_id, self.model_fn,
+                               self.feature_cols, self.label_cols,
+                               self.batch_size, self.epochs, self.lr)
+
+    def _load_model(self, payload: bytes) -> Callable:
+        import io
+        import torch
+        model = self.model_fn()
+        model.load_state_dict(torch.load(io.BytesIO(payload),
+                                         weights_only=True))
+        model.eval()
+
+        def predict(x: np.ndarray) -> np.ndarray:
+            import torch as _t
+            with _t.no_grad():
+                return model(_t.from_numpy(
+                    np.ascontiguousarray(x, np.float32))).numpy()
+        return predict
+
+
+class _TorchTrainTask:
+    def __init__(self, store, run_id, model_fn, feature_cols, label_cols,
+                 batch_size, epochs, lr):
+        self.store = store
+        self.run_id = run_id
+        self.model_fn = model_fn
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.lr = lr
+
+    def __call__(self, train_path: str):
+        import io
+        import torch
+        rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
+        size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
+        sync = _grad_sync_fn()
+        loader = ParquetDataLoader(train_path, self.batch_size,
+                                   rank=rank, num_workers=size)
+        model = self.model_fn()
+        # All workers start from identical weights (rank-0 convention):
+        # one fused sync of the initial parameters.
+        if size > 1:
+            avgs = sync([p.detach().numpy() for p in model.parameters()])
+            with torch.no_grad():
+                for p, a in zip(model.parameters(), avgs):
+                    p.copy_(torch.from_numpy(np.ascontiguousarray(a)))
+        opt = torch.optim.SGD(model.parameters(), lr=self.lr)
+        loss_fn = torch.nn.MSELoss()
+        loss = torch.zeros(())
+        for _ in range(self.epochs):
+            for batch in loader:
+                x, y = _assemble_batch(batch, self.feature_cols,
+                                       self.label_cols)
+                xt = torch.from_numpy(np.ascontiguousarray(x, np.float32))
+                yt = torch.from_numpy(np.ascontiguousarray(y, np.float32))
+                opt.zero_grad()
+                loss = loss_fn(model(xt), yt)
+                loss.backward()
+                if size > 1:
+                    # ONE fused grouped collective per batch, not one per
+                    # parameter.
+                    with_grads = [p for p in model.parameters()
+                                  if p.grad is not None]
+                    gs = sync([p.grad.numpy() for p in with_grads])
+                    for p, g in zip(with_grads, gs):
+                        p.grad.copy_(torch.from_numpy(
+                            np.ascontiguousarray(g)))
+                opt.step()
+        if rank == 0:
+            buf = io.BytesIO()
+            torch.save(model.state_dict(), buf)
+            self.store.save_checkpoint(self.run_id, buf.getvalue())
+        return float(loss)
+
+
 class _KerasTrainTask:
     def __init__(self, store, run_id, model_fn, feature_cols, label_cols,
                  batch_size, epochs, lr):
@@ -248,14 +349,13 @@ class _KerasTrainTask:
         model.compile(optimizer=keras.optimizers.SGD(self.lr), loss="mse")
         for _ in range(self.epochs):
             for batch in loader:
-                x = np.concatenate([batch[c].reshape(len(batch[c]), -1)
-                                    for c in self.feature_cols], axis=1)
-                y = batch[self.label_cols[0]].reshape(len(x), -1)
+                x, y = _assemble_batch(batch, self.feature_cols,
+                                       self.label_cols)
                 loss = model.train_on_batch(x, y)
             # per-epoch parameter averaging keeps every worker's model
-            # identical at epoch boundaries
-            model.set_weights([sync(np.asarray(w))
-                               for w in model.get_weights()])
+            # identical at epoch boundaries (one fused collective)
+            model.set_weights(sync([np.asarray(w)
+                                    for w in model.get_weights()]))
         if rank == 0:
             self.store.save_checkpoint(
                 self.run_id, pickle.dumps(model.get_weights()))

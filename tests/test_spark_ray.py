@@ -157,3 +157,39 @@ def test_ray_pool_requires_ray():
     from horovod_tpu.ray import RayWorkerPool
     with pytest.raises(ImportError, match="LocalWorkerPool"):
         RayWorkerPool()
+
+
+def _torch_model_fn():
+    import torch
+    return torch.nn.Linear(4, 1)
+
+
+def test_torch_estimator_end_to_end(tmp_path):
+    rng = np.random.RandomState(3)
+    W = rng.randn(4, 1)
+    x = rng.randn(128, 4).astype(np.float32)
+    y = (x @ W).astype(np.float32)
+    from horovod_tpu.spark import TorchEstimator
+    store = FilesystemStore(str(tmp_path))
+    est = TorchEstimator(store, _torch_model_fn, num_proc=2,
+                         feature_cols=["features"], label_cols=["label"],
+                         batch_size=32, epochs=12, lr=0.2,
+                         executor=LocalTaskExecutor(2))
+    model = est.fit({"features": x, "label": y})
+    pred = model.transform({"features": x})["predict"]
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < 5e-2, mse
+
+
+def _rank_report():
+    import os
+    return int(os.environ["HOROVOD_RANK"])
+
+
+def test_programmatic_run_api():
+    """horovod_tpu.run(func, np=N) — the reference's horovod.run surface."""
+    import horovod_tpu
+    out = horovod_tpu.run(_rank_report, np=3)
+    assert sorted(out) == [0, 1, 2]
+    with pytest.raises(NotImplementedError, match="hvdrun"):
+        horovod_tpu.run(_rank_report, np=2, hosts="remote1:2")
