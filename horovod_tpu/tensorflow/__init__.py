@@ -192,12 +192,15 @@ def broadcast(tensor: tf.Tensor, root_rank: int = 0,
 
 
 def alltoall(tensor: tf.Tensor, splits=None, name: Optional[str] = None):
-    """Returns (output, received_splits) like the reference
-    (reference: tensorflow/__init__.py alltoall)."""
+    """No-splits calls return the bare output; with splits, the
+    (output, received_splits) pair — the reference convention
+    (reference: tensorflow/mpi_ops.py:277-310)."""
     sp = None if splits is None else np.asarray(splits)
     out, recv = _C.alltoall(_np_from_tf(tensor), splits=sp)
-    return (_tf_from_np(out, tensor.dtype),
-            tf.convert_to_tensor(np.asarray(recv), tf.int32))
+    out_t = _tf_from_np(out, tensor.dtype)
+    if splits is None:
+        return out_t
+    return out_t, tf.convert_to_tensor(np.asarray(recv), tf.int32)
 
 
 def reducescatter(tensor: tf.Tensor, op: ReduceOp = Average,

@@ -129,9 +129,14 @@ def test_broadcast():
 
 def test_alltoall():
     t = tf.reshape(tf.range(16, dtype=tf.float32), (16, 1))
-    out, recv = hvd.alltoall(t)
+    # no splits -> bare output (reference: tensorflow/mpi_ops.py:296-303)
+    out = hvd.alltoall(t)
+    assert isinstance(out, tf.Tensor) and out.shape[0] == 16
+    # with splits -> (output, received_splits)
+    splits = tf.constant([2] * 8, tf.int64)
+    out, recv = hvd.alltoall(t, splits=splits)
     assert out.shape[0] == 16
-    assert int(tf.reduce_sum(recv)) >= 8
+    assert int(tf.reduce_sum(recv)) == 16
 
 
 def test_broadcast_variables():
