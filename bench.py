@@ -80,6 +80,11 @@ def main() -> int:
                     choices=["bench", "tiny", "mini", "1b", "8b"])
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize the forward pass (bigger batches)")
+    ap.add_argument("--dim", type=int, default=0,
+                    help="override model width (with --layers/--ffn, scans "
+                         "custom shapes; 0 = use --model's config)")
+    ap.add_argument("--layers", type=int, default=0)
+    ap.add_argument("--ffn", type=int, default=0)
     ap.add_argument("--flash", action="store_true",
                     help="use the pallas flash-attention kernel (forward "
                          "is ~1.3x XLA's, but compiling it inside the "
@@ -110,6 +115,14 @@ def main() -> int:
         ffn_dim=4096, max_seq=max(2048, args.seq),
         dtype=jnp.bfloat16)
     cfg = cfgs[args.model]
+    if args.dim:
+        import dataclasses
+        cfg = dataclasses.replace(
+            cfgs["bench"], dim=args.dim,
+            n_layers=args.layers or 8,
+            n_heads=max(1, args.dim // 64),
+            n_kv_heads=max(1, args.dim // 128),
+            ffn_dim=args.ffn or 4 * args.dim)
     if args.cpu:
         cfg = llama.CONFIGS["tiny"]
         args.batch, args.seq, args.steps = 4, 64, 4

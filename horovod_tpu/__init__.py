@@ -130,7 +130,23 @@ def ccl_built() -> bool:
     return False
 
 
+def ddl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
 def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
     return False
 
 
@@ -165,7 +181,8 @@ __all__ = [
     "Product", "spmd",
     "HorovodInternalError", "HostsUpdatedInterrupt",
     "tpu_built", "xla_built", "mpi_built", "nccl_built", "gloo_built",
-    "ccl_built", "mpi_enabled", "mpi_threads_supported",
+    "ccl_built", "ddl_built", "cuda_built", "rocm_built",
+    "mpi_enabled", "gloo_enabled", "mpi_threads_supported",
     "start_timeline", "stop_timeline",
     "CheckpointManager", "save_checkpoint", "restore_checkpoint",
     "flash_attention", "run",

@@ -37,6 +37,10 @@ import numpy as np
 import tensorflow as tf
 
 from .. import runtime as _rt
+from .. import (tpu_built, xla_built, mpi_built, nccl_built, gloo_built,
+                ccl_built, ddl_built, cuda_built, rocm_built, mpi_enabled,
+                gloo_enabled, mpi_threads_supported,
+                start_timeline, stop_timeline)
 from ..common.reduce_op import (ReduceOp, Average, Sum, Adasum, Min, Max,
                                 Product)
 from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
@@ -56,6 +60,10 @@ __all__ = [
     "broadcast_global_variables", "broadcast_object", "allgather_object",
     "SyncBatchNormalization", "Compression", "ReduceOp", "Average", "Sum",
     "Adasum", "Min", "Max", "Product",
+    "tpu_built", "xla_built", "mpi_built", "nccl_built", "gloo_built",
+    "ccl_built", "ddl_built", "cuda_built", "rocm_built", "mpi_enabled",
+    "gloo_enabled", "mpi_threads_supported",
+    "start_timeline", "stop_timeline",
 ]
 
 

@@ -1,0 +1,342 @@
+"""tf.keras frontend: Horovod's ``horovod.tensorflow.keras`` surface on TPU.
+
+Mirrors the reference binding (reference: horovod/tensorflow/keras/__init__.py
+:52-240): ``DistributedOptimizer`` returns a dynamically created subclass of
+the wrapped tf.keras optimizer's class (so Keras serialization and
+``model.compile`` see a regular optimizer), gradients are synchronized with
+the TF frontend's fused collectives before every apply, and the callback /
+elastic modules complete the training surface.
+
+TPU-native design notes:
+  * Gradient sync dispatches to :func:`horovod_tpu.tensorflow._sync_grads`
+    (one fused grouped allreduce on the XLA data plane; IndexedSlices ride
+    the sparse allgather path).
+  * Inside a ``tf.function`` graph (keras ``fit`` compiles its train step)
+    the sync crosses into the eager data plane through ``tf.py_function`` —
+    the TF-graph analog of the reference's registered C++ allreduce op.
+
+Usage::
+
+    import horovod_tpu.tensorflow.keras as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.01 * hvd.size()))
+    model.compile(optimizer=opt, loss=..., run_eagerly=True)
+    model.fit(..., callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+    ])
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, List, Optional
+
+import numpy as np
+import tensorflow as tf
+
+from .. import (  # noqa: F401  (re-exported topology + op surface)
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, process_rank, process_size, mesh,
+    allreduce, grouped_allreduce, allgather, broadcast, alltoall,
+    reducescatter, broadcast_variables, broadcast_object, allgather_object,
+    SyncBatchNormalization, _sync_grads,
+    ReduceOp, Average, Sum, Adasum, Min, Max, Product,
+    tpu_built, xla_built, mpi_built, nccl_built, gloo_built, ccl_built,
+    ddl_built, cuda_built, rocm_built, mpi_enabled, gloo_enabled,
+    mpi_threads_supported, start_timeline, stop_timeline,
+)
+from ..compression import Compression
+from . import callbacks, elastic  # noqa: F401
+
+
+_wrapped_cache: dict = {}
+
+
+def _make_distributed_class(base_cls):
+    """Build (and cache) a ``Distributed<Optimizer>`` subclass whose
+    ``apply`` synchronizes gradients first (reference:
+    horovod/_keras/__init__.py create_distributed_optimizer — dynamic
+    subclass so Keras treats it as a stock optimizer)."""
+    if base_cls in _wrapped_cache:
+        return _wrapped_cache[base_cls]
+
+    class _DistributedOptimizer(base_cls):
+        _hvd_distributed = True
+
+        def apply(self, grads, trainable_variables=None):
+            grads = list(grads)
+            tvars = list(trainable_variables) if trainable_variables \
+                is not None else None
+            synced = self._hvd_sync(grads, tvars)
+            if synced is None:  # accumulating a local backward pass
+                return
+            return super().apply(synced, trainable_variables)
+
+        # -------------------------------------------------- gradient sync
+        def _hvd_sync(self, grads: List[Any],
+                      tvars: Optional[List[Any]]) -> Optional[List[Any]]:
+            from horovod_tpu import runtime as _rt
+            bpps = getattr(self, "_hvd_backward_passes_per_step", 1)
+            in_graph = not tf.executing_eagerly()
+            if bpps > 1:
+                # Local aggregation runs regardless of world size so a
+                # 1-process debug run trains with the same effective batch
+                # as the distributed run.
+                if in_graph:
+                    raise RuntimeError(
+                        "backward_passes_per_step > 1 requires eager "
+                        "execution (host-side aggregation state); compile "
+                        "with run_eagerly=True or use "
+                        "hvd.DistributedOptimizer(...,"
+                        " backward_passes_per_step=1)")
+                grads = self._hvd_accumulate(grads)
+                if grads is None:
+                    return None
+            if _rt.get().size() == 1:
+                return grads
+            pre, post = self._hvd_scales()
+            if pre != 1.0:
+                grads = [None if g is None else _scale(g, pre)
+                         for g in grads]
+            op = Sum if pre != 1.0 else getattr(self, "_hvd_op", Average)
+            if in_graph:
+                synced = self._hvd_sync_graph(grads, op)
+            else:
+                synced = self._hvd_sync_eager(grads, op, tvars)
+            if post != 1.0:
+                synced = [None if g is None else _scale(g, post)
+                          for g in synced]
+            return synced
+
+        def _hvd_scales(self):
+            """(prescale, postscale) implementing gradient_predivide_factor
+            (reference: tensorflow/__init__.py DistributedOptimizer arg —
+            grads are scaled by 1/f before the sum and f/size after)."""
+            f = getattr(self, "_hvd_predivide", 1.0)
+            if f == 1.0:
+                return 1.0, 1.0
+            from horovod_tpu import runtime as _rt
+            return 1.0 / f, f / _rt.get().size()
+
+        def _hvd_sync_eager(self, grads, op, tvars):
+            comp = getattr(self, "_hvd_compression", Compression.none)
+            sad = getattr(self, "_hvd_sparse_as_dense", False)
+            groups = self._hvd_group_indices(grads, tvars)
+            if groups is None:
+                return _sync_grads(grads, op, comp, sad)
+            out: List[Any] = [None] * len(grads)
+            for idx in groups:
+                sub = _sync_grads([grads[i] for i in idx], op, comp, sad)
+                for i, g in zip(idx, sub):
+                    out[i] = g
+            return out
+
+        def _hvd_sync_graph(self, grads, op):
+            """Synchronize symbolic gradients from inside a ``tf.function``
+            graph: ``tf.py_function`` hops to eager, where the fused
+            grouped allreduce runs on the XLA data plane.  IndexedSlices
+            are densified first (on TPU, XLA densifies embedding grads
+            anyway; the reference's sparse_as_dense knob does the same)."""
+            comp = getattr(self, "_hvd_compression", Compression.none)
+            idx = [i for i, g in enumerate(grads) if g is not None]
+            dense = [tf.convert_to_tensor(grads[i]) for i in idx]
+            if not dense:
+                return grads
+
+            def _eager(*arrs):
+                return _sync_grads(list(arrs), op, comp, False)
+
+            synced = tf.py_function(_eager, dense,
+                                    [g.dtype for g in dense])
+            out = list(grads)
+            for i, s, g in zip(idx, synced, dense):
+                s.set_shape(g.shape)
+                out[i] = s
+            return out
+
+        def _hvd_group_indices(self, grads, tvars):
+            """Resolve the ``groups`` argument to index groups (reference:
+            DistributedOptimizer ``groups`` — int means n fused groups,
+            a list of variable lists pins co-negotiated parameters)."""
+            groups = getattr(self, "_hvd_groups", None)
+            if groups is None:
+                return None
+            if isinstance(groups, int):
+                n = max(1, min(groups, len(grads)))
+                return [list(range(k, len(grads), n)) for k in range(n)]
+            by_id = {}
+            for gi, var_list in enumerate(groups):
+                for v in var_list:
+                    by_id[id(v)] = gi
+            if tvars is None or len(tvars) != len(grads):
+                return None  # cannot map vars -> grads; one fused group
+            out: dict = {}
+            solo = len(groups)
+            for i, v in enumerate(tvars):
+                gi = by_id.get(id(v))
+                if gi is None:
+                    gi, solo = solo, solo + 1
+                out.setdefault(gi, []).append(i)
+            return list(out.values())
+
+        def _hvd_accumulate(self, grads):
+            """Local aggregation over backward_passes_per_step calls —
+            grads SUM across passes; ``average_aggregated_gradients``
+            divides by the pass count (reference:
+            tensorflow/gradient_aggregation.py LocalGradientAggregation)."""
+            acc = getattr(self, "_hvd_acc", None)
+            if acc is None:
+                acc = [None] * len(grads)
+            for i, g in enumerate(grads):
+                if g is None:
+                    continue
+                if isinstance(g, tf.IndexedSlices):
+                    entry = acc[i]
+                    if entry is None:
+                        entry = ("sparse", [], [], g.dense_shape)
+                        acc[i] = entry
+                    entry[1].append(np.asarray(g.values.numpy()))
+                    entry[2].append(np.asarray(g.indices.numpy()))
+                else:
+                    a = np.asarray(g.numpy() if hasattr(g, "numpy") else g)
+                    acc[i] = a if acc[i] is None else acc[i] + a
+            self._hvd_counter = getattr(self, "_hvd_counter", 0) + 1
+            if self._hvd_counter < self._hvd_backward_passes_per_step:
+                self._hvd_acc = acc
+                return None
+            self._hvd_acc, self._hvd_counter = None, 0
+            div = float(self._hvd_backward_passes_per_step) \
+                if getattr(self, "_hvd_average_aggregated", False) else 1.0
+            out: List[Any] = []
+            for a in acc:
+                if a is None:
+                    out.append(None)
+                elif isinstance(a, tuple):
+                    out.append(tf.IndexedSlices(
+                        values=tf.convert_to_tensor(
+                            np.concatenate(a[1]) / div),
+                        indices=tf.convert_to_tensor(np.concatenate(a[2])),
+                        dense_shape=a[3]))
+                else:
+                    out.append(tf.convert_to_tensor(a / div))
+            return out
+
+    _DistributedOptimizer.__name__ = "Distributed" + base_cls.__name__
+    _wrapped_cache[base_cls] = _DistributedOptimizer
+    return _DistributedOptimizer
+
+
+def _scale(g, factor: float):
+    if isinstance(g, tf.IndexedSlices):
+        return tf.IndexedSlices(values=g.values * factor, indices=g.indices,
+                                dense_shape=g.dense_shape)
+    return g * factor
+
+
+def DistributedOptimizer(optimizer,
+                         name: Optional[str] = None,
+                         device_dense: str = "",
+                         device_sparse: str = "",
+                         compression=Compression.none,
+                         sparse_as_dense: bool = False,
+                         gradient_predivide_factor: float = 1.0,
+                         op: ReduceOp = Average,
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = False,
+                         num_groups: int = 0,
+                         groups=None):
+    """Wrap a tf.keras optimizer so every apply sees globally reduced
+    gradients (reference: horovod/tensorflow/keras/__init__.py:52-155).
+
+    ``device_dense``/``device_sparse`` are accepted for signature parity and
+    ignored: placement on TPU is the XLA partitioner's job.
+    """
+    if op not in (Average, Sum):
+        raise ValueError("op currently only supports Average and Sum")
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+    if num_groups != 0:
+        warnings.warn("Parameter `num_groups` has been replaced by `groups`",
+                      DeprecationWarning)
+        if groups is None:
+            groups = num_groups
+    if groups is not None:
+        if not (isinstance(groups, list) or
+                (isinstance(groups, int) and groups >= 0)):
+            raise ValueError("groups should be a non-negative integer or "
+                             "a list of lists of tf.Variable")
+        if groups == 0:
+            groups = None
+
+    cls = _make_distributed_class(optimizer.__class__)
+    cfg = optimizer.get_config()
+    if name:
+        cfg["name"] = name
+    dist = cls.from_config(cfg)
+    dist._hvd_compression = compression
+    dist._hvd_sparse_as_dense = bool(sparse_as_dense)
+    dist._hvd_predivide = float(gradient_predivide_factor)
+    dist._hvd_op = op
+    dist._hvd_backward_passes_per_step = int(backward_passes_per_step)
+    dist._hvd_average_aggregated = bool(average_aggregated_gradients)
+    dist._hvd_groups = groups
+    return dist
+
+
+def broadcast_global_variables(model, root_rank: int = 0) -> None:
+    """Broadcast model + optimizer variables from ``root_rank`` (the
+    tf.keras analog of reference tensorflow/__init__.py:263; the graph
+    collection variant has no TF2 meaning)."""
+    broadcast_variables(model.variables, root_rank=root_rank)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None:
+        broadcast_variables(list(getattr(opt, "variables", []) or []),
+                            root_rank=root_rank)
+
+
+def load_model(filepath: str,
+               custom_optimizers=None,
+               custom_objects: Optional[dict] = None,
+               compression=Compression.none):
+    """Load a tf.keras model, wrapping its optimizer in DistributedOptimizer
+    (reference: horovod/tensorflow/keras/__init__.py:158-196).
+
+    ``custom_optimizers`` (a list of optimizer classes) is merged into
+    ``custom_objects`` for deserialization, matching the reference.
+    """
+    objs = dict(custom_objects or {})
+    for opt_cls in custom_optimizers or []:
+        objs.setdefault(opt_cls.__name__, opt_cls)
+    model = tf.keras.models.load_model(filepath, custom_objects=objs,
+                                       compile=True)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and not getattr(opt, "_hvd_distributed", False):
+        # Swap the deserialized optimizer's class IN PLACE: the Distributed
+        # subclass only adds sync behavior, so the restored iteration count
+        # and slot variables (Adam moments, momenta) survive — rebuilding
+        # from get_config() would silently reset them.
+        opt.__class__ = _make_distributed_class(opt.__class__)
+        opt._hvd_compression = compression
+        opt._hvd_sparse_as_dense = False
+        opt._hvd_predivide = 1.0
+        opt._hvd_op = Average
+        opt._hvd_backward_passes_per_step = 1
+        opt._hvd_average_aggregated = False
+        opt._hvd_groups = None
+    return model
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "mesh",
+    "allreduce", "grouped_allreduce", "allgather", "broadcast", "alltoall",
+    "reducescatter", "broadcast_variables", "broadcast_object",
+    "allgather_object", "broadcast_global_variables",
+    "DistributedOptimizer", "load_model", "SyncBatchNormalization",
+    "Compression", "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max",
+    "Product", "callbacks", "elastic",
+]

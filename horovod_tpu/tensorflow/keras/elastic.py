@@ -1,0 +1,30 @@
+"""Elastic state for the tf.keras frontend (reference:
+horovod/tensorflow/keras/elastic.py: KerasState + Commit/UpdateBatch/
+UpdateEpoch callbacks).
+
+``KerasState`` is the TF frontend's ``TensorFlowKerasState`` with the
+reference's convenience default of picking up ``model.optimizer``; the
+commit/update callbacks are shared with the Keras-3 frontend (they only
+touch the generic State protocol).
+"""
+
+from __future__ import annotations
+
+from ..elastic import TensorFlowKerasState, run  # noqa: F401
+from ...keras.elastic import (  # noqa: F401  (generic State-protocol cbs)
+    CommitStateCallback, UpdateBatchStateCallback, UpdateEpochStateCallback)
+
+
+class KerasState(TensorFlowKerasState):
+    """Elastic state for a tf.keras model: defaults the tracked optimizer
+    to ``model.optimizer`` (reference: tensorflow/keras/elastic.py:22-31).
+    """
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        super().__init__(model,
+                         optimizer or getattr(model, "optimizer", None),
+                         **kwargs)
+
+
+__all__ = ["KerasState", "CommitStateCallback", "UpdateBatchStateCallback",
+           "UpdateEpochStateCallback", "run"]

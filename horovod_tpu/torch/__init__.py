@@ -18,7 +18,11 @@ from __future__ import annotations
 # Topology + lifecycle re-exported from the package root.
 from .. import (init, shutdown, is_initialized, rank, size, local_rank,
                 local_size, cross_rank, cross_size, process_rank,
-                process_size, mesh, is_homogeneous)
+                process_size, mesh, is_homogeneous,
+                tpu_built, xla_built, mpi_built, nccl_built, gloo_built,
+                ccl_built, ddl_built, cuda_built, rocm_built, mpi_enabled,
+                gloo_enabled, mpi_threads_supported,
+                start_timeline, stop_timeline)
 from ..common.reduce_op import ReduceOp, Average, Sum, Adasum, Min, Max, \
     Product
 from ..common.exceptions import (HorovodInternalError,
@@ -53,4 +57,8 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object", "SyncBatchNorm", "elastic",
     "HorovodInternalError", "HostsUpdatedInterrupt",
+    "tpu_built", "xla_built", "mpi_built", "nccl_built", "gloo_built",
+    "ccl_built", "ddl_built", "cuda_built", "rocm_built", "mpi_enabled",
+    "gloo_enabled", "mpi_threads_supported",
+    "start_timeline", "stop_timeline",
 ]

@@ -377,3 +377,194 @@ def test_sparse_allreduce_scaling():
     out = hvd.allreduce(slices, op=hvd.Sum, prescale_factor=0.5,
                         postscale_factor=4.0)
     np.testing.assert_allclose(out.values.numpy(), [[4.0]], rtol=1e-6)
+
+
+# ===================================================================== tf.keras
+# horovod_tpu.tensorflow.keras binding (reference:
+# horovod/tensorflow/keras/__init__.py, callbacks.py, elastic.py)
+
+import horovod_tpu.tensorflow.keras as hvdk  # noqa: E402
+
+
+def _toy_model():
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(4, activation="relu", input_shape=(3,)),
+        tf.keras.layers.Dense(1)])
+    return model
+
+
+def _toy_data(n=64):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 3).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True).astype(np.float32)
+    return x, y
+
+
+def test_keras_distributed_optimizer_is_subclass():
+    opt = hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(0.01))
+    assert isinstance(opt, tf.keras.optimizers.SGD)
+    assert opt._hvd_distributed
+    assert type(opt).__name__ == "DistributedSGD"
+
+
+def test_keras_fit_eager_converges():
+    model = _toy_model()
+    opt = hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+    model.compile(optimizer=opt, loss="mse", run_eagerly=True)
+    x, y = _toy_data()
+    hist = model.fit(x, y, epochs=4, batch_size=16, verbose=0)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+
+def test_keras_fit_graph_mode_py_function_bridge():
+    """model.fit with the default compiled (tf.function) train step must
+    sync through the py_function bridge (jit_compile=False: XLA cannot
+    compile the host hop, same constraint as the reference's custom op)."""
+    model = _toy_model()
+    opt = hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+    model.compile(optimizer=opt, loss="mse", jit_compile=False)
+    x, y = _toy_data()
+    hist = model.fit(x, y, epochs=4, batch_size=16, verbose=0)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+
+def test_keras_gradient_predivide_factor():
+    """predivide: grads scaled 1/f before Sum, f/size after — numerically
+    equal to Average for identical contributions."""
+    v = tf.Variable([0.0])
+    opt = hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                    gradient_predivide_factor=2.0)
+    opt.build([v])
+    opt.apply([tf.constant([2.0])], [v])
+    np.testing.assert_allclose(v.numpy(), [-2.0], rtol=1e-6)
+
+
+def test_keras_predivide_requires_average():
+    with pytest.raises(ValueError, match="predivide"):
+        hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                  gradient_predivide_factor=2.0,
+                                  op=hvdk.Sum)
+
+
+def test_keras_groups_int_matches_ungrouped():
+    vs = [tf.Variable([float(i)]) for i in range(5)]
+    grads = [tf.constant([float(i) + 1.0]) for i in range(5)]
+    o1 = hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(1.0), groups=2)
+    o1.build(vs)
+    o1.apply([tf.identity(g) for g in grads], vs)
+    expect = [float(i) - (float(i) + 1.0) for i in range(5)]
+    for v, e in zip(vs, expect):
+        np.testing.assert_allclose(v.numpy(), [e], rtol=1e-6)
+
+
+def test_keras_groups_variable_lists():
+    vs = [tf.Variable([0.0]) for _ in range(3)]
+    opt = hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                    groups=[[vs[0], vs[2]]])
+    opt.build(vs)
+    opt.apply([tf.constant([1.0]), tf.constant([2.0]), tf.constant([3.0])],
+              vs)
+    for v, e in zip(vs, [-1.0, -2.0, -3.0]):
+        np.testing.assert_allclose(v.numpy(), [e], rtol=1e-6)
+
+
+def test_keras_num_groups_deprecation_maps_to_groups():
+    with pytest.warns(DeprecationWarning):
+        opt = hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                        num_groups=2)
+    assert opt._hvd_groups == 2
+
+
+def test_keras_bpps_sum_vs_average_aggregated():
+    # default: aggregated grads SUM across passes
+    v = tf.Variable([0.0])
+    opt = hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                    backward_passes_per_step=2)
+    opt.build([v])
+    assert opt.apply([tf.constant([1.0])], [v]) is None
+    np.testing.assert_allclose(v.numpy(), [0.0])
+    opt.apply([tf.constant([3.0])], [v])
+    np.testing.assert_allclose(v.numpy(), [-4.0], rtol=1e-6)
+    # average_aggregated_gradients divides by the pass count
+    v2 = tf.Variable([0.0])
+    opt2 = hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                     backward_passes_per_step=2,
+                                     average_aggregated_gradients=True)
+    opt2.build([v2])
+    opt2.apply([tf.constant([1.0])], [v2])
+    opt2.apply([tf.constant([3.0])], [v2])
+    np.testing.assert_allclose(v2.numpy(), [-2.0], rtol=1e-6)
+
+
+def test_keras_broadcast_callback_and_metric_average():
+    model = _toy_model()
+    opt = hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+    model.compile(optimizer=opt, loss="mse", run_eagerly=True)
+    x, y = _toy_data(32)
+    cb = hvdk.callbacks.BroadcastGlobalVariablesCallback(0)
+    hist = model.fit(x, y, epochs=2, batch_size=16, verbose=0,
+                     callbacks=[cb, hvdk.callbacks.MetricAverageCallback()])
+    assert cb.broadcast_done
+    assert np.isfinite(hist.history["loss"][-1])
+
+
+def test_keras_lr_warmup_callback_ramps():
+    model = _toy_model()
+    opt = hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(0.8))
+    model.compile(optimizer=opt, loss="mse", run_eagerly=True)
+    x, y = _toy_data(32)
+    cb = hvdk.callbacks.LearningRateWarmupCallback(initial_lr=0.8,
+                                                   warmup_epochs=3)
+    model.fit(x, y, epochs=2, batch_size=16, verbose=0, callbacks=[cb])
+    lr = float(np.asarray(model.optimizer.learning_rate))
+    assert 0.8 / hvdk.size() <= lr < 0.8  # mid-ramp
+
+
+def test_keras_best_model_checkpoint(tmp_path):
+    model = _toy_model()
+    model.compile(optimizer=hvdk.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.05)), loss="mse", run_eagerly=True)
+    x, y = _toy_data(32)
+    cb = hvdk.callbacks.BestModelCheckpoint(monitor="val_loss",
+                                            save_weights_only=True)
+    path = str(tmp_path / "best.weights.h5")
+    cb.set_filepath(path)
+    model.fit(x, y, epochs=2, batch_size=16, verbose=0,
+              validation_data=(x, y), callbacks=[cb])
+    import os as _os
+    assert _os.path.exists(path)
+
+
+def test_keras_best_model_checkpoint_requires_filepath():
+    cb = hvdk.callbacks.BestModelCheckpoint()
+    with pytest.raises(ValueError, match="filepath"):
+        cb.on_epoch_end(0, {"val_loss": 1.0})
+
+
+def test_keras_elastic_state_defaults_model_optimizer():
+    model = _toy_model()
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.1), loss="mse")
+    model(tf.zeros((1, 3)))
+    state = hvdk.elastic.KerasState(model, batch=0, epoch=0)
+    assert state.optimizer is model.optimizer
+    w0 = [np.copy(w) for w in model.get_weights()]
+    state.commit()
+    model.set_weights([w + 1.0 for w in model.get_weights()])
+    state.restore()
+    for a, b in zip(model.get_weights(), w0):
+        np.testing.assert_allclose(a, b)
+
+
+def test_keras_load_model_wraps_optimizer(tmp_path):
+    model = _toy_model()
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.05), loss="mse")
+    x, y = _toy_data(16)
+    model.fit(x, y, epochs=1, batch_size=16, verbose=0)
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+    loaded = hvdk.load_model(path)
+    assert getattr(loaded.optimizer, "_hvd_distributed", False)
+    assert isinstance(loaded.optimizer, tf.keras.optimizers.SGD)
+    # the restored optimizer STATE must survive the wrap (regression:
+    # rebuilding from get_config() reset iterations + slot variables)
+    assert int(loaded.optimizer.iterations) > 0
