@@ -118,8 +118,8 @@ def main() -> int:
     if args.dim:
         import dataclasses
         cfg = dataclasses.replace(
-            cfgs["bench"], dim=args.dim,
-            n_layers=args.layers or 8,
+            cfg, dim=args.dim,
+            n_layers=args.layers or cfg.n_layers,
             n_heads=max(1, args.dim // 64),
             n_kv_heads=max(1, args.dim // 128),
             ffn_dim=args.ffn or 4 * args.dim)

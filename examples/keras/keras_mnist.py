@@ -1,0 +1,65 @@
+"""Keras-3 (JAX backend) MNIST with `horovod_tpu.keras` (reference:
+examples/keras/keras_mnist.py, re-shaped for Keras 3 on JAX).
+
+Whole-mesh single-controller data parallelism: the model runs under
+`keras.distribution.DataParallel` over the framework mesh, so the batch is
+sharded and XLA inserts the gradient reductions; the DistributedOptimizer
+passes traced gradients through untouched (sync happened inside the
+compiled step).
+
+    python examples/keras/keras_mnist.py --cpu
+"""
+
+import argparse
+import os
+
+
+def make_data(n=4096, classes=10, dim=784, seed=0):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    templates = rng.randn(classes, dim).astype("float32")
+    y = rng.randint(0, classes, n)
+    x = templates[y] + 0.8 * rng.randn(n, dim).astype("float32")
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+            " --xla_force_host_platform_device_count=8"
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+
+    import keras
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+    keras.distribution.set_distribution(hvd.distribution())
+
+    x, y = make_data()
+    model = keras.Sequential([
+        keras.Input((784,)),
+        keras.layers.Dense(256, activation="relu"),
+        keras.layers.Dense(256, activation="relu"),
+        keras.layers.Dense(10),
+    ])
+    opt = hvd.DistributedOptimizer(keras.optimizers.Adam(args.lr))
+    model.compile(
+        optimizer=opt,
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"])
+
+    model.fit(x, y, batch_size=args.batch, epochs=args.epochs,
+              callbacks=[hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                         hvd.callbacks.MetricAverageCallback()],
+              verbose=1 if hvd.rank() == 0 else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Elastic training on a Ray cluster (reference:
+examples/elastic/pytorch/pytorch_synthetic_benchmark_elastic.py +
+horovod/ray/elastic.py usage pattern).
+
+On a real Ray cluster, `ElasticRayExecutor()` discovers hosts from the
+live cluster; here the `--local` flag injects a fixed-hosts discovery so
+the example runs anywhere (the executor machinery is identical).
+
+    python examples/ray/ray_elastic_run.py --local
+"""
+
+import argparse
+import os
+
+
+def train(steps=20):
+    """Runs on every elastic worker; plain jax data-parallel training."""
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu.parallel.data_parallel import (make_train_step,
+                                                    replicate, shard_batch)
+    hvd.init()
+    mesh = hvd.mesh()
+    # Same GLOBAL batch on every process: shard_batch hands each chip its
+    # slice (per-process data would use a process-local loader instead).
+    rng = np.random.RandomState(0)
+    X = rng.randn(32 * hvd.size(), 4).astype(np.float32)
+    Y = X.sum(1, keepdims=True).astype(np.float32)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    params = replicate({"w": jnp.zeros((4, 1))}, mesh)
+    opt = optax.sgd(0.1)
+    state = replicate(opt.init(params), mesh)
+    step = make_train_step(loss_fn, opt, mesh)
+    loss = None
+    for _ in range(steps):
+        batch = (shard_batch(jnp.asarray(X), mesh),
+                 shard_batch(jnp.asarray(Y), mesh))
+        params, state, loss = step(params, state, batch)
+    return float(loss)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--np", type=int, default=2, dest="num_proc")
+    ap.add_argument("--local", action="store_true",
+                    help="fixed localhost hosts instead of ray discovery")
+    args = ap.parse_args()
+
+    from horovod_tpu.ray import ElasticRayExecutor
+    kwargs = {}
+    if args.local:
+        from horovod_tpu.elastic.discovery import FixedHosts
+        from horovod_tpu.runner.hosts import HostInfo
+        kwargs["discovery"] = FixedHosts(
+            [HostInfo("localhost", args.num_proc)])
+    ex = ElasticRayExecutor(min_np=args.num_proc, max_np=args.num_proc,
+                            env={"JAX_PLATFORMS":
+                                 os.environ.get("JAX_PLATFORMS", "cpu")},
+                            **kwargs)
+    ex.start()
+    losses = ex.run(train)
+    print("per-rank final losses:", losses)
+    ex.shutdown()
+
+
+if __name__ == "__main__":
+    main()

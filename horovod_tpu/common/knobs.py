@@ -78,6 +78,9 @@ _knob("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0, int,
 _knob("HOROVOD_LOG_LEVEL", "warning", str,
       "trace|debug|info|warning|error|fatal")
 _knob("HOROVOD_LOG_HIDE_TIME", False, _parse_bool, "Hide timestamps in logs.")
+_knob("HOROVOD_START_TIMEOUT", 300, int,
+      "Seconds a worker waits for the jax.distributed coordinator during "
+      "bring-up before giving up (reference: horovodrun --start-timeout).")
 # --- elastic (reference: elastic/constants.py, driver.py:69-93) ---
 _knob("HOROVOD_ELASTIC_TIMEOUT", 600, int,
       "Seconds to wait for the required number of slots in elastic mode.")

@@ -247,7 +247,9 @@ def run_elastic(args, command: List[str]) -> int:
         raise SystemExit(
             "elastic mode requires --host-discovery-script "
             "(reference: launch.py elastic validation)")
-    discovery = HostDiscoveryScript(args.host_discovery_script)
+    discovery = HostDiscoveryScript(args.host_discovery_script,
+                                    default_slots=getattr(args, "slots",
+                                                          None) or 1)
     min_np = args.min_np or args.num_proc or 1
     max_np = args.max_np or args.num_proc or (1 << 30)
     from ..runner.launch import args_to_env

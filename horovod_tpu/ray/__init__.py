@@ -1,8 +1,9 @@
 """Ray integration (reference: horovod/ray/runner.py:128 RayExecutor,
-strategy.py placement, elastic.py)."""
+strategy.py placement, elastic.py ElasticRayExecutor)."""
 
 from .runner import (BaseWorkerPool, LocalWorkerPool, RayExecutor,
                      RayWorkerPool)
+from .elastic import ElasticRayExecutor, RayHostDiscovery
 
 __all__ = ["RayExecutor", "BaseWorkerPool", "LocalWorkerPool",
-           "RayWorkerPool"]
+           "RayWorkerPool", "ElasticRayExecutor", "RayHostDiscovery"]

@@ -1,0 +1,39 @@
+"""Worker entrypoint for ElasticRayExecutor: fetch the pickled closure
+from the driver's rendezvous KV, run it, and publish this rank's result
+back (reference: ray/elastic.py ships the training function into workers;
+results return through the object store — here the rendezvous KV that
+every elastic worker already dials plays that role, so remote hosts need
+no shared filesystem)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+
+def main() -> int:
+    from ..runner.http_client import get_kv, put_kv
+    from .elastic import PAYLOAD_SCOPE, PAYLOAD_KEY, RESULT_SCOPE
+
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
+    raw = get_kv(addr, port, PAYLOAD_SCOPE, PAYLOAD_KEY)
+    if raw is None:
+        print("elastic_run: no payload at rendezvous", file=sys.stderr)
+        return 1
+    import io
+    buf = io.BytesIO(raw)
+    for p in pickle.load(buf):  # driver's sys.path, see elastic.py
+        if p not in sys.path:
+            sys.path.append(p)
+    fn, args, kwargs = pickle.load(buf)
+    result = fn(*args, **kwargs)
+    rank = os.environ.get("HOROVOD_RANK", "0")
+    put_kv(addr, port, RESULT_SCOPE, f"rank.{rank}",
+           pickle.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,6 +67,7 @@ class RendezvousServer:
         self._port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._final_kv: dict = {}
 
     def start(self) -> int:
         self._httpd = ThreadingHTTPServer((self._host, self._port),
@@ -91,12 +92,26 @@ class RendezvousServer:
             self._httpd.kv.setdefault(scope, {})[key] = value  # type: ignore
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
-        assert self._httpd is not None
+        if self._httpd is None:
+            # Server-side reads stay valid after stop(): the store is
+            # retained so drivers can harvest worker-published state
+            # (e.g. elastic per-rank results) during teardown.
+            return self._final_kv.get(scope, {}).get(key)
         with self._httpd.kv_lock:  # type: ignore[attr-defined]
             return self._httpd.kv.get(scope, {}).get(key)  # type: ignore
 
+    def clear_scope(self, scope: str) -> None:
+        """Drop every key in a scope (round-scoped state like elastic
+        worker results)."""
+        assert self._httpd is not None
+        with self._httpd.kv_lock:  # type: ignore[attr-defined]
+            self._httpd.kv.pop(scope, None)  # type: ignore[attr-defined]
+
     def stop(self) -> None:
         if self._httpd is not None:
+            with self._httpd.kv_lock:  # type: ignore[attr-defined]
+                self._final_kv = {s: dict(d) for s, d
+                                  in self._httpd.kv.items()}  # type: ignore
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None

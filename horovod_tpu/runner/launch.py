@@ -52,10 +52,37 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--ssh-identity-file", default=None)
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--version", action="store_true")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="print the build capability summary and exit "
+                        "(reference: launch.py check_build)")
+    p.add_argument("--start-timeout", type=int, default=None,
+                   help="seconds to wait for all workers to start")
+    p.add_argument("--network-interface", default=None,
+                   help="network interface whose address workers should use "
+                        "to reach the coordinator (e.g. ens3)")
+    p.add_argument("--output-filename", default=None,
+                   help="redirect each worker's stdout/stderr to "
+                        "<dir>/rank.<N>/stdout|stderr")
     # --- tunables -> env knobs (reference: config_parser.py:1-202) ---
     p.add_argument("--fusion-threshold-mb", type=int, default=None)
     p.add_argument("--cycle-time-ms", type=float, default=None)
     p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--disable-cache", action="store_true",
+                   help="disable the response/bucket-plan cache "
+                        "(HOROVOD_CACHE_CAPACITY=0)")
+    hier_ar = p.add_mutually_exclusive_group()
+    hier_ar.add_argument("--hierarchical-allreduce", action="store_true",
+                         default=None)
+    hier_ar.add_argument("--no-hierarchical-allreduce", dest="hierarchical_allreduce",
+                         action="store_false")
+    hier_ag = p.add_mutually_exclusive_group()
+    hier_ag.add_argument("--hierarchical-allgather", action="store_true",
+                         default=None)
+    hier_ag.add_argument("--no-hierarchical-allgather", dest="hierarchical_allgather",
+                         action="store_false")
+    p.add_argument("--num-streams", "--num-nccl-streams", dest="num_streams",
+                   type=int, default=None,
+                   help="eager dispatch parallelism (HOROVOD_NUM_STREAMS)")
     p.add_argument("--mesh", default=None,
                    help="mesh spec, e.g. 'data=8' or 'data=4,model=2'")
     p.add_argument("--timeline-filename", default=None)
@@ -68,6 +95,11 @@ def make_parser() -> argparse.ArgumentParser:
                             "fatal"])
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--autotune-warmup-samples", type=int, default=None)
+    p.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    p.add_argument("--autotune-bayes-opt-max-samples", type=int, default=None)
+    p.add_argument("--autotune-gaussian-process-noise", type=float,
+                   default=None)
     p.add_argument("--config-file", default=None,
                    help="YAML config (reference schema: params/autotune/"
                         "timeline/stall-check sections)")
@@ -75,6 +107,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
     p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--slots", "--slots-per-host", dest="slots", type=int,
+                   default=None,
+                   help="default slots per discovered host when the "
+                        "discovery script omits ':slots'")
     p.add_argument("--elastic-timeout", type=int, default=None)
     p.add_argument("--reset-limit", type=int, default=None)
     # --- ports ---
@@ -137,6 +173,18 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
     if args.cache_capacity is not None:
         env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.disable_cache:
+        env["HOROVOD_CACHE_CAPACITY"] = "0"
+    if args.hierarchical_allreduce is not None:
+        env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = \
+            "1" if args.hierarchical_allreduce else "0"
+    if args.hierarchical_allgather is not None:
+        env["HOROVOD_HIERARCHICAL_ALLGATHER"] = \
+            "1" if args.hierarchical_allgather else "0"
+    if args.num_streams is not None:
+        env["HOROVOD_NUM_STREAMS"] = str(args.num_streams)
+    if args.start_timeout is not None:
+        env["HOROVOD_START_TIMEOUT"] = str(args.start_timeout)
     if args.mesh:
         env["HOROVOD_TPU_MESH"] = args.mesh
     if args.timeline_filename:
@@ -157,11 +205,81 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_AUTOTUNE"] = "1"
     if args.autotune_log_file:
         env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.autotune_warmup_samples is not None:
+        env["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = \
+            str(args.autotune_warmup_samples)
+    if args.autotune_steps_per_sample is not None:
+        env["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = \
+            str(args.autotune_steps_per_sample)
+    if args.autotune_bayes_opt_max_samples is not None:
+        env["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = \
+            str(args.autotune_bayes_opt_max_samples)
+    if args.autotune_gaussian_process_noise is not None:
+        env["HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"] = \
+            str(args.autotune_gaussian_process_noise)
     if args.elastic_timeout is not None:
         env["HOROVOD_ELASTIC_TIMEOUT"] = str(args.elastic_timeout)
     if args.reset_limit is not None:
         env["HOROVOD_ELASTIC_RESET_LIMIT"] = str(args.reset_limit)
     return env
+
+
+def check_build() -> str:
+    """Capability summary (reference: launch.py check_build / horovodrun
+    --check-build prints frameworks + controllers + tensor ops built in).
+    Framework rows probe importability in THIS environment; the data plane
+    rows describe the single XLA path."""
+    from .. import __version__
+
+    def probe(mod: str) -> bool:
+        import importlib.util
+        try:
+            return importlib.util.find_spec(mod) is not None
+        except (ImportError, ModuleNotFoundError, ValueError):
+            return False
+
+    def mark(flag: bool) -> str:
+        return "[X]" if flag else "[ ]"
+
+    lines = [
+        f"horovod_tpu v{__version__}:", "",
+        "Available Frameworks:",
+        f"    {mark(probe('jax'))} JAX",
+        f"    {mark(probe('tensorflow'))} TensorFlow",
+        f"    {mark(probe('torch'))} PyTorch",
+        f"    {mark(probe('keras'))} Keras",
+        f"    {mark(probe('mxnet'))} MXNet", "",
+        "Available Controllers:",
+        "    [X] TCP (native C++ coordination core)",
+        "    [ ] MPI",
+        "    [ ] Gloo", "",
+        "Available Tensor Operations:",
+        "    [X] XLA collectives (ICI/DCN)",
+        "    [X] Hierarchical two-level (dcn.X/ici.X mesh)",
+        "    [X] Adasum (recursive halving over ppermute)",
+        "    [ ] NCCL",
+        "    [ ] DDL",
+        "    [ ] CCL",
+        "    [ ] MPI",
+        "    [ ] Gloo",
+    ]
+    return "\n".join(lines)
+
+
+def interface_address(ifname: str) -> str:
+    """IPv4 address of a network interface (reference: --network-interface
+    pins gloo/NCCL traffic to specific NICs; here it pins the rendezvous +
+    coordinator address workers dial)."""
+    import fcntl
+    import struct
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        # SIOCGIFADDR
+        packed = fcntl.ioctl(s.fileno(), 0x8915,
+                             struct.pack("256s", ifname[:15].encode()))
+        return socket.inet_ntoa(packed[20:24])
+    finally:
+        s.close()
 
 
 def resolve_hosts(args: argparse.Namespace) -> List[hosts_mod.HostInfo]:
@@ -218,6 +336,9 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
     coord_host = slots[0].hostname
     if _is_local(coord_host):
         coord_host = "127.0.0.1"
+    if args.network_interface:
+        # Workers must dial the coordinator over this NIC's address.
+        coord_host = interface_address(args.network_interface)
     knob_env = args_to_env(args)
 
     procs: List[subprocess.Popen] = []
@@ -241,6 +362,17 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
         if args.verbose:
             print(f"[hvdrun] rank {slot.rank} on {slot.hostname}: "
                   f"{' '.join(cmd)}", file=sys.stderr)
+        if args.output_filename:
+            # Per-rank stream capture (reference: --output-filename writes
+            # <dir>/rank.<N>/stdout|stderr).  ssh forwards remote streams,
+            # so driver-side redirection covers both paths.
+            d = os.path.join(args.output_filename, f"rank.{slot.rank}")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "stdout"), "wb") as out, \
+                    open(os.path.join(d, "stderr"), "wb") as err:
+                # the child holds its own dups; drop the parent's handles
+                return subprocess.Popen(cmd, env=env, stdout=out,
+                                        stderr=err)
         return subprocess.Popen(cmd, env=env)
 
     try:
@@ -273,6 +405,9 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     if args.version:
         from .. import __version__
         print(__version__)
+        return 0
+    if args.check_build:
+        print(check_build())
         return 0
     command = args.command
     if command and command[0] == "--":

@@ -86,6 +86,8 @@ class Runtime:
                     coordinator_address=coord,
                     num_processes=self.knobs["HOROVOD_SIZE"],
                     process_id=max(self.knobs["HOROVOD_RANK"], 0),
+                    initialization_timeout=self.knobs[
+                        "HOROVOD_START_TIMEOUT"],
                 )
             except RuntimeError as e:
                 # Already initialized (e.g. by user code) is fine.

@@ -332,11 +332,16 @@ def load_model(filepath: str,
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
-    "local_size", "cross_rank", "cross_size", "mesh",
+    "local_size", "cross_rank", "cross_size", "process_rank",
+    "process_size", "mesh",
     "allreduce", "grouped_allreduce", "allgather", "broadcast", "alltoall",
     "reducescatter", "broadcast_variables", "broadcast_object",
     "allgather_object", "broadcast_global_variables",
     "DistributedOptimizer", "load_model", "SyncBatchNormalization",
     "Compression", "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max",
     "Product", "callbacks", "elastic",
+    "tpu_built", "xla_built", "mpi_built", "nccl_built", "gloo_built",
+    "ccl_built", "ddl_built", "cuda_built", "rocm_built", "mpi_enabled",
+    "gloo_enabled", "mpi_threads_supported",
+    "start_timeline", "stop_timeline",
 ]

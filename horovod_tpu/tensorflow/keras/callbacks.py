@@ -28,17 +28,12 @@ class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
         self.root_rank = root_rank
         self.broadcast_done = False
 
-    def _broadcast(self):
-        broadcast_variables(self.model.variables, root_rank=self.root_rank)
-        opt = getattr(self.model, "optimizer", None)
-        if opt is not None:
-            broadcast_variables(list(getattr(opt, "variables", []) or []),
-                                root_rank=self.root_rank)
-        self.broadcast_done = True
-
     def on_train_batch_end(self, batch, logs=None):
         if not self.broadcast_done:
-            self._broadcast()
+            from . import broadcast_global_variables
+            broadcast_global_variables(self.model,
+                                       root_rank=self.root_rank)
+            self.broadcast_done = True
 
 
 class BestModelCheckpoint(tf.keras.callbacks.ModelCheckpoint):

@@ -216,3 +216,63 @@ def test_launch_static_propagates_failure(tmp_path):
         ["-np", "2", sys.executable, str(script)])
     rc = launch_static(args, [sys.executable, str(script)])
     assert rc == 3
+
+
+def test_args_to_env_new_flags():
+    """Round-2 launcher flags (reference: horovodrun --disable-cache,
+    hierarchical toggles, autotune fine knobs, --num-nccl-streams,
+    --start-timeout)."""
+    args = make_parser().parse_args(
+        ["-np", "2", "--disable-cache", "--hierarchical-allreduce",
+         "--no-hierarchical-allgather", "--num-streams", "4",
+         "--start-timeout", "60", "--autotune-warmup-samples", "5",
+         "--autotune-steps-per-sample", "20",
+         "--autotune-bayes-opt-max-samples", "30",
+         "--autotune-gaussian-process-noise", "0.5",
+         "python", "t.py"])
+    env = args_to_env(args)
+    assert env["HOROVOD_CACHE_CAPACITY"] == "0"
+    assert env["HOROVOD_HIERARCHICAL_ALLREDUCE"] == "1"
+    assert env["HOROVOD_HIERARCHICAL_ALLGATHER"] == "0"
+    assert env["HOROVOD_NUM_STREAMS"] == "4"
+    assert env["HOROVOD_START_TIMEOUT"] == "60"
+    assert env["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] == "5"
+    assert env["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] == "20"
+    assert env["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] == "30"
+    assert env["HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"] == "0.5"
+    # untouched flags contribute nothing
+    plain = args_to_env(make_parser().parse_args(["-np", "2", "x"]))
+    for k in env:
+        assert k not in plain
+
+
+def test_num_nccl_streams_alias():
+    args = make_parser().parse_args(
+        ["-np", "1", "--num-nccl-streams", "3", "x"])
+    assert args_to_env(args)["HOROVOD_NUM_STREAMS"] == "3"
+
+
+def test_check_build_output(capsys):
+    from horovod_tpu.runner.launch import run_commandline
+    rc = run_commandline(["--check-build"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Available Frameworks" in out
+    assert "[X] JAX" in out
+    assert "[X] XLA collectives (ICI/DCN)" in out
+    assert "[ ] NCCL" in out
+
+
+def test_output_filename_captures_per_rank(tmp_path):
+    """--output-filename must write each worker's streams to
+    <dir>/rank.<N>/stdout (reference: horovodrun --output-filename)."""
+    from horovod_tpu.runner.launch import run_commandline
+    outdir = tmp_path / "logs"
+    rc = run_commandline(
+        ["-np", "2", "--output-filename", str(outdir),
+         sys.executable, "-c",
+         "import os; print('hello from', os.environ['HOROVOD_RANK'])"])
+    assert rc == 0
+    for rank in (0, 1):
+        data = (outdir / f"rank.{rank}" / "stdout").read_bytes().decode()
+        assert f"hello from {rank}" in data
