@@ -70,7 +70,9 @@ class ElasticDriver:
                  reset_limit: int = 0,
                  coordinator_port: int = 29500,
                  controller_port: int = 29499,
-                 discovery_interval: float = 1.0):
+                 discovery_interval: float = 1.0,
+                 output_filename: Optional[str] = None,
+                 network_interface: Optional[str] = None):
         self.host_manager = HostManager(discovery)
         self.min_np = min_np
         self.max_np = max_np
@@ -81,6 +83,8 @@ class ElasticDriver:
         self.coordinator_port = coordinator_port
         self.controller_port = controller_port
         self.discovery_interval = discovery_interval
+        self.output_filename = output_filename
+        self.network_interface = network_interface
 
         self.registry = WorkerStateRegistry()
         self.rendezvous = RendezvousServer()
@@ -165,6 +169,16 @@ class ElasticDriver:
         env.update(updates)
         cmd = build_worker_command(slot, self.command, updates,
                                    ssh_port=None, ssh_identity=None)
+        if self.output_filename:
+            # Per-rank stream capture across reset rounds (append so a
+            # restarted rank's log continues, mirroring --output-filename
+            # in static mode).
+            d = os.path.join(self.output_filename, f"rank.{slot.rank}")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "stdout"), "ab") as out, \
+                    open(os.path.join(d, "stderr"), "ab") as err:
+                return subprocess.Popen(cmd, env=env, stdout=out,
+                                        stderr=err)
         return subprocess.Popen(cmd, env=env)
 
     def _terminate_all(self) -> None:
@@ -192,6 +206,9 @@ class ElasticDriver:
                 coord_host = slots[0].hostname
                 if coord_host in ("localhost",):
                     coord_host = "127.0.0.1"
+                if self.network_interface:
+                    from ..runner.launch import interface_address
+                    coord_host = interface_address(self.network_interface)
                 self._hosts_changed.clear()
                 self.registry.reset()
                 log.info("elastic round %d: %d workers on %s", resets,
@@ -261,5 +278,7 @@ def run_elastic(args, command: List[str]) -> int:
         if args.reset_limit is not None
         else knobs["HOROVOD_ELASTIC_RESET_LIMIT"],
         coordinator_port=args.coordinator_port,
-        controller_port=args.controller_port)
+        controller_port=args.controller_port,
+        output_filename=getattr(args, "output_filename", None),
+        network_interface=getattr(args, "network_interface", None))
     return driver.run()

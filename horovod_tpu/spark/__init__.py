@@ -12,8 +12,9 @@ from .runner import (LocalTaskExecutor, SparkTaskExecutor, TaskExecutor,
 from .store import FilesystemStore, LocalStore, Store
 from .estimator import (Estimator, EstimatorModel, KerasEstimator,
                         LinearEstimator, TorchEstimator)
+from .lightning import LightningEstimator
 
 __all__ = ["run", "TaskExecutor", "LocalTaskExecutor", "SparkTaskExecutor",
            "Store", "FilesystemStore", "LocalStore", "Estimator",
            "EstimatorModel", "LinearEstimator", "KerasEstimator",
-           "TorchEstimator"]
+           "TorchEstimator", "LightningEstimator"]

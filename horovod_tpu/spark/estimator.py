@@ -132,6 +132,42 @@ def _grad_sync_fn():
     return sync
 
 
+def _torch_sync_params(model, sync) -> None:
+    """All workers start from identical weights (rank-0 convention): ONE
+    fused sync of the initial parameters."""
+    import torch
+    avgs = sync([p.detach().numpy() for p in model.parameters()])
+    with torch.no_grad():
+        for p, a in zip(model.parameters(), avgs):
+            p.copy_(torch.from_numpy(np.ascontiguousarray(a)))
+
+
+def _torch_sync_grads(model, sync) -> None:
+    """ONE fused grouped collective per batch, not one per parameter."""
+    import torch
+    with_grads = [p for p in model.parameters() if p.grad is not None]
+    gs = sync([p.grad.numpy() for p in with_grads])
+    for p, g in zip(with_grads, gs):
+        p.grad.copy_(torch.from_numpy(np.ascontiguousarray(g)))
+
+
+def _torch_predict_fn(model_fn: Callable, payload: bytes) -> Callable:
+    """state_dict bytes -> eval-mode predict closure (shared by the torch
+    and lightning estimators)."""
+    import io
+    import torch
+    model = model_fn()
+    model.load_state_dict(torch.load(io.BytesIO(payload),
+                                     weights_only=True))
+    model.eval()
+
+    def predict(x: np.ndarray) -> np.ndarray:
+        with torch.no_grad():
+            return model(torch.from_numpy(
+                np.ascontiguousarray(x, np.float32))).numpy()
+    return predict
+
+
 def _assemble_batch(batch, feature_cols, label_cols):
     """Stack feature columns into a 2-D x and the (first) label column into
     a 2-D y — the one batch-assembly implementation every train task
@@ -254,19 +290,7 @@ class TorchEstimator(Estimator):
                                self.batch_size, self.epochs, self.lr)
 
     def _load_model(self, payload: bytes) -> Callable:
-        import io
-        import torch
-        model = self.model_fn()
-        model.load_state_dict(torch.load(io.BytesIO(payload),
-                                         weights_only=True))
-        model.eval()
-
-        def predict(x: np.ndarray) -> np.ndarray:
-            import torch as _t
-            with _t.no_grad():
-                return model(_t.from_numpy(
-                    np.ascontiguousarray(x, np.float32))).numpy()
-        return predict
+        return _torch_predict_fn(self.model_fn, payload)
 
 
 class _TorchTrainTask:
@@ -290,13 +314,8 @@ class _TorchTrainTask:
         loader = ParquetDataLoader(train_path, self.batch_size,
                                    rank=rank, num_workers=size)
         model = self.model_fn()
-        # All workers start from identical weights (rank-0 convention):
-        # one fused sync of the initial parameters.
         if size > 1:
-            avgs = sync([p.detach().numpy() for p in model.parameters()])
-            with torch.no_grad():
-                for p, a in zip(model.parameters(), avgs):
-                    p.copy_(torch.from_numpy(np.ascontiguousarray(a)))
+            _torch_sync_params(model, sync)
         opt = torch.optim.SGD(model.parameters(), lr=self.lr)
         loss_fn = torch.nn.MSELoss()
         loss = torch.zeros(())
@@ -310,14 +329,7 @@ class _TorchTrainTask:
                 loss = loss_fn(model(xt), yt)
                 loss.backward()
                 if size > 1:
-                    # ONE fused grouped collective per batch, not one per
-                    # parameter.
-                    with_grads = [p for p in model.parameters()
-                                  if p.grad is not None]
-                    gs = sync([p.grad.numpy() for p in with_grads])
-                    for p, g in zip(with_grads, gs):
-                        p.grad.copy_(torch.from_numpy(
-                            np.ascontiguousarray(g)))
+                    _torch_sync_grads(model, sync)
                 opt.step()
         if rank == 0:
             buf = io.BytesIO()

@@ -1,0 +1,137 @@
+"""Lightning estimator: train a ``LightningModule`` on Spark-managed data.
+
+Reference: horovod/spark/lightning/estimator.py:100+ (TorchEstimator on
+pytorch_lightning) + remote.py RemoteTrainer — the estimator ships a
+LightningModule to every worker, trains it under horovod with the
+module's own ``configure_optimizers``/``training_step`` hooks, and
+returns a servable model.
+
+TPU-native reshape: the train task drives the LightningModule *protocol*
+directly (``configure_optimizers`` -> wrapped optimizer,
+``training_step`` -> loss, ``on_train_epoch_end`` hook) over parquet
+shards with per-batch fused gradient averaging on the XLA data plane —
+the same flow every estimator in this package uses.  Because only the
+protocol is used, any object with those methods trains identically: real
+``pytorch_lightning.LightningModule`` subclasses work when lightning is
+installed, and lightning is NOT required otherwise (the reference hard-
+depends on it; here the Trainer's role is played by the task loop).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..data.loader import ParquetDataLoader
+from .estimator import (Estimator, _assemble_batch, _grad_sync_fn,
+                        _torch_predict_fn, _torch_sync_grads,
+                        _torch_sync_params)
+from .store import Store
+
+
+def _is_optimizer(obj) -> bool:
+    return hasattr(obj, "param_groups")
+
+
+def _first_optimizer(configured):
+    """``configure_optimizers`` may return an optimizer, a list/tuple of
+    them, or a (optimizers, schedulers) pair (lightning's contract);
+    training uses the first optimizer and steps the first scheduler per
+    epoch.  A 2-tuple of OPTIMIZERS is the multi-optimizer form, not an
+    (optimizer, scheduler) pair — stepping an optimizer as if it were a
+    scheduler would apply stale gradients."""
+    sched = None
+    if isinstance(configured, tuple) and len(configured) == 2 and \
+            not _is_optimizer(configured[1]):
+        opts, scheds = configured
+        opt = opts[0] if isinstance(opts, (list, tuple)) else opts
+        if isinstance(scheds, (list, tuple)) and scheds:
+            sched = scheds[0]
+        elif scheds is not None and not isinstance(scheds, (list, tuple)):
+            sched = scheds
+        return opt, sched
+    if isinstance(configured, (list, tuple)):
+        return configured[0], None
+    return configured, None
+
+
+class LightningEstimator(Estimator):
+    """Estimator over a LightningModule factory (reference:
+    spark/lightning/estimator.py TorchEstimator(model=...)).
+
+    ``model_fn`` builds the module per worker (factories keep the fit
+    payload small and make re-instantiation after elastic resets safe —
+    the reference serializes the module itself for the same purpose).
+    """
+
+    def __init__(self, store: Store, model_fn: Callable, num_proc: int = 1,
+                 **kwargs):
+        super().__init__(store, num_proc=num_proc, **kwargs)
+        self.model_fn = model_fn
+
+    def _make_train_task(self) -> Callable:
+        return _LightningTrainTask(self.store, self.run_id, self.model_fn,
+                                   self.feature_cols, self.label_cols,
+                                   self.batch_size, self.epochs)
+
+    def _load_model(self, payload: bytes) -> Callable:
+        return _torch_predict_fn(self.model_fn, payload)
+
+
+class _LightningTrainTask:
+    """Picklable per-worker trainer: the Trainer-role loop over the
+    LightningModule protocol (reference: lightning/remote.py
+    RemoteTrainer's train function)."""
+
+    def __init__(self, store, run_id, model_fn, feature_cols, label_cols,
+                 batch_size, epochs):
+        self.store = store
+        self.run_id = run_id
+        self.model_fn = model_fn
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+        self.batch_size = batch_size
+        self.epochs = epochs
+
+    def __call__(self, train_path: str):
+        import io
+        import torch
+        rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
+        size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
+        sync = _grad_sync_fn()
+        loader = ParquetDataLoader(train_path, self.batch_size,
+                                   rank=rank, num_workers=size)
+        module = self.model_fn()
+        if size > 1:  # identical start: one fused parameter sync
+            _torch_sync_params(module, sync)
+        opt, sched = _first_optimizer(module.configure_optimizers())
+        loss = torch.zeros(())
+        for epoch in range(self.epochs):
+            module.train()
+            for i, batch in enumerate(loader):
+                x, y = _assemble_batch(batch, self.feature_cols,
+                                       self.label_cols)
+                bt = (torch.from_numpy(np.ascontiguousarray(x, np.float32)),
+                      torch.from_numpy(np.ascontiguousarray(y, np.float32)))
+                opt.zero_grad()
+                out = module.training_step(bt, i)
+                loss = out["loss"] if isinstance(out, dict) else out
+                loss.backward()
+                if size > 1:
+                    _torch_sync_grads(module, sync)
+                opt.step()
+            if sched is not None:
+                sched.step()
+            if hasattr(module, "on_train_epoch_end"):
+                module.on_train_epoch_end()
+            if rank == 0:  # per-epoch checkpoint (reference: remote.py
+                buf = io.BytesIO()  # ModelCheckpoint every epoch)
+                torch.save(module.state_dict(), buf)
+                self.store.save_checkpoint(self.run_id, buf.getvalue())
+        return float(loss)
+
+
+__all__ = ["LightningEstimator"]

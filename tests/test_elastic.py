@@ -198,3 +198,25 @@ def test_elastic_driver_blacklists_failing_host(tmp_path):
     with pytest.raises(TimeoutError):
         d.run()
     assert d.host_manager.is_blacklisted("localhost")
+
+
+def test_elastic_driver_output_filename(tmp_path):
+    """--output-filename in elastic mode captures per-rank streams across
+    rounds (regression: the flag was silently ignored outside static
+    runs)."""
+    import sys
+    from horovod_tpu.elastic.discovery import FixedHosts
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.hosts import HostInfo
+
+    outdir = tmp_path / "logs"
+    driver = ElasticDriver(
+        FixedHosts([HostInfo("localhost", 2)]), min_np=2, max_np=2,
+        command=[sys.executable, "-c",
+                 "import os; print('out rank', os.environ['HOROVOD_RANK'])"],
+        env={"JAX_PLATFORMS": "cpu"}, elastic_timeout=30,
+        output_filename=str(outdir))
+    assert driver.run() == 0
+    for rank in (0, 1):
+        text = (outdir / f"rank.{rank}" / "stdout").read_bytes().decode()
+        assert f"out rank {rank}" in text

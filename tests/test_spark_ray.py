@@ -250,3 +250,83 @@ def test_ray_host_discovery_requires_ray():
         pass
     with pytest.raises(ImportError, match="ray"):
         RayHostDiscovery()
+
+
+# ------------------------------------------------------------- lightning
+class _ToyLightningModule:
+    """LightningModule-protocol module (configure_optimizers /
+    training_step / on_train_epoch_end) with no pytorch_lightning
+    dependency — real pl.LightningModule subclasses satisfy the same
+    protocol (horovod_tpu/spark/lightning.py docstring)."""
+
+    def __init__(self):
+        import torch
+        self.net = torch.nn.Linear(4, 1)
+        self.epochs_ended = 0
+
+    # protocol surface the trainer loop drives
+    def parameters(self):
+        return self.net.parameters()
+
+    def state_dict(self):
+        return self.net.state_dict()
+
+    def load_state_dict(self, sd):
+        self.net.load_state_dict(sd)
+
+    def train(self):
+        self.net.train()
+
+    def eval(self):
+        self.net.eval()
+
+    def __call__(self, x):
+        return self.net(x)
+
+    def configure_optimizers(self):
+        import torch
+        opt = torch.optim.SGD(self.net.parameters(), lr=0.05)
+        sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1,
+                                                gamma=0.9)
+        return [opt], [sched]
+
+    def training_step(self, batch, batch_idx):
+        import torch
+        x, y = batch
+        return {"loss": torch.nn.functional.mse_loss(self.net(x), y)}
+
+    def on_train_epoch_end(self):
+        self.epochs_ended += 1
+
+
+def test_lightning_estimator_end_to_end(tmp_path):
+    from horovod_tpu.spark import FilesystemStore, LightningEstimator
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 4).astype("float32")
+    w = np.array([[1.0], [-1.0], [0.5], [2.0]], "float32")
+    y = (X @ w).astype("float32")
+
+    store = FilesystemStore(str(tmp_path))
+    est = LightningEstimator(
+        store=store, model_fn=_ToyLightningModule, num_proc=2,
+        feature_cols=["features"], label_cols=["label"],
+        batch_size=32, epochs=10)
+    model = est.fit({"features": X, "label": y})
+
+    out = model.transform({"features": X[:16], "label": y[:16]})
+    mse = float(np.mean((out["predict"] - y[:16]) ** 2))
+    assert mse < 0.5, mse
+
+
+def test_lightning_first_optimizer_unpacking():
+    import torch
+    from horovod_tpu.spark.lightning import _first_optimizer
+
+    lin = torch.nn.Linear(2, 1)
+    opt = torch.optim.SGD(lin.parameters(), lr=0.1)
+    sched = torch.optim.lr_scheduler.StepLR(opt, 1)
+    assert _first_optimizer(opt) == (opt, None)
+    assert _first_optimizer([opt]) == (opt, None)
+    assert _first_optimizer(([opt], [sched])) == (opt, sched)
+    assert _first_optimizer((opt, sched)) == (opt, sched)
