@@ -85,6 +85,7 @@ class ElasticDriver:
         self.discovery_interval = discovery_interval
         self.output_filename = output_filename
         self.network_interface = network_interface
+        self._spawned_ranks: set = set()
 
         self.registry = WorkerStateRegistry()
         self.rendezvous = RendezvousServer()
@@ -169,17 +170,14 @@ class ElasticDriver:
         env.update(updates)
         cmd = build_worker_command(slot, self.command, updates,
                                    ssh_port=None, ssh_identity=None)
-        if self.output_filename:
-            # Per-rank stream capture across reset rounds (append so a
-            # restarted rank's log continues, mirroring --output-filename
-            # in static mode).
-            d = os.path.join(self.output_filename, f"rank.{slot.rank}")
-            os.makedirs(d, exist_ok=True)
-            with open(os.path.join(d, "stdout"), "ab") as out, \
-                    open(os.path.join(d, "stderr"), "ab") as err:
-                return subprocess.Popen(cmd, env=env, stdout=out,
-                                        stderr=err)
-        return subprocess.Popen(cmd, env=env)
+        from ..runner.launch import spawn_with_output
+        # Truncate on a rank's FIRST spawn of this driver run (a stale
+        # log from a previous run must not leak in); append on reset
+        # rounds so a restarted rank's log continues.
+        mode = "ab" if slot.rank in self._spawned_ranks else "wb"
+        self._spawned_ranks.add(slot.rank)
+        return spawn_with_output(cmd, env, self.output_filename,
+                                 slot.rank, mode=mode)
 
     def _terminate_all(self) -> None:
         for p in self._procs.values():
@@ -207,8 +205,15 @@ class ElasticDriver:
                 if coord_host in ("localhost",):
                     coord_host = "127.0.0.1"
                 if self.network_interface:
-                    from ..runner.launch import interface_address
-                    coord_host = interface_address(self.network_interface)
+                    # The coordinator binds on RANK 0's host: the NIC
+                    # override only holds when rank 0 is this machine
+                    # (remote hosts' NIC addresses can't be resolved
+                    # driver-side).
+                    from ..runner.launch import (_is_local,
+                                                 interface_address)
+                    if _is_local(slots[0].hostname):
+                        coord_host = interface_address(
+                            self.network_interface)
                 self._hosts_changed.clear()
                 self.registry.reset()
                 log.info("elastic round %d: %d workers on %s", resets,

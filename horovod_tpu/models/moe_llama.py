@@ -1,0 +1,145 @@
+"""MoE decoder: Llama attention blocks with switch-MoE FFNs.
+
+Beyond-reference model family (the reference ships no models; its
+examples use torchvision/keras zoos).  The expert layer shares its
+parameter layout and routing math with ``parallel/expert.py`` — the SAME
+``{"router", "wi", "wo"}`` pytree runs dense on one chip (this module's
+default path, used for tests/inference) or expert-parallel over an
+``ep`` mesh axis via :func:`horovod_tpu.parallel.expert.make_moe_fn`
+(pass it as ``moe_fn``), so checkpoints move freely between layouts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+from . import llama as Ll
+from ..parallel.expert import init_moe_params, moe_dense_reference
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeLlamaConfig:
+    vocab: int = 4096
+    dim: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    moe_hidden: int = 512
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+    max_seq: int = 512
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+CONFIGS = {
+    "tiny": MoeLlamaConfig(vocab=256, dim=64, n_layers=2, n_heads=4,
+                           n_kv_heads=2, moe_hidden=128, n_experts=4,
+                           max_seq=128),
+    "mini": MoeLlamaConfig(),
+}
+
+
+def _llama_cfg(cfg: MoeLlamaConfig) -> Ll.LlamaConfig:
+    """The attention half of a layer is exactly llama's."""
+    return Ll.LlamaConfig(vocab=cfg.vocab, dim=cfg.dim,
+                          n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+                          n_kv_heads=cfg.n_kv_heads, ffn_dim=1,
+                          max_seq=cfg.max_seq, rope_theta=cfg.rope_theta,
+                          dtype=cfg.dtype)
+
+
+def init(key, cfg: MoeLlamaConfig) -> Dict[str, Any]:
+    keys = jax.random.split(key, cfg.n_layers + 2)
+    lcfg = _llama_cfg(cfg)
+    layers = []
+    for i in range(cfg.n_layers):
+        ka, km = jax.random.split(keys[2 + i])
+        lp = Ll.init_layer(ka, lcfg)
+        # drop the dense FFN; the MoE block replaces it
+        for k in ("w_gate", "w_up", "w_down"):
+            lp.pop(k)
+        lp["moe"] = init_moe_params(km, cfg.dim, cfg.moe_hidden,
+                                    cfg.n_experts, dtype=cfg.dtype)
+        layers.append(lp)
+    return {
+        "embed": L.embedding_init(keys[0], cfg.vocab, cfg.dim, cfg.dtype),
+        "final_norm": L.rmsnorm_init(cfg.dim, cfg.dtype),
+        "lm_head": L.dense_init(keys[1], cfg.dim, cfg.vocab,
+                                use_bias=False,
+                                scale=1.0 / math.sqrt(cfg.dim),
+                                dtype=cfg.dtype),
+        "layers": layers,
+    }
+
+
+def _moe_block(p_moe: Dict[str, Any], x: jax.Array,
+               cfg: MoeLlamaConfig,
+               moe_fn: Optional[Callable]) -> tuple[jax.Array, jax.Array]:
+    """[B, S, D] -> ([B, S, D], aux).  Dense single-chip path by default;
+    an injected ``moe_fn`` (from parallel/expert.make_moe_fn) runs the
+    expert-parallel all_to_all path with the same params."""
+    B, S, D = x.shape
+    tokens = x.reshape(B * S, D)
+    if moe_fn is not None:
+        y, aux = moe_fn(p_moe, tokens)
+    else:
+        capacity = int(math.ceil(B * S * cfg.capacity_factor /
+                                 cfg.n_experts))
+        y, aux = moe_dense_reference(p_moe, tokens, cfg.n_experts,
+                                     capacity)
+    return y.reshape(B, S, D), aux
+
+
+def apply(params: Dict[str, Any], ids: jax.Array, cfg: MoeLlamaConfig,
+          moe_fn: Optional[Callable] = None,
+          attn_fn=None) -> tuple[jax.Array, jax.Array]:
+    """Forward: ids [B, S] -> (logits [B, S, vocab], mean router aux)."""
+    lcfg = _llama_cfg(cfg)
+    cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    x = L.embedding(params["embed"], ids).astype(cfg.dtype)
+    auxes = []
+    for p in params["layers"]:
+        x = x + Ll._attn(p, L.rmsnorm(p["attn_norm"], x), lcfg, cos, sin,
+                         attn_fn)
+        y, aux = _moe_block(p["moe"], L.rmsnorm(p["ffn_norm"], x), cfg,
+                            moe_fn)
+        x = x + y
+        auxes.append(aux)
+    x = L.rmsnorm(params["final_norm"], x)
+    return L.dense(params["lm_head"], x), jnp.mean(jnp.stack(auxes))
+
+
+def loss_fn(params: Dict[str, Any], ids: jax.Array, cfg: MoeLlamaConfig,
+            moe_fn: Optional[Callable] = None) -> jax.Array:
+    """Next-token cross-entropy + router load-balancing aux."""
+    logits, aux = apply(params, ids[:, :-1], cfg, moe_fn=moe_fn)
+    targets = ids[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll) + cfg.router_aux_coef * aux
+
+
+def param_count(cfg: MoeLlamaConfig) -> int:
+    attn = (cfg.dim * cfg.n_heads * cfg.head_dim
+            + 2 * cfg.dim * cfg.n_kv_heads * cfg.head_dim
+            + cfg.n_heads * cfg.head_dim * cfg.dim + 2 * cfg.dim)
+    moe = (cfg.dim * cfg.n_experts
+           + 2 * cfg.n_experts * cfg.dim * cfg.moe_hidden)
+    return (cfg.n_layers * (attn + moe)
+            + 2 * cfg.vocab * cfg.dim + cfg.dim)
+
+
+__all__ = ["MoeLlamaConfig", "CONFIGS", "init", "apply", "loss_fn",
+           "param_count"]

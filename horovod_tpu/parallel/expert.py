@@ -1,0 +1,156 @@
+"""Expert parallelism: switch-style MoE dispatch over an ``ep`` mesh axis.
+
+Beyond-reference capability (SURVEY §2.3: EP is "NO built-in; same
+alltoall primitive" — the reference only offers ``hvd.alltoall`` for
+users to build this themselves).  Here it is first-class: a capacity-
+bounded top-1 (switch) router builds a static-shape dispatch tensor, and
+TWO ``lax.all_to_all`` hops over the ``ep`` axis move tokens to their
+expert's chip and back — the canonical TPU MoE data path (einsum-based
+dispatch/combine keeps everything on the MXU; static capacity keeps
+shapes compile-time constant).
+
+Layout: with E experts over an ep-way axis, each chip owns E/ep experts
+and a token shard.  Per shard: route -> dispatch einsum [T,D]x[T,E,C] ->
+[E,C,D] -> all_to_all -> expert FFN -> all_to_all back -> combine einsum
+weighted by the router gate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops._compat import shard_map
+
+
+def init_moe_params(key, dim: int, hidden: int, n_experts: int,
+                    dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+    """Router + per-expert FFN weights, experts stacked on axis 0 (the
+    axis sharded over ``ep``)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = 1.0 / np.sqrt(dim)
+    scale_out = 1.0 / np.sqrt(hidden)
+    return {
+        "router": (jax.random.normal(k1, (dim, n_experts)) *
+                   scale_in).astype(dtype),
+        "wi": (jax.random.normal(k2, (n_experts, dim, hidden)) *
+               scale_in).astype(dtype),
+        "wo": (jax.random.normal(k3, (n_experts, hidden, dim)) *
+               scale_out).astype(dtype),
+    }
+
+
+def _route_top1(logits: jnp.ndarray, capacity: int):
+    """Switch router: per-token best expert, capacity-bounded.
+
+    Returns the [T, E, C] dispatch tensor (0/1), the [T] combine gate
+    (softmax prob, zeroed for dropped tokens), and the load-balancing
+    auxiliary loss (Switch Transformer eq. 4: E * mean(frac_tokens *
+    frac_prob))."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert, E, dtype=logits.dtype)
+    position = jnp.cumsum(onehot, axis=0) * onehot  # 1-based per expert
+    within = position <= capacity
+    onehot = onehot * within
+    disp = onehot[:, :, None] * jax.nn.one_hot(
+        jnp.maximum(position - 1, 0).astype(jnp.int32), capacity,
+        dtype=logits.dtype)
+    gate = gate * onehot.sum(-1)  # dropped tokens contribute nothing
+    aux = E * jnp.mean(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
+    return disp, gate, aux
+
+
+def _expert_ffn(wi, wo, x):
+    """Per-expert MLP batched over the local experts dim:
+    x [El, S, D] -> [El, S, D]."""
+    h = jax.nn.gelu(jnp.einsum("esd,edh->esh", x, wi))
+    return jnp.einsum("esh,ehd->esd", h, wo)
+
+
+def make_moe_fn(mesh: Mesh, n_experts: int,
+                capacity_factor: float = 1.25,
+                axis: str = "ep") -> Callable:
+    """Build ``apply(params, x) -> (y, aux_loss)`` where ``x`` is
+    [T, D] tokens (sharded over ``axis``) and ``params`` comes from
+    :func:`init_moe_params` (experts sharded over ``axis``).
+
+    Differentiable end-to-end; ``aux_loss`` is the Switch load-balancing
+    term (mean over shards), to be added to the task loss scaled by the
+    caller.
+    """
+    ep = mesh.shape[axis]
+    if n_experts % ep:
+        raise ValueError(f"n_experts={n_experts} not divisible by "
+                         f"{axis}={ep}")
+    e_local = n_experts // ep
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=({"router": P(), "wi": P(axis), "wo": P(axis)},
+                       P(axis)),
+             out_specs=(P(axis), P()),
+             check_vma=False)
+    def _inner(params, x):
+        T = x.shape[0]  # local token count
+        capacity = int(np.ceil(T * capacity_factor / n_experts))
+        logits = x @ params["router"]
+        disp, gate, aux = _route_top1(logits, capacity)
+
+        # [T,D] x [T,E,C] -> [E,C,D]: tokens in their expert's slot.
+        xd = jnp.einsum("td,tec->ecd", x, disp)
+        # Ship slots to the owning chips: split E into [ep, e_local] and
+        # trade the ep dim for the token-source dim.
+        xd = xd.reshape(ep, e_local, capacity, xd.shape[-1])
+        xd = lax.all_to_all(xd, axis, split_axis=0, concat_axis=0,
+                            tiled=False)
+        # Now [ep(source), e_local, C, D]: merge source chips into the
+        # expert's working set (transpose first — a bare reshape would
+        # interleave experts across source chunks).
+        d = xd.shape[-1]
+        xw = xd.transpose(1, 0, 2, 3).reshape(e_local, ep * capacity, d)
+        yw = _expert_ffn(params["wi"], params["wo"], xw)
+        # Send results home (inverse all_to_all).
+        yd = yw.reshape(e_local, ep, capacity, d).transpose(1, 0, 2, 3)
+        yd = lax.all_to_all(yd, axis, split_axis=0, concat_axis=0,
+                            tiled=False)
+        yd = yd.reshape(n_experts, capacity, yd.shape[-1])
+        # Combine back to token order, weighted by the gate.
+        y = jnp.einsum("ecd,tec->td", yd, disp) * gate[:, None]
+        return y, lax.pmean(aux, axis)
+
+    return _inner
+
+
+def moe_shardings(mesh: Mesh, params: Any, axis: str = "ep"):
+    """NamedShardings for init_moe_params output: experts over ``ep``,
+    router replicated."""
+    return {
+        "router": NamedSharding(mesh, P()),
+        "wi": NamedSharding(mesh, P(axis)),
+        "wo": NamedSharding(mesh, P(axis)),
+    }
+
+
+def moe_dense_reference(params, x, n_experts: int, capacity: int):
+    """Single-device reference with IDENTICAL routing math (for tests):
+    every token goes through its routed expert unless over capacity."""
+    logits = x @ params["router"]
+    disp, gate, aux = _route_top1(logits, capacity)
+    y_all = jnp.einsum("td,edh->teh", x, params["wi"])
+    y_all = jax.nn.gelu(y_all)
+    y_all = jnp.einsum("teh,ehd->ted", y_all, params["wo"])
+    sel = disp.sum(-1)  # [T, E] 0/1 kept-assignment
+    y = jnp.einsum("ted,te->td", y_all, sel) * gate[:, None]
+    return y, aux
+
+
+__all__ = ["make_moe_fn", "init_moe_params", "moe_shardings",
+           "moe_dense_reference"]

@@ -224,6 +224,24 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
     return env
 
 
+def spawn_with_output(cmd: List[str], env: Dict[str, str],
+                      output_filename: Optional[str], rank: int,
+                      mode: str = "wb") -> subprocess.Popen:
+    """Spawn a worker, optionally redirecting its streams to
+    <output_filename>/rank.<N>/stdout|stderr (reference:
+    --output-filename).  ssh forwards remote streams, so driver-side
+    redirection covers both paths.  ``mode="ab"`` appends (elastic reset
+    rounds continue a rank's log)."""
+    if not output_filename:
+        return subprocess.Popen(cmd, env=env)
+    d = os.path.join(output_filename, f"rank.{rank}")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "stdout"), mode) as out, \
+            open(os.path.join(d, "stderr"), mode) as err:
+        # the child holds its own dups; drop the parent's handles
+        return subprocess.Popen(cmd, env=env, stdout=out, stderr=err)
+
+
 def check_build() -> str:
     """Capability summary (reference: launch.py check_build / horovodrun
     --check-build prints frameworks + controllers + tensor ops built in).
@@ -336,8 +354,10 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
     coord_host = slots[0].hostname
     if _is_local(coord_host):
         coord_host = "127.0.0.1"
-    if args.network_interface:
+    if args.network_interface and _is_local(slots[0].hostname):
         # Workers must dial the coordinator over this NIC's address.
+        # The coordinator binds on rank 0's host, so the override only
+        # holds when that host is this machine.
         coord_host = interface_address(args.network_interface)
     knob_env = args_to_env(args)
 
@@ -362,18 +382,8 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
         if args.verbose:
             print(f"[hvdrun] rank {slot.rank} on {slot.hostname}: "
                   f"{' '.join(cmd)}", file=sys.stderr)
-        if args.output_filename:
-            # Per-rank stream capture (reference: --output-filename writes
-            # <dir>/rank.<N>/stdout|stderr).  ssh forwards remote streams,
-            # so driver-side redirection covers both paths.
-            d = os.path.join(args.output_filename, f"rank.{slot.rank}")
-            os.makedirs(d, exist_ok=True)
-            with open(os.path.join(d, "stdout"), "wb") as out, \
-                    open(os.path.join(d, "stderr"), "wb") as err:
-                # the child holds its own dups; drop the parent's handles
-                return subprocess.Popen(cmd, env=env, stdout=out,
-                                        stderr=err)
-        return subprocess.Popen(cmd, env=env)
+        return spawn_with_output(cmd, env, args.output_filename,
+                                 slot.rank)
 
     try:
         for slot in slots:

@@ -20,8 +20,7 @@ depends on it; here the Trainer's role is played by the task loop).
 from __future__ import annotations
 
 import os
-import pickle
-from typing import Callable, Optional
+from typing import Callable
 
 import numpy as np
 
@@ -36,13 +35,40 @@ def _is_optimizer(obj) -> bool:
     return hasattr(obj, "param_groups")
 
 
+def _unwrap_scheduler(sched):
+    """Lightning allows scheduler *configs* — dicts like
+    ``{"scheduler": sched, "interval": "step", "frequency": N}`` —
+    wherever a scheduler goes; normalize to a (scheduler, interval,
+    frequency) triple (or None) so the trainer loop honors the declared
+    cadence instead of silently stepping per epoch."""
+    if sched is None:
+        return None
+    if isinstance(sched, dict):
+        inner = sched.get("scheduler")
+        if inner is None:
+            return None
+        return (inner, sched.get("interval", "epoch"),
+                int(sched.get("frequency", 1)))
+    return sched, "epoch", 1
+
+
 def _first_optimizer(configured):
     """``configure_optimizers`` may return an optimizer, a list/tuple of
-    them, or a (optimizers, schedulers) pair (lightning's contract);
-    training uses the first optimizer and steps the first scheduler per
-    epoch.  A 2-tuple of OPTIMIZERS is the multi-optimizer form, not an
-    (optimizer, scheduler) pair — stepping an optimizer as if it were a
-    scheduler would apply stale gradients."""
+    them, a (optimizers, schedulers) pair, or the dict form
+    ``{"optimizer": ..., "lr_scheduler": ...}`` (lightning's contract).
+    Returns (optimizer, scheduler_config) where scheduler_config is the
+    :func:`_unwrap_scheduler` triple or None.  A 2-tuple of OPTIMIZERS is
+    the multi-optimizer form, not an (optimizer, scheduler) pair —
+    stepping an optimizer as if it were a scheduler would apply stale
+    gradients."""
+    if configured is None:
+        raise NotImplementedError(
+            "configure_optimizers returned None (lightning manual "
+            "optimization); LightningEstimator drives automatic "
+            "optimization — return an optimizer")
+    if isinstance(configured, dict):
+        return (configured["optimizer"],
+                _unwrap_scheduler(configured.get("lr_scheduler")))
     sched = None
     if isinstance(configured, tuple) and len(configured) == 2 and \
             not _is_optimizer(configured[1]):
@@ -52,9 +78,13 @@ def _first_optimizer(configured):
             sched = scheds[0]
         elif scheds is not None and not isinstance(scheds, (list, tuple)):
             sched = scheds
-        return opt, sched
+        return opt, _unwrap_scheduler(sched)
     if isinstance(configured, (list, tuple)):
-        return configured[0], None
+        first = configured[0]
+        if isinstance(first, dict):  # list of dict configs
+            return (first["optimizer"],
+                    _unwrap_scheduler(first.get("lr_scheduler")))
+        return first, None
     return configured, None
 
 
@@ -107,8 +137,10 @@ class _LightningTrainTask:
         module = self.model_fn()
         if size > 1:  # identical start: one fused parameter sync
             _torch_sync_params(module, sync)
-        opt, sched = _first_optimizer(module.configure_optimizers())
+        opt, sched_cfg = _first_optimizer(module.configure_optimizers())
+        sched, interval, freq = sched_cfg or (None, "epoch", 1)
         loss = torch.zeros(())
+        global_step = 0
         for epoch in range(self.epochs):
             module.train()
             for i, batch in enumerate(loader):
@@ -118,12 +150,19 @@ class _LightningTrainTask:
                       torch.from_numpy(np.ascontiguousarray(y, np.float32)))
                 opt.zero_grad()
                 out = module.training_step(bt, i)
+                if out is None:
+                    continue  # lightning's skip-this-batch signal
                 loss = out["loss"] if isinstance(out, dict) else out
                 loss.backward()
                 if size > 1:
                     _torch_sync_grads(module, sync)
                 opt.step()
-            if sched is not None:
+                global_step += 1
+                if sched is not None and interval == "step" and \
+                        global_step % freq == 0:
+                    sched.step()
+            if sched is not None and interval == "epoch" and \
+                    (epoch + 1) % freq == 0:
                 sched.step()
             if hasattr(module, "on_train_epoch_end"):
                 module.on_train_epoch_end()

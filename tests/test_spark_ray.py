@@ -328,5 +328,30 @@ def test_lightning_first_optimizer_unpacking():
     sched = torch.optim.lr_scheduler.StepLR(opt, 1)
     assert _first_optimizer(opt) == (opt, None)
     assert _first_optimizer([opt]) == (opt, None)
-    assert _first_optimizer(([opt], [sched])) == (opt, sched)
-    assert _first_optimizer((opt, sched)) == (opt, sched)
+    assert _first_optimizer(([opt], [sched])) == (opt, (sched, "epoch", 1))
+    assert _first_optimizer((opt, sched)) == (opt, (sched, "epoch", 1))
+
+
+def test_lightning_dict_configure_optimizers():
+    import torch
+    from horovod_tpu.spark.lightning import _first_optimizer
+
+    lin = torch.nn.Linear(2, 1)
+    opt = torch.optim.SGD(lin.parameters(), lr=0.1)
+    sched = torch.optim.lr_scheduler.StepLR(opt, 1)
+    # lightning dict form
+    assert _first_optimizer({"optimizer": opt, "lr_scheduler": sched}) == \
+        (opt, (sched, "epoch", 1))
+    # scheduler CONFIG dict: interval/frequency preserved
+    assert _first_optimizer(
+        ([opt], [{"scheduler": sched, "interval": "step",
+                  "frequency": 2}])) == (opt, (sched, "step", 2))
+    # list of dict configs
+    assert _first_optimizer([{"optimizer": opt}]) == (opt, None)
+    # manual optimization is rejected with a clear error
+    import pytest as _pt
+    with _pt.raises(NotImplementedError, match="manual"):
+        _first_optimizer(None)
+    # 2-tuple of optimizers = multi-optimizer form, NOT (opt, sched)
+    opt2 = torch.optim.SGD(lin.parameters(), lr=0.2)
+    assert _first_optimizer((opt, opt2)) == (opt, None)
