@@ -214,6 +214,11 @@ class ElasticDriver:
                     if _is_local(slots[0].hostname):
                         coord_host = interface_address(
                             self.network_interface)
+                    else:
+                        log.warning(
+                            "--network-interface %s ignored this round: "
+                            "rank 0 is on remote host %s",
+                            self.network_interface, slots[0].hostname)
                 self._hosts_changed.clear()
                 self.registry.reset()
                 log.info("elastic round %d: %d workers on %s", resets,

@@ -57,15 +57,21 @@ def _route_top1(logits: jnp.ndarray, capacity: int):
     probs = jax.nn.softmax(logits, axis=-1)
     expert = jnp.argmax(probs, axis=-1)
     gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
-    onehot = jax.nn.one_hot(expert, E, dtype=logits.dtype)
-    position = jnp.cumsum(onehot, axis=0) * onehot  # 1-based per expert
+    raw_onehot = jax.nn.one_hot(expert, E, dtype=logits.dtype)
+    # Aux from the RAW routing assignment (pre-capacity): Switch eq. 4,
+    # alpha * E * sum_e f_e * P_e — equals 1 under perfect balance and
+    # grows toward E as the router collapses.  Masking f_e by capacity
+    # would clamp the hot expert's fraction exactly when imbalance is
+    # worst, neutering the regularizer.
+    aux = E * jnp.sum(jnp.mean(raw_onehot, axis=0) *
+                      jnp.mean(probs, axis=0))
+    position = jnp.cumsum(raw_onehot, axis=0) * raw_onehot  # 1-based
     within = position <= capacity
-    onehot = onehot * within
+    onehot = raw_onehot * within
     disp = onehot[:, :, None] * jax.nn.one_hot(
         jnp.maximum(position - 1, 0).astype(jnp.int32), capacity,
         dtype=logits.dtype)
     gate = gate * onehot.sum(-1)  # dropped tokens contribute nothing
-    aux = E * jnp.mean(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
     return disp, gate, aux
 
 
@@ -126,7 +132,13 @@ def make_moe_fn(mesh: Mesh, n_experts: int,
         y = jnp.einsum("ecd,tec->td", yd, disp) * gate[:, None]
         return y, lax.pmean(aux, axis)
 
-    return _inner
+    def apply(params, x):
+        if x.shape[0] % ep:
+            raise ValueError(
+                f"token count {x.shape[0]} not divisible by {axis}={ep}")
+        return _inner(params, x)
+
+    return apply
 
 
 def moe_shardings(mesh: Mesh, params: Any, axis: str = "ep"):

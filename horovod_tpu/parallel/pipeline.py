@@ -123,6 +123,11 @@ def make_pipeline_fn(stage_fn: Callable, mesh: Mesh, n_micro: int,
         if B % n_micro:
             raise ValueError(f"batch {B} not divisible by "
                              f"n_micro={n_micro}")
+        if batch_axis is not None and \
+                (B // n_micro) % mesh.shape[batch_axis]:
+            raise ValueError(
+                f"microbatch rows {B // n_micro} not divisible by "
+                f"{batch_axis}={mesh.shape[batch_axis]}")
         xm = x.reshape((n_micro, B // n_micro) + x.shape[1:])
         out = _inner(params_stacked, xm)
         return out.reshape((B,) + out.shape[2:])
@@ -141,6 +146,60 @@ def pipeline_shardings(mesh: Mesh, params_stacked: Any,
     return jax.tree_util.tree_map(lambda _: sh, params_stacked)
 
 
+def make_pipelined_llama(cfg, mesh: Mesh, n_micro: int,
+                         axis: str = "pp",
+                         batch_axis: str | None = None):
+    """Pipeline the flagship llama over ``pp``: the shape-preserving layer
+    stack runs through the GPipe schedule (layers grouped
+    ``n_layers // n_stages`` per stage, scanned locally), while the
+    embedding / final-norm / lm-head stay outside (they change shape).
+
+    Returns ``(apply_fn, restack)`` where ``restack(params)`` converts a
+    standard ``llama.init`` pytree into ``{"embed", "final_norm",
+    "lm_head", "stages"}`` with stages stacked [S, L/S, ...], and
+    ``apply_fn(pparams, ids) -> logits`` is differentiable end-to-end.
+    """
+    from ..models import llama as Ll
+    from ..models import layers as L
+
+    S = mesh.shape[axis]
+    if cfg.n_layers % S:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
+                         f"{axis}={S} stages")
+    per_stage = cfg.n_layers // S
+    cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+
+    def restack(params):
+        layers = params["layers"]
+        groups = [stack_stage_params(layers[s * per_stage:
+                                            (s + 1) * per_stage])
+                  for s in range(S)]
+        return {
+            "embed": params["embed"],
+            "final_norm": params["final_norm"],
+            "lm_head": params["lm_head"],
+            "stages": stack_stage_params(groups),  # [S, L/S, ...]
+        }
+
+    def stage_fn(stage_params, x):
+        # stage_params: [L/S, ...]; scan this stage's layers locally.
+        def body(h, lp):
+            return Ll.apply_layer(lp, h, cfg, cos, sin), None
+        out, _ = lax.scan(body, x, stage_params)
+        return out
+
+    pipe = make_pipeline_fn(stage_fn, mesh, n_micro, axis=axis,
+                            batch_axis=batch_axis)
+
+    def apply_fn(pparams, ids):
+        x = L.embedding(pparams["embed"], ids).astype(cfg.dtype)
+        x = pipe(pparams["stages"], x)
+        x = L.rmsnorm(pparams["final_norm"], x)
+        return L.dense(pparams["lm_head"], x)
+
+    return apply_fn, restack
+
+
 def pipeline_bubble_fraction(n_stages: int, n_micro: int) -> float:
     """The GPipe bubble overhead (S-1)/(M+S-1) — exposed so autotuning /
     benchmarks can pick ``n_micro`` (reference has no analog; standard
@@ -149,4 +208,4 @@ def pipeline_bubble_fraction(n_stages: int, n_micro: int) -> float:
 
 
 __all__ = ["make_pipeline_fn", "stack_stage_params", "pipeline_shardings",
-           "pipeline_bubble_fraction"]
+           "make_pipelined_llama", "pipeline_bubble_fraction"]

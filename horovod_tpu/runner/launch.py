@@ -354,11 +354,17 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
     coord_host = slots[0].hostname
     if _is_local(coord_host):
         coord_host = "127.0.0.1"
-    if args.network_interface and _is_local(slots[0].hostname):
+    if args.network_interface:
         # Workers must dial the coordinator over this NIC's address.
         # The coordinator binds on rank 0's host, so the override only
         # holds when that host is this machine.
-        coord_host = interface_address(args.network_interface)
+        if _is_local(slots[0].hostname):
+            coord_host = interface_address(args.network_interface)
+        else:
+            print(f"[hvdrun] warning: --network-interface "
+                  f"{args.network_interface} ignored — rank 0 is on "
+                  f"remote host {slots[0].hostname}, whose NIC address "
+                  f"cannot be resolved driver-side", file=sys.stderr)
     knob_env = args_to_env(args)
 
     procs: List[subprocess.Popen] = []

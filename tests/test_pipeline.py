@@ -162,3 +162,55 @@ def test_bubble_fraction():
     assert pipeline_bubble_fraction(4, 1) == pytest.approx(3 / 4)
     assert pipeline_bubble_fraction(4, 13) == pytest.approx(3 / 16)
     assert pipeline_bubble_fraction(1, 8) == 0.0
+
+
+def test_pipelined_llama_matches_sequential(hvd):
+    """The flagship model through the pipeline (layers grouped per stage,
+    embed/head outside) must equal llama.apply, forward and grad."""
+    import dataclasses
+    from horovod_tpu.models import llama
+    from horovod_tpu.parallel.pipeline import make_pipelined_llama
+
+    mesh = _mesh(hvd)
+    cfg = dataclasses.replace(llama.CONFIGS["tiny"], n_layers=4)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab, (4, 16)), jnp.int32)
+
+    apply_fn, restack = make_pipelined_llama(cfg, mesh, n_micro=2)
+    pp = restack(params)
+    logits_pp = apply_fn(pp, ids)
+    logits_ref = llama.apply(params, ids, cfg)
+    np.testing.assert_allclose(np.asarray(logits_pp),
+                               np.asarray(logits_ref),
+                               rtol=2e-4, atol=2e-5)
+
+    # gradient parity on the stacked stage params
+    tgt = jax.random.normal(jax.random.PRNGKey(1), logits_ref.shape)
+    g_pp = jax.grad(lambda q: jnp.mean(
+        (apply_fn({**pp, "stages": q}, ids) - tgt) ** 2))(pp["stages"])
+
+    def seq_loss(layers):
+        p2 = dict(params)
+        p2["layers"] = layers
+        return jnp.mean((llama.apply(p2, ids, cfg) - tgt) ** 2)
+
+    g_seq_list = jax.grad(seq_loss)(params["layers"])
+    g_seq = stack_stage_params(
+        [stack_stage_params(g_seq_list[s:s + 1]) for s in range(4)])
+    for (path_a, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(g_pp),
+            jax.tree_util.tree_leaves_with_path(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=1e-5,
+                                   err_msg=str(path_a))
+
+
+def test_pipelined_llama_rejects_bad_layering(hvd):
+    import dataclasses
+    from horovod_tpu.models import llama
+    from horovod_tpu.parallel.pipeline import make_pipelined_llama
+    mesh = _mesh(hvd)
+    cfg = dataclasses.replace(llama.CONFIGS["tiny"], n_layers=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pipelined_llama(cfg, mesh, n_micro=2)
