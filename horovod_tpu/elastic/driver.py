@@ -201,24 +201,10 @@ class ElasticDriver:
             while True:
                 hosts = self.wait_for_available_slots(self.min_np)
                 slots = self.compute_assignments(hosts)
-                coord_host = slots[0].hostname
-                if coord_host in ("localhost",):
-                    coord_host = "127.0.0.1"
-                if self.network_interface:
-                    # The coordinator binds on RANK 0's host: the NIC
-                    # override only holds when rank 0 is this machine
-                    # (remote hosts' NIC addresses can't be resolved
-                    # driver-side).
-                    from ..runner.launch import (_is_local,
-                                                 interface_address)
-                    if _is_local(slots[0].hostname):
-                        coord_host = interface_address(
-                            self.network_interface)
-                    else:
-                        log.warning(
-                            "--network-interface %s ignored this round: "
-                            "rank 0 is on remote host %s",
-                            self.network_interface, slots[0].hostname)
+                from ..runner.launch import resolve_coord_host
+                coord_host = resolve_coord_host(
+                    slots[0].hostname, self.network_interface,
+                    warn=log.warning)
                 self._hosts_changed.clear()
                 self.registry.reset()
                 log.info("elastic round %d: %d workers on %s", resets,

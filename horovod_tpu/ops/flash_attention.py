@@ -33,9 +33,9 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
                  block_k: int, seq_len: int, scale: float):
-    # q_ref: [BQ, D]; k_ref/v_ref: [S, D]; o_ref: [BQ, D]
+    # q_ref: [BQ, D]; k_ref/v_ref: [S, D]; o_ref: [BQ, D]; lse_ref: [BQ]
     qi = pl.program_id(2)
     bq = q_ref.shape[0]
     d = q_ref.shape[1]
@@ -74,15 +74,102 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
         return m_new, l, acc
 
     m, l, acc = jax.lax.fori_loop(0, kb_hi, body, (m, l, acc))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    # logsumexp of the SCALED scores — the backward kernels rebuild
+    # p = exp(s - lse) from it without re-running the online softmax.
+    lse_ref[:] = (m + jnp.log(l))[:, 0]
 
 
-def _reference_attention(q, k, v, causal):
-    """XLA attention (same math) — the backward rule recomputes through
-    this, so training gets the Pallas forward + a compiler-derived
-    backward without a hand-written bwd kernel."""
-    from ..models import layers as L
-    return L.causal_attention(q, k, v, causal=causal)
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, causal: bool, block_k: int, seq_len: int,
+                   scale: float):
+    # q/do/dq: [BQ, D]; k/v: [S, D]; lse/delta: [BQ]
+    qi = pl.program_id(2)
+    bq = q_ref.shape[0]
+    d = q_ref.shape[1]
+    qs = q_ref[:].astype(jnp.float32) * scale
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:].astype(jnp.float32)[:, None]
+    delta = delta_ref[:].astype(jnp.float32)[:, None]
+
+    q_start = qi * bq
+    num_kb = pl.cdiv(seq_len, block_k)
+    kb_hi = jnp.minimum(num_kb,
+                        pl.cdiv(q_start + bq, block_k)) if causal else num_kb
+
+    def body(kb, dq):
+        k_start = kb * block_k
+        k = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, kb_hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, causal: bool, block_q: int,
+                    seq_len: int, scale: float):
+    # k/v/dk/dv: [BK, D]; q/do: [S, D]; lse/delta: [S]
+    ki = pl.program_id(2)
+    bk = k_ref.shape[0]
+    d = k_ref.shape[1]
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+
+    k_start = ki * bk
+    num_qb = pl.cdiv(seq_len, block_q)
+    # causal: q blocks strictly before this k block contribute nothing
+    qb_lo = (k_start // block_q) if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_start = qb * block_q
+        qs = q_ref[pl.ds(q_start, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(q_start, block_q)].astype(jnp.float32)[:, None]
+        delta = delta_ref[pl.ds(q_start, block_q)].astype(
+            jnp.float32)[:, None]
+        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                              # [BQ2, BK]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        qb_lo, num_qb, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -92,24 +179,36 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Blockwise attention, model layout [B, S, H, D] with GQA.
 
+    Training uses Pallas kernels on BOTH passes: the forward saves the
+    per-row logsumexp, and the backward rebuilds the probabilities
+    blockwise in two kernels (dq; dk+dv) — the flash-attention backward
+    algorithm, no [S, S] score matrix in either direction.
+
     ``interpret=None`` auto-selects: compiled on TPU backends, Pallas
     interpreter elsewhere (numerics-identical, for tests/CPU smoke)."""
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)[0]
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+                           interpret)
 
 
-flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+def _resolve_blocks(S, block_q, block_k, interpret):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(f"seq len {S} must divide block sizes "
+                         f"({block_q}, {block_k})")
+    return block_q, block_k, interpret
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -117,21 +216,15 @@ flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
                    causal: bool = True,
                    block_q: int = 256, block_k: int = 256,
-                   interpret: Optional[bool] = None) -> jax.Array:
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+                   interpret: Optional[bool] = None):
     B, S, H, D = q.shape
     HK = k.shape[2]
     if H % HK:
         raise ValueError(
             f"q heads ({H}) must be a multiple of kv heads ({HK}) for GQA")
     group = H // HK
-
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
-    if S % block_q or S % block_k:
-        raise ValueError(f"seq len {S} must divide block sizes "
-                         f"({block_q}, {block_k})")
+    block_q, block_k, interpret = _resolve_blocks(S, block_q, block_k,
+                                                  interpret)
 
     # kernel layout [B, H, S, D]
     qt = jnp.swapaxes(q, 1, 2)
@@ -141,7 +234,7 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
 
     kernel = functools.partial(_attn_kernel, causal=causal,
                                block_k=block_k, seq_len=S, scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, S // block_q),
         in_specs=[
@@ -152,9 +245,86 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
             pl.BlockSpec((None, None, S, D),
                          lambda b, h, i, g=group: (b, h // g, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, None, block_q, D),
-                               lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, D),
+                         lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q),
+                         lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+        ],
         interpret=interpret,
     )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2)
+    return jnp.swapaxes(out, 1, 2), lse
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def _flash_backward(q, k, v, out, lse, g, causal: bool = True,
+                    block_q: int = 256, block_k: int = 256,
+                    interpret: Optional[bool] = None):
+    B, S, H, D = q.shape
+    HK = k.shape[2]
+    group = H // HK
+    block_q, block_k, interpret = _resolve_blocks(S, block_q, block_k,
+                                                  interpret)
+    scale = 1.0 / (D ** 0.5)
+
+    qt = jnp.swapaxes(q, 1, 2)
+    do = jnp.swapaxes(g, 1, 2)
+    ot = jnp.swapaxes(out, 1, 2)
+    # GQA: K/V stay at their real [B, HK, S, D] footprint; the h//group
+    # index maps fan each q-head onto its shared kv head (same trick as
+    # the forward), and only the per-q-head dk/dv OUTPUTS carry H extent
+    # before the group summation below.
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    # delta_i = sum_d dO_i * O_i  (the softmax-jacobian row correction)
+    delta = jnp.sum(do.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1)
+
+    qspec = pl.BlockSpec((None, None, block_q, D),
+                         lambda b, h, i: (b, h, i, 0))
+    kvfull = pl.BlockSpec((None, None, S, D),
+                          lambda b, h, i, g=group: (b, h // g, 0, 0))
+    qfull = pl.BlockSpec((None, None, S, D), lambda b, h, i: (b, h, 0, 0))
+    rowq = pl.BlockSpec((None, None, block_q), lambda b, h, i: (b, h, i))
+    rowfull = pl.BlockSpec((None, None, S), lambda b, h, i: (b, h, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, block_k=block_k,
+                          seq_len=S, scale=scale),
+        grid=(B, H, S // block_q),
+        in_specs=[qspec, kvfull, kvfull, qspec, rowq, rowq],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, do, lse, delta)
+
+    kspec = pl.BlockSpec((None, None, block_k, D),
+                         lambda b, h, i: (b, h, i, 0))
+    kvblock = pl.BlockSpec((None, None, block_k, D),
+                           lambda b, h, i, g=group: (b, h // g, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, block_q=block_q,
+                          seq_len=S, scale=scale),
+        grid=(B, H, S // block_k),
+        in_specs=[kvblock, kvblock, qfull, qfull, rowfull, rowfull],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, S, D), v.dtype)],
+        interpret=interpret,
+    )(kt, vt, qt, do, lse, delta)
+
+    if group > 1:  # sum each kv head's group of q-head contributions
+        dk = dk.reshape(B, HK, group, S, D).sum(axis=2)
+        dv = dv.reshape(B, HK, group, S, D).sum(axis=2)
+
+    return (jnp.swapaxes(dq, 1, 2),
+            jnp.swapaxes(dk, 1, 2).astype(k.dtype),
+            jnp.swapaxes(dv, 1, 2).astype(v.dtype))
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -51,8 +51,9 @@ def _route_top1(logits: jnp.ndarray, capacity: int):
 
     Returns the [T, E, C] dispatch tensor (0/1), the [T] combine gate
     (softmax prob, zeroed for dropped tokens), and the load-balancing
-    auxiliary loss (Switch Transformer eq. 4: E * mean(frac_tokens *
-    frac_prob))."""
+    auxiliary loss (Switch Transformer eq. 4: E * sum_e f_e * P_e with
+    f_e the raw pre-capacity token fraction — 1.0 when balanced, up to E
+    on collapse)."""
     T, E = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)
     expert = jnp.argmax(probs, axis=-1)

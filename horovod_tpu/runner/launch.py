@@ -300,6 +300,25 @@ def interface_address(ifname: str) -> str:
         s.close()
 
 
+def resolve_coord_host(rank0_hostname: str,
+                       network_interface: Optional[str],
+                       warn=None) -> str:
+    """The address workers dial for the coordinator: rank 0's host, with
+    localhost normalized, optionally pinned to a NIC's address — but only
+    when rank 0 IS this machine (a remote host's NIC address can't be
+    resolved driver-side; ``warn`` is called with a message instead)."""
+    coord_host = rank0_hostname
+    if _is_local(coord_host):
+        coord_host = "127.0.0.1"
+        if network_interface:
+            coord_host = interface_address(network_interface)
+    elif network_interface and warn is not None:
+        warn(f"--network-interface {network_interface} ignored — rank 0 "
+             f"is on remote host {rank0_hostname}, whose NIC address "
+             f"cannot be resolved driver-side")
+    return coord_host
+
+
 def resolve_hosts(args: argparse.Namespace) -> List[hosts_mod.HostInfo]:
     if args.hosts and args.hostfile:
         raise ValueError("use either --hosts or --hostfile, not both")
@@ -351,20 +370,9 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
         rendezvous.put("rank", str(slot.rank),
                        repr(slot.to_env()).encode())
 
-    coord_host = slots[0].hostname
-    if _is_local(coord_host):
-        coord_host = "127.0.0.1"
-    if args.network_interface:
-        # Workers must dial the coordinator over this NIC's address.
-        # The coordinator binds on rank 0's host, so the override only
-        # holds when that host is this machine.
-        if _is_local(slots[0].hostname):
-            coord_host = interface_address(args.network_interface)
-        else:
-            print(f"[hvdrun] warning: --network-interface "
-                  f"{args.network_interface} ignored — rank 0 is on "
-                  f"remote host {slots[0].hostname}, whose NIC address "
-                  f"cannot be resolved driver-side", file=sys.stderr)
+    coord_host = resolve_coord_host(
+        slots[0].hostname, args.network_interface,
+        warn=lambda m: print(f"[hvdrun] warning: {m}", file=sys.stderr))
     knob_env = args_to_env(args)
 
     procs: List[subprocess.Popen] = []
