@@ -165,6 +165,10 @@ def stop_timeline() -> None:
     _rt.get().stop_timeline()
 
 
+# xprof deep-dive profiling (NVTX-ranges analog; utils/profiler.py)
+from .utils import profiler  # noqa: E402
+
+
 __all__ = [
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
@@ -183,7 +187,7 @@ __all__ = [
     "tpu_built", "xla_built", "mpi_built", "nccl_built", "gloo_built",
     "ccl_built", "ddl_built", "cuda_built", "rocm_built",
     "mpi_enabled", "gloo_enabled", "mpi_threads_supported",
-    "start_timeline", "stop_timeline",
+    "start_timeline", "stop_timeline", "profiler",
     "CheckpointManager", "save_checkpoint", "restore_checkpoint",
     "flash_attention", "run",
     "__version__",

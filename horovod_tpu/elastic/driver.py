@@ -201,10 +201,12 @@ class ElasticDriver:
             while True:
                 hosts = self.wait_for_available_slots(self.min_np)
                 slots = self.compute_assignments(hosts)
-                from ..runner.launch import resolve_coord_host
+                from ..runner.launch import _is_local, resolve_coord_host
                 coord_host = resolve_coord_host(
                     slots[0].hostname, self.network_interface,
-                    warn=log.warning)
+                    warn=log.warning,
+                    has_remote_workers=any(
+                        not _is_local(s.hostname) for s in slots))
                 self._hosts_changed.clear()
                 self.registry.reset()
                 log.info("elastic round %d: %d workers on %s", resets,

@@ -119,9 +119,21 @@ def _compiled(mesh_id: int, kind: str, **static) -> Any:
 
     from ._compat import shard_map
 
+    def annotate(jitted):
+        # NVTX-range analog (reference: nvtx_op_range.h wraps every
+        # user-facing op): xprof correlates this host range with the
+        # device activity it launches; no-op outside a trace session.
+        range_name = f"HOROVOD_{kind.upper()}"
+
+        def dispatch(*args):
+            with jax.profiler.TraceAnnotation(range_name):
+                return jitted(*args)
+        return dispatch
+
     def wrap(body, out_specs=None):
-        return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
-                                 out_specs=out_specs or spec))
+        return annotate(jax.jit(shard_map(body, mesh=mesh,
+                                          in_specs=(spec,),
+                                          out_specs=out_specs or spec)))
 
     if kind == "allreduce":
         op = ReduceOp(static["op"])
@@ -148,16 +160,19 @@ def _compiled(mesh_id: int, kind: str, **static) -> Any:
             return tuple(jnp.reshape(o, l.shape)
                          for o, l in zip(outs, leaves))
         n = static["n_leaves"]
-        return jax.jit(shard_map(
-            gbody, mesh=mesh, in_specs=(spec,) * n, out_specs=(spec,) * n))
+        return annotate(jax.jit(shard_map(
+            gbody, mesh=mesh, in_specs=(spec,) * n,
+            out_specs=(spec,) * n)))
     if kind == "allgather":
         def agbody(x):  # [1, rows, ...] -> full concat, replicated out
             g = spmd.allgather(x, axes, axis=0)
             return g
         # The gathered result is identical on every chip (out_specs=P());
         # jax's varying-mesh-axes check can't prove that, so disable it.
-        return jax.jit(shard_map(agbody, mesh=mesh, in_specs=(spec,),
-                                 out_specs=P(), check_vma=False))
+        return annotate(jax.jit(shard_map(agbody, mesh=mesh,
+                                          in_specs=(spec,),
+                                          out_specs=P(),
+                                          check_vma=False)))
     if kind == "broadcast":
         root = static["root"]
 

@@ -307,14 +307,16 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool = True,
                          lambda b, h, i: (b, h, i, 0))
     kvblock = pl.BlockSpec((None, None, block_k, D),
                            lambda b, h, i, g=group: (b, h // g, i, 0))
+    # Per-q-head dk/dv stay fp32 so the GQA group summation below does
+    # not compound bf16 rounding; one cast to the input dtype at the end.
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, block_q=block_q,
                           seq_len=S, scale=scale),
         grid=(B, H, S // block_k),
         in_specs=[kvblock, kvblock, qfull, qfull, rowfull, rowfull],
         out_specs=[kspec, kspec],
-        out_shape=[jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
-                   jax.ShapeDtypeStruct((B, H, S, D), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((B, H, S, D), jnp.float32),
+                   jax.ShapeDtypeStruct((B, H, S, D), jnp.float32)],
         interpret=interpret,
     )(kt, vt, qt, do, lse, delta)
 

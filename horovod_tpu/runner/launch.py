@@ -302,21 +302,27 @@ def interface_address(ifname: str) -> str:
 
 def resolve_coord_host(rank0_hostname: str,
                        network_interface: Optional[str],
-                       warn=None) -> str:
-    """The address workers dial for the coordinator: rank 0's host, with
-    localhost normalized, optionally pinned to a NIC's address — but only
-    when rank 0 IS this machine (a remote host's NIC address can't be
-    resolved driver-side; ``warn`` is called with a message instead)."""
-    coord_host = rank0_hostname
-    if _is_local(coord_host):
-        coord_host = "127.0.0.1"
+                       warn=None, has_remote_workers: bool = False) -> str:
+    """The address workers dial for the coordinator: rank 0's host.
+
+    When rank 0 is THIS machine: a NIC pin resolves to that interface's
+    address (remotely dialable); otherwise loopback for all-local runs,
+    but the real hostname when remote workers exist — they cannot dial
+    127.0.0.1.  When rank 0 is remote, its NIC address can't be resolved
+    driver-side; ``warn`` is called and the hostname used as-is."""
+    if _is_local(rank0_hostname):
         if network_interface:
-            coord_host = interface_address(network_interface)
-    elif network_interface and warn is not None:
+            return interface_address(network_interface)
+        if not has_remote_workers:
+            return "127.0.0.1"
+        if rank0_hostname in ("localhost", "127.0.0.1"):
+            return socket.gethostname()
+        return rank0_hostname
+    if network_interface and warn is not None:
         warn(f"--network-interface {network_interface} ignored — rank 0 "
              f"is on remote host {rank0_hostname}, whose NIC address "
              f"cannot be resolved driver-side")
-    return coord_host
+    return rank0_hostname
 
 
 def resolve_hosts(args: argparse.Namespace) -> List[hosts_mod.HostInfo]:
@@ -372,7 +378,8 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
 
     coord_host = resolve_coord_host(
         slots[0].hostname, args.network_interface,
-        warn=lambda m: print(f"[hvdrun] warning: {m}", file=sys.stderr))
+        warn=lambda m: print(f"[hvdrun] warning: {m}", file=sys.stderr),
+        has_remote_workers=any(not _is_local(s.hostname) for s in slots))
     knob_env = args_to_env(args)
 
     procs: List[subprocess.Popen] = []

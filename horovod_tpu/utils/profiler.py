@@ -1,0 +1,68 @@
+"""xprof / JAX-profiler integration — the TPU-native analog of the
+reference's NVTX op ranges (reference: horovod/common/nvtx_op_range.h +
+operations.cc:1018-1033: every user-facing op opens an NVTX range so
+device traces attribute time to the op that launched it).
+
+On TPU the tracer is the JAX profiler (xprof/TensorBoard): ``start`` /
+``stop`` wrap a trace session, and ``annotate`` opens a named host range
+that xprof correlates with device activity.  The framework's eager
+collectives annotate themselves (ops/collectives.py), so a captured
+trace shows HOROVOD_ALLREDUCE etc. exactly where the reference would
+show its NVTX ranges.  The Chrome-trace Timeline (utils/timeline.py)
+remains the lightweight always-on story; this is the deep-dive tool.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+_active_logdir: Optional[str] = None
+
+
+def start(logdir: str) -> None:
+    """Begin an xprof trace session writing into ``logdir`` (view with
+    TensorBoard's profile plugin or xprof)."""
+    global _active_logdir
+    import jax
+    jax.profiler.start_trace(logdir)
+    _active_logdir = logdir
+
+
+def stop() -> None:
+    global _active_logdir
+    import jax
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        # Clear even when stop_trace raises (e.g. the session was already
+        # stopped directly through jax.profiler) — a stuck is_active()
+        # would block every future session in this process.
+        _active_logdir = None
+
+
+def is_active() -> bool:
+    return _active_logdir is not None
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """``with hvd.profiler.trace("/tmp/prof"): step()`` — session-scoped
+    capture."""
+    start(logdir)
+    try:
+        yield
+    finally:
+        stop()
+
+
+def annotate(name: str):
+    """Named range correlated with device activity in the captured trace
+    (NVTX-range analog).  Usable as context manager or decorator; cheap
+    enough to leave on unconditionally — outside a trace session the
+    annotation is a no-op."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)
+
+
+__all__ = ["start", "stop", "trace", "annotate", "is_active"]

@@ -187,3 +187,24 @@ def test_knobs_overrides(monkeypatch):
     monkeypatch.delenv("HOROVOD_CYCLE_TIME", raising=False)
     k = Knobs({"HOROVOD_CYCLE_TIME": 5.0})
     assert k["HOROVOD_CYCLE_TIME"] == 5.0
+
+
+def test_profiler_trace_captures_session(tmp_path, hvd):
+    """hvd.profiler (utils/profiler.py): an xprof session wraps eager
+    collectives (which self-annotate with HOROVOD_* ranges, the NVTX
+    analog) and writes profile data under the logdir."""
+    import numpy as np
+    import horovod_tpu as hvd_mod
+
+    logdir = str(tmp_path / "prof")
+    assert not hvd_mod.profiler.is_active()
+    with hvd_mod.profiler.trace(logdir):
+        assert hvd_mod.profiler.is_active()
+        with hvd_mod.profiler.annotate("user_range"):
+            out = hvd_mod.allreduce(np.ones(8, np.float32),
+                                    op=hvd_mod.Average)
+        np.testing.assert_allclose(np.asarray(out)[0], np.ones(8))
+    assert not hvd_mod.profiler.is_active()
+    import os
+    found = [f for root, _, fs in os.walk(logdir) for f in fs]
+    assert found, "trace session wrote no profile files"

@@ -276,3 +276,27 @@ def test_output_filename_captures_per_rank(tmp_path):
     for rank in (0, 1):
         data = (outdir / f"rank.{rank}" / "stdout").read_bytes().decode()
         assert f"hello from {rank}" in data
+
+
+def test_resolve_coord_host_semantics():
+    """Coordinator address rules: loopback only for all-local runs; the
+    real hostname when remote workers must dial in; NIC pin only when
+    rank 0 is this machine (regression: multi-host runs handed remotes
+    127.0.0.1)."""
+    import socket
+    from horovod_tpu.runner.launch import resolve_coord_host
+
+    # all-local: loopback
+    assert resolve_coord_host("localhost", None) == "127.0.0.1"
+    # local rank 0 + remote workers: a remotely-dialable name
+    got = resolve_coord_host("localhost", None, has_remote_workers=True)
+    assert got == socket.gethostname()
+    here = socket.gethostname()
+    assert resolve_coord_host(here, None,
+                              has_remote_workers=True) == here
+    # remote rank 0: hostname passes through, NIC pin warns
+    warnings = []
+    assert resolve_coord_host("far-away-host", "eth0",
+                              warn=warnings.append,
+                              has_remote_workers=True) == "far-away-host"
+    assert warnings and "eth0" in warnings[0]
