@@ -200,7 +200,36 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
                   f"warmup to {self._get_lr():.6g}.")
 
 
+class BestModelCheckpoint(keras.callbacks.ModelCheckpoint):
+    """``ModelCheckpoint(save_best_only=True)`` whose filepath is injected
+    later (reference: keras/callbacks.py:151-164 — the Spark Keras
+    estimator sets ``filepath`` on the driver-side copy before fit)."""
+
+    _UNSET_STEM = "__hvd_best_model_unset__"
+
+    def __init__(self, monitor: str = "val_loss", verbose: int = 0,
+                 save_weights_only: bool = False, mode: str = "auto",
+                 save_freq="epoch"):
+        sentinel = self._UNSET_STEM + (".weights.h5" if save_weights_only
+                                       else ".keras")
+        super().__init__(filepath=sentinel, monitor=monitor,
+                         verbose=verbose, save_best_only=True,
+                         save_weights_only=save_weights_only,
+                         mode=mode, save_freq=save_freq)
+
+    def set_filepath(self, filepath: str) -> None:
+        self.filepath = filepath
+
+    def _save_model(self, *args, **kwargs):
+        if self._UNSET_STEM in str(self.filepath):
+            raise ValueError(
+                "BestModelCheckpoint has no filepath; call "
+                "set_filepath(...) before fit()")
+        return super()._save_model(*args, **kwargs)
+
+
 __all__ = [
     "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
     "LearningRateScheduleCallback", "LearningRateWarmupCallback",
+    "BestModelCheckpoint",
 ]

@@ -12,8 +12,8 @@ from __future__ import annotations
 import tensorflow as tf
 
 from ...keras.callbacks import (  # noqa: F401  (shared impl layer)
-    LearningRateScheduleCallback, LearningRateWarmupCallback,
-    MetricAverageCallback)
+    BestModelCheckpoint, LearningRateScheduleCallback,
+    LearningRateWarmupCallback, MetricAverageCallback)
 from ..functions import broadcast_variables
 
 
@@ -35,39 +35,6 @@ class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
                                        root_rank=self.root_rank)
             self.broadcast_done = True
 
-
-class BestModelCheckpoint(tf.keras.callbacks.ModelCheckpoint):
-    """``ModelCheckpoint(save_best_only=True)`` whose filepath is injected
-    later (reference: tensorflow/keras/callbacks.py:151-164 — the Spark
-    Keras estimator sets ``filepath`` on the driver-side copy before fit).
-    """
-
-    _UNSET_STEM = "__hvd_best_model_unset__"
-
-    def __init__(self, monitor: str = "val_loss", verbose: int = 0,
-                 save_weights_only: bool = False, mode: str = "auto",
-                 save_freq="epoch"):
-        # Keras-3 ModelCheckpoint validates the filepath suffix at __init__
-        # (and requires '.weights.h5' when save_weights_only); a sentinel
-        # stands in until set_filepath() provides the real one.
-        sentinel = self._UNSET_STEM + (".weights.h5" if save_weights_only
-                                       else ".keras")
-        super().__init__(filepath=sentinel, monitor=monitor,
-                         verbose=verbose, save_best_only=True,
-                         save_weights_only=save_weights_only,
-                         mode=mode, save_freq=save_freq)
-
-    def set_filepath(self, filepath: str) -> None:
-        self.filepath = filepath
-
-    def _save_model(self, *args, **kwargs):
-        # Single choke point for every save cadence (epoch AND integer
-        # save_freq batch saves): refuse to write the sentinel path.
-        if self._UNSET_STEM in str(self.filepath):
-            raise ValueError(
-                "BestModelCheckpoint has no filepath; call "
-                "set_filepath(...) before fit()")
-        return super()._save_model(*args, **kwargs)
 
 
 __all__ = [

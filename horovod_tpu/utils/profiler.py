@@ -58,11 +58,19 @@ def trace(logdir: str) -> Iterator[None]:
 
 def annotate(name: str):
     """Named range correlated with device activity in the captured trace
-    (NVTX-range analog).  Usable as context manager or decorator; cheap
-    enough to leave on unconditionally — outside a trace session the
-    annotation is a no-op."""
+    (NVTX-range analog).  Context manager; cheap enough to leave on
+    unconditionally — outside a trace session the annotation is a no-op.
+    For the decorator form use :func:`annotate_function`."""
     import jax
     return jax.profiler.TraceAnnotation(name)
 
 
-__all__ = ["start", "stop", "trace", "annotate", "is_active"]
+def annotate_function(fn, name: Optional[str] = None):
+    """Decorator form: every call of ``fn`` opens a named range
+    (``jax.profiler.annotate_function`` passthrough)."""
+    import jax
+    return jax.profiler.annotate_function(fn, name=name)
+
+
+__all__ = ["start", "stop", "trace", "annotate", "annotate_function",
+           "is_active"]

@@ -1,0 +1,39 @@
+"""bench.py smoke: the driver's benchmark entry must keep producing its
+one-line JSON contract in CPU mode for both metrics (llama tokens/sec and
+resnet images/sec).  Subprocess-isolated — bench.py owns process-global
+jax config."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(*flags):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--cpu", *flags],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = out.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+@pytest.mark.slow
+def test_bench_llama_cpu_contract():
+    rec = _run_bench()
+    assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "tokens/sec/chip"
+    assert rec["value"] > 0
+    assert 0 < rec["vs_baseline"] < 1
+
+
+@pytest.mark.slow
+def test_bench_resnet_cpu_contract():
+    rec = _run_bench("--resnet")
+    assert rec["unit"] == "images/sec/chip"
+    assert rec["value"] > 0
+    assert 0 < rec["vs_baseline"] < 1

@@ -183,3 +183,15 @@ def test_load_model_wraps_optimizer(hvd, hk, tmp_path):
 def test_distribution_covers_mesh(hvd, hk):
     dist = hk.distribution()
     assert len(dist.device_mesh.devices.flatten()) == hvd.size()
+
+
+def test_best_model_checkpoint_requires_filepath():
+    """keras frontend BestModelCheckpoint (reference:
+    keras/callbacks.py:151): sentinel path must refuse to save."""
+    import pytest as _pt
+    import horovod_tpu.keras as hvdk
+    cb = hvdk.callbacks.BestModelCheckpoint()
+    with _pt.raises(ValueError, match="filepath"):
+        cb.on_epoch_end(0, {"val_loss": 1.0})
+    cb2 = hvdk.callbacks.BestModelCheckpoint(save_weights_only=True)
+    assert cb2.filepath.endswith(".weights.h5")
