@@ -35,7 +35,7 @@ from .mpi_ops import (allreduce, allreduce_, allreduce_async,
                       grouped_allreduce_async_, allgather, allgather_async,
                       broadcast, broadcast_, broadcast_async,
                       broadcast_async_, alltoall, alltoall_async,
-                      synchronize, poll, join)
+                      sparse_allreduce_async, synchronize, poll, join)
 from .optimizer import DistributedOptimizer
 from .functions import (broadcast_parameters, broadcast_optimizer_state,
                         broadcast_object, allgather_object)
@@ -52,7 +52,8 @@ __all__ = [
     "grouped_allreduce", "grouped_allreduce_", "grouped_allreduce_async",
     "grouped_allreduce_async_", "allgather", "allgather_async",
     "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
-    "alltoall", "alltoall_async", "synchronize", "poll", "join",
+    "alltoall", "alltoall_async", "sparse_allreduce_async",
+    "synchronize", "poll", "join",
     "DistributedOptimizer",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object", "SyncBatchNorm", "elastic",

@@ -264,6 +264,9 @@ def _execute_response(resp) -> None:
                              op=ReduceOp(int(extra)) if extra else Sum)
             elif kind == "allgather":
                 _C.allgather(arrs[0])
+            elif kind == "allgather_ragged":
+                # 0-row contribution: peers' concat sees nothing from us.
+                _C.allgather_ragged([arrs[0]] * _rt.get().local_size())
             elif kind == "broadcast":
                 _C.broadcast(arrs[0],
                              root_rank=int(extra) if extra else 0)
@@ -545,6 +548,61 @@ def allgather(tensor: torch.Tensor,
     if tensor.requires_grad:
         return _AllgatherFunction.apply(tensor, name)
     return synchronize(allgather_async(tensor, name))
+
+
+def _allgather_ragged_async(tensor: torch.Tensor, name: str) -> int:
+    """Negotiated allgather whose FIRST dim may differ across processes
+    (the reference's allgather negotiates per-rank sizes natively,
+    controller.cc:580-650).  The signature canonicalizes dim0 to 0 so
+    ragged submissions agree across ranks — and a JOINed rank's zero
+    dummy is then a 0-row contribution, which is exactly right."""
+    rest = "x".join(str(s) for s in tensor.shape[1:])
+    sig = (f"{_SIG_DTYPE.get(tensor.dtype, str(tensor.dtype))}:0x{rest}:"
+           f"allgather_ragged:")
+
+    def execute():
+        rt = _rt.get()
+        out = np.asarray(_C.allgather_ragged(
+            [_np_from_torch(tensor)] * rt.local_size(), name=name))
+        return _torch_from_np(out, tensor.dtype)
+
+    return _dispatch(name, sig, _basics.OP_ALLGATHER, _nbytes(tensor),
+                     "allgather_ragged", execute)
+
+
+def sparse_allreduce_async(tensor: torch.Tensor,
+                           name: Optional[str] = None,
+                           op: ReduceOp = Average):
+    """Allreduce a ``torch.sparse_coo_tensor`` by gathering every chip's
+    (indices, values) and re-assembling — duplicates coalesce-sum on use
+    (reference: torch/mpi_ops.py:512-531 sparse_allreduce_async; like the
+    reference this returns a CALLABLE handle whose invocation yields the
+    reduced sparse tensor).
+
+    Both gathers ride the negotiated dispatch like every other torch op,
+    so cross-process hook-order nondeterminism cannot interleave them
+    with other collectives; per-chip nnz may differ (ragged path)."""
+    name = name or _auto_name("sparse_allreduce")
+    t = tensor.coalesce() if not tensor.is_coalesced() else tensor
+    # [ndim, nnz] -> [nnz, ndim] so rows concatenate per element.
+    idx_handle = _allgather_ragged_async(
+        t._indices().transpose(0, 1).contiguous(), f"{name}.indices")
+    val_handle = _allgather_ragged_async(t._values(), f"{name}.values")
+    size_at_submit = _rt.get().size()  # elastic resize must not skew it
+
+    def handle():
+        indices = synchronize(idx_handle)
+        values = synchronize(val_handle)
+        vals = values / size_at_submit if op == Average else values
+        if indices.numel() == 0 or vals.numel() == 0:
+            return torch.sparse_coo_tensor(
+                torch.zeros((t._indices().shape[0], 0), dtype=torch.long),
+                torch.zeros((0,) + tuple(t._values().shape[1:]),
+                            dtype=t.dtype), t.shape)
+        return torch.sparse_coo_tensor(indices.transpose(0, 1), vals,
+                                       t.shape)
+
+    return handle
 
 
 # ------------------------------------------------------------------ broadcast
