@@ -1,0 +1,82 @@
+"""Elastic tf.keras training (reference:
+examples/elastic/tensorflow2/tensorflow2_keras_mnist_elastic.py):
+survives host membership changes via ``hvd.elastic.run`` + ``KerasState``
+commit/restore, with the state callbacks tracking batch/epoch so a reset
+resumes mid-epoch.
+
+    hvdrun -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover_hosts.sh \
+        python examples/tensorflow2/tensorflow2_keras_elastic.py
+"""
+
+import argparse
+import os
+
+
+def make_data(n=2048, classes=10, dim=784, seed=0):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    templates = rng.randn(classes, dim).astype("float32")
+    y = rng.randint(0, classes, n)
+    x = templates[y] + 0.8 * rng.randn(n, dim).astype("float32")
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("KERAS_BACKEND", "tensorflow")
+
+    import tensorflow as tf
+    import horovod_tpu.tensorflow.keras as hvd
+
+    hvd.init()
+
+    x, y = make_data()
+    model = tf.keras.Sequential([
+        tf.keras.Input((784,)),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.Adam(1e-3 * hvd.size()))
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True),
+        metrics=["accuracy"], jit_compile=False)
+    model(x[:1])  # build variables before wrapping them in state
+
+    state = hvd.elastic.KerasState(model, batch=0, epoch=0)
+
+    def on_state_reset():
+        # A reset round rebuilt the mesh: rescale the LR to the new world
+        # size (the reference's elastic keras example does the same).
+        opt.learning_rate = 1e-3 * hvd.size()
+
+    state.register_reset_callbacks([on_state_reset])
+
+    @hvd.elastic.run
+    def train(state):
+        model.fit(
+            x, y, batch_size=args.batch,
+            initial_epoch=state.epoch, epochs=args.epochs,
+            callbacks=[
+                hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                hvd.callbacks.MetricAverageCallback(),
+                hvd.elastic.CommitStateCallback(state),
+                hvd.elastic.UpdateBatchStateCallback(state),
+                hvd.elastic.UpdateEpochStateCallback(state),
+            ],
+            verbose=1 if hvd.rank() == 0 else 0)
+
+    train(state)
+
+
+if __name__ == "__main__":
+    main()

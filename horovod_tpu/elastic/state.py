@@ -32,6 +32,7 @@ class State:
     def __init__(self, **kwargs: Any):
         self._saved: Dict[str, Any] = {}
         self._host_updated: Callable[[], bool] = lambda: False
+        self._reset_callbacks: list = []
         for k, v in kwargs.items():
             setattr(self, k, v)
         self._fields = list(kwargs.keys())
@@ -39,6 +40,18 @@ class State:
     # -- reset plumbing -----------------------------------------------------
     def register_host_update_check(self, fn: Callable[[], bool]) -> None:
         self._host_updated = fn
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        """Callbacks invoked after every elastic reset, before training
+        resumes (reference: common/elastic.py State.register_reset_callbacks
+        — the canonical use is rescaling the LR to the new world size)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        """Run registered reset callbacks (called by the @run wrapper after
+        a hard or soft reset re-formed the mesh)."""
+        for cb in self._reset_callbacks:
+            cb()
 
     def check_host_updates(self) -> None:
         """Raise HostsUpdatedInterrupt when membership changed (reference:

@@ -113,5 +113,7 @@ def run(func: Callable) -> Callable:
                 raise RuntimeError(
                     f"elastic reset limit {reset_limit} exceeded "
                     "(reference: --reset-limit semantics)")
+            if hasattr(state, "on_reset"):
+                state.on_reset()  # user hooks, e.g. LR rescale to new size
             state.sync()
     return wrapper

@@ -46,7 +46,7 @@ __all__ = [
     "grouped_allreduce_async_",
     "allgather", "allgather_async", "broadcast", "broadcast_",
     "broadcast_async", "broadcast_async_", "alltoall", "alltoall_async",
-    "synchronize", "poll", "join",
+    "sparse_allreduce_async", "synchronize", "poll", "join",
 ]
 
 
@@ -593,12 +593,14 @@ def sparse_allreduce_async(tensor: torch.Tensor,
     def handle():
         indices = synchronize(idx_handle)
         values = synchronize(val_handle)
+        # Average true-divides (int values become float, matching the
+        # reference's `values / size()`).
         vals = values / size_at_submit if op == Average else values
         if indices.numel() == 0 or vals.numel() == 0:
             return torch.sparse_coo_tensor(
                 torch.zeros((t._indices().shape[0], 0), dtype=torch.long),
                 torch.zeros((0,) + tuple(t._values().shape[1:]),
-                            dtype=t.dtype), t.shape)
+                            dtype=vals.dtype), t.shape)
         return torch.sparse_coo_tensor(indices.transpose(0, 1), vals,
                                        t.shape)
 

@@ -220,3 +220,16 @@ def test_elastic_driver_output_filename(tmp_path):
     for rank in (0, 1):
         text = (outdir / f"rank.{rank}" / "stdout").read_bytes().decode()
         assert f"out rank {rank}" in text
+
+
+def test_state_reset_callbacks():
+    """register_reset_callbacks (reference: common/elastic.py State):
+    callbacks fire after every reset via on_reset()."""
+    from horovod_tpu.elastic.state import State
+
+    calls = []
+    s = State(epoch=0)
+    s.register_reset_callbacks([lambda: calls.append("a"),
+                                lambda: calls.append("b")])
+    s.on_reset()
+    assert calls == ["a", "b"]
