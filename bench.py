@@ -63,6 +63,47 @@ def detect_chip() -> str:
     return os.environ.get("PALLAS_AXON_TPU_GEN", "") or "v5e"
 
 
+def _init_with_retry(hvd, expect_tpu: bool, attempts: int = 3,
+                     delay_s: float = 45.0) -> None:
+    """Backend bring-up with retries: the remote-TPU tunnel can throw
+    transient 'backend setup/compile error (Unavailable)'.
+
+    jax caches backend-init state process-globally, so a naive re-call
+    would re-raise the cached error — or worse, silently hand back an
+    already-registered CPU backend and benchmark the wrong hardware.
+    Each retry clears jax's backend cache first, and a successful init
+    that landed on CPU when a TPU was expected counts as a failure."""
+    import jax
+
+    def clear_backends():
+        try:
+            from jax._src import xla_bridge
+            xla_bridge._clear_backends()
+        except Exception as e:  # private API moved: fail loudly, no retry
+            raise RuntimeError(
+                f"cannot clear jax backend cache for retry: {e}")
+
+    last: Exception = RuntimeError("backend init never attempted")
+    for i in range(attempts):
+        try:
+            hvd.init()
+            if expect_tpu and jax.devices()[0].platform == "cpu":
+                raise RuntimeError(
+                    "Unavailable: TPU expected but jax fell back to the "
+                    "CPU backend")
+            return
+        except RuntimeError as e:
+            last = e
+            if "Unavailable" not in str(e) or i == attempts - 1:
+                raise
+            print(f"backend unavailable (attempt {i + 1}/{attempts}); "
+                  f"retrying in {delay_s:.0f}s", file=sys.stderr)
+            hvd.shutdown()
+            clear_backends()
+            time.sleep(delay_s)
+    raise last
+
+
 def fail(reason: str, **extra) -> int:
     print(json.dumps({"metric": "BENCH_INVALID", "value": 0,
                       "unit": "error", "vs_baseline": 0,
@@ -137,7 +178,7 @@ def main() -> int:
         cfg = llama.CONFIGS["tiny"]
         args.batch, args.seq, args.steps = 4, 64, 4
 
-    hvd.init()
+    _init_with_retry(hvd, expect_tpu=not args.cpu)
     mesh = hvd.mesh()
     n_chips = hvd.size()
 
@@ -241,7 +282,7 @@ def resnet_bench(args) -> int:
     from horovod_tpu.ops._compat import shard_map
     from horovod_tpu.parallel.data_parallel import replicate, shard_batch
 
-    hvd.init()
+    _init_with_retry(hvd, expect_tpu=not args.cpu)
     mesh = hvd.mesh()
     n_chips = hvd.size()
     batch = args.batch if args.batch is not None else 64  # per chip
