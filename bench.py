@@ -83,7 +83,7 @@ def _init_with_retry(hvd, expect_tpu: bool, attempts: int = 3,
             raise RuntimeError(
                 f"cannot clear jax backend cache for retry: {e}")
 
-    last: Exception = RuntimeError("backend init never attempted")
+    assert attempts >= 1
     for i in range(attempts):
         try:
             hvd.init()
@@ -93,15 +93,15 @@ def _init_with_retry(hvd, expect_tpu: bool, attempts: int = 3,
                     "CPU backend")
             return
         except RuntimeError as e:
-            last = e
-            if "Unavailable" not in str(e) or i == attempts - 1:
+            # case-insensitive: the tunnel emits mixed-case messages AND
+            # canonical upper-case gRPC status prefixes ('UNAVAILABLE:')
+            if "unavailable" not in str(e).lower() or i == attempts - 1:
                 raise
             print(f"backend unavailable (attempt {i + 1}/{attempts}); "
                   f"retrying in {delay_s:.0f}s", file=sys.stderr)
             hvd.shutdown()
             clear_backends()
             time.sleep(delay_s)
-    raise last
 
 
 def fail(reason: str, **extra) -> int:

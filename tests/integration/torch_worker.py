@@ -86,6 +86,24 @@ def main() -> int:
         assert np.allclose(per_chip[c], per_chip[0], atol=1e-6), c
     assert not np.allclose(w_now, w0.numpy()), "weights never updated"
 
+    # ---- sparse allreduce: DIFFERENT nnz per process (ragged path) ----
+    # Process 0 contributes 1 element, process 1 contributes 2 — the
+    # negotiated allgather_ragged signature canonicalizes the first dim,
+    # so the ranks still agree and the reduced sparse tensor sums every
+    # chip's contribution (each process drives 4 chips).
+    if pr == 0:
+        sp = torch.sparse_coo_tensor(torch.tensor([[1], [0]]),
+                                     torch.tensor([10.0]), (4, 2))
+    else:
+        sp = torch.sparse_coo_tensor(torch.tensor([[1, 3], [0, 1]]),
+                                     torch.tensor([2.0, 8.0]), (4, 2))
+    out_sp = hvd.sparse_allreduce_async(sp, name="sparse0", op=hvd.Sum)()
+    dense = out_sp.coalesce().to_dense().numpy()
+    want_sp = np.zeros((4, 2), np.float32)
+    want_sp[1, 0] = 4 * 10.0 + 4 * 2.0   # both processes hit (1,0)
+    want_sp[3, 1] = 4 * 8.0              # only process 1
+    assert np.allclose(dense, want_sp), dense
+
     # ---- timeline lifecycle: per-tensor NEGOTIATE -> QUEUE -> EXEC -----
     import horovod_tpu.runtime as rt
     rt.get().timeline.close()
