@@ -83,7 +83,8 @@ def _init_with_retry(hvd, expect_tpu: bool, attempts: int = 3,
             raise RuntimeError(
                 f"cannot clear jax backend cache for retry: {e}")
 
-    assert attempts >= 1
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
     for i in range(attempts):
         try:
             hvd.init()

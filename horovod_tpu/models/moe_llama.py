@@ -32,6 +32,7 @@ class MoeLlamaConfig:
     n_kv_heads: int = 4
     moe_hidden: int = 512
     n_experts: int = 8
+    experts_per_token: int = 1  # 1 = Switch, 2 = Mixtral top-2
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
     max_seq: int = 512
@@ -48,6 +49,11 @@ CONFIGS = {
                            n_kv_heads=2, moe_hidden=128, n_experts=4,
                            max_seq=128),
     "mini": MoeLlamaConfig(),
+    # Mixtral-style top-2 routing with renormalized gates
+    "mixtral-tiny": MoeLlamaConfig(vocab=256, dim=64, n_layers=2,
+                                   n_heads=4, n_kv_heads=2,
+                                   moe_hidden=128, n_experts=8,
+                                   experts_per_token=2, max_seq=128),
 }
 
 
@@ -95,10 +101,11 @@ def _moe_block(p_moe: Dict[str, Any], x: jax.Array,
     if moe_fn is not None:
         y, aux = moe_fn(p_moe, tokens)
     else:
-        capacity = int(math.ceil(B * S * cfg.capacity_factor /
-                                 cfg.n_experts))
+        capacity = int(math.ceil(B * S * cfg.experts_per_token *
+                                 cfg.capacity_factor / cfg.n_experts))
         y, aux = moe_dense_reference(p_moe, tokens, cfg.n_experts,
-                                     capacity)
+                                     capacity,
+                                     experts_per_token=cfg.experts_per_token)
     return y.reshape(B, S, D), aux
 
 

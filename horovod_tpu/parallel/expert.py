@@ -46,34 +46,50 @@ def init_moe_params(key, dim: int, hidden: int, n_experts: int,
     }
 
 
-def _route_top1(logits: jnp.ndarray, capacity: int):
-    """Switch router: per-token best expert, capacity-bounded.
+def _route_topk(logits: jnp.ndarray, capacity: int, k: int = 1):
+    """Top-k router, capacity-bounded (k=1: Switch; k=2: Mixtral/GShard).
 
-    Returns the [T, E, C] dispatch tensor (0/1), the [T] combine gate
-    (softmax prob, zeroed for dropped tokens), and the load-balancing
-    auxiliary loss (Switch Transformer eq. 4: E * sum_e f_e * P_e with
-    f_e the raw pre-capacity token fraction — 1.0 when balanced, up to E
-    on collapse)."""
+    Returns the [T, E, C] dispatch tensor (0/1), the [T, E, C] COMBINE
+    tensor (dispatch weighted by each choice's gate), and the
+    load-balancing auxiliary loss (Switch eq. 4 generalized:
+    E * sum_e f_e * P_e with f_e the raw pre-capacity fraction of
+    routing assignments — 1.0 when balanced, up to E on collapse; the
+    raw fraction is used because capacity-masking f_e would clamp the
+    hot expert exactly when imbalance is worst).
+
+    Gate convention follows the papers: k=1 uses the raw softmax prob
+    (Switch); k>1 renormalizes the selected gates to sum to 1 per token
+    (Mixtral).  Capacity slots are granted choice-major (every token's
+    1st choice before any 2nd choice — GShard's priority order)."""
     T, E = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
-    raw_onehot = jax.nn.one_hot(expert, E, dtype=logits.dtype)
-    # Aux from the RAW routing assignment (pre-capacity): Switch eq. 4,
-    # alpha * E * sum_e f_e * P_e — equals 1 under perfect balance and
-    # grows toward E as the router collapses.  Masking f_e by capacity
-    # would clamp the hot expert's fraction exactly when imbalance is
-    # worst, neutering the regularizer.
-    aux = E * jnp.sum(jnp.mean(raw_onehot, axis=0) *
-                      jnp.mean(probs, axis=0))
-    position = jnp.cumsum(raw_onehot, axis=0) * raw_onehot  # 1-based
-    within = position <= capacity
-    onehot = raw_onehot * within
-    disp = onehot[:, :, None] * jax.nn.one_hot(
-        jnp.maximum(position - 1, 0).astype(jnp.int32), capacity,
-        dtype=logits.dtype)
-    gate = gate * onehot.sum(-1)  # dropped tokens contribute nothing
-    return disp, gate, aux
+    top_vals, top_idx = jax.lax.top_k(probs, k)  # [T, k]
+    if k > 1:
+        gates = top_vals / jnp.maximum(
+            top_vals.sum(-1, keepdims=True), 1e-30)
+    else:
+        gates = top_vals
+
+    disp = jnp.zeros((T, E, capacity), logits.dtype)
+    comb = jnp.zeros((T, E, capacity), logits.dtype)
+    raw_total = jnp.zeros((E,), logits.dtype)
+    slot_base = jnp.zeros((1, E), logits.dtype)
+    for j in range(k):
+        oh = jax.nn.one_hot(top_idx[:, j], E, dtype=logits.dtype)
+        raw_total = raw_total + oh.sum(0)
+        # 1-based slot per (token, expert), offset past prior choices'
+        # claims so slots never collide across choice ranks.
+        position = slot_base + jnp.cumsum(oh, axis=0) * oh
+        within = jnp.logical_and(position >= 1, position <= capacity)
+        ohk = oh * within
+        disp_j = ohk[:, :, None] * jax.nn.one_hot(
+            jnp.maximum(position - 1, 0).astype(jnp.int32), capacity,
+            dtype=logits.dtype)
+        disp = disp + disp_j
+        comb = comb + disp_j * gates[:, j][:, None, None]
+        slot_base = slot_base + oh.sum(0, keepdims=True)
+    aux = E * jnp.sum((raw_total / (T * k)) * jnp.mean(probs, axis=0))
+    return disp, comb, aux
 
 
 def _expert_ffn(wi, wo, x):
@@ -85,10 +101,15 @@ def _expert_ffn(wi, wo, x):
 
 def make_moe_fn(mesh: Mesh, n_experts: int,
                 capacity_factor: float = 1.25,
-                axis: str = "ep") -> Callable:
+                axis: str = "ep",
+                experts_per_token: int = 1) -> Callable:
     """Build ``apply(params, x) -> (y, aux_loss)`` where ``x`` is
     [T, D] tokens (sharded over ``axis``) and ``params`` comes from
     :func:`init_moe_params` (experts sharded over ``axis``).
+
+    ``experts_per_token``: 1 = Switch (raw-prob gate), 2 = Mixtral-style
+    top-2 with renormalized gates.  Capacity scales with it:
+    ``ceil(T * k * capacity_factor / E)`` slots per expert.
 
     Differentiable end-to-end; ``aux_loss`` is the Switch load-balancing
     term (mean over shards), to be added to the task loss scaled by the
@@ -107,9 +128,11 @@ def make_moe_fn(mesh: Mesh, n_experts: int,
              check_vma=False)
     def _inner(params, x):
         T = x.shape[0]  # local token count
-        capacity = int(np.ceil(T * capacity_factor / n_experts))
+        capacity = int(np.ceil(T * experts_per_token * capacity_factor /
+                               n_experts))
         logits = x @ params["router"]
-        disp, gate, aux = _route_top1(logits, capacity)
+        disp, comb, aux = _route_topk(logits, capacity,
+                                      k=experts_per_token)
 
         # [T,D] x [T,E,C] -> [E,C,D]: tokens in their expert's slot.
         xd = jnp.einsum("td,tec->ecd", x, disp)
@@ -129,8 +152,8 @@ def make_moe_fn(mesh: Mesh, n_experts: int,
         yd = lax.all_to_all(yd, axis, split_axis=0, concat_axis=0,
                             tiled=False)
         yd = yd.reshape(n_experts, capacity, yd.shape[-1])
-        # Combine back to token order, weighted by the gate.
-        y = jnp.einsum("ecd,tec->td", yd, disp) * gate[:, None]
+        # Combine back to token order, weighted per choice by the gate.
+        y = jnp.einsum("ecd,tec->td", yd, comb)
         return y, lax.pmean(aux, axis)
 
     def apply(params, x):
@@ -152,16 +175,17 @@ def moe_shardings(mesh: Mesh, params: Any, axis: str = "ep"):
     }
 
 
-def moe_dense_reference(params, x, n_experts: int, capacity: int):
+def moe_dense_reference(params, x, n_experts: int, capacity: int,
+                        experts_per_token: int = 1):
     """Single-device reference with IDENTICAL routing math (for tests):
-    every token goes through its routed expert unless over capacity."""
+    every token goes through its routed expert(s) unless over capacity."""
     logits = x @ params["router"]
-    disp, gate, aux = _route_top1(logits, capacity)
+    disp, comb, aux = _route_topk(logits, capacity, k=experts_per_token)
     y_all = jnp.einsum("td,edh->teh", x, params["wi"])
     y_all = jax.nn.gelu(y_all)
     y_all = jnp.einsum("teh,ehd->ted", y_all, params["wo"])
-    sel = disp.sum(-1)  # [T, E] 0/1 kept-assignment
-    y = jnp.einsum("ted,te->td", y_all, sel) * gate[:, None]
+    sel = comb.sum(-1)  # [T, E] per-(token,expert) combine weight
+    y = jnp.einsum("ted,te->td", y_all, sel)
     return y, aux
 
 

@@ -186,3 +186,78 @@ def test_moe_llama_param_count(hvd):
     n = sum(int(np.prod(l.shape))
             for l in jax.tree_util.tree_leaves(params))
     assert n == moe_llama.param_count(cfg), (n, moe_llama.param_count(cfg))
+
+
+# -------------------------------------------------------------- top-k routing
+def test_moe_top2_matches_dense_reference(hvd):
+    mesh = _mesh(hvd)
+    E, D, H, T = 8, 16, 32, 64
+    params = init_moe_params(jax.random.PRNGKey(10), D, H, E)
+    x = jax.random.normal(jax.random.PRNGKey(11), (T, D))
+
+    fn = make_moe_fn(mesh, n_experts=E, capacity_factor=2.0,
+                     experts_per_token=2)
+    y, aux = fn(params, x)
+
+    t_local = T // EP
+    capacity = int(np.ceil(t_local * 2 * 2.0 / E))
+    ys, auxs = [], []
+    for s in range(EP):
+        yy, aa = moe_dense_reference(params,
+                                     x[s * t_local:(s + 1) * t_local],
+                                     E, capacity, experts_per_token=2)
+        ys.append(yy)
+        auxs.append(aa)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(jnp.concatenate(ys)),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(float(aux),
+                               float(jnp.mean(jnp.stack(auxs))), rtol=1e-5)
+
+
+def test_moe_top2_equals_full_soft_mixture_when_k_is_E(hvd):
+    """k = E = 2 with ample capacity: every token reaches BOTH experts and
+    the renormalized top-2 gates are the full softmax — the MoE output
+    must equal the dense soft mixture sum_e p_e * expert_e(x)."""
+    mesh = Mesh(np.array(jax.devices()[:2]), ("ep",))
+    E, D, H, T = 2, 8, 16, 32
+    params = init_moe_params(jax.random.PRNGKey(12), D, H, E)
+    x = jax.random.normal(jax.random.PRNGKey(13), (T, D))
+
+    fn = make_moe_fn(mesh, n_experts=E, capacity_factor=4.0,
+                     experts_per_token=2)
+    y, _ = fn(params, x)
+
+    probs = jax.nn.softmax(x @ params["router"], axis=-1)
+    h = jax.nn.gelu(jnp.einsum("td,edh->teh", x, params["wi"]))
+    full = jnp.einsum("teh,ehd->ted", h, params["wo"])
+    soft = jnp.einsum("ted,te->td", full, probs)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(soft),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_moe_llama_mixtral_config_trains(hvd):
+    import optax
+    from horovod_tpu.models import moe_llama
+
+    cfg = moe_llama.CONFIGS["mixtral-tiny"]
+    assert cfg.experts_per_token == 2
+    params = moe_llama.init(jax.random.PRNGKey(14), cfg)
+    ids = jnp.asarray(np.random.RandomState(5).randint(
+        0, cfg.vocab, (4, 33)), jnp.int32)
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        l, g = jax.value_and_grad(
+            lambda q: moe_llama.loss_fn(q, ids, cfg))(p)
+        up, s = opt.update(g, s)
+        return optax.apply_updates(p, up), s, l
+
+    losses = []
+    for _ in range(10):
+        params, state, l = step(params, state)
+        losses.append(float(l))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
