@@ -70,12 +70,17 @@ def _route_topk(logits: jnp.ndarray, capacity: int, k: int = 1):
     else:
         gates = top_vals
 
-    disp = jnp.zeros((T, E, capacity), logits.dtype)
-    comb = jnp.zeros((T, E, capacity), logits.dtype)
-    raw_total = jnp.zeros((E,), logits.dtype)
-    slot_base = jnp.zeros((1, E), logits.dtype)
+    # Slot bookkeeping runs in fp32 regardless of logits dtype: bf16
+    # cumsum cannot represent integers above 256, so slot positions on
+    # a hot expert would collide and sum multiple tokens into one
+    # capacity slot.  Only disp/comb are cast back at the end.
+    disp = jnp.zeros((T, E, capacity), jnp.float32)
+    comb = jnp.zeros((T, E, capacity), jnp.float32)
+    raw_total = jnp.zeros((E,), jnp.float32)
+    slot_base = jnp.zeros((1, E), jnp.float32)
+    gates32 = gates.astype(jnp.float32)
     for j in range(k):
-        oh = jax.nn.one_hot(top_idx[:, j], E, dtype=logits.dtype)
+        oh = jax.nn.one_hot(top_idx[:, j], E, dtype=jnp.float32)
         raw_total = raw_total + oh.sum(0)
         # 1-based slot per (token, expert), offset past prior choices'
         # claims so slots never collide across choice ranks.
@@ -84,12 +89,14 @@ def _route_topk(logits: jnp.ndarray, capacity: int, k: int = 1):
         ohk = oh * within
         disp_j = ohk[:, :, None] * jax.nn.one_hot(
             jnp.maximum(position - 1, 0).astype(jnp.int32), capacity,
-            dtype=logits.dtype)
+            dtype=jnp.float32)
         disp = disp + disp_j
-        comb = comb + disp_j * gates[:, j][:, None, None]
+        comb = comb + disp_j * gates32[:, j][:, None, None]
         slot_base = slot_base + oh.sum(0, keepdims=True)
-    aux = E * jnp.sum((raw_total / (T * k)) * jnp.mean(probs, axis=0))
-    return disp, comb, aux
+    aux = E * jnp.sum((raw_total / (T * k)) *
+                      jnp.mean(probs.astype(jnp.float32), axis=0))
+    return (disp.astype(logits.dtype), comb.astype(logits.dtype),
+            aux.astype(logits.dtype))
 
 
 def _expert_ffn(wi, wo, x):

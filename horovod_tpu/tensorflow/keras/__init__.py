@@ -65,6 +65,10 @@ def _make_distributed_class(base_cls):
         _hvd_distributed = True
 
         def apply(self, grads, trainable_variables=None):
+            if getattr(self, "_hvd_applying", False):
+                # Re-entered from our apply_gradients override (Keras 3
+                # routes apply_gradients -> apply); grads already synced.
+                return super().apply(grads, trainable_variables)
             grads = list(grads)
             tvars = list(trainable_variables) if trainable_variables \
                 is not None else None
@@ -72,6 +76,30 @@ def _make_distributed_class(base_cls):
             if synced is None:  # accumulating a local backward pass
                 return
             return super().apply(synced, trainable_variables)
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            # Legacy Keras 2 (and raw tf.keras code) drives training via
+            # apply_gradients, never apply — without this override those
+            # paths would train with silently unsynchronized gradients
+            # (reference wraps _compute_gradients/apply_gradients for the
+            # same reason: horovod/_keras/__init__.py).
+            if getattr(self, "_hvd_applying", False):
+                return super().apply_gradients(grads_and_vars, *args,
+                                               **kwargs)
+            gv = list(grads_and_vars)
+            if not gv:  # keras's own apply_gradients rejects empty input
+                return None
+            grads = [g for g, _ in gv]
+            tvars = [v for _, v in gv]
+            synced = self._hvd_sync(grads, tvars)
+            if synced is None:  # accumulating a local backward pass
+                return
+            self._hvd_applying = True
+            try:
+                return super().apply_gradients(
+                    list(zip(synced, tvars)), *args, **kwargs)
+            finally:
+                self._hvd_applying = False
 
         # -------------------------------------------------- gradient sync
         def _hvd_sync(self, grads: List[Any],
