@@ -8,13 +8,14 @@ test strategy runs Spark in local mode the same way).
 """
 
 from .runner import (LocalTaskExecutor, SparkTaskExecutor, TaskExecutor,
-                     run)
-from .store import FilesystemStore, LocalStore, Store
+                     run, run_elastic)
+from .store import DBFSLocalStore, FilesystemStore, LocalStore, Store
 from .estimator import (Estimator, EstimatorModel, KerasEstimator,
                         LinearEstimator, TorchEstimator)
 from .lightning import LightningEstimator
 
-__all__ = ["run", "TaskExecutor", "LocalTaskExecutor", "SparkTaskExecutor",
-           "Store", "FilesystemStore", "LocalStore", "Estimator",
-           "EstimatorModel", "LinearEstimator", "KerasEstimator",
-           "TorchEstimator", "LightningEstimator"]
+__all__ = ["run", "run_elastic", "TaskExecutor", "LocalTaskExecutor",
+           "SparkTaskExecutor", "Store", "FilesystemStore", "LocalStore",
+           "DBFSLocalStore", "Estimator", "EstimatorModel",
+           "LinearEstimator", "KerasEstimator", "TorchEstimator",
+           "LightningEstimator"]

@@ -26,7 +26,7 @@ from .runner import TaskExecutor, run as spark_run
 from .store import Store
 
 
-def _as_columns(df, feature_cols=None, label_cols=None
+def _as_columns(df, feature_cols=None, label_cols=None, extra_cols=()
                 ) -> Dict[str, np.ndarray]:
     """Accept a column dict, or a pyspark/pandas DataFrame.  With no column
     lists, ALL columns convert (transform() must not drop id/label columns
@@ -35,20 +35,186 @@ def _as_columns(df, feature_cols=None, label_cols=None
         return {k: np.asarray(v) for k, v in df.items()}
     if hasattr(df, "toPandas"):  # pyspark DataFrame
         df = df.toPandas()
-    cols = (list(feature_cols or []) + list(label_cols or [])) or \
-        list(df.columns)
+    cols = (list(feature_cols or []) + list(label_cols or []) +
+            list(extra_cols)) or list(df.columns)
     return {c: np.stack(df[c].to_numpy()) for c in cols}
+
+
+def _split_validation(cols: Dict[str, np.ndarray], validation,
+                      seed: int = 0):
+    """Split a column dict into (train, val) following the reference's
+    ``validation`` param (common/params.py): a float in (0, 1) holds out a
+    random fraction; a string names a boolean column marking val rows
+    (the column itself is dropped from both splits).  Returns val=None
+    when no validation was requested or the split came out empty."""
+    if not validation:
+        return cols, None
+    if isinstance(validation, str):
+        if validation not in cols:
+            raise ValueError(f"validation column {validation!r} not in "
+                             f"columns {sorted(cols)}")
+        mask = np.asarray(cols[validation]).astype(bool).ravel()
+        base = {k: np.asarray(v) for k, v in cols.items()
+                if k != validation}
+    else:
+        frac = float(validation)
+        if not 0.0 < frac < 1.0:
+            raise ValueError(f"validation fraction must be in (0,1), got "
+                             f"{frac}")
+        n = len(next(iter(cols.values())))
+        mask = np.random.RandomState(seed).rand(n) < frac
+        base = {k: np.asarray(v) for k, v in cols.items()}
+    train = {k: v[~mask] for k, v in base.items()}
+    val = {k: v[mask] for k, v in base.items()}
+    return train, (val if mask.any() else None)
+
+
+# ---------------------------------------------------------------------------
+# metrics + per-epoch checkpoint envelope (shared by every train task)
+
+_NAMED_METRICS = {
+    "mse": lambda p, y: float(np.mean((p - y) ** 2)),
+    "mae": lambda p, y: float(np.mean(np.abs(p - y))),
+    "accuracy": lambda p, y: float(np.mean(
+        (np.argmax(p, axis=-1) if p.ndim > 1 and p.shape[-1] > 1
+         else (p > 0.5).astype(np.int64)).ravel() ==
+        np.asarray(y).ravel().astype(np.int64))),
+}
+
+
+def _resolve_metrics(metrics) -> List:
+    """Names or callables -> [(name, fn(pred, y) -> float)] (reference:
+    common/params.py metrics param; keras/torch estimators accept both)."""
+    out = []
+    for m in metrics or ():
+        if callable(m):
+            out.append((getattr(m, "__name__", "metric"), m))
+        elif m in _NAMED_METRICS:
+            out.append((m, _NAMED_METRICS[m]))
+        else:
+            raise ValueError(f"unknown metric {m!r}; named metrics: "
+                             f"{sorted(_NAMED_METRICS)}")
+    return out
+
+
+def _save_epoch_checkpoint(store: Store, run_id: str, epoch: int,
+                           model_bytes: bytes, history: Dict) -> None:
+    """Durable per-epoch envelope: {epoch, model, history} (reference:
+    estimator per-epoch ckpt via keras callbacks / remote.py; resume keys
+    off the stored epoch)."""
+    store.save_checkpoint(run_id, pickle.dumps(
+        {"fmt": 1, "epoch": int(epoch), "model": model_bytes,
+         "history": history}))
+
+
+def _load_epoch_checkpoint(store: Store, run_id: str) -> Optional[Dict]:
+    """Read the envelope back; legacy raw payloads (pre-envelope) load as
+    epoch=-1 so resume starts from scratch but serving still works."""
+    payload = store.read_checkpoint(run_id)
+    if payload is None:
+        return None
+    try:
+        obj = pickle.loads(payload)
+    except Exception:
+        return {"fmt": 0, "epoch": -1, "model": payload, "history": {}}
+    if isinstance(obj, dict) and "model" in obj and "epoch" in obj:
+        return obj
+    return {"fmt": 0, "epoch": -1, "model": payload, "history": {}}
+
+
+def _eval_metrics(predict: Callable, val_path: Optional[str],
+                  feature_cols, label_cols, metrics, batch_size: int,
+                  rank: int, size: int, sync) -> Dict[str, float]:
+    """Per-epoch validation metrics over the (sharded) val dataset.  The
+    cross-worker combine is exact: Average(weighted sums)/Average(counts)
+    equals the global weighted mean regardless of shard imbalance."""
+    if val_path is None or not metrics:
+        return {}
+    loader = ParquetDataLoader(val_path, batch_size, rank=rank,
+                               num_workers=size)
+    sums = np.zeros((len(metrics) + 1,), np.float64)
+    for batch in loader:
+        x, y = _assemble_batch(batch, feature_cols, label_cols)
+        p = np.asarray(predict(x))
+        for j, (_, fn) in enumerate(metrics):
+            sums[j] += fn(p, y) * len(x)
+        sums[-1] += len(x)
+    if size > 1:
+        sums = np.asarray(sync([sums])[0], np.float64)
+    denom = max(sums[-1], 1.0)
+    return {f"val_{name}": float(sums[j] / denom)
+            for j, (name, _) in enumerate(metrics)}
+
+
+def _epoch_driver(store: Store, run_id: str, epochs: int, metrics,
+                  batch_size: int, feature_cols, label_cols,
+                  rank: int, size: int, sync,
+                  val_path: Optional[str], *,
+                  restore: Callable[[bytes], None],
+                  serialize: Callable[[], bytes],
+                  train_epoch: Callable[[int], float],
+                  predict: Callable[[np.ndarray], np.ndarray],
+                  cold_start: Optional[Callable[[], None]] = None) -> Dict:
+    """The one epoch loop every train task shares: resume from the stored
+    envelope (or run ``cold_start`` — typically the initial cross-worker
+    parameter sync), then per epoch: train, eval val metrics, rank-0
+    checkpoint + history log, failure-injection hook.  Framework
+    specifics come in as closures (restore/serialize/train_epoch/predict).
+    """
+    metrics = _resolve_metrics(metrics)
+    start_epoch = 0
+    history: Dict[str, List[float]] = {}
+    env = _load_epoch_checkpoint(store, run_id)
+    if env is not None and env["epoch"] >= 0:
+        restore(env["model"])
+        start_epoch = env["epoch"] + 1
+        history = dict(env.get("history") or {})
+    elif cold_start is not None:
+        cold_start()
+    for epoch in range(start_epoch, epochs):
+        history.setdefault("train_loss", []).append(train_epoch(epoch))
+        for k, v in _eval_metrics(predict, val_path, feature_cols,
+                                  label_cols, metrics, batch_size, rank,
+                                  size, sync).items():
+            history.setdefault(k, []).append(v)
+        if rank == 0:
+            _save_epoch_checkpoint(store, run_id, epoch, serialize(),
+                                   history)
+            store.save_log(run_id, pickle.dumps(history))
+        _maybe_inject_fault(rank, epoch)
+    return history
+
+
+def _maybe_inject_fault(rank: int, epoch: int) -> None:
+    """Failure-injection hook for elastic tests: when
+    ``HOROVOD_SPARK_FAULT='<rank>,<epoch>,<marker_path>'`` is set and the
+    marker file does not exist yet, the matching worker hard-exits after
+    that epoch's checkpoint — once.  The marker makes the relaunched job
+    run clean (the integration tier's analog of the reference's
+    elastic_common.py host-mutation hooks)."""
+    spec = os.environ.get("HOROVOD_SPARK_FAULT")
+    if not spec:
+        return
+    frank, fepoch, marker = spec.split(",", 2)
+    if rank == int(frank) and epoch == int(fepoch) and \
+            not os.path.exists(marker):
+        with open(marker, "w") as f:
+            f.write(f"fault injected at rank={rank} epoch={epoch}\n")
+        os._exit(17)
 
 
 class EstimatorModel:
     """Fitted-model transformer (reference: HorovodModel,
-    common/estimator.py:97-103)."""
+    common/estimator.py:97-103).  ``history`` carries the per-epoch
+    train/val series recorded by the train task."""
 
     def __init__(self, predict_fn: Callable[[np.ndarray], np.ndarray],
-                 feature_cols: Sequence[str], output_col: str = "predict"):
+                 feature_cols: Sequence[str], output_col: str = "predict",
+                 history: Optional[Dict[str, List[float]]] = None):
         self._predict = predict_fn
         self.feature_cols = list(feature_cols)
         self.output_col = output_col
+        self.history = dict(history or {})
 
     def transform(self, df):
         cols = _as_columns(df)  # keep every input column in the output
@@ -72,7 +238,11 @@ class Estimator:
                  label_cols: Sequence[str] = ("label",),
                  batch_size: int = 32, epochs: int = 1,
                  run_id: str = "run0",
-                 executor: Optional[TaskExecutor] = None):
+                 executor: Optional[TaskExecutor] = None,
+                 validation=None,
+                 metrics: Sequence = (),
+                 loss=None,
+                 seed: int = 0):
         self.store = store
         self.num_proc = num_proc
         self.feature_cols = list(feature_cols)
@@ -81,6 +251,11 @@ class Estimator:
         self.epochs = epochs
         self.run_id = run_id
         self.executor = executor
+        self.validation = validation
+        self.metrics = list(metrics)
+        self.loss = loss
+        self.seed = seed
+        _resolve_metrics(self.metrics)  # fail fast on unknown names
 
     # -- subclass surface --------------------------------------------------
     def _make_train_task(self) -> Callable:
@@ -91,23 +266,79 @@ class Estimator:
 
     # -- the fit flow ------------------------------------------------------
     def _has_checkpoint(self) -> bool:
-        """Resume support (reference: estimator.py:91-96)."""
+        """Resume support (reference: estimator.py:91-96): when a
+        checkpoint exists, the next fit/fit_on_parquet CONTINUES training
+        from the stored epoch instead of starting over."""
         return self.store.read_checkpoint(self.run_id) is not None
 
-    def fit(self, df) -> EstimatorModel:
-        cols = _as_columns(df, self.feature_cols, self.label_cols)
+    def fit(self, df, elastic: bool = False, min_np: int = 1,
+            reset_limit: Optional[int] = 3) -> EstimatorModel:
+        """Persist df (with optional validation split) to the Store, train
+        on ``num_proc`` workers, return the fitted transformer (reference:
+        common/estimator.py:25-96 _fit -> prepare_data ->
+        _fit_on_prepared_data).
+
+        ``elastic=True`` routes the job through :func:`run_elastic` —
+        task failures shrink the worker set (down to ``min_np``) and
+        training resumes from the last epoch checkpoint."""
+        extra = (self.validation,) if isinstance(self.validation, str) \
+            else ()
+        cols = _as_columns(df, self.feature_cols, self.label_cols,
+                           extra_cols=extra)
+        train_cols, val_cols = _split_validation(cols, self.validation,
+                                                 self.seed)
+        train_path = self.store.write_parquet(
+            self.store.get_train_data_path(self.run_id), train_cols)
+        val_path = None
+        if val_cols is not None:
+            val_path = self.store.write_parquet(
+                self.store.get_val_data_path(self.run_id), val_cols)
+        return self._fit_on_paths(train_path, val_path, elastic=elastic,
+                                  min_np=min_np, reset_limit=reset_limit)
+
+    def fit_on_parquet(self, elastic: bool = False, min_np: int = 1,
+                       reset_limit: Optional[int] = 3) -> EstimatorModel:
+        """Train on data already materialized in the Store (reference:
+        estimator.fit_on_parquet:37-48) — the re-fit path after a driver
+        restart, skipping the prepare step."""
         train_path = self.store.get_train_data_path(self.run_id)
-        self.store.write_parquet(train_path, cols)
+        if not self.store.is_parquet_dataset(train_path):
+            raise ValueError(f"no parquet dataset at {train_path}; run "
+                             "fit() once (or write the dataset) first")
+        val_path = self.store.get_val_data_path(self.run_id)
+        if not self.store.is_parquet_dataset(val_path):
+            val_path = None
+        return self._fit_on_paths(train_path, val_path, elastic=elastic,
+                                  min_np=min_np, reset_limit=reset_limit)
 
+    def _fit_on_paths(self, train_path: str, val_path: Optional[str],
+                      elastic: bool, min_np: int,
+                      reset_limit: Optional[int]) -> EstimatorModel:
         task = self._make_train_task()
-        spark_run(task, args=(train_path,), num_proc=self.num_proc,
-                  executor=self.executor)
+        if elastic:
+            from .runner import run_elastic
+            run_elastic(task, args=(train_path, val_path),
+                        num_proc=self.num_proc, min_np=min_np,
+                        reset_limit=reset_limit,
+                        executor_factory=self._executor_factory())
+        else:
+            spark_run(task, args=(train_path, val_path),
+                      num_proc=self.num_proc, executor=self.executor)
 
-        payload = self.store.read_checkpoint(self.run_id)
-        if payload is None:
+        env = _load_epoch_checkpoint(self.store, self.run_id)
+        if env is None:
             raise RuntimeError("training produced no checkpoint")
-        return EstimatorModel(self._load_model(payload),
-                              self.feature_cols)
+        return EstimatorModel(self._load_model(env["model"]),
+                              self.feature_cols,
+                              history=env.get("history"))
+
+    def _executor_factory(self):
+        """How run_elastic rebuilds the placement layer at a smaller size
+        after a failure: the executor's own ``with_num_tasks`` preserves
+        its configuration (start_method, spark context, ...)."""
+        if self.executor is None:
+            return None
+        return self.executor.with_num_tasks
 
 
 def _grad_sync_fn():
@@ -151,6 +382,18 @@ def _torch_sync_grads(model, sync) -> None:
         p.grad.copy_(torch.from_numpy(np.ascontiguousarray(g)))
 
 
+def _torch_eval_predict(model, x: np.ndarray) -> np.ndarray:
+    """One forward in eval mode, restoring train mode after (the val-
+    metrics predict closure shared by the torch and lightning tasks)."""
+    import torch
+    model.eval()
+    with torch.no_grad():
+        out = model(torch.from_numpy(
+            np.ascontiguousarray(x, np.float32))).numpy()
+    model.train()
+    return out
+
+
 def _torch_predict_fn(model_fn: Callable, payload: bytes) -> Callable:
     """state_dict bytes -> eval-mode predict closure (shared by the torch
     and lightning estimators)."""
@@ -181,11 +424,11 @@ def _assemble_batch(batch, feature_cols, label_cols):
 class _SGDTrainTask:
     """Picklable linear-model trainer used by LinearEstimator: each worker
     reads ITS parquet shard, per-batch gradients are averaged across
-    workers through the eager data plane, rank 0 checkpoints to the
-    store."""
+    workers through the eager data plane, rank 0 checkpoints an epoch
+    envelope (resume + history) to the store."""
 
     def __init__(self, store, run_id, feature_cols, label_cols, batch_size,
-                 epochs, lr):
+                 epochs, lr, metrics=()):
         self.store = store
         self.run_id = run_id
         self.feature_cols = feature_cols
@@ -193,33 +436,48 @@ class _SGDTrainTask:
         self.batch_size = batch_size
         self.epochs = epochs
         self.lr = lr
+        self.metrics = list(metrics)
 
-    def __call__(self, train_path: str):
+    def __call__(self, train_path: str, val_path: Optional[str] = None):
         rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
         size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
         sync = _grad_sync_fn()
         loader = ParquetDataLoader(train_path, self.batch_size,
                                    rank=rank, num_workers=size)
         first = next(iter(loader))
-        x0, y0 = _assemble_batch(first, self.feature_cols, self.label_cols)
-        w = np.zeros((x0.shape[1], y0.shape[1]), np.float64)
-        b = np.zeros((y0.shape[1],), np.float64)
-        for _ in range(self.epochs):
+        x, y = _assemble_batch(first, self.feature_cols, self.label_cols)
+        state = {"w": np.zeros((x.shape[1], y.shape[1]), np.float64),
+                 "b": np.zeros((y.shape[1],), np.float64)}
+
+        def restore(payload: bytes) -> None:
+            state.update(pickle.loads(payload))
+
+        def train_epoch(_epoch: int) -> float:
+            epoch_loss, nb = 0.0, 0
             for batch in loader:
                 x, y = _assemble_batch(batch, self.feature_cols,
                                        self.label_cols)
-                pred = x @ w + b
+                pred = x @ state["w"] + state["b"]
                 gw, gb = sync([x.T @ (pred - y) / len(x),
                                (pred - y).mean(axis=0)])
-                w -= self.lr * gw
-                b -= self.lr * gb
-        if rank == 0:
-            self.store.save_checkpoint(
-                self.run_id, pickle.dumps({"w": w, "b": b}))
+                state["w"] -= self.lr * gw
+                state["b"] -= self.lr * gb
+                epoch_loss += float(np.mean((pred - y) ** 2))
+                nb += 1
+            return epoch_loss / max(nb, 1)
+
+        history = _epoch_driver(
+            self.store, self.run_id, self.epochs, self.metrics,
+            self.batch_size, self.feature_cols, self.label_cols,
+            rank, size, sync, val_path,
+            restore=restore,
+            serialize=lambda: pickle.dumps(dict(state)),
+            train_epoch=train_epoch,
+            predict=lambda x: x @ state["w"] + state["b"])
         # w_sum lets callers assert every worker converged to the SAME
         # model (gradient sync actually happened).
-        return {"mse": float(np.mean((x @ w + b - y) ** 2)),
-                "w_sum": float(w.sum() + b.sum())}
+        return {"mse": history["train_loss"][-1],
+                "w_sum": float(state["w"].sum() + state["b"].sum())}
 
 
 class LinearEstimator(Estimator):
@@ -234,7 +492,7 @@ class LinearEstimator(Estimator):
     def _make_train_task(self) -> Callable:
         return _SGDTrainTask(self.store, self.run_id, self.feature_cols,
                              self.label_cols, self.batch_size, self.epochs,
-                             self.lr)
+                             self.lr, metrics=self.metrics)
 
     def _load_model(self, payload: bytes) -> Callable:
         state = pickle.loads(payload)
@@ -260,7 +518,8 @@ class KerasEstimator(Estimator):
     def _make_train_task(self) -> Callable:
         return _KerasTrainTask(self.store, self.run_id, self.model_fn,
                                self.feature_cols, self.label_cols,
-                               self.batch_size, self.epochs, self.lr)
+                               self.batch_size, self.epochs, self.lr,
+                               loss=self.loss, metrics=self.metrics)
 
     def _load_model(self, payload: bytes) -> Callable:
         weights = pickle.loads(payload)
@@ -272,22 +531,51 @@ class KerasEstimator(Estimator):
         return predict
 
 
+def _torch_loss_fn(loss):
+    """Resolve the user ``loss`` param to a callable(pred, y) -> scalar
+    tensor (reference: TorchEstimator ``loss`` accepts instances and
+    callables; strings are the keras-style convenience)."""
+    import torch
+    if loss is None:
+        return torch.nn.MSELoss()
+    if isinstance(loss, str):
+        table = {"mse": torch.nn.MSELoss, "l1": torch.nn.L1Loss,
+                 "mae": torch.nn.L1Loss, "bce": torch.nn.BCELoss,
+                 "bce_logits": torch.nn.BCEWithLogitsLoss,
+                 "cross_entropy": torch.nn.CrossEntropyLoss}
+        if loss not in table:
+            raise ValueError(f"unknown torch loss {loss!r}; named losses: "
+                             f"{sorted(table)}")
+        return table[loss]()
+    return loss  # instance or plain callable
+
+
 class TorchEstimator(Estimator):
     """Torch estimator (reference: spark/torch/ TorchEstimator): the model
     is built by a factory, trained per-worker on parquet shards with
     per-batch gradient averaging over the data plane, checkpointed via
-    state_dict bytes."""
+    state_dict bytes.
+
+    ``loss`` is a name ('mse', 'l1', 'bce', 'bce_logits', 'cross_entropy'),
+    a torch loss instance, or a callable(pred, y); ``optimizer_fn`` builds
+    the optimizer from model.parameters() (picklable; default SGD(lr));
+    ``metrics``/``validation`` come from the Estimator base (reference
+    exposes the same four on spark/torch/estimator.py)."""
 
     def __init__(self, store: Store, model_fn: Callable, num_proc: int = 1,
-                 lr: float = 1e-3, **kwargs):
+                 lr: float = 1e-3, optimizer_fn: Optional[Callable] = None,
+                 **kwargs):
         super().__init__(store, num_proc=num_proc, **kwargs)
         self.model_fn = model_fn
         self.lr = lr
+        self.optimizer_fn = optimizer_fn
 
     def _make_train_task(self) -> Callable:
         return _TorchTrainTask(self.store, self.run_id, self.model_fn,
                                self.feature_cols, self.label_cols,
-                               self.batch_size, self.epochs, self.lr)
+                               self.batch_size, self.epochs, self.lr,
+                               loss=self.loss, metrics=self.metrics,
+                               optimizer_fn=self.optimizer_fn)
 
     def _load_model(self, payload: bytes) -> Callable:
         return _torch_predict_fn(self.model_fn, payload)
@@ -295,7 +583,8 @@ class TorchEstimator(Estimator):
 
 class _TorchTrainTask:
     def __init__(self, store, run_id, model_fn, feature_cols, label_cols,
-                 batch_size, epochs, lr):
+                 batch_size, epochs, lr, loss=None, metrics=(),
+                 optimizer_fn=None):
         self.store = store
         self.run_id = run_id
         self.model_fn = model_fn
@@ -304,8 +593,11 @@ class _TorchTrainTask:
         self.batch_size = batch_size
         self.epochs = epochs
         self.lr = lr
+        self.loss = loss
+        self.metrics = list(metrics)
+        self.optimizer_fn = optimizer_fn
 
-    def __call__(self, train_path: str):
+    def __call__(self, train_path: str, val_path: Optional[str] = None):
         import io
         import torch
         rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
@@ -314,33 +606,58 @@ class _TorchTrainTask:
         loader = ParquetDataLoader(train_path, self.batch_size,
                                    rank=rank, num_workers=size)
         model = self.model_fn()
-        if size > 1:
-            _torch_sync_params(model, sync)
-        opt = torch.optim.SGD(model.parameters(), lr=self.lr)
-        loss_fn = torch.nn.MSELoss()
-        loss = torch.zeros(())
-        for _ in range(self.epochs):
+        opt = (self.optimizer_fn(model.parameters()) if self.optimizer_fn
+               else torch.optim.SGD(model.parameters(), lr=self.lr))
+        loss_fn = _torch_loss_fn(self.loss)
+        # Class-index losses need (n,) int64 targets, not the (n,1) float
+        # regression layout _assemble_batch produces.
+        index_target = isinstance(loss_fn, torch.nn.CrossEntropyLoss) or \
+            self.loss == "cross_entropy"
+
+        def as_target(y: np.ndarray):
+            if index_target:
+                return torch.from_numpy(y.ravel().astype(np.int64))
+            return torch.from_numpy(np.ascontiguousarray(y, np.float32))
+
+        def restore(payload: bytes) -> None:
+            model.load_state_dict(torch.load(io.BytesIO(payload),
+                                             weights_only=True))
+
+        def serialize() -> bytes:
+            buf = io.BytesIO()
+            torch.save(model.state_dict(), buf)
+            return buf.getvalue()
+
+        def train_epoch(_epoch: int) -> float:
+            epoch_loss, nb = 0.0, 0
             for batch in loader:
                 x, y = _assemble_batch(batch, self.feature_cols,
                                        self.label_cols)
                 xt = torch.from_numpy(np.ascontiguousarray(x, np.float32))
-                yt = torch.from_numpy(np.ascontiguousarray(y, np.float32))
                 opt.zero_grad()
-                loss = loss_fn(model(xt), yt)
+                loss = loss_fn(model(xt), as_target(y))
                 loss.backward()
                 if size > 1:
                     _torch_sync_grads(model, sync)
                 opt.step()
-        if rank == 0:
-            buf = io.BytesIO()
-            torch.save(model.state_dict(), buf)
-            self.store.save_checkpoint(self.run_id, buf.getvalue())
-        return float(loss)
+                epoch_loss += float(loss)
+                nb += 1
+            return epoch_loss / max(nb, 1)
+
+        history = _epoch_driver(
+            self.store, self.run_id, self.epochs, self.metrics,
+            self.batch_size, self.feature_cols, self.label_cols,
+            rank, size, sync, val_path,
+            restore=restore, serialize=serialize, train_epoch=train_epoch,
+            predict=lambda x: _torch_eval_predict(model, x),
+            cold_start=(lambda: _torch_sync_params(model, sync))
+            if size > 1 else None)
+        return history["train_loss"][-1] if history["train_loss"] else 0.0
 
 
 class _KerasTrainTask:
     def __init__(self, store, run_id, model_fn, feature_cols, label_cols,
-                 batch_size, epochs, lr):
+                 batch_size, epochs, lr, loss=None, metrics=()):
         self.store = store
         self.run_id = run_id
         self.model_fn = model_fn
@@ -349,8 +666,10 @@ class _KerasTrainTask:
         self.batch_size = batch_size
         self.epochs = epochs
         self.lr = lr
+        self.loss = loss
+        self.metrics = list(metrics)
 
-    def __call__(self, train_path: str):
+    def __call__(self, train_path: str, val_path: Optional[str] = None):
         import keras
         rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
         size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
@@ -358,17 +677,31 @@ class _KerasTrainTask:
         loader = ParquetDataLoader(train_path, self.batch_size,
                                    rank=rank, num_workers=size)
         model = self.model_fn()
-        model.compile(optimizer=keras.optimizers.SGD(self.lr), loss="mse")
-        for _ in range(self.epochs):
+        # ``loss`` passes straight to compile: keras resolves names and
+        # callables the same way (reference: keras estimator's loss param).
+        model.compile(optimizer=keras.optimizers.SGD(self.lr),
+                      loss=self.loss or "mse")
+
+        def train_epoch(_epoch: int) -> float:
+            epoch_loss, nb = 0.0, 0
             for batch in loader:
                 x, y = _assemble_batch(batch, self.feature_cols,
                                        self.label_cols)
                 loss = model.train_on_batch(x, y)
+                epoch_loss += float(np.asarray(loss).ravel()[0])
+                nb += 1
             # per-epoch parameter averaging keeps every worker's model
             # identical at epoch boundaries (one fused collective)
             model.set_weights(sync([np.asarray(w)
                                     for w in model.get_weights()]))
-        if rank == 0:
-            self.store.save_checkpoint(
-                self.run_id, pickle.dumps(model.get_weights()))
-        return float(np.asarray(loss).ravel()[0])
+            return epoch_loss / max(nb, 1)
+
+        history = _epoch_driver(
+            self.store, self.run_id, self.epochs, self.metrics,
+            self.batch_size, self.feature_cols, self.label_cols,
+            rank, size, sync, val_path,
+            restore=lambda p: model.set_weights(pickle.loads(p)),
+            serialize=lambda: pickle.dumps(model.get_weights()),
+            train_epoch=train_epoch,
+            predict=lambda x: np.asarray(model(x)))
+        return history["train_loss"][-1] if history["train_loss"] else 0.0

@@ -25,7 +25,8 @@ from typing import Callable
 import numpy as np
 
 from ..data.loader import ParquetDataLoader
-from .estimator import (Estimator, _assemble_batch, _grad_sync_fn,
+from .estimator import (Estimator, _assemble_batch, _epoch_driver,
+                        _grad_sync_fn, _torch_eval_predict,
                         _torch_predict_fn, _torch_sync_grads,
                         _torch_sync_params)
 from .store import Store
@@ -105,7 +106,8 @@ class LightningEstimator(Estimator):
     def _make_train_task(self) -> Callable:
         return _LightningTrainTask(self.store, self.run_id, self.model_fn,
                                    self.feature_cols, self.label_cols,
-                                   self.batch_size, self.epochs)
+                                   self.batch_size, self.epochs,
+                                   metrics=self.metrics)
 
     def _load_model(self, payload: bytes) -> Callable:
         return _torch_predict_fn(self.model_fn, payload)
@@ -117,7 +119,7 @@ class _LightningTrainTask:
     RemoteTrainer's train function)."""
 
     def __init__(self, store, run_id, model_fn, feature_cols, label_cols,
-                 batch_size, epochs):
+                 batch_size, epochs, metrics=()):
         self.store = store
         self.run_id = run_id
         self.model_fn = model_fn
@@ -125,8 +127,9 @@ class _LightningTrainTask:
         self.label_cols = label_cols
         self.batch_size = batch_size
         self.epochs = epochs
+        self.metrics = list(metrics)
 
-    def __call__(self, train_path: str):
+    def __call__(self, train_path: str, val_path=None):
         import io
         import torch
         rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
@@ -135,14 +138,24 @@ class _LightningTrainTask:
         loader = ParquetDataLoader(train_path, self.batch_size,
                                    rank=rank, num_workers=size)
         module = self.model_fn()
-        if size > 1:  # identical start: one fused parameter sync
-            _torch_sync_params(module, sync)
         opt, sched_cfg = _first_optimizer(module.configure_optimizers())
         sched, interval, freq = sched_cfg or (None, "epoch", 1)
-        loss = torch.zeros(())
-        global_step = 0
-        for epoch in range(self.epochs):
+        step_counter = {"global_step": 0}
+
+        def restore(payload: bytes) -> None:
+            module.load_state_dict(torch.load(io.BytesIO(payload),
+                                              weights_only=True))
+
+        def serialize() -> bytes:
+            # per-epoch checkpoint (reference: remote.py ModelCheckpoint
+            # every epoch)
+            buf = io.BytesIO()
+            torch.save(module.state_dict(), buf)
+            return buf.getvalue()
+
+        def train_epoch(epoch: int) -> float:
             module.train()
+            epoch_loss, nb = 0.0, 0
             for i, batch in enumerate(loader):
                 x, y = _assemble_batch(batch, self.feature_cols,
                                        self.label_cols)
@@ -157,20 +170,28 @@ class _LightningTrainTask:
                 if size > 1:
                     _torch_sync_grads(module, sync)
                 opt.step()
-                global_step += 1
+                epoch_loss += float(loss)
+                nb += 1
+                step_counter["global_step"] += 1
                 if sched is not None and interval == "step" and \
-                        global_step % freq == 0:
+                        step_counter["global_step"] % freq == 0:
                     sched.step()
             if sched is not None and interval == "epoch" and \
                     (epoch + 1) % freq == 0:
                 sched.step()
             if hasattr(module, "on_train_epoch_end"):
                 module.on_train_epoch_end()
-            if rank == 0:  # per-epoch checkpoint (reference: remote.py
-                buf = io.BytesIO()  # ModelCheckpoint every epoch)
-                torch.save(module.state_dict(), buf)
-                self.store.save_checkpoint(self.run_id, buf.getvalue())
-        return float(loss)
+            return epoch_loss / max(nb, 1)
+
+        history = _epoch_driver(
+            self.store, self.run_id, self.epochs, self.metrics,
+            self.batch_size, self.feature_cols, self.label_cols,
+            rank, size, sync, val_path,
+            restore=restore, serialize=serialize, train_epoch=train_epoch,
+            predict=lambda x: _torch_eval_predict(module, x),
+            cold_start=(lambda: _torch_sync_params(module, sync))
+            if size > 1 else None)
+        return history["train_loss"][-1] if history["train_loss"] else 0.0
 
 
 __all__ = ["LightningEstimator"]

@@ -40,6 +40,14 @@ class TaskExecutor:
                   ) -> List[Any]:
         raise NotImplementedError
 
+    def with_num_tasks(self, n: int) -> "TaskExecutor":
+        """Rebuild this executor at a different task count, preserving its
+        configuration — how elastic resets shrink the placement layer.
+        Subclasses with extra constructor state must override."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support elastic resizing; "
+            "override with_num_tasks(n)")
+
 
 def _local_task_entry(index: int, payload: bytes, hostnames, q):
     try:
@@ -54,10 +62,14 @@ class LocalTaskExecutor(TaskExecutor):
 
     def __init__(self, num_tasks: int, start_method: str = "spawn"):
         self._n = num_tasks
+        self._start_method = start_method
         self._ctx = multiprocessing.get_context(start_method)
 
     def num_tasks(self) -> int:
         return self._n
+
+    def with_num_tasks(self, n: int) -> "LocalTaskExecutor":
+        return LocalTaskExecutor(n, start_method=self._start_method)
 
     def run_tasks(self, task_fn: Callable[[int, List[str]], Any]
                   ) -> List[Any]:
@@ -131,6 +143,9 @@ class SparkTaskExecutor(TaskExecutor):
     def num_tasks(self) -> int:
         return self._n
 
+    def with_num_tasks(self, n: int) -> "SparkTaskExecutor":
+        return SparkTaskExecutor(n, spark_context=self._sc)
+
     def run_tasks(self, task_fn: Callable[[int, List[str]], Any]
                   ) -> List[Any]:
         rdd = self._sc.parallelize(range(self._n), self._n)
@@ -165,6 +180,67 @@ def run(fn: Callable, args: Sequence[Any] = (), kwargs: Dict = None,
     base_env = {k: v for k, v in (env or {}).items()}
     task = _Task(fn, tuple(args), dict(kwargs), coordinator_port, base_env)
     return executor.run_tasks(task)
+
+
+def run_elastic(fn: Callable, args: Sequence[Any] = (),
+                kwargs: Dict = None,
+                num_proc: Optional[int] = None,
+                min_np: Optional[int] = None,
+                max_np: Optional[int] = None,
+                start_timeout: Optional[float] = None,
+                elastic_timeout: Optional[float] = None,
+                reset_limit: Optional[int] = 3,
+                env: Optional[Dict[str, str]] = None,
+                executor_factory: Optional[Callable] = None,
+                coordinator_port: int = 29511,
+                verbose: int = 1) -> List[Any]:
+    """Elastic training on a cluster scheduler (reference:
+    spark/runner.py:306-334 run_elastic).
+
+    TPU-native reshape of the reference's gloo-rendezvous elasticity: a
+    jax.distributed mesh cannot shrink in place, so each membership
+    change is a RESET — the barrier job is relaunched at the surviving
+    worker count (bounded below by ``min_np``) and the training function
+    resumes from its last durable checkpoint (the estimator tasks'
+    per-epoch envelope).  ``reset_limit`` bounds relaunches exactly like
+    the reference's param; ``start_timeout``/``elastic_timeout`` are
+    accepted for signature parity (process spawn on a barrier stage is
+    scheduler-supervised, so there is no separate registration window to
+    time out).
+
+    ``executor_factory(n)`` rebuilds the placement backend at size n per
+    attempt; with None, pyspark (when importable) or local processes are
+    chosen per attempt exactly as :func:`run` does.
+    """
+    del start_timeout, elastic_timeout  # signature parity; see docstring
+    n = num_proc or 1
+    lo = max(1, min_np or 1)
+    if max_np is not None:
+        n = min(n, max_np)
+    if n < lo:
+        raise ValueError(f"num_proc={n} below min_np={lo}")
+    resets = 0
+    while True:
+        executor = executor_factory(n) if executor_factory else None
+        try:
+            return run(fn, args=args, kwargs=kwargs, num_proc=n,
+                       executor=executor, env=env,
+                       coordinator_port=coordinator_port)
+        # Broad on purpose: task death surfaces as RuntimeError from
+        # LocalTaskExecutor but as Py4J/Spark exception types from a real
+        # barrier stage — all of them mean "reset and shrink".
+        except Exception as e:
+            resets += 1
+            if reset_limit is not None and resets > reset_limit:
+                raise RuntimeError(
+                    f"elastic job failed after {resets - 1} resets "
+                    f"(reset_limit={reset_limit})") from e
+            n = max(lo, n - 1)
+            if verbose:
+                import sys as _sys
+                print(f"[spark.run_elastic] task failure: {e}; reset "
+                      f"#{resets} relaunching with np={n}",
+                      file=_sys.stderr)
 
 
 class _Task:

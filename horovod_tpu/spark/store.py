@@ -37,9 +37,26 @@ class Store:
     def is_parquet_dataset(self, path: str) -> bool:
         raise NotImplementedError
 
+    # ---- run logs (per-epoch history) -----------------------------------
+    def save_log(self, run_id: str, payload: bytes) -> str:
+        raise NotImplementedError
+
+    def read_log(self, run_id: str) -> Optional[bytes]:
+        raise NotImplementedError
+
     @staticmethod
     def create(prefix_path: str, **kwargs) -> "Store":
-        """Factory (reference: store.py Store.create chooses by scheme)."""
+        """Factory dispatching on path scheme (reference: store.py
+        Store.create routes hdfs:// to HDFSStore and everything else to
+        FilesystemStore; DBFSLocalStore handles dbfs:/)."""
+        if prefix_path.startswith("dbfs:/") or \
+                prefix_path.startswith("/dbfs"):
+            return DBFSLocalStore(prefix_path, **kwargs)
+        if prefix_path.startswith("hdfs://"):
+            raise ValueError(
+                "hdfs:// stores need an HDFS client, which TPU-VM images "
+                "do not ship; mount the cluster (fuse/NFS) and pass the "
+                "mounted path, or use gcsfuse + a local path")
         return FilesystemStore(prefix_path, **kwargs)
 
 
@@ -137,6 +154,41 @@ class FilesystemStore(Store):
         with open(p, "rb") as f:
             return f.read()
 
+    # ---- run logs --------------------------------------------------------
+    def save_log(self, run_id: str, payload: bytes) -> str:
+        d = self.get_logs_path(run_id)
+        os.makedirs(d, exist_ok=True)
+        p = os.path.join(d, "history.bin")
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, p)
+        return p
 
-# DBFS/HDFS naming parity: same behavior, fuse-mounted paths.
+    def read_log(self, run_id: str) -> Optional[bytes]:
+        p = os.path.join(self.get_logs_path(run_id), "history.bin")
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+
 LocalStore = FilesystemStore
+
+
+class DBFSLocalStore(FilesystemStore):
+    """Databricks DBFS store (reference: store.py DBFSLocalStore): paths
+    given as ``dbfs:/...`` are accessed through the ``/dbfs/`` fuse mount.
+    Everything else is FilesystemStore — proving the Store abstraction is
+    a path-translation boundary, exactly as in the reference."""
+
+    def __init__(self, prefix_path: str, **kwargs):
+        super().__init__(self.normalize_path(prefix_path), **kwargs)
+
+    @staticmethod
+    def normalize_path(path: str) -> str:
+        """``dbfs:/foo`` -> ``/dbfs/foo`` (reference:
+        store.py DBFSLocalStore.normalize_path)."""
+        if path.startswith("dbfs:/"):
+            return "/dbfs/" + path[len("dbfs:/"):].lstrip("/")
+        return path
