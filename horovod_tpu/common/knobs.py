@@ -114,6 +114,12 @@ _knob("HOROVOD_CONTROLLER", "auto", str,
       "(reference: HOROVOD_CONTROLLER in {mpi,gloo}, operations.cc:654).")
 _knob("HOROVOD_CONTROLLER_PORT", 29499, int,
       "TCP port of the rank-0 controller listener.")
+_knob("HOROVOD_TF_JOIN", False, _parse_bool,
+      "Route the TensorFlow frontend's dense collectives through the "
+      "native controller so join() (uneven inputs) works: a joined rank "
+      "answers peers' negotiated ops with zero dummies.  Off by default — "
+      "TF2 eager ordering is deterministic by construction, so the "
+      "negotiation round-trip is pure overhead unless join is needed.")
 
 
 def current(name: str) -> Any:

@@ -1,0 +1,178 @@
+"""Framework-neutral negotiated dispatch over the native controller.
+
+The torch frontend negotiates every async op through the C++ core
+(torch/mpi_ops.py); this module is the SYNCHRONOUS counterpart for
+frontends whose ops complete inline (TensorFlow eager):
+
+  * ``SyncNegotiator.run`` submits one collective to the controller and
+    pumps responses until it executes — peers' collectives that this rank
+    never submitted are answered with ZERO DUMMY tensors (only possible
+    for a rank that has JOINed).
+  * ``SyncNegotiator.join`` implements the uneven-input Join protocol
+    (reference: tensorflow/mpi_ops.py:334 join() -> horovod_join,
+    controller JOIN/JOIN_DONE handling controller.cc:254-307): signal no
+    more collectives, then keep serving peers until everyone joined.
+
+Signatures use the same wire format as the torch frontend
+(``dtype:shape:kind:extra`` joined by ``+`` for groups), so the
+controller's consistency validation and fusion logic see one dialect.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..common import basics as _basics
+from ..common.exceptions import HorovodInternalError
+from ..common.reduce_op import ReduceOp, Sum
+from . import collectives as _C
+
+_NP_SIG = {"float32": "f32", "float64": "f64", "float16": "f16",
+           "bfloat16": "bf16", "int32": "i32", "int64": "i64",
+           "int16": "i16", "int8": "i8", "uint8": "u8", "bool": "b1"}
+_NP_SIG_INV = {v: k for k, v in _NP_SIG.items()}
+
+
+def np_signature(arr: np.ndarray, kind: str, extra: str = "") -> str:
+    """Consistency key for one numpy array (same layout the torch
+    frontend emits: torch/mpi_ops.py _signature)."""
+    shape = "x".join(str(s) for s in arr.shape)
+    return f"{_NP_SIG.get(arr.dtype.name, arr.dtype.name)}:{shape}:" \
+           f"{kind}:{extra}"
+
+
+def np_zeros_from_signature(sig: str) -> np.ndarray:
+    """Zero dummy for a collective this rank never submitted (reference:
+    JoinOp zero tensor, collective_operations.cc:262)."""
+    dt, shape, _kind, _extra = sig.split(":", 3)
+    dims = tuple(int(s) for s in shape.split("x") if s)
+    name = _NP_SIG_INV.get(dt, "float32")
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.zeros(dims, ml_dtypes.bfloat16)
+    return np.zeros(dims, np.dtype(name))
+
+
+def zero_participate(sig: str, local_size: int = 1) -> None:
+    """Serve one negotiated response batch with zero dummies so the
+    peers' collective completes (the op/root ride the signature's extra
+    field — the compiled SPMD program must match on every process)."""
+    parts = sig.split("+") if sig else [""]
+    fields = parts[0].split(":", 3)
+    kind = fields[2] if len(fields) >= 3 else "allreduce"
+    extra = fields[3] if len(fields) >= 4 else ""
+    # process_local marking matters: peers submitted marked arrays, so a
+    # dummy whose leading dim happens to equal local_size() must NOT be
+    # read as a per-chip axis (ops/collectives._per_chip) — the joined
+    # rank would compile a different SPMD program than its peers.
+    arrs = [_C.process_local(np_zeros_from_signature(p)) for p in parts]
+    if kind == "grouped_allreduce":
+        _C.grouped_allreduce(arrs, op=ReduceOp(int(extra)) if extra
+                             else Sum)
+    elif kind == "allreduce":
+        _C.allreduce(arrs[0], op=ReduceOp(int(extra)) if extra else Sum)
+    elif kind == "allgather":
+        _C.allgather(arrs[0])
+    elif kind == "allgather_ragged":
+        # 0-row contribution: peers' concat sees nothing from us.
+        _C.allgather_ragged([arrs[0]] * local_size)
+    elif kind == "broadcast":
+        _C.broadcast(arrs[0], root_rank=int(extra) if extra else 0)
+    else:
+        # alltoall's host-side size exchange cannot be mirrored by a
+        # joined rank; the reference restricts Join the same way.
+        raise HorovodInternalError(
+            f"collective kind {kind!r} is not supported while this rank "
+            "has joined (reference: Join supports "
+            "allreduce/allgather/broadcast)")
+
+
+class SyncNegotiator:
+    """Controller-negotiated execution for synchronous frontends.
+
+    One instance per runtime; thread-safe for the single-caller pattern
+    TF uses (ops run on the python thread that drives training).
+    """
+
+    def __init__(self, runtime):
+        self._rt = runtime
+        self._lock = threading.RLock()
+        self._pending: Dict[str, Callable[[], Any]] = {}
+        self._results: Dict[str, Any] = {}
+        self._counter = 0
+
+    def _core(self):
+        core = self._rt.ensure_core()
+        if core is None:
+            raise HorovodInternalError(
+                "negotiated dispatch requires the native core (size > 1 "
+                "with the controller enabled)")
+        return core
+
+    def auto_name(self, prefix: str) -> str:
+        with self._lock:
+            self._counter += 1
+            return f"{prefix}.tfneg.{self._counter}"
+
+    def run(self, name: str, sig: str, op_type: int, nbytes: int,
+            execute: Callable[[], Any], timeout_s: float = 300.0) -> Any:
+        """Submit + pump until this op's negotiated slot runs it."""
+        core = self._core()
+        with self._lock:
+            self._pending[name] = execute
+        core.submit(name, sig, op_type, nbytes)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                if name in self._results:
+                    return self._results.pop(name)
+            if time.monotonic() > deadline:
+                raise HorovodInternalError(
+                    f"timed out after {timeout_s}s negotiating {name!r} "
+                    "(stalled peer?)")
+            resp = core.wait(timeout_s=1.0)
+            if resp is not None:
+                self._execute_response(resp)
+
+    def _execute_response(self, resp) -> None:
+        if resp.type == "ERROR":
+            raise HorovodInternalError(
+                f"controller error: {resp.error}")
+        if resp.type in ("JOIN_DONE", "SHUTDOWN"):
+            return
+        for name, sig in zip(resp.names,
+                             resp.sigs or [""] * len(resp.names)):
+            with self._lock:
+                execute = self._pending.pop(name, None)
+            if execute is not None:
+                result = execute()
+                with self._lock:
+                    self._results[name] = result
+            else:
+                zero_participate(sig, self._rt.local_size())
+
+    def join(self, timeout_s: float = 300.0) -> int:
+        """Reference TF join(): no more collectives from this rank; serve
+        stragglers with zeros until every rank joined.  Returns the last
+        rank to join (carried in JOIN_DONE, matching the torch path)."""
+        core = self._core()
+        core.join()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            resp = core.wait(timeout_s=1.0)
+            if resp is None:
+                continue
+            if resp.type == "JOIN_DONE":
+                return resp.total_bytes
+            self._execute_response(resp)
+        raise HorovodInternalError("join() timed out waiting for peers")
+
+
+OP_ALLREDUCE = _basics.OP_ALLREDUCE
+OP_ALLGATHER = _basics.OP_ALLGATHER
+OP_BROADCAST = _basics.OP_BROADCAST
+OP_JOIN = _basics.OP_JOIN

@@ -44,6 +44,11 @@ def make_parser() -> argparse.ArgumentParser:
                     "(horovodrun equivalent)")
     p.add_argument("-np", "--num-proc", type=int, required=False,
                    help="total number of worker processes")
+    p.add_argument("--tpu", action="store_true",
+                   help="discover the TPU pod slice's worker hosts from "
+                        "TPU_WORKER_HOSTNAMES / GCE metadata instead of "
+                        "-H (reference analog: the launcher's host "
+                        "discovery tier, driver_service.py:49-193)")
     p.add_argument("-H", "--hosts", default=None,
                    help="comma-separated host:slots, e.g. h1:1,h2:1")
     p.add_argument("--hostfile", default=None,
@@ -328,6 +333,8 @@ def resolve_coord_host(rank0_hostname: str,
 def resolve_hosts(args: argparse.Namespace) -> List[hosts_mod.HostInfo]:
     if args.hosts and args.hostfile:
         raise ValueError("use either --hosts or --hostfile, not both")
+    if getattr(args, "tpu", False) and (args.hosts or args.hostfile):
+        raise ValueError("--tpu discovers the host list; drop -H/--hostfile")
     if args.hostfile:
         with open(args.hostfile) as f:
             spec = ",".join(line.strip() for line in f
@@ -335,7 +342,42 @@ def resolve_hosts(args: argparse.Namespace) -> List[hosts_mod.HostInfo]:
         return hosts_mod.parse_hosts(spec)
     if args.hosts:
         return hosts_mod.parse_hosts(args.hosts)
-    return [hosts_mod.HostInfo("localhost", args.num_proc or 1)]
+    from .tpu_discovery import discover_tpu_hosts, tpu_worker_id
+    tpu_flag = getattr(args, "tpu", False)
+    slots = getattr(args, "slots", None) or 1
+    # The GCE metadata probe (blocking HTTP, 2s timeout) only runs under
+    # --tpu; plain hvdrun auto-detects from the env vars alone, so
+    # non-GCE launches never stall on metadata DNS.
+    discovered = discover_tpu_hosts(
+        slots_per_host=slots,
+        metadata_fetch=None if tpu_flag else (lambda a: None))
+    local = [hosts_mod.HostInfo("localhost", args.num_proc or 1)]
+    if discovered is not None:
+        wid = tpu_worker_id(
+            metadata_fetch=None if tpu_flag else (lambda a: None))
+        if wid not in (None, 0):
+            # The TPU runtime starts the same command on every worker VM;
+            # only worker 0 plays the driver (reference: driver service
+            # lives on one node, driver_service.py:49).
+            if tpu_flag:
+                raise ValueError(
+                    f"--tpu: this is slice worker {wid}; run hvdrun on "
+                    "worker 0 only — it launches the other workers")
+            return local
+        total = sum(h.slots for h in discovered)
+        if not tpu_flag and args.num_proc and args.num_proc > total:
+            print(f"hvdrun: TPU slice env present ({len(discovered)} "
+                  f"hosts x {slots} slots) but -np {args.num_proc} "
+                  "exceeds its slots; launching locally (pass --tpu to "
+                  "force slice mode)", file=sys.stderr)
+            return local
+        return discovered
+    if tpu_flag:
+        raise ValueError(
+            "--tpu: no multi-host TPU slice detected (TPU_WORKER_HOSTNAMES "
+            "unset and GCE metadata unreachable); on a single-host slice "
+            "run without --tpu")
+    return local
 
 
 def _is_local(hostname: str) -> bool:

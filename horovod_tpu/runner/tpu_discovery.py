@@ -1,0 +1,88 @@
+"""TPU-VM pod slice host discovery.
+
+The reference launcher discovers and probes remote hosts driver-side
+(reference: horovod/runner/driver/driver_service.py:49-193 — the driver
+service starts task services on every host and routes interfaces).  On
+TPU pods none of that probing is needed: every worker VM of a slice is
+told its peers by the TPU runtime, through either
+
+  * the ``TPU_WORKER_HOSTNAMES`` / ``TPU_WORKER_ID`` environment
+    variables (set on each worker VM of a multi-host slice), or
+  * the GCE metadata server's TPU attributes
+    (``instance/attributes/worker-network-endpoints`` — a
+    ``ip:port,ip:port,...`` list — and ``agent-worker-number``).
+
+``hvdrun --tpu`` (or plain ``hvdrun`` with the env present) turns that
+into the same HostInfo list an explicit ``-H host1:1,host2:1`` would
+produce, with one process per host by default — on TPU VMs jax owns all
+local chips of a host, so the natural worker unit is one process per
+host (``--slots`` overrides for process-per-chip layouts).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+from .hosts import HostInfo
+
+_METADATA_BASE = ("http://metadata.google.internal/computeMetadata/v1/"
+                  "instance/attributes/")
+
+
+def _metadata_fetch(attribute: str, timeout: float = 2.0) -> Optional[str]:
+    """GET one GCE metadata attribute; None when unreachable (not on GCE)
+    or absent.  Kept tiny and injectable so tests run without a metadata
+    server."""
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(_METADATA_BASE + attribute,
+                                 headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.read().decode()
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def discover_tpu_hosts(slots_per_host: int = 1,
+                       environ=None,
+                       metadata_fetch: Optional[Callable] = None
+                       ) -> Optional[List[HostInfo]]:
+    """The slice's worker host list, or None when this VM is not part of
+    a multi-host TPU slice (single-host slices have no peer list and fall
+    back to localhost exactly like a bare ``hvdrun -np N``)."""
+    env = os.environ if environ is None else environ
+    fetch = metadata_fetch or _metadata_fetch
+
+    hostnames = env.get("TPU_WORKER_HOSTNAMES", "").strip()
+    if not hostnames:
+        endpoints = fetch("worker-network-endpoints")
+        if endpoints:
+            # 'ip:port:idx,...' or 'ip:port,...' — the host part is what
+            # the launcher dials over ssh.
+            hostnames = ",".join(
+                e.split(":")[0] for e in endpoints.split(",") if e.strip())
+    if not hostnames:
+        return None
+    hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
+    if len(hosts) < 2:
+        return None  # single-host slice: nothing to discover
+    return [HostInfo(hostname=h, slots=slots_per_host) for h in hosts]
+
+
+def tpu_worker_id(environ=None,
+                  metadata_fetch: Optional[Callable] = None
+                  ) -> Optional[int]:
+    """This VM's index within the slice (TPU_WORKER_ID /
+    agent-worker-number) — lets the launcher refuse to run on a
+    non-zero worker, mirroring the reference's driver-on-rank-0 model."""
+    env = os.environ if environ is None else environ
+    fetch = metadata_fetch or _metadata_fetch
+    wid = env.get("TPU_WORKER_ID", "").strip()
+    if not wid:
+        wid = (fetch("agent-worker-number") or "").strip()
+    try:
+        return int(wid)
+    except ValueError:
+        return None

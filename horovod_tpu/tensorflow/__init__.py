@@ -55,7 +55,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "process_rank", "process_size",
     "mesh", "allreduce", "grouped_allreduce", "allgather", "broadcast",
-    "alltoall", "reducescatter", "DistributedOptimizer",
+    "alltoall", "reducescatter", "join", "DistributedOptimizer",
     "DistributedGradientTape", "broadcast_variables",
     "broadcast_global_variables", "broadcast_object", "allgather_object",
     "SyncBatchNormalization", "Compression", "ReduceOp", "Average", "Sum",
@@ -117,6 +117,48 @@ def _tf_from_np(a: Any, like_dtype: tf.DType) -> tf.Tensor:
     return tf.cast(tf.convert_to_tensor(arr), like_dtype)
 
 
+# ------------------------------------------------------ negotiated dispatch
+def _negotiator():
+    """The controller-negotiated path for TF's dense collectives, active
+    when ``HOROVOD_TF_JOIN=1`` and the run is multi-process (see the knob
+    help; reference: TF ops always negotiate, mpi_ops.cc EnqueueTensor).
+    Returns None on the default fast path (ordered-by-construction)."""
+    from ..common.knobs import current
+    if not current("HOROVOD_TF_JOIN"):
+        return None
+    rt = _rt.get()
+    if rt.process_size() <= 1:
+        return None
+    neg = getattr(rt, "tf_negotiator", None)
+    if neg is None:
+        from ..ops.negotiated import SyncNegotiator
+        neg = SyncNegotiator(rt)
+        rt.tf_negotiator = neg
+    return neg
+
+
+def join() -> int:
+    """Uneven-input Join (reference: tensorflow/mpi_ops.py:334): signal
+    that this rank submits no more collectives, serve peers' negotiated
+    ops with zero dummies until every rank joined, return the last rank
+    to join.
+
+    Requires ``HOROVOD_TF_JOIN=1`` (negotiated TF dispatch): without the
+    controller in the loop, a joined rank cannot know which collectives
+    its peers will launch.  With it, sparse gradients must use
+    ``sparse_as_dense=True`` (the reference restricts Join to the
+    allreduce family for the same reason)."""
+    rt = _rt.get()
+    if rt.process_size() <= 1:
+        return rt.rank()
+    neg = _negotiator()
+    if neg is None:
+        raise RuntimeError(
+            "join() on the TF frontend requires HOROVOD_TF_JOIN=1 "
+            "(controller-negotiated dispatch); see docs/knobs.md")
+    return neg.join()
+
+
 # --------------------------------------------------------------------- the ops
 def allreduce(tensor, average: Optional[bool] = None,
               name: Optional[str] = None,
@@ -136,9 +178,21 @@ def allreduce(tensor, average: Optional[bool] = None,
                                  prescale_factor=prescale_factor,
                                  postscale_factor=postscale_factor)
     wire, ctx = compression.compress(tensor)
-    out = _C.allreduce(_np_from_tf(wire), op=op, name=name,
-                       prescale_factor=prescale_factor,
-                       postscale_factor=postscale_factor)
+    arr = _np_from_tf(wire)
+    neg = _negotiator()
+    if neg is None:
+        out = _C.allreduce(arr, op=op, name=name,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+    else:
+        from ..ops.negotiated import OP_ALLREDUCE, np_signature
+        out = neg.run(name or neg.auto_name("tf.allreduce"),
+                      np_signature(arr, "allreduce", str(int(op))),
+                      OP_ALLREDUCE, arr.nbytes,
+                      lambda: _C.allreduce(
+                          arr, op=op,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor))
     return compression.decompress(_tf_from_np(out, wire.dtype), ctx)
 
 
@@ -182,20 +236,47 @@ def grouped_allreduce(tensors: Sequence[tf.Tensor],
     if average is not None:
         op = ReduceOp.AVERAGE if average else ReduceOp.SUM
     arrs = [_np_from_tf(t) for t in tensors]
-    outs = _C.grouped_allreduce(arrs, op=op, name=name)
+    neg = _negotiator()
+    if neg is None:
+        outs = _C.grouped_allreduce(arrs, op=op, name=name)
+    else:
+        from ..ops.negotiated import OP_ALLREDUCE, np_signature
+        sig = "+".join(
+            np_signature(a, "grouped_allreduce", str(int(op)) if i == 0
+                         else "") for i, a in enumerate(arrs))
+        outs = neg.run(name or neg.auto_name("tf.grouped_allreduce"),
+                       sig, OP_ALLREDUCE, sum(a.nbytes for a in arrs),
+                       lambda: _C.grouped_allreduce(arrs, op=op))
     return [_tf_from_np(o, t.dtype) for o, t in zip(outs, tensors)]
 
 
 def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
     """Concatenate along axis 0 across all chip-workers (reference:
     tensorflow/__init__.py allgather)."""
-    out = _C.allgather(_np_from_tf(tensor))
+    arr = _np_from_tf(tensor)
+    neg = _negotiator()
+    if neg is None:
+        out = _C.allgather(arr)
+    else:
+        from ..ops.negotiated import OP_ALLGATHER, np_signature
+        out = neg.run(name or neg.auto_name("tf.allgather"),
+                      np_signature(arr, "allgather"), OP_ALLGATHER,
+                      arr.nbytes, lambda: _C.allgather(arr))
     return _tf_from_np(out, tensor.dtype)
 
 
 def broadcast(tensor: tf.Tensor, root_rank: int = 0,
               name: Optional[str] = None) -> tf.Tensor:
-    out = _C.broadcast(_np_from_tf(tensor), root_rank=root_rank)
+    arr = _np_from_tf(tensor)
+    neg = _negotiator()
+    if neg is None:
+        out = _C.broadcast(arr, root_rank=root_rank)
+    else:
+        from ..ops.negotiated import OP_BROADCAST, np_signature
+        out = neg.run(name or neg.auto_name("tf.broadcast"),
+                      np_signature(arr, "broadcast", str(root_rank)),
+                      OP_BROADCAST, arr.nbytes,
+                      lambda: _C.broadcast(arr, root_rank=root_rank))
     return _tf_from_np(out, tensor.dtype)
 
 

@@ -87,23 +87,14 @@ _SIG_DTYPE = {
     torch.int16: "i16", torch.int8: "i8", torch.uint8: "u8",
     torch.bool: "b1",
 }
-_SIG_DTYPE_INV = {v: k for k, v in _SIG_DTYPE.items()}
-
-
 def _signature(t: torch.Tensor, kind: str, extra: str = "") -> str:
     """Consistency key checked across ranks by the controller (reference:
     ConstructResponse shape/dtype/op validation, controller.cc:472-749).
-    Leading token is the dtype — the controller fuses same-dtype batches."""
+    Leading token is the dtype — the controller fuses same-dtype batches.
+    Same wire dialect as ops/negotiated.np_signature; joined-rank zero
+    dummies are rebuilt there (np_zeros_from_signature)."""
     shape = "x".join(str(s) for s in t.shape)
     return f"{_SIG_DTYPE.get(t.dtype, str(t.dtype))}:{shape}:{kind}:{extra}"
-
-
-def _zeros_from_signature(sig: str) -> torch.Tensor:
-    """Rebuild a zero dummy tensor for a collective this (joined) rank never
-    submitted (reference: JoinOp zero tensor, collective_operations.cc:262)."""
-    dt, shape, _kind, _extra = sig.split(":", 3)
-    dims = tuple(int(s) for s in shape.split("x") if s)
-    return torch.zeros(dims, dtype=_SIG_DTYPE_INV.get(dt, torch.float32))
 
 
 # ------------------------------------------------------------- handle manager
@@ -246,38 +237,12 @@ def _execute_response(resp) -> None:
             _handles.mark_done(op.handle, result)
         else:
             # We never submitted this tensor: we must have JOINed.
-            # Participate with zero dummies so peers' collective completes,
-            # honoring the negotiated op/root carried in the signature extra
-            # field (the compiled SPMD program must be identical on every
-            # process).
-            parts = sig.split("+") if sig else [""]
-            fields = parts[0].split(":", 3)
-            kind = fields[2] if len(fields) >= 3 else "allreduce"
-            extra = fields[3] if len(fields) >= 4 else ""
-            arrs = [_np_from_torch(_zeros_from_signature(p)) for p in parts]
-            if kind == "grouped_allreduce":
-                _C.grouped_allreduce(arrs,
-                                     op=ReduceOp(int(extra)) if extra
-                                     else Sum)
-            elif kind == "allreduce":
-                _C.allreduce(arrs[0],
-                             op=ReduceOp(int(extra)) if extra else Sum)
-            elif kind == "allgather":
-                _C.allgather(arrs[0])
-            elif kind == "allgather_ragged":
-                # 0-row contribution: peers' concat sees nothing from us.
-                _C.allgather_ragged([arrs[0]] * _rt.get().local_size())
-            elif kind == "broadcast":
-                _C.broadcast(arrs[0],
-                             root_rank=int(extra) if extra else 0)
-            else:
-                # alltoall with splits takes a host-side size-exchange
-                # barrier a joined rank cannot mirror; the reference
-                # restricts Join to allreduce-family ops too.
-                raise HorovodInternalError(
-                    f"collective kind {kind!r} is not supported while this "
-                    "rank has joined (reference: Join supports "
-                    "allreduce/allgather/broadcast)")
+            # Participate with zero dummies so peers' collective completes
+            # (shared with the TF negotiated path; the negotiated op/root
+            # ride the signature's extra field so the compiled SPMD
+            # program is identical on every process).
+            from ..ops.negotiated import zero_participate
+            zero_participate(sig, _rt.get().local_size())
 
 
 def _drain(handle: Optional[int] = None, timeout_s: float = 300.0) -> None:

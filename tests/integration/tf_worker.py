@@ -50,6 +50,29 @@ def main() -> int:
                    grad.values.numpy().sum(axis=1).tolist()))
     assert got == {0: 1.0, 1: 1.0}, got
 
+    # join(): uneven inputs across REAL processes (reference:
+    # tensorflow/mpi_ops.py:334).  Requires negotiated TF dispatch.
+    import os
+    try:
+        hvd.join()
+        raise AssertionError("join() without HOROVOD_TF_JOIN must raise")
+    except RuntimeError as e:
+        assert "HOROVOD_TF_JOIN" in str(e)
+    os.environ["HOROVOD_TF_JOIN"] = "1"
+    try:
+        # rank 0 has one extra batch; rank 1 joins early and serves it
+        # with a zero dummy (0 contribution, divisor stays the full chip
+        # count — the reference JoinOp's zero-tensor behavior).
+        out1 = hvd.allreduce(tf.constant([1.0 + pr]), op=hvd.Average)
+        np.testing.assert_allclose(out1.numpy(), [1.5])  # (1+2)/2
+        if pr == 0:
+            out2 = hvd.allreduce(tf.constant([7.0]), op=hvd.Average)
+            np.testing.assert_allclose(out2.numpy(), [3.5])  # (7+0)/2
+        last = hvd.join()
+        assert last == 0, f"last joiner should be rank 0, got {last}"
+    finally:
+        del os.environ["HOROVOD_TF_JOIN"]
+
     print(f"tf worker process {pr} OK", flush=True)
     return 0
 

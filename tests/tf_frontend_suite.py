@@ -568,3 +568,29 @@ def test_keras_load_model_wraps_optimizer(tmp_path):
     # the restored optimizer STATE must survive the wrap (regression:
     # rebuilding from get_config() reset iterations + slot variables)
     assert int(loaded.optimizer.iterations) > 0
+
+
+# -------------------------------------------------------------- Adasum + join
+def test_adasum_optimizer_path():
+    """DistributedOptimizer(op=Adasum) runs the Adasum combine end to end
+    (reference: tensorflow's op=Adasum optimizer arg; VERDICT-r2 #7).
+    With identical per-chip contributions adasum(a, a, ...) == a, so the
+    step must match a plain local gradient step."""
+    v_ada = tf.Variable([1.0, 2.0, 3.0])
+    v_ref = tf.Variable([1.0, 2.0, 3.0])
+    opt_ada = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.5), op=hvd.Adasum)
+    with hvd.DistributedGradientTape(tf.GradientTape(),
+                                     op=hvd.Adasum) as tape:
+        loss = tf.reduce_sum(v_ada ** 2)
+    grads = tape.gradient(loss, [v_ada])
+    opt_ada.apply_gradients(zip(grads, [v_ada]))
+    # local reference step: grad = 2v
+    v_ref.assign_sub(0.5 * 2.0 * v_ref)
+    np.testing.assert_allclose(v_ada.numpy(), v_ref.numpy(), rtol=1e-5)
+
+
+def test_join_single_process_returns_rank():
+    # single process: nobody to wait for (reference join() degenerates the
+    # same way); must not require the negotiation knob
+    assert hvd.join() == hvd.rank()
