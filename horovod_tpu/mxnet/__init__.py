@@ -219,6 +219,8 @@ def DistributedOptimizer(optimizer, gradient_predivide_factor: float = 1.0,
     mx = _mx()
 
     class _DistributedOptimizer(mx.optimizer.Optimizer):
+        _hvd_distributed = True
+
         def __init__(self):
             self._optimizer = optimizer
             self._optimizer.rescale_grad *= \
@@ -288,11 +290,18 @@ def DistributedTrainer(params, optimizer, optimizer_params=None,
     class _DistributedTrainer(mx.gluon.Trainer):
         def __init__(self):
             opt = optimizer
-            if isinstance(opt, DistributedOptimizer):
+            # duck-typed: DistributedOptimizer is a factory, so an
+            # isinstance() against it would TypeError
+            if getattr(opt, "_hvd_distributed", False):
                 import warnings
                 warnings.warn("DistributedTrainer does not take "
                               "DistributedOptimizer; unwrapped it for you")
-                opt = opt._optimizer
+                inner = opt._optimizer
+                # undo the wrapper's in-place rescale_grad division —
+                # the trainer applies its own _scale normalization below,
+                # and keeping both would shrink every step by size()
+                inner.rescale_grad *= size() / opt._gradient_predivide_factor
+                opt = inner
             prm = params
             if isinstance(prm, dict):
                 prm = OrderedDict(prm)

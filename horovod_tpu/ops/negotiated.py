@@ -50,7 +50,10 @@ def np_zeros_from_signature(sig: str) -> np.ndarray:
     JoinOp zero tensor, collective_operations.cc:262)."""
     dt, shape, _kind, _extra = sig.split(":", 3)
     dims = tuple(int(s) for s in shape.split("x") if s)
-    name = _NP_SIG_INV.get(dt, "float32")
+    # unknown tokens are verbatim numpy dtype names (np_signature passes
+    # them through) — resolving them keeps the joined rank's SPMD program
+    # identical to its peers'; a truly bogus token fails loudly below
+    name = _NP_SIG_INV.get(dt, dt)
     if name == "bfloat16":
         import ml_dtypes
         return np.zeros(dims, ml_dtypes.bfloat16)

@@ -490,7 +490,9 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         return 2
     elastic = args.host_discovery_script or args.min_np or args.max_np
     if args.num_proc is None and not (args.hosts or args.hostfile
-                                      or elastic):
+                                      or args.tpu or elastic):
+        # --tpu discovers the host list, so np defaults to its slot
+        # total in launch_static exactly like an explicit -H
         print("hvdrun: -np required when no hosts are given",
               file=sys.stderr)
         return 2

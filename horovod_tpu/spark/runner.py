@@ -111,14 +111,19 @@ class LocalTaskExecutor(TaskExecutor):
         return results
 
 
-def _spark_partition_entry(task_fn):
-    """Runs inside a barrier task: exchange hostnames, then run."""
-    def body(it):
+class _spark_partition_entry:
+    """Runs inside a barrier task: exchange hostnames, then run.  A
+    picklable class (not a closure) so plain pickle suffices — real
+    pyspark cloudpickles closures, but nothing here needs that."""
+
+    def __init__(self, task_fn):
+        self.task_fn = task_fn
+
+    def __call__(self, it):
         from pyspark import BarrierTaskContext
         ctx = BarrierTaskContext.get()
         hostnames = ctx.allGather(socket.gethostname())
-        return [task_fn(ctx.partitionId(), list(hostnames))]
-    return body
+        return [self.task_fn(ctx.partitionId(), list(hostnames))]
 
 
 class SparkTaskExecutor(TaskExecutor):

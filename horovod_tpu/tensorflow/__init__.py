@@ -241,9 +241,9 @@ def grouped_allreduce(tensors: Sequence[tf.Tensor],
         outs = _C.grouped_allreduce(arrs, op=op, name=name)
     else:
         from ..ops.negotiated import OP_ALLREDUCE, np_signature
-        sig = "+".join(
-            np_signature(a, "grouped_allreduce", str(int(op)) if i == 0
-                         else "") for i, a in enumerate(arrs))
+        # op code on EVERY part — the torch frontend's dialect
+        sig = "+".join(np_signature(a, "grouped_allreduce", str(int(op)))
+                       for a in arrs)
         outs = neg.run(name or neg.auto_name("tf.grouped_allreduce"),
                        sig, OP_ALLREDUCE, sum(a.nbytes for a in arrs),
                        lambda: _C.grouped_allreduce(arrs, op=op))

@@ -1,0 +1,160 @@
+"""Strict pyspark barrier-stage contract fake.
+
+Models exactly the surface `horovod_tpu.spark.SparkTaskExecutor` drives —
+``SparkContext.getOrCreate / getConf().get / parallelize``,
+``RDD.barrier().mapPartitions(...).collect()``, and
+``BarrierTaskContext.get()/allGather()/partitionId()`` — with REAL
+semantics: every barrier task runs in its own python process (as real
+pyspark workers do) and ``allGather`` synchronizes them through a
+filesystem rendezvous, so rank-env derivation and cross-process
+collectives in the task body actually execute.
+
+Purpose (VERDICT-r2 #8): pyspark is not installable in this image, so
+``SparkTaskExecutor.run_tasks`` had never executed.  Activate by putting
+``tests/fakes`` on sys.path (see the spark_fake fixture).
+"""
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import time
+
+
+class _Conf:
+    def get(self, key, default=None):
+        return default
+
+
+class SparkContext:
+    _active_spark_context = None
+
+    def __init__(self):
+        SparkContext._active_spark_context = self
+
+    @classmethod
+    def getOrCreate(cls):
+        return cls._active_spark_context or cls()
+
+    def getConf(self):
+        return _Conf()
+
+    def parallelize(self, data, numSlices):
+        return RDD(list(data), numSlices)
+
+    def stop(self):
+        SparkContext._active_spark_context = None
+
+
+class RDD:
+    def __init__(self, data, num_slices):
+        self._data = data
+        self._n = num_slices
+
+    def barrier(self):
+        return _BarrierRDD(self)
+
+
+class _BarrierRDD:
+    def __init__(self, rdd):
+        self._rdd = rdd
+
+    def mapPartitions(self, f):
+        return _MappedBarrierRDD(self._rdd, f)
+
+
+class _MappedBarrierRDD:
+    def __init__(self, rdd, f):
+        self._rdd = rdd
+        self._f = f
+
+    def collect(self):
+        import cloudpickle
+        n = self._rdd._n
+        parts = [[] for _ in range(n)]
+        for i, item in enumerate(self._rdd._data):
+            parts[i * n // max(len(self._rdd._data), 1)].append(item)
+        rdv = tempfile.mkdtemp(prefix="pyspark_fake_barrier_")
+        fakes_dir = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [fakes_dir] + [p for p in sys.path if p])
+        procs = []
+        for idx in range(n):
+            payload = os.path.join(rdv, f"task_{idx}.pkl")
+            with open(payload, "wb") as fh:
+                cloudpickle.dump((self._f, parts[idx], idx, n, rdv), fh)
+            procs.append((idx, subprocess.Popen(
+                [sys.executable, "-m", "pyspark._task", payload],
+                env=env)))
+        out = []
+        failed = []
+        for idx, p in procs:
+            rc = p.wait(timeout=600)
+            res_path = os.path.join(rdv, f"task_{idx}.out")
+            if rc != 0 or not os.path.exists(res_path):
+                failed.append((idx, rc))
+                continue
+            with open(res_path, "rb") as fh:
+                out.extend(pickle.load(fh))
+        if failed:
+            raise RuntimeError(  # what py4j surfaces as a task failure
+                f"barrier stage failed: tasks {failed} died")
+        return out
+
+
+class BarrierTaskContext:
+    """Per-task context; in a worker process the _task bootstrap installs
+    the singleton before running the partition function."""
+
+    _ctx = None
+
+    def __init__(self, idx, n, rdv):
+        self._idx = idx
+        self._n = n
+        self._rdv = rdv
+        self._round = 0
+
+    @classmethod
+    def get(cls):
+        if cls._ctx is None:
+            raise RuntimeError("not inside a barrier task")
+        return cls._ctx
+
+    def partitionId(self):
+        return self._idx
+
+    def allGather(self, message):
+        self._round += 1
+        mine = os.path.join(self._rdv,
+                            f"ag_{self._round}_{self._idx}.txt")
+        tmp = mine + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(message))
+        os.replace(tmp, mine)
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            vals = []
+            for i in range(self._n):
+                p = os.path.join(self._rdv, f"ag_{self._round}_{i}.txt")
+                if not os.path.exists(p):
+                    break
+                with open(p) as f:
+                    vals.append(f.read())
+            else:
+                return vals
+            time.sleep(0.02)
+        raise RuntimeError(f"allGather round {self._round} timed out")
+
+
+def barrier_task_main(payload_path):
+    with open(payload_path, "rb") as fh:
+        f, items, idx, n, rdv = pickle.load(fh)
+    BarrierTaskContext._ctx = BarrierTaskContext(idx, n, rdv)
+    result = list(f(iter(items)))
+    tmp = os.path.join(rdv, f"task_{idx}.out.tmp")
+    with open(tmp, "wb") as fh:
+        pickle.dump(result, fh)
+    os.replace(tmp, os.path.join(rdv, f"task_{idx}.out"))

@@ -1,0 +1,9 @@
+"""Barrier-task bootstrap for the pyspark fake (run as
+``python -m pyspark._task <payload.pkl>`` in its own process)."""
+
+import sys
+
+from . import barrier_task_main
+
+if __name__ == "__main__":
+    barrier_task_main(sys.argv[1])

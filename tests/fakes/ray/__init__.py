@@ -1,0 +1,177 @@
+"""Strict ray actor contract fake.
+
+Models exactly the surface ``horovod_tpu.ray.RayWorkerPool`` drives —
+``@ray.remote`` actor classes, ``Actor.options(...).remote()``, remote
+method calls returning object refs, ``ray.get`` (single/list, timeout),
+``ray.kill``, and ``ray.util.placement_group`` / ``remove_placement_group``
+— with REAL semantics: each actor is its own python process (as real ray
+actors are), the class is shipped by value with cloudpickle (as real ray
+does), and object refs resolve over the actor's pipe.
+
+Purpose (VERDICT-r2 #8): ray is not installable in this image, so
+``RayWorkerPool.execute`` had never executed.  Activate by putting
+``tests/fakes`` on sys.path (see the ray_fake fixture).
+"""
+
+import multiprocessing
+import types
+from typing import Any, Dict, List
+
+
+def _actor_loop(conn):
+    """Generic actor process: receive the cloudpickled class, instantiate,
+    dispatch method calls in order."""
+    import cloudpickle
+    obj = None
+    while True:
+        msg = conn.recv()
+        kind = msg[0]
+        if kind == "init":
+            cls = cloudpickle.loads(msg[1])
+            obj = cls(*msg[2], **msg[3])
+            conn.send(("ok", None))
+        elif kind == "call":
+            _, method, args, kwargs = msg
+            try:
+                conn.send(("ok", getattr(obj, method)(*args, **kwargs)))
+            except BaseException as e:  # surfaced by ray.get
+                import traceback
+                conn.send(("error", f"{e}\n{traceback.format_exc()}"))
+        elif kind == "stop":
+            conn.close()
+            return
+
+
+class ObjectRef:
+    def __init__(self, actor, seq):
+        self._actor = actor
+        self._seq = seq
+
+
+class _ImmediateRef(ObjectRef):
+    def __init__(self, value):
+        self._value = value
+
+
+class _ActorMethod:
+    def __init__(self, actor, name):
+        self._actor = actor
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        return self._actor._submit(self._name, args, kwargs)
+
+
+class _ActorHandle:
+    def __init__(self, cls_payload, args, kwargs):
+        ctx = multiprocessing.get_context("spawn")
+        self._conn, child = ctx.Pipe()
+        self._proc = ctx.Process(target=_actor_loop, args=(child,),
+                                 daemon=True)
+        self._proc.start()
+        self._seq = 0
+        self._recv_seq = 0
+        self._results: Dict[int, Any] = {}
+        self._conn.send(("init", cls_payload, args, kwargs))
+        status, _ = self._conn.recv()
+        assert status == "ok"
+
+    def _submit(self, method, args, kwargs):
+        self._conn.send(("call", method, args, kwargs))
+        self._seq += 1
+        return ObjectRef(self, self._seq)
+
+    def _resolve(self, seq, timeout):
+        # responses arrive strictly in submission order (one pipe, one
+        # dispatch loop) — correlation is a counter
+        while seq not in self._results:
+            if timeout is not None and not self._conn.poll(timeout):
+                raise TimeoutError(f"ray.get timed out after {timeout}s")
+            status, value = self._conn.recv()
+            self._recv_seq += 1
+            if status == "error":
+                raise RayTaskError(value)
+            self._results[self._recv_seq] = value
+        return self._results.pop(seq)
+
+    def _kill(self):
+        try:
+            self._conn.send(("stop", None))
+        except (BrokenPipeError, OSError):
+            pass
+        self._proc.join(timeout=5)
+        if self._proc.is_alive():
+            self._proc.terminate()
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ActorMethod(self, name)
+
+
+class RayTaskError(RuntimeError):
+    pass
+
+
+class _RemoteClass:
+    def __init__(self, cls, options=None):
+        import cloudpickle
+        self._payload = cloudpickle.dumps(cls)
+        self._options = dict(options or {})
+
+    def options(self, **kwargs):
+        return _RemoteClass.__new__(_RemoteClass)._adopt(
+            self._payload, {**self._options, **kwargs})
+
+    def _adopt(self, payload, options):
+        self._payload = payload
+        self._options = options
+        return self
+
+    def remote(self, *args, **kwargs):
+        return _ActorHandle(self._payload, args, kwargs)
+
+
+def remote(cls):
+    return _RemoteClass(cls)
+
+
+def get(refs, timeout=None):
+    if isinstance(refs, list):
+        return [get(r, timeout=timeout) for r in refs]
+    if isinstance(refs, _ImmediateRef):
+        return refs._value
+    return refs._actor._resolve(refs._seq, timeout)
+
+
+def kill(actor):
+    actor._kill()
+
+
+class _PlacementGroup:
+    def __init__(self, bundles, strategy):
+        self.bundles = bundles
+        self.strategy = strategy
+        self.removed = False
+
+    def ready(self):
+        return _ImmediateRef(self)
+
+
+def _placement_group(bundles: List[dict], strategy: str = "PACK"):
+    if strategy not in ("PACK", "STRICT_PACK", "SPREAD", "STRICT_SPREAD"):
+        raise ValueError(f"unknown placement strategy {strategy!r}")
+    return _PlacementGroup(bundles, strategy)
+
+
+def _remove_placement_group(pg):
+    pg.removed = True
+
+
+util = types.ModuleType("ray.util")
+util.placement_group = _placement_group
+util.remove_placement_group = _remove_placement_group
+
+import sys as _sys
+
+_sys.modules.setdefault("ray.util", util)

@@ -1,0 +1,201 @@
+"""Strict mxnet contract shim: the exact NDArray/optimizer/gluon surface
+the horovod_tpu.mxnet binding touches, with REAL behavior (numpy-backed
+arrays, SGD updates, deferred-init parameters, Trainer.step driving
+_allreduce_grads then updates).
+
+Purpose (VERDICT-r2 #8): mxnet is not installable in this image, so the
+binding's DistributedOptimizer.update / DistributedTrainer._allreduce_grads
+/ deferred-init broadcast hook had never executed.  This shim is strict —
+anything the binding touches beyond the modeled contract raises
+AttributeError — so a green test means the binding's real code ran, not
+that a mock swallowed it.
+
+Install via sys.modules (see tests/test_mxnet.py mx_shim fixture); the
+binding's lazy ``import mxnet`` then resolves here.
+"""
+
+import types
+from collections import OrderedDict
+
+import numpy as np
+
+
+class NDArray:
+    """numpy-backed NDArray: asnumpy / dtype / shape / slice assignment —
+    the bridge surface (mxnet arrays cross into the data plane as numpy
+    and results are written back in place)."""
+
+    def __init__(self, data, dtype=None):
+        self._a = np.array(data, dtype=dtype or np.float32)
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    def asnumpy(self):
+        return self._a.copy()
+
+    def __setitem__(self, key, value):
+        self._a[key] = value._a if isinstance(value, NDArray) \
+            else np.asarray(value)
+
+    def __repr__(self):
+        return f"ShimNDArray({self._a!r})"
+
+
+def _nd_array(data, dtype=None):
+    if isinstance(data, NDArray):
+        return NDArray(data._a, dtype)
+    return NDArray(data, dtype)
+
+
+class Optimizer:
+    """mx.optimizer.Optimizer contract: rescale_grad + update(index,
+    weight, grad, state).  The base class is what DistributedOptimizer
+    subclasses (gluon isinstance-checks it)."""
+
+    def __init__(self, learning_rate=0.01, rescale_grad=1.0):
+        self.lr = learning_rate
+        self.rescale_grad = rescale_grad
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self._lr_mult = args_lr_mult
+
+    def set_wd_mult(self, args_wd_mult):
+        self._wd_mult = args_wd_mult
+
+
+class SGD(Optimizer):
+    def update(self, index, weight, grad, state):
+        # real mx optimizers accept the (index, weight, grad, state)
+        # list form as well as scalars
+        if isinstance(index, (list, tuple)):
+            for i, w, g, s in zip(index, weight, grad, state):
+                self.update(i, w, g, s)
+            return
+        weight[:] = weight.asnumpy() - self.lr * self.rescale_grad * \
+            grad.asnumpy()
+
+
+def _opt_create(name, **kwargs):
+    table = {"sgd": SGD}
+    if name not in table:
+        raise ValueError(f"shim models only {sorted(table)}, got {name!r}")
+    if "learning_rate" not in kwargs:
+        kwargs.setdefault("learning_rate", 0.01)
+    return table[name](**kwargs)
+
+
+class DeferredInitializationError(Exception):
+    pass
+
+
+class Parameter:
+    """gluon Parameter: data()/list_grad()/grad_req plus the _init_impl
+    hook point broadcast_parameters wraps for deferred initialization."""
+
+    def __init__(self, name, shape=None, grad_req="write"):
+        self.name = name
+        self.grad_req = grad_req
+        self._shape = shape
+        self._data = None
+        self._grad = None
+
+    def data(self):
+        if self._data is None:
+            raise DeferredInitializationError(
+                f"parameter {self.name} not initialized yet")
+        return self._data
+
+    def list_grad(self):
+        if self._grad is None:
+            raise DeferredInitializationError(
+                f"parameter {self.name} has no grad yet")
+        return [self._grad]
+
+    def _init_impl(self, init, ctx, default_init, data):
+        self._data = _nd_array(data)
+        self._grad = _nd_array(np.zeros_like(self._data._a))
+
+    def initialize(self, data):
+        # gluon resolves shapes at first forward; the shim initializes
+        # through the SAME _init_impl chokepoint so a wrapped hook fires.
+        self._init_impl(None, None, None, data)
+
+
+class Trainer:
+    """gluon Trainer contract: step(batch) = rescale, _allreduce_grads,
+    per-param optimizer.update — the method order the binding's override
+    depends on (its _allreduce_grads must see raw grads, before update)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore=None):
+        if isinstance(params, (dict, OrderedDict)):
+            params = list(params.values())
+        self._params = list(params)
+        if isinstance(optimizer, str):
+            optimizer = _opt_create(optimizer, **(optimizer_params or {}))
+        elif optimizer_params:
+            raise ValueError(
+                "optimizer_params only combine with a str optimizer name")
+        if not isinstance(optimizer, Optimizer):
+            raise TypeError(f"not an mx Optimizer: {optimizer!r}")
+        self._optimizer = optimizer
+        self._scale = optimizer.rescale_grad
+        self._kvstore = kvstore
+
+    def _allreduce_grads(self):
+        pass  # kvstore reduction; the binding overrides this
+
+    def step(self, batch_size):
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update()
+
+    def _update(self):
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                self._optimizer.update(i, p.data(), p.list_grad()[0], None)
+
+
+def build_module():
+    """Assemble module objects so ``import mxnet`` / ``mx.gluon.parameter``
+    resolve exactly like the real package layout."""
+    mxnet = types.ModuleType("mxnet")
+    nd = types.ModuleType("mxnet.nd")
+    nd.array = _nd_array
+    nd.NDArray = NDArray
+    opt = types.ModuleType("mxnet.optimizer")
+    opt.Optimizer = Optimizer
+    opt.SGD = SGD
+    opt.create = _opt_create
+    gluon = types.ModuleType("mxnet.gluon")
+    gluon_parameter = types.ModuleType("mxnet.gluon.parameter")
+    gluon_parameter.Parameter = Parameter
+    gluon_parameter.DeferredInitializationError = DeferredInitializationError
+    gluon.parameter = gluon_parameter
+    gluon.Parameter = Parameter
+    gluon.Trainer = Trainer
+    mxnet.nd = nd
+    mxnet.optimizer = opt
+    mxnet.gluon = gluon
+    return mxnet

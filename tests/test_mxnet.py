@@ -1,10 +1,17 @@
-"""MXNet frontend: full op coverage when mxnet is installed, gating
-behavior when it is not (reference: test/parallel/test_mxnet.py)."""
+"""MXNet frontend: import gating without mxnet, and the REAL binding
+paths (ops, DistributedOptimizer.update, DistributedTrainer.
+_allreduce_grads, deferred-init broadcast hook) executed against the
+strict contract shim in tests/mxnet_shim.py (reference:
+test/parallel/test_mxnet.py; VERDICT-r2 #8 — these paths had never run
+because mxnet is not installable here)."""
+
+import sys
 
 import numpy as np
 import pytest
 
 import horovod_tpu.mxnet as hmx
+import mxnet_shim
 
 
 def test_topology_without_mxnet(hvd):
@@ -13,7 +20,7 @@ def test_topology_without_mxnet(hvd):
     assert hmx.local_size() == 8
 
 
-def _have_mxnet():
+def _have_real_mxnet():
     try:
         import mxnet  # noqa: F401
         return True
@@ -21,7 +28,8 @@ def _have_mxnet():
         return False
 
 
-@pytest.mark.skipif(_have_mxnet(), reason="mxnet installed; gate not hit")
+@pytest.mark.skipif(_have_real_mxnet(),
+                    reason="mxnet installed; gate not hit")
 def test_ops_raise_actionable_importerror(hvd):
     with pytest.raises(ImportError, match="mxnet"):
         hmx.allreduce(np.ones(3))
@@ -29,42 +37,138 @@ def test_ops_raise_actionable_importerror(hvd):
         hmx.DistributedOptimizer(object())
 
 
-@pytest.mark.skipif(not _have_mxnet(), reason="mxnet not installed")
-class TestWithMXNet:
-    def test_allreduce_sum_average(self, hvd):
-        import mxnet as mx
-        t = mx.nd.array([1.0, 2.0])
-        out = hmx.allreduce(t, op=hmx.Sum)
-        np.testing.assert_allclose(out.asnumpy(), [8.0, 16.0])
-        out = hmx.allreduce(t, average=True)
-        np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])
+@pytest.fixture()
+def mx(hvd, monkeypatch):
+    shim = mxnet_shim.build_module()
+    monkeypatch.setitem(sys.modules, "mxnet", shim)
+    return shim
 
-    def test_inplace_and_grouped(self, hvd):
-        import mxnet as mx
-        t = mx.nd.array([2.0])
-        hmx.allreduce_(t, average=True)
-        np.testing.assert_allclose(t.asnumpy(), [2.0])
-        ts = [mx.nd.array([float(i)]) for i in range(3)]
-        hmx.grouped_allreduce_(ts, average=False)
-        for i, t in enumerate(ts):
-            np.testing.assert_allclose(t.asnumpy(), [8.0 * i])
 
-    def test_broadcast_and_allgather(self, hvd):
-        import mxnet as mx
-        t = mx.nd.array([[5.0]])
+# ------------------------------------------------------------------- ops
+def test_allreduce_sum_average(mx):
+    t = mx.nd.array([1.0, 2.0])
+    out = hmx.allreduce(t, op=hmx.Sum)
+    np.testing.assert_allclose(out.asnumpy(), [8.0, 16.0])
+    out = hmx.allreduce(t, average=True)
+    np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])
+
+
+def test_inplace_and_grouped(mx):
+    t = mx.nd.array([2.0])
+    hmx.allreduce_(t, average=True)
+    np.testing.assert_allclose(t.asnumpy(), [2.0])
+    ts = [mx.nd.array([float(i)]) for i in range(3)]
+    hmx.grouped_allreduce_(ts, average=False)
+    for i, t in enumerate(ts):
+        np.testing.assert_allclose(t.asnumpy(), [8.0 * i])
+
+
+def test_broadcast_allgather_alltoall(mx):
+    t = mx.nd.array([[5.0]])
+    np.testing.assert_allclose(
+        hmx.broadcast(t, root_rank=2).asnumpy(), [[5.0]])
+    g = hmx.allgather(mx.nd.array([[1.0, 2.0]]))
+    assert g.shape == (8, 2)
+    a = hmx.alltoall(mx.nd.array(np.arange(8.0)))
+    assert a.shape == (8,)
+
+
+# -------------------------------------------------- DistributedOptimizer
+def test_distributed_optimizer_update_executes(mx):
+    """update(): grads allreduced (sum over 8 chips), rescale_grad
+    normalized by size -> the step equals a LOCAL sgd step."""
+    opt = hmx.DistributedOptimizer(mx.optimizer.SGD(learning_rate=0.5))
+    w = mx.nd.array([1.0, 2.0])
+    g = mx.nd.array([0.2, -0.4])
+    opt.update(0, w, g, None)
+    np.testing.assert_allclose(w.asnumpy(), [1.0 - 0.5 * 0.2,
+                                             2.0 + 0.5 * 0.4], rtol=1e-6)
+
+
+def test_distributed_optimizer_update_list_and_groups(mx):
+    """The index-list form and the num_groups fused form both execute."""
+    opt = hmx.DistributedOptimizer(mx.optimizer.SGD(learning_rate=1.0),
+                                   num_groups=2)
+    ws = [mx.nd.array([float(i)]) for i in range(4)]
+    gs = [mx.nd.array([0.1 * (i + 1)]) for i in range(4)]
+    for i, (w, g) in enumerate(zip(ws, gs)):
+        opt.update([i], [w], [g], [None])
+    for i, w in enumerate(ws):
+        np.testing.assert_allclose(w.asnumpy(), [i - 0.1 * (i + 1)],
+                                   rtol=1e-6)
+
+
+def test_distributed_optimizer_forwards_hyperparams(mx):
+    inner = mx.optimizer.SGD(learning_rate=0.1)
+    opt = hmx.DistributedOptimizer(inner)
+    opt.set_learning_rate(0.7)
+    assert inner.lr == 0.7
+    assert opt.create_state_multi_precision(0, None) is None
+
+
+# ---------------------------------------------------- DistributedTrainer
+def test_distributed_trainer_allreduce_grads_and_step(mx):
+    params = {}
+    for i in range(3):
+        p = mx.gluon.Parameter(f"w{i}")
+        p.initialize([float(i), float(i)])
+        p._grad = mx.nd.array([0.5, -0.5])
+        params[f"w{i}"] = p
+    trainer = hmx.DistributedTrainer(params, "sgd",
+                                     {"learning_rate": 0.2})
+    trainer.step(1)
+    for i in range(3):
         np.testing.assert_allclose(
-            hmx.broadcast(t, root_rank=2).asnumpy(), [[5.0]])
-        g = hmx.allgather(mx.nd.array([[1.0, 2.0]]))
-        assert g.shape == (8, 2)
+            params[f"w{i}"].data().asnumpy(),
+            [i - 0.2 * 0.5, i + 0.2 * 0.5], rtol=1e-6)
 
-    def test_distributed_trainer_step(self, hvd):
-        import mxnet as mx
-        net = mx.gluon.nn.Dense(1)
-        net.initialize()
-        x = mx.nd.random.normal(shape=(4, 3))
-        with mx.autograd.record():
-            loss = (net(x) ** 2).mean()
-        loss.backward()
-        trainer = hmx.DistributedTrainer(
-            net.collect_params(), "sgd", {"learning_rate": 0.1})
-        trainer.step(4)
+
+def test_distributed_trainer_grouped_and_null_grads(mx):
+    params = {}
+    for i in range(4):
+        p = mx.gluon.Parameter(f"w{i}",
+                               grad_req="null" if i == 3 else "write")
+        p.initialize([1.0])
+        p._grad = mx.nd.array([1.0])
+        params[f"w{i}"] = p
+    trainer = hmx.DistributedTrainer(params, "sgd",
+                                     {"learning_rate": 1.0}, num_groups=2)
+    trainer.step(1)
+    for i in range(3):
+        np.testing.assert_allclose(params[f"w{i}"].data().asnumpy(), [0.0])
+    # grad_req='null' params are excluded from reduce AND update
+    np.testing.assert_allclose(params["w3"].data().asnumpy(), [1.0])
+
+
+def test_distributed_trainer_unwraps_distributed_optimizer(mx):
+    """Unwrapping must also undo the wrapper's in-place rescale_grad
+    division, or the step would be divided by size() twice."""
+    inner = mx.optimizer.SGD(learning_rate=1.0)
+    wrapped = hmx.DistributedOptimizer(inner)
+    p = mx.gluon.Parameter("w")
+    p.initialize([2.0])
+    p._grad = mx.nd.array([0.5])
+    with pytest.warns(UserWarning, match="unwrapped"):
+        trainer = hmx.DistributedTrainer({"w": p}, wrapped)
+    assert trainer._optimizer is inner
+    trainer.step(1)
+    # one local-equivalent sgd step: 2.0 - 1.0 * 0.5
+    np.testing.assert_allclose(p.data().asnumpy(), [1.5], rtol=1e-6)
+
+
+# ------------------------------------------------- broadcast_parameters
+def test_broadcast_parameters_immediate_and_deferred(mx):
+    ready = mx.gluon.Parameter("a")
+    ready.initialize([3.0, 4.0])
+    deferred = mx.gluon.Parameter("b")  # no data yet
+    hmx.broadcast_parameters({"a": ready, "b": deferred}, root_rank=0)
+    np.testing.assert_allclose(ready.data().asnumpy(), [3.0, 4.0])
+    # the deferred param's _init_impl was wrapped: first initialization
+    # must run the broadcast hook and leave the param usable
+    deferred.initialize([7.0])
+    np.testing.assert_allclose(deferred.data().asnumpy(), [7.0])
+
+
+def test_broadcast_parameters_rejects_non_dict(mx):
+    with pytest.raises(ValueError, match="invalid params"):
+        hmx.broadcast_parameters([1, 2, 3])

@@ -389,3 +389,23 @@ def test_tpu_nonzero_worker_refuses_driver_role(monkeypatch):
     # plain hvdrun on a non-zero worker quietly runs locally instead
     args = make_parser().parse_args(["-np", "2", "cmd"])
     assert resolve_hosts(args)[0].hostname == "localhost"
+
+
+def test_tpu_flag_defaults_np_like_explicit_hosts(monkeypatch, tmp_path):
+    """`hvdrun --tpu cmd` without -np must not be rejected: np defaults
+    to the discovered slot total exactly like an explicit -H list."""
+    import horovod_tpu.runner.launch as L
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "vm-a,vm-b")
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    seen = {}
+
+    def fake_launch_static(args, command):
+        seen["np"] = args.num_proc
+        seen["hosts"] = [h.hostname for h in L.resolve_hosts(args)]
+        return 0
+
+    monkeypatch.setattr(L, "launch_static", fake_launch_static)
+    rc = run_commandline(["--tpu", "echo", "ok"])
+    assert rc == 0
+    assert seen["np"] is None  # launch_static derives it from slots
+    assert seen["hosts"] == ["vm-a", "vm-b"]
