@@ -320,7 +320,9 @@ def resolve_coord_host(rank0_hostname: str,
             return interface_address(network_interface)
         if not has_remote_workers:
             return "127.0.0.1"
-        if rank0_hostname in ("localhost", "127.0.0.1"):
+        if rank0_hostname == "localhost" or \
+                rank0_hostname.startswith("127."):
+            # any loopback alias is undialable from a remote worker
             return socket.gethostname()
         return rank0_hostname
     if network_interface and warn is not None:
@@ -381,7 +383,11 @@ def resolve_hosts(args: argparse.Namespace) -> List[hosts_mod.HostInfo]:
 
 
 def _is_local(hostname: str) -> bool:
-    return hostname in LOCAL_HOSTNAMES
+    # any 127.0.0.0/8 address is this machine by definition — elastic
+    # tests (and single-host multi-"host" layouts) use loopback aliases
+    # as distinct scheduling hosts, the reference's elastic_common.py
+    # trick
+    return hostname in LOCAL_HOSTNAMES or hostname.startswith("127.")
 
 
 def build_worker_command(slot: hosts_mod.SlotInfo, command: List[str],

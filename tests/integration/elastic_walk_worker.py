@@ -1,0 +1,73 @@
+"""Worker for the elastic 3 -> 2 -> 3 membership walk (VERDICT-r2 #6).
+
+Phases, tracked by marker files in ELASTIC_TEST_DIR:
+  round 0 (3 workers): mesh up, verified allreduce; the worker on the
+      3rd host exits non-zero once -> its host is blacklisted -> reset.
+  round 1 (2 workers): before any mesh bring-up, rank 0 grows the
+      discovery file by a NEW loopback host and both workers park; the
+      driver's discovery poll sees the membership change and resets.
+  round 2 (3 workers again): mesh up on the regrown host set, verified
+      allreduce, success markers, clean exit.
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> int:
+    state_dir = os.environ["ELASTIC_TEST_DIR"]
+    failed = os.path.join(state_dir, "failed_once")
+    grew = os.path.join(state_dir, "grew")
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    size = int(os.environ.get("HOROVOD_SIZE", "0"))
+    # Snapshot the phase marker at SPAWN time: rank 2 writes it mid-round
+    # 0 (after the shared allreduce), so a post-allreduce read on ranks
+    # 0/1 could misfile round 0 as round 2.
+    failed_at_start = os.path.exists(failed)
+
+    if size == 2:
+        # shrunken world (round 1, or a transitional incarnation if the
+        # discovery poll lagged): grow the host set once and park — the
+        # driver terminates us when it notices the membership change
+        assert os.path.exists(failed), "shrink before any failure?"
+        if rank == 0 and not os.path.exists(grew):
+            with open(os.path.join(state_dir, "hosts.txt"), "a") as f:
+                f.write("127.0.0.3:1\n")
+            open(grew, "w").write("x")
+            print("elastic walk: grew host set to 3", flush=True)
+        open(os.path.join(state_dir, f"round1_seen_{rank}"), "w").write("x")
+        time.sleep(120)  # the driver terminates us on the host change
+        return 1  # only reached if the reset never came
+
+    import _env_setup  # noqa: F401  (must run before other jax imports)
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    assert hvd.process_size() == 3, hvd.process_size()
+    rt = hvd.runtime.get()
+    positions = rt.local_chip_positions()
+    n = hvd.size()
+    x = np.stack([np.full((2,), float(pos), np.float32)
+                  for pos in positions])
+    out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+    assert np.allclose(out, float(sum(range(n)))), out
+
+    pr = hvd.process_rank()
+    if not failed_at_start:
+        # round 0: the worker on the third host simulates a host loss
+        if pr == 2:
+            open(failed, "w").write("x")
+            print("elastic walk: rank 2 simulating host loss", flush=True)
+            return 1
+        return 0
+
+    # round 2: regrown to 3 processes
+    open(os.path.join(state_dir, f"walk_ok_{pr}"), "w").write("done")
+    print(f"elastic walk worker {pr} OK (round 2)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,50 @@
+"""Hierarchical two-level allreduce across a 2x2 process mesh: 4 real
+processes x 2 chips = dcn.data=2 over ici.data=4 — the DCN axis spans a
+REAL process boundary, so the two-level RS -> DCN-AR -> AG path
+(parallel/hierarchical.py; reference: nccl_operations.cc:188-319) runs
+with cross-process collectives in both stages (VERDICT-r2 #6)."""
+
+import os
+import sys
+
+os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+os.environ["HOROVOD_TPU_MESH"] = "dcn.data=2,ici.data=4"
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.process_size() == 4, hvd.process_size()
+    assert hvd.size() == 8, hvd.size()
+    rt = hvd.runtime.get()
+    assert dict(rt.mesh.shape) == {"dcn.data": 2, "ici.data": 4}, \
+        rt.mesh.shape
+    positions = rt.local_chip_positions()
+
+    # eager allreduce under the forced two-level path: per-chip distinct
+    # values; sum over all 8 chips regardless of the dcn/ici split
+    x = np.stack([np.full((5,), float(pos), np.float32)
+                  for pos in positions])
+    out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+    assert np.allclose(out, float(sum(range(8)))), out
+    avg = np.asarray(hvd.allreduce(x, op=hvd.Average))
+    assert np.allclose(avg, sum(range(8)) / 8.0), avg
+
+    # ragged payload sizes (not a multiple of the ici group) exercise the
+    # padding path
+    y = np.stack([np.full((7,), 1.0 + pos, np.float32)
+                  for pos in positions])
+    out = np.asarray(hvd.allreduce(y, op=hvd.Sum))
+    assert np.allclose(out, 8.0 + float(sum(range(8)))), out
+
+    print(f"hier worker process {hvd.process_rank()} OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

