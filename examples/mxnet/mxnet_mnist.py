@@ -1,0 +1,63 @@
+"""MXNet frontend example (reference: examples/mxnet/mxnet_mnist.py):
+gluon training with DistributedTrainer, broadcast_parameters, and
+size-scaled LR.  Requires the mxnet package (the frontend itself is
+lazily gated; see tests/mxnet_shim.py for the contract the binding
+drives when mxnet is absent).
+
+Run (with mxnet installed):
+  hvdrun -np 4 python examples/mxnet/mxnet_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+
+import horovod_tpu.mxnet as hvd
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    hvd.init()
+    import mxnet as mx  # after init; raises an actionable error if absent
+    from mxnet import autograd, gluon
+
+    rng = np.random.RandomState(hvd.rank())
+    xs = rng.randn(2048, 1, 28, 28).astype(np.float32)
+    w_true = np.random.RandomState(0).randn(28 * 28, 10)
+    ys = (xs.reshape(len(xs), -1) @ w_true).argmax(1)
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(128, activation="relu"), gluon.nn.Dense(10))
+    net.initialize()
+    # one forward builds the deferred-init params so broadcast sees data
+    net(mx.nd.array(xs[:2]))
+    params = net.collect_params()
+    hvd.broadcast_parameters(params, root_rank=0)
+
+    trainer = hvd.DistributedTrainer(
+        params, "sgd", {"learning_rate": args.lr * hvd.size()})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    n_batches = len(xs) // args.batch_size
+    for epoch in range(args.epochs):
+        total = 0.0
+        for b in range(n_batches):
+            x = mx.nd.array(xs[b * args.batch_size:(b + 1) * args.batch_size])
+            y = mx.nd.array(ys[b * args.batch_size:(b + 1) * args.batch_size])
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            total += float(loss.mean().asnumpy())
+        out = hvd.allreduce(mx.nd.array([total / n_batches]),
+                            average=True)
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {float(out.asnumpy()[0]):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -38,13 +38,30 @@ def main():
         est = LinearEstimator(
             store=store, num_proc=args.num_proc,
             feature_cols=["features"], label_cols=["label"],
-            batch_size=64, epochs=args.epochs, lr=0.1)
-        model = est.fit(df)
+            batch_size=64, epochs=args.epochs, lr=0.1,
+            validation=0.25, metrics=["mse", "mae"])
+        # elastic=True: a worker loss shrinks the job and training
+        # resumes from the last per-epoch checkpoint
+        model = est.fit(df, elastic=True, min_np=1)
+        print("per-epoch history:")
+        for name, series in model.history.items():
+            print(f"  {name}: " + " ".join(f"{v:.4f}" for v in series))
+
+        # the per-epoch checkpoint makes re-fitting a CONTINUATION:
+        est2 = LinearEstimator(
+            store=store, num_proc=args.num_proc,
+            feature_cols=["features"], label_cols=["label"],
+            batch_size=64, epochs=args.epochs + 2, lr=0.1,
+            validation=0.25, metrics=["mse", "mae"])
+        if not est2._has_checkpoint():
+            raise SystemExit("expected the epoch checkpoint from fit()")
+        model = est2.fit_on_parquet()
+        print(f"resumed to {len(model.history['train_loss'])} epochs")
 
         out = model.transform({"features": X[:8], "label": y[:8]})
         print("features -> predictions vs labels:")
         for pred, label in zip(out["predict"][:8], y[:8]):
-            print(f"  {float(pred):8.3f}  {float(label):8.3f}")
+            print(f"  {pred.item():8.3f}  {label.item():8.3f}")
 
 
 if __name__ == "__main__":
