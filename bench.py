@@ -242,6 +242,10 @@ def main() -> int:
                     help="use the pallas flash-attention kernel (forward "
                          "is ~1.3x XLA's, but compiling it inside the "
                          "scanned step is slow on remote-compile setups)")
+    ap.add_argument("--block-q", type=int, default=256,
+                    help="flash attention q-block (VMEM tuning)")
+    ap.add_argument("--block-k", type=int, default=256,
+                    help="flash attention k-block (VMEM tuning)")
     ap.add_argument("--autotune", action="store_true",
                     help="HOROVOD_AUTOTUNE end-to-end: tune (fusion "
                          "threshold, cycle) on the live fused gradient "
@@ -311,8 +315,11 @@ def main() -> int:
     # online softmax on the MXU, ~1.3x the XLA attention at seq 1024.
     attn_fn = None
     if args.flash and not args.cpu:
+        import functools
         from horovod_tpu.ops.flash_attention import flash_attention
-        attn_fn = flash_attention
+        attn_fn = functools.partial(flash_attention,
+                                    block_q=args.block_q,
+                                    block_k=args.block_k)
 
     # --remat uses the model's PER-LAYER checkpointing (the standard TPU
     # memory lever); whole-loss jax.checkpoint wouldn't reduce the peak.

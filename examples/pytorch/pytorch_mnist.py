@@ -93,6 +93,7 @@ def main():
     if os.path.exists(args.ckpt) and pr == 0:
         ck = torch.load(args.ckpt, weights_only=True)
         model.load_state_dict(ck["model"])
+        opt.load_state_dict(ck["opt"])  # momentum buffers resume too
         start_epoch = ck["epoch"] + 1
         print(f"resuming from epoch {start_epoch}")
     # rank 0 read the checkpoint; everyone else adopts its decision
@@ -136,11 +137,16 @@ def main():
             print(f"epoch {epoch}: train_loss "
                   f"{total / steps_per_epoch:.4f} val_loss {vloss:.4f} "
                   f"val_acc {vacc:.3f}")
-            torch.save({"model": model.state_dict(), "epoch": epoch},
+            torch.save({"model": model.state_dict(),
+                        "opt": opt.state_dict(), "epoch": epoch},
                        args.ckpt)
     if pr == 0:
-        assert vacc > 0.5, f"failed to learn: val_acc={vacc}"
-        print("done")
+        if start_epoch >= args.epochs:
+            print(f"nothing to do: checkpoint already at epoch "
+                  f"{start_epoch - 1}; raise --epochs to continue")
+        else:
+            assert vacc > 0.5, f"failed to learn: val_acc={vacc}"
+            print("done")
 
 
 if __name__ == "__main__":

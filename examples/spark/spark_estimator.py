@@ -53,7 +53,7 @@ def main():
             feature_cols=["features"], label_cols=["label"],
             batch_size=64, epochs=args.epochs + 2, lr=0.1,
             validation=0.25, metrics=["mse", "mae"])
-        if not est2._has_checkpoint():
+        if not est2.has_checkpoint():
             raise SystemExit("expected the epoch checkpoint from fit()")
         model = est2.fit_on_parquet()
         print(f"resumed to {len(model.history['train_loss'])} epochs")

@@ -265,11 +265,15 @@ class Estimator:
         raise NotImplementedError
 
     # -- the fit flow ------------------------------------------------------
-    def _has_checkpoint(self) -> bool:
-        """Resume support (reference: estimator.py:91-96): when a
+    def has_checkpoint(self) -> bool:
+        """Resume support (reference: estimator.py:91-96 _has_checkpoint,
+        made public here — user code legitimately branches on it): when a
         checkpoint exists, the next fit/fit_on_parquet CONTINUES training
         from the stored epoch instead of starting over."""
         return self.store.read_checkpoint(self.run_id) is not None
+
+    # reference-parity spelling
+    _has_checkpoint = has_checkpoint
 
     def fit(self, df, elastic: bool = False, min_np: int = 1,
             reset_limit: Optional[int] = 3) -> EstimatorModel:

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Perf sweep on the real TPU (run when the axon tunnel is healthy):
+#   nohup bash scripts/tpu_sweep.sh > /tmp/sweep.out 2>&1 &
+# Results accumulate as JSON lines in sweep_results.jsonl (one per
+# config).  Each run goes through bench.py's supervisor (probe +
+# deadline + fallback) and the persistent compile cache, so repeats of
+# the same config are cheap.
+set -u
+cd "$(dirname "$0")/.."
+OUT=sweep_results.jsonl
+: > "$OUT"
+
+run() {
+  desc="$1"; shift
+  echo "=== $desc : bench.py $* ===" >&2
+  line=$(BENCH_DEADLINE_S=2400 python bench.py "$@" 2>>/tmp/sweep_stderr.log)
+  [ -n "$line" ] || line=null   # keep the jsonl parseable on a crash
+  echo "{\"config\": \"$desc\", \"result\": $line}" >> "$OUT"
+  echo "$line" >&2
+}
+
+# the number to beat: 0.449 MFU (default, r2)
+run "default-b16"            --steps 30
+run "batch-24"               --batch 24
+run "batch-20"               --batch 20
+run "batch-32-remat"         --batch 32 --remat
+run "flash-fwd-bwd-b16"      --flash --steps 10
+run "flash-bq512-bk512"      --flash --block-q 512 --block-k 512 --steps 10
+run "flash-bq128-bk256"      --flash --block-q 128 --block-k 256 --steps 10
+run "seq2048-b8"             --seq 2048 --batch 8
+run "seq2048-b8-flash"       --seq 2048 --batch 8 --flash --steps 10
+run "resnet50"               --resnet
+run "autotune"               --autotune
+
+echo "sweep complete" >&2
