@@ -93,7 +93,8 @@ def main():
     if os.path.exists(args.ckpt) and pr == 0:
         ck = torch.load(args.ckpt, weights_only=True)
         model.load_state_dict(ck["model"])
-        opt.load_state_dict(ck["opt"])  # momentum buffers resume too
+        if "opt" in ck:  # momentum buffers resume too (older
+            opt.load_state_dict(ck["opt"])  # checkpoints lack them)
         start_epoch = ck["epoch"] + 1
         print(f"resuming from epoch {start_epoch}")
     # rank 0 read the checkpoint; everyone else adopts its decision

@@ -55,7 +55,9 @@ __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "process_rank", "process_size",
     "mesh", "allreduce", "grouped_allreduce", "allgather", "broadcast",
-    "alltoall", "reducescatter", "join", "DistributedOptimizer",
+    "alltoall", "reducescatter", "join", "size_op", "local_size_op",
+    "rank_op", "local_rank_op", "process_set_included_op",
+    "DistributedOptimizer",
     "DistributedGradientTape", "broadcast_variables",
     "broadcast_global_variables", "broadcast_object", "allgather_object",
     "SyncBatchNormalization", "Compression", "ReduceOp", "Average", "Sum",
@@ -65,6 +67,34 @@ __all__ = [
     "gloo_enabled", "mpi_threads_supported",
     "start_timeline", "stop_timeline",
 ]
+
+
+def size_op(name=None):
+    """Graph-time topology ops (reference: tensorflow/mpi_ops.py
+    size_op/local_size_op/rank_op/local_rank_op — values resolved at
+    execution, letting a graph build where it will not run).  In TF2 the
+    value is a tensor produced at call time; inside a tf.function it is
+    captured per trace, which matches eager-first TF2 semantics."""
+    return tf.constant(_rt.get().size(), tf.int32, name=name)
+
+
+def local_size_op(name=None):
+    return tf.constant(_rt.get().local_size(), tf.int32, name=name)
+
+
+def rank_op(name=None):
+    return tf.constant(_rt.get().rank(), tf.int32, name=name)
+
+
+def local_rank_op(name=None):
+    return tf.constant(_rt.get().local_rank(), tf.int32, name=name)
+
+
+def process_set_included_op(name=None):
+    """Parity stub for the reference's process-set op: the global process
+    set always includes every rank here (process sets beyond GLOBAL are
+    not modeled on the TPU mesh)."""
+    return tf.constant(1, tf.int32, name=name)
 
 
 def rank() -> int:

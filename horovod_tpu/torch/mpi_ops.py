@@ -24,6 +24,17 @@ which is identical on every process — preventing cross-process deadlock
 Joined ranks reconstruct zero dummy tensors from the response signatures and
 keep participating until JOIN_DONE (reference: Join protocol,
 controller.cc:254-307, collective_operations.cc:262-270).
+
+Performance envelope (a deliberate design boundary): every eager op costs
+two host<->device transfers because torch itself has no TPU backend — the
+tensor is born on host and the result must return there.  The stream pool
+(HOROVOD_NUM_STREAMS) overlaps dispatch and the fusion-threshold
+auto-bucketing amortizes per-op overhead, but gradient bytes still cross
+PCIe twice per step.  This surface exists for CORRECTNESS parity (porting
+torch-Horovod scripts verbatim) and host-side glue; throughput-critical
+training belongs on the jax frontend, where `DistributedOptimizer` is an
+optax transform and gradient sync happens INSIDE the compiled step with
+no host round-trip (see docs/migration.md "What changes on TPU").
 """
 
 from __future__ import annotations

@@ -8,7 +8,7 @@
 set -u
 cd "$(dirname "$0")/.."
 OUT=sweep_results.jsonl
-: > "$OUT"
+# append-only: prior measurements are expensive; dedupe by config when reading
 
 run() {
   desc="$1"; shift

@@ -594,3 +594,19 @@ def test_join_single_process_returns_rank():
     # single process: nobody to wait for (reference join() degenerates the
     # same way); must not require the negotiation knob
     assert hvd.join() == hvd.rank()
+
+
+def test_topology_ops_are_tensors():
+    """Graph-time topology ops (reference: tensorflow/mpi_ops.py
+    size_op/rank_op family)."""
+    assert int(hvd.size_op()) == hvd.size()
+    assert int(hvd.rank_op()) == hvd.rank()
+    assert int(hvd.local_size_op()) == hvd.local_size()
+    assert int(hvd.local_rank_op()) == hvd.local_rank()
+
+    @tf.function
+    def in_graph():
+        return hvd.size_op() + hvd.rank_op()
+
+    assert int(in_graph()) == hvd.size() + hvd.rank()
+    assert int(hvd.process_set_included_op()) == 1
