@@ -57,6 +57,7 @@ __all__ = [
     "mesh", "allreduce", "grouped_allreduce", "allgather", "broadcast",
     "alltoall", "reducescatter", "join", "size_op", "local_size_op",
     "rank_op", "local_rank_op", "process_set_included_op",
+    "BroadcastGlobalVariablesHook",
     "DistributedOptimizer",
     "DistributedGradientTape", "broadcast_variables",
     "broadcast_global_variables", "broadcast_object", "allgather_object",
@@ -95,6 +96,62 @@ def process_set_included_op(name=None):
     set always includes every rank here (process sets beyond GLOBAL are
     not modeled on the TPU mesh)."""
     return tf.constant(1, tf.int32, name=name)
+
+
+class BroadcastGlobalVariablesHook:
+    """Estimator-era startup hook (reference: tensorflow/__init__.py:297
+    BroadcastGlobalVariablesHook, a SessionRunHook): broadcast the model's
+    variables from ``root_rank`` once at session start.
+
+    TF2-native reshape: eager TF2 has NO global-variables collection (the
+    v1 ``GLOBAL_VARIABLES`` graph collection the reference hook reads
+    stays empty in eager mode), so the variables to sync must be given
+    EXPLICITLY — ``variables=model.variables`` — and the broadcast runs
+    eagerly over the data plane in ``after_create_session``.  The class
+    duck-types the SessionRunHook protocol (begin / after_create_session
+    / before_run / after_run / end) so estimator-style driver loops keep
+    their shape while migrating; v1 graph-mode sessions themselves are
+    NOT supported (this frontend's data plane is eager-only — use
+    ``broadcast_variables`` inside your TF2 training function instead).
+    """
+
+    def __init__(self, root_rank: int = 0, device: str = "",
+                 variables: Optional[Sequence[Any]] = None):
+        del device  # placement is the partitioner's job on TPU
+        self.root_rank = root_rank
+        self.variables = variables
+
+    def begin(self):
+        pass
+
+    def after_create_session(self, session=None, coord=None):
+        variables = self.variables
+        if variables is None:
+            # v1 graph collection — populated only under compat.v1 graph
+            # building, which is also the one regime we must refuse (the
+            # eager data plane cannot run inside a v1 session).
+            variables = list(tf.compat.v1.global_variables())
+            if variables and not tf.executing_eagerly():
+                raise RuntimeError(
+                    "BroadcastGlobalVariablesHook cannot broadcast v1 "
+                    "graph variables (the data plane is eager-only); "
+                    "migrate the loop to TF2 eager and pass "
+                    "variables=model.variables")
+        if not variables:
+            raise RuntimeError(
+                "no variables to broadcast: eager TF2 has no global-"
+                "variables collection — construct the hook with "
+                "variables=model.variables")
+        broadcast_variables(list(variables), root_rank=self.root_rank)
+
+    def before_run(self, run_context=None):
+        return None
+
+    def after_run(self, run_context=None, run_values=None):
+        pass
+
+    def end(self, session=None):
+        pass
 
 
 def rank() -> int:

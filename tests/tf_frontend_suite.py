@@ -610,3 +610,31 @@ def test_topology_ops_are_tensors():
 
     assert int(in_graph()) == hvd.size() + hvd.rank()
     assert int(hvd.process_set_included_op()) == 1
+
+
+def test_broadcast_global_variables_hook(monkeypatch):
+    """Estimator-era hook (reference: BroadcastGlobalVariablesHook):
+    explicit variables are actually broadcast from root; the eager-TF2
+    no-collection case fails loudly instead of silently skipping."""
+    v = tf.Variable([3.0, 4.0])
+    seen = {}
+    import horovod_tpu.tensorflow as _mod
+    real = _mod.broadcast_variables
+
+    def spy(variables, root_rank=0):
+        seen["vars"] = list(variables)
+        seen["root"] = root_rank
+        return real(variables, root_rank=root_rank)
+
+    monkeypatch.setattr(_mod, "broadcast_variables", spy)
+    hook = hvd.BroadcastGlobalVariablesHook(root_rank=0, variables=[v])
+    hook.begin()
+    hook.after_create_session()
+    assert seen["vars"] == [v] and seen["root"] == 0
+    np.testing.assert_allclose(v.numpy(), [3.0, 4.0])
+    hook.before_run()
+    hook.after_run()
+    hook.end()
+
+    with pytest.raises(RuntimeError, match="variables=model.variables"):
+        hvd.BroadcastGlobalVariablesHook().after_create_session()
