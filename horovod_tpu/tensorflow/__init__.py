@@ -125,18 +125,17 @@ class BroadcastGlobalVariablesHook:
         pass
 
     def after_create_session(self, session=None, coord=None):
+        if not tf.executing_eagerly():
+            # the eager data plane cannot run inside a v1 session,
+            # explicit variables or not
+            raise RuntimeError(
+                "BroadcastGlobalVariablesHook cannot broadcast under v1 "
+                "graph mode (the data plane is eager-only); migrate the "
+                "loop to TF2 eager and pass variables=model.variables")
         variables = self.variables
         if variables is None:
-            # v1 graph collection — populated only under compat.v1 graph
-            # building, which is also the one regime we must refuse (the
-            # eager data plane cannot run inside a v1 session).
+            # v1 graph collection — empty in eager TF2
             variables = list(tf.compat.v1.global_variables())
-            if variables and not tf.executing_eagerly():
-                raise RuntimeError(
-                    "BroadcastGlobalVariablesHook cannot broadcast v1 "
-                    "graph variables (the data plane is eager-only); "
-                    "migrate the loop to TF2 eager and pass "
-                    "variables=model.variables")
         if not variables:
             raise RuntimeError(
                 "no variables to broadcast: eager TF2 has no global-"
