@@ -1,0 +1,165 @@
+"""ZeRO-1 weight-update sharding for the data-parallel path.
+
+The technique of "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (arXiv:2004.13336, retrieved in PAPERS.md): in
+plain data parallelism every chip redundantly applies the SAME optimizer
+update and holds the FULL optimizer state.  Sharding the update instead:
+
+    grads --reduce_scatter-->  1/n per chip
+    optimizer.update on the shard (state lives at 1/n)
+    updates --all_gather-->    full update, applied to replicated params
+
+communicates the same bytes as one allreduce (RS + AG == AR) while
+cutting optimizer-state HBM by n and update FLOPs by n — the lever that
+makes Adam-class optimizers affordable at scale.  This is the
+data-parallel midpoint between :mod:`.data_parallel` (everything
+replicated) and :mod:`.fsdp` (params sharded too / ZeRO-3).
+
+Works with any optax transformation whose state is elementwise over the
+parameters (sgd/momentum/adam/adamw/...): the whole pytree is flattened
+to one fp32 vector, padded to a multiple of the axis size, and the shard
+geometry is static — XLA sees fixed-shape RS/AG collectives riding ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common.reduce_op import ReduceOp, Average
+from ..ops._compat import shard_map
+from .hierarchical import resolve_axis
+
+
+def _single_axis(axis_name, mesh: Mesh) -> str:
+    axis = resolve_axis(axis_name, mesh)
+    if isinstance(axis, tuple):
+        if len(axis) != 1:
+            raise ValueError(
+                "zero-1 update sharding shards over ONE mesh axis; got "
+                f"{axis} (flatten the mesh or pick a single axis)")
+        axis = axis[0]
+    return axis
+
+
+def _flat_size(params: Any) -> int:
+    return sum(int(np.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(params))
+
+
+def _flatten(tree: Any) -> jnp.ndarray:
+    """One fp32 vector for the whole pytree (stock ravel; the fp32 cast
+    first keeps the update math full-precision for bf16 params)."""
+    from jax.flatten_util import ravel_pytree
+    flat, _ = ravel_pytree(jax.tree_util.tree_map(
+        lambda l: l.astype(jnp.float32), tree))
+    return flat
+
+
+def _unflatten_like(flat: jnp.ndarray, tree: Any) -> Any:
+    """Inverse of :func:`_flatten` against ``tree``'s structure, casting
+    each leaf back to ITS dtype (ravel_pytree's unravel wants the ravel
+    dtype back, so the cast stays explicit here)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    off = 0
+    for l in leaves:
+        n = int(np.prod(l.shape))
+        out.append(flat[off:off + n].reshape(l.shape).astype(l.dtype))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def init_sharded_opt_state(optimizer: optax.GradientTransformation,
+                           params: Any, mesh: Mesh,
+                           axis_name="hvd") -> Any:
+    """Optimizer state over the flat parameter shards: leaf layout is
+    ``[n, padded/n, ...]`` with dim 0 sharded over the axis, so each chip
+    materializes state for exactly 1/n of the parameters."""
+    axis = _single_axis(axis_name, mesh)
+    n = int(mesh.shape[axis])
+    total = _flat_size(params)
+    padded = -(-total // n) * n
+
+    def init(params):
+        flat = jnp.pad(_flatten(params), (0, padded - total))
+        shards = flat.reshape(n, padded // n)
+        return jax.vmap(optimizer.init)(shards)
+
+    # out_shardings: each chip WRITES only its 1/n block — materializing
+    # the full state replicated first would OOM exactly the large-model
+    # regime this module exists for.
+    shapes = jax.eval_shape(init, params)
+    out_shardings = jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P(axis)), shapes)
+    return jax.jit(init, out_shardings=out_shardings)(params)
+
+
+def make_zero1_train_step(loss_fn: Callable,
+                          optimizer: optax.GradientTransformation,
+                          mesh: Mesh,
+                          axis_name="hvd",
+                          op: ReduceOp = Average,
+                          donate=None,
+                          remat: bool = False) -> Callable:
+    """Build ``step(params, opt_state, batch) -> (params, opt_state,
+    loss)`` with the weight update sharded across ``axis_name``.
+
+    ``opt_state`` comes from :func:`init_sharded_opt_state`; ``batch`` is
+    sharded over the axis like :func:`..data_parallel.make_train_step`'s.
+    Numerics match the replicated-update step exactly (same mean
+    gradient, same elementwise update) — only WHERE the update runs
+    changes.
+    """
+    if op != Average:
+        raise ValueError("zero-1 update sharding reduces with Average "
+                         "(gradient mean); prescale for other semantics")
+    axis = _single_axis(axis_name, mesh)
+    n = int(mesh.shape[axis])
+    fn = jax.checkpoint(loss_fn) if remat else loss_fn
+    from .data_parallel import _resolve_donate
+    donate = _resolve_donate(donate)
+
+    def body(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(fn)(params, batch)
+        total = _flat_size(params)
+        padded = -(-total // n) * n
+        shard_len = padded // n
+        gflat = jnp.pad(_flatten(grads), (0, padded - total))
+        # sum-reduce + scatter my shard: [n, L/n] -> [1, L/n] per chip
+        gshard = lax.psum_scatter(gflat.reshape(n, shard_len), axis,
+                                  scatter_dimension=0, tiled=True)
+        gshard = gshard.reshape(shard_len) / n
+        # my slice of the flattened params (adamw's decoupled weight
+        # decay needs them); params are replicated so this is a local
+        # static-size slice
+        pflat = jnp.pad(_flatten(params), (0, padded - total))
+        pshard = lax.dynamic_slice_in_dim(
+            pflat, lax.axis_index(axis) * shard_len, shard_len)
+        # the local state block carries the [1, ...] sharded leading dim
+        state_local = jax.tree_util.tree_map(lambda x: x[0], opt_state)
+        updates, state_local = optimizer.update(gshard, state_local,
+                                                pshard)
+        opt_state = jax.tree_util.tree_map(lambda x: x[None], state_local)
+        # rebuild the full update: [L/n] -> [L]
+        ufull = lax.all_gather(updates, axis, axis=0, tiled=True)
+        params = optax.apply_updates(
+            params, _unflatten_like(ufull[:total], params))
+        return params, opt_state, lax.pmean(loss, axis)
+
+    def step(params, opt_state, batch):
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=(P(), P(axis), P()),
+            check_vma=False)(params, opt_state, batch)
+
+    # donate the old params/opt_state buffers so XLA updates in place
+    # (the same knob-driven default as data_parallel.make_train_step)
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,102 @@
+"""ZeRO-1 weight-update sharding (parallel/zero.py): numerics must match
+the replicated-update data-parallel step exactly while the optimizer
+state lives at 1/n per chip (arXiv:2004.13336, PAPERS.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu.parallel.data_parallel import (make_train_step, replicate,
+                                                shard_batch)
+from horovod_tpu.parallel.zero import (init_sharded_opt_state,
+                                       make_zero1_train_step)
+
+
+def _model():
+    rng = np.random.RandomState(0)
+    params = {"w1": jnp.asarray(rng.randn(7, 5), jnp.float32),
+              "b1": jnp.asarray(rng.randn(5), jnp.float32),
+              "w2": jnp.asarray(rng.randn(5, 1), jnp.float32)}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+    return params, loss_fn
+
+
+def _batches(k, n):
+    rng = np.random.RandomState(1)
+    xs = rng.randn(k, 8 * n, 7).astype(np.float32)
+    ys = rng.randn(k, 8 * n, 1).astype(np.float32)
+    return xs, ys
+
+
+def test_zero1_matches_replicated_update(hvd):
+    mesh = hvd.mesh()
+    n = hvd.size()
+    params, loss_fn = _model()
+    opt = optax.adamw(1e-2, weight_decay=0.01)
+
+    ref_step = make_train_step(loss_fn, opt, mesh, axis_name="hvd")
+    ref_p = replicate(params, mesh)
+    ref_s = replicate(opt.init(ref_p), mesh)
+
+    z_step = make_zero1_train_step(loss_fn, opt, mesh, axis_name="hvd")
+    z_p = replicate(params, mesh)
+    z_s = init_sharded_opt_state(opt, z_p, mesh, axis_name="hvd")
+
+    xs, ys = _batches(4, n)
+    for k in range(4):
+        batch = (shard_batch(jnp.asarray(xs[k]), mesh),
+                 shard_batch(jnp.asarray(ys[k]), mesh))
+        ref_p, ref_s, ref_l = ref_step(ref_p, ref_s, batch)
+        z_p, z_s, z_l = z_step(z_p, z_s, batch)
+        np.testing.assert_allclose(float(ref_l), float(z_l), rtol=1e-5)
+    for key in params:
+        np.testing.assert_allclose(np.asarray(z_p[key]),
+                                   np.asarray(ref_p[key]),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_zero1_state_is_sharded(hvd):
+    mesh = hvd.mesh()
+    n = hvd.size()
+    params, _ = _model()
+    opt = optax.adam(1e-3)
+    state = init_sharded_opt_state(opt, replicate(params, mesh), mesh)
+    total = sum(int(np.prod(l.shape)) for l in
+                jax.tree_util.tree_leaves(params))
+    padded = -(-total // n) * n
+    mu = state[0].mu  # ScaleByAdamState
+    assert mu.shape == (n, padded // n)
+    # each chip holds exactly one shard row
+    for shard in mu.addressable_shards:
+        assert shard.data.shape == (1, padded // n)
+
+
+def test_zero1_rejects_non_average(hvd):
+    from horovod_tpu.common.reduce_op import Sum
+    params, loss_fn = _model()
+    with pytest.raises(ValueError, match="Average"):
+        make_zero1_train_step(loss_fn, optax.sgd(0.1), hvd.mesh(), op=Sum)
+
+
+def test_zero1_loss_decreases(hvd):
+    mesh = hvd.mesh()
+    n = hvd.size()
+    params, loss_fn = _model()
+    opt = optax.sgd(0.05, momentum=0.9)
+    step = make_zero1_train_step(loss_fn, opt, mesh)
+    p = replicate(params, mesh)
+    s = init_sharded_opt_state(opt, p, mesh)
+    xs, ys = _batches(1, n)
+    batch = (shard_batch(jnp.asarray(xs[0]), mesh),
+             shard_batch(jnp.asarray(ys[0]), mesh))
+    losses = []
+    for _ in range(15):
+        p, s, loss = step(p, s, batch)
+        losses.append(float(loss))
+    assert losses[-1] < 0.3 * losses[0], losses
