@@ -30,6 +30,7 @@ run "flash-bq128-bk256"      --flash --block-q 128 --block-k 256 --steps 10
 run "seq2048-b8"             --seq 2048 --batch 8
 run "seq2048-b8-flash"       --seq 2048 --batch 8 --flash --steps 10
 run "resnet50"               --resnet
+run "resnet101"              --resnet --depth 101
 run "autotune"               --autotune
 
 echo "sweep complete" >&2
