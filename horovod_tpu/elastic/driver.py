@@ -72,7 +72,8 @@ class ElasticDriver:
                  controller_port: int = 29499,
                  discovery_interval: float = 1.0,
                  output_filename: Optional[str] = None,
-                 network_interface: Optional[str] = None):
+                 network_interface: Optional[str] = None,
+                 prefix_output_with_timestamp: bool = False):
         self.host_manager = HostManager(discovery)
         self.min_np = min_np
         self.max_np = max_np
@@ -85,6 +86,7 @@ class ElasticDriver:
         self.discovery_interval = discovery_interval
         self.output_filename = output_filename
         self.network_interface = network_interface
+        self.prefix_output_with_timestamp = prefix_output_with_timestamp
         self._spawned_ranks: set = set()
 
         self.registry = WorkerStateRegistry()
@@ -176,8 +178,9 @@ class ElasticDriver:
         # rounds so a restarted rank's log continues.
         mode = "ab" if slot.rank in self._spawned_ranks else "wb"
         self._spawned_ranks.add(slot.rank)
-        return spawn_with_output(cmd, env, self.output_filename,
-                                 slot.rank, mode=mode)
+        return spawn_with_output(
+            cmd, env, self.output_filename, slot.rank, mode=mode,
+            prefix_timestamp=self.prefix_output_with_timestamp)
 
     def _terminate_all(self) -> None:
         for p in self._procs.values():
@@ -189,6 +192,8 @@ class ElasticDriver:
                 p.wait(timeout=max(0.1, deadline - time.time()))
             except subprocess.TimeoutExpired:
                 p.kill()
+            from ..runner.launch import join_output_pumps
+            join_output_pumps(p, timeout=2.0)
         self._procs.clear()
 
     # ------------------------------------------------------------------ run
@@ -221,6 +226,8 @@ class ElasticDriver:
                             if p.poll() is not None]
                     for r, p in done:
                         del self._procs[r]
+                        from ..runner.launch import join_output_pumps
+                        join_output_pumps(p, timeout=5.0)
                         outcome = (WorkerStateRegistry.SUCCESS
                                    if p.returncode == 0
                                    else WorkerStateRegistry.FAILURE)
@@ -278,5 +285,7 @@ def run_elastic(args, command: List[str]) -> int:
         coordinator_port=args.coordinator_port,
         controller_port=args.controller_port,
         output_filename=getattr(args, "output_filename", None),
-        network_interface=getattr(args, "network_interface", None))
+        network_interface=getattr(args, "network_interface", None),
+        prefix_output_with_timestamp=getattr(
+            args, "prefix_output_with_timestamp", False))
     return driver.run()

@@ -23,12 +23,14 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import datetime
 import os
 import shlex
 import signal
 import socket
 import subprocess
 import sys
+import threading
 from typing import Dict, List, Optional
 
 from . import hosts as hosts_mod
@@ -49,7 +51,7 @@ def make_parser() -> argparse.ArgumentParser:
                         "TPU_WORKER_HOSTNAMES / GCE metadata instead of "
                         "-H (reference analog: the launcher's host "
                         "discovery tier, driver_service.py:49-193)")
-    p.add_argument("-H", "--hosts", default=None,
+    p.add_argument("-H", "--hosts", "--hostnames", default=None,
                    help="comma-separated host:slots, e.g. h1:1,h2:1")
     p.add_argument("--hostfile", default=None,
                    help="file with one host:slots per line")
@@ -65,6 +67,20 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--network-interface", default=None,
                    help="network interface whose address workers should use "
                         "to reach the coordinator (e.g. ens3)")
+    p.add_argument("--prefix-output-with-timestamp", action="store_true",
+                   help="stamp every forwarded worker output line with "
+                        "a timestamp and its rank")
+    # transport selectors (reference: --mpi/--gloo/--jsrun/--tcp): the
+    # TPU runtime has exactly one controller (native TCP) and one data
+    # plane (XLA); --tcp is therefore a no-op and the others fail with
+    # the same not-built story `hvdrun --check-build` prints.
+    p.add_argument("--tcp", action="store_true",
+                   help="use the TCP controller (always on; accepted for "
+                        "reference compatibility)")
+    p.add_argument("--mpi", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--gloo", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--jsrun", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--mpi-args", default=None, help=argparse.SUPPRESS)
     p.add_argument("--output-filename", default=None,
                    help="redirect each worker's stdout/stderr to "
                         "<dir>/rank.<N>/stdout|stderr")
@@ -229,22 +245,72 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
     return env
 
 
+def _pump_prefixed(stream, sink, rank: int, close_sink: bool) -> None:
+    """Copy a child stream line-by-line, prefixing each line with a
+    timestamp and the rank (reference: --prefix-output-with-timestamp,
+    launch.py + run/util forwarders).  File sinks are closed at EOF;
+    the process-wide std streams are not."""
+    for raw in iter(stream.readline, b""):
+        ts = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S.%f")[:-3]
+        sink.write(f"[{ts}]<rank {rank}> ".encode() + raw)
+        sink.flush()
+    stream.close()
+    if close_sink:
+        sink.close()
+
+
+def join_output_pumps(proc, timeout: float = 10.0) -> None:
+    """Drain a prefixed worker's forwarder threads after it exits —
+    without this, output still buffered in the pipes (often the final
+    traceback or metrics) is lost when the launcher exits."""
+    for t in getattr(proc, "_hvd_pump_threads", ()):
+        t.join(timeout=timeout)
+
+
 def spawn_with_output(cmd: List[str], env: Dict[str, str],
                       output_filename: Optional[str], rank: int,
-                      mode: str = "wb") -> subprocess.Popen:
+                      mode: str = "wb",
+                      prefix_timestamp: bool = False) -> subprocess.Popen:
     """Spawn a worker, optionally redirecting its streams to
     <output_filename>/rank.<N>/stdout|stderr (reference:
     --output-filename).  ssh forwards remote streams, so driver-side
     redirection covers both paths.  ``mode="ab"`` appends (elastic reset
-    rounds continue a rank's log)."""
-    if not output_filename:
+    rounds continue a rank's log).  ``prefix_timestamp`` routes the
+    streams through the driver and stamps every line (reference:
+    --prefix-output-with-timestamp)."""
+    if not output_filename and not prefix_timestamp:
         return subprocess.Popen(cmd, env=env)
-    d = os.path.join(output_filename, f"rank.{rank}")
-    os.makedirs(d, exist_ok=True)
-    with open(os.path.join(d, "stdout"), mode) as out, \
-            open(os.path.join(d, "stderr"), mode) as err:
-        # the child holds its own dups; drop the parent's handles
-        return subprocess.Popen(cmd, env=env, stdout=out, stderr=err)
+    if output_filename:
+        d = os.path.join(output_filename, f"rank.{rank}")
+        os.makedirs(d, exist_ok=True)
+        out_path = os.path.join(d, "stdout")
+        err_path = os.path.join(d, "stderr")
+        if not prefix_timestamp:
+            with open(out_path, mode) as out, open(err_path, mode) as err:
+                # the child holds its own dups; drop the parent's handles
+                return subprocess.Popen(cmd, env=env, stdout=out,
+                                        stderr=err)
+        sinks = (open(out_path, mode), open(err_path, mode))
+        close_sink = True
+    else:
+        sinks = (sys.stdout.buffer, sys.stderr.buffer)
+        close_sink = False
+    try:
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE)
+    except BaseException:
+        if close_sink:
+            for s in sinks:
+                s.close()
+        raise
+    proc._hvd_pump_threads = [
+        threading.Thread(target=_pump_prefixed,
+                         args=(stream, sink, rank, close_sink),
+                         daemon=True)
+        for stream, sink in zip((proc.stdout, proc.stderr), sinks)]
+    for t in proc._hvd_pump_threads:
+        t.start()
+    return proc
 
 
 def check_build() -> str:
@@ -451,8 +517,9 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
         if args.verbose:
             print(f"[hvdrun] rank {slot.rank} on {slot.hostname}: "
                   f"{' '.join(cmd)}", file=sys.stderr)
-        return spawn_with_output(cmd, env, args.output_filename,
-                                 slot.rank)
+        return spawn_with_output(
+            cmd, env, args.output_filename, slot.rank,
+            prefix_timestamp=args.prefix_output_with_timestamp)
 
     try:
         for slot in slots:
@@ -460,6 +527,7 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
         exit_code = 0
         for p in procs:
             rc = p.wait()
+            join_output_pumps(p)
             if rc != 0 and exit_code == 0:
                 exit_code = rc
                 # fail fast: kill the rest (reference: gloo_run terminates
@@ -493,6 +561,14 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         command = command[1:]
     if not command:
         print("hvdrun: no training command given", file=sys.stderr)
+        return 2
+    if args.mpi or args.gloo or args.jsrun or args.mpi_args:
+        which = ("--mpi" if args.mpi or args.mpi_args else
+                 "--gloo" if args.gloo else "--jsrun")
+        print(f"hvdrun: {which} requested, but only the native TCP "
+              "controller + XLA data plane are built on the TPU runtime "
+              "(see hvdrun --check-build); drop the flag — --tcp is the "
+              "default and only transport", file=sys.stderr)
         return 2
     elastic = args.host_discovery_script or args.min_np or args.max_np
     if args.num_proc is None and not (args.hosts or args.hostfile

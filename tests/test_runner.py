@@ -409,3 +409,35 @@ def test_tpu_flag_defaults_np_like_explicit_hosts(monkeypatch, tmp_path):
     assert rc == 0
     assert seen["np"] is None  # launch_static derives it from slots
     assert seen["hosts"] == ["vm-a", "vm-b"]
+
+
+def test_prefix_output_with_timestamp(tmp_path):
+    import subprocess
+    import time as _time
+    from horovod_tpu.runner.launch import spawn_with_output
+    p = spawn_with_output(
+        [sys.executable, "-c", "print('hello'); print('world')"],
+        dict(os.environ), str(tmp_path), rank=3, prefix_timestamp=True)
+    p.wait()
+    for _ in range(50):  # pump threads flush asynchronously
+        text = (tmp_path / "rank.3" / "stdout").read_text()
+        if "world" in text:
+            break
+        _time.sleep(0.1)
+    lines = text.strip().splitlines()
+    assert all("<rank 3>" in ln and ln.startswith("[2") for ln in lines), \
+        lines
+    assert lines[0].endswith("hello") and lines[1].endswith("world")
+
+
+def test_transport_selector_flags():
+    assert run_commandline(["--mpi", "-np", "1", "echo", "x"]) == 2
+    assert run_commandline(["--gloo", "-np", "1", "echo", "x"]) == 2
+    # --tcp is the (only) default transport: accepted as a no-op
+    args = make_parser().parse_args(["--tcp", "-np", "1", "cmd"])
+    assert args.tcp
+
+
+def test_hostnames_alias():
+    args = make_parser().parse_args(["--hostnames", "a:1,b:1", "cmd"])
+    assert args.hosts == "a:1,b:1"
