@@ -20,7 +20,7 @@ horovod/tensorflow/__init__.py):
 
 from __future__ import annotations
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from . import runtime as _rt
 from .runtime import init, shutdown, is_initialized
