@@ -1,0 +1,59 @@
+"""Summarize sweep_results.jsonl into a markdown table (the README's
+"Recorded numbers" format).
+
+    python scripts/summarize_sweep.py [sweep_results.jsonl]
+
+Appended runs of the same config dedupe to the LATEST valid result;
+crashed entries (result null / BENCH_INVALID) are listed separately so
+a partial sweep still reads honestly.
+"""
+
+import json
+import sys
+
+
+def load(path: str):
+    latest = {}
+    failed = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            cfg, res = rec.get("config", "?"), rec.get("result")
+            if res and res.get("metric") != "BENCH_INVALID":
+                latest[cfg] = res
+                failed.pop(cfg, None)
+            elif cfg not in latest:
+                failed[cfg] = (res or {}).get("error", "no JSON produced")
+    return latest, failed
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else "sweep_results.jsonl"
+    latest, failed = load(path)
+    if not latest and not failed:
+        print("no sweep results found", file=sys.stderr)
+        return 1
+    print("| Config | Result | Unit | vs_baseline (MFU/ratio) |")
+    print("|---|---|---|---|")
+    for cfg, res in sorted(latest.items(),
+                           key=lambda kv: -kv[1].get("vs_baseline", 0)):
+        print(f"| {cfg} | {res['value']} | {res['unit']} | "
+              f"{res['vs_baseline']} |")
+    if failed:
+        print()
+        print("Incomplete configs:")
+        for cfg, err in sorted(failed.items()):
+            print(f"- {cfg}: {err}")
+    best = max(latest.items(), key=lambda kv: kv[1].get("vs_baseline", 0),
+               default=None)
+    if best:
+        print(f"\nBest vs_baseline: {best[0]} at "
+              f"{best[1]['vs_baseline']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
