@@ -138,32 +138,27 @@ def _enable_compile_cache() -> None:
 
 
 def probe_tpu(timeout_s: float) -> str:
-    """Check the TPU backend is reachable WITHOUT risking main-process
-    state: a down tunnel makes jax backend init hang for tens of minutes
-    (round-2 recorded 25 min per attempt), which no in-process watchdog
-    can interrupt.  A subprocess can be killed.  Returns '' when healthy,
-    else a human-readable reason."""
-    code = ("import jax, json, sys; ds = jax.devices(); "
-            "print(json.dumps([str(d.platform) for d in ds]))")
+    """Killable-subprocess backend probe (round-2 recorded 25-minute
+    in-process init hangs on a down tunnel).  The canonical
+    implementation is the library's (also exposed as
+    ``horovod_tpu.probe_backend``) — loaded here BY FILE PATH so the
+    supervisor stays free of the heavy package __init__ (jax etc.), and
+    any load failure degrades to a probe-failure string instead of
+    killing the JSON contract."""
     try:
-        res = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True,
-                             timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return (f"TPU backend unreachable: device probe exceeded "
-                f"{timeout_s:.0f}s (tunnel likely down)")
-    if res.returncode != 0:
-        tail = (res.stderr or "").strip().splitlines()[-3:]
-        return "TPU backend probe failed: " + " | ".join(tail)
-    try:
-        platforms = json.loads((res.stdout or "").strip().splitlines()[-1])
-    except (ValueError, IndexError):
-        return "TPU backend probe printed no platform list"
-    if all(p == "cpu" for p in platforms):
-        # A mis-registered plugin silently falls back to CPU; failing here
-        # beats burning the deadline in expect_tpu retry loops.
-        return f"TPU expected but jax only sees platforms {platforms}"
-    return ""
+        mod = sys.modules.get("horovod_tpu.utils.probe")
+        if mod is None:  # standalone supervisor: load the stdlib-only file
+            import importlib.util
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "horovod_tpu", "utils", "probe.py")
+            spec = importlib.util.spec_from_file_location("_hvd_probe",
+                                                          path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        return mod.probe_backend(timeout_s)
+    except Exception as e:
+        return f"probe unavailable ({e})"
 
 
 def supervise(argv) -> int:

@@ -45,6 +45,7 @@ from .checkpoint import (CheckpointManager, save_checkpoint,
                          restore_checkpoint)
 from .ops.flash_attention import flash_attention
 from .runner.api import run
+from .utils.probe import probe_backend
 
 
 # ---------------------------------------------------------------- topology API
@@ -190,5 +191,5 @@ __all__ = [
     "start_timeline", "stop_timeline", "profiler",
     "CheckpointManager", "save_checkpoint", "restore_checkpoint",
     "flash_attention", "run",
-    "__version__",
+    "__version__", "probe_backend",
 ]

@@ -1,0 +1,42 @@
+"""Fast, killable TPU-backend reachability probe.
+
+A down TPU tunnel makes jax backend init hang for tens of minutes, and
+no in-process watchdog can interrupt it (the hang sits inside the PJRT
+C API).  A SUBPROCESS can be killed — so the probe initializes the
+backend in a child with a hard timeout and reports what it saw.  Used by
+``bench.py``'s supervisor and exposed as ``horovod_tpu.probe_backend``
+for interactive sessions ("is the tunnel up before I call init()?").
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+
+def probe_backend(timeout_s: float = 55.0) -> str:
+    """Returns '' when an accelerator backend is reachable, else a
+    human-readable reason (probe timeout, init error, or cpu-only
+    fallback)."""
+    code = ("import jax, json, sys; ds = jax.devices(); "
+            "print(json.dumps([str(d.platform) for d in ds]))")
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return (f"TPU backend unreachable: device probe exceeded "
+                f"{timeout_s:.0f}s (tunnel likely down)")
+    if res.returncode != 0:
+        tail = (res.stderr or "").strip().splitlines()[-3:]
+        return "TPU backend probe failed: " + " | ".join(tail)
+    try:
+        platforms = json.loads((res.stdout or "").strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return "TPU backend probe printed no platform list"
+    if all(p == "cpu" for p in platforms):
+        # A mis-registered plugin silently falls back to CPU; callers
+        # that expect hardware should treat this as unhealthy.
+        return f"TPU expected but jax only sees platforms {platforms}"
+    return ""

@@ -20,7 +20,14 @@ def load(path: str):
             line = line.strip()
             if not line:
                 continue
-            rec = json.loads(line)
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                # a killed sweep can truncate its last append; a partial
+                # file must still summarize
+                print(f"skipping malformed line: {line[:80]!r}",
+                      file=sys.stderr)
+                continue
             cfg, res = rec.get("config", "?"), rec.get("result")
             if res and res.get("metric") != "BENCH_INVALID":
                 latest[cfg] = res
@@ -36,10 +43,11 @@ def main() -> int:
     if not latest and not failed:
         print("no sweep results found", file=sys.stderr)
         return 1
+    rows = sorted(latest.items(),
+                  key=lambda kv: -kv[1].get("vs_baseline", 0))
     print("| Config | Result | Unit | vs_baseline (MFU/ratio) |")
     print("|---|---|---|---|")
-    for cfg, res in sorted(latest.items(),
-                           key=lambda kv: -kv[1].get("vs_baseline", 0)):
+    for cfg, res in rows:
         print(f"| {cfg} | {res['value']} | {res['unit']} | "
               f"{res['vs_baseline']} |")
     if failed:
@@ -47,11 +55,9 @@ def main() -> int:
         print("Incomplete configs:")
         for cfg, err in sorted(failed.items()):
             print(f"- {cfg}: {err}")
-    best = max(latest.items(), key=lambda kv: kv[1].get("vs_baseline", 0),
-               default=None)
-    if best:
-        print(f"\nBest vs_baseline: {best[0]} at "
-              f"{best[1]['vs_baseline']}")
+    if rows:
+        print(f"\nBest vs_baseline: {rows[0][0]} at "
+              f"{rows[0][1]['vs_baseline']}")
     return 0
 
 

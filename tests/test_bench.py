@@ -69,26 +69,32 @@ def _fake_result(rc=0, stdout=""):
 
 def test_probe_tpu_detects_cpu_only_fallback(monkeypatch):
     import bench
+    from horovod_tpu.utils import probe
     monkeypatch.setattr(
-        bench.subprocess, "run",
+        probe.subprocess, "run",
         lambda *a, **k: _fake_result(0, '["cpu", "cpu"]\n'))
     assert "only sees platforms" in bench.probe_tpu(5)
 
 
 def test_probe_tpu_timeout_is_fast_fail(monkeypatch):
     import bench
+    from horovod_tpu.utils import probe
 
     def hang(*a, **k):
-        raise bench.subprocess.TimeoutExpired(cmd="probe", timeout=5)
-    monkeypatch.setattr(bench.subprocess, "run", hang)
+        raise probe.subprocess.TimeoutExpired(cmd="probe", timeout=5)
+    monkeypatch.setattr(probe.subprocess, "run", hang)
     assert "unreachable" in bench.probe_tpu(5)
 
 
 def test_probe_tpu_healthy(monkeypatch):
     import bench
-    monkeypatch.setattr(bench.subprocess, "run",
+    from horovod_tpu.utils import probe
+    monkeypatch.setattr(probe.subprocess, "run",
                         lambda *a, **k: _fake_result(0, '["axon"]\n'))
     assert bench.probe_tpu(5) == ""
+    # and the public alias sees the same implementation
+    import horovod_tpu
+    assert horovod_tpu.probe_backend(5) == ""
 
 
 def test_supervise_fast_fails_on_probe(monkeypatch, capsys):
