@@ -152,13 +152,20 @@ def probe_tpu(timeout_s: float) -> str:
             path = os.path.join(
                 os.path.dirname(os.path.abspath(__file__)),
                 "horovod_tpu", "utils", "probe.py")
-            spec = importlib.util.spec_from_file_location("_hvd_probe",
-                                                          path)
+            spec = importlib.util.spec_from_file_location(
+                "horovod_tpu.utils.probe", path)
             mod = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(mod)
+            # one module for repeat calls, tests, and the package import
+            sys.modules["horovod_tpu.utils.probe"] = mod
         return mod.probe_backend(timeout_s)
     except Exception as e:
-        return f"probe unavailable ({e})"
+        # The probe is an optimization (fast-fail on a dead tunnel); a
+        # broken loader must not veto a benchmark the deadline-bounded
+        # child could still produce.
+        print(f"probe unavailable, proceeding without it ({e})",
+              file=sys.stderr)
+        return ""
 
 
 def supervise(argv) -> int:

@@ -396,6 +396,11 @@ class CoordinationCore:
                 "last_cycle_bytes": arr[8]}
 
     def shutdown(self) -> None:
+        """Ask the cycle loop to exit.  Multi-core teardown MUST call
+        shutdown() on EVERY core before the first close(): close() joins
+        the cycle thread, which can sit blocked inside the hub's gather
+        waiting on a still-cycling peer — peers that were not told to
+        shut down first turn that join into a deadlock."""
         if self._h:
             self._lib.hvd_core_shutdown(self._h)
 

@@ -30,7 +30,9 @@ def probe_backend(timeout_s: float = 55.0) -> str:
                 f"{timeout_s:.0f}s (tunnel likely down)")
     if res.returncode != 0:
         tail = (res.stderr or "").strip().splitlines()[-3:]
-        return "TPU backend probe failed: " + " | ".join(tail)
+        detail = " | ".join(tail) if tail else "no stderr (killed?)"
+        return (f"TPU backend probe failed (rc={res.returncode}): "
+                f"{detail}")
     try:
         platforms = json.loads((res.stdout or "").strip().splitlines()[-1])
     except (ValueError, IndexError):

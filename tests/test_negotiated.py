@@ -1,0 +1,166 @@
+"""ops/negotiated.py unit tier: signature wire format, zero-dummy
+participation, and SyncNegotiator against a 2-rank loopback core —
+the single-process counterpart of the 2-process TF join integration
+test (tests/integration/tf_worker.py)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.basics import (CoordinationCore, LoopbackHub,
+                                       OP_ALLREDUCE)
+from horovod_tpu.common.exceptions import HorovodInternalError
+from horovod_tpu.ops.negotiated import (SyncNegotiator, np_signature,
+                                        np_zeros_from_signature,
+                                        zero_participate)
+
+
+# ------------------------------------------------------------- wire format
+def test_signature_round_trip():
+    a = np.zeros((3, 5), np.float32)
+    sig = np_signature(a, "allreduce", "2")
+    assert sig == "f32:3x5:allreduce:2"
+    z = np_zeros_from_signature(sig)
+    assert z.shape == (3, 5) and z.dtype == np.float32
+
+
+def test_signature_unknown_dtype_passes_through():
+    a = np.zeros((4,), np.uint32)  # not in the short-name table
+    sig = np_signature(a, "allgather")
+    assert sig.startswith("uint32:4:")
+    z = np_zeros_from_signature(sig)
+    assert z.dtype == np.uint32  # NOT silently float32
+
+
+def test_signature_bf16():
+    import ml_dtypes
+    a = np.zeros((2, 2), ml_dtypes.bfloat16)
+    z = np_zeros_from_signature(np_signature(a, "allreduce"))
+    assert z.dtype == ml_dtypes.bfloat16
+
+
+def test_zeros_truly_bogus_dtype_fails_loudly():
+    with pytest.raises(TypeError):
+        np_zeros_from_signature("notadtype:4:allreduce:")
+
+
+# ------------------------------------------------------ zero participation
+def test_zero_participate_all_kinds(hvd):
+    # the joined rank's dummy must run the SAME SPMD program as peers;
+    # on one process this means the ops simply complete with zeros
+    zero_participate("f32:4:allreduce:1")
+    zero_participate("f32:2x3:allgather:")
+    zero_participate("f32:3:broadcast:2")
+    zero_participate("f32:2:grouped_allreduce:1+f32:5:grouped_allreduce:")
+    zero_participate("f32:0x2:allgather_ragged:",
+                     local_size=hvd.local_size())
+
+
+def test_zero_participate_rejects_alltoall(hvd):
+    with pytest.raises(HorovodInternalError, match="not supported"):
+        zero_participate("f32:4:alltoall:")
+
+
+# ------------------------------------------------------- negotiated core
+class _FakeRuntime:
+    """Runtime facade for SyncNegotiator: hands out a loopback core."""
+
+    def __init__(self, core, local_size=1):
+        self._core = core
+        self._ls = local_size
+
+    def ensure_core(self):
+        return self._core
+
+    def local_size(self):
+        return self._ls
+
+
+def test_sync_negotiator_completes_matching_submissions():
+    """Both ranks drive the same op sequence (the TF frontend's
+    ordered-by-construction contract — synchronous per-op negotiation
+    CANNOT reorder; reordering tolerance is the torch async path's job);
+    every op executes exactly when both ranks submitted it."""
+    hub = LoopbackHub(2)
+    c0 = CoordinationCore.loopback(hub, rank=0)
+    c1 = CoordinationCore.loopback(hub, rank=1)
+    try:
+        n0 = SyncNegotiator(_FakeRuntime(c0))
+        n1 = SyncNegotiator(_FakeRuntime(c1))
+        results = {}
+
+        def drive(neg, tag):
+            for name in ("a", "b", "c"):
+                arr = np.ones((2,), np.float32)
+                results[(tag, name)] = neg.run(
+                    name, np_signature(arr, "allreduce", "1"),
+                    OP_ALLREDUCE, arr.nbytes,
+                    lambda name=name: name.upper())
+
+        t = threading.Thread(target=drive, args=(n1, "r1"), daemon=True)
+        t.start()
+        drive(n0, "r0")
+        t.join(timeout=30)
+        assert not t.is_alive(), "peer negotiator hung"
+        assert results == {("r0", "a"): "A", ("r0", "b"): "B",
+                           ("r0", "c"): "C", ("r1", "a"): "A",
+                           ("r1", "b"): "B", ("r1", "c"): "C"}
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+        c0.close()
+        c1.close()
+        hub.close()
+
+
+def test_sync_negotiator_joined_rank_serves_straggler():
+    """Rank 1 JOINs while rank 0 still has a collective in flight: the
+    joined rank answers it with a zero dummy and both get JOIN_DONE —
+    the uneven-input contract behind TF join()."""
+    hub = LoopbackHub(2)
+    c0 = CoordinationCore.loopback(hub, rank=0)
+    c1 = CoordinationCore.loopback(hub, rank=1)
+    try:
+        n0 = SyncNegotiator(_FakeRuntime(c0))
+        n1 = SyncNegotiator(_FakeRuntime(c1))
+        out = {}
+
+        def straggler():
+            arr = np.ones((3,), np.float32)
+            out["val"] = n0.run("late",
+                                np_signature(arr, "allreduce", "1"),
+                                OP_ALLREDUCE, arr.nbytes, lambda: 42)
+            out["last"] = n0.join(timeout_s=60.0)
+
+        t = threading.Thread(target=straggler, daemon=True)
+        t.start()
+        out["peer_last"] = n1.join(timeout_s=60.0)  # serves 'late'
+        t.join(timeout=60)
+        assert not t.is_alive(), "straggler hung"
+        assert out["val"] == 42
+        assert out["last"] == 0 and out["peer_last"] == 0
+    finally:
+        c0.shutdown()
+        c1.shutdown()
+        c0.close()
+        c1.close()
+        hub.close()
+
+
+def test_sync_negotiator_join_single_rank():
+    hub = LoopbackHub(1)
+    core = CoordinationCore.loopback(hub, rank=0)
+    try:
+        neg = SyncNegotiator(_FakeRuntime(core))
+        assert neg.join(timeout_s=30.0) >= 0
+    finally:
+        core.shutdown()
+        core.close()
+        hub.close()
+
+
+def test_sync_negotiator_requires_core():
+    neg = SyncNegotiator(_FakeRuntime(None))
+    with pytest.raises(HorovodInternalError, match="native core"):
+        neg.run("x", "f32:1:allreduce:", OP_ALLREDUCE, 4, lambda: None)
