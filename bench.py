@@ -241,6 +241,9 @@ def main() -> int:
                          "1656.82 img/s 16-GPU headline row exactly")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize the forward pass (bigger batches)")
+    ap.add_argument("--no-fuse", action="store_true",
+                    help="disable the fused qkv/gate-up projections "
+                         "(fused is the default for the bench model)")
     ap.add_argument("--dim", type=int, default=0,
                     help="override model width (with --layers/--ffn, scans "
                          "custom shapes; 0 = use --model's config)")
@@ -297,7 +300,7 @@ def main() -> int:
     cfgs["bench"] = llama.LlamaConfig(
         vocab=32768, dim=1024, n_layers=8, n_heads=16, n_kv_heads=8,
         ffn_dim=4096, max_seq=max(2048, args.seq),
-        dtype=jnp.bfloat16)
+        dtype=jnp.bfloat16, fuse_proj=not args.no_fuse)
     cfg = cfgs[args.model]
     if args.dim:
         import dataclasses

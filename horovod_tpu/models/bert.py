@@ -122,8 +122,7 @@ def loss_fn(params, ids, labels, cfg: BertConfig,
             mask: Optional[jax.Array] = None) -> jax.Array:
     """Masked-LM cross-entropy; ``mask`` selects predicted positions."""
     logits = apply(params, ids, cfg)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    nll = L.softmax_cross_entropy(logits, labels)
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(nll)

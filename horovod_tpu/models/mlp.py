@@ -32,5 +32,4 @@ def apply(params: Dict[str, Any], x: jax.Array) -> jax.Array:
 
 def loss_fn(params: Dict[str, Any], x: jax.Array, y: jax.Array) -> jax.Array:
     logits = apply(params, x)
-    logp = jax.nn.log_softmax(logits)
-    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+    return jnp.mean(L.softmax_cross_entropy(logits, y))

@@ -133,8 +133,7 @@ def loss_fn(params: Dict[str, Any], ids: jax.Array, cfg: MoeLlamaConfig,
     """Next-token cross-entropy + router load-balancing aux."""
     logits, aux = apply(params, ids[:, :-1], cfg, moe_fn=moe_fn)
     targets = ids[:, 1:]
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    nll = L.softmax_cross_entropy(logits, targets)
     return jnp.mean(nll) + cfg.router_aux_coef * aux
 
 

@@ -116,6 +116,5 @@ def loss_fn(params, x, y_true, depth: int = 50, training: bool = True,
             axis_name: Optional[str] = None):
     logits, new_params = apply(params, x, depth=depth, training=training,
                                axis_name=axis_name)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-    loss = -jnp.mean(jnp.take_along_axis(logp, y_true[:, None], axis=1))
+    loss = jnp.mean(L.softmax_cross_entropy(logits, y_true))
     return loss, new_params

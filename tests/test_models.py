@@ -114,6 +114,39 @@ def test_bert_pad_mask(hvd):
                                np.asarray(out2[0, :4]), atol=1e-4)
 
 
+def test_softmax_cross_entropy_matches_log_softmax():
+    """The logsumexp-gather loss is the same function as -log_softmax[tgt]."""
+    from horovod_tpu.models import layers as L
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(4, 7, 33).astype(np.float32) * 5)
+    targets = jnp.asarray(rng.randint(0, 33, (4, 7)))
+    got = L.softmax_cross_entropy(logits, targets)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    want = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+    # and in bf16 inputs the upcast happens before the reduction
+    got16 = L.softmax_cross_entropy(logits.astype(jnp.bfloat16), targets)
+    np.testing.assert_allclose(np.asarray(got16), np.asarray(want), atol=0.05)
+
+
+def test_llama_fused_projections_match():
+    """fuse_proj=True is the same model: one concatenated qkv (and gate/up)
+    matmul contracts exactly the same weight columns per output."""
+    import dataclasses
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init(jax.random.PRNGKey(3), cfg)
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, cfg.vocab, (2, 16)))
+    a = llama.apply(params, ids, cfg)
+    b = llama.apply(params, ids, dataclasses.replace(cfg, fuse_proj=True))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    ga = jax.grad(lambda p: llama.loss_fn(p, ids, cfg))(params)
+    gb = jax.grad(lambda p: llama.loss_fn(
+        p, ids, dataclasses.replace(cfg, fuse_proj=True)))(params)
+    for la, lb in zip(jax.tree_util.tree_leaves(ga),
+                      jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=1e-5)
+
+
 def test_llama_trains(hvd):
     cfg = llama.CONFIGS["tiny"]
     params = llama.init(jax.random.PRNGKey(0), cfg)
