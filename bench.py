@@ -243,7 +243,12 @@ def main() -> int:
                     help="rematerialize the forward pass (bigger batches)")
     ap.add_argument("--no-fuse", action="store_true",
                     help="disable the fused qkv/gate-up projections "
-                         "(fused is the default for the bench model)")
+                         "(the bench enables fusion for every llama size; "
+                         "the library default is off)")
+    ap.add_argument("--ce-chunks", type=int, default=0,
+                    help="stream the lm_head+cross-entropy over N sequence "
+                         "chunks under jax.checkpoint (0 = whole-sequence "
+                         "logits); cuts the ~1 GB logits slab to 1/N live")
     ap.add_argument("--dim", type=int, default=0,
                     help="override model width (with --layers/--ffn, scans "
                          "custom shapes; 0 = use --model's config)")
@@ -300,10 +305,11 @@ def main() -> int:
     cfgs["bench"] = llama.LlamaConfig(
         vocab=32768, dim=1024, n_layers=8, n_heads=16, n_kv_heads=8,
         ffn_dim=4096, max_seq=max(2048, args.seq),
-        dtype=jnp.bfloat16, fuse_proj=not args.no_fuse)
-    cfg = cfgs[args.model]
+        dtype=jnp.bfloat16)
+    import dataclasses
+    cfg = dataclasses.replace(cfgs[args.model],
+                              fuse_proj=not args.no_fuse)
     if args.dim:
-        import dataclasses
         cfg = dataclasses.replace(
             cfg, dim=args.dim,
             n_layers=args.layers or cfg.n_layers,
@@ -336,7 +342,8 @@ def main() -> int:
     # memory lever); whole-loss jax.checkpoint wouldn't reduce the peak.
     run = make_scanned_train_step(
         lambda p, ids: llama.loss_fn(p, ids, cfg, attn_fn=attn_fn,
-                                     remat=args.remat),
+                                     remat=args.remat,
+                                     ce_chunks=args.ce_chunks),
         opt, mesh)
     params = replicate(params, mesh)
     opt_state = replicate(opt.init(params), mesh)

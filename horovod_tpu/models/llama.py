@@ -44,7 +44,8 @@ class LlamaConfig:
     # the ffn) per layer, and the MXU sees a larger N dim.  Bit-identical to
     # the unfused path (each output column contracts the same weight column);
     # off by default because TP shards the individual kernels along their
-    # output dims and the concat would cross that sharding.
+    # output dims and the concat would cross that sharding.  Ignored (falls
+    # back to separate matmuls) when a projection carries a bias term.
     fuse_proj: bool = False
 
     @property
@@ -108,7 +109,9 @@ def _attn(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig,
           attn_fn=None) -> jax.Array:
     B, S, _ = x.shape
     nq, nkv = cfg.n_heads * cfg.head_dim, cfg.n_kv_heads * cfg.head_dim
-    if cfg.fuse_proj:
+    fuse = cfg.fuse_proj and not any(
+        "bias" in p[k] for k in ("wq", "wk", "wv"))
+    if fuse:
         wqkv = jnp.concatenate([p["wq"]["kernel"], p["wk"]["kernel"],
                                 p["wv"]["kernel"]], axis=1)
         qkv = jnp.einsum("...i,io->...o", x, wqkv)
@@ -128,7 +131,7 @@ def _attn(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig,
 
 
 def _ffn(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig) -> jax.Array:
-    if cfg.fuse_proj:
+    if cfg.fuse_proj and "bias" not in p["w_gate"] and "bias" not in p["w_up"]:
         wgu = jnp.concatenate([p["w_gate"]["kernel"], p["w_up"]["kernel"]],
                               axis=1)
         gu = jnp.einsum("...i,io->...o", x, wgu)
@@ -149,8 +152,10 @@ def apply_layer(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig,
 
 def apply(params: Dict[str, Any], ids: jax.Array, cfg: LlamaConfig,
           attn_fn=None, remat: bool = False,
-          act_sharding=None) -> jax.Array:
-    """Forward: token ids [B, S] -> logits [B, S, vocab].
+          act_sharding=None, return_hidden: bool = False) -> jax.Array:
+    """Forward: token ids [B, S] -> logits [B, S, vocab] (or the final-norm
+    hidden states [B, S, dim] with ``return_hidden=True``, for chunked-loss
+    callers that apply the lm_head themselves).
 
     ``remat=True`` wraps each layer in jax.checkpoint — rematerialization
     trades FLOPs for HBM, the standard TPU memory lever.
@@ -176,16 +181,41 @@ def apply(params: Dict[str, Any], ids: jax.Array, cfg: LlamaConfig,
     for p in params["layers"]:
         x = pin(layer(p, x, cfg, cos, sin, attn_fn))
     x = L.rmsnorm(params["final_norm"], x)
+    if return_hidden:
+        return x
     return L.dense(params["lm_head"], x)
 
 
 def loss_fn(params: Dict[str, Any], ids: jax.Array, cfg: LlamaConfig,
             attn_fn=None, remat: bool = False,
-            act_sharding=None) -> jax.Array:
-    """Next-token cross-entropy over shifted ids."""
+            act_sharding=None, ce_chunks: int = 0) -> jax.Array:
+    """Next-token cross-entropy over shifted ids.
+
+    ``ce_chunks > 0`` streams the lm_head matmul + loss over that many
+    sequence chunks under ``jax.checkpoint``: only a [B, S/C, vocab] logits
+    slab is ever live (vs the full [B, S, vocab] — ~1 GB bf16 at bench
+    shapes), and the backward recomputes each slab instead of saving it.
+    Costs one extra lm_head matmul per chunk in the backward (~6% of step
+    FLOPs at bench shapes) for a large cut in peak HBM + traffic."""
+    targets = ids[:, 1:]
+    if ce_chunks:
+        h = apply(params, ids[:, :-1], cfg, attn_fn=attn_fn, remat=remat,
+                  act_sharding=act_sharding, return_hidden=True)
+        B, S, D = h.shape
+        if S % ce_chunks:
+            raise ValueError(f"seq {S} not divisible by ce_chunks={ce_chunks}")
+        hs = h.reshape(B, ce_chunks, S // ce_chunks, D).swapaxes(0, 1)
+        ts = targets.reshape(B, ce_chunks, S // ce_chunks).swapaxes(0, 1)
+
+        @jax.checkpoint
+        def chunk_nll(hc, tc):
+            return jnp.sum(
+                L.softmax_cross_entropy(L.dense(params["lm_head"], hc), tc))
+
+        total = jnp.sum(jax.lax.map(lambda x: chunk_nll(*x), (hs, ts)))
+        return total / (B * S)
     logits = apply(params, ids[:, :-1], cfg, attn_fn=attn_fn, remat=remat,
                    act_sharding=act_sharding)
-    targets = ids[:, 1:]
     return jnp.mean(L.softmax_cross_entropy(logits, targets))
 
 

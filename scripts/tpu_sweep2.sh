@@ -1,0 +1,30 @@
+#!/bin/bash
+# Phase-2 perf sweep: the fused-projection + chunked-cross-entropy knobs
+# (landed after tpu_sweep.sh's matrix).  Same protocol: each config goes
+# through bench.py's probe+deadline supervisor; results append to
+# sweep_results.jsonl.
+set -u
+cd "$(dirname "$0")/.."
+OUT=sweep_results.jsonl
+
+run() {
+  desc="$1"; shift
+  echo "=== $desc : bench.py $* ===" >&2
+  line=$(BENCH_DEADLINE_S=2400 python bench.py "$@" 2>>/tmp/sweep_stderr.log)
+  [ -n "$line" ] || line=null
+  echo "{\"config\": \"$desc\", \"result\": $line}" >> "$OUT"
+  echo "$line" >&2
+}
+
+run "fused-default"          --steps 30
+run "fused-ce8"              --ce-chunks 8
+run "fused-ce8-b24"          --ce-chunks 8 --batch 24
+run "fused-ce8-b32"          --ce-chunks 8 --batch 32
+run "nofuse-control"         --no-fuse
+run "fused-flash-bq256-bk512" --flash --block-q 256 --block-k 512 --steps 10
+run "fused-ce8-flash"        --ce-chunks 8 --flash --steps 10
+
+run "llama1b-b8-remat-ce8"   --model 1b --batch 8 --remat --ce-chunks 8 --steps 10
+run "llama1b-b4-remat-ce8"   --model 1b --batch 4 --remat --ce-chunks 8 --steps 10
+
+echo "sweep2 complete" >&2

@@ -147,6 +147,24 @@ def test_llama_fused_projections_match():
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=1e-5)
 
 
+def test_llama_chunked_ce_matches():
+    """ce_chunks streams the lm_head loss but computes the same value and
+    gradients as the whole-sequence path."""
+    cfg = llama.CONFIGS["tiny"]
+    params = llama.init(jax.random.PRNGKey(4), cfg)
+    ids = jnp.asarray(np.random.RandomState(4).randint(0, cfg.vocab, (2, 17)))
+    a = llama.loss_fn(params, ids, cfg)
+    b = llama.loss_fn(params, ids, cfg, ce_chunks=4)
+    np.testing.assert_allclose(float(a), float(b), atol=1e-5)
+    ga = jax.grad(lambda p: llama.loss_fn(p, ids, cfg))(params)
+    gb = jax.grad(lambda p: llama.loss_fn(p, ids, cfg, ce_chunks=4))(params)
+    for la, lb in zip(jax.tree_util.tree_leaves(ga),
+                      jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=1e-5)
+    with pytest.raises(ValueError):
+        llama.loss_fn(params, ids, cfg, ce_chunks=3)
+
+
 def test_llama_trains(hvd):
     cfg = llama.CONFIGS["tiny"]
     params = llama.init(jax.random.PRNGKey(0), cfg)
