@@ -168,20 +168,32 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      mask: Optional[jax.Array] = None) -> jax.Array:
     """Multi-head attention core.  q: [B, S, H, D]; k/v: [B, S, Hkv, D]
     (grouped-query when Hkv < H).  Softmax in fp32 for stability; einsum
-    contractions land on the MXU."""
+    contractions land on the MXU.
+
+    Grouped-query heads are handled by folding the group into a batched
+    einsum dimension rather than ``jnp.repeat``-ing k/v: no duplicated
+    k/v buffers in the forward and no scatter-add un-repeat in their
+    backward — the einsum's reduction over the group does it natively."""
     B, S, H, D = q.shape
+    Sk = k.shape[1]
     Hkv = k.shape[2]
-    if Hkv != H:
-        rep = H // Hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    rep = H // Hkv
     scale = 1.0 / math.sqrt(D)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    qg = q.reshape(B, S, Hkv, rep, D)
+    # preferred_element_type=fp32: the MXU accumulates in fp32 anyway; ask
+    # for fp32 out directly instead of materializing a bf16 score tensor
+    # and upcasting it in a second pass.
+    logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
-        Sk = k.shape[1]
         causal_mask = jnp.tril(jnp.ones((S, Sk), jnp.bool_), k=Sk - S)
-        logits = jnp.where(causal_mask[None, None], logits, -1e30)
+        logits = jnp.where(causal_mask[None, None, None], logits, -1e30)
     if mask is not None:
-        logits = jnp.where(mask, logits, -1e30)
+        # user masks address [B?, H, Sq, Sk]; expose the grouped logits in
+        # that layout, mask, and re-group
+        lg = logits.reshape(B, H, S, Sk)
+        lg = jnp.where(mask, lg, -1e30)
+        logits = lg.reshape(B, Hkv, rep, S, Sk)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    o = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v)
+    return o.reshape(B, S, H, D)
