@@ -31,6 +31,29 @@ from .hierarchical import resolve_axis
 AxisName = Union[str, Sequence[str]]
 
 
+def cast_params(tree: Any, dtype) -> Any:
+    """Cast floating leaves of a param pytree (ints/bools untouched)."""
+    def one(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree_util.tree_map(one, tree)
+
+
+def _compute_cast(loss_fn: Callable, compute_dtype) -> Callable:
+    """Mixed precision the TPU way: params (and optimizer state) stay in
+    their storage dtype — typically fp32 "master" weights — and are cast
+    to ``compute_dtype`` (bf16) just for the forward.  jax differentiates
+    through the cast, so gradients and the optimizer update arrive back in
+    the storage dtype; no dual copy of the weights is kept."""
+    if compute_dtype is None:
+        return loss_fn
+
+    def fn(params, *batch):
+        return loss_fn(cast_params(params, compute_dtype), *batch)
+    return fn
+
+
 def _resolve_donate(donate: Optional[bool]) -> bool:
     """HOROVOD_TPU_DONATE_BUFFERS is the default when the caller doesn't
     say — the TPU analog of the reference's persistent fusion-buffer
@@ -50,12 +73,18 @@ def make_train_step(loss_fn: Callable,
                     backward_passes_per_step: int = 1,
                     fusion_threshold_bytes: Optional[int] = None,
                     donate: Optional[bool] = None,
-                    has_aux: bool = False) -> Callable:
+                    has_aux: bool = False,
+                    compute_dtype=None) -> Callable:
     """Build ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
 
     ``loss_fn(params, *batch_shard)`` is evaluated per chip on the local
     batch shard; gradients are fused+allreduced; the update is applied
     identically everywhere (params replicated).
+
+    ``compute_dtype=jnp.bfloat16`` with fp32 params is the standard TPU
+    mixed-precision recipe: fp32 master weights + optimizer state, bf16
+    forward/backward (params are cast inside the step; the gradient of the
+    cast lands back in fp32).
 
     ``donate=True`` donates params/opt_state so XLA updates them in place in
     HBM — the analog of the reference's persistent fusion buffer residency
@@ -71,6 +100,7 @@ def make_train_step(loss_fn: Callable,
         fusion_threshold_bytes=fusion_threshold_bytes)
 
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    loss_fn = _compute_cast(loss_fn, compute_dtype)
 
     def body(params, opt_state, *batch):
         if has_aux:
@@ -122,7 +152,8 @@ def make_scanned_train_step(loss_fn: Callable,
                             compression: type[Compressor] = Compression.none,
                             fusion_threshold_bytes: Optional[int] = None,
                             donate: Optional[bool] = None,
-                            remat: bool = False) -> Callable:
+                            remat: bool = False,
+                            compute_dtype=None) -> Callable:
     """Build ``run(params, opt_state, batches) -> (params, opt_state, losses)``
     executing ``batches.shape[0]`` optimizer steps inside ONE compiled program
     via ``lax.scan``.
@@ -138,6 +169,8 @@ def make_scanned_train_step(loss_fn: Callable,
     ``batches`` is a pytree whose leaves are stacked per-step inputs of
     shape ``(K, global_batch, ...)``; each step's slice is sharded over the
     data axis.  ``losses`` comes back with shape ``(K,)``.
+    ``compute_dtype`` as in :func:`make_train_step` (fp32 master weights,
+    bf16 compute).
     """
     axis_name = resolve_axis(axis_name, mesh)
     donate = _resolve_donate(donate)
@@ -146,7 +179,8 @@ def make_scanned_train_step(loss_fn: Callable,
         fusion_threshold_bytes=fusion_threshold_bytes)
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
 
-    fn = loss_fn if not remat else jax.checkpoint(loss_fn)
+    fn = _compute_cast(loss_fn, compute_dtype)
+    fn = fn if not remat else jax.checkpoint(fn)
 
     def body(params, opt_state, batches):
         def one(carry, batch):

@@ -1,18 +1,7 @@
 #!/bin/bash
-# Poll the TPU tunnel; when devices appear, run the perf sweep once.
-#   nohup bash scripts/tpu_watch_and_sweep.sh > /dev/null 2>&1 &
-# Progress: /tmp/tpu_watch3.log, sweep output: /tmp/sweep.out,
-# results: sweep_results.jsonl (appended).
+# Thin wrapper kept for round-2 muscle memory: the probe/recovery loop
+# now lives inside scripts/resume_sweep.py (probe-gated, resumable,
+# priority-ordered).  Just exec it.
+#   nohup bash scripts/tpu_watch_and_sweep.sh > /tmp/resume_sweep.out 2>&1 &
 cd "$(dirname "$0")/.."
-while true; do
-  ts=$(date +%H:%M:%S)
-  out=$(timeout 240 python -c "import jax; print(jax.devices())" 2>/dev/null | tail -1)
-  echo "$ts devices=[$out]" >> /tmp/tpu_watch3.log
-  if [ -n "$out" ]; then
-    echo "$ts TPU UP - launching sweep" >> /tmp/tpu_watch3.log
-    bash scripts/tpu_sweep.sh > /tmp/sweep.out 2>&1
-    echo "$(date +%H:%M:%S) sweep finished" >> /tmp/tpu_watch3.log
-    exit 0
-  fi
-  sleep 150
-done
+exec python scripts/resume_sweep.py
