@@ -1,7 +1,8 @@
 #!/bin/bash
 # Thin wrapper kept for round-2 muscle memory: the probe/recovery loop
 # now lives inside scripts/resume_sweep.py (probe-gated, resumable,
-# priority-ordered).  Just exec it.
-#   nohup bash scripts/tpu_watch_and_sweep.sh > /tmp/resume_sweep.out 2>&1 &
+# priority-ordered).  Logs to /tmp/resume_sweep.out itself so the old
+# "> /dev/null 2>&1 &" invocation still leaves a progress trail.
+#   nohup bash scripts/tpu_watch_and_sweep.sh &
 cd "$(dirname "$0")/.."
-exec python scripts/resume_sweep.py
+exec python scripts/resume_sweep.py >> /tmp/resume_sweep.out 2>&1

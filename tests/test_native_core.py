@@ -88,17 +88,32 @@ def test_signature_mismatch_yields_error(hub2):
     assert "t" in r.names
 
 
-def test_fusion_groups_small_tensors(hub2):
+def test_fusion_groups_small_tensors():
     """Small same-dtype tensors fuse into one response batch under the
-    threshold (reference: FuseResponses controller.cc:778-915)."""
-    c0, c1 = hub2
-    for c in (c0, c1):
-        for i in range(4):
-            c.submit(f"g{i}", "f32:10:sum", OP_ALLREDUCE, 40)
-    r = c0.wait(5.0)
-    assert r is not None and r.type == "OK"
-    assert len(r.names) == 4, r.names  # all fused
-    assert r.total_bytes == 160
+    threshold (reference: FuseResponses controller.cc:778-915).
+
+    Uses its own hub with a LONG cycle (50 ms) so all eight submits land
+    inside one negotiation window even on a loaded machine — with the
+    suite-default 0.2 ms cycle, a scheduler hiccup can split the
+    submissions across cycles and the batch arrives in two pieces."""
+    hub = LoopbackHub(2)
+    cores = [CoordinationCore.loopback(hub, r, cycle_ms=50.0)
+             for r in range(2)]
+    try:
+        c0, _ = cores
+        for c in cores:
+            for i in range(4):
+                c.submit(f"g{i}", "f32:10:sum", OP_ALLREDUCE, 40)
+        r = c0.wait(5.0)
+        assert r is not None and r.type == "OK"
+        assert len(r.names) == 4, r.names  # all fused
+        assert r.total_bytes == 160
+    finally:
+        for c in cores:
+            c.shutdown()
+        for c in cores:
+            c.close()
+        hub.close()
 
 
 def test_fusion_respects_dtype_boundary(hub2):
