@@ -29,17 +29,32 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+# Every grid axis (batch, head, q-or-k block) is independent — the
+# sequential online-softmax walk over K/V lives in an in-kernel
+# fori_loop, not on the grid — so Mosaic may pipeline/reorder grid
+# iterations freely.  Ignored in interpret mode.
+_GRID_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel"))
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
                  block_k: int, seq_len: int, scale: float):
     # q_ref: [BQ, D]; k_ref/v_ref: [S, D]; o_ref: [BQ, D]; lse_ref: [BQ]
+    #
+    # MXU dtype discipline: matmul OPERANDS stay in the input dtype (the
+    # MXU runs bf16 x bf16 -> fp32 at full rate; upcasting operands to
+    # fp32 first would halve-or-worse its throughput), while every
+    # softmax statistic and the output accumulator are fp32 via
+    # preferred_element_type.  The scale folds into the fp32 accumulator
+    # AFTER the q.k matmul, not into q.
     qi = pl.program_id(2)
     bq = q_ref.shape[0]
     d = q_ref.shape[1]
-    q = q_ref[:].astype(jnp.float32) * scale
+    q = q_ref[:]
 
     m = jnp.full((bq, 1), NEG_INF, jnp.float32)       # running max
     l = jnp.zeros((bq, 1), jnp.float32)               # running sum
@@ -54,10 +69,10 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
     def body(kb, carry):
         m, l, acc = carry
         k_start = kb * block_k
-        k = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(k_start, block_k), :]
+        v = v_ref[pl.ds(k_start, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
@@ -68,8 +83,10 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        # p back to the input dtype for the second matmul (bf16 inputs ->
+        # full-rate MXU; fp32 inputs keep fp32 precision)
         acc = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l, acc
 
@@ -88,8 +105,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(2)
     bq = q_ref.shape[0]
     d = q_ref.shape[1]
-    qs = q_ref[:].astype(jnp.float32) * scale
-    do = do_ref[:].astype(jnp.float32)
+    # Same MXU dtype discipline as the forward: operands in input dtype,
+    # fp32 accumulation, scale folded in fp32 (s after the matmul, dq at
+    # the end).
+    q = q_ref[:]
+    do = do_ref[:]
     lse = lse_ref[:].astype(jnp.float32)[:, None]
     delta = delta_ref[:].astype(jnp.float32)[:, None]
 
@@ -100,10 +120,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(kb, dq):
         k_start = kb * block_k
-        k = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        k = k_ref[pl.ds(k_start, block_k), :]
+        v = v_ref[pl.ds(k_start, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
@@ -113,7 +133,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q_ref.dtype)
         return dq + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -129,8 +149,11 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
     ki = pl.program_id(2)
     bk = k_ref.shape[0]
     d = k_ref.shape[1]
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
+    # Input-dtype operands / fp32 accumulators, as in the other kernels.
+    # dk absorbs the softmax scale once at the end (d/dk of s=(q.k)*scale)
+    # instead of pre-scaling every q block.
+    k = k_ref[:]
+    v = v_ref[:]
 
     k_start = ki * bk
     num_qb = pl.cdiv(seq_len, block_q)
@@ -140,13 +163,13 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
     def body(qb, carry):
         dk, dv = carry
         q_start = qb * block_q
-        qs = q_ref[pl.ds(q_start, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[pl.ds(q_start, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.ds(q_start, block_q), :]
+        do = do_ref[pl.ds(q_start, block_q), :]
         lse = lse_ref[pl.ds(q_start, block_q)].astype(jnp.float32)[:, None]
         delta = delta_ref[pl.ds(q_start, block_q)].astype(
             jnp.float32)[:, None]
-        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
@@ -155,20 +178,20 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)                              # [BQ2, BK]
         dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk = dk + jax.lax.dot_general(
-            ds, qs, (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk, dv
 
     dk, dv = jax.lax.fori_loop(
         qb_lo, num_qb, body,
         (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dk_ref[:] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
@@ -255,6 +278,7 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
             jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
             jax.ShapeDtypeStruct((B, H, S), jnp.float32),
         ],
+        compiler_params=_GRID_SEMANTICS,
         interpret=interpret,
     )(qt, kt, vt)
     return jnp.swapaxes(out, 1, 2), lse
@@ -300,6 +324,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool = True,
         in_specs=[qspec, kvfull, kvfull, qspec, rowq, rowq],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        compiler_params=_GRID_SEMANTICS,
         interpret=interpret,
     )(qt, kt, vt, do, lse, delta)
 
@@ -317,6 +342,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool = True,
         out_specs=[kspec, kspec],
         out_shape=[jax.ShapeDtypeStruct((B, H, S, D), jnp.float32),
                    jax.ShapeDtypeStruct((B, H, S, D), jnp.float32)],
+        compiler_params=_GRID_SEMANTICS,
         interpret=interpret,
     )(kt, vt, qt, do, lse, delta)
 
