@@ -113,6 +113,17 @@ def _init_with_retry(hvd, expect_tpu: bool, attempts: int = 3,
             time.sleep(delay_s)
 
 
+def maybe_profile(args):
+    """Context manager: a jax.profiler trace into ``args.profile`` when
+    set, else a no-op.  One definition so every bench path opens the
+    trace the same way."""
+    import contextlib
+    if not args.profile:
+        return contextlib.nullcontext()
+    import jax
+    return jax.profiler.trace(args.profile)
+
+
 def fail(reason: str, **extra) -> int:
     print(json.dumps({"metric": "BENCH_INVALID", "value": 0,
                       "unit": "error", "vs_baseline": 0,
@@ -270,6 +281,10 @@ def main() -> int:
                          "sync throughput")
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU (smoke mode)")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a jax.profiler trace of the timed scan "
+                         "into DIR (inspect with xprof/tensorboard to see "
+                         "where step time goes)")
     ap.add_argument("--inner", action="store_true",
                     help="internal: run the measurement directly (no "
                          "probe/deadline supervisor)")
@@ -288,6 +303,9 @@ def main() -> int:
     import optax
 
     if args.autotune:
+        if args.profile:
+            print("--profile is not supported with --autotune (its timing "
+                  "loop re-traces per threshold); ignoring", file=sys.stderr)
         return autotune_bench(args)
     if args.resnet:
         return resnet_bench(args)
@@ -366,10 +384,11 @@ def main() -> int:
     params, opt_state = wparams, wopt
 
     batches = make_batches(args.steps)
-    t0 = time.perf_counter()
-    params, opt_state, losses = run(params, opt_state, batches)
-    losses_host = np.asarray(losses)  # D2H fence — timer is honest
-    dt = time.perf_counter() - t0
+    with maybe_profile(args):
+        t0 = time.perf_counter()
+        params, opt_state, losses = run(params, opt_state, batches)
+        losses_host = np.asarray(losses)  # D2H fence — timer is honest
+        dt = time.perf_counter() - t0
 
     # --- sanity gates ---------------------------------------------------
     if losses_host.shape != (args.steps,):
@@ -572,10 +591,11 @@ def resnet_bench(args) -> int:
     if not np.all(np.isfinite(warm)):
         return fail("non-finite warmup loss", losses=warm.tolist())
 
-    t0 = time.perf_counter()
-    params, opt_state, losses = run(params, opt_state, x, y)
-    losses_host = np.asarray(losses)
-    dt = time.perf_counter() - t0
+    with maybe_profile(args):
+        t0 = time.perf_counter()
+        params, opt_state, losses = run(params, opt_state, x, y)
+        losses_host = np.asarray(losses)
+        dt = time.perf_counter() - t0
 
     if not np.all(np.isfinite(losses_host)):
         return fail("non-finite loss", losses=losses_host.tolist())

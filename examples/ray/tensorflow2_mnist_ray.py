@@ -16,7 +16,9 @@ import argparse
 def train(epochs=3, batch=128, lr=1e-3):
     """Runs on every Ray worker."""
     import os
-    os.environ.setdefault("KERAS_BACKEND", "tensorflow")
+    # force, not setdefault: tf.keras IS Keras 3 here and obeys
+    # KERAS_BACKEND — an inherited =jax would silently break TF training
+    os.environ["KERAS_BACKEND"] = "tensorflow"
     import numpy as np
     import tensorflow as tf
     import horovod_tpu.tensorflow.keras as hvd

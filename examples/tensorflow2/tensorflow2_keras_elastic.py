@@ -30,7 +30,9 @@ def main():
     args = ap.parse_args()
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ.setdefault("KERAS_BACKEND", "tensorflow")
+    # force, not setdefault: tf.keras IS Keras 3 here and obeys
+    # KERAS_BACKEND — an inherited =jax would silently break TF training
+    os.environ["KERAS_BACKEND"] = "tensorflow"
 
     import tensorflow as tf
     import horovod_tpu.tensorflow.keras as hvd

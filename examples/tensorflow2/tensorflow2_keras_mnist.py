@@ -32,7 +32,9 @@ def main():
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
             " --xla_force_host_platform_device_count=8"
-    os.environ.setdefault("KERAS_BACKEND", "tensorflow")
+    # force, not setdefault: tf.keras IS Keras 3 here and obeys
+    # KERAS_BACKEND — an inherited =jax would silently break TF training
+    os.environ["KERAS_BACKEND"] = "tensorflow"
 
     import tensorflow as tf
     import horovod_tpu.tensorflow.keras as hvd

@@ -58,6 +58,10 @@ def main():
         os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
             " --xla_force_host_platform_device_count=8"
 
+    # force, not setdefault: tf.keras IS Keras 3 here and obeys
+    # KERAS_BACKEND — an inherited =jax would hand tf.keras.optimizers.SGD
+    # a JAX-backend class that cannot apply IndexedSlices grads
+    os.environ["KERAS_BACKEND"] = "tensorflow"
     import numpy as np
     import tensorflow as tf
     import horovod_tpu.tensorflow as hvd

@@ -62,18 +62,22 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
 
     q_start = qi * bq
     num_kb = pl.cdiv(seq_len, block_k)
-    # causal: K blocks strictly after this q block contribute nothing
+    # causal split: K blocks strictly after this q block contribute
+    # nothing; blocks entirely at-or-below the diagonal need no mask at
+    # all (most blocks, for long sequences) — only the diagonal-crossing
+    # tail pays the iota/compare/select VPU tax.
     kb_hi = jnp.minimum(num_kb,
                         pl.cdiv(q_start + bq, block_k)) if causal else num_kb
+    kb_full = (q_start // block_k) if causal else num_kb
 
-    def body(kb, carry):
+    def body(kb, carry, *, masked):
         m, l, acc = carry
         k_start = kb * block_k
         k = k_ref[pl.ds(k_start, block_k), :]
         v = v_ref[pl.ds(k_start, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if masked:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(
@@ -90,7 +94,10 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
             preferred_element_type=jnp.float32)
         return m_new, l, acc
 
-    m, l, acc = jax.lax.fori_loop(0, kb_hi, body, (m, l, acc))
+    m, l, acc = jax.lax.fori_loop(
+        0, kb_full, functools.partial(body, masked=False), (m, l, acc))
+    m, l, acc = jax.lax.fori_loop(
+        kb_full, kb_hi, functools.partial(body, masked=causal), (m, l, acc))
     l = jnp.maximum(l, 1e-30)
     o_ref[:] = (acc / l).astype(o_ref.dtype)
     # logsumexp of the SCALED scores — the backward kernels rebuild
@@ -117,14 +124,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     num_kb = pl.cdiv(seq_len, block_k)
     kb_hi = jnp.minimum(num_kb,
                         pl.cdiv(q_start + bq, block_k)) if causal else num_kb
+    # blocks entirely below the diagonal skip the mask (see _attn_kernel)
+    kb_full = (q_start // block_k) if causal else num_kb
 
-    def body(kb, dq):
+    def body(kb, dq, *, masked):
         k_start = kb * block_k
         k = k_ref[pl.ds(k_start, block_k), :]
         v = v_ref[pl.ds(k_start, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if masked:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(
@@ -138,7 +147,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, kb_hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq = jax.lax.fori_loop(0, kb_full,
+                           functools.partial(body, masked=False),
+                           jnp.zeros((bq, d), jnp.float32))
+    dq = jax.lax.fori_loop(kb_full, kb_hi,
+                           functools.partial(body, masked=causal), dq)
     dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
 
 
@@ -157,10 +170,12 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
     k_start = ki * bk
     num_qb = pl.cdiv(seq_len, block_q)
-    # causal: q blocks strictly before this k block contribute nothing
+    # causal: q blocks strictly before this k block contribute nothing;
+    # q blocks entirely past the diagonal need no mask (see _attn_kernel)
     qb_lo = (k_start // block_q) if causal else 0
+    qb_full_lo = (pl.cdiv(k_start + bk, block_q) if causal else 0)
 
-    def body(qb, carry):
+    def body(qb, carry, *, masked):
         dk, dv = carry
         q_start = qb * block_q
         q = q_ref[pl.ds(q_start, block_q), :]
@@ -170,7 +185,7 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
             jnp.float32)[:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if masked:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(
@@ -188,9 +203,14 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
         return dk, dv
 
+    zeros = (jnp.zeros((bk, d), jnp.float32),
+             jnp.zeros((bk, d), jnp.float32))
     dk, dv = jax.lax.fori_loop(
-        qb_lo, num_qb, body,
-        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+        qb_lo, jnp.minimum(qb_full_lo, num_qb),
+        functools.partial(body, masked=causal), zeros)
+    dk, dv = jax.lax.fori_loop(
+        jnp.minimum(qb_full_lo, num_qb), num_qb,
+        functools.partial(body, masked=False), (dk, dv))
     dk_ref[:] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
 

@@ -27,31 +27,41 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "sweep_results.jsonl")
 
 # (name, bench.py args) — priority order: the headline numbers first.
+# "mxu" rows re-measure the flash kernel AFTER the input-dtype fix
+# (operands were upcast fp32 pre-matmul before; fixed 2026-07-31).
 MATRIX = [
-    ("fused-default", ["--steps", "30"]),
-    ("fused-ce8", ["--ce-chunks", "8", "--steps", "30"]),
-    ("fused-ce8-b24", ["--ce-chunks", "8", "--batch", "24", "--steps", "30"]),
-    ("fused-ce8-b32", ["--ce-chunks", "8", "--batch", "32", "--steps", "30"]),
-    # the reference's own headline row (docs/benchmarks.rst:31-43 is
-    # resnet101 img/sec) — land these before the flash experiments
-    ("resnet101", ["--resnet", "--depth", "101"]),
-    ("resnet50", ["--resnet"]),
-    ("nofuse-control", ["--no-fuse", "--steps", "30"]),
-    ("fused-flash-bq256-bk512",
-     ["--flash", "--block-q", "256", "--block-k", "512", "--steps", "10"]),
-    ("fused-ce8-flash", ["--ce-chunks", "8", "--flash", "--steps", "10"]),
+    ("flash-mxu-default", ["--flash", "--steps", "30"]),
+    ("flash-mxu-bq512", ["--flash", "--block-q", "512", "--block-k", "512",
+                         "--steps", "30"]),
+    ("flash-mxu-ce8", ["--flash", "--ce-chunks", "8", "--steps", "30"]),
     ("llama1b-b8-remat-ce8",
      ["--model", "1b", "--batch", "8", "--remat", "--ce-chunks", "8",
       "--steps", "10"]),
-    ("llama1b-b4-remat-ce8",
-     ["--model", "1b", "--batch", "4", "--remat", "--ce-chunks", "8",
+    ("llama1b-b8-remat-ce8-flash",
+     ["--model", "1b", "--batch", "8", "--remat", "--ce-chunks", "8",
+      "--flash", "--steps", "10"]),
+    ("seq2048-b8-ce8-flash",
+     ["--seq", "2048", "--batch", "8", "--ce-chunks", "8", "--flash",
       "--steps", "10"]),
     ("seq2048-b8-ce8",
      ["--seq", "2048", "--batch", "8", "--ce-chunks", "8", "--steps", "10"]),
-    ("flash-bq512-bk512",
-     ["--flash", "--block-q", "512", "--block-k", "512", "--steps", "10"]),
+    # diagnostic: same token count, 1/4 the attention share — locates the
+    # non-matmul time if MFU jumps
+    ("seq256-b64", ["--seq", "256", "--batch", "64", "--steps", "30"]),
+    ("nofuse-control", ["--no-fuse", "--steps", "30"]),
     ("batch-20", ["--batch", "20", "--steps", "30"]),
+    ("llama1b-b4-remat-ce8",
+     ["--model", "1b", "--batch", "4", "--remat", "--ce-chunks", "8",
+      "--steps", "10"]),
     ("autotune", ["--autotune"]),
+    # the reference's own headline rows (docs/benchmarks.rst:31-43 is
+    # resnet101 img/sec) — LAST: the unrolled conv graphs compile >25 min
+    # over the tunnel, so they must not starve the rows above; run_config
+    # gives --resnet the long leash
+    # "-scan10" = the stage-scanned model at --steps 10 (names encode the
+    # protocol so a rename, not silent staleness, accompanies any change)
+    ("resnet50-scan10", ["--resnet", "--steps", "10"]),
+    ("resnet101-scan10", ["--resnet", "--depth", "101", "--steps", "10"]),
 ]
 
 
@@ -137,9 +147,11 @@ def main():
             continue
         name, args = todo[0]
         attempts[name] = attempts.get(name, 0) + 1
-        # Mosaic (Pallas) programs compile much slower over the remote
-        # tunnel than plain XLA — give flash configs a longer leash.
-        cfg_deadline = deadline_s * 2 if "--flash" in args else deadline_s
+        # Mosaic (Pallas) programs and the unrolled ResNet conv graphs
+        # compile much slower over the remote tunnel than the llama
+        # decoder — give them a longer leash.
+        slow_compile = "--flash" in args or "--resnet" in args
+        cfg_deadline = deadline_s * 2 if slow_compile else deadline_s
         if not run_config(name, args, cfg_deadline):
             consecutive_fail += 1
             # A config can fail on its own (e.g. OOM) while the tunnel is

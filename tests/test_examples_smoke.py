@@ -1,0 +1,75 @@
+"""Examples smoke tier: run the fast user-facing example scripts as real
+subprocesses (their documented --cpu/--local invocations) and assert they
+reach their own "OK"/success output.
+
+The reference keeps examples working by running them in CI
+(.buildkite/gen-pipeline.sh test-cpu examples); this is the TPU-repo
+analog for the examples whose runtime is a few seconds with reduced
+steps.  Scripts needing minutes (resnet50_train, llama_fsdp) stay out —
+the integration tier and dryrun cover their machinery.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run(relpath, *args, timeout=900):
+    # Generous timeout: the smoke tier may share the machine with the
+    # rest of the suite (first-compile under load took >420 s once).
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, relpath), *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+    assert res.returncode == 0, (
+        f"{relpath} rc={res.returncode}\n--- stdout ---\n{res.stdout[-2000:]}"
+        f"\n--- stderr ---\n{res.stderr[-2000:]}")
+    return res.stdout
+
+
+def test_word2vec_sparse_path():
+    out = _run("tensorflow2/tensorflow2_word2vec.py",
+               "--cpu", "--steps", "150")
+    assert "2/2 IndexedSlices (sparse sync)" in out
+    assert "OK" in out
+
+
+def test_word2vec_dense_control():
+    out = _run("tensorflow2/tensorflow2_word2vec.py",
+               "--cpu", "--steps", "100", "--sparse-as-dense")
+    assert "0/2 IndexedSlices (dense sync)" in out
+    assert "OK" in out
+
+
+def test_spark_torch_estimator_example():
+    out = _run("spark/pytorch_spark_mnist.py", "--cpu", "--epochs", "2")
+    assert "holdout accuracy" in out
+    assert "OK" in out
+
+
+def test_spark_keras_estimator_example():
+    out = _run("spark/keras_spark_mnist.py", "--cpu", "--epochs", "2")
+    assert "OK" in out
+
+
+def test_ray_tf2_fit_example():
+    out = _run("ray/tensorflow2_mnist_ray.py", "--local", "--epochs", "2")
+    # Two worker processes report; their global ranks depend on how many
+    # (virtual) chips each sees, so count reports rather than pin ranks.
+    import re
+    assert len(re.findall(r"rank \d+: final accuracy", out)) == 2, out
+    assert "OK" in out
+
+
+@pytest.mark.parametrize("relpath,args", [
+    ("jax/mlp_mnist.py", ("--cpu",)),
+    ("spark/spark_estimator.py", ("--cpu",)),
+])
+def test_small_jax_examples(relpath, args):
+    _run(relpath, *args)
