@@ -1,0 +1,246 @@
+"""Inception V3 in pure JAX, NHWC.
+
+Completes the reference's headline scaling-table trio (reference:
+docs/benchmarks.rst:12-13 — Inception V3 and ResNet-101 at 90%, VGG-16
+at 68% scaling efficiency over 512 GPUs; the tf_cnn_benchmarks protocol
+behind those rows drives ``--model inception3``).
+
+TPU design mirrors resnet.py/vgg.py: NHWC + bf16 activations on the MXU
+(1x1/1x7/7x1 factorized convs are exactly the narrow matmuls the MXU
+tiles well), BN statistics in fp32, functional (params, new_params) BN
+threading, optional cross-chip sync-BN via ``axis_name``.  Channel
+configs follow the canonical V3 (torchvision/tf-slim numbers), aux head
+omitted (train-time regularizer, not part of the throughput protocol).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+
+def _cbr_init(key, kh, kw, cin, cout, dtype):
+    return {"conv": L.conv_init(key, kh, kw, cin, cout, dtype),
+            "bn": L.batchnorm_init(cout)}
+
+
+def _cbr(p, x, stride, training, axis_name, padding="SAME"):
+    out = dict(p)
+    y = L.conv(p["conv"], x, stride=stride, padding=padding)
+    y, out["bn"] = L.batchnorm(p["bn"], y, training, axis_name=axis_name)
+    return jax.nn.relu(y), out
+
+
+def _pool(x, kind, window=3, stride=1, padding="SAME"):
+    if kind == "max":
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                     (1, window, window, 1),
+                                     (1, stride, stride, 1), padding)
+    ones = (1, window, window, 1)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, ones,
+                              (1, stride, stride, 1), padding)
+    # divisor from a [1,H,W,1] plane (broadcasts) — not a full-tensor
+    # second reduce_window
+    cnt = jax.lax.reduce_window(
+        jnp.ones((1,) + x.shape[1:3] + (1,), x.dtype), 0.0, jax.lax.add,
+        ones, (1, stride, stride, 1), padding)
+    return s / cnt
+
+
+# Each block spec is a dict of branches; a branch is a list of
+# (name, kh, kw, cout, stride, padding) conv steps, optionally preceded
+# by a pool marker handled in apply.
+def _branch_init(key, steps, cin, dtype):
+    ks = jax.random.split(key, max(len(steps), 1))
+    p = {}
+    c = cin
+    for k, (name, kh, kw, cout, _s, _pad) in zip(ks, steps):
+        p[name] = _cbr_init(k, kh, kw, c, cout, dtype)
+        c = cout
+    return p, c
+
+
+def _branch_apply(p, x, steps, training, axis_name):
+    out = dict(p)
+    y = x
+    for (name, _kh, _kw, _cout, stride, padding) in steps:
+        y, out[name] = _cbr(p[name], y, stride, training, axis_name,
+                            padding)
+    return y, out
+
+
+def _inc_a(pool_features):
+    return {
+        "b1": [("c1", 1, 1, 64, 1, "SAME")],
+        "b2": [("c1", 1, 1, 48, 1, "SAME"), ("c2", 5, 5, 64, 1, "SAME")],
+        "b3": [("c1", 1, 1, 64, 1, "SAME"), ("c2", 3, 3, 96, 1, "SAME"),
+               ("c3", 3, 3, 96, 1, "SAME")],
+        "pool": [("c1", 1, 1, pool_features, 1, "SAME")],
+    }
+
+
+def _inc_b():  # grid reduction 35 -> 17
+    return {
+        "b1": [("c1", 3, 3, 384, 2, "VALID")],
+        "b2": [("c1", 1, 1, 64, 1, "SAME"), ("c2", 3, 3, 96, 1, "SAME"),
+               ("c3", 3, 3, 96, 2, "VALID")],
+        "maxpool": [],
+    }
+
+
+def _inc_c(c7):
+    return {
+        "b1": [("c1", 1, 1, 192, 1, "SAME")],
+        "b2": [("c1", 1, 1, c7, 1, "SAME"), ("c2", 1, 7, c7, 1, "SAME"),
+               ("c3", 7, 1, 192, 1, "SAME")],
+        "b3": [("c1", 1, 1, c7, 1, "SAME"), ("c2", 7, 1, c7, 1, "SAME"),
+               ("c3", 1, 7, c7, 1, "SAME"), ("c4", 7, 1, c7, 1, "SAME"),
+               ("c5", 1, 7, 192, 1, "SAME")],
+        "pool": [("c1", 1, 1, 192, 1, "SAME")],
+    }
+
+
+def _inc_d():  # grid reduction 17 -> 8
+    return {
+        "b1": [("c1", 1, 1, 192, 1, "SAME"), ("c2", 3, 3, 320, 2, "VALID")],
+        "b2": [("c1", 1, 1, 192, 1, "SAME"), ("c2", 1, 7, 192, 1, "SAME"),
+               ("c3", 7, 1, 192, 1, "SAME"), ("c4", 3, 3, 192, 2, "VALID")],
+        "maxpool": [],
+    }
+
+
+def _inc_e():
+    return {
+        "b1": [("c1", 1, 1, 320, 1, "SAME")],
+        # b2/b3 fan out into parallel 1x3+3x1 pairs, handled in apply
+        "b2": [("c1", 1, 1, 384, 1, "SAME")],
+        "b2a": [("c1", 1, 3, 384, 1, "SAME")],
+        "b2b": [("c1", 3, 1, 384, 1, "SAME")],
+        "b3": [("c1", 1, 1, 448, 1, "SAME"), ("c2", 3, 3, 384, 1, "SAME")],
+        "b3a": [("c1", 1, 3, 384, 1, "SAME")],
+        "b3b": [("c1", 3, 1, 384, 1, "SAME")],
+        "pool": [("c1", 1, 1, 192, 1, "SAME")],
+    }
+
+
+# (name, spec, kind) — kind drives the concat topology in apply
+BLOCKS = (
+    ("a0", _inc_a(32), "a"),
+    ("a1", _inc_a(64), "a"),
+    ("a2", _inc_a(64), "a"),
+    ("b0", _inc_b(), "reduce"),
+    ("c0", _inc_c(128), "a"),
+    ("c1", _inc_c(160), "a"),
+    ("c2", _inc_c(160), "a"),
+    ("c3", _inc_c(192), "a"),
+    ("d0", _inc_d(), "reduce"),
+    ("e0", _inc_e(), "e"),
+    ("e1", _inc_e(), "e"),
+)
+
+STEM = (  # (name, kh, kw, cout, stride, padding, pool_after)
+    ("s0", 3, 3, 32, 2, "VALID", False),
+    ("s1", 3, 3, 32, 1, "VALID", False),
+    ("s2", 3, 3, 64, 1, "SAME", True),
+    ("s3", 1, 1, 80, 1, "VALID", False),
+    ("s4", 3, 3, 192, 1, "VALID", True),
+)
+
+
+def init(key, classes: int = 1000, dtype=jnp.float32) -> Dict[str, Any]:
+    keys = jax.random.split(key, len(STEM) + len(BLOCKS) + 1)
+    ki = iter(keys)
+    params: Dict[str, Any] = {}
+    cin = 3
+    for (name, kh, kw, cout, _s, _pad, _pool) in STEM:
+        params[name] = _cbr_init(next(ki), kh, kw, cin, cout, dtype)
+        cin = cout
+    for (bname, spec, kind) in BLOCKS:
+        bk = jax.random.split(next(ki), len(spec))
+        bp = {}
+        width = {}  # branch -> output channels
+        for k, (branch, steps) in zip(bk, spec.items()):
+            # e-block fan-out branches (b2a/b2b read b2's output, etc.) —
+            # derived from the spec, not hardcoded
+            if kind == "e" and branch.endswith(("a", "b")):
+                src = width[branch[:-1]]
+            else:
+                src = cin
+            p, c = _branch_init(k, steps, src, dtype)
+            bp[branch] = p
+            width[branch] = c if steps else cin
+        params[bname] = bp
+        if kind == "a":
+            cin = sum(width.values())
+        elif kind == "reduce":
+            # maxpool branch passes cin through unchanged
+            cin = sum(c for b, c in width.items() if b != "maxpool") + cin
+        else:  # e: b1 + (b2a|b2b) + (b3a|b3b) + pool; b2/b3 are internal
+            cin = (width["b1"] + width["b2a"] + width["b2b"]
+                   + width["b3a"] + width["b3b"] + width["pool"])
+    params["head"] = L.dense_init(next(ki), cin, classes, dtype=dtype)
+    return params
+
+
+def apply(params: Dict[str, Any], x: jax.Array,
+          training: bool = False, axis_name: Optional[str] = None
+          ) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Forward.  x: [N, H, W, 3] (299 canonical; any size surviving the
+    stem's two VALID stride-2 stages works).  Returns (logits,
+    new_params) with updated BN stats when training."""
+    out = dict(params)
+    y = x
+    for (name, _kh, _kw, _c, stride, padding, pool_after) in STEM:
+        y, out[name] = _cbr(params[name], y, stride, training, axis_name,
+                            padding)
+        if pool_after:
+            y = _pool(y, "max", 3, 2, "VALID")
+    for (bname, spec, kind) in BLOCKS:
+        bp = params[bname]
+        newb = dict(bp)
+        outs = []
+        if kind in ("a", "reduce"):
+            for branch, steps in spec.items():
+                if branch == "maxpool":
+                    outs.append(_pool(y, "max", 3, 2, "VALID"))
+                    continue
+                src = _pool(y, "avg") if branch == "pool" else y
+                o, newb[branch] = _branch_apply(bp[branch], src, steps,
+                                                training, axis_name)
+                outs.append(o)
+        else:  # e-block: 1x3/3x1 fan-outs concat inside branches 2 and 3
+            o1, newb["b1"] = _branch_apply(bp["b1"], y, spec["b1"],
+                                           training, axis_name)
+            t2, newb["b2"] = _branch_apply(bp["b2"], y, spec["b2"],
+                                           training, axis_name)
+            o2a, newb["b2a"] = _branch_apply(bp["b2a"], t2, spec["b2a"],
+                                             training, axis_name)
+            o2b, newb["b2b"] = _branch_apply(bp["b2b"], t2, spec["b2b"],
+                                             training, axis_name)
+            t3, newb["b3"] = _branch_apply(bp["b3"], y, spec["b3"],
+                                           training, axis_name)
+            o3a, newb["b3a"] = _branch_apply(bp["b3a"], t3, spec["b3a"],
+                                             training, axis_name)
+            o3b, newb["b3b"] = _branch_apply(bp["b3b"], t3, spec["b3b"],
+                                             training, axis_name)
+            po, newb["pool"] = _branch_apply(
+                bp["pool"], _pool(y, "avg"), spec["pool"], training,
+                axis_name)
+            outs = [o1, jnp.concatenate([o2a, o2b], -1),
+                    jnp.concatenate([o3a, o3b], -1), po]
+        y = jnp.concatenate(outs, axis=-1)
+        out[bname] = newb
+    y = jnp.mean(y, axis=(1, 2))
+    return L.dense(params["head"], y), out
+
+
+def loss_fn(params, x, y_true, training: bool = True,
+            axis_name: Optional[str] = None):
+    logits, new_params = apply(params, x, training=training,
+                               axis_name=axis_name)
+    loss = jnp.mean(L.softmax_cross_entropy(logits, y_true))
+    return loss, new_params

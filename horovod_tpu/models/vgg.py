@@ -1,0 +1,124 @@
+"""VGG-16/19 (with BatchNorm) in pure JAX, NHWC.
+
+The third model family of the reference's headline scaling table
+(reference: docs/benchmarks.rst:12-13 — Inception V3 / ResNet-101 at 90%
+and VGG-16 at 68% scaling efficiency over 512 GPUs; VGG's huge dense
+head makes it the communication-heavy stress case, which is exactly why
+the reference reports it).
+
+TPU design mirrors resnet.py: NHWC + bf16 activations on the MXU, BN
+statistics in fp32, functional (params, new_params) BN-state threading,
+optional cross-chip sync-BN via ``axis_name``.  Convs within a stage are
+shape-identical after the first, so they run under ``lax.scan`` over
+stacked params — same compile-size trick as resnet.init.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+# channels per stage; (convs per stage) differs between 16 and 19
+STAGE_CHANNELS = (64, 128, 256, 512, 512)
+STAGE_CONVS = {
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+
+
+def _conv_bn_init(key, cin: int, cout: int, dtype) -> Dict[str, Any]:
+    return {"conv": L.conv_init(key, 3, 3, cin, cout, dtype),
+            "bn": L.batchnorm_init(cout)}
+
+
+def _conv_bn_apply(p, x, training, axis_name):
+    out = dict(p)
+    y = L.conv(p["conv"], x)
+    y, out["bn"] = L.batchnorm(p["bn"], y, training, axis_name=axis_name)
+    return jax.nn.relu(y), out
+
+
+def init(key, depth: int = 16, classes: int = 1000,
+         dtype=jnp.float32) -> Dict[str, Any]:
+    """Parameter pytree.  Per stage: ``s{i}c0`` is the channel-changing
+    first conv; the remaining (shape-identical) convs are stacked at
+    ``s{i}rest`` for the scanned apply."""
+    if depth not in STAGE_CONVS:
+        raise ValueError(f"unsupported depth {depth}")
+    convs = STAGE_CONVS[depth]
+    keys = jax.random.split(key, sum(convs) + 3)
+    ki = iter(keys)
+    params: Dict[str, Any] = {}
+    cin = 3
+    for stage, (cout, n) in enumerate(zip(STAGE_CHANNELS, convs)):
+        params[f"s{stage}c0"] = _conv_bn_init(next(ki), cin, cout, dtype)
+        rest = [_conv_bn_init(next(ki), cout, cout, dtype)
+                for _ in range(n - 1)]
+        if rest:
+            params[f"s{stage}rest"] = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *rest)
+        cin = cout
+    # the classifier head: the reference-era 4096-wide dense stack whose
+    # gradients dominate allreduce volume (VGG's claim to the table)
+    params["fc1"] = L.dense_init(next(ki), 512 * 7 * 7, 4096, dtype=dtype)
+    params["fc2"] = L.dense_init(next(ki), 4096, 4096, dtype=dtype)
+    params["head"] = L.dense_init(next(ki), 4096, classes, dtype=dtype)
+    return params
+
+
+def _trunk(params, x, depth, training, axis_name):
+    convs = STAGE_CONVS[depth]
+    out = dict(params)
+    y = x
+    for stage, n in enumerate(convs):
+        y, out[f"s{stage}c0"] = _conv_bn_apply(
+            params[f"s{stage}c0"], y, training, axis_name)
+        if n > 1:
+            def body(y, cp):
+                y2, newp = _conv_bn_apply(cp, y, training, axis_name)
+                return y2, newp
+            y, out[f"s{stage}rest"] = jax.lax.scan(
+                body, y, params[f"s{stage}rest"])
+        y = jax.lax.reduce_window(y, -jnp.inf, jax.lax.max, (1, 2, 2, 1),
+                                  (1, 2, 2, 1), "VALID")
+    return y, out
+
+
+def apply(params: Dict[str, Any], x: jax.Array, depth: int = 16,
+          training: bool = False, axis_name: Optional[str] = None
+          ) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Forward.  x: [N, 224, 224, 3] — the classifier's flatten pins the
+    resolution; use :func:`features` for any H/W divisible by 32.
+    Returns (logits, new_params) with updated BN stats when training."""
+    y, out = _trunk(params, x, depth, training, axis_name)
+    n = y.shape[0]
+    # 7x7x512 at 224 input
+    y = y.reshape(n, -1)
+    if y.shape[1] != 512 * 7 * 7:
+        raise ValueError(
+            f"classifier expects 224x224 inputs (flattened 25088, got "
+            f"{y.shape[1]}); use vgg.features() for other sizes")
+    y = jax.nn.relu(L.dense(params["fc1"], y))
+    y = jax.nn.relu(L.dense(params["fc2"], y))
+    return L.dense(params["head"], y), out
+
+
+def features(params: Dict[str, Any], x: jax.Array, depth: int = 16,
+             training: bool = False, axis_name: Optional[str] = None
+             ) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Conv trunk only -> globally pooled [N, 512] features (for smoke
+    tests and transfer heads at non-224 resolutions)."""
+    y, out = _trunk(params, x, depth, training, axis_name)
+    return jnp.mean(y, axis=(1, 2)), out
+
+
+def loss_fn(params, x, y_true, depth: int = 16, training: bool = True,
+            axis_name: Optional[str] = None):
+    logits, new_params = apply(params, x, depth=depth, training=training,
+                               axis_name=axis_name)
+    loss = jnp.mean(L.softmax_cross_entropy(logits, y_true))
+    return loss, new_params

@@ -55,9 +55,9 @@ MATRIX = [
       "--steps", "10"]),
     ("autotune", ["--autotune"]),
     # the reference's own headline rows (docs/benchmarks.rst:31-43 is
-    # resnet101 img/sec) — LAST: the unrolled conv graphs compile >25 min
-    # over the tunnel, so they must not starve the rows above; run_config
-    # gives --resnet the long leash
+    # resnet101 img/sec) — LAST until the stage-scanned model (which
+    # replaced the >25-min unrolled compile) proves its compile time on
+    # the tunnel; run_config still gives --resnet the long leash
     # "-scan10" = the stage-scanned model at --steps 10 (names encode the
     # protocol so a rename, not silent staleness, accompanies any change)
     ("resnet50-scan10", ["--resnet", "--steps", "10"]),

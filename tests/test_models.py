@@ -211,3 +211,73 @@ def test_llama_trains(hvd):
         params, opt_state, loss = step(params, opt_state, ids)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_vgg16_features_train_and_param_count():
+    """VGG-16 (reference headline family: docs/benchmarks.rst:12-13 VGG-16
+    68% scaling row): trunk trains on small inputs, BN stats thread
+    functionally, classifier param count lands in the known ~138M band."""
+    from horovod_tpu.models import vgg
+
+    key = jax.random.PRNGKey(0)
+    params = vgg.init(key, depth=16, classes=1000)
+    n = sum(int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(params))
+    assert 130e6 < n < 145e6, n  # torchvision vgg16_bn: ~138.4M
+
+    # trunk + tiny head trains at 32x32 (apply() demands 224 inputs)
+    import optax
+    small = vgg.init(key, depth=16, classes=10)
+
+    def loss(p, x, y):
+        feats, newp = vgg.features(p, x, training=True)
+        logits = feats @ p["head"]["kernel"][:512, :10]
+        return jnp.mean(
+            -jax.nn.log_softmax(logits)[jnp.arange(len(y)), y]), newp
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 32, 32, 3), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, 4))
+    (l0, newp), g = jax.value_and_grad(loss, has_aux=True)(small, x, y)
+    assert np.isfinite(float(l0))
+    # BN running stats moved in training mode
+    assert not np.allclose(
+        np.asarray(newp["s0c0"]["bn"]["mean"]),
+        np.asarray(small["s0c0"]["bn"]["mean"]))
+    # grads flow to first and last conv stages
+    assert float(jnp.abs(g["s0c0"]["conv"]["kernel"]).sum()) > 0
+    assert float(jnp.abs(g["s4rest"]["conv"]["kernel"]).sum()) > 0
+
+
+def test_vgg_apply_rejects_wrong_resolution():
+    from horovod_tpu.models import vgg
+    params = vgg.init(jax.random.PRNGKey(0), depth=16, classes=10)
+    with pytest.raises(ValueError, match="224"):
+        vgg.apply(params, jnp.zeros((1, 64, 64, 3)), depth=16)
+
+
+def test_inception_v3_forward_and_grads():
+    """Inception V3 (the reference headline family: docs/benchmarks.rst:12
+    90% scaling row): canonical ~23.8M params, forward at 299, grads flow
+    through every block type (A, reduction, C, D, E) and BN stats move."""
+    from horovod_tpu.models import inception
+
+    key = jax.random.PRNGKey(0)
+    params = inception.init(key, classes=1000)
+    n = sum(int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(params))
+    assert 22e6 < n < 26e6, n
+
+    small = inception.init(key, classes=10)
+    rng = np.random.RandomState(0)
+    # 139 keeps every VALID stage positive-sized while staying cheap
+    x = jnp.asarray(rng.randn(2, 139, 139, 3), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, 2))
+    (l0, newp), g = jax.value_and_grad(
+        inception.loss_fn, has_aux=True)(small, x, y)
+    assert np.isfinite(float(l0))
+    assert not np.allclose(np.asarray(newp["s0"]["bn"]["mean"]),
+                           np.asarray(small["s0"]["bn"]["mean"]))
+    for blk in ("a0", "b0", "c0", "d0", "e1"):
+        leaves = jax.tree_util.tree_leaves(g[blk])
+        assert sum(float(jnp.abs(l).sum()) for l in leaves) > 0, blk
