@@ -23,23 +23,17 @@ import jax.numpy as jnp
 from . import layers as L
 
 
-def _cbr_init(key, kh, kw, cin, cout, dtype):
-    return {"conv": L.conv_init(key, kh, kw, cin, cout, dtype),
-            "bn": L.batchnorm_init(cout)}
+_cbr_init = L.conv_bn_init
 
 
 def _cbr(p, x, stride, training, axis_name, padding="SAME"):
-    out = dict(p)
-    y = L.conv(p["conv"], x, stride=stride, padding=padding)
-    y, out["bn"] = L.batchnorm(p["bn"], y, training, axis_name=axis_name)
-    return jax.nn.relu(y), out
+    return L.conv_bn_relu(p, x, stride=stride, padding=padding,
+                          training=training, axis_name=axis_name)
 
 
 def _pool(x, kind, window=3, stride=1, padding="SAME"):
     if kind == "max":
-        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
-                                     (1, window, window, 1),
-                                     (1, stride, stride, 1), padding)
+        return L.maxpool(x, window=window, stride=stride, padding=padding)
     ones = (1, window, window, 1)
     s = jax.lax.reduce_window(x, 0.0, jax.lax.add, ones,
                               (1, stride, stride, 1), padding)

@@ -126,6 +126,32 @@ def batchnorm(p: Params, x: jax.Array, training: bool = False,
     return (y * p["scale"] + p["bias"]).astype(x.dtype), new_p
 
 
+def conv_bn_init(key, kh: int, kw: int, cin: int, cout: int,
+                 dtype=jnp.float32) -> Params:
+    """conv (no bias) + BN parameter pair — the CNN zoo's basic unit."""
+    return {"conv": conv_init(key, kh, kw, cin, cout, dtype),
+            "bn": batchnorm_init(cout)}
+
+
+def conv_bn_relu(p: Params, x: jax.Array, stride: int = 1,
+                 padding: str = "SAME", training: bool = False,
+                 axis_name: Optional[str] = None
+                 ) -> Tuple[jax.Array, Params]:
+    """conv -> BN -> relu with functional BN-state threading (shared by
+    vgg.py / inception.py; resnet's bottleneck places its relus itself)."""
+    out = dict(p)
+    y = conv(p["conv"], x, stride=stride, padding=padding)
+    y, out["bn"] = batchnorm(p["bn"], y, training, axis_name=axis_name)
+    return jax.nn.relu(y), out
+
+
+def maxpool(x: jax.Array, window: int = 3, stride: int = 2,
+            padding: str = "SAME") -> jax.Array:
+    return lax.reduce_window(x, -jnp.inf, lax.max,
+                             (1, window, window, 1),
+                             (1, stride, stride, 1), padding)
+
+
 # --------------------------------------------------------------------- losses
 def softmax_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """Per-position negative log-likelihood, ``logits[..., V]`` vs integer

@@ -115,8 +115,7 @@ def apply(params: Dict[str, Any], x: jax.Array, depth: int = 50,
     y, out["bn_stem"] = L.batchnorm(params["bn_stem"], y, training,
                                     axis_name=axis_name)
     y = jax.nn.relu(y)
-    y = jax.lax.reduce_window(y, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
-                              (1, 2, 2, 1), "SAME")
+    y = L.maxpool(y, window=3, stride=2, padding="SAME")
     for stage, nblocks in enumerate(blocks):
         stride = 2 if stage > 0 else 1
         y, out[f"s{stage}b0"] = _bottleneck_apply(

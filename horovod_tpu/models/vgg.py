@@ -30,16 +30,12 @@ STAGE_CONVS = {
 }
 
 
-def _conv_bn_init(key, cin: int, cout: int, dtype) -> Dict[str, Any]:
-    return {"conv": L.conv_init(key, 3, 3, cin, cout, dtype),
-            "bn": L.batchnorm_init(cout)}
+def _conv_bn_init(key, cin, cout, dtype):
+    return L.conv_bn_init(key, 3, 3, cin, cout, dtype)
 
 
 def _conv_bn_apply(p, x, training, axis_name):
-    out = dict(p)
-    y = L.conv(p["conv"], x)
-    y, out["bn"] = L.batchnorm(p["bn"], y, training, axis_name=axis_name)
-    return jax.nn.relu(y), out
+    return L.conv_bn_relu(p, x, training=training, axis_name=axis_name)
 
 
 def init(key, depth: int = 16, classes: int = 1000,
@@ -83,25 +79,24 @@ def _trunk(params, x, depth, training, axis_name):
                 return y2, newp
             y, out[f"s{stage}rest"] = jax.lax.scan(
                 body, y, params[f"s{stage}rest"])
-        y = jax.lax.reduce_window(y, -jnp.inf, jax.lax.max, (1, 2, 2, 1),
-                                  (1, 2, 2, 1), "VALID")
+        y = L.maxpool(y, window=2, stride=2, padding="VALID")
     return y, out
 
 
 def apply(params: Dict[str, Any], x: jax.Array, depth: int = 16,
           training: bool = False, axis_name: Optional[str] = None
           ) -> Tuple[jax.Array, Dict[str, Any]]:
-    """Forward.  x: [N, 224, 224, 3] — the classifier's flatten pins the
-    resolution; use :func:`features` for any H/W divisible by 32.
-    Returns (logits, new_params) with updated BN stats when training."""
+    """Forward.  x: [N, H, W, 3], any H/W divisible by 32 (224
+    canonical).  Off-canonical trunk outputs are BILINEARLY resized to
+    the classifier's 7x7 grid — same spirit as torchvision's
+    ``AdaptiveAvgPool2d((7,7))`` bridge but different weights, so ported
+    torchvision logits only match at 224.  Returns (logits, new_params)
+    with updated BN stats when training."""
     y, out = _trunk(params, x, depth, training, axis_name)
     n = y.shape[0]
-    # 7x7x512 at 224 input
-    y = y.reshape(n, -1)
-    if y.shape[1] != 512 * 7 * 7:
-        raise ValueError(
-            f"classifier expects 224x224 inputs (flattened 25088, got "
-            f"{y.shape[1]}); use vgg.features() for other sizes")
+    if y.shape[1:3] != (7, 7):  # 224 input lands on 7x7 exactly
+        y = jax.image.resize(y, (n, 7, 7, y.shape[-1]), "linear")
+    y = y.reshape(n, -1)  # [N, 25088]
     y = jax.nn.relu(L.dense(params["fc1"], y))
     y = jax.nn.relu(L.dense(params["fc2"], y))
     return L.dense(params["head"], y), out

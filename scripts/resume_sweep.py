@@ -62,6 +62,9 @@ MATRIX = [
     # protocol so a rename, not silent staleness, accompanies any change)
     ("resnet50-scan10", ["--resnet", "--steps", "10"]),
     ("resnet101-scan10", ["--resnet", "--depth", "101", "--steps", "10"]),
+    ("inception3-b64", ["--cnn", "inception3", "--batch", "64",
+                        "--steps", "10"]),
+    ("vgg16-b32", ["--cnn", "vgg16", "--batch", "32", "--steps", "10"]),
 ]
 
 
@@ -150,7 +153,8 @@ def main():
         # Mosaic (Pallas) programs and the unrolled ResNet conv graphs
         # compile much slower over the remote tunnel than the llama
         # decoder — give them a longer leash.
-        slow_compile = "--flash" in args or "--resnet" in args
+        slow_compile = any(f in args for f in ("--flash", "--resnet",
+                                               "--cnn"))
         cfg_deadline = deadline_s * 2 if slow_compile else deadline_s
         if not run_config(name, args, cfg_deadline):
             consecutive_fail += 1

@@ -249,11 +249,14 @@ def test_vgg16_features_train_and_param_count():
     assert float(jnp.abs(g["s4rest"]["conv"]["kernel"]).sum()) > 0
 
 
-def test_vgg_apply_rejects_wrong_resolution():
+def test_vgg_apply_adaptive_resolution():
+    """Off-canonical inputs hit the adaptive 7x7 classifier bridge (the
+    torchvision AdaptiveAvgPool contract) and still produce logits."""
     from horovod_tpu.models import vgg
     params = vgg.init(jax.random.PRNGKey(0), depth=16, classes=10)
-    with pytest.raises(ValueError, match="224"):
-        vgg.apply(params, jnp.zeros((1, 64, 64, 3)), depth=16)
+    logits, _ = vgg.apply(params, jnp.zeros((1, 64, 64, 3)), depth=16)
+    assert logits.shape == (1, 10)
+    assert np.all(np.isfinite(np.asarray(logits)))
 
 
 def test_inception_v3_forward_and_grads():
