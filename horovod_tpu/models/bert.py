@@ -104,10 +104,20 @@ def apply_layer(p: Dict[str, Any], x: jax.Array, cfg: BertConfig,
 def apply(params: Dict[str, Any], ids: jax.Array, cfg: BertConfig,
           type_ids: Optional[jax.Array] = None,
           pad_mask: Optional[jax.Array] = None,
-          attn_fn=None) -> jax.Array:
-    """ids: [B, S] -> MLM logits [B, S, vocab]."""
+          attn_fn=None,
+          positions: Optional[jax.Array] = None) -> jax.Array:
+    """ids: [B, S] -> MLM logits [B, S, vocab].
+
+    ``positions`` overrides the default ``arange(S)`` — required under
+    sequence parallelism, where each chip holds an S/n slice and must
+    embed its GLOBAL positions (offset by ``axis_index * S/n``)."""
+    if attn_fn is not None and pad_mask is not None:
+        raise ValueError(
+            "pad_mask is applied by the built-in attention only; a custom "
+            "attn_fn (ulysses/ring/flash) receives no mask — compose "
+            "padding handling into attn_fn or drop pad_mask")
     B, S = ids.shape
-    pos = jnp.arange(S)
+    pos = jnp.arange(S) if positions is None else positions
     x = (L.embedding(params["tok_embed"], ids)
          + L.embedding(params["pos_embed"], pos)[None])
     if type_ids is not None:
@@ -119,9 +129,16 @@ def apply(params: Dict[str, Any], ids: jax.Array, cfg: BertConfig,
 
 
 def loss_fn(params, ids, labels, cfg: BertConfig,
-            mask: Optional[jax.Array] = None) -> jax.Array:
-    """Masked-LM cross-entropy; ``mask`` selects predicted positions."""
-    logits = apply(params, ids, cfg)
+            mask: Optional[jax.Array] = None, attn_fn=None,
+            positions: Optional[jax.Array] = None) -> jax.Array:
+    """Masked-LM cross-entropy; ``mask`` selects predicted positions.
+    ``attn_fn``/``positions`` thread through to :func:`apply`.
+
+    NOTE: the masked mean here is over THIS call's positions.  Under
+    sequence parallelism the local ratio is NOT the global masked mean —
+    psum numerator and denominator separately instead (see
+    examples/jax/bert_ulysses_sp.py)."""
+    logits = apply(params, ids, cfg, attn_fn=attn_fn, positions=positions)
     nll = L.softmax_cross_entropy(logits, labels)
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -69,6 +69,12 @@ def test_ray_tf2_fit_example():
     assert "OK" in out
 
 
+def test_bert_ulysses_sequence_parallel_example():
+    out = _run("jax/bert_ulysses_sp.py", "--cpu")
+    assert "over 8 chips" in out
+    assert "OK" in out
+
+
 @pytest.mark.parametrize("relpath,args", [
     ("jax/mlp_mnist.py", ("--cpu",)),
     ("spark/spark_estimator.py", ("--cpu",)),
