@@ -82,14 +82,173 @@ def _block_attend(q, k, v, q_off, k_off, causal: bool,
     return m_new, l_new, o_new
 
 
+def _pvary_missing(t, axis_name):
+    """Mark ``t`` varying over ``axis_name`` so fori_loop carry types line
+    up when the initial value is device-invariant (newer-JAX vma typing;
+    no-op on older JAX)."""
+    if not hasattr(lax, "pvary"):
+        return t
+    axes = ((axis_name,) if isinstance(axis_name, str)
+            else tuple(axis_name))
+    vma = getattr(jax.typeof(t), "vma", frozenset())
+    missing = tuple(a for a in axes if a not in vma)
+    return lax.pvary(t, missing) if missing else t
+
+
+def _flash_ring_step(q, kk, vv, src, idx, block_q, block_k):
+    """One ring step through the Pallas flash kernel.
+
+    The k/v block now local started on chip ``src``; relative to this
+    chip's q block it is either fully visible (src < idx — plain
+    attention), diagonal (src == idx — standard causal), or fully masked
+    (src > idx — zero contribution).  Offsets are whole-shard multiples,
+    so the three cases are exact and pick the kernel's own causal flag —
+    no offset masks needed.  Returns (out [B,S,H,D] in q.dtype,
+    lse [B,H,S] fp32) for the logsumexp merge."""
+    from ..ops.flash_attention import _flash_forward
+
+    def full(_):
+        return _flash_forward(q, kk, vv, False, block_q, block_k)
+
+    def diag(_):
+        return _flash_forward(q, kk, vv, True, block_q, block_k)
+
+    def skip(_):
+        B, S, H, _D = q.shape
+        return (jnp.zeros_like(q),
+                jnp.full((B, H, S), -jnp.inf, jnp.float32))
+
+    case = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
+    return lax.switch(case, [full, diag, skip], None)
+
+
+def _lse_merge(o, lse, o2, lse2):
+    """Combine two partial attentions over disjoint key sets from their
+    (unnormalized-by-each-other) outputs and logsumexps."""
+    lse_new = jnp.logaddexp(lse, lse2)
+    # clamp the subtrahend so an all-masked (-inf) pair yields weight 0,
+    # not exp(nan)
+    safe = jnp.maximum(lse_new, -1e30)
+    w1 = jnp.exp(lse - safe).transpose(0, 2, 1)[..., None]
+    w2 = jnp.exp(lse2 - safe).transpose(0, 2, 1)[..., None]
+    return o.astype(jnp.float32) * w1 + o2.astype(jnp.float32) * w2, lse_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention_flash(q, k, v, axis_name: str,
+                          block_q: int, block_k: int) -> jax.Array:
+    """Causal ring attention with the Pallas flash kernel as the per-step
+    block attention.  GQA k/v stay at their Hkv footprint: the kernel
+    maps q-head groups onto shared kv heads itself, so the ring moves
+    1/rep of the bytes the repeat-based path would.  Differentiable: the
+    backward runs its own ring over the flash backward kernels (see
+    ``_ring_flash_bwd``)."""
+    return _ring_flash_fwd(q, k, v, axis_name, block_q, block_k)[0]
+
+
+def _ring_flash_fwd(q, k, v, axis_name, block_q, block_k):
+    n = int(lax.psum(1, axis_name))
+    idx = lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+
+    o0 = _pvary_missing(jnp.zeros_like(q, dtype=jnp.float32), axis_name)
+    lse0 = _pvary_missing(jnp.full((B, H, Sq), -jnp.inf, jnp.float32),
+                          axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(step, carry):
+        o, lse, kk, vv = carry
+        src = (idx - step) % n
+        o2, lse2 = _flash_ring_step(q, kk, vv, src, idx, block_q, block_k)
+        o, lse = _lse_merge(o, lse, o2, lse2)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return o, lse, kk, vv
+
+    o, lse, _, _ = lax.fori_loop(0, n, body, (o0, lse0, k, v))
+    out = o.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, block_q, block_k, res, g):
+    """Ring backward: one pass around the ring re-derives every block's
+    gradient contribution from the saved GLOBAL lse (the flash backward
+    kernels rebuild p = exp(s - lse) blockwise, so partial-key blocks
+    yield exactly their share of dq/dk/dv).  dq accumulates locally; the
+    dk/dv accumulators TRAVEL WITH their k/v block and arrive home after
+    n hops having collected every chip's contribution."""
+    from ..ops.flash_attention import _flash_backward
+
+    q, k, v, out, lse, = res
+    n = int(lax.psum(1, axis_name))
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def block_grads(kk, vv, src):
+        def full(_):
+            return _flash_backward(q, kk, vv, out, lse, g, False,
+                                   block_q, block_k)
+
+        def diag(_):
+            return _flash_backward(q, kk, vv, out, lse, g, True,
+                                   block_q, block_k)
+
+        def skip(_):
+            return (jnp.zeros_like(q), jnp.zeros_like(kk),
+                    jnp.zeros_like(vv))
+
+        case = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
+        return lax.switch(case, [full, diag, skip], None)
+
+    dq0 = _pvary_missing(jnp.zeros(q.shape, jnp.float32), axis_name)
+    dk0 = _pvary_missing(jnp.zeros(k.shape, jnp.float32), axis_name)
+    dv0 = _pvary_missing(jnp.zeros(v.shape, jnp.float32), axis_name)
+
+    def body(step, carry):
+        dq, kk, vv, dkk, dvv = carry
+        src = (idx - step) % n
+        dq_b, dk_b, dv_b = block_grads(kk, vv, src)
+        dq = dq + dq_b.astype(jnp.float32)
+        dkk = dkk + dk_b.astype(jnp.float32)
+        dvv = dvv + dv_b.astype(jnp.float32)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        dkk = lax.ppermute(dkk, axis_name, perm)
+        dvv = lax.ppermute(dvv, axis_name, perm)
+        return dq, kk, vv, dkk, dvv
+
+    dq, _, _, dk, dv = lax.fori_loop(0, n, body, (dq0, k, v, dk0, dv0))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _ring_flash_fwd_rule(q, k, v, axis_name, block_q, block_k):
+    out, res = _ring_flash_fwd(q, k, v, axis_name, block_q, block_k)
+    return out, res
+
+
+_ring_attention_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "sp",
-                   causal: bool = True) -> jax.Array:
+                   causal: bool = True,
+                   kernel: str = "xla",
+                   block_q: int = 256, block_k: int = 256) -> jax.Array:
     """Ring attention over a sequence-sharded batch: [B, S/n, H, D] per chip.
 
     k/v blocks travel the ring (ppermute shift +1) for n steps; each chip
     accumulates online-softmax partial attention for its query block.
-    GQA inputs (Hkv < H) are repeated up front."""
+    ``kernel='flash'`` runs each step's block attention through the
+    Pallas flash kernel (causal only; GQA k/v ride the ring unrepeated);
+    the default ``'xla'`` path repeats GQA inputs up front."""
+    if kernel == "flash":
+        if not causal:
+            raise NotImplementedError(
+                "flash ring path is causal-only (the 3-way block split "
+                "relies on it); use kernel='xla' for bidirectional")
+        return _ring_attention_flash(q, k, v, axis_name, block_q, block_k)
+    if kernel != "xla":
+        raise ValueError(f"unknown ring attention kernel {kernel!r}")
     n = int(lax.psum(1, axis_name))
     idx = lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
@@ -132,10 +291,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
-def make_ring_attn_fn(axis_name: str = "sp", causal: bool = True):
-    """attn_fn hook for the model zoo (models/llama.py apply(attn_fn=...))."""
+def make_ring_attn_fn(axis_name: str = "sp", causal: bool = True,
+                      kernel: str = "xla",
+                      block_q: int = 256, block_k: int = 256):
+    """attn_fn hook for the model zoo (models/llama.py apply(attn_fn=...));
+    ``kernel='flash'`` uses the Pallas kernel per ring step."""
     return functools.partial(ring_attention, axis_name=axis_name,
-                             causal=causal)
+                             causal=causal, kernel=kernel,
+                             block_q=block_q, block_k=block_k)
 
 
 def make_ulysses_attn_fn(axis_name: str = "sp", causal: bool = True):
