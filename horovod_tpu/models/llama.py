@@ -106,7 +106,7 @@ def init(key, cfg: LlamaConfig) -> Dict[str, Any]:
 
 def _attn(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig,
           cos: jax.Array, sin: jax.Array,
-          attn_fn=None) -> jax.Array:
+          attn_fn=None, pos_offset=0) -> jax.Array:
     B, S, _ = x.shape
     nq, nkv = cfg.n_heads * cfg.head_dim, cfg.n_kv_heads * cfg.head_dim
     fuse = cfg.fuse_proj and not any(
@@ -121,8 +121,8 @@ def _attn(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig,
     q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
     k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-    q = L.apply_rope(q, cos, sin)
-    k = L.apply_rope(k, cos, sin)
+    q = L.apply_rope(q, cos, sin, offset=pos_offset)
+    k = L.apply_rope(k, cos, sin, offset=pos_offset)
     if attn_fn is None:
         o = L.causal_attention(q, k, v, causal=True)
     else:
@@ -144,21 +144,27 @@ def _ffn(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig) -> jax.Array:
 
 def apply_layer(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig,
                 cos: jax.Array, sin: jax.Array,
-                attn_fn=None) -> jax.Array:
-    x = x + _attn(p, L.rmsnorm(p["attn_norm"], x), cfg, cos, sin, attn_fn)
+                attn_fn=None, pos_offset=0) -> jax.Array:
+    x = x + _attn(p, L.rmsnorm(p["attn_norm"], x), cfg, cos, sin, attn_fn,
+                  pos_offset)
     x = x + _ffn(p, L.rmsnorm(p["ffn_norm"], x), cfg)
     return x
 
 
 def apply(params: Dict[str, Any], ids: jax.Array, cfg: LlamaConfig,
           attn_fn=None, remat: bool = False,
-          act_sharding=None, return_hidden: bool = False) -> jax.Array:
+          act_sharding=None, return_hidden: bool = False,
+          pos_offset=0) -> jax.Array:
     """Forward: token ids [B, S] -> logits [B, S, vocab] (or the final-norm
     hidden states [B, S, dim] with ``return_hidden=True``, for chunked-loss
     callers that apply the lm_head themselves).
 
     ``remat=True`` wraps each layer in jax.checkpoint — rematerialization
     trades FLOPs for HBM, the standard TPU memory lever.
+
+    ``pos_offset`` (int or traced scalar) shifts RoPE positions — under
+    sequence parallelism each chip passes its global slice offset
+    (``axis_index * S_shard``).
 
     ``act_sharding`` (a NamedSharding for the [B, S, D] residual stream)
     pins activations between layers, e.g. batch-sharded over (dp, fsdp) and
@@ -179,7 +185,7 @@ def apply(params: Dict[str, Any], ids: jax.Array, cfg: LlamaConfig,
         layer = jax.checkpoint(apply_layer, static_argnums=(2, 5))
 
     for p in params["layers"]:
-        x = pin(layer(p, x, cfg, cos, sin, attn_fn))
+        x = pin(layer(p, x, cfg, cos, sin, attn_fn, pos_offset))
     x = L.rmsnorm(params["final_norm"], x)
     if return_hidden:
         return x

@@ -75,6 +75,12 @@ def test_bert_ulysses_sequence_parallel_example():
     assert "OK" in out
 
 
+def test_llama_ring_longcontext_example():
+    out = _run("jax/llama_ring_longcontext.py", "--cpu")
+    assert "flash ring" in out
+    assert "OK" in out
+
+
 @pytest.mark.parametrize("relpath,args", [
     ("jax/mlp_mnist.py", ("--cpu",)),
     ("spark/spark_estimator.py", ("--cpu",)),
