@@ -43,7 +43,9 @@ _GRID_SEMANTICS = pltpu.CompilerParams(
 
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
                  block_k: int, seq_len: int, scale: float):
-    # q_ref: [BQ, D]; k_ref/v_ref: [S, D]; o_ref: [BQ, D]; lse_ref: [BQ]
+    # q_ref: [BQ, D]; k_ref/v_ref: [S, D]; o_ref: [BQ, D]; lse_ref: [BQ, 1]
+    # (the trailing unit lane dim keeps the row-statistic blocks legal for
+    # Mosaic's last-two-dims tiling rule; callers see lse as [B, H, S])
     #
     # MXU dtype discipline: matmul OPERANDS stay in the input dtype (the
     # MXU runs bf16 x bf16 -> fp32 at full rate; upcasting operands to
@@ -102,13 +104,13 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
     o_ref[:] = (acc / l).astype(o_ref.dtype)
     # logsumexp of the SCALED scores — the backward kernels rebuild
     # p = exp(s - lse) from it without re-running the online softmax.
-    lse_ref[:] = (m + jnp.log(l))[:, 0]
+    lse_ref[:] = m + jnp.log(l)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, *, causal: bool, block_k: int, seq_len: int,
                    scale: float):
-    # q/do/dq: [BQ, D]; k/v: [S, D]; lse/delta: [BQ]
+    # q/do/dq: [BQ, D]; k/v: [S, D]; lse/delta: [BQ, 1]
     qi = pl.program_id(2)
     bq = q_ref.shape[0]
     d = q_ref.shape[1]
@@ -117,8 +119,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # the end).
     q = q_ref[:]
     do = do_ref[:]
-    lse = lse_ref[:].astype(jnp.float32)[:, None]
-    delta = delta_ref[:].astype(jnp.float32)[:, None]
+    lse = lse_ref[:].astype(jnp.float32)
+    delta = delta_ref[:].astype(jnp.float32)
 
     q_start = qi * bq
     num_kb = pl.cdiv(seq_len, block_k)
@@ -158,7 +160,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, causal: bool, block_q: int,
                     seq_len: int, scale: float):
-    # k/v/dk/dv: [BK, D]; q/do: [S, D]; lse/delta: [S]
+    # k/v/dk/dv: [BK, D]; q/do: [S, D]; lse/delta: [S, 1]
     ki = pl.program_id(2)
     bk = k_ref.shape[0]
     d = k_ref.shape[1]
@@ -180,9 +182,8 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         q_start = qb * block_q
         q = q_ref[pl.ds(q_start, block_q), :]
         do = do_ref[pl.ds(q_start, block_q), :]
-        lse = lse_ref[pl.ds(q_start, block_q)].astype(jnp.float32)[:, None]
-        delta = delta_ref[pl.ds(q_start, block_q)].astype(
-            jnp.float32)[:, None]
+        lse = lse_ref[pl.ds(q_start, block_q), :].astype(jnp.float32)
+        delta = delta_ref[pl.ds(q_start, block_q), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if masked:
@@ -291,17 +292,17 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array,
         out_specs=[
             pl.BlockSpec((None, None, block_q, D),
                          lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((None, None, block_q),
-                         lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((None, None, block_q, 1),
+                         lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
         ],
         compiler_params=_GRID_SEMANTICS,
         interpret=interpret,
     )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2), lse
+    return jnp.swapaxes(out, 1, 2), lse[..., 0]
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -325,17 +326,20 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool = True,
     # before the group summation below.
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    # delta_i = sum_d dO_i * O_i  (the softmax-jacobian row correction)
+    # delta_i = sum_d dO_i * O_i  (the softmax-jacobian row correction);
+    # row statistics carry a trailing unit lane dim for Mosaic tiling
     delta = jnp.sum(do.astype(jnp.float32) * ot.astype(jnp.float32),
-                    axis=-1)
+                    axis=-1, keepdims=True)
+    lse = lse[..., None]
 
     qspec = pl.BlockSpec((None, None, block_q, D),
                          lambda b, h, i: (b, h, i, 0))
     kvfull = pl.BlockSpec((None, None, S, D),
                           lambda b, h, i, g=group: (b, h // g, 0, 0))
     qfull = pl.BlockSpec((None, None, S, D), lambda b, h, i: (b, h, 0, 0))
-    rowq = pl.BlockSpec((None, None, block_q), lambda b, h, i: (b, h, i))
-    rowfull = pl.BlockSpec((None, None, S), lambda b, h, i: (b, h, 0))
+    rowq = pl.BlockSpec((None, None, block_q, 1),
+                        lambda b, h, i: (b, h, i, 0))
+    rowfull = pl.BlockSpec((None, None, S, 1), lambda b, h, i: (b, h, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, block_k=block_k,
