@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""CI pipeline generator — the TPU-native analog of the reference's
+matrix generator (reference: .buildkite/gen-pipeline.sh, golden-tested by
+test/single/test_buildkite.py against expected_buildkite_pipeline.yaml).
+
+The reference varies a baseline docker image one dimension at a time
+(python x framework-versions x {gloo,openmpi,mpich,oneccl} x {cpu,gpu})
+and emits a Buildkite YAML.  Here the axes that exist on a TPU-native
+stack are different — there is ONE data-plane backend (XLA collectives)
+and no docker matrix — so the generated pipeline varies:
+
+  * frontend suites (jax core / native controller / torch / tf / keras /
+    mxnet-shim / spark+ray contract fakes / data+checkpoint+elastic),
+    each an independent step so CI fans out;
+  * runtime knobs, one dimension at a time off the baseline
+    (hierarchical allreduce, response-cache off, stream-pool width,
+    donation off, negotiated TF join) on exactly the suites that consume
+    the knob;
+  * process topology: the integration tier under the real launcher at
+    np=2 and np=4, and the 8-device multi-chip dryrun.
+
+Usage:
+  python scripts/gen_ci.py            # rewrite .ci/pipeline.yaml
+  python scripts/gen_ci.py --check    # exit 1 if the committed file is stale
+
+The golden test (tests/test_ci_pipeline.py) regenerates the pipeline and
+compares it to the committed file, and cross-checks every HOROVOD_* env
+var against the knob registry and every pytest target against the tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, ".ci", "pipeline.yaml")
+
+# Suite groups: label -> pytest files (relative to repo root).  Grouped so
+# each step is big enough to amortize interpreter+jax startup but small
+# enough to pinpoint a red area from the step name alone.
+SUITES = {
+    "jax-core": [
+        "tests/test_basics.py", "tests/test_collectives.py",
+        "tests/test_optimizer.py", "tests/test_fsdp.py",
+        "tests/test_zero.py", "tests/test_adasum.py",
+        "tests/test_hierarchical.py",
+    ],
+    "models-kernels": [
+        "tests/test_models.py", "tests/test_flash_attention.py",
+        "tests/test_sequence_parallel.py", "tests/test_pipeline.py",
+        "tests/test_expert.py",
+    ],
+    "native-controller": [
+        "tests/test_native_core.py", "tests/test_negotiated.py",
+        "tests/test_autotune.py", "tests/test_aux.py",
+    ],
+    "torch": ["tests/test_torch.py"],
+    "tensorflow-keras": ["tests/test_tensorflow.py", "tests/test_keras.py"],
+    "mxnet-shim": ["tests/test_mxnet.py"],
+    "cluster": [
+        "tests/test_spark_ray.py", "tests/test_spark_estimator_depth.py",
+        "tests/test_real_backend_fakes.py", "tests/test_runner.py",
+        "tests/test_ci_pipeline.py",
+    ],
+    "state-elastic-data": [
+        "tests/test_data.py", "tests/test_checkpoint.py",
+        "tests/test_elastic.py",
+    ],
+    "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py"],
+}
+
+# Knob variations: (dimension-label, {env}, suite labels to re-run).
+# One dimension at a time off the baseline, on the suites that consume the
+# knob — the reference's vary-the-baseline pattern.
+KNOB_DIMS = [
+    ("hierarchical", {"HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+                      "HOROVOD_HIERARCHICAL_ALLGATHER": "1"},
+     ["jax-core"]),
+    ("cache-off", {"HOROVOD_CACHE_CAPACITY": "0"},
+     ["native-controller"]),
+    ("streams-4", {"HOROVOD_NUM_STREAMS": "4"},
+     ["torch"]),
+    ("no-donate", {"HOROVOD_TPU_DONATE_BUFFERS": "0"},
+     ["jax-core"]),
+    ("tf-join", {"HOROVOD_TF_JOIN": "1"},
+     ["tensorflow-keras"]),
+]
+
+
+def _step(label, command, env=None, timeout=30):
+    s = {"label": label, "command": command,
+         "timeout_in_minutes": timeout}
+    if env:
+        s["env"] = dict(sorted(env.items()))
+    return s
+
+
+def build_steps():
+    py = "python"
+    steps = []
+    for name, files in SUITES.items():
+        steps.append(_step(
+            f"unit: {name}",
+            f"{py} -m pytest {' '.join(files)} -q"))
+    for dim, env, suites in KNOB_DIMS:
+        for name in suites:
+            steps.append(_step(
+                f"knob {dim}: {name}",
+                f"{py} -m pytest {' '.join(SUITES[name])} -q", env=env))
+    steps.append(_step(
+        "integration: real launcher np=2/np=4",
+        f"{py} -m pytest tests/integration -q", timeout=45))
+    steps.append(_step(
+        "dryrun: 8-chip multichip shardings",
+        f'{py} -c "import __graft_entry__ as g; g.dryrun_multichip(8)"',
+        env={"JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        timeout=20))
+    steps.append(_step(
+        "bench: cpu smoke",
+        f"{py} bench.py --cpu", timeout=15))
+    return steps
+
+
+def validate(steps):
+    """Every pytest target must exist — a renamed test file must break the
+    generator, not silently shrink CI."""
+    for s in steps:
+        for tok in s["command"].split():
+            if tok in ("tests/integration", "bench.py") or (
+                    tok.startswith("tests/") and tok.endswith(".py")):
+                if not os.path.exists(os.path.join(REPO, tok)):
+                    raise FileNotFoundError(
+                        f"step '{s['label']}' references missing {tok}")
+    dirs = [t for s in steps for t in s["command"].split()
+            if t == "tests/integration"]
+    assert dirs, "integration tier missing from pipeline"
+    assert os.path.exists(os.path.join(REPO, "__graft_entry__.py")), \
+        "dryrun step target __graft_entry__.py missing"
+
+
+def render(steps) -> str:
+    """Hand-rendered YAML: deterministic byte-for-byte output (a yaml-lib
+    version bump must not dirty the golden file)."""
+    lines = ["# Generated by scripts/gen_ci.py — do not edit by hand.",
+             "# Regenerate: python scripts/gen_ci.py", "steps:"]
+    for s in steps:
+        lines.append(f"  - label: {_q(s['label'])}")
+        lines.append(f"    command: {_q(s['command'])}")
+        lines.append(f"    timeout_in_minutes: {s['timeout_in_minutes']}")
+        if "env" in s:
+            lines.append("    env:")
+            for k, v in s["env"].items():
+                lines.append(f"      {k}: {_q(v)}")
+    return "\n".join(lines) + "\n"
+
+
+def _q(v: str) -> str:
+    return '"' + str(v).replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="verify the committed pipeline is current")
+    args = ap.parse_args()
+    steps = build_steps()
+    validate(steps)
+    text = render(steps)
+    if args.check:
+        if not os.path.exists(OUT):
+            print(f"{OUT} missing; run scripts/gen_ci.py", file=sys.stderr)
+            return 1
+        with open(OUT) as f:
+            if f.read() != text:
+                print(f"{OUT} is stale; run scripts/gen_ci.py",
+                      file=sys.stderr)
+                return 1
+        print("pipeline up to date")
+        return 0
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        f.write(text)
+    print(f"wrote {OUT} ({len(steps)} steps)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

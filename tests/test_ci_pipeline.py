@@ -1,0 +1,63 @@
+"""Golden test of the CI pipeline generator (reference strategy:
+test/single/test_buildkite.py compares gen-pipeline.sh output to
+test/single/data/expected_buildkite_pipeline.yaml).
+
+Three properties:
+  * the committed .ci/pipeline.yaml matches a fresh generation — editing
+    the matrix without regenerating fails CI itself;
+  * every HOROVOD_* env var any step sets is a registered knob — the
+    pipeline can't drift from the config system (docs/knobs.md);
+  * every unit-tier test file in the tree is covered by some step — a new
+    test file that no CI step runs is a silent coverage hole.
+"""
+
+import glob
+import importlib.util
+import os
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_gen():
+    spec = importlib.util.spec_from_file_location(
+        "gen_ci", os.path.join(REPO, "scripts", "gen_ci.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_committed_pipeline_is_current():
+    gen = _load_gen()
+    steps = gen.build_steps()
+    gen.validate(steps)
+    with open(os.path.join(REPO, ".ci", "pipeline.yaml")) as f:
+        committed = f.read()
+    assert committed == gen.render(steps), \
+        "stale .ci/pipeline.yaml — run: python scripts/gen_ci.py"
+
+
+def test_pipeline_parses_and_env_vars_are_registered_knobs():
+    from horovod_tpu.common import knobs
+    with open(os.path.join(REPO, ".ci", "pipeline.yaml")) as f:
+        doc = yaml.safe_load(f)
+    assert isinstance(doc["steps"], list) and len(doc["steps"]) >= 10
+    for step in doc["steps"]:
+        assert step["label"] and step["command"]
+        assert step["timeout_in_minutes"] > 0
+        for k in step.get("env", {}):
+            if k.startswith("HOROVOD_"):
+                assert k in knobs.KNOBS, \
+                    f"step '{step['label']}' sets unregistered knob {k}"
+
+
+def test_every_unit_test_file_is_scheduled():
+    gen = _load_gen()
+    scheduled = {t for s in gen.build_steps()
+                 for t in s["command"].split()
+                 if t.startswith("tests/") and t.endswith(".py")}
+    on_disk = {os.path.relpath(p, REPO)
+               for p in glob.glob(os.path.join(REPO, "tests", "test_*.py"))}
+    missing = on_disk - scheduled
+    assert not missing, f"test files no CI step runs: {sorted(missing)}"
