@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Summarize a jax.profiler trace: where does the step time go?
+
+The reference's perf-observability story is the Horovod timeline
+(reference: horovod/common/timeline.{h,cc}) plus NVTX op ranges; this
+framework emits those (utils/timeline.py, utils/profiler.py) AND the
+XLA-level truth via ``jax.profiler.trace`` (``bench.py --profile DIR``).
+This script turns the trace's device timeline into the table a human
+needs: per-op total time, share of device-busy time, and a category
+rollup (matmul / elementwise-fusion / data movement / collectives /
+pallas custom calls) — the TPU analog of reading nvprof output.
+
+Usage:
+  python bench.py --profile /tmp/prof            # capture
+  python scripts/analyze_profile.py /tmp/prof    # analyze
+  python scripts/analyze_profile.py /tmp/prof --top 40 --csv out.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+# category -> regexes over XLA op/fusion names (first match wins, in order)
+CATEGORIES = [
+    ("pallas/custom", re.compile(r"custom-call|pallas|mosaic|_attn_kernel|"
+                                 r"_bwd_d(q|kv)_kernel", re.I)),
+    ("collective", re.compile(r"all-reduce|all-gather|reduce-scatter|"
+                              r"all-to-all|collective-permute|psum", re.I)),
+    # 'convolution', not 'conv': XLA's 'convert' (dtype cast) ops must not
+    # land in the matmul bucket
+    ("matmul/conv", re.compile(r"dot|convolution", re.I)),
+    ("data-movement", re.compile(r"copy|transpose|reshape|bitcast|"
+                                 r"dynamic-slice|dynamic-update-slice|"
+                                 r"gather|scatter|pad|concatenate", re.I)),
+    ("infeed/outfeed", re.compile(r"infeed|outfeed|transfer", re.I)),
+    ("elementwise/fusion", re.compile(r"fusion|loop|wrapped|add|multiply|"
+                                      r"tanh|exp|log|select|compare|reduce",
+                                      re.I)),
+]
+
+
+def find_trace(path: str) -> str:
+    """Accept a trace .json.gz file, a profile session dir, or the DIR
+    passed to ``bench.py --profile`` (newest session wins)."""
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(
+        os.path.join(path, "plugins", "profile", "*", "*.trace.json.gz")))
+    hits = hits or sorted(glob.glob(os.path.join(path, "*.trace.json.gz")))
+    if not hits:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {path} — was the profile captured "
+            "with jax.profiler.trace / bench.py --profile?")
+    return hits[-1]
+
+
+def load_events(trace_file: str):
+    with gzip.open(trace_file, "rt") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", [])
+    pid_names = {e["pid"]: e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"
+                 and "name" in e.get("args", {})}
+    return events, pid_names
+
+
+def device_pids(pid_names) -> set:
+    """Device planes: TPU/GPU planes when present, else the host-CPU
+    device plane (CPU-backend traces).  Python-thread planes never count."""
+    dev = {p for p, n in pid_names.items()
+           if "/device:" in n or n.startswith("/tpu")}
+    if not dev:
+        dev = {p for p, n in pid_names.items() if n.startswith("/host:")}
+    return dev
+
+
+_HOST_FRAME = re.compile(r"^(\$|PjitFunction|PjRt|PyClient|ExecuteSharded)")
+
+
+def summarize(events, pids):
+    per_op = collections.defaultdict(lambda: [0.0, 0])  # name -> [us, count]
+    t0, t1 = float("inf"), 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in pids:
+            continue
+        # host-plane fallback (CPU traces) carries python-frame events
+        # ("$file.py:123 fn") and runtime dispatch frames; only XLA
+        # executable activity counts
+        if _HOST_FRAME.match(e["name"]):
+            continue
+        dur = float(e.get("dur", 0.0))
+        ts = float(e.get("ts", 0.0))
+        per_op[e["name"]][0] += dur
+        per_op[e["name"]][1] += 1
+        t0 = min(t0, ts)
+        t1 = max(t1, ts + dur)
+    busy = sum(us for us, _ in per_op.values())
+    span = max(0.0, t1 - t0) if per_op else 0.0
+    return per_op, busy, span
+
+
+def categorize(name: str) -> str:
+    for cat, rx in CATEGORIES:
+        if rx.search(name):
+            return cat
+    return "other"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", help="profile dir or .trace.json.gz file")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--csv", default=None,
+                    help="also write the full per-op table as CSV")
+    args = ap.parse_args()
+
+    trace_file = find_trace(args.path)
+    events, pid_names = load_events(trace_file)
+    pids = device_pids(pid_names)
+    if not pids:
+        print(f"no device planes in {trace_file}; planes: "
+              f"{sorted(pid_names.values())}", file=sys.stderr)
+        return 1
+    per_op, busy_us, span_us = summarize(events, pids)
+    if not per_op or busy_us <= 0.0:
+        print("no timed device events in trace", file=sys.stderr)
+        return 1
+
+    planes = ", ".join(sorted(pid_names[p] for p in pids))
+    print(f"trace:  {trace_file}")
+    print(f"planes: {planes}")
+    print(f"device busy {busy_us / 1e3:.2f} ms over a {span_us / 1e3:.2f} ms "
+          f"span ({100 * busy_us / span_us if span_us else 0:.0f}% occupied)")
+
+    cats = collections.defaultdict(float)
+    for name, (us, _) in per_op.items():
+        cats[categorize(name)] += us
+    print("\nby category:")
+    for cat, us in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print(f"  {cat:<20} {us / 1e3:>10.2f} ms  {100 * us / busy_us:5.1f}%")
+
+    rows = sorted(per_op.items(), key=lambda kv: -kv[1][0])
+    print(f"\ntop {min(args.top, len(rows))} ops:")
+    print(f"  {'ms':>10} {'%':>6} {'count':>6}  op")
+    for name, (us, cnt) in rows[:args.top]:
+        print(f"  {us / 1e3:>10.2f} {100 * us / busy_us:>6.1f} {cnt:>6}  "
+              f"{name[:90]}")
+
+    if args.csv:
+        with open(args.csv, "w") as f:
+            f.write("op,category,total_ms,count\n")
+            for name, (us, cnt) in rows:
+                safe = name.replace('"', "'")
+                f.write(f'"{safe}",{categorize(name)},{us / 1e3:.3f},{cnt}\n')
+        print(f"\nwrote {args.csv} ({len(rows)} ops)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

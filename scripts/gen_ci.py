@@ -68,7 +68,8 @@ SUITES = {
         "tests/test_data.py", "tests/test_checkpoint.py",
         "tests/test_elastic.py",
     ],
-    "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py"],
+    "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py",
+                       "tests/test_profile_analyzer.py"],
 }
 
 # Knob variations: (dimension-label, {env}, suite labels to re-run).

@@ -1,0 +1,78 @@
+"""The profile analyzer (scripts/analyze_profile.py) against a real
+jax.profiler capture — the XLA-level observability tool beside the
+Horovod-style timeline (reference perf story: timeline.{h,cc} + NVTX
+ranges; here the device-truth comes from the jax profiler)."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "analyze_profile",
+        os.path.join(REPO, "scripts", "analyze_profile.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def trace_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("prof"))
+    x = jnp.asarray(np.random.RandomState(0).randn(256, 256), jnp.float32)
+    f = jax.jit(lambda x: jnp.tanh(x @ x) @ x)
+    f(x).block_until_ready()  # compile outside the capture
+    with jax.profiler.trace(d):
+        r = f(x)
+        for _ in range(4):
+            r = f(r)
+        r.block_until_ready()
+    return d
+
+
+def test_finds_and_aggregates_device_ops(trace_dir):
+    ap = _load()
+    trace_file = ap.find_trace(trace_dir)
+    events, pid_names = ap.load_events(trace_file)
+    pids = ap.device_pids(pid_names)
+    assert pids
+    per_op, busy_us, span_us = ap.summarize(events, pids)
+    assert busy_us > 0 and span_us > 0
+    # the jitted program is two matmuls + tanh: a dot op must dominate
+    names = " ".join(per_op)
+    assert "dot" in names, names
+    top = max(per_op.items(), key=lambda kv: kv[1][0])
+    assert ap.categorize(top[0]) == "matmul/conv", top
+    # python-frame events from the host plane are excluded
+    assert not any(n.startswith("$") for n in per_op)
+
+
+def test_categorize_tpu_op_names():
+    ap = _load()
+    assert ap.categorize("fusion.123") == "elementwise/fusion"
+    assert ap.categorize("all-reduce.7") == "collective"
+    assert ap.categorize("custom-call _attn_kernel") == "pallas/custom"
+    assert ap.categorize("copy-start.2") == "data-movement"
+    assert ap.categorize("rng-bit-generator") == "other"
+
+
+def test_cli_end_to_end(trace_dir, tmp_path):
+    csv = str(tmp_path / "ops.csv")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "analyze_profile.py"),
+         trace_dir, "--top", "5", "--csv", csv],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "by category:" in proc.stdout and "top" in proc.stdout
+    with open(csv) as f:
+        header = f.readline().strip()
+    assert header == "op,category,total_ms,count"
