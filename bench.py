@@ -271,6 +271,12 @@ def main() -> int:
                          "custom shapes; 0 = use --model's config)")
     ap.add_argument("--layers", type=int, default=0)
     ap.add_argument("--ffn", type=int, default=0)
+    ap.add_argument("--score-dtype", default="f32",
+                    choices=["f32", "input"],
+                    help="dtype the attention score tensor materializes "
+                         "in (XLA attention path): f32 keeps full logit "
+                         "precision, 'input' halves the score-slab HBM "
+                         "traffic for bf16 models")
     ap.add_argument("--flash", action="store_true",
                     help="use the pallas flash-attention kernel (forward "
                          "is ~1.3x XLA's, but compiling it inside the "
@@ -361,6 +367,10 @@ def main() -> int:
         attn_fn = functools.partial(flash_attention,
                                     block_q=args.block_q,
                                     block_k=args.block_k)
+    elif args.score_dtype == "input":
+        import functools
+        from horovod_tpu.models import layers as L
+        attn_fn = functools.partial(L.causal_attention, score_dtype=None)
 
     # --remat uses the model's PER-LAYER checkpointing (the standard TPU
     # memory lever); whole-loss jax.checkpoint wouldn't reduce the peak.

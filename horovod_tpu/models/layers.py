@@ -191,7 +191,8 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
 
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      causal: bool = True,
-                     mask: Optional[jax.Array] = None) -> jax.Array:
+                     mask: Optional[jax.Array] = None,
+                     score_dtype: Optional[Any] = jnp.float32) -> jax.Array:
     """Multi-head attention core.  q: [B, S, H, D]; k/v: [B, S, Hkv, D]
     (grouped-query when Hkv < H).  Softmax in fp32 for stability; einsum
     contractions land on the MXU.
@@ -199,27 +200,34 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Grouped-query heads are handled by folding the group into a batched
     einsum dimension rather than ``jnp.repeat``-ing k/v: no duplicated
     k/v buffers in the forward and no scatter-add un-repeat in their
-    backward — the einsum's reduction over the group does it natively."""
+    backward — the einsum's reduction over the group does it natively.
+
+    ``score_dtype`` is the dtype the [.., S, S] score tensor MATERIALIZES
+    in — the largest activation at long seq.  jnp.float32 (default)
+    keeps every logit bit the MXU accumulated; ``None`` stores scores in
+    the input dtype (half the score HBM traffic for bf16 models — the
+    softmax still runs fp32 on the upcast inside one fused pass, so only
+    one bf16 rounding of the logits is introduced)."""
     B, S, H, D = q.shape
     Sk = k.shape[1]
     Hkv = k.shape[2]
     rep = H // Hkv
     scale = 1.0 / math.sqrt(D)
     qg = q.reshape(B, S, Hkv, rep, D)
-    # preferred_element_type=fp32: the MXU accumulates in fp32 anyway; ask
-    # for fp32 out directly instead of materializing a bf16 score tensor
-    # and upcasting it in a second pass.
+    sdt = q.dtype if score_dtype is None else score_dtype
     logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k,
-                        preferred_element_type=jnp.float32) * scale
+                        preferred_element_type=sdt) * jnp.asarray(scale, sdt)
     if causal:
         causal_mask = jnp.tril(jnp.ones((S, Sk), jnp.bool_), k=Sk - S)
-        logits = jnp.where(causal_mask[None, None, None], logits, -1e30)
+        logits = jnp.where(causal_mask[None, None, None], logits,
+                           jnp.asarray(-1e30, sdt))
     if mask is not None:
         # user masks address [B?, H, Sq, Sk]; expose the grouped logits in
         # that layout, mask, and re-group
         lg = logits.reshape(B, H, S, Sk)
-        lg = jnp.where(mask, lg, -1e30)
+        lg = jnp.where(mask, lg, jnp.asarray(-1e30, sdt))
         logits = lg.reshape(B, Hkv, rep, S, Sk)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32),
+                           axis=-1).astype(q.dtype)
     o = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v)
     return o.reshape(B, S, H, D)

@@ -20,12 +20,14 @@ from __future__ import annotations
 
 import argparse
 import collections
+import csv
 import glob
 import gzip
 import json
 import os
 import re
 import sys
+from typing import Optional
 
 # category -> regexes over XLA op/fusion names (first match wins, in order)
 CATEGORIES = [
@@ -68,7 +70,10 @@ def load_events(trace_file: str):
     pid_names = {e["pid"]: e["args"]["name"] for e in events
                  if e.get("ph") == "M" and e.get("name") == "process_name"
                  and "name" in e.get("args", {})}
-    return events, pid_names
+    tid_names = {(e["pid"], e["tid"]): e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"
+                 and "name" in e.get("args", {})}
+    return events, pid_names, tid_names
 
 
 def device_pids(pid_names) -> set:
@@ -81,14 +86,29 @@ def device_pids(pid_names) -> set:
     return dev
 
 
-_HOST_FRAME = re.compile(r"^(\$|PjitFunction|PjRt|PyClient|ExecuteSharded)")
+_HOST_FRAME = re.compile(
+    r"^(\$|end: |PjitFunction|PjRt|PyClient|ExecuteSharded|ParseArguments|"
+    r"Handle inputs|CommonPjRt|ThreadpoolListener|TransferTo|CopyTo)")
 
 
-def summarize(events, pids):
+def op_tids(events, pids, tid_names) -> Optional[set]:
+    """Device planes carry sibling thread lines ('XLA Modules', 'Steps')
+    whose envelope events span the op events — summing the whole plane
+    double-counts.  Restrict to the 'XLA Ops' lines when any exist;
+    return None (no tid filter) for planes without named op lines (CPU
+    fallback traces)."""
+    ops = {(p, t) for (p, t), n in tid_names.items()
+           if p in pids and "XLA Ops" in n}
+    return ops or None
+
+
+def summarize(events, pids, tids=None):
     per_op = collections.defaultdict(lambda: [0.0, 0])  # name -> [us, count]
     t0, t1 = float("inf"), 0.0
     for e in events:
         if e.get("ph") != "X" or e.get("pid") not in pids:
+            continue
+        if tids is not None and (e["pid"], e.get("tid")) not in tids:
             continue
         # host-plane fallback (CPU traces) carries python-frame events
         # ("$file.py:123 fn") and runtime dispatch frames; only XLA
@@ -122,22 +142,25 @@ def main() -> int:
     args = ap.parse_args()
 
     trace_file = find_trace(args.path)
-    events, pid_names = load_events(trace_file)
+    events, pid_names, tid_names = load_events(trace_file)
     pids = device_pids(pid_names)
     if not pids:
         print(f"no device planes in {trace_file}; planes: "
               f"{sorted(pid_names.values())}", file=sys.stderr)
         return 1
-    per_op, busy_us, span_us = summarize(events, pids)
+    per_op, busy_us, span_us = summarize(events, pids,
+                                         op_tids(events, pids, tid_names))
     if not per_op or busy_us <= 0.0:
         print("no timed device events in trace", file=sys.stderr)
         return 1
 
     planes = ", ".join(sorted(pid_names[p] for p in pids))
+    denom = span_us * len(pids)
     print(f"trace:  {trace_file}")
     print(f"planes: {planes}")
     print(f"device busy {busy_us / 1e3:.2f} ms over a {span_us / 1e3:.2f} ms "
-          f"span ({100 * busy_us / span_us if span_us else 0:.0f}% occupied)")
+          f"span ({100 * busy_us / denom if denom else 0:.0f}% occupied "
+          f"per core)")
 
     cats = collections.defaultdict(float)
     for name, (us, _) in per_op.items():
@@ -154,11 +177,11 @@ def main() -> int:
               f"{name[:90]}")
 
     if args.csv:
-        with open(args.csv, "w") as f:
-            f.write("op,category,total_ms,count\n")
+        with open(args.csv, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["op", "category", "total_ms", "count"])
             for name, (us, cnt) in rows:
-                safe = name.replace('"', "'")
-                f.write(f'"{safe}",{categorize(name)},{us / 1e3:.3f},{cnt}\n')
+                w.writerow([name, categorize(name), f"{us / 1e3:.3f}", cnt])
         print(f"\nwrote {args.csv} ({len(rows)} ops)")
     return 0
 

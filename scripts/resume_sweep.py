@@ -30,6 +30,8 @@ OUT = os.path.join(REPO, "sweep_results.jsonl")
 # "mxu" rows re-measure the flash kernel AFTER the input-dtype fix
 # (operands were upcast fp32 pre-matmul before; fixed 2026-07-31).
 MATRIX = [
+    # bf16 score-slab control: is the fp32 score tensor the r3 regression?
+    ("score-input-dtype", ["--score-dtype", "input", "--steps", "30"]),
     ("flash-mxu-default", ["--flash", "--steps", "30"]),
     ("flash-mxu-bq512", ["--flash", "--block-q", "512", "--block-k", "512",
                          "--steps", "30"]),

@@ -169,3 +169,28 @@ def test_backward_in_jitted_train_step():
         losses.append(float(l))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_score_dtype_input_matches_f32():
+    """score_dtype=None stores the score slab in the input dtype (half
+    the HBM traffic for bf16); numerics must stay within one bf16
+    rounding of the fp32-score path, and the fp32-input path must be
+    bit-identical (input dtype IS fp32 there)."""
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, 64, 4, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 64, 2, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 64, 2, 16), jnp.float32)
+    ref = L.causal_attention(q, k, v, causal=True)
+    same = L.causal_attention(q, k, v, causal=True, score_dtype=None)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(same))
+
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ref_b = L.causal_attention(qb, kb, vb, causal=True)
+    got_b = L.causal_attention(qb, kb, vb, causal=True, score_dtype=None)
+    np.testing.assert_allclose(
+        np.asarray(ref_b, np.float32), np.asarray(got_b, np.float32),
+        atol=3e-2, rtol=3e-2)
+    # differentiable in both modes
+    g = jax.grad(lambda q: jnp.sum(L.causal_attention(
+        q, kb, vb, causal=True, score_dtype=None) ** 2))(qb)
+    assert np.all(np.isfinite(np.asarray(g, np.float32)))

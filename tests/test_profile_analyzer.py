@@ -42,18 +42,21 @@ def trace_dir(tmp_path_factory):
 def test_finds_and_aggregates_device_ops(trace_dir):
     ap = _load()
     trace_file = ap.find_trace(trace_dir)
-    events, pid_names = ap.load_events(trace_file)
+    events, pid_names, tid_names = ap.load_events(trace_file)
     pids = ap.device_pids(pid_names)
     assert pids
-    per_op, busy_us, span_us = ap.summarize(events, pids)
+    per_op, busy_us, span_us = ap.summarize(
+        events, pids, ap.op_tids(events, pids, tid_names))
     assert busy_us > 0 and span_us > 0
     # the jitted program is two matmuls + tanh: a dot op must dominate
     names = " ".join(per_op)
     assert "dot" in names, names
     top = max(per_op.items(), key=lambda kv: kv[1][0])
     assert ap.categorize(top[0]) == "matmul/conv", top
-    # python-frame events from the host plane are excluded
-    assert not any(n.startswith("$") for n in per_op)
+    # python-frame / runtime-dispatch / envelope events are excluded
+    for n in per_op:
+        assert not n.startswith(("$", "end: ", "PjitFunction", "PjRt",
+                                 "ThreadpoolListener")), n
 
 
 def test_categorize_tpu_op_names():
