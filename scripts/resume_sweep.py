@@ -32,41 +32,45 @@ OUT = os.path.join(REPO, "sweep_results.jsonl")
 MATRIX = [
     # bf16 score-slab control: is the fp32 score tensor the r3 regression?
     ("score-input-dtype", ["--score-dtype", "input", "--steps", "30"]),
-    ("flash-mxu-default", ["--flash", "--steps", "30"]),
-    ("flash-mxu-bq512", ["--flash", "--block-q", "512", "--block-k", "512",
-                         "--steps", "30"]),
-    ("flash-mxu-ce8", ["--flash", "--ce-chunks", "8", "--steps", "30"]),
+    ("nofuse-control", ["--no-fuse", "--steps", "30"]),
+    ("nofuse-score-input", ["--no-fuse", "--score-dtype", "input",
+                            "--steps", "30"]),
+    # diagnostic: same token count, 1/4 the attention share — locates the
+    # non-matmul time if MFU jumps
+    ("seq256-b64", ["--seq", "256", "--batch", "64", "--steps", "30"]),
+    ("batch-20", ["--batch", "20", "--steps", "30"]),
     ("llama1b-b8-remat-ce8",
      ["--model", "1b", "--batch", "8", "--remat", "--ce-chunks", "8",
       "--steps", "10"]),
+    ("seq2048-b8-ce8",
+     ["--seq", "2048", "--batch", "8", "--ce-chunks", "8", "--steps", "10"]),
+    ("llama1b-b4-remat-ce8",
+     ["--model", "1b", "--batch", "4", "--remat", "--ce-chunks", "8",
+      "--steps", "10"]),
+    ("autotune", ["--autotune"]),
+    # the reference's own headline rows (docs/benchmarks.rst:31-43 is
+    # resnet101 img/sec); "-scan10" = the stage-scanned model at
+    # --steps 10 (names encode the protocol so a rename, not silent
+    # staleness, accompanies any change)
+    ("resnet50-scan10", ["--resnet", "--steps", "10"]),
+    ("resnet101-scan10", ["--resnet", "--depth", "101", "--steps", "10"]),
+    ("inception3-b64", ["--cnn", "inception3", "--batch", "64",
+                        "--steps", "10"]),
+    ("vgg16-b32", ["--cnn", "vgg16", "--batch", "32", "--steps", "10"]),
+    # Pallas (Mosaic) programs compile 45+ min over the remote tunnel and
+    # each block-size variant recompiles — flash rows run LAST with the
+    # doubled leash so a timeout can't starve the cheap rows above; one
+    # completed compile lands in the persistent cache for repeats.
+    ("flash-mxu-default", ["--flash", "--steps", "30"]),
+    ("flash-mxu-ce8", ["--flash", "--ce-chunks", "8", "--steps", "30"]),
+    ("flash-mxu-bq512", ["--flash", "--block-q", "512", "--block-k", "512",
+                         "--steps", "30"]),
     ("llama1b-b8-remat-ce8-flash",
      ["--model", "1b", "--batch", "8", "--remat", "--ce-chunks", "8",
       "--flash", "--steps", "10"]),
     ("seq2048-b8-ce8-flash",
      ["--seq", "2048", "--batch", "8", "--ce-chunks", "8", "--flash",
       "--steps", "10"]),
-    ("seq2048-b8-ce8",
-     ["--seq", "2048", "--batch", "8", "--ce-chunks", "8", "--steps", "10"]),
-    # diagnostic: same token count, 1/4 the attention share — locates the
-    # non-matmul time if MFU jumps
-    ("seq256-b64", ["--seq", "256", "--batch", "64", "--steps", "30"]),
-    ("nofuse-control", ["--no-fuse", "--steps", "30"]),
-    ("batch-20", ["--batch", "20", "--steps", "30"]),
-    ("llama1b-b4-remat-ce8",
-     ["--model", "1b", "--batch", "4", "--remat", "--ce-chunks", "8",
-      "--steps", "10"]),
-    ("autotune", ["--autotune"]),
-    # the reference's own headline rows (docs/benchmarks.rst:31-43 is
-    # resnet101 img/sec) — LAST until the stage-scanned model (which
-    # replaced the >25-min unrolled compile) proves its compile time on
-    # the tunnel; run_config still gives --resnet the long leash
-    # "-scan10" = the stage-scanned model at --steps 10 (names encode the
-    # protocol so a rename, not silent staleness, accompanies any change)
-    ("resnet50-scan10", ["--resnet", "--steps", "10"]),
-    ("resnet101-scan10", ["--resnet", "--depth", "101", "--steps", "10"]),
-    ("inception3-b64", ["--cnn", "inception3", "--batch", "64",
-                        "--steps", "10"]),
-    ("vgg16-b32", ["--cnn", "vgg16", "--batch", "32", "--steps", "10"]),
 ]
 
 
