@@ -258,10 +258,14 @@ def main() -> int:
                          "1656.82 img/s 16-GPU headline row exactly")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize the forward pass (bigger batches)")
+    ap.add_argument("--fuse", action="store_true",
+                    help="enable the fused qkv/gate-up projections "
+                         "(measured SLOWER than unfused on v5e: 0.423 vs "
+                         "0.437 MFU, sweep_results.jsonl fused-default vs "
+                         "default-b16 — so the bench default is unfused)")
     ap.add_argument("--no-fuse", action="store_true",
-                    help="disable the fused qkv/gate-up projections "
-                         "(the bench enables fusion for every llama size; "
-                         "the library default is off)")
+                    help="back-compat no-op: unfused is the default; "
+                         "kept so recorded sweep configs stay runnable")
     ap.add_argument("--ce-chunks", type=int, default=0,
                     help="stream the lm_head+cross-entropy over N sequence "
                          "chunks under jax.checkpoint (0 = whole-sequence "
@@ -338,7 +342,7 @@ def main() -> int:
         dtype=jnp.bfloat16)
     import dataclasses
     cfg = dataclasses.replace(cfgs[args.model],
-                              fuse_proj=not args.no_fuse)
+                              fuse_proj=args.fuse and not args.no_fuse)
     if args.dim:
         cfg = dataclasses.replace(
             cfg, dim=args.dim,
@@ -361,6 +365,12 @@ def main() -> int:
     # Pallas flash attention on TPU (ops/flash_attention.py): blockwise
     # online softmax on the MXU, ~1.3x the XLA attention at seq 1024.
     attn_fn = None
+    if args.flash and not args.cpu and args.score_dtype == "input":
+        # The flash kernel never materializes a score tensor, so the two
+        # flags cannot combine; labeling such a row "input" would record
+        # a measurement of nothing (ADVICE r3).
+        print("--score-dtype input is ignored under --flash (the kernel "
+              "has no score tensor)", file=sys.stderr)
     if args.flash and not args.cpu:
         import functools
         from horovod_tpu.ops.flash_attention import flash_attention

@@ -20,9 +20,8 @@ def main():
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.cpu:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
-            " --xla_force_host_platform_device_count=8"
+        from horovod_tpu.utils.platform import force_cpu
+        force_cpu(virtual_chips=8)  # binds jax config; env var alone loses
 
     import numpy as np
     import jax

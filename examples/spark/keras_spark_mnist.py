@@ -42,7 +42,8 @@ def main():
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.cpu:
-        os.environ["JAX_PLATFORMS"] = "cpu"
+        from horovod_tpu.utils.platform import force_cpu
+        force_cpu()  # env var alone loses to the site-customized jax config
     os.environ.setdefault("KERAS_BACKEND", "jax")
 
     import tempfile

@@ -29,7 +29,8 @@ def main():
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.cpu:
-        os.environ["JAX_PLATFORMS"] = "cpu"
+        from horovod_tpu.utils.platform import force_cpu
+        force_cpu()  # env var alone loses to the site-customized jax config
     # force, not setdefault: tf.keras IS Keras 3 here and obeys
     # KERAS_BACKEND — an inherited =jax would silently break TF training
     os.environ["KERAS_BACKEND"] = "tensorflow"

@@ -215,17 +215,21 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     scale = 1.0 / math.sqrt(D)
     qg = q.reshape(B, S, Hkv, rep, D)
     sdt = q.dtype if score_dtype is None else score_dtype
+    # Masked positions fill with the score dtype's own minimum: -1e30
+    # overflows to -inf in float16 (5-bit exponent), and a fully-masked
+    # row of -inf softmaxes to NaN where the fp32-score path stayed
+    # finite.  finfo.min is representable by construction in every dtype.
+    fill = jnp.asarray(jnp.finfo(sdt).min, sdt)
     logits = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k,
                         preferred_element_type=sdt) * jnp.asarray(scale, sdt)
     if causal:
         causal_mask = jnp.tril(jnp.ones((S, Sk), jnp.bool_), k=Sk - S)
-        logits = jnp.where(causal_mask[None, None, None], logits,
-                           jnp.asarray(-1e30, sdt))
+        logits = jnp.where(causal_mask[None, None, None], logits, fill)
     if mask is not None:
         # user masks address [B?, H, Sq, Sk]; expose the grouped logits in
         # that layout, mask, and re-group
         lg = logits.reshape(B, H, S, Sk)
-        lg = jnp.where(mask, lg, jnp.asarray(-1e30, sdt))
+        lg = jnp.where(mask, lg, fill)
         logits = lg.reshape(B, Hkv, rep, S, Sk)
     probs = jax.nn.softmax(logits.astype(jnp.float32),
                            axis=-1).astype(q.dtype)

@@ -1,0 +1,82 @@
+"""Deterministic CPU-platform forcing for smoke modes and tests.
+
+On TPU images a site customization force-registers the hardware backend
+by updating the ``jax_platforms`` *config*, which takes precedence over
+the ``JAX_PLATFORMS`` environment variable.  A script that only sets the
+env var therefore still lands on the hardware backend — and with the
+device tunnel down, backend init blocks for many minutes with no
+interruptible point (round-3 post-mortem: a 900 s example-test timeout).
+
+``force_cpu()`` sets BOTH the env var (inherited by spawned workers,
+rescued by ``Runtime.__init__``) and the jax config (wins in THIS
+process even against site customization).  Call it before any other
+jax-touching import (keras, flax, ...).
+
+Reference analog: the reference pins devices per process via
+``CUDA_VISIBLE_DEVICES`` at spawn time (horovod/runner/gloo_run.py);
+on TPU the equivalent per-process pinning must go through jax's config
+because env alone does not bind the backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(virtual_chips: int | None = None) -> None:
+    """Force this process (and spawned children) onto the CPU backend.
+
+    ``virtual_chips`` additionally requests N virtual CPU devices via
+    XLA's host-platform device-count flag (the smoke-mode mesh every
+    example uses); an existing device-count flag in ``XLA_FLAGS`` wins,
+    so launcher-provided settings are never clobbered.
+
+    Safe to call multiple times; raises RuntimeError if a non-CPU
+    backend was already initialized (the caller ran too late to be a
+    CPU-only process — surfacing that beats hanging on a dead tunnel).
+    """
+    if virtual_chips:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{virtual_chips}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # For THIS process the config update below is what binds the backend
+    # (site customization already ran at interpreter start); popping the
+    # customization's trigger var protects CHILD processes, which would
+    # otherwise re-register the hardware backend at their own start.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+
+    if jax.config.jax_platforms != "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as e:  # backends already initialized
+            raise RuntimeError(
+                "force_cpu() called after a non-cpu jax backend "
+                "initialized; call it before any jax-touching import"
+            ) from e
+
+
+def apply_env_platform() -> None:
+    """Make the jax config match an EXPLICIT ``JAX_PLATFORMS`` env var.
+
+    Spawned workers inherit the parent's env but not its jax config; on
+    an image whose site customization pins the config to hardware, the
+    inherited env var alone is dead weight.  Task-entry shims (spark
+    runner, launcher exec paths) call this BEFORE unpickling the user
+    fn, because unpickling imports the fn's module — which may import
+    keras/flax and initialize the wrong backend.  No-op when the env var
+    is unset (hardware runs stay untouched).
+    """
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if not plat:
+        return
+    import jax
+
+    if jax.config.jax_platforms != plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass  # backends already up; Runtime.__init__ will warn

@@ -104,7 +104,11 @@ def op_tids(events, pids, tid_names) -> Optional[set]:
 
 def summarize(events, pids, tids=None):
     per_op = collections.defaultdict(lambda: [0.0, 0])  # name -> [us, count]
-    t0, t1 = float("inf"), 0.0
+    # Span is tracked PER PLANE and summed: planes start/stop at different
+    # times (e.g. a late-created device plane), and one global
+    # [min ts, max ts] window times len(pids) would understate occupancy
+    # on every plane that wasn't alive for the whole window.
+    plane_t = {}  # pid -> [t0, t1]
     for e in events:
         if e.get("ph") != "X" or e.get("pid") not in pids:
             continue
@@ -119,10 +123,11 @@ def summarize(events, pids, tids=None):
         ts = float(e.get("ts", 0.0))
         per_op[e["name"]][0] += dur
         per_op[e["name"]][1] += 1
-        t0 = min(t0, ts)
-        t1 = max(t1, ts + dur)
+        w = plane_t.setdefault(e["pid"], [ts, ts + dur])
+        w[0] = min(w[0], ts)
+        w[1] = max(w[1], ts + dur)
     busy = sum(us for us, _ in per_op.values())
-    span = max(0.0, t1 - t0) if per_op else 0.0
+    span = sum(max(0.0, t1 - t0) for t0, t1 in plane_t.values())
     return per_op, busy, span
 
 
@@ -155,12 +160,12 @@ def main() -> int:
         return 1
 
     planes = ", ".join(sorted(pid_names[p] for p in pids))
-    denom = span_us * len(pids)
+    denom = span_us  # already summed per plane (see summarize)
     print(f"trace:  {trace_file}")
     print(f"planes: {planes}")
-    print(f"device busy {busy_us / 1e3:.2f} ms over a {span_us / 1e3:.2f} ms "
-          f"span ({100 * busy_us / denom if denom else 0:.0f}% occupied "
-          f"per core)")
+    print(f"device busy {busy_us / 1e3:.2f} ms over {span_us / 1e3:.2f} ms "
+          f"of summed per-plane span ({100 * busy_us / denom if denom else 0:.0f}% "
+          f"occupied per core)")
 
     cats = collections.defaultdict(float)
     for name, (us, _) in per_op.items():

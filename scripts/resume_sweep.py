@@ -30,23 +30,31 @@ OUT = os.path.join(REPO, "sweep_results.jsonl")
 # "mxu" rows re-measure the flash kernel AFTER the input-dtype fix
 # (operands were upcast fp32 pre-matmul before; fixed 2026-07-31).
 MATRIX = [
-    # bf16 score-slab control: is the fp32 score tensor the r3 regression?
-    ("score-input-dtype", ["--score-dtype", "input", "--steps", "30"]),
+    # 2x2 fusion x score-dtype A/B (r3 regression hypothesis: the fp32
+    # [B,H,S,S] score slab).  bench.py's default flipped to UNFUSED on
+    # 2026-07-31 (measurements: fused-default 0.423 < default-b16 0.437),
+    # so the fused rows now pin --fuse explicitly.
+    ("score-input-dtype", ["--fuse", "--score-dtype", "input",
+                           "--steps", "30"]),
     ("nofuse-control", ["--no-fuse", "--steps", "30"]),
     ("nofuse-score-input", ["--no-fuse", "--score-dtype", "input",
                             "--steps", "30"]),
     # diagnostic: same token count, 1/4 the attention share — locates the
-    # non-matmul time if MFU jumps
-    ("seq256-b64", ["--seq", "256", "--batch", "64", "--steps", "30"]),
-    ("batch-20", ["--batch", "20", "--steps", "30"]),
+    # non-matmul time if MFU jumps.  All rows pin --no-fuse explicitly so
+    # their protocol no longer depends on bench.py's default (none of
+    # these had a valid recorded line before the default flip).
+    ("seq256-b64", ["--no-fuse", "--seq", "256", "--batch", "64",
+                    "--steps", "30"]),
+    ("batch-20", ["--no-fuse", "--batch", "20", "--steps", "30"]),
     ("llama1b-b8-remat-ce8",
-     ["--model", "1b", "--batch", "8", "--remat", "--ce-chunks", "8",
-      "--steps", "10"]),
+     ["--no-fuse", "--model", "1b", "--batch", "8", "--remat",
+      "--ce-chunks", "8", "--steps", "10"]),
     ("seq2048-b8-ce8",
-     ["--seq", "2048", "--batch", "8", "--ce-chunks", "8", "--steps", "10"]),
-    ("llama1b-b4-remat-ce8",
-     ["--model", "1b", "--batch", "4", "--remat", "--ce-chunks", "8",
+     ["--no-fuse", "--seq", "2048", "--batch", "8", "--ce-chunks", "8",
       "--steps", "10"]),
+    ("llama1b-b4-remat-ce8",
+     ["--no-fuse", "--model", "1b", "--batch", "4", "--remat",
+      "--ce-chunks", "8", "--steps", "10"]),
     ("autotune", ["--autotune"]),
     # the reference's own headline rows (docs/benchmarks.rst:31-43 is
     # resnet101 img/sec); "-scan10" = the stage-scanned model at
@@ -61,16 +69,17 @@ MATRIX = [
     # each block-size variant recompiles — flash rows run LAST with the
     # doubled leash so a timeout can't starve the cheap rows above; one
     # completed compile lands in the persistent cache for repeats.
-    ("flash-mxu-default", ["--flash", "--steps", "30"]),
-    ("flash-mxu-ce8", ["--flash", "--ce-chunks", "8", "--steps", "30"]),
-    ("flash-mxu-bq512", ["--flash", "--block-q", "512", "--block-k", "512",
-                         "--steps", "30"]),
+    ("flash-mxu-default", ["--no-fuse", "--flash", "--steps", "30"]),
+    ("flash-mxu-ce8", ["--no-fuse", "--flash", "--ce-chunks", "8",
+                       "--steps", "30"]),
+    ("flash-mxu-bq512", ["--no-fuse", "--flash", "--block-q", "512",
+                         "--block-k", "512", "--steps", "30"]),
     ("llama1b-b8-remat-ce8-flash",
-     ["--model", "1b", "--batch", "8", "--remat", "--ce-chunks", "8",
-      "--flash", "--steps", "10"]),
+     ["--no-fuse", "--model", "1b", "--batch", "8", "--remat",
+      "--ce-chunks", "8", "--flash", "--steps", "10"]),
     ("seq2048-b8-ce8-flash",
-     ["--seq", "2048", "--batch", "8", "--ce-chunks", "8", "--flash",
-      "--steps", "10"]),
+     ["--no-fuse", "--seq", "2048", "--batch", "8", "--ce-chunks", "8",
+      "--flash", "--steps", "10"]),
 ]
 
 

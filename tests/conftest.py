@@ -14,6 +14,13 @@ import os
 # environment may point JAX_PLATFORMS at real TPU hardware, which tests must
 # never touch.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# TPU-image site customization registers the hardware backend (and wins
+# over the env var) only when its trigger env var is present.  Strip it so
+# EVERY subprocess a test spawns — examples, launcher workers, estimator
+# tasks — is deterministically CPU even if it imports keras before
+# hvd.init(); with the tunnel down those processes otherwise hang minutes
+# in backend init (round-3 judged failure: spark keras example, 900 s).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 # Keras 3's backend is process-global and fixed at first keras import; pin
 # it for the whole suite so collection order can't flip it (the TF
 # frontend's suite runs in its own subprocess with backend=tensorflow).

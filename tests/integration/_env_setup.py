@@ -11,6 +11,10 @@ Each worker process drives 4 virtual CPU chips; with -np 2 the mesh is
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Disarm the TPU-image site customization for this worker and anything it
+# spawns (it only registers the hardware backend when this var is set, and
+# its config update beats JAX_PLATFORMS — see tests/conftest.py).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -194,3 +194,19 @@ def test_score_dtype_input_matches_f32():
     g = jax.grad(lambda q: jnp.sum(L.causal_attention(
         q, kb, vb, causal=True, score_dtype=None) ** 2))(qb)
     assert np.all(np.isfinite(np.asarray(g, np.float32)))
+
+
+def test_score_dtype_f16_fully_masked_row_finite():
+    """float16's 5-bit exponent overflows a -1e30 mask fill to -inf, and a
+    fully-masked row then softmaxes to NaN; the fill must be dtype-aware
+    (finfo.min).  A user mask that blanks one query row entirely is the
+    trigger (ADVICE r3)."""
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(1, 8, 2, 16), jnp.float16)
+    k = jnp.asarray(rng.randn(1, 8, 1, 16), jnp.float16)
+    v = jnp.asarray(rng.randn(1, 8, 1, 16), jnp.float16)
+    mask = np.ones((1, 2, 8, 8), bool)
+    mask[:, :, 3, :] = False  # query row 3 sees nothing
+    out = L.causal_attention(q, k, v, causal=False,
+                             mask=jnp.asarray(mask), score_dtype=None)
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))
