@@ -5,11 +5,14 @@ persists the DataFrame as parquet in the Store, trains inside
 horovod-on-spark workers with petastorm readers, checkpoints per epoch,
 and returns a Model transformer.
 
-TPU-native reshape: data arrives as a column dict (or a pyspark DataFrame
-when pyspark is present — converted via toPandas), training runs through
-``horovod_tpu.spark.run`` on any TaskExecutor, workers read their shard
-with ParquetDataLoader, rank 0 checkpoints to the Store each epoch, and
-``fit`` returns a KerasModel/TorchModel wrapper exposing ``transform``.
+TPU-native reshape: data arrives as a pyspark DataFrame (prepared as a
+DISTRIBUTED partition-parallel parquet write — spark/prepare.py), an
+iterator of column-dict chunks (streamed through the driver with bounded
+memory), or an in-memory column dict; training runs through
+``horovod_tpu.spark.run`` on any TaskExecutor, workers STREAM their
+shard row-group by row-group (StreamingParquetDataLoader), rank 0
+checkpoints to the Store each epoch, and ``fit`` returns a
+KerasModel/TorchModel wrapper exposing ``transform``.
 """
 
 from __future__ import annotations
@@ -21,52 +24,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..data.loader import ParquetDataLoader
+from ..data.loader import ParquetDataLoader, StreamingParquetDataLoader
+from .prepare import _as_columns, _split_validation, prepare_data
 from .runner import TaskExecutor, run as spark_run
 from .store import Store
-
-
-def _as_columns(df, feature_cols=None, label_cols=None, extra_cols=()
-                ) -> Dict[str, np.ndarray]:
-    """Accept a column dict, or a pyspark/pandas DataFrame.  With no column
-    lists, ALL columns convert (transform() must not drop id/label columns
-    the caller wants to keep alongside predictions)."""
-    if isinstance(df, dict):
-        return {k: np.asarray(v) for k, v in df.items()}
-    if hasattr(df, "toPandas"):  # pyspark DataFrame
-        df = df.toPandas()
-    cols = (list(feature_cols or []) + list(label_cols or []) +
-            list(extra_cols)) or list(df.columns)
-    return {c: np.stack(df[c].to_numpy()) for c in cols}
-
-
-def _split_validation(cols: Dict[str, np.ndarray], validation,
-                      seed: int = 0):
-    """Split a column dict into (train, val) following the reference's
-    ``validation`` param (common/params.py): a float in (0, 1) holds out a
-    random fraction; a string names a boolean column marking val rows
-    (the column itself is dropped from both splits).  Returns val=None
-    when no validation was requested or the split came out empty."""
-    if not validation:
-        return cols, None
-    if isinstance(validation, str):
-        if validation not in cols:
-            raise ValueError(f"validation column {validation!r} not in "
-                             f"columns {sorted(cols)}")
-        mask = np.asarray(cols[validation]).astype(bool).ravel()
-        base = {k: np.asarray(v) for k, v in cols.items()
-                if k != validation}
-    else:
-        frac = float(validation)
-        if not 0.0 < frac < 1.0:
-            raise ValueError(f"validation fraction must be in (0,1), got "
-                             f"{frac}")
-        n = len(next(iter(cols.values())))
-        mask = np.random.RandomState(seed).rand(n) < frac
-        base = {k: np.asarray(v) for k, v in cols.items()}
-    train = {k: v[~mask] for k, v in base.items()}
-    val = {k: v[mask] for k, v in base.items()}
-    return train, (val if mask.any() else None)
 
 
 # ---------------------------------------------------------------------------
@@ -124,14 +85,14 @@ def _load_epoch_checkpoint(store: Store, run_id: str) -> Optional[Dict]:
 
 def _eval_metrics(predict: Callable, val_path: Optional[str],
                   feature_cols, label_cols, metrics, batch_size: int,
-                  rank: int, size: int, sync) -> Dict[str, float]:
+                  rank: int, size: int, sync, fs=None) -> Dict[str, float]:
     """Per-epoch validation metrics over the (sharded) val dataset.  The
     cross-worker combine is exact: Average(weighted sums)/Average(counts)
     equals the global weighted mean regardless of shard imbalance."""
     if val_path is None or not metrics:
         return {}
     loader = ParquetDataLoader(val_path, batch_size, rank=rank,
-                               num_workers=size)
+                               num_workers=size, fs=fs)
     sums = np.zeros((len(metrics) + 1,), np.float64)
     for batch in loader:
         x, y = _assemble_batch(batch, feature_cols, label_cols)
@@ -175,7 +136,7 @@ def _epoch_driver(store: Store, run_id: str, epochs: int, metrics,
         history.setdefault("train_loss", []).append(train_epoch(epoch))
         for k, v in _eval_metrics(predict, val_path, feature_cols,
                                   label_cols, metrics, batch_size, rank,
-                                  size, sync).items():
+                                  size, sync, fs=store.fs).items():
             history.setdefault(k, []).append(v)
         if rank == 0:
             _save_epoch_checkpoint(store, run_id, epoch, serialize(),
@@ -284,19 +245,16 @@ class Estimator:
 
         ``elastic=True`` routes the job through :func:`run_elastic` —
         task failures shrink the worker set (down to ``min_np``) and
-        training resumes from the last epoch checkpoint."""
-        extra = (self.validation,) if isinstance(self.validation, str) \
-            else ()
-        cols = _as_columns(df, self.feature_cols, self.label_cols,
-                           extra_cols=extra)
-        train_cols, val_cols = _split_validation(cols, self.validation,
-                                                 self.seed)
-        train_path = self.store.write_parquet(
-            self.store.get_train_data_path(self.run_id), train_cols)
-        val_path = None
-        if val_cols is not None:
-            val_path = self.store.write_parquet(
-                self.store.get_val_data_path(self.run_id), val_cols)
+        training resumes from the last epoch checkpoint.
+
+        ``df`` may be a pyspark DataFrame (prepared partition-parallel on
+        the executors — the driver never materializes it), an iterator of
+        column-dict chunks (streamed, bounded driver memory), or an
+        in-memory column dict / pandas DataFrame (one-shot write)."""
+        train_path, val_path = prepare_data(
+            self.store, df, self.feature_cols, self.label_cols,
+            validation=self.validation, seed=self.seed,
+            run_id=self.run_id)
         return self._fit_on_paths(train_path, val_path, elastic=elastic,
                                   min_np=min_np, reset_limit=reset_limit)
 
@@ -446,8 +404,9 @@ class _SGDTrainTask:
         rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
         size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
         sync = _grad_sync_fn()
-        loader = ParquetDataLoader(train_path, self.batch_size,
-                                   rank=rank, num_workers=size)
+        loader = StreamingParquetDataLoader(train_path, self.batch_size,
+                                            rank=rank, num_workers=size,
+                                            fs=self.store.fs)
         first = next(iter(loader))
         x, y = _assemble_batch(first, self.feature_cols, self.label_cols)
         state = {"w": np.zeros((x.shape[1], y.shape[1]), np.float64),
@@ -607,8 +566,9 @@ class _TorchTrainTask:
         rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
         size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
         sync = _grad_sync_fn()
-        loader = ParquetDataLoader(train_path, self.batch_size,
-                                   rank=rank, num_workers=size)
+        loader = StreamingParquetDataLoader(train_path, self.batch_size,
+                                            rank=rank, num_workers=size,
+                                            fs=self.store.fs)
         model = self.model_fn()
         opt = (self.optimizer_fn(model.parameters()) if self.optimizer_fn
                else torch.optim.SGD(model.parameters(), lr=self.lr))
@@ -644,7 +604,7 @@ class _TorchTrainTask:
                 if size > 1:
                     _torch_sync_grads(model, sync)
                 opt.step()
-                epoch_loss += float(loss)
+                epoch_loss += float(loss.detach())
                 nb += 1
             return epoch_loss / max(nb, 1)
 
@@ -678,8 +638,9 @@ class _KerasTrainTask:
         rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
         size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
         sync = _grad_sync_fn()
-        loader = ParquetDataLoader(train_path, self.batch_size,
-                                   rank=rank, num_workers=size)
+        loader = StreamingParquetDataLoader(train_path, self.batch_size,
+                                            rank=rank, num_workers=size,
+                                            fs=self.store.fs)
         model = self.model_fn()
         # ``loss`` passes straight to compile: keras resolves names and
         # callables the same way (reference: keras estimator's loss param).

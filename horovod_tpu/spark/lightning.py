@@ -24,7 +24,7 @@ from typing import Callable
 
 import numpy as np
 
-from ..data.loader import ParquetDataLoader
+from ..data.loader import StreamingParquetDataLoader
 from .estimator import (Estimator, _assemble_batch, _epoch_driver,
                         _grad_sync_fn, _torch_eval_predict,
                         _torch_predict_fn, _torch_sync_grads,
@@ -135,8 +135,9 @@ class _LightningTrainTask:
         rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
         size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
         sync = _grad_sync_fn()
-        loader = ParquetDataLoader(train_path, self.batch_size,
-                                   rank=rank, num_workers=size)
+        loader = StreamingParquetDataLoader(train_path, self.batch_size,
+                                            rank=rank, num_workers=size,
+                                            fs=self.store.fs)
         module = self.model_fn()
         opt, sched_cfg = _first_optimizer(module.configure_optimizers())
         sched, interval, freq = sched_cfg or (None, "epoch", 1)

@@ -1,0 +1,210 @@
+"""Data prepare: DataFrame/chunks -> partitioned parquet in the Store.
+
+Reference: horovod/spark/common/util.py prepare_data — the reference
+writes the training DataFrame as a DISTRIBUTED Spark job (each partition
+becomes parquet written by its executor) and workers stream it back with
+petastorm readers; the driver never materializes the dataset.
+
+Three input shapes, one output contract (a ``part-NNNNN.parquet``
+dataset per split, readable by any of the parquet loaders):
+
+* a pyspark DataFrame (anything with ``.rdd``): partition-parallel —
+  ``rdd.mapPartitionsWithIndex`` runs :class:`_PartitionWriter` on the
+  executors, each writing its own part files straight to the Store
+  (namespaced part numbers, no coordination);
+* an iterator/generator of column-dict chunks: the driver streams
+  chunk-by-chunk through a part writer — bounded memory for datasets
+  bigger than driver RAM;
+* an in-memory column dict / pandas DataFrame: split + one-shot write
+  (small-data path, semantics identical to the pre-partitioned
+  estimator).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .store import FilesystemStore, Store
+
+
+def _as_columns(df, feature_cols=None, label_cols=None, extra_cols=()
+                ) -> Dict[str, np.ndarray]:
+    """Accept a column dict, or a pyspark/pandas DataFrame.  With no column
+    lists, ALL columns convert (transform() must not drop id/label columns
+    the caller wants to keep alongside predictions)."""
+    if isinstance(df, dict):
+        return {k: np.asarray(v) for k, v in df.items()}
+    if hasattr(df, "toPandas"):  # pyspark DataFrame (transform-time only)
+        df = df.toPandas()
+    cols = (list(feature_cols or []) + list(label_cols or []) +
+            list(extra_cols)) or list(df.columns)
+    return {c: np.stack(df[c].to_numpy()) for c in cols}
+
+
+def _split_validation(cols: Dict[str, np.ndarray], validation,
+                      seed: int = 0):
+    """Split a column dict into (train, val) following the reference's
+    ``validation`` param (common/params.py): a float in (0, 1) holds out a
+    random fraction; a string names a boolean column marking val rows
+    (the column itself is dropped from both splits).  Returns val=None
+    when no validation was requested or the split came out empty."""
+    if not validation:
+        return cols, None
+    if isinstance(validation, str):
+        if validation not in cols:
+            raise ValueError(f"validation column {validation!r} not in "
+                             f"columns {sorted(cols)}")
+        mask = np.asarray(cols[validation]).astype(bool).ravel()
+        base = {k: np.asarray(v) for k, v in cols.items()
+                if k != validation}
+    else:
+        frac = float(validation)
+        if not 0.0 < frac < 1.0:
+            raise ValueError(f"validation fraction must be in (0,1), got "
+                             f"{frac}")
+        n = len(next(iter(cols.values())))
+        mask = np.random.RandomState(seed).rand(n) < frac
+        base = {k: np.asarray(v) for k, v in cols.items()}
+    train = {k: v[~mask] for k, v in base.items()}
+    val = {k: v[mask] for k, v in base.items()}
+    return train, (val if mask.any() else None)
+
+
+def _row_to_dict(row) -> Dict:
+    """A pyspark Row (has asDict) or a plain mapping."""
+    if hasattr(row, "asDict"):
+        return row.asDict()
+    return dict(row)
+
+
+def _write_split_chunk(tw, vw, cols: Dict[str, np.ndarray], columns,
+                       validation, seed: int) -> Tuple[int, int]:
+    """Select columns, split train/val, append non-empty parts; returns
+    (train_rows, val_rows).  The ONE chunk-level write both the
+    partition-parallel and chunk-iterator prepare paths share."""
+    if columns:
+        cols = {c: cols[c] for c in columns}
+    tr, va = _split_validation(cols, validation, seed=seed)
+    t = len(next(iter(tr.values())))
+    v = 0
+    if t:
+        tw.write(tr)
+    if va is not None:
+        v = len(next(iter(va.values())))
+        vw.write(va)
+    return t, v
+
+
+class _PartitionWriter:
+    """Picklable per-partition prepare task: buffer rows, split
+    train/val, flush every ``chunk_rows`` as a part file.  Part numbers
+    are namespaced by partition index (ParquetPartWriter.base_index), so
+    N executors append to the same dataset without coordination —
+    the reference's distributed Spark write, minus petastorm."""
+
+    def __init__(self, store: FilesystemStore, train_path: str,
+                 val_path: str, columns: List[str], validation, seed: int,
+                 chunk_rows: int):
+        self.store = store
+        self.train_path = train_path
+        self.val_path = val_path
+        self.columns = columns
+        self.validation = validation
+        self.seed = seed
+        self.chunk_rows = chunk_rows
+
+    def __call__(self, idx: int, it) -> Iterable[Tuple[int, int, int]]:
+        tw = self.store.part_writer(self.train_path, overwrite=False,
+                                    base_index=idx)
+        vw = self.store.part_writer(self.val_path, overwrite=False,
+                                    base_index=idx)
+        buf: List[Dict] = []
+        counts = [0, 0]  # train, val rows
+        chunk_i = 0
+
+        def flush():
+            nonlocal buf, chunk_i
+            if not buf:
+                return
+            cols = {c: np.stack([np.asarray(r[c]) for r in buf])
+                    for c in (self.columns or sorted(buf[0]))}
+            # Seeded per (partition, chunk): a re-run of the same layout
+            # reproduces the same split.
+            t, v = _write_split_chunk(
+                tw, vw, cols, None, self.validation,
+                seed=self.seed + 1000003 * idx + chunk_i)
+            chunk_i += 1
+            counts[0] += t
+            counts[1] += v
+            buf = []
+
+        for row in it:
+            buf.append(_row_to_dict(row))
+            if len(buf) >= self.chunk_rows:
+                flush()
+        flush()
+        yield (idx, counts[0], counts[1])
+
+
+def prepare_data(store: Store, df, feature_cols, label_cols,
+                 validation=None, seed: int = 0,
+                 chunk_rows: int = 65536,
+                 train_path: Optional[str] = None,
+                 val_path: Optional[str] = None,
+                 run_id: str = "run0") -> Tuple[str, Optional[str]]:
+    """Materialize ``df`` into the Store as train (+ optional val)
+    parquet datasets; returns ``(train_path, val_path_or_None)``.
+
+    Dispatch is by input shape (module docstring); the DataFrame path is
+    partition-parallel and the chunk-iterator path is bounded-memory —
+    only the plain-dict path assumes the data fits in driver memory
+    (because it already does)."""
+    train_path = train_path or store.get_train_data_path(run_id)
+    val_path = val_path or store.get_val_data_path(run_id)
+    extra = (validation,) if isinstance(validation, str) else ()
+    columns = (list(feature_cols or []) + list(label_cols or []) +
+               list(extra))
+
+    if hasattr(df, "rdd"):  # pyspark DataFrame: distributed write
+        # Clear both datasets once on the driver; executors append.
+        store.part_writer(train_path, overwrite=True)
+        store.part_writer(val_path, overwrite=True)
+        task = _PartitionWriter(store, train_path, val_path, columns,
+                                validation, seed, chunk_rows)
+        counts = df.rdd.mapPartitionsWithIndex(task).collect()
+        train_rows = sum(t for _, t, _ in counts)
+        val_rows = sum(v for _, _, v in counts)
+        if train_rows == 0:
+            raise ValueError("prepare_data: DataFrame produced 0 train "
+                             "rows")
+        return train_path, (val_path if val_rows else None)
+
+    if not isinstance(df, dict) and not hasattr(df, "toPandas") and \
+            not hasattr(df, "columns") and hasattr(df, "__iter__"):
+        # iterator/generator of column-dict chunks: stream through ONE
+        # writer — driver memory stays bounded by the chunk size.
+        tw = store.part_writer(train_path, overwrite=True)
+        vw = store.part_writer(val_path, overwrite=True)
+        val_rows = 0
+        train_rows = 0
+        for i, chunk in enumerate(df):
+            cols = {k: np.asarray(v) for k, v in chunk.items()}
+            t, v = _write_split_chunk(tw, vw, cols, columns, validation,
+                                      seed=seed + i)
+            train_rows += t
+            val_rows += v
+        if train_rows == 0:
+            raise ValueError("prepare_data: chunk stream produced 0 train "
+                             "rows")
+        return train_path, (val_path if val_rows else None)
+
+    # in-memory dict / pandas DataFrame (small-data path)
+    cols = _as_columns(df, feature_cols, label_cols, extra_cols=extra)
+    train_cols, val_cols = _split_validation(cols, validation, seed)
+    store.write_parquet(train_path, train_cols)
+    if val_cols is not None:
+        store.write_parquet(val_path, val_cols)
+        return train_path, val_path
+    return train_path, None

@@ -2,22 +2,33 @@
 
 Reference: horovod/spark/common/store.py:36-530 — FilesystemStore keeps
 train/val parquet, per-run checkpoints and logs under a base directory;
-HDFS/DBFS variants change only path handling.  Here the filesystem store
-is the core implementation (TPU VMs mount GCS via fuse or use local SSD;
-remote-blob variants slot in by overriding ``fs`` path joins).
+HDFS/DBFS variants change only path handling and the byte-transport
+client.  Here that boundary is explicit: ONE store implementation
+(:class:`FilesystemStore`) runs over the seven-method filesystem
+protocol (``data/fs.py``), and the remote variants swap the fs object —
+:class:`HDFSStore` takes an ``hdfs://`` prefix plus an injected (or
+pyarrow-constructed) client, :class:`DBFSLocalStore` rewrites ``dbfs:/``
+paths onto the fuse mount.
+
+Datasets are DIRECTORIES of ``part-NNNNN.parquet`` files.  The prepare
+step appends parts — from one driver streaming chunks, or from many
+Spark partitions writing in parallel (``spark/prepare.py``) — so no
+single process ever has to hold the dataset.
 """
 
 from __future__ import annotations
 
-import os
-import shutil
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
+
+from ..data.fs import BaseFS, LocalFS
 
 
 class Store:
     """Abstract store surface (reference: store.py:36-100)."""
+
+    fs: BaseFS
 
     def get_train_data_path(self, idx: Optional[str] = None) -> str:
         raise NotImplementedError
@@ -47,36 +58,90 @@ class Store:
     @staticmethod
     def create(prefix_path: str, **kwargs) -> "Store":
         """Factory dispatching on path scheme (reference: store.py
-        Store.create routes hdfs:// to HDFSStore and everything else to
-        FilesystemStore; DBFSLocalStore handles dbfs:/)."""
+        Store.create routes hdfs:// to HDFSStore, dbfs:/ to
+        DBFSLocalStore, everything else to FilesystemStore)."""
         if prefix_path.startswith("dbfs:/") or \
                 prefix_path.startswith("/dbfs"):
             return DBFSLocalStore(prefix_path, **kwargs)
         if prefix_path.startswith("hdfs://"):
-            raise ValueError(
-                "hdfs:// stores need an HDFS client, which TPU-VM images "
-                "do not ship; mount the cluster (fuse/NFS) and pass the "
-                "mounted path, or use gcsfuse + a local path")
+            return HDFSStore(prefix_path, **kwargs)
         return FilesystemStore(prefix_path, **kwargs)
 
 
+def _encode_table(columns: Dict[str, np.ndarray]):
+    """Column dict -> (pyarrow table with shape metadata).  Multi-dim
+    columns flatten to lists; shapes ride the schema metadata so readers
+    restore them (decoder: data/loader.decode_table)."""
+    import json
+
+    import pyarrow as pa
+
+    flat = {}
+    meta: Dict[str, Any] = {}
+    for name, arr in columns.items():
+        arr = np.asarray(arr)
+        if arr.ndim > 1:  # parquet columns are 1-D; flatten + remember
+            meta[name] = list(arr.shape[1:])
+            flat[name] = list(arr.reshape(arr.shape[0], -1))
+        else:
+            flat[name] = arr
+    table = pa.table(flat)
+    return table.replace_schema_metadata(
+        {b"horovod_tpu_shapes": json.dumps(meta).encode()})
+
+
+class ParquetPartWriter:
+    """Append column-dict chunks to a dataset as ``part-NNNNN.parquet``
+    files.  ``base_index`` namespaces the part numbers so N writers (one
+    per Spark partition) append to the SAME dataset without coordination:
+    partition p writes part-(p*stride+i).  Each part lands via
+    tmp+rename, so readers never observe half-written files."""
+
+    def __init__(self, store: "FilesystemStore", path: str,
+                 base_index: int = 0, stride: int = 1 << 20):
+        self.store = store
+        self.path = path
+        self._next = base_index * stride
+        self._wrote = 0
+
+    def write(self, columns: Dict[str, np.ndarray]) -> str:
+        import pyarrow.parquet as pq
+
+        fs = self.store.fs
+        fs.mkdirs(self.path)
+        out = fs.join(self.path, f"part-{self._next:09d}.parquet")
+        tmp = out + ".tmp"
+        with fs.open(tmp, "wb") as f:
+            pq.write_table(_encode_table(columns), f)
+        fs.rename(tmp, out)
+        self._next += 1
+        self._wrote += 1
+        return out
+
+    @property
+    def parts_written(self) -> int:
+        return self._wrote
+
+
 class FilesystemStore(Store):
-    """Local/NFS/fuse-mounted storage (reference: store.py:103-330)."""
+    """Storage over a filesystem object (reference: store.py:103-330).
+    With the default ``LocalFS`` this is local/NFS/fuse-mounted storage;
+    remote stores pass a different fs + posix path joining."""
 
     def __init__(self, prefix_path: str,
                  train_path: Optional[str] = None,
                  val_path: Optional[str] = None,
                  checkpoint_path: Optional[str] = None,
-                 logs_path: Optional[str] = None):
+                 logs_path: Optional[str] = None,
+                 fs: Optional[BaseFS] = None):
+        self.fs = fs or LocalFS()
+        j = self.fs.join
         self.prefix_path = prefix_path
-        self._train = train_path or os.path.join(prefix_path,
-                                                 "intermediate_train_data")
-        self._val = val_path or os.path.join(prefix_path,
-                                             "intermediate_val_data")
-        self._ckpt = checkpoint_path or os.path.join(prefix_path,
-                                                     "checkpoints")
-        self._logs = logs_path or os.path.join(prefix_path, "logs")
-        os.makedirs(prefix_path, exist_ok=True)
+        self._train = train_path or j(prefix_path, "intermediate_train_data")
+        self._val = val_path or j(prefix_path, "intermediate_val_data")
+        self._ckpt = checkpoint_path or j(prefix_path, "checkpoints")
+        self._logs = logs_path or j(prefix_path, "logs")
+        self.fs.mkdirs(prefix_path)
 
     def get_train_data_path(self, idx: Optional[str] = None) -> str:
         return self._train if idx is None else f"{self._train}.{idx}"
@@ -85,102 +150,191 @@ class FilesystemStore(Store):
         return self._val if idx is None else f"{self._val}.{idx}"
 
     def get_checkpoint_path(self, run_id: str) -> str:
-        return os.path.join(self._ckpt, run_id)
+        return self.fs.join(self._ckpt, run_id)
 
     def get_logs_path(self, run_id: str) -> str:
-        return os.path.join(self._logs, run_id)
+        return self.fs.join(self._logs, run_id)
 
     def exists(self, path: str) -> bool:
-        return os.path.exists(path)
+        return self.fs.exists(path)
 
     def is_parquet_dataset(self, path: str) -> bool:
-        if not os.path.isdir(path):
-            return os.path.isfile(path) and path.endswith(".parquet")
-        return any(f.endswith(".parquet") for f in os.listdir(path))
+        if not self.fs.exists(path):
+            return False
+        if not self.fs.isdir(path):
+            return path.endswith(".parquet")
+        return any(f.endswith(".parquet") for f in self.fs.listdir(path))
 
     # ---- data prep -------------------------------------------------------
+    def part_writer(self, path: str, overwrite: bool = True,
+                    base_index: int = 0) -> ParquetPartWriter:
+        """Chunked/parallel prepare entry (spark/common/util.py
+        prepare_data analog): each chunk of rows becomes its own part
+        file.  ``overwrite`` clears the dataset first — only ONE caller
+        (the driver, before fanning out) should pass it."""
+        if overwrite and self.fs.exists(path):
+            self.fs.rmtree(path)
+        return ParquetPartWriter(self, path, base_index=base_index)
+
     def write_parquet(self, path: str, columns: Dict[str, np.ndarray],
                       overwrite: bool = True) -> str:
-        """Persist a column dict as a parquet dataset (the prepare_data
-        step of Estimator.fit, reference: spark/common/util.py)."""
-        import pyarrow as pa
-        import pyarrow.parquet as pq
-        if overwrite and os.path.isdir(path):
-            shutil.rmtree(path)
-        os.makedirs(path, exist_ok=True)
-        flat = {}
-        meta: Dict[str, Any] = {}
-        for name, arr in columns.items():
-            arr = np.asarray(arr)
-            if arr.ndim > 1:  # parquet columns are 1-D; flatten + remember
-                meta[name] = list(arr.shape[1:])
-                flat[name] = list(arr.reshape(arr.shape[0], -1))
-            else:
-                flat[name] = arr
-        table = pa.table(flat)
-        import json
-        table = table.replace_schema_metadata(
-            {b"horovod_tpu_shapes": json.dumps(meta).encode()})
-        out = os.path.join(path, "part-00000.parquet")
-        pq.write_table(table, out)
+        """One-shot prepare of an in-memory column dict (small data /
+        tests); a single part via the same writer."""
+        self.part_writer(path, overwrite=overwrite).write(columns)
         return path
 
     def read_parquet(self, path: str) -> Dict[str, np.ndarray]:
-        """Read back a dataset written by write_parquet, restoring shapes
-        (decoder shared with ParquetDataLoader: data/loader.decode_table)."""
+        """Read back a dataset, restoring shapes (decoder shared with
+        ParquetDataLoader: data/loader.decode_table)."""
         import pyarrow as pa
         import pyarrow.parquet as pq
+
         from ..data.loader import decode_table, list_parquet_files
-        return decode_table(pa.concat_tables(
-            [pq.read_table(f) for f in list_parquet_files(path)]))
+        tables = []
+        for fpath in list_parquet_files(path, fs=self.fs):
+            with self.fs.open(fpath, "rb") as f:
+                tables.append(pq.read_table(f))
+        return decode_table(pa.concat_tables(tables))
 
     # ---- checkpoints -----------------------------------------------------
     def save_checkpoint(self, run_id: str, payload: bytes,
                         name: str = "checkpoint.bin") -> str:
         d = self.get_checkpoint_path(run_id)
-        os.makedirs(d, exist_ok=True)
-        p = os.path.join(d, name)
+        self.fs.mkdirs(d)
+        p = self.fs.join(d, name)
         tmp = p + ".tmp"
-        with open(tmp, "wb") as f:
+        with self.fs.open(tmp, "wb") as f:
             f.write(payload)
-        os.replace(tmp, p)
+        self.fs.rename(tmp, p)
         return p
 
     def read_checkpoint(self, run_id: str,
                         name: str = "checkpoint.bin") -> Optional[bytes]:
-        p = os.path.join(self.get_checkpoint_path(run_id), name)
-        if not os.path.exists(p):
+        p = self.fs.join(self.get_checkpoint_path(run_id), name)
+        if not self.fs.exists(p):
             return None
-        with open(p, "rb") as f:
+        with self.fs.open(p, "rb") as f:
             return f.read()
 
     # ---- run logs --------------------------------------------------------
     def save_log(self, run_id: str, payload: bytes) -> str:
         d = self.get_logs_path(run_id)
-        os.makedirs(d, exist_ok=True)
-        p = os.path.join(d, "history.bin")
+        self.fs.mkdirs(d)
+        p = self.fs.join(d, "history.bin")
         tmp = p + ".tmp"
-        with open(tmp, "wb") as f:
+        with self.fs.open(tmp, "wb") as f:
             f.write(payload)
-        os.replace(tmp, p)
+        self.fs.rename(tmp, p)
         return p
 
     def read_log(self, run_id: str) -> Optional[bytes]:
-        p = os.path.join(self.get_logs_path(run_id), "history.bin")
-        if not os.path.exists(p):
+        p = self.fs.join(self.get_logs_path(run_id), "history.bin")
+        if not self.fs.exists(p):
             return None
-        with open(p, "rb") as f:
+        with self.fs.open(p, "rb") as f:
             return f.read()
 
 
 LocalStore = FilesystemStore
 
 
+class HDFSStore(FilesystemStore):
+    """Remote-scheme store (reference: store.py HDFSStore:333-530): paths
+    are ``hdfs://namenode/...`` URIs and every byte moves through an
+    HDFS client speaking the fs protocol (data/fs.py).
+
+    ``fs`` is the client.  Pass one explicitly (anything implementing the
+    seven-method protocol — tests inject a fake namenode; production
+    wraps pyarrow's HadoopFileSystem); with ``fs=None`` a pyarrow-backed
+    client is attempted, and environments without Hadoop libraries get
+    the actionable error instead of a deep pyarrow stack."""
+
+    def __init__(self, prefix_path: str, fs: Optional[BaseFS] = None,
+                 **kwargs):
+        if not prefix_path.startswith("hdfs://"):
+            raise ValueError(f"HDFSStore requires an hdfs:// prefix, got "
+                             f"{prefix_path!r}")
+        if fs is None:
+            fs = _pyarrow_hdfs(prefix_path)
+        super().__init__(prefix_path, fs=fs, **kwargs)
+
+
+class PyArrowFS(BaseFS):
+    """fs-protocol adapter over a pyarrow FileSystem.  Module-level and
+    holding only the (picklable) pyarrow client, because the Store rides
+    inside train tasks shipped to workers with PLAIN pickle
+    (runner.py's picklable-class convention)."""
+
+    def __init__(self, pafs_client):
+        self._c = pafs_client
+
+    def open(self, path, mode="rb"):
+        p = _strip_scheme(path)
+        return (self._c.open_input_stream(p) if "r" in mode
+                else self._c.open_output_stream(p))
+
+    def exists(self, path):
+        from pyarrow import fs as pafs
+        info = self._c.get_file_info(_strip_scheme(path))
+        return info.type != pafs.FileType.NotFound
+
+    def isdir(self, path):
+        from pyarrow import fs as pafs
+        info = self._c.get_file_info(_strip_scheme(path))
+        return info.type == pafs.FileType.Directory
+
+    def listdir(self, path):
+        from pyarrow import fs as pafs
+        sel = pafs.FileSelector(_strip_scheme(path))
+        return [i.base_name for i in self._c.get_file_info(sel)]
+
+    def mkdirs(self, path):
+        self._c.create_dir(_strip_scheme(path), recursive=True)
+
+    def rmtree(self, path):
+        p = _strip_scheme(path)
+        if self.isdir(p):
+            self._c.delete_dir(p)
+        elif self.exists(p):
+            self._c.delete_file(p)
+
+    def rename(self, src, dst):
+        self._c.move(_strip_scheme(src), _strip_scheme(dst))
+
+
+def _pyarrow_hdfs(uri: str) -> BaseFS:
+    """Build a PyArrowFS over pyarrow's HadoopFileSystem, or raise with
+    the TPU-image guidance (reference store.py's HDFS client bring-up,
+    minus the libhdfs juggling)."""
+    try:
+        from pyarrow import fs as pafs
+        hdfs, _ = pafs.FileSystem.from_uri(uri)
+    except Exception as e:
+        raise RuntimeError(
+            "hdfs:// stores need an HDFS client: pass "
+            "HDFSStore(prefix, fs=<client>) with any object speaking the "
+            "horovod_tpu.data.fs protocol, or install Hadoop native libs "
+            "for pyarrow. TPU-VM images ship neither — mounting the "
+            "cluster (fuse/NFS) and using FilesystemStore also works"
+        ) from e
+    return PyArrowFS(hdfs)
+
+
+def _strip_scheme(path: str) -> str:
+    """hdfs://host[:port]/a/b -> /a/b (pyarrow clients address paths
+    relative to the connected namenode)."""
+    if path.startswith("hdfs://"):
+        rest = path[len("hdfs://"):]
+        slash = rest.find("/")
+        return rest[slash:] if slash >= 0 else "/"
+    return path
+
+
 class DBFSLocalStore(FilesystemStore):
     """Databricks DBFS store (reference: store.py DBFSLocalStore): paths
     given as ``dbfs:/...`` are accessed through the ``/dbfs/`` fuse mount.
-    Everything else is FilesystemStore — proving the Store abstraction is
-    a path-translation boundary, exactly as in the reference."""
+    Everything else is FilesystemStore — the Store abstraction is a
+    path-translation boundary, exactly as in the reference."""
 
     def __init__(self, prefix_path: str, **kwargs):
         super().__init__(self.normalize_path(prefix_path), **kwargs)

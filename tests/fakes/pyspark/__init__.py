@@ -47,6 +47,20 @@ class SparkContext:
         SparkContext._active_spark_context = None
 
 
+class Row(dict):
+    """pyspark.sql.Row lookalike: mapping + asDict() (the two access
+    patterns prepare_data's row decoder handles)."""
+
+    def asDict(self):
+        return dict(self)
+
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(name)
+
+
 class RDD:
     def __init__(self, data, num_slices):
         self._data = data
@@ -54,6 +68,84 @@ class RDD:
 
     def barrier(self):
         return _BarrierRDD(self)
+
+    def _partitions(self):
+        n = self._n
+        parts = [[] for _ in range(n)]
+        for i, item in enumerate(self._data):
+            parts[i * n // max(len(self._data), 1)].append(item)
+        return parts
+
+    def mapPartitionsWithIndex(self, f):
+        return _MappedRDD(self, f)
+
+    def getNumPartitions(self):
+        return self._n
+
+
+class _MappedRDD:
+    """Non-barrier mapPartitionsWithIndex: every partition function runs
+    in its OWN python process, all partitions concurrently — exactly the
+    execution model a distributed prepare step must survive (parallel
+    writers, no shared driver state)."""
+
+    def __init__(self, rdd, f):
+        self._rdd = rdd
+        self._f = f
+
+    def collect(self):
+        import cloudpickle
+        parts = self._rdd._partitions()
+        rdv = tempfile.mkdtemp(prefix="pyspark_fake_rdd_")
+        fakes_dir = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [fakes_dir] + [p for p in sys.path if p])
+        procs = []
+        for idx, items in enumerate(parts):
+            payload = os.path.join(rdv, f"ptask_{idx}.pkl")
+            with open(payload, "wb") as fh:
+                cloudpickle.dump((self._f, items, idx), fh)
+            procs.append((idx, subprocess.Popen(
+                [sys.executable, "-m", "pyspark._ptask", payload], env=env)))
+        out, failed = [], []
+        for idx, p in procs:
+            rc = p.wait(timeout=600)
+            res = os.path.join(rdv, f"ptask_{idx}.out")
+            if rc != 0 or not os.path.exists(res):
+                failed.append((idx, rc))
+                continue
+            with open(res, "rb") as fh:
+                out.extend(pickle.load(fh))
+        if failed:
+            raise RuntimeError(f"stage failed: tasks {failed} died")
+        return out
+
+
+def partition_task_main(payload_path):
+    with open(payload_path, "rb") as fh:
+        f, items, idx = pickle.load(fh)
+    result = list(f(idx, iter(items)))
+    tmp = payload_path[:-len(".pkl")] + ".out.tmp"
+    with open(tmp, "wb") as fh:
+        pickle.dump(result, fh)
+    os.replace(tmp, payload_path[:-len(".pkl")] + ".out")
+
+
+class DataFrame:
+    """Row-holding DataFrame lookalike: just enough surface for
+    Estimator.fit — ``.rdd`` (the distributed-prepare path) and
+    ``toPandas`` deliberately ABSENT so any code path regressing to
+    whole-dataset driver materialization fails loudly."""
+
+    def __init__(self, rows, numSlices=2):
+        self._rows = [Row(r) for r in rows]
+        self._n = numSlices
+
+    @property
+    def rdd(self):
+        return RDD(self._rows, self._n)
 
 
 class _BarrierRDD:

@@ -1,0 +1,10 @@
+"""Partition-task bootstrap for the pyspark fake's non-barrier
+mapPartitionsWithIndex (run as ``python -m pyspark._ptask <payload.pkl>``
+in its own process)."""
+
+import sys
+
+from . import partition_task_main
+
+if __name__ == "__main__":
+    partition_task_main(sys.argv[1])

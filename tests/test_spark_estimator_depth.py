@@ -114,7 +114,9 @@ def test_store_create_dispatches_on_scheme(tmp_path):
     assert type(s) is FilesystemStore
     assert DBFSLocalStore.normalize_path("dbfs:/foo/bar") == "/dbfs/foo/bar"
     assert DBFSLocalStore.normalize_path("/other") == "/other"
-    with pytest.raises(ValueError, match="hdfs"):
+    # hdfs:// now dispatches to HDFSStore (test_spark_prepare.py covers
+    # it end-to-end); without a client it raises the actionable error.
+    with pytest.raises(RuntimeError, match="HDFS client"):
         Store.create("hdfs://namenode/path")
 
 

@@ -1,0 +1,234 @@
+"""Distributed/streaming data prepare + remote Store (VERDICT-r3 #5):
+
+* prepare_data's chunk-iterator path streams part files with bounded
+  driver memory;
+* the pyspark-DataFrame path writes PARTITION-PARALLEL on executor
+  processes (fake pyspark proves it: the fake DataFrame has no toPandas,
+  so regressing to driver materialization fails loudly);
+* StreamingParquetDataLoader matches ParquetDataLoader batch-for-batch
+  while touching only row-group-sized memory;
+* HDFSStore runs the whole estimator flow over an INJECTED remote
+  filesystem speaking the data/fs.py protocol — no local path ever
+  reaches the store (reference: spark/common/store.py:36-530 HDFSStore,
+  spark/common/util.py prepare_data).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from horovod_tpu.data.fs import BaseFS, LocalFS
+from horovod_tpu.data.loader import (ParquetDataLoader,
+                                     StreamingParquetDataLoader)
+from horovod_tpu.spark import FilesystemStore, LinearEstimator
+from horovod_tpu.spark.prepare import prepare_data
+from horovod_tpu.spark.runner import LocalTaskExecutor
+from horovod_tpu.spark.store import HDFSStore, Store
+
+FAKES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fakes")
+
+
+def _purge(prefix):
+    for m in list(sys.modules):
+        if m == prefix or m.startswith(prefix + "."):
+            del sys.modules[m]
+
+
+@pytest.fixture()
+def pyspark_fake(monkeypatch):
+    monkeypatch.syspath_prepend(FAKES)
+    _purge("pyspark")
+    yield
+    _purge("pyspark")
+
+
+# ------------------------------------------------------- chunked prepare
+def test_prepare_chunk_iterator_streams_parts(tmp_path):
+    store = FilesystemStore(str(tmp_path))
+
+    def chunks():
+        for i in range(5):
+            yield {"features": np.full((10, 3), i, np.float64),
+                   "label": np.full((10, 1), i, np.float64)}
+
+    train, val = prepare_data(store, chunks(), ["features"], ["label"])
+    assert val is None
+    parts = [f for f in os.listdir(train) if f.endswith(".parquet")]
+    assert len(parts) == 5  # one part per chunk — never one big array
+    data = store.read_parquet(train)
+    assert data["features"].shape == (50, 3)
+    assert sorted(set(data["label"].ravel())) == [0, 1, 2, 3, 4]
+
+
+def test_prepare_chunk_iterator_validation_fraction(tmp_path):
+    store = FilesystemStore(str(tmp_path))
+    it = ({"features": np.random.RandomState(i).randn(40, 2),
+           "label": np.zeros((40, 1))} for i in range(4))
+    train, val = prepare_data(store, it, ["features"], ["label"],
+                              validation=0.25, seed=7)
+    n_train = len(store.read_parquet(train)["label"])
+    n_val = len(store.read_parquet(val)["label"])
+    assert n_train + n_val == 160
+    assert 10 <= n_val <= 70  # ~25%, chunk-level randomness
+
+
+# -------------------------------------------- distributed (fake pyspark)
+def test_prepare_dataframe_partition_parallel(tmp_path, pyspark_fake):
+    import pyspark
+    store = FilesystemStore(str(tmp_path))
+    rows = [{"features": [float(i), float(2 * i)], "label": [float(i)]}
+            for i in range(48)]
+    df = pyspark.DataFrame(rows, numSlices=4)
+    assert not hasattr(df, "toPandas")  # materialization is impossible
+    train, val = prepare_data(store, df, ["features"], ["label"],
+                              chunk_rows=8)
+    parts = sorted(f for f in os.listdir(train) if f.endswith(".parquet"))
+    # 4 partitions x 12 rows / chunk_rows 8 -> 2 parts each, namespaced
+    assert len(parts) == 8
+    bases = {int(p.split("-")[1].split(".")[0]) >> 20 for p in parts}
+    assert bases == {0, 1, 2, 3}  # every partition wrote its own parts
+    data = store.read_parquet(train)
+    assert sorted(data["label"].ravel()) == [float(i) for i in range(48)]
+    assert val is None
+
+
+def test_estimator_fit_on_dataframe(tmp_path, pyspark_fake):
+    import pyspark
+    rng = np.random.RandomState(0)
+    x = rng.randn(120, 4)
+    w = np.asarray([[1.0], [-2.0], [0.5], [3.0]])
+    y = x @ w
+    rows = [{"features": list(map(float, x[i])),
+             "label": [float(y[i, 0])]} for i in range(len(x))]
+    est = LinearEstimator(store=FilesystemStore(str(tmp_path)),
+                          num_proc=2, epochs=30, batch_size=16, lr=0.05,
+                          executor=LocalTaskExecutor(2))
+    model = est.fit(pyspark.DataFrame(rows, numSlices=3))
+    pred = model.transform({"features": x, "label": y})
+    assert float(np.mean((pred["predict"] - y) ** 2)) < 1e-2
+
+
+def test_estimator_fit_on_chunk_stream(tmp_path):
+    rng = np.random.RandomState(1)
+
+    def chunks():
+        for _ in range(6):
+            x = rng.randn(32, 3)
+            yield {"features": x, "label": x @ np.ones((3, 1))}
+
+    est = LinearEstimator(store=FilesystemStore(str(tmp_path)),
+                          num_proc=1, epochs=25, batch_size=16, lr=0.05,
+                          executor=LocalTaskExecutor(1))
+    model = est.fit(chunks())
+    x = rng.randn(20, 3)
+    pred = model.transform({"features": x})
+    assert float(np.mean((pred["predict"] - x @ np.ones((3, 1))) ** 2)) \
+        < 1e-2
+
+
+# ------------------------------------------------------ streaming reader
+@pytest.mark.parametrize("num_workers,rank", [(1, 0), (2, 0), (2, 1),
+                                              (3, 2)])
+def test_streaming_loader_matches_eager(tmp_path, num_workers, rank):
+    store = FilesystemStore(str(tmp_path))
+    w = store.part_writer(str(tmp_path / "ds"))
+    rng = np.random.RandomState(3)
+    for _ in range(4):  # 4 parts -> multiple row groups across files
+        w.write({"x": rng.randn(13, 2), "y": rng.randn(13)})
+    path = str(tmp_path / "ds")
+    eager = ParquetDataLoader(path, batch_size=5, rank=rank,
+                              num_workers=num_workers)
+    stream = StreamingParquetDataLoader(path, batch_size=5, rank=rank,
+                                        num_workers=num_workers)
+    eb = list(eager)
+    sb = list(stream)
+    assert len(eb) == len(sb) == len(stream) == len(eager)
+    for b1, b2 in zip(eb, sb):
+        assert sorted(b1) == sorted(b2)
+        for k in b1:
+            np.testing.assert_array_equal(b1[k], b2[k])
+
+
+def test_streaming_loader_two_epochs_identical(tmp_path):
+    store = FilesystemStore(str(tmp_path))
+    store.write_parquet(str(tmp_path / "ds"),
+                        {"x": np.arange(23, dtype=np.float64)})
+    dl = StreamingParquetDataLoader(str(tmp_path / "ds"), batch_size=4)
+    e1 = [b["x"].copy() for b in dl]
+    e2 = [b["x"].copy() for b in dl]
+    for a, b in zip(e1, e2):
+        np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------------------ HDFS store
+class FakeHDFS(BaseFS):
+    """Strict fake namenode: speaks ONLY hdfs://nn/ URIs (any bare local
+    path is a contract violation and raises), backed by a local root.
+    Picklable — it travels to worker processes inside the Store."""
+
+    def __init__(self, root):
+        self._root = str(root)
+        self._local = LocalFS()
+
+    def _resolve(self, path):
+        if not path.startswith("hdfs://nn/"):
+            raise ValueError(f"non-hdfs path reached FakeHDFS: {path!r}")
+        return os.path.join(self._root, path[len("hdfs://nn/"):])
+
+    def open(self, path, mode="rb"):
+        return self._local.open(self._resolve(path), mode)
+
+    def exists(self, path):
+        return self._local.exists(self._resolve(path))
+
+    def isdir(self, path):
+        return self._local.isdir(self._resolve(path))
+
+    def listdir(self, path):
+        return self._local.listdir(self._resolve(path))
+
+    def mkdirs(self, path):
+        self._local.mkdirs(self._resolve(path))
+
+    def rmtree(self, path):
+        self._local.rmtree(self._resolve(path))
+
+    def rename(self, src, dst):
+        self._local.rename(self._resolve(src), self._resolve(dst))
+
+
+def test_hdfs_store_estimator_end_to_end(tmp_path):
+    """The whole flow — prepare, sharded streaming reads in worker
+    processes, per-epoch checkpoints, history logs, model load — over a
+    remote-scheme store whose every byte moves through the injected fs."""
+    fs = FakeHDFS(tmp_path / "namenode")
+    store = HDFSStore("hdfs://nn/warehouse", fs=fs)
+    assert store.get_train_data_path("r0").startswith("hdfs://nn/")
+    rng = np.random.RandomState(2)
+    x = rng.randn(96, 3)
+    y = x @ np.asarray([[2.0], [1.0], [-1.0]])
+    est = LinearEstimator(store=store, num_proc=2, epochs=30,
+                          batch_size=16, lr=0.05, validation=0.2,
+                          metrics=["mse"],
+                          executor=LocalTaskExecutor(2))
+    model = est.fit({"features": x, "label": y})
+    pred = model.transform({"features": x, "label": y})
+    assert float(np.mean((pred["predict"] - y) ** 2)) < 1e-2
+    assert model.history["val_mse"][-1] < model.history["val_mse"][0]
+    # bytes really landed under the fake namenode, not any local path
+    assert (tmp_path / "namenode" / "warehouse").is_dir()
+    assert store.read_checkpoint("run0") is not None
+
+
+def test_store_create_dispatches_hdfs(tmp_path):
+    s = Store.create("hdfs://nn/base", fs=FakeHDFS(tmp_path))
+    assert isinstance(s, HDFSStore)
+    with pytest.raises(RuntimeError, match="HDFS client"):
+        Store.create("hdfs://unreachable-namenode/base")
+
+
+def test_hdfs_store_rejects_non_hdfs_prefix():
+    with pytest.raises(ValueError, match="hdfs://"):
+        HDFSStore("/local/path", fs=FakeHDFS("/tmp"))
