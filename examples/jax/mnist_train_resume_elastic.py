@@ -1,0 +1,269 @@
+"""The full-depth MNIST flow: real data, checkpoint-resume, elastic.
+
+Reference pattern: examples/pytorch/pytorch_mnist.py +
+examples/elastic/pytorch/pytorch_mnist_elastic.py — download MNIST,
+train data-parallel with a sharded loader, checkpoint per epoch, resume
+from the latest checkpoint, optionally run elastically.  Rebuilt for the
+jax frontend with the sharded orbax checkpoint manager and
+``horovod_tpu.elastic``.
+
+Data resolution order (offline-capable by design):
+
+1. ``--data-dir`` containing the canonical IDX files
+   (``train-images-idx3-ubyte[.gz]`` etc.) — parsed directly;
+2. ``--download``: fetch the four IDX files into ``--data-dir`` (works
+   only with network egress; failure is reported and falls through);
+3. deterministic procedural MNIST-lookalike (blurred class templates +
+   noise) so the example always runs.
+
+Run:
+
+  python examples/jax/mnist_train_resume_elastic.py --cpu --epochs 2
+  python examples/jax/mnist_train_resume_elastic.py --cpu --elastic
+  # resume: run it twice with the same --ckpt-dir; epoch continues
+  hvdrun -np 4 python examples/jax/mnist_train_resume_elastic.py \
+      --data-dir ~/mnist --download          # TPU pod, real data
+"""
+
+import argparse
+import gzip
+import os
+import struct
+import time
+
+MNIST_FILES = {
+    "x_train": "train-images-idx3-ubyte",
+    "y_train": "train-labels-idx1-ubyte",
+    "x_test": "t10k-images-idx3-ubyte",
+    "y_test": "t10k-labels-idx1-ubyte",
+}
+MNIST_MIRROR = "https://storage.googleapis.com/cvdf-datasets/mnist/"
+
+
+def _read_idx(path):
+    """Parse one IDX ubyte file (the 1998 LeCun format: magic, dims,
+    big-endian uint8 payload); transparently handles .gz."""
+    import numpy as np
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype, ndim = struct.unpack(">HBB", f.read(4))
+        if zero != 0 or dtype != 0x08:
+            raise ValueError(f"{path}: not an IDX ubyte file")
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+def load_mnist_idx(data_dir):
+    """Load the four canonical files (plain or .gz) or return None."""
+    out = {}
+    for key, name in MNIST_FILES.items():
+        for cand in (name, name + ".gz"):
+            p = os.path.join(data_dir, cand)
+            if os.path.exists(p):
+                out[key] = _read_idx(p)
+                break
+        else:
+            return None
+    return out
+
+
+def try_download(data_dir):
+    """Best-effort fetch of the IDX files (gz) from the GCS mirror; a
+    zero-egress environment fails fast and falls through to synthetic."""
+    import urllib.error
+    import urllib.request
+    os.makedirs(data_dir, exist_ok=True)
+    for name in MNIST_FILES.values():
+        dst = os.path.join(data_dir, name + ".gz")
+        if os.path.exists(dst):
+            continue
+        url = MNIST_MIRROR + name + ".gz"
+        try:
+            with urllib.request.urlopen(url, timeout=20) as r, \
+                    open(dst + ".tmp", "wb") as f:
+                f.write(r.read())
+            os.replace(dst + ".tmp", dst)
+            print(f"downloaded {url}")
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            print(f"download failed ({e}); continuing without network")
+            return False
+    return True
+
+
+def make_synthetic(n=8192, seed=0):
+    """Procedural 28x28 'digits' (blurred class templates + noise):
+    shaped exactly like MNIST so the rest of the flow is identical."""
+    import numpy as np
+    t = np.random.RandomState(1234).rand(10, 28, 28).astype("float32")
+    for _ in range(3):
+        t = (t + np.roll(t, 1, 1) + np.roll(t, -1, 1)
+             + np.roll(t, 1, 2) + np.roll(t, -1, 2)) / 5.0
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = np.clip(t[y] + 0.5 * rng.randn(n, 28, 28).astype("float32"),
+                0.0, 1.0)
+    split = int(0.9 * n)
+    return {"x_train": (x[:split] * 255).astype("uint8"),
+            "y_train": y[:split].astype("uint8"),
+            "x_test": (x[split:] * 255).astype("uint8"),
+            "y_test": y[split:].astype("uint8")}
+
+
+def resolve_data(args):
+    if args.download and not args.data_dir:
+        args.data_dir = os.path.expanduser("~/.cache/horovod_tpu/mnist")
+        print(f"--download without --data-dir: using {args.data_dir}")
+    if args.data_dir:
+        if args.download:
+            try_download(args.data_dir)
+        d = load_mnist_idx(args.data_dir)
+        if d is not None:
+            print(f"loaded real MNIST from {args.data_dir} "
+                  f"({len(d['x_train'])} train / {len(d['x_test'])} test)")
+            return d, "mnist"
+        print(f"no IDX files under {args.data_dir}; using synthetic data")
+    return make_synthetic(), "synthetic"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=128, help="global")
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--data-dir", default=None,
+                    help="directory with/for the IDX files")
+    ap.add_argument("--download", action="store_true",
+                    help="fetch MNIST into --data-dir first")
+    ap.add_argument("--ckpt-dir", default="/tmp/hvd_tpu_mnist_ckpt",
+                    help="orbax checkpoint dir; re-running resumes")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run under horovod_tpu.elastic (commit per "
+                         "epoch, survives membership resets)")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        from horovod_tpu.utils.platform import force_cpu
+        force_cpu(virtual_chips=8)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.checkpoint import CheckpointManager
+    from horovod_tpu.data.loader import NumpyDataLoader
+    from horovod_tpu.models import mlp
+    from horovod_tpu.parallel.data_parallel import (make_train_step,
+                                                    replicate)
+
+    hvd.init()
+    mesh = hvd.mesh()
+    if hvd.process_rank() == 0:
+        print(f"chips={hvd.size()} processes={hvd.process_size()}")
+
+    data, source = resolve_data(args)
+    x_train = data["x_train"].reshape(len(data["x_train"]), -1) \
+        .astype("float32") / 255.0
+    y_train = data["y_train"].astype("float32")
+    x_test = data["x_test"].reshape(len(data["x_test"]), -1) \
+        .astype("float32") / 255.0
+    y_test = data["y_test"].astype("int64")
+
+    params = mlp.init(jax.random.PRNGKey(0), in_dim=784, hidden=256,
+                      classes=10)
+    opt = optax.adam(args.lr)
+
+    def loss_fn(p, batch):
+        x, y = batch[:, :-1], batch[:, -1].astype(jnp.int32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            mlp.apply(p, x), y).mean()
+
+    step = make_train_step(loss_fn, opt, mesh)
+    params = replicate(params, mesh)
+    opt_state = replicate(opt.init(params), mesh)
+
+    mgr = CheckpointManager(args.ckpt_dir)
+    start_epoch = 0
+    latest = mgr.latest_step()
+    if latest is not None:
+        restored = mgr.restore(latest, params=params, opt_state=opt_state)
+        params, opt_state = restored["params"], restored["opt_state"]
+        start_epoch = int(restored["meta"]["epoch"]) + 1
+        print(f"resumed from epoch {start_epoch - 1} "
+              f"(checkpoint step {latest})")
+
+    def evaluate(p):
+        logits = np.asarray(mlp.apply(p, x_test))
+        return float((logits.argmax(-1) == y_test).mean())
+
+    train_arr = np.concatenate([x_train, y_train[:, None]], 1)
+
+    def run_epochs(get_state, set_state, commit):
+        """Shared epoch loop; state access is indirected so the plain and
+        elastic paths drive the identical code."""
+        p, o, e0 = get_state()
+        # per-epoch reshuffled shard (DistributedSampler convention);
+        # under elastic the loader rebuilds per epoch at the CURRENT size
+        # (the array itself is built once — only the cheap index shard
+        # is per-epoch)
+        for epoch in range(e0, args.epochs):
+            loader = NumpyDataLoader(
+                [train_arr],
+                max(1, args.batch // hvd.process_size()),
+                rank=hvd.process_rank(), num_workers=hvd.process_size(),
+                shuffle=True, seed=epoch,
+                drop_last=True)  # full batches: the mesh shards axis 0
+            t0 = time.time()
+            total, nb = 0.0, 0
+            for (b,) in loader:
+                p, o, loss = step(p, o, jnp.asarray(b))
+                total += float(loss)
+                nb += 1
+            acc = evaluate(p)
+            if hvd.process_rank() == 0:
+                print(f"epoch {epoch}: loss {total / max(nb, 1):.4f} "
+                      f"val_acc {acc:.3f} ({time.time() - t0:.1f}s, "
+                      f"{source})")
+            set_state(p, o, epoch)
+            commit(epoch)
+        return p
+
+    if args.elastic:
+        from horovod_tpu import elastic
+        state = elastic.JaxState(params=params, opt_state=opt_state,
+                                 epoch=start_epoch)
+
+        @elastic.run
+        def train(state):
+            return run_epochs(
+                lambda: (state.params, state.opt_state, state.epoch),
+                lambda p, o, e: (setattr(state, "params", p),
+                                 setattr(state, "opt_state", o),
+                                 setattr(state, "epoch", e + 1)),
+                # durable save FIRST: state.commit() may raise
+                # HostsUpdatedInterrupt (membership change), and the
+                # epoch's checkpoint must exist before that unwinds
+                lambda epoch: (mgr.save(epoch, params=state.params,
+                                        opt_state=state.opt_state,
+                                        meta={"epoch": epoch}),
+                               state.commit()))
+
+        params = train(state)
+    else:
+        box = {"p": params, "o": opt_state}
+        params = run_epochs(
+            lambda: (box["p"], box["o"], start_epoch),
+            lambda p, o, e: box.update(p=p, o=o),
+            lambda epoch: mgr.save(epoch, params=box["p"],
+                                   opt_state=box["o"],
+                                   meta={"epoch": epoch}))
+    mgr.close()
+    acc = evaluate(params)
+    if hvd.process_rank() == 0:
+        print(f"final val_acc {acc:.3f} "
+              f"(checkpoints: {args.ckpt_dir}) OK")
+
+
+if __name__ == "__main__":
+    main()
