@@ -61,6 +61,7 @@ SUITES = {
     "mxnet-shim": ["tests/test_mxnet.py"],
     "cluster": [
         "tests/test_spark_ray.py", "tests/test_spark_estimator_depth.py",
+        "tests/test_spark_prepare.py",
         "tests/test_real_backend_fakes.py", "tests/test_runner.py",
         "tests/test_ci_pipeline.py",
     ],
@@ -122,6 +123,13 @@ def build_steps():
     steps.append(_step(
         "bench: cpu smoke",
         f"{py} bench.py --cpu", timeout=15))
+    steps.append(_step(
+        # Gated on availability: with real pyspark/ray installed this
+        # validates the contract fakes against reality (reference:
+        # Dockerfile.test.cpu:57-86); without them it exits 0 with an
+        # explicit impossibility note, never a silent skip.
+        "real-backends (gated): contract tests vs real pyspark/ray",
+        f"{py} scripts/run_real_backends.py", timeout=30))
     return steps
 
 

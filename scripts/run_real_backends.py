@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Run the spark/ray contract tests against the REAL packages when
+installed (reference analog: Dockerfile.test.cpu:57-86 installs real
+pyspark/ray and the docker-compose matrix runs the framework tests
+against them).
+
+The contract fakes (tests/fakes/) model the exact pyspark/ray surface
+the integrations drive; this runner closes the loop by executing the
+SAME tests with the fakes disabled (``HOROVOD_REAL_BACKENDS=1`` makes
+the fixtures skip their sys.path injection) so the fakes' contract is
+validated against reality wherever reality is installable.
+
+This image cannot install pyspark/ray (no package installation allowed,
+zero egress), so here the step reports the gap explicitly and exits 0 —
+a documented impossibility, not a silent skip.  On any environment with
+the real packages, the same command turns into the real run.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+TARGETS = {
+    "pyspark": ["tests/test_real_backend_fakes.py::"
+                "test_spark_task_executor_runs_barrier_tasks",
+                "tests/test_spark_prepare.py"],
+    "ray": ["tests/test_real_backend_fakes.py -k ray"],
+}
+
+
+def available(pkg: str) -> bool:
+    return importlib.util.find_spec(pkg) is not None
+
+
+def main() -> int:
+    ran_any = False
+    rc = 0
+    for pkg, targets in TARGETS.items():
+        if not available(pkg):
+            print(f"[real-backends] {pkg} not installed in this image "
+                  f"(installation disallowed); contract covered by "
+                  f"tests/fakes/{pkg} — see COVERAGE.md caveat")
+            continue
+        ran_any = True
+        env = dict(os.environ, HOROVOD_REAL_BACKENDS="1")
+        for t in targets:
+            cmd = [sys.executable, "-m", "pytest", *t.split(), "-q"]
+            print(f"[real-backends] {pkg}: {' '.join(cmd)}", flush=True)
+            rc |= subprocess.call(cmd, env=env)
+    if not ran_any:
+        print("[real-backends] no real packages available; fakes remain "
+              "the (documented) substitute")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

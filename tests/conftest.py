@@ -40,6 +40,16 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def use_real_backend(pkg: str) -> bool:
+    """HOROVOD_REAL_BACKENDS=1 + the real package installed: contract
+    fixtures skip their fake injection and the same tests run against
+    reality (scripts/run_real_backends.py).  Shared here so every
+    fixture gates identically."""
+    import importlib.util
+    return (os.environ.get("HOROVOD_REAL_BACKENDS") == "1"
+            and importlib.util.find_spec(pkg) is not None)
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -21,8 +21,14 @@ def _purge(prefix):
             del sys.modules[m]
 
 
+from conftest import use_real_backend as _use_real  # noqa: E402
+
+
 @pytest.fixture()
 def pyspark_fake(monkeypatch):
+    if _use_real("pyspark"):
+        yield
+        return
     monkeypatch.syspath_prepend(FAKES)
     _purge("pyspark")
     yield
@@ -31,6 +37,9 @@ def pyspark_fake(monkeypatch):
 
 @pytest.fixture()
 def ray_fake(monkeypatch):
+    if _use_real("ray"):
+        yield
+        return
     monkeypatch.syspath_prepend(FAKES)
     _purge("ray")
     yield
