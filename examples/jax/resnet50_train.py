@@ -50,6 +50,14 @@ def main():
     ap.add_argument("--ckpt-every", type=int, default=20)
     ap.add_argument("--cpu", action="store_true",
                     help="tiny shapes for laptop smoke runs")
+    ap.add_argument("--data-dir", default=None,
+                    help="directory-per-class image tree (the ImageNet "
+                         "layout); decoded lazily with a decode-ahead "
+                         "thread. Default: synthetic images")
+    ap.add_argument("--val-dir", default=None,
+                    help="held-out image tree for val_acc (reference "
+                         "example's --val-dir); without it, real-data "
+                         "runs report accuracy on a training batch")
     args = ap.parse_args()
 
     hvd.init()
@@ -57,6 +65,40 @@ def main():
     n = hvd.size()
     size_hw = 32 if args.cpu else 224
     dtype = jnp.float32 if args.cpu else jnp.bfloat16
+
+    def _folder_loader(root, shuffle):
+        # Per-PROCESS batches: each host decodes only the 1/P of the
+        # global batch its own chips consume (shard_local_batch
+        # assembles the global array) — no wasted PIL work on a pod
+        # (reference: pytorch_imagenet_resnet50.py ImageFolder +
+        # DistributedSampler).
+        from horovod_tpu.data import AsyncImageFolderDataLoader
+        loader = AsyncImageFolderDataLoader(
+            root, batch_size=args.batch * hvd.local_size(),
+            image_size=size_hw, rank=hvd.process_rank(),
+            num_workers=hvd.process_size(), shuffle=shuffle,
+            drop_last=True)
+        if len(loader) == 0:
+            raise ValueError(
+                f"{root}: shard has fewer images than one per-process "
+                f"batch ({args.batch * hvd.local_size()}); lower --batch "
+                "or add data")
+        return loader
+
+    image_iter = None
+    if args.data_dir:
+        folder = _folder_loader(args.data_dir, shuffle=True)
+        args.classes = len(folder.classes)
+        if hvd.process_rank() == 0:
+            print(f"data: {args.data_dir} ({args.classes} classes)")
+
+        def _cycle():
+            epoch = 0
+            while True:
+                folder.set_epoch(epoch)
+                yield from folder
+                epoch += 1
+        image_iter = _cycle()
 
     params = replicate(resnet.init(jax.random.PRNGKey(0), depth=50,
                                    classes=args.classes, dtype=dtype),
@@ -68,8 +110,20 @@ def main():
 
     rng = np.random.RandomState(0)
 
+    # uint8 crosses the host->HBM hop; normalize on-device in one fused
+    # op (4x less transfer than a host-side float32 blow-up).
+    _normalize = jax.jit(lambda u: u.astype(dtype) / 255.0 - 0.5)
+
+    def _device_image_batch(xu, y):
+        from horovod_tpu.parallel.data_parallel import shard_local_batch
+        xg = shard_local_batch(np.ascontiguousarray(xu), mesh)
+        yg = shard_local_batch(y.astype(np.int32), mesh)
+        return _normalize(xg), yg
+
     def make_batch(step):
-        """Synthetic labeled images; replace with your input pipeline."""
+        """Next real batch when --data-dir is set, else synthetic."""
+        if image_iter is not None:
+            return _device_image_batch(*next(image_iter))
         x = rng.randn(args.batch * n, size_hw, size_hw, 3).astype(
             np.float32)
         y = rng.randint(0, args.classes, (args.batch * n,))
@@ -109,7 +163,15 @@ def main():
         if hvd.process_rank() == 0:
             print(f"resumed from step {latest}")
 
-    vx, vy = make_batch(-1)
+    if args.val_dir:
+        # true holdout (reference example's --val-dir)
+        vx, vy = _device_image_batch(*next(iter(
+            _folder_loader(args.val_dir, shuffle=False))))
+    else:
+        # synthetic runs: a fixed synthetic batch; real-data runs
+        # WITHOUT --val-dir: a training batch — accuracy then tracks
+        # train accuracy, pass --val-dir for a real metric
+        vx, vy = make_batch(-1)
     for step in range(start, args.steps):
         x, y = make_batch(step)
         params, opt_state, loss, lr_now = train_step(

@@ -1,9 +1,16 @@
 """Data loader utilities (reference: horovod/data/data_loader_base.py)."""
 
-from .loader import (AsyncDataLoaderMixin, AsyncNumpyDataLoader,
-                     AsyncParquetDataLoader, BaseDataLoader,
-                     NumpyDataLoader, ParquetDataLoader, shard_indices)
+from .fs import BaseFS, LocalFS
+from .loader import (AsyncDataLoaderMixin, AsyncImageFolderDataLoader,
+                     AsyncNumpyDataLoader, AsyncParquetDataLoader,
+                     AsyncStreamingParquetDataLoader, BaseDataLoader,
+                     ImageFolderDataLoader, NumpyDataLoader,
+                     ParquetDataLoader, StreamingParquetDataLoader,
+                     shard_indices)
 
 __all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "NumpyDataLoader",
            "AsyncNumpyDataLoader", "ParquetDataLoader",
-           "AsyncParquetDataLoader", "shard_indices"]
+           "AsyncParquetDataLoader", "StreamingParquetDataLoader",
+           "AsyncStreamingParquetDataLoader", "ImageFolderDataLoader",
+           "AsyncImageFolderDataLoader", "BaseFS", "LocalFS",
+           "shard_indices"]

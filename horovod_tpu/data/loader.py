@@ -140,20 +140,15 @@ def shard_indices(n: int, rank: int, num_workers: int,
     return idx[rank::num_workers]
 
 
-class NumpyDataLoader(BaseDataLoader):
-    """In-memory arrays -> batches, optionally sharded per worker."""
+class _ShardedIndexLoader(BaseDataLoader):
+    """Shared sharded-index machinery: per-epoch reshuffled shard
+    (DistributedSampler convention), ceil-div length, drop_last
+    truncation.  Subclasses call ``_init_sharding`` and consume
+    ``_batched_indices()`` — ONE definition of the shard/epoch/seed
+    convention, so index-dependent loaders cannot drift."""
 
-    def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
-                 rank: int = 0, num_workers: int = 1,
-                 shuffle: bool = False, seed: int = 0,
-                 drop_last: bool = False):
-        self.arrays = [np.asarray(a) for a in arrays]
-        n = len(self.arrays[0])
-        for a in self.arrays:
-            if len(a) != n:
-                raise ValueError("arrays must share the first dimension")
-        self.batch_size = batch_size
-        self.drop_last = drop_last
+    def _init_sharding(self, n: int, rank: int, num_workers: int,
+                       shuffle: bool, seed: int) -> None:
         self._epoch = 0
         self._base = dict(n=n, rank=rank, num_workers=num_workers,
                           shuffle=shuffle, seed=seed)
@@ -173,12 +168,32 @@ class NumpyDataLoader(BaseDataLoader):
         return n // self.batch_size if self.drop_last else \
             -(-n // self.batch_size)
 
-    def _iterate(self):
+    def _batched_indices(self):
         idx = self._indices()
         end = (len(idx) // self.batch_size * self.batch_size
                if self.drop_last else len(idx))
         for s in range(0, end, self.batch_size):
-            sel = idx[s:s + self.batch_size]
+            yield idx[s:s + self.batch_size]
+
+
+class NumpyDataLoader(_ShardedIndexLoader):
+    """In-memory arrays -> batches, optionally sharded per worker."""
+
+    def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
+                 rank: int = 0, num_workers: int = 1,
+                 shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = False):
+        self.arrays = [np.asarray(a) for a in arrays]
+        n = len(self.arrays[0])
+        for a in self.arrays:
+            if len(a) != n:
+                raise ValueError("arrays must share the first dimension")
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._init_sharding(n, rank, num_workers, shuffle, seed)
+
+    def _iterate(self):
+        for sel in self._batched_indices():
             yield tuple(a[sel] for a in self.arrays)
 
 
@@ -401,4 +416,78 @@ class AsyncStreamingParquetDataLoader(AsyncDataLoaderMixin,
     """Producer-thread streaming reads: the host decodes the next row
     group while the chips run the current step — the standard TPU input
     pipeline shape."""
+
+
+class ImageFolderDataLoader(_ShardedIndexLoader):
+    """Directory-per-class image batches (the torchvision-ImageFolder
+    analog backing the reference's ImageNet examples, e.g.
+    examples/pytorch/pytorch_imagenet_resnet50.py's train_dataset):
+
+        root/
+          cat/  img0.png img1.jpg ...
+          dog/  img7.png ...
+
+    Class ids are the sorted directory names' indices.  Construction
+    SCANS paths only; images decode lazily per batch (PIL), resized to
+    ``image_size``² RGB — so a dataset far larger than host memory
+    streams.  Sharding/shuffling come from _ShardedIndexLoader (the one
+    convention every loader here shares); compose AsyncDataLoaderMixin
+    (below) to decode the next batch while the chips run the current
+    step.  ``fs`` speaks the data/fs.py protocol like the parquet
+    loaders, so the tree may live on remote storage.
+
+    Batches are ``(uint8 [B, H, W, 3], int32 [B])`` — normalization
+    belongs on-device (one fused op, not a host-side float blow-up).
+    """
+
+    EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+    def __init__(self, root: str, batch_size: int, image_size: int = 224,
+                 rank: int = 0, num_workers: int = 1,
+                 shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = False, fs=None):
+        from .fs import LOCAL_FS
+        self.root = root
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.drop_last = drop_last
+        self.fs = fs or LOCAL_FS
+        self.classes = sorted(
+            d for d in self.fs.listdir(root)
+            if self.fs.isdir(self.fs.join(root, d)))
+        if not self.classes:
+            raise ValueError(f"no class directories under {root}")
+        self._files: List[str] = []
+        self._labels: List[int] = []
+        for ci, cname in enumerate(self.classes):
+            cdir = self.fs.join(root, cname)
+            for f in sorted(self.fs.listdir(cdir)):
+                if f.lower().endswith(self.EXTENSIONS):
+                    self._files.append(self.fs.join(cdir, f))
+                    self._labels.append(ci)
+        if not self._files:
+            raise ValueError(f"no images under {root} "
+                             f"(extensions: {self.EXTENSIONS})")
+        self._init_sharding(len(self._files), rank, num_workers, shuffle,
+                            seed)
+
+    def _decode(self, path: str) -> np.ndarray:
+        from PIL import Image
+        with self.fs.open(path, "rb") as fh:
+            with Image.open(fh) as im:
+                im = im.convert("RGB").resize(
+                    (self.image_size, self.image_size))
+                return np.asarray(im, np.uint8)
+
+    def _iterate(self):
+        for sel in self._batched_indices():
+            x = np.stack([self._decode(self._files[i]) for i in sel])
+            y = np.asarray([self._labels[i] for i in sel], np.int32)
+            yield x, y
+
+
+class AsyncImageFolderDataLoader(AsyncDataLoaderMixin,
+                                 ImageFolderDataLoader):
+    """Decode-ahead composition: PIL decode of batch k+1 overlaps the
+    chips' step k (the reference's PytorchAsyncDataLoader pattern)."""
 

@@ -213,6 +213,22 @@ def shard_batch(batch: Any, mesh: Mesh,
         lambda x: jax.device_put(x, sharding), batch)
 
 
+def shard_local_batch(batch: Any, mesh: Mesh,
+                      axis_name: AxisName = "hvd", axis: int = 0) -> Any:
+    """Assemble a GLOBAL batch-sharded array from each process's LOCAL
+    slice — the multi-host input-pipeline entry point: every process
+    loads ONLY the rows its own chips consume (1/P of the global batch),
+    unlike :func:`shard_batch`, which expects the full global batch on
+    every host.  Per-process loader shard -> global jax.Array, no
+    cross-host data movement."""
+    axis_name = resolve_axis(axis_name, mesh)
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    sharding = NamedSharding(mesh, P(*((None,) * axis), axes))
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x),
+        batch)
+
+
 def replicate(tree: Any, mesh: Mesh) -> Any:
     """Device-put a pytree fully replicated over the mesh.
 
