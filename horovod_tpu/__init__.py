@@ -107,6 +107,15 @@ def is_homogeneous() -> bool:
 # ----------------------------------------------------------- built/enabled API
 # Build-capability probes (reference: operations.cc:845-915 horovod_mpi_built
 # etc.).  This framework has exactly one data plane: XLA over ICI/DCN.
+# CAPABILITY_EXPORTS is the ONE list every frontend re-exports (each
+# extends its __all__ from it, so the parity surface cannot drift
+# between frontends).
+CAPABILITY_EXPORTS = (
+    "tpu_built", "xla_built", "mpi_built", "nccl_built", "gloo_built",
+    "ccl_built", "ddl_built", "cuda_built", "rocm_built", "mpi_enabled",
+    "gloo_enabled", "mpi_threads_supported", "start_timeline",
+    "stop_timeline")
+
 def tpu_built() -> bool:
     return True
 

@@ -238,3 +238,9 @@ __all__ = [
     "distribution", "sync_trainer_state", "callbacks", "elastic",
     "Compression", "ReduceOp", "Average", "Sum", "Adasum",
 ]
+
+import horovod_tpu as _root  # noqa: E402
+for _n in _root.CAPABILITY_EXPORTS:  # one shared parity surface
+    globals()[_n] = getattr(_root, _n)
+__all__ += list(_root.CAPABILITY_EXPORTS)
+del _root, _n

@@ -26,10 +26,14 @@ from .. import runtime as _rt
 from ..common.reduce_op import ReduceOp, Average, Sum
 from ..ops import collectives as _C
 from ..runtime import init, shutdown, is_initialized
+from ..common.util import check_extension  # noqa: F401
+from ..functions import (broadcast_object,  # noqa: F401
+                         allgather_object)
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size",
+    "broadcast_object", "allgather_object", "check_extension",
     "allreduce", "allreduce_", "grouped_allreduce", "grouped_allreduce_",
     "allgather", "broadcast", "broadcast_", "alltoall",
     "DistributedOptimizer", "DistributedTrainer", "broadcast_parameters",
@@ -339,3 +343,10 @@ def DistributedTrainer(params, optimizer, optimizer_params=None,
                                prescale_factor=pre)
 
     return _DistributedTrainer()
+
+
+import horovod_tpu as _root  # noqa: E402
+for _n in _root.CAPABILITY_EXPORTS:  # one shared parity surface
+    globals()[_n] = getattr(_root, _n)
+__all__ += list(_root.CAPABILITY_EXPORTS)
+del _root, _n

@@ -1,9 +1,11 @@
 """Ray integration (reference: horovod/ray/runner.py:128 RayExecutor,
 strategy.py placement, elastic.py ElasticRayExecutor)."""
 
-from .runner import (BaseWorkerPool, LocalWorkerPool, RayExecutor,
+from .runner import (BaseHorovodWorker, BaseWorkerPool, LocalWorkerPool,
+                     RayExecutor,
                      RayWorkerPool)
 from .elastic import ElasticRayExecutor, RayHostDiscovery
 
-__all__ = ["RayExecutor", "BaseWorkerPool", "LocalWorkerPool",
-           "RayWorkerPool", "ElasticRayExecutor", "RayHostDiscovery"]
+__all__ = ["RayExecutor", "BaseHorovodWorker", "BaseWorkerPool",
+           "LocalWorkerPool", "RayWorkerPool", "ElasticRayExecutor",
+           "RayHostDiscovery"]

@@ -129,6 +129,32 @@ class LocalWorkerPool(BaseWorkerPool):
 
 
 # ------------------------------------------------------------------ ray pool
+class BaseHorovodWorker:
+    """The actor class hosting one training slot (reference:
+    horovod/ray/runner.py BaseHorovodWorker — exported so integrations
+    can subclass/compose it into their own actors).  Plain class;
+    RayWorkerPool wraps it with ``ray.remote`` at placement time, the
+    reference's own pattern."""
+
+    def hostname(self) -> str:
+        import socket as s
+        return s.gethostname()
+
+    def set_env(self, env) -> None:
+        import os as o
+        o.environ.update(env)
+
+    def run(self, payload):
+        import pickle as p
+        # Actor processes get JAX_PLATFORMS via set_env but start with
+        # the raylet's own env (the driver's trigger-var pop doesn't
+        # reach them); bind the platform before loads() imports the
+        # fn's module (utils/platform.py).
+        from horovod_tpu.utils.platform import apply_env_platform
+        apply_env_platform()
+        return p.loads(payload)()
+
+
 class RayWorkerPool(BaseWorkerPool):
     """Ray-actor pool with Colocated/Pack placement (reference:
     strategy.py:32-204).  Requires ray at construction."""
@@ -154,26 +180,7 @@ class RayWorkerPool(BaseWorkerPool):
 
     def create(self, num_workers: int) -> None:
         ray = self._ray
-
-        @ray.remote
-        class _Worker:
-            def hostname(self):
-                import socket as s
-                return s.gethostname()
-
-            def set_env(self, env):
-                import os as o
-                o.environ.update(env)
-
-            def run(self, payload):
-                import pickle as p
-                # Actor processes get JAX_PLATFORMS via set_env but start
-                # with the raylet's own env (the driver's trigger-var pop
-                # doesn't reach them); bind the platform before loads()
-                # imports the fn's module (utils/platform.py).
-                from horovod_tpu.utils.platform import apply_env_platform
-                apply_env_platform()
-                return p.loads(payload)()
+        _Worker = ray.remote(BaseHorovodWorker)
 
         bundle = {"CPU": self.cpus_per_worker}
         if self.use_gpu and self.gpus_per_worker:

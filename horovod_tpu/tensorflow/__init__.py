@@ -37,19 +37,20 @@ import numpy as np
 import tensorflow as tf
 
 from .. import runtime as _rt
-from .. import (tpu_built, xla_built, mpi_built, nccl_built, gloo_built,
-                ccl_built, ddl_built, cuda_built, rocm_built, mpi_enabled,
-                gloo_enabled, mpi_threads_supported,
-                start_timeline, stop_timeline)
 from ..common.reduce_op import (ReduceOp, Average, Sum, Adasum, Min, Max,
                                 Product)
 from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..ops import collectives as _C
 from ..runtime import init, shutdown, is_initialized
 from .compression import Compression
-from .functions import (broadcast_object, broadcast_variables,
+from .functions import (broadcast_object, broadcast_object_fn,
+                        broadcast_variables,
                         broadcast_global_variables, allgather_object)
 from .sync_batch_norm import SyncBatchNormalization
+from ..common.util import (check_extension, check_num_rank_power_of_2,
+                           gpu_available)
+from . import elastic  # noqa: F401  (hvd.elastic.* parity, reference
+#                        tensorflow/__init__.py:30 imports the submodule)
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
@@ -60,14 +61,18 @@ __all__ = [
     "BroadcastGlobalVariablesHook",
     "DistributedOptimizer",
     "DistributedGradientTape", "broadcast_variables",
-    "broadcast_global_variables", "broadcast_object", "allgather_object",
+    "broadcast_global_variables", "broadcast_object",
+    "broadcast_object_fn", "allgather_object", "check_extension",
+    "check_num_rank_power_of_2", "gpu_available", "elastic",
     "SyncBatchNormalization", "Compression", "ReduceOp", "Average", "Sum",
     "Adasum", "Min", "Max", "Product",
-    "tpu_built", "xla_built", "mpi_built", "nccl_built", "gloo_built",
-    "ccl_built", "ddl_built", "cuda_built", "rocm_built", "mpi_enabled",
-    "gloo_enabled", "mpi_threads_supported",
-    "start_timeline", "stop_timeline",
 ]
+
+import horovod_tpu as _root  # noqa: E402
+for _n in _root.CAPABILITY_EXPORTS:  # one shared parity surface
+    globals()[_n] = getattr(_root, _n)
+__all__ += list(_root.CAPABILITY_EXPORTS)
+del _root, _n
 
 
 def size_op(name=None):

@@ -59,3 +59,17 @@ def broadcast_object(obj: Any, root_rank: int = 0,
 
 def allgather_object(obj: Any, name: Optional[str] = None) -> list:
     return _F.allgather_object(obj, name=name)
+
+
+def broadcast_object_fn(root_rank: int = 0, session=None,
+                        name: Optional[str] = None):
+    """Return a callable broadcasting any picklable object from
+    ``root_rank`` (reference: tensorflow/functions.py:103-130 — a TF1
+    placeholder graph built once and fed per call; eager TF2 needs no
+    graph, so this closes over the rank instead).  ``session`` is
+    accepted for signature parity and ignored."""
+    del session
+
+    def _bcast(obj: Any) -> Any:
+        return broadcast_object(obj, root_rank=root_rank, name=name)
+    return _bcast

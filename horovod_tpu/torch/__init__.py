@@ -18,16 +18,13 @@ from __future__ import annotations
 # Topology + lifecycle re-exported from the package root.
 from .. import (init, shutdown, is_initialized, rank, size, local_rank,
                 local_size, cross_rank, cross_size, process_rank,
-                process_size, mesh, is_homogeneous,
-                tpu_built, xla_built, mpi_built, nccl_built, gloo_built,
-                ccl_built, ddl_built, cuda_built, rocm_built, mpi_enabled,
-                gloo_enabled, mpi_threads_supported,
-                start_timeline, stop_timeline)
+                process_size, mesh, is_homogeneous)
 from ..common.reduce_op import ReduceOp, Average, Sum, Adasum, Min, Max, \
     Product
 from ..common.exceptions import (HorovodInternalError,
                                  HostsUpdatedInterrupt)
 
+from ..common.util import check_extension
 from .compression import Compression
 from .mpi_ops import (allreduce, allreduce_, allreduce_async,
                       allreduce_async_, grouped_allreduce,
@@ -43,6 +40,7 @@ from .sync_batch_norm import SyncBatchNorm
 from . import elastic
 
 __all__ = [
+    "check_extension",
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "process_rank", "process_size",
     "mesh", "is_homogeneous",
@@ -58,8 +56,11 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object", "SyncBatchNorm", "elastic",
     "HorovodInternalError", "HostsUpdatedInterrupt",
-    "tpu_built", "xla_built", "mpi_built", "nccl_built", "gloo_built",
-    "ccl_built", "ddl_built", "cuda_built", "rocm_built", "mpi_enabled",
-    "gloo_enabled", "mpi_threads_supported",
-    "start_timeline", "stop_timeline",
 ]
+
+
+import horovod_tpu as _root  # noqa: E402
+for _n in _root.CAPABILITY_EXPORTS:  # one shared parity surface
+    globals()[_n] = getattr(_root, _n)
+__all__ += list(_root.CAPABILITY_EXPORTS)
+del _root, _n

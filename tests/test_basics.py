@@ -37,3 +37,44 @@ def test_reduce_op_constants(hvd):
     assert int(hvd.Average) == 0
     assert int(hvd.Sum) == 1
     assert int(hvd.Adasum) == 2
+
+
+def test_frontend_api_parity_names():
+    """Names the reference exports per frontend that users script
+    against (reference: horovod/{torch,tensorflow,keras,mxnet,ray}
+    __init__ public surfaces); an AST audit found these missing in r4 —
+    pin them so they cannot regress.  Every frontend must also expose
+    the WHOLE shared capability surface (hvd.CAPABILITY_EXPORTS), both
+    as attributes and in __all__."""
+    import importlib
+
+    import pytest
+
+    import horovod_tpu
+    surface = {
+        "horovod_tpu.torch": ("torch", ["check_extension"]),
+        "horovod_tpu.keras": ("keras", []),
+        "horovod_tpu.mxnet": (None, ["allgather_object",
+                                     "broadcast_object",
+                                     "check_extension"]),
+        "horovod_tpu.ray": (None, ["BaseHorovodWorker"]),
+    }
+    for mod, (dep, names) in surface.items():
+        if dep is not None:
+            pytest.importorskip(dep)
+        m = importlib.import_module(mod)
+        if mod != "horovod_tpu.ray":  # ray surface has no probes in ref
+            names = list(names) + list(horovod_tpu.CAPABILITY_EXPORTS)
+            missing = [n for n in names if not hasattr(m, n)]
+            not_exported = [n for n in horovod_tpu.CAPABILITY_EXPORTS
+                            if n not in m.__all__]
+            assert not not_exported, f"{mod} __all__ missing {not_exported}"
+        else:
+            missing = [n for n in names if not hasattr(m, n)]
+        assert not missing, f"{mod} missing {missing}"
+    # common.util semantics
+    from horovod_tpu.common.util import (check_num_rank_power_of_2,
+                                         split_list)
+    assert check_num_rank_power_of_2(8) and \
+        not check_num_rank_power_of_2(6)
+    assert split_list(list(range(7)), 3) == [[0, 1, 2], [3, 4], [5, 6]]
