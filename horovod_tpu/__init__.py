@@ -177,6 +177,9 @@ def stop_timeline() -> None:
 
 # xprof deep-dive profiling (NVTX-ranges analog; utils/profiler.py)
 from .utils import profiler  # noqa: E402
+# hyperparameter search over the native GP (reference:
+# docs/hyperparameter_search.rst's Ray Tune story)
+from . import tune  # noqa: E402
 
 
 __all__ = [
@@ -197,7 +200,7 @@ __all__ = [
     "tpu_built", "xla_built", "mpi_built", "nccl_built", "gloo_built",
     "ccl_built", "ddl_built", "cuda_built", "rocm_built",
     "mpi_enabled", "gloo_enabled", "mpi_threads_supported",
-    "start_timeline", "stop_timeline", "profiler",
+    "start_timeline", "stop_timeline", "profiler", "tune",
     "CheckpointManager", "save_checkpoint", "restore_checkpoint",
     "flash_attention", "run",
     "__version__", "probe_backend",

@@ -1,0 +1,147 @@
+"""Hyperparameter search (reference: docs/hyperparameter_search.rst —
+Ray Tune grid/Bayesian trials over distributed training functions; here
+the Bayesian engine is the native GP+EI from csrc/optim.cc and trials
+place through the framework's own executors)."""
+
+import pytest
+
+from horovod_tpu import tune
+
+
+def quad(config):
+    # minimum at lr=0.3
+    return (config["lr"] - 0.3) ** 2
+
+
+def test_grid_search_exhaustive_best():
+    res = tune.run(quad, config={"lr": tune.grid_search(
+        [0.1, 0.2, 0.3, 0.4])}, metric="loss", mode="min")
+    assert len(res.trials) == 4
+    assert res.best_config["lr"] == 0.3
+    assert res.best_metric == 0.0
+
+
+def test_grid_search_crosses_axes():
+    seen = []
+
+    def f(cfg):
+        seen.append((cfg["a"], cfg["b"]))
+        return cfg["a"] + cfg["b"]
+
+    res = tune.run(f, config={"a": tune.grid_search([1, 2]),
+                              "b": tune.grid_search([10, 20]),
+                              "c": "fixed"},
+                   metric="loss", mode="min")
+    assert sorted(seen) == [(1, 10), (1, 20), (2, 10), (2, 20)]
+    assert res.best_config["a"] == 1 and res.best_config["b"] == 10
+    assert res.best_config["c"] == "fixed"
+
+
+def test_bayes_converges_on_quadratic():
+    res = tune.run(quad, config={"lr": tune.uniform(0.0, 1.0)},
+                   metric="loss", mode="min", num_trials=20, seed=7)
+    assert res.best_metric < 0.01  # |lr - 0.3| < 0.1
+    assert abs(res.best_config["lr"] - 0.3) < 0.1
+
+
+def test_bayes_mode_max_and_report_api():
+    def f(cfg):
+        tune.report(acc=1.0 - (cfg["x"] - 0.7) ** 2)  # no return value
+
+    res = tune.run(f, config={"x": tune.uniform(0.0, 1.0)},
+                   metric="acc", mode="max", num_trials=20, seed=3)
+    assert res.best_metric > 0.95
+    assert abs(res.best_config["x"] - 0.7) < 0.25
+
+
+def test_choice_and_loguniform_domains():
+    def f(cfg):
+        assert cfg["opt"] in ("sgd", "adam")
+        assert 1e-5 <= cfg["lr"] <= 1e-1
+        return cfg["lr"] if cfg["opt"] == "sgd" else cfg["lr"] * 10
+
+    res = tune.run(f, config={"lr": tune.loguniform(1e-5, 1e-1),
+                              "opt": tune.choice(["sgd", "adam"])},
+                   metric="loss", mode="min", num_trials=12, seed=1)
+    assert res.best_metric is not None
+
+
+def test_failed_trials_do_not_kill_search():
+    def f(cfg):
+        if cfg["lr"] > 0.5:
+            raise RuntimeError("diverged")
+        return cfg["lr"]
+
+    res = tune.run(f, config={"lr": tune.grid_search(
+        [0.1, 0.9, 0.2, 0.8])}, metric="loss", mode="min")
+    errs = [t for t in res.trials if t.error]
+    assert len(errs) == 2 and "diverged" in errs[0].error
+    assert res.best_config["lr"] == 0.1
+
+
+def test_grid_may_not_mix_with_continuous():
+    with pytest.raises(ValueError, match="grid_search"):
+        tune.run(quad, config={"lr": tune.grid_search([1]),
+                               "x": tune.uniform(0, 1)},
+                 metric="loss")
+
+
+def test_report_is_noop_outside_trials():
+    tune.report(loss=1.0)  # must not raise
+
+
+# module-level for spawn pickling
+def _dist_trial(config):
+    import os
+    import horovod_tpu as hvd
+    hvd.init()
+    # every worker computes the same metric; rank 0's scores the trial
+    rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
+    return (config["lr"] - 0.25) ** 2 + 0.0 * rank
+
+
+def test_distributed_trainable_runs_workers():
+    trial = tune.distributed_trainable(_dist_trial, num_proc=2)
+    res = tune.run(trial, config={"lr": tune.grid_search([0.1, 0.25])},
+                   metric="loss", mode="min")
+    assert res.best_config["lr"] == 0.25
+    assert res.best_metric == 0.0
+
+
+def test_no_search_axes_runs_single_trial():
+    res = tune.run(lambda c: c["batch"] * 0.5,
+                   config={"batch": 2}, metric="loss")
+    assert len(res.trials) == 1 and res.best_metric == 1.0
+
+
+def test_loguniform_validates_bounds():
+    with pytest.raises(ValueError, match="0 < low < high"):
+        tune.loguniform(0, 1e-1)
+    with pytest.raises(ValueError, match="low < high"):
+        tune.uniform(2.0, 1.0)
+
+
+def _report_only_dist(config):
+    import horovod_tpu as hvd
+    hvd.init()
+    from horovod_tpu import tune as t
+    t.report(loss=(config["lr"] - 0.25) ** 2)  # no return value
+
+
+def test_distributed_trainable_forwards_worker_reports():
+    trial = tune.distributed_trainable(_report_only_dist, num_proc=2)
+    res = tune.run(trial, config={"lr": tune.grid_search([0.1, 0.25])},
+                   metric="loss", mode="min")
+    assert res.best_config["lr"] == 0.25 and res.best_metric == 0.0
+
+
+def _silent_dist(config):
+    import horovod_tpu as hvd
+    hvd.init()
+
+
+def test_distributed_trainable_raises_on_no_metric():
+    trial = tune.distributed_trainable(_silent_dist, num_proc=1)
+    res = tune.run(trial, config={"lr": tune.grid_search([0.1])},
+                   metric="loss")
+    assert res.trials[0].error and "no metric" in res.trials[0].error
