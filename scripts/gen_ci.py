@@ -68,6 +68,7 @@ SUITES = {
     "state-elastic-data": [
         "tests/test_data.py", "tests/test_checkpoint.py",
         "tests/test_elastic.py", "tests/test_tune.py",
+        "tests/test_platform_utils.py",
     ],
     "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py",
                        "tests/test_profile_analyzer.py"],
