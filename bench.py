@@ -275,6 +275,11 @@ def main() -> int:
     ap.add_argument("--no-fuse", action="store_true",
                     help="back-compat no-op: unfused is the default; "
                          "kept so recorded sweep configs stay runnable")
+    ap.add_argument("--scan-unroll", type=int, default=1,
+                    help="lax.scan unroll factor for the timed step loop "
+                         "(unrolled iterations drop loop overhead and let "
+                         "XLA overlap across step boundaries; program "
+                         "size grows proportionally)")
     ap.add_argument("--ce-chunks", type=int, default=0,
                     help="stream the lm_head+cross-entropy over N sequence "
                          "chunks under jax.checkpoint (0 = whole-sequence "
@@ -397,7 +402,7 @@ def main() -> int:
         lambda p, ids: llama.loss_fn(p, ids, cfg, attn_fn=attn_fn,
                                      remat=args.remat,
                                      ce_chunks=args.ce_chunks),
-        opt, mesh)
+        opt, mesh, unroll=args.scan_unroll)
     params = replicate(params, mesh)
     opt_state = replicate(opt.init(params), mesh)
 

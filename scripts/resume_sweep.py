@@ -45,6 +45,9 @@ MATRIX = [
     # these had a valid recorded line before the default flip).
     ("seq256-b64", ["--no-fuse", "--seq", "256", "--batch", "64",
                     "--steps", "30"]),
+    # loop-overhead probe: unrolled scan drops per-step control overhead
+    # and lets XLA software-pipeline across step boundaries
+    ("unroll3-b16", ["--no-fuse", "--scan-unroll", "3", "--steps", "30"]),
     ("batch-20", ["--no-fuse", "--batch", "20", "--steps", "30"]),
     ("llama1b-b8-remat-ce8",
      ["--no-fuse", "--model", "1b", "--batch", "8", "--remat",

@@ -284,3 +284,40 @@ def test_inception_v3_forward_and_grads():
     for blk in ("a0", "b0", "c0", "d0", "e1"):
         leaves = jax.tree_util.tree_leaves(g[blk])
         assert sum(float(jnp.abs(l).sum()) for l in leaves) > 0, blk
+
+
+def test_scanned_train_step_unroll_equivalent(hvd):
+    """lax.scan unrolling is a pure scheduling lever: params/losses must
+    be bit-identical to unroll=1 (bench exposes it as --scan-unroll)."""
+    from horovod_tpu.models import mlp
+    from horovod_tpu.parallel.data_parallel import (
+        make_scanned_train_step, replicate, shard_batch)
+
+    mesh = hvd.mesh()
+    params = mlp.init(jax.random.PRNGKey(0), in_dim=8, hidden=16,
+                      classes=4)
+    opt = optax.sgd(0.1)
+
+    def loss_fn(p, batch):
+        x, y = batch[:, :-1], batch[:, -1].astype(jnp.int32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            mlp.apply(p, x), y).mean()
+
+    rng = np.random.RandomState(0)
+    data = np.concatenate(
+        [rng.randn(6, 16, 8).astype(np.float32),
+         rng.randint(0, 4, (6, 16, 1)).astype(np.float32)], axis=2)
+    batches = shard_batch(jnp.asarray(data), mesh, axis=1)
+
+    outs = []
+    for unroll in (1, 3):
+        run = make_scanned_train_step(loss_fn, opt, mesh, unroll=unroll)
+        p = replicate(params, mesh)
+        s = replicate(opt.init(params), mesh)
+        p, s, losses = run(p, s, batches)
+        outs.append((np.asarray(losses), p))
+    np.testing.assert_array_equal(outs[0][0], outs[1][0])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        outs[0][1], outs[1][1])
