@@ -97,6 +97,10 @@ _knob("HOROVOD_NUM_STREAMS", 1, int,
       "Parallelism for eager collective dispatch (analog of "
       "HOROVOD_NUM_NCCL_STREAMS, reference global_state.h:92-95).")
 # --- rendezvous / launcher (reference: gloo_run.py:187-212) ---
+_knob("HOROVOD_GLOO_TIMEOUT_SECONDS", 30, int,
+      "Rendezvous KV client patience: how long a worker polls the HTTP "
+      "rendezvous for a key before giving up (reference: "
+      "--gloo-timeout-seconds).")
 _knob("HOROVOD_RENDEZVOUS_ADDR", "", str, "Rendezvous HTTP server address.")
 _knob("HOROVOD_RENDEZVOUS_PORT", 0, int, "Rendezvous HTTP server port.")
 _knob("HOROVOD_RANK", -1, int, "Global process rank assigned by the launcher.")

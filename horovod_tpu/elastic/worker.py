@@ -59,7 +59,7 @@ class WorkerNotificationManager:
             return 0
         try:
             v = get_kv(self.addr, self.port, HOST_UPDATE_SCOPE,
-                       HOST_UPDATE_KEY)
+                       HOST_UPDATE_KEY, timeout=0)  # poll, never block
             return int(v) if v else 0
         except Exception:
             return 0

@@ -19,10 +19,16 @@ def put_kv(addr: str, port: int, scope: str, key: str,
 
 
 def get_kv(addr: str, port: int, scope: str, key: str,
-           timeout: float = 0.0,
+           timeout: Optional[float] = None,
            poll_interval: float = 0.2) -> Optional[bytes]:
-    """GET with optional blocking-until-present semantics (workers wait for
-    the launcher to publish slot info)."""
+    """GET with blocking-until-present semantics (workers wait for the
+    launcher to publish slot info).  ``timeout=None`` reads
+    HOROVOD_GLOO_TIMEOUT_SECONDS (reference: --gloo-timeout-seconds, the
+    knob bounding how long workers wait on the rendezvous); pass 0 for
+    a non-blocking probe."""
+    if timeout is None:
+        from ..common.knobs import current
+        timeout = float(current("HOROVOD_GLOO_TIMEOUT_SECONDS"))
     url = f"http://{addr}:{port}/{scope}/{key}"
     deadline = time.time() + timeout
     while True:

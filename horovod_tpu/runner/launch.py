@@ -107,13 +107,51 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", default=None,
                    help="mesh spec, e.g. 'data=8' or 'data=4,model=2'")
     p.add_argument("--timeline-filename", default=None)
-    p.add_argument("--timeline-mark-cycles", action="store_true")
-    p.add_argument("--no-stall-check", action="store_true")
-    p.add_argument("--stall-check-time-seconds", type=int, default=None)
-    p.add_argument("--stall-shutdown-time-seconds", type=int, default=None)
+    tl_mc = p.add_mutually_exclusive_group()
+    tl_mc.add_argument("--timeline-mark-cycles", action="store_true",
+                       default=None)
+    tl_mc.add_argument("--no-timeline-mark-cycles",
+                       dest="timeline_mark_cycles", action="store_false")
+    # reference spells the stall flags as a --stall-check pair plus
+    # -warning-/-shutdown- time names (launch.py:469-489); both
+    # spellings funnel to the same knobs
+    stall = p.add_mutually_exclusive_group()
+    stall.add_argument("--stall-check", dest="no_stall_check",
+                       action="store_false", default=None)
+    stall.add_argument("--no-stall-check", action="store_true")
+    p.add_argument("--stall-check-time-seconds",
+                   "--stall-check-warning-time-seconds",
+                   dest="stall_check_time_seconds", type=int, default=None)
+    p.add_argument("--stall-shutdown-time-seconds",
+                   "--stall-check-shutdown-time-seconds",
+                   dest="stall_shutdown_time_seconds", type=int,
+                   default=None)
     p.add_argument("--log-level", default=None,
                    choices=["trace", "debug", "info", "warning", "error",
                             "fatal"])
+    log_ts = p.add_mutually_exclusive_group()
+    log_ts.add_argument("--log-hide-timestamp", "--log-without-timestamp",
+                        dest="log_hide_timestamp", action="store_true",
+                        default=None)
+    log_ts.add_argument("--no-log-hide-timestamp", "--log-with-timestamp",
+                        dest="log_hide_timestamp", action="store_false")
+    p.add_argument("--gloo-timeout-seconds", type=int, default=None,
+                   help="rendezvous KV client patience (reference: "
+                        "launch.py --gloo-timeout-seconds; here it bounds "
+                        "HTTP rendezvous waits, "
+                        "HOROVOD_GLOO_TIMEOUT_SECONDS)")
+    # CPU-affinity/MPI-thread knobs have no TPU analog; accepted so
+    # reference launch scripts run unchanged, with a warning (not
+    # silence) so nobody believes they took effect
+    p.add_argument("--binding-args", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--thread-affinity", default=None,
+                   help=argparse.SUPPRESS)
+    mpi_thr = p.add_mutually_exclusive_group()
+    mpi_thr.add_argument("--mpi-threads-disable", action="store_true",
+                         default=None, help=argparse.SUPPRESS)
+    mpi_thr.add_argument("--no-mpi-threads-disable",
+                         dest="mpi_threads_disable", action="store_false",
+                         help=argparse.SUPPRESS)
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
     p.add_argument("--autotune-warmup-samples", type=int, default=None)
@@ -210,10 +248,25 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_TPU_MESH"] = args.mesh
     if args.timeline_filename:
         env["HOROVOD_TIMELINE"] = args.timeline_filename
-    if args.timeline_mark_cycles:
-        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
-    if args.no_stall_check:
-        env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    if args.timeline_mark_cycles is not None:
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = \
+            "1" if args.timeline_mark_cycles else "0"
+    if args.no_stall_check is not None:
+        env["HOROVOD_STALL_CHECK_DISABLE"] = \
+            "1" if args.no_stall_check else "0"
+    if args.log_hide_timestamp is not None:
+        env["HOROVOD_LOG_HIDE_TIME"] = \
+            "1" if args.log_hide_timestamp else "0"
+    if args.gloo_timeout_seconds is not None:
+        env["HOROVOD_GLOO_TIMEOUT_SECONDS"] = \
+            str(args.gloo_timeout_seconds)
+    for flag, val in (("--binding-args", args.binding_args),
+                      ("--thread-affinity", args.thread_affinity),
+                      ("--mpi-threads-disable", args.mpi_threads_disable)):
+        if val is not None:
+            print(f"hvdrun: {flag} has no effect on a TPU stack "
+                  "(CPU-affinity/MPI-thread knob); accepted for launch-"
+                  "script compatibility only", file=sys.stderr)
     if args.stall_check_time_seconds is not None:
         env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = \
             str(args.stall_check_time_seconds)

@@ -138,9 +138,11 @@ def test_rendezvous_kv_roundtrip():
     try:
         put_kv("127.0.0.1", port, "scope", "key", b"value42")
         assert get_kv("127.0.0.1", port, "scope", "key") == b"value42"
-        assert get_kv("127.0.0.1", port, "scope", "missing") is None
+        assert get_kv("127.0.0.1", port, "scope", "missing",
+                      timeout=0) is None
         assert delete_kv("127.0.0.1", port, "scope", "key")
-        assert get_kv("127.0.0.1", port, "scope", "key") is None
+        assert get_kv("127.0.0.1", port, "scope", "key",
+                      timeout=0) is None
         # server-side direct put (launcher publishing slot info)
         srv.put("rank", "0", b"{}")
         assert get_kv("127.0.0.1", port, "rank", "0") == b"{}"
@@ -441,3 +443,50 @@ def test_transport_selector_flags():
 def test_hostnames_alias():
     args = make_parser().parse_args(["--hostnames", "a:1,b:1", "cmd"])
     assert args.hosts == "a:1,b:1"
+
+
+def test_get_kv_default_patience_follows_gloo_timeout_knob(monkeypatch):
+    """timeout=None reads HOROVOD_GLOO_TIMEOUT_SECONDS (reference:
+    --gloo-timeout-seconds bounds worker waits on the rendezvous)."""
+    import time as _time
+
+    from horovod_tpu.runner.http_server import RendezvousServer
+    from horovod_tpu.runner.http_client import get_kv
+
+    monkeypatch.setenv("HOROVOD_GLOO_TIMEOUT_SECONDS", "1")
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        t0 = _time.monotonic()
+        assert get_kv("127.0.0.1", port, "s", "never") is None
+        waited = _time.monotonic() - t0
+        assert 0.8 <= waited < 5.0, waited  # knob-bounded, not 0/30s
+    finally:
+        srv.stop()
+
+
+def test_reference_flag_spellings_funnel_to_knobs(capsys):
+    """The upstream launcher's exact flag spellings must work unchanged
+    (reference launch.py:469-527): stall-check pair + warning/shutdown
+    names, log-timestamp pairs, gloo timeout; CPU-affinity flags are
+    accepted with a warning, never silently."""
+    args = make_parser().parse_args(
+        ["-np", "2", "--stall-check",
+         "--stall-check-warning-time-seconds", "30",
+         "--stall-check-shutdown-time-seconds", "90",
+         "--log-with-timestamp", "--gloo-timeout-seconds", "45",
+         "--no-timeline-mark-cycles",
+         "--binding-args", "-bind-to socket",
+         "python", "t.py"])
+    env = args_to_env(args)
+    assert env["HOROVOD_STALL_CHECK_DISABLE"] == "0"
+    assert env["HOROVOD_STALL_CHECK_TIME_SECONDS"] == "30"
+    assert env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] == "90"
+    assert env["HOROVOD_LOG_HIDE_TIME"] == "0"
+    assert env["HOROVOD_GLOO_TIMEOUT_SECONDS"] == "45"
+    assert env["HOROVOD_TIMELINE_MARK_CYCLES"] == "0"
+    assert "no effect on a TPU stack" in capsys.readouterr().err
+
+    args = make_parser().parse_args(
+        ["-np", "2", "--log-hide-timestamp", "python", "t.py"])
+    assert args_to_env(args)["HOROVOD_LOG_HIDE_TIME"] == "1"
