@@ -45,7 +45,7 @@ SUITES = {
         "tests/test_basics.py", "tests/test_collectives.py",
         "tests/test_optimizer.py", "tests/test_fsdp.py",
         "tests/test_zero.py", "tests/test_adasum.py",
-        "tests/test_hierarchical.py",
+        "tests/test_hierarchical.py", "tests/test_quantized.py",
     ],
     "models-kernels": [
         "tests/test_models.py", "tests/test_flash_attention.py",
