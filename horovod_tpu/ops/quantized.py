@@ -4,8 +4,8 @@ Technique: EQuARX — Efficient Quantized AllReduce in XLA
 (arxiv.org/pdf/2506.17615; listed in PAPERS.md): decompose the
 allreduce into its ring reduce-scatter + allgather phases and quantize
 each HOP's payload to int8 with a fresh per-chunk scale, so the wire
-carries ~1/4 the bytes of a bf16 allreduce while accumulation stays
-full precision.  A plain ``psum`` of int8 values cannot do this
+carries 1/4 the bytes of an fp32 allreduce (half a bf16 one) while
+accumulation stays full precision.  A plain ``psum`` of int8 values cannot do this
 (integer overflow, and per-rank scales don't commute with the sum) —
 the hop structure is the point.
 

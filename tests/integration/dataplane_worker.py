@@ -115,6 +115,30 @@ def main() -> int:
     for i, o in enumerate(outs):
         assert np.allclose(np.asarray(o), expect + 8.0 * i), (i, o)
 
+    # ---- quantized-wire sync across the process boundary --------------
+    # The int8 ring (ops/quantized.py) rides ppermute over the GLOBAL
+    # mesh: its cross-process collective_permute hops only execute here.
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.ops._compat import shard_map
+    from horovod_tpu.optimizer import sync_gradients
+    mesh = hvd.mesh()
+    g_local = np.stack([np.full((16,), float(pos), np.float32)
+                        for pos in positions])
+    g_global = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("hvd")), g_local)
+    qf = jax.jit(shard_map(
+        lambda g: sync_gradients({"g": g}, "hvd",
+                                 quantized_wire=True)["g"],
+        mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd"),
+        check_vma=False))
+    q_out = jax.block_until_ready(qf(g_global))
+    for shard in q_out.addressable_shards:
+        # per-chunk constants quantize exactly; mean(0..7) = 3.5
+        assert np.allclose(np.asarray(shard.data), 3.5, atol=0.02), \
+            np.asarray(shard.data)
+
     # ---- barrier ------------------------------------------------------
     hvd.barrier()
 
