@@ -63,7 +63,7 @@ SUITES = {
         "tests/test_spark_ray.py", "tests/test_spark_estimator_depth.py",
         "tests/test_spark_prepare.py",
         "tests/test_real_backend_fakes.py", "tests/test_runner.py",
-        "tests/test_ci_pipeline.py",
+        "tests/test_ci_pipeline.py", "tests/test_docs_refs.py",
     ],
     "state-elastic-data": [
         "tests/test_data.py", "tests/test_checkpoint.py",
