@@ -5,12 +5,14 @@ from .loader import (AsyncDataLoaderMixin, AsyncImageFolderDataLoader,
                      AsyncNumpyDataLoader, AsyncParquetDataLoader,
                      AsyncStreamingParquetDataLoader, BaseDataLoader,
                      ImageFolderDataLoader, NumpyDataLoader,
-                     ParquetDataLoader, StreamingParquetDataLoader,
+                     ParquetDataLoader, ShuffleBufferLoader,
+                     StreamingParquetDataLoader,
                      shard_indices)
 
 __all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "NumpyDataLoader",
            "AsyncNumpyDataLoader", "ParquetDataLoader",
            "AsyncParquetDataLoader", "StreamingParquetDataLoader",
            "AsyncStreamingParquetDataLoader", "ImageFolderDataLoader",
-           "AsyncImageFolderDataLoader", "BaseFS", "LocalFS",
+           "AsyncImageFolderDataLoader", "ShuffleBufferLoader", "BaseFS",
+           "LocalFS",
            "shard_indices"]

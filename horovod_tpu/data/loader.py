@@ -418,6 +418,79 @@ class AsyncStreamingParquetDataLoader(AsyncDataLoaderMixin,
     pipeline shape."""
 
 
+class ShuffleBufferLoader(BaseDataLoader):
+    """Streaming shuffle over dict-batch loaders (the petastorm
+    ``shuffle_buffer_size`` semantics the reference estimators expose,
+    spark/common/params.py): rows from the inner loader fill a
+    ``buffer_rows`` reservoir; each emitted batch draws uniformly from
+    the full buffer, which refills as it drains.  Randomness quality
+    scales with the buffer (buffer >= dataset = a true shuffle); memory
+    is bounded by ``buffer_rows`` regardless of dataset size.
+
+    ``set_epoch`` reseeds so epochs see different orders
+    (DistributedSampler convention, like the index-based loaders)."""
+
+    def __init__(self, inner: BaseDataLoader, buffer_rows: int,
+                 seed: int = 0):
+        if buffer_rows < 1:
+            raise ValueError(f"buffer_rows must be >= 1, got {buffer_rows}")
+        self.inner = inner
+        self.buffer_rows = buffer_rows
+        self.seed = seed
+        self._epoch = 0
+        self.batch_size = getattr(inner, "batch_size", None)
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        if hasattr(self.inner, "set_epoch"):
+            self.inner.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.inner)
+
+    def _iterate(self):
+        # The standard exchange reservoir (TF/petastorm shuffle-buffer
+        # algorithm, vectorized): once the buffer is full, an incoming
+        # batch of k rows picks k DISTINCT slots, emits their occupants,
+        # and takes their places — O(k) row traffic per batch, never a
+        # whole-buffer copy.  The multiset of rows is preserved exactly.
+        rng = np.random.RandomState(self.seed + self._epoch)
+        buf: dict = {}
+        have = 0
+
+        for batch in self.inner:
+            batch = {k: np.asarray(v) for k, v in batch.items()}
+            k_rows = len(next(iter(batch.values())))
+            if have < self.buffer_rows:
+                take = min(self.buffer_rows - have, k_rows)
+                head = {k: v[:take] for k, v in batch.items()}
+                if not buf:
+                    buf = head
+                else:
+                    buf = {k: np.concatenate([buf[k], head[k]])
+                           for k in buf}
+                have += take
+                batch = {k: v[take:] for k, v in batch.items()}
+                k_rows -= take
+                if k_rows == 0:
+                    continue
+            sel = rng.choice(have, size=min(k_rows, have), replace=False)
+            out = {k: buf[k][sel].copy() for k in buf}
+            for k in buf:
+                buf[k][sel] = batch[k][:len(sel)]
+            if k_rows > len(sel):  # batch bigger than the buffer: pass
+                out = {k: np.concatenate([out[k], batch[k][len(sel):]])
+                       for k in out}
+            yield out
+        # drain: remaining buffered rows in one random order, chunked
+        if have:
+            order = rng.permutation(have)
+            step = self.batch_size or have
+            for s in range(0, have, step):
+                sel = order[s:s + step]
+                yield {k: buf[k][sel] for k in buf}
+
+
 class ImageFolderDataLoader(_ShardedIndexLoader):
     """Directory-per-class image batches (the torchvision-ImageFolder
     analog backing the reference's ImageNet examples, e.g.

@@ -83,18 +83,53 @@ def _load_epoch_checkpoint(store: Store, run_id: str) -> Optional[Dict]:
     return {"fmt": 0, "epoch": -1, "model": payload, "history": {}}
 
 
+def _make_train_loader(store, path: str, batch_size: int, rank: int,
+                       size: int, opts: Dict):
+    """Worker-side train reader honoring the data params
+    (shuffle_buffer_size -> ShuffleBufferLoader wrap; streaming base)."""
+    loader = StreamingParquetDataLoader(path, batch_size, rank=rank,
+                                        num_workers=size, fs=store.fs)
+    if opts.get("shuffle_buffer_size"):
+        from ..data.loader import ShuffleBufferLoader
+        loader = ShuffleBufferLoader(loader, opts["shuffle_buffer_size"],
+                                     seed=opts.get("seed", 0))
+    return loader
+
+
+def _iter_train(loader, epoch: int, opts: Dict):
+    """One epoch's batches: per-epoch reshuffle (set_epoch), the
+    train_steps_per_epoch cap, and the transformation_fn hook."""
+    import itertools
+    if hasattr(loader, "set_epoch"):
+        loader.set_epoch(epoch)
+    cap = opts.get("train_steps_per_epoch")
+    transform = opts.get("transformation_fn")
+    it = iter(loader) if cap is None else itertools.islice(loader, cap)
+    for batch in it:  # islice never pulls the batch past the cap
+        yield transform(batch) if transform else batch
+
+
 def _eval_metrics(predict: Callable, val_path: Optional[str],
                   feature_cols, label_cols, metrics, batch_size: int,
-                  rank: int, size: int, sync, fs=None) -> Dict[str, float]:
+                  rank: int, size: int, sync, fs=None,
+                  opts: Optional[Dict] = None) -> Dict[str, float]:
     """Per-epoch validation metrics over the (sharded) val dataset.  The
     cross-worker combine is exact: Average(weighted sums)/Average(counts)
     equals the global weighted mean regardless of shard imbalance."""
     if val_path is None or not metrics:
         return {}
-    loader = ParquetDataLoader(val_path, batch_size, rank=rank,
-                               num_workers=size, fs=fs)
+    opts = opts or {}
+    loader = ParquetDataLoader(val_path,
+                               opts.get("val_batch_size") or batch_size,
+                               rank=rank, num_workers=size, fs=fs)
     sums = np.zeros((len(metrics) + 1,), np.float64)
-    for batch in loader:
+    import itertools
+    transform = opts.get("transformation_fn")
+    val_cap = opts.get("validation_steps_per_epoch")
+    it = iter(loader) if val_cap is None else         itertools.islice(loader, val_cap)
+    for batch in it:
+        if transform:
+            batch = transform(batch)
         x, y = _assemble_batch(batch, feature_cols, label_cols)
         p = np.asarray(predict(x))
         for j, (_, fn) in enumerate(metrics):
@@ -115,7 +150,8 @@ def _epoch_driver(store: Store, run_id: str, epochs: int, metrics,
                   serialize: Callable[[], bytes],
                   train_epoch: Callable[[int], float],
                   predict: Callable[[np.ndarray], np.ndarray],
-                  cold_start: Optional[Callable[[], None]] = None) -> Dict:
+                  cold_start: Optional[Callable[[], None]] = None,
+                  opts: Optional[Dict] = None) -> Dict:
     """The one epoch loop every train task shares: resume from the stored
     envelope (or run ``cold_start`` — typically the initial cross-worker
     parameter sync), then per epoch: train, eval val metrics, rank-0
@@ -132,12 +168,18 @@ def _epoch_driver(store: Store, run_id: str, epochs: int, metrics,
         history = dict(env.get("history") or {})
     elif cold_start is not None:
         cold_start()
+    opts = opts or {}
     for epoch in range(start_epoch, epochs):
         history.setdefault("train_loss", []).append(train_epoch(epoch))
         for k, v in _eval_metrics(predict, val_path, feature_cols,
                                   label_cols, metrics, batch_size, rank,
-                                  size, sync, fs=store.fs).items():
+                                  size, sync, fs=store.fs,
+                                  opts=opts).items():
             history.setdefault(k, []).append(v)
+        if rank == 0 and opts.get("verbose"):
+            parts = [f"{k}={v[-1]:.4f}" for k, v in history.items()]
+            print(f"[estimator] epoch {epoch}: " + " ".join(parts),
+                  flush=True)
         if rank == 0:
             _save_epoch_checkpoint(store, run_id, epoch, serialize(),
                                    history)
@@ -203,7 +245,24 @@ class Estimator:
                  validation=None,
                  metrics: Sequence = (),
                  loss=None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 shuffle_buffer_size: int = 0,
+                 train_steps_per_epoch: Optional[int] = None,
+                 validation_steps_per_epoch: Optional[int] = None,
+                 val_batch_size: Optional[int] = None,
+                 transformation_fn: Optional[Callable] = None,
+                 verbose: int = 0):
+        """Reference param parity (spark/common/params.py): beyond the
+        core fit knobs, ``shuffle_buffer_size`` streams a bounded-memory
+        shuffle over each worker's shard (petastorm semantics),
+        ``train/validation_steps_per_epoch`` cap batches per epoch,
+        ``val_batch_size`` overrides the eval batch,
+        ``transformation_fn`` rewrites each batch dict before assembly
+        (the reference's per-row transform hook, applied batchwise),
+        and ``verbose`` prints rank-0 per-epoch progress.  Petastorm
+        reader-pool knobs (reader_pool_type, *_reader_num_workers,
+        partitions_per_process) have no analog — the streaming loaders
+        read row groups directly."""
         self.store = store
         self.num_proc = num_proc
         self.feature_cols = list(feature_cols)
@@ -216,7 +275,27 @@ class Estimator:
         self.metrics = list(metrics)
         self.loss = loss
         self.seed = seed
+        if int(shuffle_buffer_size) < 0:
+            raise ValueError(f"shuffle_buffer_size must be >= 0, got "
+                             f"{shuffle_buffer_size}")
+        self.shuffle_buffer_size = int(shuffle_buffer_size)
+        self.train_steps_per_epoch = train_steps_per_epoch
+        self.validation_steps_per_epoch = validation_steps_per_epoch
+        self.val_batch_size = val_batch_size
+        self.transformation_fn = transformation_fn
+        self.verbose = verbose
         _resolve_metrics(self.metrics)  # fail fast on unknown names
+
+    def _data_opts(self) -> Dict:
+        """The per-worker data/reporting params every train task shares
+        (reference: spark/common/params.py surface)."""
+        return {"shuffle_buffer_size": self.shuffle_buffer_size,
+                "train_steps_per_epoch": self.train_steps_per_epoch,
+                "validation_steps_per_epoch": self.validation_steps_per_epoch,
+                "val_batch_size": self.val_batch_size,
+                "transformation_fn": self.transformation_fn,
+                "verbose": self.verbose,
+                "seed": self.seed}
 
     # -- subclass surface --------------------------------------------------
     def _make_train_task(self) -> Callable:
@@ -390,7 +469,7 @@ class _SGDTrainTask:
     envelope (resume + history) to the store."""
 
     def __init__(self, store, run_id, feature_cols, label_cols, batch_size,
-                 epochs, lr, metrics=()):
+                 epochs, lr, metrics=(), opts=None):
         self.store = store
         self.run_id = run_id
         self.feature_cols = feature_cols
@@ -399,15 +478,17 @@ class _SGDTrainTask:
         self.epochs = epochs
         self.lr = lr
         self.metrics = list(metrics)
+        self.opts = dict(opts or {})
 
     def __call__(self, train_path: str, val_path: Optional[str] = None):
         rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
         size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
         sync = _grad_sync_fn()
-        loader = StreamingParquetDataLoader(train_path, self.batch_size,
-                                            rank=rank, num_workers=size,
-                                            fs=self.store.fs)
-        first = next(iter(loader))
+        loader = _make_train_loader(self.store, train_path,
+                                    self.batch_size, rank, size, self.opts)
+        # probe through the SAME pipeline the epochs use, so a
+        # shape-changing transformation_fn sizes w/b correctly
+        first = next(_iter_train(loader, 0, self.opts))
         x, y = _assemble_batch(first, self.feature_cols, self.label_cols)
         state = {"w": np.zeros((x.shape[1], y.shape[1]), np.float64),
                  "b": np.zeros((y.shape[1],), np.float64)}
@@ -415,9 +496,9 @@ class _SGDTrainTask:
         def restore(payload: bytes) -> None:
             state.update(pickle.loads(payload))
 
-        def train_epoch(_epoch: int) -> float:
+        def train_epoch(epoch: int) -> float:
             epoch_loss, nb = 0.0, 0
-            for batch in loader:
+            for batch in _iter_train(loader, epoch, self.opts):
                 x, y = _assemble_batch(batch, self.feature_cols,
                                        self.label_cols)
                 pred = x @ state["w"] + state["b"]
@@ -433,6 +514,7 @@ class _SGDTrainTask:
             self.store, self.run_id, self.epochs, self.metrics,
             self.batch_size, self.feature_cols, self.label_cols,
             rank, size, sync, val_path,
+            opts=self.opts,
             restore=restore,
             serialize=lambda: pickle.dumps(dict(state)),
             train_epoch=train_epoch,
@@ -455,7 +537,8 @@ class LinearEstimator(Estimator):
     def _make_train_task(self) -> Callable:
         return _SGDTrainTask(self.store, self.run_id, self.feature_cols,
                              self.label_cols, self.batch_size, self.epochs,
-                             self.lr, metrics=self.metrics)
+                             self.lr, metrics=self.metrics,
+                             opts=self._data_opts())
 
     def _load_model(self, payload: bytes) -> Callable:
         state = pickle.loads(payload)
@@ -482,7 +565,8 @@ class KerasEstimator(Estimator):
         return _KerasTrainTask(self.store, self.run_id, self.model_fn,
                                self.feature_cols, self.label_cols,
                                self.batch_size, self.epochs, self.lr,
-                               loss=self.loss, metrics=self.metrics)
+                               loss=self.loss, metrics=self.metrics,
+                               opts=self._data_opts())
 
     def _load_model(self, payload: bytes) -> Callable:
         weights = pickle.loads(payload)
@@ -538,7 +622,8 @@ class TorchEstimator(Estimator):
                                self.feature_cols, self.label_cols,
                                self.batch_size, self.epochs, self.lr,
                                loss=self.loss, metrics=self.metrics,
-                               optimizer_fn=self.optimizer_fn)
+                               optimizer_fn=self.optimizer_fn,
+                               opts=self._data_opts())
 
     def _load_model(self, payload: bytes) -> Callable:
         return _torch_predict_fn(self.model_fn, payload)
@@ -547,7 +632,8 @@ class TorchEstimator(Estimator):
 class _TorchTrainTask:
     def __init__(self, store, run_id, model_fn, feature_cols, label_cols,
                  batch_size, epochs, lr, loss=None, metrics=(),
-                 optimizer_fn=None):
+                 optimizer_fn=None, opts=None):
+        self.opts = dict(opts or {})
         self.store = store
         self.run_id = run_id
         self.model_fn = model_fn
@@ -566,9 +652,8 @@ class _TorchTrainTask:
         rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
         size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
         sync = _grad_sync_fn()
-        loader = StreamingParquetDataLoader(train_path, self.batch_size,
-                                            rank=rank, num_workers=size,
-                                            fs=self.store.fs)
+        loader = _make_train_loader(self.store, train_path,
+                                    self.batch_size, rank, size, self.opts)
         model = self.model_fn()
         opt = (self.optimizer_fn(model.parameters()) if self.optimizer_fn
                else torch.optim.SGD(model.parameters(), lr=self.lr))
@@ -592,9 +677,9 @@ class _TorchTrainTask:
             torch.save(model.state_dict(), buf)
             return buf.getvalue()
 
-        def train_epoch(_epoch: int) -> float:
+        def train_epoch(epoch: int) -> float:
             epoch_loss, nb = 0.0, 0
-            for batch in loader:
+            for batch in _iter_train(loader, epoch, self.opts):
                 x, y = _assemble_batch(batch, self.feature_cols,
                                        self.label_cols)
                 xt = torch.from_numpy(np.ascontiguousarray(x, np.float32))
@@ -612,6 +697,7 @@ class _TorchTrainTask:
             self.store, self.run_id, self.epochs, self.metrics,
             self.batch_size, self.feature_cols, self.label_cols,
             rank, size, sync, val_path,
+            opts=self.opts,
             restore=restore, serialize=serialize, train_epoch=train_epoch,
             predict=lambda x: _torch_eval_predict(model, x),
             cold_start=(lambda: _torch_sync_params(model, sync))
@@ -621,7 +707,8 @@ class _TorchTrainTask:
 
 class _KerasTrainTask:
     def __init__(self, store, run_id, model_fn, feature_cols, label_cols,
-                 batch_size, epochs, lr, loss=None, metrics=()):
+                 batch_size, epochs, lr, loss=None, metrics=(), opts=None):
+        self.opts = dict(opts or {})
         self.store = store
         self.run_id = run_id
         self.model_fn = model_fn
@@ -638,18 +725,17 @@ class _KerasTrainTask:
         rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
         size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
         sync = _grad_sync_fn()
-        loader = StreamingParquetDataLoader(train_path, self.batch_size,
-                                            rank=rank, num_workers=size,
-                                            fs=self.store.fs)
+        loader = _make_train_loader(self.store, train_path,
+                                    self.batch_size, rank, size, self.opts)
         model = self.model_fn()
         # ``loss`` passes straight to compile: keras resolves names and
         # callables the same way (reference: keras estimator's loss param).
         model.compile(optimizer=keras.optimizers.SGD(self.lr),
                       loss=self.loss or "mse")
 
-        def train_epoch(_epoch: int) -> float:
+        def train_epoch(epoch: int) -> float:
             epoch_loss, nb = 0.0, 0
-            for batch in loader:
+            for batch in _iter_train(loader, epoch, self.opts):
                 x, y = _assemble_batch(batch, self.feature_cols,
                                        self.label_cols)
                 loss = model.train_on_batch(x, y)
@@ -665,6 +751,7 @@ class _KerasTrainTask:
             self.store, self.run_id, self.epochs, self.metrics,
             self.batch_size, self.feature_cols, self.label_cols,
             rank, size, sync, val_path,
+            opts=self.opts,
             restore=lambda p: model.set_weights(pickle.loads(p)),
             serialize=lambda: pickle.dumps(model.get_weights()),
             train_epoch=train_epoch,

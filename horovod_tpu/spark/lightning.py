@@ -24,8 +24,9 @@ from typing import Callable
 
 import numpy as np
 
-from ..data.loader import StreamingParquetDataLoader
+from ..data.loader import StreamingParquetDataLoader  # noqa: F401
 from .estimator import (Estimator, _assemble_batch, _epoch_driver,
+                        _iter_train, _make_train_loader,
                         _grad_sync_fn, _torch_eval_predict,
                         _torch_predict_fn, _torch_sync_grads,
                         _torch_sync_params)
@@ -107,7 +108,8 @@ class LightningEstimator(Estimator):
         return _LightningTrainTask(self.store, self.run_id, self.model_fn,
                                    self.feature_cols, self.label_cols,
                                    self.batch_size, self.epochs,
-                                   metrics=self.metrics)
+                                   metrics=self.metrics,
+                                   opts=self._data_opts())
 
     def _load_model(self, payload: bytes) -> Callable:
         return _torch_predict_fn(self.model_fn, payload)
@@ -119,7 +121,8 @@ class _LightningTrainTask:
     RemoteTrainer's train function)."""
 
     def __init__(self, store, run_id, model_fn, feature_cols, label_cols,
-                 batch_size, epochs, metrics=()):
+                 batch_size, epochs, metrics=(), opts=None):
+        self.opts = dict(opts or {})
         self.store = store
         self.run_id = run_id
         self.model_fn = model_fn
@@ -135,9 +138,8 @@ class _LightningTrainTask:
         rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
         size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
         sync = _grad_sync_fn()
-        loader = StreamingParquetDataLoader(train_path, self.batch_size,
-                                            rank=rank, num_workers=size,
-                                            fs=self.store.fs)
+        loader = _make_train_loader(self.store, train_path,
+                                    self.batch_size, rank, size, self.opts)
         module = self.model_fn()
         opt, sched_cfg = _first_optimizer(module.configure_optimizers())
         sched, interval, freq = sched_cfg or (None, "epoch", 1)
@@ -157,7 +159,8 @@ class _LightningTrainTask:
         def train_epoch(epoch: int) -> float:
             module.train()
             epoch_loss, nb = 0.0, 0
-            for i, batch in enumerate(loader):
+            for i, batch in enumerate(_iter_train(loader, epoch,
+                                                  self.opts)):
                 x, y = _assemble_batch(batch, self.feature_cols,
                                        self.label_cols)
                 bt = (torch.from_numpy(np.ascontiguousarray(x, np.float32)),
@@ -171,7 +174,7 @@ class _LightningTrainTask:
                 if size > 1:
                     _torch_sync_grads(module, sync)
                 opt.step()
-                epoch_loss += float(loss)
+                epoch_loss += float(loss.detach())
                 nb += 1
                 step_counter["global_step"] += 1
                 if sched is not None and interval == "step" and \
@@ -188,6 +191,7 @@ class _LightningTrainTask:
             self.store, self.run_id, self.epochs, self.metrics,
             self.batch_size, self.feature_cols, self.label_cols,
             rank, size, sync, val_path,
+            opts=self.opts,
             restore=restore, serialize=serialize, train_epoch=train_epoch,
             predict=lambda x: _torch_eval_predict(module, x),
             cold_start=(lambda: _torch_sync_params(module, sync))

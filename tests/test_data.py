@@ -221,3 +221,28 @@ def test_image_folder_loader_rejects_empty(tmp_path):
     from horovod_tpu.data import ImageFolderDataLoader
     with pytest.raises(ValueError, match="class directories"):
         ImageFolderDataLoader(str(tmp_path), batch_size=2)
+
+
+# ------------------------------------------------------------ shuffle buffer
+def test_shuffle_buffer_covers_all_rows_reordered(tmp_path):
+    from horovod_tpu.data.loader import (ShuffleBufferLoader,
+                                         StreamingParquetDataLoader)
+    from horovod_tpu.spark import FilesystemStore
+    store = FilesystemStore(str(tmp_path))
+    store.write_parquet(str(tmp_path / "ds"),
+                        {"x": np.arange(100, dtype=np.float64)})
+    base = StreamingParquetDataLoader(str(tmp_path / "ds"), batch_size=8)
+    dl = ShuffleBufferLoader(base, buffer_rows=32, seed=1)
+    rows = np.concatenate([b["x"] for b in dl])
+    assert sorted(rows.tolist()) == list(range(100))  # full coverage
+    assert rows.tolist() != list(range(100))          # actually shuffled
+    dl.set_epoch(1)
+    rows2 = np.concatenate([b["x"] for b in dl])
+    assert rows2.tolist() != rows.tolist()            # reshuffles per epoch
+    assert sorted(rows2.tolist()) == list(range(100))
+
+
+def test_shuffle_buffer_rejects_bad_size(tmp_path):
+    from horovod_tpu.data.loader import ShuffleBufferLoader
+    with pytest.raises(ValueError, match="buffer_rows"):
+        ShuffleBufferLoader(None, buffer_rows=0)

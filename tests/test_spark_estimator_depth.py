@@ -234,3 +234,34 @@ def test_elastic_fit_survives_worker_kill(tmp_path):
     assert len(model.history["train_loss"]) == 4
     assert len(model.history["val_mse"]) == 4
     assert model.history["val_mse"][-1] < model.history["val_mse"][0]
+
+
+# ------------------------------------------------- reference data params
+def _double_labels(batch):
+    batch = dict(batch)
+    batch["label"] = batch["label"] * 2.0
+    return batch
+
+
+def test_estimator_data_params(tmp_path, capfd):
+    """shuffle_buffer_size / steps caps / val_batch_size /
+    transformation_fn / verbose (reference: spark/common/params.py
+    surface).  transformation_fn doubling the labels must double the
+    learned weights — proof it ran inside the workers."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 3)
+    w = np.asarray([[1.0], [-1.0], [0.5]])
+    y = x @ w
+    est = LinearEstimator(
+        store=FilesystemStore(str(tmp_path)), num_proc=1, epochs=40,
+        batch_size=32, lr=0.05, validation=0.2, metrics=["mse"],
+        shuffle_buffer_size=64, train_steps_per_epoch=6,
+        validation_steps_per_epoch=1, val_batch_size=16,
+        transformation_fn=_double_labels, verbose=1,
+        executor=LocalTaskExecutor(1))
+    model = est.fit({"features": x, "label": y})
+    pred = model.transform({"features": x})["predict"]
+    # labels were doubled by the transform -> model learns 2w
+    assert float(np.mean((pred - 2.0 * y) ** 2)) < 5e-2
+    assert "[estimator] epoch" in capfd.readouterr().out  # verbose=1
+    assert model.history["val_mse"][-1] < model.history["val_mse"][0]
