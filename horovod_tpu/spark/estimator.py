@@ -251,6 +251,7 @@ class Estimator:
                  validation_steps_per_epoch: Optional[int] = None,
                  val_batch_size: Optional[int] = None,
                  transformation_fn: Optional[Callable] = None,
+                 sample_weight_col: Optional[str] = None,
                  verbose: int = 0):
         """Reference param parity (spark/common/params.py): beyond the
         core fit knobs, ``shuffle_buffer_size`` streams a bounded-memory
@@ -259,7 +260,11 @@ class Estimator:
         ``val_batch_size`` overrides the eval batch,
         ``transformation_fn`` rewrites each batch dict before assembly
         (the reference's per-row transform hook, applied batchwise),
-        and ``verbose`` prints rank-0 per-epoch progress.  Petastorm
+        ``sample_weight_col`` names a per-row weight column applied to
+        the training loss (reference: params.py sample_weight_col;
+        validation metrics stay unweighted, matching the reference's
+        evaluation), and ``verbose`` prints rank-0 per-epoch progress.
+        Petastorm
         reader-pool knobs (reader_pool_type, *_reader_num_workers,
         partitions_per_process) have no analog — the streaming loaders
         read row groups directly."""
@@ -283,6 +288,7 @@ class Estimator:
         self.validation_steps_per_epoch = validation_steps_per_epoch
         self.val_batch_size = val_batch_size
         self.transformation_fn = transformation_fn
+        self.sample_weight_col = sample_weight_col
         self.verbose = verbose
         _resolve_metrics(self.metrics)  # fail fast on unknown names
 
@@ -294,6 +300,7 @@ class Estimator:
                 "validation_steps_per_epoch": self.validation_steps_per_epoch,
                 "val_batch_size": self.val_batch_size,
                 "transformation_fn": self.transformation_fn,
+                "sample_weight_col": self.sample_weight_col,
                 "verbose": self.verbose,
                 "seed": self.seed}
 
@@ -333,7 +340,9 @@ class Estimator:
         train_path, val_path = prepare_data(
             self.store, df, self.feature_cols, self.label_cols,
             validation=self.validation, seed=self.seed,
-            run_id=self.run_id)
+            run_id=self.run_id,
+            extra_cols=(self.sample_weight_col,)
+            if self.sample_weight_col else ())
         return self._fit_on_paths(train_path, val_path, elastic=elastic,
                                   min_np=min_np, reset_limit=reset_limit)
 
@@ -452,6 +461,21 @@ def _torch_predict_fn(model_fn: Callable, payload: bytes) -> Callable:
     return predict
 
 
+def _batch_weights(batch, opts) -> Optional[np.ndarray]:
+    """Per-row loss weights as a (n, 1) float array, or None (reference:
+    sample_weight_col)."""
+    col = (opts or {}).get("sample_weight_col")
+    if not col:
+        return None
+    if col not in batch:
+        raise ValueError(
+            f"sample_weight_col {col!r} not in the batch (columns: "
+            f"{sorted(batch)}); the dataset was prepared without it, or "
+            "a transformation_fn dropped it")
+    w = np.asarray(batch[col], np.float64).ravel()
+    return w[:, None]
+
+
 def _assemble_batch(batch, feature_cols, label_cols):
     """Stack feature columns into a 2-D x and the (first) label column into
     a 2-D y — the one batch-assembly implementation every train task
@@ -501,12 +525,14 @@ class _SGDTrainTask:
             for batch in _iter_train(loader, epoch, self.opts):
                 x, y = _assemble_batch(batch, self.feature_cols,
                                        self.label_cols)
+                sw = _batch_weights(batch, self.opts)
                 pred = x @ state["w"] + state["b"]
-                gw, gb = sync([x.T @ (pred - y) / len(x),
-                               (pred - y).mean(axis=0)])
+                err = (pred - y) if sw is None else (pred - y) * sw
+                gw, gb = sync([x.T @ err / len(x), err.mean(axis=0)])
                 state["w"] -= self.lr * gw
                 state["b"] -= self.lr * gb
-                epoch_loss += float(np.mean((pred - y) ** 2))
+                sq = (pred - y) ** 2 if sw is None else sw * (pred - y) ** 2
+                epoch_loss += float(np.mean(sq))
                 nb += 1
             return epoch_loss / max(nb, 1)
 
@@ -578,21 +604,31 @@ class KerasEstimator(Estimator):
         return predict
 
 
-def _torch_loss_fn(loss):
+def _torch_loss_fn(loss, weighted: bool = False):
     """Resolve the user ``loss`` param to a callable(pred, y) -> scalar
     tensor (reference: TorchEstimator ``loss`` accepts instances and
-    callables; strings are the keras-style convenience)."""
+    callables; strings are the keras-style convenience).  ``weighted``
+    builds NAMED losses with reduction="none" so per-row sample weights
+    can apply; custom instances/callables own their reduction, so the
+    combination is rejected with guidance."""
     import torch
+    table = {"mse": torch.nn.MSELoss, "l1": torch.nn.L1Loss,
+             "mae": torch.nn.L1Loss, "bce": torch.nn.BCELoss,
+             "bce_logits": torch.nn.BCEWithLogitsLoss,
+             "cross_entropy": torch.nn.CrossEntropyLoss}
+    if isinstance(loss, str) and loss not in table:
+        raise ValueError(f"unknown torch loss {loss!r}; named losses: "
+                         f"{sorted(table)}")
+    if weighted:
+        if loss is not None and not isinstance(loss, str):
+            raise ValueError(
+                "sample_weight_col requires a NAMED loss (or the mse "
+                "default) so it can be built unreduced; weight inside "
+                "your custom loss instead")
+        return table[loss or "mse"](reduction="none")
     if loss is None:
         return torch.nn.MSELoss()
     if isinstance(loss, str):
-        table = {"mse": torch.nn.MSELoss, "l1": torch.nn.L1Loss,
-                 "mae": torch.nn.L1Loss, "bce": torch.nn.BCELoss,
-                 "bce_logits": torch.nn.BCEWithLogitsLoss,
-                 "cross_entropy": torch.nn.CrossEntropyLoss}
-        if loss not in table:
-            raise ValueError(f"unknown torch loss {loss!r}; named losses: "
-                             f"{sorted(table)}")
         return table[loss]()
     return loss  # instance or plain callable
 
@@ -657,7 +693,8 @@ class _TorchTrainTask:
         model = self.model_fn()
         opt = (self.optimizer_fn(model.parameters()) if self.optimizer_fn
                else torch.optim.SGD(model.parameters(), lr=self.lr))
-        loss_fn = _torch_loss_fn(self.loss)
+        weighted = bool(self.opts.get("sample_weight_col"))
+        loss_fn = _torch_loss_fn(self.loss, weighted=weighted)
         # Class-index losses need (n,) int64 targets, not the (n,1) float
         # regression layout _assemble_batch produces.
         index_target = isinstance(loss_fn, torch.nn.CrossEntropyLoss) or \
@@ -682,9 +719,16 @@ class _TorchTrainTask:
             for batch in _iter_train(loader, epoch, self.opts):
                 x, y = _assemble_batch(batch, self.feature_cols,
                                        self.label_cols)
+                sw = _batch_weights(batch, self.opts)
                 xt = torch.from_numpy(np.ascontiguousarray(x, np.float32))
                 opt.zero_grad()
                 loss = loss_fn(model(xt), as_target(y))
+                if sw is not None:
+                    wt = torch.from_numpy(
+                        np.ascontiguousarray(sw, np.float32))
+                    while loss.dim() > 1:  # per-element -> per-row
+                        loss = loss.mean(dim=-1)
+                    loss = (loss * wt.ravel()).mean()
                 loss.backward()
                 if size > 1:
                     _torch_sync_grads(model, sync)
@@ -738,7 +782,10 @@ class _KerasTrainTask:
             for batch in _iter_train(loader, epoch, self.opts):
                 x, y = _assemble_batch(batch, self.feature_cols,
                                        self.label_cols)
-                loss = model.train_on_batch(x, y)
+                sw = _batch_weights(batch, self.opts)
+                loss = model.train_on_batch(
+                    x, y, sample_weight=None if sw is None
+                    else sw.ravel().astype(np.float32))
                 epoch_loss += float(np.asarray(loss).ravel()[0])
                 nb += 1
             # per-epoch parameter averaging keeps every worker's model

@@ -102,6 +102,11 @@ class LightningEstimator(Estimator):
     def __init__(self, store: Store, model_fn: Callable, num_proc: int = 1,
                  **kwargs):
         super().__init__(store, num_proc=num_proc, **kwargs)
+        if self.sample_weight_col:
+            raise ValueError(
+                "LightningEstimator does not support sample_weight_col: "
+                "training_step owns the loss — weight it inside the "
+                "module")
         self.model_fn = model_fn
 
     def _make_train_task(self) -> Callable:

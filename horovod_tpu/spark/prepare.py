@@ -22,7 +22,7 @@ dataset per split, readable by any of the parquet loaders):
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -153,7 +153,8 @@ def prepare_data(store: Store, df, feature_cols, label_cols,
                  chunk_rows: int = 65536,
                  train_path: Optional[str] = None,
                  val_path: Optional[str] = None,
-                 run_id: str = "run0") -> Tuple[str, Optional[str]]:
+                 run_id: str = "run0",
+                 extra_cols: Sequence[str] = ()) -> Tuple[str, Optional[str]]:
     """Materialize ``df`` into the Store as train (+ optional val)
     parquet datasets; returns ``(train_path, val_path_or_None)``.
 
@@ -165,7 +166,7 @@ def prepare_data(store: Store, df, feature_cols, label_cols,
     val_path = val_path or store.get_val_data_path(run_id)
     extra = (validation,) if isinstance(validation, str) else ()
     columns = (list(feature_cols or []) + list(label_cols or []) +
-               list(extra))
+               list(extra) + [c for c in extra_cols if c])
 
     if hasattr(df, "rdd"):  # pyspark DataFrame: distributed write
         # Clear both datasets once on the driver; executors append.
@@ -201,7 +202,9 @@ def prepare_data(store: Store, df, feature_cols, label_cols,
         return train_path, (val_path if val_rows else None)
 
     # in-memory dict / pandas DataFrame (small-data path)
-    cols = _as_columns(df, feature_cols, label_cols, extra_cols=extra)
+    cols = _as_columns(df, feature_cols, label_cols,
+                       extra_cols=tuple(extra)
+                       + tuple(c for c in extra_cols if c))
     train_cols, val_cols = _split_validation(cols, validation, seed)
     store.write_parquet(train_path, train_cols)
     if val_cols is not None:

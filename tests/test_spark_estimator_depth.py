@@ -265,3 +265,53 @@ def test_estimator_data_params(tmp_path, capfd):
     assert float(np.mean((pred - 2.0 * y) ** 2)) < 5e-2
     assert "[estimator] epoch" in capfd.readouterr().out  # verbose=1
     assert model.history["val_mse"][-1] < model.history["val_mse"][0]
+
+
+def test_sample_weight_col_steers_linear_fit(tmp_path):
+    """Two inconsistent label populations; weights pick the winner
+    (reference: params.py sample_weight_col applied to the loss)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 2)
+    w_true = np.asarray([[2.0], [-1.0]])
+    y = x @ w_true
+    # second half gets CONTRADICTORY labels but ~zero weight
+    y[128:] = -y[128:]
+    weights = np.concatenate([np.ones(128), np.full(128, 1e-6)])
+    est = LinearEstimator(
+        store=FilesystemStore(str(tmp_path)), num_proc=1, epochs=40,
+        batch_size=64, lr=0.05, sample_weight_col="wt",
+        executor=LocalTaskExecutor(1))
+    model = est.fit({"features": x, "label": y, "wt": weights})
+    pred = model.transform({"features": x[:128]})["predict"]
+    # fits the weighted half; unweighted fit would average to ~0
+    assert float(np.mean((pred - x[:128] @ w_true) ** 2)) < 5e-2
+
+
+def test_sample_weight_col_torch_and_custom_loss_guard(tmp_path):
+    rng = np.random.RandomState(1)
+    x = rng.randn(128, 4).astype(np.float32)
+    y = (x @ rng.randn(4, 1)).astype(np.float32)
+    wt = np.ones(128)
+    est = TorchEstimator(
+        FilesystemStore(str(tmp_path)), _reg_model_fn, num_proc=1,
+        lr=0.05, batch_size=32, epochs=6, sample_weight_col="wt",
+        executor=LocalTaskExecutor(1))
+    model = est.fit({"features": x, "label": y, "wt": wt})
+    assert model.history["train_loss"][-1] < model.history["train_loss"][0]
+
+    from horovod_tpu.spark.estimator import _torch_loss_fn
+    import torch
+    with pytest.raises(ValueError, match="NAMED loss"):
+        _torch_loss_fn(torch.nn.MSELoss(), weighted=True)
+
+
+def _reg_model_fn():
+    import torch
+    return torch.nn.Linear(4, 1)
+
+
+def test_lightning_rejects_sample_weight_col(tmp_path):
+    from horovod_tpu.spark import LightningEstimator
+    with pytest.raises(ValueError, match="sample_weight_col"):
+        LightningEstimator(FilesystemStore(str(tmp_path)), _reg_model_fn,
+                           sample_weight_col="wt")

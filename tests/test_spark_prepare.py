@@ -255,3 +255,26 @@ def test_store_create_dispatches_hdfs(tmp_path):
 def test_hdfs_store_rejects_non_hdfs_prefix():
     with pytest.raises(ValueError, match="hdfs://"):
         HDFSStore("/local/path", fs=FakeHDFS("/tmp"))
+
+
+def test_prepare_pandas_keeps_extra_cols(tmp_path):
+    """The small-data pandas path must keep extra_cols (e.g. the sample
+    weight column) — the dict path keeps all columns unconditionally and
+    masked this."""
+    import pandas as pd
+
+    from horovod_tpu.spark.prepare import prepare_data
+    store = FilesystemStore(str(tmp_path))
+    df = pd.DataFrame({"features": np.random.RandomState(0).randn(16),
+                       "label": np.zeros(16), "wt": np.ones(16)})
+    train, _ = prepare_data(store, df, ["features"], ["label"],
+                            extra_cols=("wt",))
+    data = store.read_parquet(train)
+    assert "wt" in data and len(data["wt"]) == 16
+
+
+def test_missing_weight_column_names_the_param(tmp_path):
+    from horovod_tpu.spark.estimator import _batch_weights
+    with pytest.raises(ValueError, match="sample_weight_col 'wt'"):
+        _batch_weights({"features": np.ones(4)},
+                       {"sample_weight_col": "wt"})
