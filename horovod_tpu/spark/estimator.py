@@ -151,7 +151,8 @@ def _epoch_driver(store: Store, run_id: str, epochs: int, metrics,
                   train_epoch: Callable[[int], float],
                   predict: Callable[[np.ndarray], np.ndarray],
                   cold_start: Optional[Callable[[], None]] = None,
-                  opts: Optional[Dict] = None) -> Dict:
+                  opts: Optional[Dict] = None,
+                  should_stop: Optional[Callable[[], bool]] = None) -> Dict:
     """The one epoch loop every train task shares: resume from the stored
     envelope (or run ``cold_start`` — typically the initial cross-worker
     parameter sync), then per epoch: train, eval val metrics, rank-0
@@ -185,6 +186,8 @@ def _epoch_driver(store: Store, run_id: str, epochs: int, metrics,
                                    history)
             store.save_log(run_id, pickle.dumps(history))
         _maybe_inject_fault(rank, epoch)
+        if should_stop is not None and should_stop():
+            break  # e.g. keras EarlyStopping set model.stop_training
     return history
 
 
@@ -582,16 +585,27 @@ class KerasEstimator(Estimator):
     instead of per batch), then rank 0 checkpoints model bytes."""
 
     def __init__(self, store: Store, model_fn: Callable, num_proc: int = 1,
-                 lr: float = 1e-3, **kwargs):
+                 lr: float = 1e-3, callbacks: Sequence = (), **kwargs):
+        """``callbacks``: keras callbacks run inside every worker
+        (reference: keras estimator's callbacks param) — epoch-level
+        hooks (set_model, on_train_begin/end, on_epoch_begin/end with
+        the CROSS-WORKER average loss), which covers LR schedules,
+        ReduceLROnPlateau, and EarlyStopping (model.stop_training ends
+        the run).  They ship to workers by pickle, so use module-level
+        schedule fns.  Callback STATE is rebuilt on elastic/checkpoint
+        resume (only weights+history persist) — prefer absolute
+        schedules (epoch -> lr) over relative ones across resumes."""
         super().__init__(store, num_proc=num_proc, **kwargs)
         self.model_fn = model_fn
         self.lr = lr
+        self.callbacks = list(callbacks)
 
     def _make_train_task(self) -> Callable:
         return _KerasTrainTask(self.store, self.run_id, self.model_fn,
                                self.feature_cols, self.label_cols,
                                self.batch_size, self.epochs, self.lr,
                                loss=self.loss, metrics=self.metrics,
+                               callbacks=self.callbacks,
                                opts=self._data_opts())
 
     def _load_model(self, payload: bytes) -> Callable:
@@ -751,7 +765,9 @@ class _TorchTrainTask:
 
 class _KerasTrainTask:
     def __init__(self, store, run_id, model_fn, feature_cols, label_cols,
-                 batch_size, epochs, lr, loss=None, metrics=(), opts=None):
+                 batch_size, epochs, lr, loss=None, metrics=(),
+                 callbacks=(), opts=None):
+        self.callbacks = list(callbacks)
         self.opts = dict(opts or {})
         self.store = store
         self.run_id = run_id
@@ -776,8 +792,13 @@ class _KerasTrainTask:
         # callables the same way (reference: keras estimator's loss param).
         model.compile(optimizer=keras.optimizers.SGD(self.lr),
                       loss=self.loss or "mse")
+        for cb in self.callbacks:
+            cb.set_model(model)
+            cb.on_train_begin()
 
         def train_epoch(epoch: int) -> float:
+            for cb in self.callbacks:
+                cb.on_epoch_begin(epoch)
             epoch_loss, nb = 0.0, 0
             for batch in _iter_train(loader, epoch, self.opts):
                 x, y = _assemble_batch(batch, self.feature_cols,
@@ -787,12 +808,24 @@ class _KerasTrainTask:
                     x, y, sample_weight=None if sw is None
                     else sw.ravel().astype(np.float32))
                 epoch_loss += float(np.asarray(loss).ravel()[0])
+                # train_on_batch reports the RUNNING mean since the last
+                # metric reset, not this batch's loss; reset so the
+                # epoch average is an average of per-batch losses
+                model.reset_metrics()
                 nb += 1
             # per-epoch parameter averaging keeps every worker's model
             # identical at epoch boundaries (one fused collective)
             model.set_weights(sync([np.asarray(w)
                                     for w in model.get_weights()]))
-            return epoch_loss / max(nb, 1)
+            # callbacks see the CROSS-WORKER average loss, so stateful
+            # monitors (ReduceLROnPlateau, EarlyStopping) make the SAME
+            # decision on every worker instead of diverging per shard
+            avg = float(np.asarray(sync(
+                [np.asarray([epoch_loss / max(nb, 1)], np.float64)]
+            )[0]).ravel()[0])
+            for cb in self.callbacks:
+                cb.on_epoch_end(epoch, logs={"loss": avg})
+            return avg
 
         history = _epoch_driver(
             self.store, self.run_id, self.epochs, self.metrics,
@@ -802,5 +835,9 @@ class _KerasTrainTask:
             restore=lambda p: model.set_weights(pickle.loads(p)),
             serialize=lambda: pickle.dumps(model.get_weights()),
             train_epoch=train_epoch,
-            predict=lambda x: np.asarray(model(x)))
+            predict=lambda x: np.asarray(model(x)),
+            should_stop=lambda: bool(getattr(model, "stop_training",
+                                             False)))
+        for cb in self.callbacks:
+            cb.on_train_end()
         return history["train_loss"][-1] if history["train_loss"] else 0.0
