@@ -164,9 +164,18 @@ class _ShardedIndexLoader(BaseDataLoader):
                              seed=b["seed"] + self._epoch)
 
     def __len__(self) -> int:
-        n = len(self._indices())
+        n = -(-self._base["n"] // self._base["num_workers"])  # shard rows
         return n // self.batch_size if self.drop_last else \
             -(-n // self.batch_size)
+
+    @property
+    def num_rows(self) -> int:
+        """Rows this shard actually yields per epoch (drop_last trims).
+        O(1): shard_indices wrap-pads every shard to ceil(n/workers)."""
+        n = -(-self._base["n"] // self._base["num_workers"])
+        if self.drop_last:
+            n = n // self.batch_size * self.batch_size
+        return n
 
     def _batched_indices(self):
         idx = self._indices()
@@ -209,8 +218,19 @@ def list_parquet_files(path: str, fs=None) -> List[str]:
     fs = fs or LOCAL_FS
     if not fs.isdir(path):
         return [path]
-    return sorted(fs.join(path, f) for f in fs.listdir(path)
-                  if f.endswith(".parquet"))
+
+    def order_key(name: str):
+        # part-<N>.parquet sorts by numeric index so zero-pad width is
+        # irrelevant (datasets can mix widths across writer versions);
+        # anything else falls back to lexicographic after the parts.
+        stem = name[:-len(".parquet")]
+        if stem.startswith("part-") and stem[5:].isdigit():
+            return (0, int(stem[5:]), name)
+        return (1, 0, name)
+
+    return [fs.join(path, f)
+            for f in sorted((f for f in fs.listdir(path)
+                             if f.endswith(".parquet")), key=order_key)]
 
 
 def decode_table(table) -> dict:
@@ -301,6 +321,11 @@ class ParquetDataLoader(BaseDataLoader):
     def __len__(self) -> int:
         return -(-self._n // self.batch_size)
 
+    @property
+    def num_rows(self) -> int:
+        """Rows this shard yields per epoch."""
+        return self._n
+
     def _iterate(self):
         for s in range(0, self._n, self.batch_size):
             yield {name: col[s:s + self.batch_size]
@@ -371,6 +396,11 @@ class StreamingParquetDataLoader(BaseDataLoader):
 
     def __len__(self) -> int:
         return -(-self._n // self.batch_size)
+
+    @property
+    def num_rows(self) -> int:
+        """Rows this shard yields per epoch."""
+        return self._n
 
     def _rows(self):
         """Yield decoded column-dict chunks (one per row-group slice),
@@ -445,8 +475,33 @@ class ShuffleBufferLoader(BaseDataLoader):
         if hasattr(self.inner, "set_epoch"):
             self.inner.set_epoch(epoch)
 
+    @property
+    def num_rows(self):
+        """The shuffle preserves the inner row multiset exactly."""
+        return getattr(self.inner, "num_rows", None)
+
     def __len__(self) -> int:
-        return len(self.inner)
+        # The wrapper changes the batch count: the fill phase absorbs
+        # whole inner batches (no yield), and the drain re-chunks the
+        # final buffer by batch_size.  For a uniform-batch inner loader
+        # with >= buffer_rows total rows that is exactly
+        #   len(inner) - floor(buffer/bs) + ceil(buffer/bs).
+        # Without a batch_size we cannot count absorbed batches, so the
+        # value falls back to len(inner); exact when the inner loader
+        # reports its row count (num_rows), else the last inner batch is
+        # assumed full and the value is approximate for ragged tails.
+        n = len(self.inner)
+        if not self.batch_size:
+            return n
+        rows = getattr(self.inner, "num_rows", None)
+        if rows is None:
+            rows = n * self.batch_size
+        if self.buffer_rows >= rows:
+            # Everything is absorbed; the drain re-chunks the dataset.
+            return -(-rows // self.batch_size)
+        absorbed = self.buffer_rows // self.batch_size
+        drained = -(-self.buffer_rows // self.batch_size)
+        return n - absorbed + drained
 
     def _iterate(self):
         # The standard exchange reservoir (TF/petastorm shuffle-buffer
@@ -463,7 +518,10 @@ class ShuffleBufferLoader(BaseDataLoader):
             k_rows = len(next(iter(batch.values())))
             if have < self.buffer_rows:
                 take = min(self.buffer_rows - have, k_rows)
-                head = {k: v[:take] for k, v in batch.items()}
+                # .copy(): the buffer is written in place by the
+                # exchange below, but arrow-backed batches arrive
+                # read-only and v[:take] would stay a view of them.
+                head = {k: v[:take].copy() for k, v in batch.items()}
                 if not buf:
                     buf = head
                 else:

@@ -134,7 +134,9 @@ class _PartitionWriter:
             # reproduces the same split.
             t, v = _write_split_chunk(
                 tw, vw, cols, None, self.validation,
-                seed=self.seed + 1000003 * idx + chunk_i)
+                # numpy seeds must fit 32 bits; the mix can exceed it on
+                # wide DataFrames (idx >= ~4295), so reduce mod 2**32.
+                seed=(self.seed + 1000003 * idx + chunk_i) % (1 << 32))
             chunk_i += 1
             counts[0] += t
             counts[1] += v

@@ -109,7 +109,10 @@ class ParquetPartWriter:
 
         fs = self.store.fs
         fs.mkdirs(self.path)
-        out = fs.join(self.path, f"part-{self._next:09d}.parquet")
+        # 13 digits covers base_index up to ~9.5e6 at the default 2**20
+        # stride; a fixed width keeps lexicographic listing == numeric
+        # order (9 digits overflowed at partition index 954).
+        out = fs.join(self.path, f"part-{self._next:013d}.parquet")
         tmp = out + ".tmp"
         with fs.open(tmp, "wb") as f:
             pq.write_table(_encode_table(columns), f)

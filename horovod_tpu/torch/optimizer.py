@@ -16,8 +16,9 @@ eager torch-style loops and exercises the negotiation path (SURVEY.md §7 M5).
 
 from __future__ import annotations
 
+import warnings
 from contextlib import contextmanager
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import torch
 
@@ -26,9 +27,11 @@ from . import mpi_ops
 from .compression import Compression
 
 
-class _DistributedOptimizer(torch.optim.Optimizer):
+class _HookReducingOptimizer(torch.optim.Optimizer):
     """Wraps any torch.optim.Optimizer; reduces grads across workers before
-    each step (reference: torch/optimizer.py:37-333)."""
+    each step (reference API surface: torch/optimizer.py:37-333; the
+    implementation here dispatches onto the XLA data plane instead of MPI
+    handles)."""
 
     def __init__(self, params, named_parameters=None,
                  compression=Compression.none,
@@ -38,42 +41,45 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                  num_groups: int = 0,
                  groups: Optional[Sequence[Sequence[torch.Tensor]]] = None,
                  bucket_bytes: Optional[int] = None):
-        super(self.__class__, self).__init__(params)
-        self._compression = compression
+        # type(self) is the dynamic subclass built by DistributedOptimizer
+        # below, so this resolves to the wrapped optimizer's __init__.
+        super(type(self), self).__init__(params)
+        self._wire_compression = compression
         self._op = op
-        self._gradient_predivide_factor = gradient_predivide_factor
+        self._predivide = gradient_predivide_factor
         self.backward_passes_per_step = backward_passes_per_step
 
-        if named_parameters is not None:
-            named_parameters = list(named_parameters)
+        every_param = [v for grp in self.param_groups
+                       for v in grp["params"]]
+        if named_parameters is None:
+            named_parameters = [(f"allreduce.noname.{i}.{j}", v)
+                                for i, grp in enumerate(self.param_groups)
+                                for j, v in enumerate(grp["params"])]
         else:
-            named_parameters = [
-                (f"allreduce.noname.{i}.{j}", v)
-                for i, group in enumerate(self.param_groups)
-                for j, v in enumerate(group["params"])]
-        # Reference validates names are unique & cover all params
-        # (optimizer.py:77-98).
-        all_params = {p for g in self.param_groups for p in g["params"]}
-        named = {v for _, v in named_parameters}
-        if len(named_parameters) != len({k for k, _ in named_parameters}):
+            named_parameters = list(named_parameters)
+        # Names must be unique and cover every parameter: the name is the
+        # cross-process negotiation key, so an unnamed or doubly-named
+        # tensor would negotiate against the wrong peer.
+        if len({k for k, _ in named_parameters}) != len(named_parameters):
             raise ValueError("named_parameters contains duplicate names")
-        unnamed = all_params - named
-        if unnamed:
+        covered = {v for _, v in named_parameters}
+        missing = [v for v in every_param if v not in covered]
+        if missing:
             raise ValueError(
-                f"{len(unnamed)} parameters were not named by "
+                f"{len(missing)} parameters were not named by "
                 "named_parameters; name all parameters or pass none")
 
-        self._parameter_names = {v: k for k, v in named_parameters}
-        self._handles: Dict[torch.Tensor, Tuple[int, Any]] = {}
-        self._grad_accs: List[Any] = []
-        self._requires_update = set()
-        self._synchronized = False
-        self._should_synchronize = True
-        # Per-parameter countdown for backward_passes_per_step (reference:
-        # optimizer.py:119-127 _allreduce_delay).
-        self._allreduce_delay = {
-            v: self.backward_passes_per_step
-            for group in self.param_groups for v in group["params"]}
+        self._names = {v: k for k, v in named_parameters}
+        self._inflight: Dict[torch.Tensor, Tuple[int, Any]] = {}
+        self._hook_handles: List[Any] = []
+        self._hooked = set()
+        self._drained = False
+        self._auto_drain = True
+        # Per-parameter countdown: the reduction fires on the pass that
+        # brings this to zero, implementing backward_passes_per_step-local
+        # accumulation.
+        self._passes_left = {v: self.backward_passes_per_step
+                             for v in every_param}
 
         self._groups: Optional[Dict[torch.Tensor, int]] = None
         self._group_buckets: Optional[List[List[torch.Tensor]]] = None
@@ -81,16 +87,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if num_groups:
                 raise ValueError("pass either num_groups or groups, not both")
             self._group_buckets = [list(g) for g in groups]
-            self._groups = {p: i for i, g in enumerate(self._group_buckets)
-                            for p in g}
         elif num_groups > 0:
-            ordered = [v for group in self.param_groups
-                       for v in group["params"]]
-            n = max(1, (len(ordered) + num_groups - 1) // num_groups)
-            self._group_buckets = [ordered[i:i + n]
-                                   for i in range(0, len(ordered), n)]
-            self._groups = {p: i for i, g in enumerate(self._group_buckets)
-                            for p in g}
+            n = max(1, (len(every_param) + num_groups - 1) // num_groups)
+            self._group_buckets = [every_param[i:i + n]
+                                   for i in range(0, len(every_param), n)]
         else:
             # Auto-bucketing by the fusion threshold (TPU-native default):
             # per-parameter hooks each paying a host->device round trip is
@@ -107,12 +107,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if compression is not Compression.none:
                 bucket_bytes = 0
             if bucket_bytes > 0:
-                ordered = [v for group in self.param_groups
-                           for v in group["params"]]
                 buckets: List[List[torch.Tensor]] = []
                 cur: List[torch.Tensor] = []
                 cur_bytes = 0
-                for v in ordered:
+                for v in every_param:
                     nb = v.numel() * v.element_size()
                     if cur and cur_bytes + nb > bucket_bytes:
                         buckets.append(cur)
@@ -123,59 +121,61 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     buckets.append(cur)
                 if len(buckets) > 1 or (buckets and len(buckets[0]) > 1):
                     self._group_buckets = buckets
-                    self._groups = {p: i
-                                    for i, g in enumerate(buckets)
-                                    for p in g}
+        if self._group_buckets is not None:
+            self._groups = {p: i for i, g in enumerate(self._group_buckets)
+                            for p in g}
         self._group_pending: Dict[int, List[torch.Tensor]] = {}
 
-        self._register_hooks()
+        self._install_hooks()
 
     # ------------------------------------------------------------------ hooks
-    def _register_hooks(self) -> None:
-        """Post-grad-accumulation hooks (reference: optimizer.py:128-171 uses
-        the grad_fn/AccumulateGrad trick; torch>=2.1 exposes it directly)."""
-        for param_group in self.param_groups:
-            for p in param_group["params"]:
-                if p.requires_grad:
-                    self._requires_update.add(p)
-                    acc = p.register_post_accumulate_grad_hook(
-                        self._make_hook())
-                    self._grad_accs.append(acc)
+    def _install_hooks(self) -> None:
+        """Post-grad-accumulation hooks (the reference reaches the same
+        event through the grad_fn/AccumulateGrad graph walk,
+        optimizer.py:128-171; torch>=2.1 exposes it directly)."""
+        for grp in self.param_groups:
+            for p in grp["params"]:
+                if not p.requires_grad:
+                    continue
+                self._hooked.add(p)
+                self._hook_handles.append(
+                    p.register_post_accumulate_grad_hook(
+                        self._on_grad_ready))
 
-    def _make_hook(self):
-        def hook(p: torch.Tensor):
-            if p in self._handles and self._handles[p][0] is not None:
-                if self._allreduce_delay[p] <= 0:
-                    raise AssertionError(
-                        "Gradients were computed more than "
-                        "backward_passes_per_step times before call to "
-                        "step(). Increase backward_passes_per_step to "
-                        "accumulate gradients locally.")
-            assert not p.grad.requires_grad
-            self._allreduce_delay[p] -= 1
-            if self._allreduce_delay[p] == 0:
-                if self._groups is not None:
-                    self._enqueue_grouped(p)
-                else:
-                    handle, ctx = self._allreduce_grad_async(p)
-                    self._handles[p] = (handle, ctx)
-        return hook
+    def _on_grad_ready(self, p: torch.Tensor) -> None:
+        already = p in self._inflight and self._inflight[p][0] is not None
+        if already and self._passes_left[p] <= 0:
+            raise AssertionError(
+                f"parameter {self._names.get(p)} accumulated a gradient "
+                "again after its allreduce was already dispatched this "
+                "step — you ran more backward passes than "
+                f"backward_passes_per_step ({self.backward_passes_per_step})"
+                " between step() calls; raise backward_passes_per_step to "
+                "cover them")
+        if p.grad.requires_grad:
+            raise AssertionError(
+                "gradient tensors must not themselves require grad")
+        self._passes_left[p] -= 1
+        if self._passes_left[p] == 0:
+            if self._groups is not None:
+                self._enqueue_grouped(p)
+            else:
+                self._inflight[p] = self._dispatch_grad(p)
 
-    def _allreduce_grad_async(self, p: torch.Tensor) -> Tuple[int, Any]:
-        """(reference: optimizer.py:173-207 _allreduce_grad_async)"""
-        name = self._parameter_names.get(p)
-        tensor = p.grad
-        if self._gradient_predivide_factor != 1.0:
-            tensor = tensor / self._gradient_predivide_factor
-        tensor_compressed, ctx = self._compression.compress(tensor)
+    def _dispatch_grad(self, p: torch.Tensor) -> Tuple[int, Any]:
+        """Fire one async (possibly compressed) allreduce for p.grad."""
+        grad = p.grad
+        if self._predivide != 1.0:
+            grad = grad / self._predivide
+        compressed, cctx = self._wire_compression.compress(grad)
         handle = mpi_ops.allreduce_async_(
-            tensor_compressed, name=name, op=self._op)
-        return handle, (ctx, tensor_compressed)
+            compressed, name=self._names.get(p), op=self._op)
+        return handle, (cctx, compressed)
 
     def _enqueue_grouped(self, p: torch.Tensor) -> None:
         """Buffer params of a bucket; fire one grouped allreduce when the
-        whole bucket's grads are ready (reference: optimizer.py num_groups
-        handling, grouped_allreduce buckets)."""
+        whole bucket's grads are ready (the reference's num_groups /
+        grouped_allreduce behavior)."""
         gid = self._groups[p]
         pending = self._group_pending.setdefault(gid, [])
         if not any(q is p for q in pending):  # tensor __eq__ is elementwise
@@ -187,79 +187,79 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             # allreduce matches tensors positionally across ranks.
             pending_ids = {id(q) for q in pending}
             ready = [q for q in bucket if id(q) in pending_ids]
-            tensors = [q.grad for q in ready]
-            if self._gradient_predivide_factor != 1.0:
-                for t in tensors:
-                    t.div_(self._gradient_predivide_factor)
-            name = f"group.{gid}." + self._parameter_names.get(
+            grads = [q.grad for q in ready]
+            if self._predivide != 1.0:
+                for t in grads:
+                    t.div_(self._predivide)
+            bucket_name = f"group.{gid}." + self._names.get(
                 ready[0], "noname")
             handle = mpi_ops.grouped_allreduce_async_(
-                tensors, name=name, op=self._op)
+                grads, name=bucket_name, op=self._op)
             for q in ready:
-                self._handles[q] = (handle, None)
+                self._inflight[q] = (handle, None)
             self._group_pending[gid] = []
 
     # ------------------------------------------------------------ synchronize
     def synchronize(self) -> None:
         """Wait on all outstanding reductions and write reduced grads back
-        (reference: optimizer.py:249-333)."""
+        (reference contract: optimizer.py:249-333)."""
         # Partially-filled buckets (a bucket member was frozen or unused this
         # step) fall back to per-parameter reduction via the missed-hook loop
         # below; clear them so stale entries can't corrupt the next step.
         self._group_pending.clear()
-        completed = set()
-        for p in list(self._requires_update - set(self._handles.keys())):
+        for p in list(self._hooked - set(self._inflight)):
             # Params whose hook never fired this step (e.g. frozen branch):
-            # reduce now so all workers agree (reference: optimizer.py
-            # missed-hook handling at synchronize time).
-            if p.grad is None:
+            # reduce now so all workers agree on the collective schedule.
+            if p.grad is not None:
+                self._inflight[p] = self._dispatch_grad(p)
+        waited = set()
+        for p, (handle, ctx) in list(self._inflight.items()):
+            self._passes_left[p] = self.backward_passes_per_step
+            if handle in waited:  # grouped: one wait covers the bucket
                 continue
-            handle, ctx = self._allreduce_grad_async(p)
-            self._handles[p] = (handle, ctx)
-        for p, (handle, ctx) in list(self._handles.items()):
-            if handle in completed:
-                self._allreduce_delay[p] = self.backward_passes_per_step
-                continue
-            output = mpi_ops.synchronize(handle)
-            completed.add(handle)
-            self._allreduce_delay[p] = self.backward_passes_per_step
+            mpi_ops.synchronize(handle)
+            waited.add(handle)
             if ctx is not None:
                 cctx, compressed = ctx
-                p.grad.copy_(self._compression.decompress(compressed, cctx))
-        self._handles.clear()
-        self._synchronized = True
+                p.grad.copy_(
+                    self._wire_compression.decompress(compressed, cctx))
+        self._inflight.clear()
+        self._drained = True
 
     @contextmanager
     def skip_synchronize(self):
         """For manual ``optimizer.synchronize()`` + clipping-then-step flows
-        (reference: optimizer.py:236-247)."""
-        self._should_synchronize = False
+        (same contract as the reference's skip_synchronize)."""
+        self._auto_drain = False
         try:
             yield
         finally:
-            self._should_synchronize = True
+            self._auto_drain = True
 
     def step(self, closure=None):
-        if self._should_synchronize:
-            if self._synchronized:
-                import warnings
+        if self._auto_drain:
+            if self._drained:
                 warnings.warn(
-                    "optimizer.step() called without a prior backward; "
-                    "called synchronize() twice")
+                    "redundant synchronize(): the reductions for this step "
+                    "were already drained once — if you call "
+                    "optimizer.synchronize() yourself, wrap step() in "
+                    "skip_synchronize() so it is not repeated")
             self.synchronize()
-        self._synchronized = False
-        return super(self.__class__, self).step(closure)
+        self._drained = False
+        return super(type(self), self).step(closure)
 
     def zero_grad(self, *args, **kwargs):
-        if self._handles:
+        if self._inflight:
             raise AssertionError(
-                "optimizer.zero_grad() was called after loss.backward() but "
-                "before optimizer.step() or optimizer.synchronize(). This is "
-                "prohibited as it can cause a race condition.")
-        return super(self.__class__, self).zero_grad(*args, **kwargs)
+                "zero_grad() while async reductions are still in flight: "
+                "zeroing .grad between backward() and "
+                "step()/synchronize() races with the pending allreduce "
+                "write-back — drain with synchronize() (or call step()) "
+                "first")
+        return super(type(self), self).zero_grad(*args, **kwargs)
 
 
-class _DistributedAdasumOptimizer(torch.optim.Optimizer):
+class _AdasumDeltaOptimizer(torch.optim.Optimizer):
     """Adasum optimizer: applies the *delta* of a local step, combined
     scale-adaptively across workers (reference: optimizer.py:335-504).
 
@@ -270,8 +270,8 @@ class _DistributedAdasumOptimizer(torch.optim.Optimizer):
 
     def __init__(self, params, compression=Compression.none,
                  backward_passes_per_step: int = 1):
-        super(self.__class__, self).__init__(params)
-        self._compression = compression
+        super(type(self), self).__init__(params)
+        self._wire_compression = compression
         self.backward_passes_per_step = backward_passes_per_step
         self._step_count = 0
 
@@ -280,20 +280,35 @@ class _DistributedAdasumOptimizer(torch.optim.Optimizer):
         if self._step_count % self.backward_passes_per_step != 0:
             return None
         befores = {p: p.detach().clone()
-                   for group in self.param_groups
-                   for p in group["params"] if p.grad is not None}
+                   for grp in self.param_groups
+                   for p in grp["params"] if p.grad is not None}
         # One local step with the wrapped optimizer's own update rule; then
         # replace each local delta by the Adasum-mixed global delta.
-        loss = super(self.__class__, self).step(closure)
+        loss = super(type(self), self).step(closure)
+        # Op names are the cross-process negotiation key: index params by
+        # their canonical (group, position) so every rank submits the
+        # same name for the same parameter (id() differs per process).
+        ordinal = {id(p): (gi, pi)
+                   for gi, grp in enumerate(self.param_groups)
+                   for pi, p in enumerate(grp["params"])}
         for p, before in befores.items():
             delta = p.detach() - before
-            comp, cctx = self._compression.compress(delta)
+            comp, cctx = self._wire_compression.compress(delta)
+            gi, pi = ordinal[id(p)]
             mixed = mpi_ops.allreduce(comp, op=Adasum,
-                                      name=f"adasum.delta.{id(p)}")
-            mixed = self._compression.decompress(mixed, cctx)
+                                      name=f"adasum.delta.{gi}.{pi}")
+            mixed = self._wire_compression.decompress(mixed, cctx)
             with torch.no_grad():
                 p.copy_(before + mixed)
         return loss
+
+
+def _subclass_of(optimizer: torch.optim.Optimizer, body: type):
+    """Dynamically subclass the wrapped optimizer's type with our methods
+    so isinstance(opt, UserOptimizerType) keeps holding — the same
+    user-visible contract the reference provides."""
+    base = type(optimizer)
+    return type(base.__name__, (base,), dict(body.__dict__))
 
 
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
@@ -306,26 +321,20 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          groups=None,
                          bucket_bytes: Optional[int] = None
                          ) -> torch.optim.Optimizer:
-    """Wrap a torch optimizer for distributed training (reference:
+    """Wrap a torch optimizer for distributed training (reference API:
     torch/optimizer.py:506-590).
 
     Without explicit ``num_groups``/``groups``, gradients are auto-bucketed
     by ``bucket_bytes`` (default: HOROVOD_FUSION_THRESHOLD) so a step costs
     a handful of fused collectives instead of one per parameter;
-    ``bucket_bytes=0`` restores per-parameter dispatch.
-
-    Dynamically subclasses the wrapped optimizer's type so isinstance
-    checks keep working, exactly like the reference."""
+    ``bucket_bytes=0`` restores per-parameter dispatch."""
     if gradient_predivide_factor != 1.0 and op != Average:
         raise ValueError(
             "gradient_predivide_factor not supported with op != Average")
     if op == Adasum:
-        cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
-                   dict(_DistributedAdasumOptimizer.__dict__))
-        return cls(optimizer.param_groups, compression,
-                   backward_passes_per_step)
-    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
-               dict(_DistributedOptimizer.__dict__))
-    return cls(optimizer.param_groups, named_parameters, compression,
-               backward_passes_per_step, op, gradient_predivide_factor,
-               num_groups, groups, bucket_bytes)
+        return _subclass_of(optimizer, _AdasumDeltaOptimizer)(
+            optimizer.param_groups, compression, backward_passes_per_step)
+    return _subclass_of(optimizer, _HookReducingOptimizer)(
+        optimizer.param_groups, named_parameters, compression,
+        backward_passes_per_step, op, gradient_predivide_factor,
+        num_groups, groups, bucket_bytes)

@@ -246,3 +246,45 @@ def test_shuffle_buffer_rejects_bad_size(tmp_path):
     from horovod_tpu.data.loader import ShuffleBufferLoader
     with pytest.raises(ValueError, match="buffer_rows"):
         ShuffleBufferLoader(None, buffer_rows=0)
+
+
+def test_shuffle_buffer_len_matches_yielded_batches(tmp_path):
+    # The wrapper absorbs whole batches during fill and re-chunks the
+    # buffer at drain, so its batch count differs from the inner
+    # loader's; __len__ must track the actual yield count for uniform
+    # inner batches (steps-per-epoch accounting depends on it).
+    from horovod_tpu.data.loader import (ShuffleBufferLoader,
+                                         StreamingParquetDataLoader)
+    from horovod_tpu.spark import FilesystemStore
+    store = FilesystemStore(str(tmp_path))
+    store.write_parquet(str(tmp_path / "ds"),
+                        {"x": np.arange(96, dtype=np.float64)})
+    # 200 > dataset: the whole dataset is absorbed and re-chunked
+    for buffer_rows in (5, 8, 32, 33, 96, 200):
+        base = StreamingParquetDataLoader(str(tmp_path / "ds"),
+                                          batch_size=8)
+        dl = ShuffleBufferLoader(base, buffer_rows=buffer_rows, seed=3)
+        assert len(dl) == sum(1 for _ in dl), buffer_rows
+    # ragged tail (100 rows, bs=8): exact via the inner num_rows,
+    # including buffers at/above the dataset size and mid-ragged-batch
+    store.write_parquet(str(tmp_path / "ds100"),
+                        {"x": np.arange(100, dtype=np.float64)})
+    for buffer_rows in (5, 96, 97, 98, 100, 104, 200):
+        base = StreamingParquetDataLoader(str(tmp_path / "ds100"),
+                                          batch_size=8)
+        dl = ShuffleBufferLoader(base, buffer_rows=buffer_rows, seed=3)
+        assert len(dl) == sum(1 for _ in dl), buffer_rows
+
+
+def test_list_parquet_files_orders_numerically_across_widths(tmp_path):
+    # Datasets may mix part-number widths (writer versions differ);
+    # read order must follow the numeric part index, not string order.
+    from horovod_tpu.data.loader import list_parquet_files
+    d = tmp_path / "ds"
+    d.mkdir()
+    for name in ("part-000000011.parquet", "part-0000000000002.parquet",
+                 "part-000000001.parquet", "extra.parquet"):
+        (d / name).write_bytes(b"")
+    got = [p.rsplit("/", 1)[-1] for p in list_parquet_files(str(d))]
+    assert got == ["part-000000001.parquet", "part-0000000000002.parquet",
+                   "part-000000011.parquet", "extra.parquet"]
