@@ -111,6 +111,15 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def step_mtime(self, step: int) -> Optional[float]:
+        """When `step` was written (orders commits across stores with
+        unrelated step counters, e.g. against the elastic fast store)."""
+        try:
+            return os.path.getmtime(os.path.join(self.directory,
+                                                 str(step)))
+        except OSError:
+            return None
+
     def all_steps(self):
         return sorted(self._mgr.all_steps())
 

@@ -1,0 +1,382 @@
+"""Raw shard-file commit store: the fast path for elastic durable commits.
+
+Why not orbax here: profiling (scripts/profile_restore.py, docs/
+benchmarks.md) pinned elastic restore at 3-8x slower than save at every
+orbax knob setting — tensorstore's read+decompress+place pipeline is
+chunk-serial per array.  The reference's bar is an in-memory broadcast
+(reference: horovod/common/elastic.py:99-150), near-instant; a restart
+restore that takes minutes at pod scale defeats elastic's purpose.
+
+The elastic restart path needs none of orbax's generality: the SAME
+process layout that wrote the commit restores it (a TPU slice restart
+reuses the topology), templates with the target shardings are in hand,
+and the files are host-local.  So each process writes its addressable
+shards as ONE flat binary blob plus a manifest, and restores them with a
+thread pool — zero-copy reads from an mmap, one device_put per shard,
+no codec in between.  Cross-topology moves stay on the orbax path
+(checkpoint.CheckpointManager); `restore` detects a layout mismatch and
+returns None so callers can fall back.
+
+Durability protocol: data + manifest land via tmp+rename, then a marker
+file; a step without this process's marker is ignored at restore, so a
+crash mid-commit can never be read back (the same promise State.commit
+documents).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import re
+import shutil
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _pwrite_all(fd: int, buf, offset: int) -> None:
+    """pwrite the WHOLE buffer: a single pwrite may write short (and is
+    capped at ~2 GiB on Linux), which would leave silent zero tails in
+    the pre-truncated file."""
+    view = memoryview(buf).cast("B")
+    while len(view):
+        n = os.pwrite(fd, view, offset)
+        view = view[n:]
+        offset += n
+
+
+def _index_spec(index) -> Tuple:
+    """A shard's global index (tuple of slices) as plain picklable data."""
+    return tuple((s.start, s.stop, s.step) for s in index)
+
+
+def _leaf_shards(leaf) -> List[Tuple[Tuple, Any]]:
+    """(index_spec, device_shard) for every DISTINCT shard this process
+    owns — no host copy yet; the save workers materialize each shard so
+    D2H copies pipeline with the writes.  Replicated axes give several
+    devices the same global index — write one copy, not one per replica
+    (DP-replicated params would otherwise blow the commit up by the
+    replica count)."""
+    if hasattr(leaf, "addressable_shards"):
+        out, seen = [], set()
+        for s in leaf.addressable_shards:
+            spec = _index_spec(s.index)
+            if spec not in seen:
+                seen.add(spec)
+                out.append((spec, s.data))
+        return out
+    arr = np.asarray(leaf)
+    full = tuple((0, n, None) for n in arr.shape)
+    return [(full, arr)]
+
+
+class FastCommitStore:
+    """Per-host raw shard blobs with a manifest; same-layout restore."""
+
+    def __init__(self, directory: str, max_to_keep: int = 2,
+                 fsync: bool = False):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        # fsync=True survives a whole-MACHINE crash but costs physical
+        # disk bandwidth per commit (~8x at 1 GB scale).  The elastic
+        # failure mode this store serves is a killed/preempted PROCESS,
+        # where the page cache survives — and the reference's bar is
+        # in-memory state that survives neither.  tmp+rename ordering is
+        # kept either way, so a torn commit is never visible.
+        self.fsync = fsync
+        self._proc = jax.process_index()
+        os.makedirs(self.directory, exist_ok=True)
+
+    # ------------------------------------------------------------------ save
+    def save(self, step: int, trees: Dict[str, Any],
+             meta: Optional[Dict[str, Any]] = None) -> None:
+        """Write this host's shards of every leaf; durable on return."""
+        # A commit counter that restarted below steps still on disk
+        # (failed/skipped load_from_disk) begins a NEW timeline: the
+        # stale higher-numbered steps would both shadow latest_step()
+        # and make _gc delete the commit being written, so purge them
+        # before anything else.  Markerless leftovers of a crashed
+        # commit (data written, marker not) are invisible to steps() but
+        # hold state-sized blobs — reap those too.
+        for s in self.steps():
+            if s >= step:
+                self._remove_step(s)
+        self._purge_incomplete()
+        d = os.path.join(self.directory, f"step_{step}")
+        os.makedirs(d, exist_ok=True)
+        manifest: Dict[str, Any] = {
+            "process_index": self._proc,
+            "process_count": jax.process_count(),
+            "meta": meta or {},
+            "trees": {},
+        }
+        # Lay out every shard's byte range from metadata only (shape +
+        # dtype come without a host copy), so the workers below can
+        # pipeline the device->host copy of one shard with the pwrite of
+        # another, and resident host memory stays bounded by the
+        # in-flight window rather than the whole state.
+        jobs = []  # (offset, device_shard)
+        offset = 0
+        for name, tree in trees.items():
+            if tree is None:
+                manifest["trees"][name] = None
+                continue
+            leaves = jax.tree_util.tree_leaves(tree)
+            entries = []
+            for leaf in leaves:
+                shards = []
+                for spec, data in _leaf_shards(leaf):
+                    shape = tuple(data.shape)
+                    dt = np.dtype(data.dtype)
+                    nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+                    shards.append({"index": spec, "offset": offset,
+                                   "nbytes": nbytes, "shape": shape,
+                                   "dtype": str(dt)})
+                    jobs.append((offset, data))
+                    offset += nbytes
+                gshape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+                entries.append({"gshape": gshape, "shards": shards})
+            manifest["trees"][name] = entries
+
+        data_path = os.path.join(d, f"host_{self._proc}.bin")
+        tmp = data_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.truncate(offset)
+            fd = f.fileno()
+            def write_shard(job):
+                off, data = job
+                host = np.ascontiguousarray(np.asarray(data))
+                # uint8 view: numpy's buffer protocol refuses extension
+                # dtypes (bfloat16/fp8 — the usual TPU dtypes), so the
+                # raw bytes go out under a dtype it always accepts.
+                _pwrite_all(fd, host.reshape(-1).view(np.uint8), off)
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                list(pool.map(write_shard, jobs))
+            f.flush()
+            if self.fsync:
+                os.fsync(fd)
+        os.replace(tmp, data_path)
+
+        man_path = os.path.join(d, f"host_{self._proc}.manifest")
+        with open(man_path + ".tmp", "wb") as f:
+            pickle.dump(manifest, f)
+            if self.fsync:
+                os.fsync(f.fileno())
+        os.replace(man_path + ".tmp", man_path)
+        # The marker is what restore trusts; everything above is invisible
+        # until it exists.
+        marker = os.path.join(d, f"COMMIT_{self._proc}")
+        with open(marker, "w") as f:
+            f.write("ok")
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        if self.fsync:
+            # The renames and the marker are directory entries; without a
+            # directory fsync a machine crash can lose them even though
+            # the data blocks are on disk.
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        self._gc()
+
+    def _remove_step(self, s: int) -> None:
+        d = os.path.join(self.directory, f"step_{s}")
+        # Marker FIRST: a kill mid-removal must leave the step
+        # invisible, never marker-bearing with missing data.
+        for fn in (f"COMMIT_{self._proc}",
+                   f"host_{self._proc}.bin",
+                   f"host_{self._proc}.manifest"):
+            try:
+                os.remove(os.path.join(d, fn))
+            except OSError:
+                pass
+        try:  # last host out removes the dir
+            os.rmdir(d)
+        except OSError:
+            pass
+
+    def _purge_incomplete(self) -> None:
+        """Remove this process's files from step dirs that lack its
+        durability marker: leftovers of a commit that crashed between
+        data and marker.  Only our own files — another process may be
+        mid-commit in the same dir."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for n in names:
+            if not _STEP_RE.match(n):
+                continue
+            d = os.path.join(self.directory, n)
+            if os.path.exists(os.path.join(d, f"COMMIT_{self._proc}")):
+                continue
+            for fn in (f"host_{self._proc}.bin",
+                       f"host_{self._proc}.bin.tmp",
+                       f"host_{self._proc}.manifest",
+                       f"host_{self._proc}.manifest.tmp"):
+                try:
+                    os.remove(os.path.join(d, fn))
+                except OSError:
+                    pass
+            try:
+                os.rmdir(d)
+            except OSError:
+                pass
+
+    def _gc(self) -> None:
+        steps = sorted(self.steps())
+        for s in steps[:-self.max_to_keep] if self.max_to_keep else []:
+            self._remove_step(s)
+
+    # --------------------------------------------------------------- restore
+    def steps(self) -> List[int]:
+        """Steps with THIS process's durability marker."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for n in names:
+            m = _STEP_RE.match(n)
+            if m and os.path.exists(os.path.join(
+                    self.directory, n, f"COMMIT_{self._proc}")):
+                out.append(int(m.group(1)))
+        return out
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return max(steps) if steps else None
+
+    def marker_mtime(self, step: int) -> Optional[float]:
+        """When this process's commit of `step` became durable (used to
+        order commits ACROSS stores, where step counters don't share a
+        timeline)."""
+        try:
+            return os.path.getmtime(os.path.join(
+                self.directory, f"step_{step}", f"COMMIT_{self._proc}"))
+        except OSError:
+            return None
+
+    def restore(self, step: int, templates: Dict[str, Any]
+                ) -> Optional[Dict[str, Any]]:
+        """Rebuild the committed trees onto the templates' shardings.
+
+        Returns None when the commit cannot be mapped onto the current
+        layout (different process count, leaf count, shapes, or shard
+        partitioning) — the caller falls back to a portable path.
+        """
+        d = os.path.join(self.directory, f"step_{step}")
+        man_path = os.path.join(d, f"host_{self._proc}.manifest")
+        data_path = os.path.join(d, f"host_{self._proc}.bin")
+        if not (os.path.exists(os.path.join(d, f"COMMIT_{self._proc}"))
+                and os.path.exists(man_path)
+                and os.path.exists(data_path)):
+            return None
+        try:
+            with open(man_path, "rb") as f:
+                manifest = pickle.load(f)
+        except (OSError, EOFError, pickle.UnpicklingError, ValueError):
+            return None  # corrupt commit: let the caller fall back
+        if manifest["process_count"] != jax.process_count():
+            return None
+
+        f = open(data_path, "rb")
+        try:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError:  # zero-length data file (empty trees)
+            mm = b""
+        view = memoryview(mm)
+
+        def build_leaf(tmpl, entry):
+            """One leaf: place every shard, assemble the global array."""
+            if tuple(entry["gshape"]) != tuple(
+                    getattr(tmpl, "shape", np.shape(tmpl))):
+                raise _LayoutMismatch()
+            tmpl_dtype = np.dtype(getattr(tmpl, "dtype",
+                                          np.asarray(tmpl).dtype))
+            if any(np.dtype(sh["dtype"]) != tmpl_dtype
+                   for sh in entry["shards"]):
+                # A precision change is a layout change: silently
+                # restoring the old dtype would retrace or train wrong.
+                raise _LayoutMismatch()
+            sharding = getattr(tmpl, "sharding", None)
+            raw = {}
+            for sh in entry["shards"]:
+                buf = np.frombuffer(
+                    view[sh["offset"]:sh["offset"] + sh["nbytes"]],
+                    dtype=np.dtype(sh["dtype"])).reshape(sh["shape"])
+                raw[sh["index"]] = buf
+            if sharding is None or not hasattr(tmpl, "addressable_shards"):
+                if len(raw) != 1:
+                    raise _LayoutMismatch()
+                buf = np.array(next(iter(raw.values())))
+                if buf.size != int(np.prod(entry["gshape"],
+                                           dtype=np.int64)):
+                    raise _LayoutMismatch()
+                # reshape: 0-d shards were stored as (1,)
+                return buf.reshape(entry["gshape"])
+            tmpl_specs = {_index_spec(s.index)
+                          for s in tmpl.addressable_shards}
+            if tmpl_specs != set(raw):  # replicas share one stored copy
+                raise _LayoutMismatch()
+            singles = []
+            for s in tmpl.addressable_shards:
+                buf = raw[_index_spec(s.index)]
+                # Compare by element count: ascontiguousarray at save
+                # time renders 0-d shards as (1,), so shapes can differ
+                # spuriously while the data is identical.
+                if buf.size != int(np.prod(s.data.shape)):
+                    raise _LayoutMismatch()
+                singles.append(jax.device_put(
+                    buf.reshape(tuple(s.data.shape)), s.device))
+            return jax.make_array_from_single_device_arrays(
+                tuple(entry["gshape"]), sharding, singles)
+
+        out: Dict[str, Any] = {"meta": manifest.get("meta") or {}}
+        try:
+            for name, tmpl_tree in templates.items():
+                entries = manifest["trees"].get(name)
+                if entries is None or tmpl_tree is None:
+                    out[name] = None
+                    continue
+                leaves, treedef = jax.tree_util.tree_flatten(tmpl_tree)
+                if len(leaves) != len(entries):
+                    raise _LayoutMismatch()
+                with ThreadPoolExecutor(max_workers=8) as pool:
+                    rebuilt = list(pool.map(build_leaf, leaves, entries))
+                out[name] = jax.tree_util.tree_unflatten(treedef, rebuilt)
+            jax.block_until_ready([out[n] for n in templates
+                                   if out.get(n) is not None])
+        except _LayoutMismatch:
+            return None
+        except (ValueError, OSError, KeyError, IndexError, TypeError):
+            # A marker-bearing commit with an unreadable data blob
+            # (machine crash under fsync=False, disk corruption): the
+            # contract is "None means fall back", never an exception —
+            # and in multi-host restarts an exception here would leave
+            # peers hanging in the outcome-agreement collective.
+            return None
+        finally:
+            # Never mmap.close() here: on CPU backends device_put is
+            # zero-copy, so restored arrays ALIAS the mapping — numpy's
+            # buffer refs keep it (and the dup'd fd) alive exactly as
+            # long as needed.  Commits never mutate old step files in
+            # place, so aliased pages stay valid.  Only drop our handle.
+            f.close()
+        return out
+
+    def clear(self) -> None:
+        shutil.rmtree(self.directory, ignore_errors=True)
+
+
+class _LayoutMismatch(Exception):
+    """Commit does not map onto the live topology; use the portable path."""

@@ -112,13 +112,22 @@ class JaxState(State):
     def __init__(self, params: Any = None, opt_state: Any = None,
                  commit_path: Optional[str] = None,
                  sharded_commit_dir: Optional[str] = None,
+                 commit_format: str = "fast",
                  **scalars: Any):
         self.commit_path = commit_path
-        # Orbax-backed sharded commits: every host writes ITS HBM shards in
-        # parallel instead of pickling a full host copy (the scalable path
-        # SURVEY §5 calls for; commit_path's pickle stays for tiny states).
+        # Sharded commits: every host writes ITS HBM shards in parallel
+        # instead of pickling a full host copy (the scalable path SURVEY
+        # §5 calls for; commit_path's pickle stays for tiny states).
+        # "fast" = raw shard blobs (fastcommit.py) restoring at disk
+        # speed for the same-topology restart this path serves;
+        # "orbax" = the portable tensorstore layout (also readable after
+        # topology changes via checkpoint.CheckpointManager).
         self.sharded_commit_dir = sharded_commit_dir
+        if commit_format not in ("fast", "orbax"):
+            raise ValueError(f"unknown commit_format {commit_format!r}")
+        self.commit_format = commit_format
         self._ckpt_mgr = None
+        self._fast_store = None
         self._commit_step = 0
         super().__init__(params=params, opt_state=opt_state, **scalars)
 
@@ -144,16 +153,97 @@ class JaxState(State):
                                                max_to_keep=2)
         return self._ckpt_mgr
 
+    def _fast(self):
+        if self._fast_store is None:
+            from .fastcommit import FastCommitStore
+            # Subdir: orbax's manager scans sharded_commit_dir for ITS
+            # step layout and must not trip over the raw step_N dirs.
+            self._fast_store = FastCommitStore(
+                os.path.join(self.sharded_commit_dir, "fastcommit"),
+                max_to_keep=2)
+        return self._fast_store
+
+
+    def _orbax_steps_may_exist(self) -> bool:
+        """Cheap listdir check for orbax's numeric step dirs, so the
+        fast path never pays the orbax import just to learn there are no
+        orbax commits."""
+        try:
+            return any(n.split(".")[0].isdigit()
+                       for n in os.listdir(self.sharded_commit_dir))
+        except OSError:
+            return False
+
+    def _agreed_restore_plan(self, fast):
+        """(fast_step, use_fast, agreed_orbax_step) — decided from the
+        SAME data on every process, in ONE gather (the restart path is
+        latency-sensitive and split rounds widen the pre-bring-up
+        fallback window).
+
+        Rules, applied identically everywhere: the agreed fast step is
+        the newest commit EVERY host holds (per-host markers can
+        disagree after a mid-commit preemption — restoring different
+        steps would diverge params and loop counters); an orbax store
+        the hosts DISAGREE about (a replaced host sees no or different
+        steps) is unusable, since its collective restore would hang or
+        diverge; between the stores the newest commit wins by the max
+        timestamp any host observed, with exact timestamp ties
+        (coarse-mtime filesystems) breaking toward the configured
+        commit_format."""
+        own_steps = {s: fast.marker_mtime(s) for s in fast.steps()}
+        orbax_step = orbax_t = None
+        if self._orbax_steps_may_exist():
+            # Fast-only deployments skip the orbax import + manager
+            # construction entirely on this latency-sensitive path.
+            mgr = self._manager()
+            orbax_step = mgr.latest_step()
+            orbax_t = (mgr.step_mtime(orbax_step)
+                       if orbax_step is not None else None)
+        local = (own_steps, orbax_step, orbax_t)
+        views = [local]
+        if jax.process_count() > 1:
+            try:
+                from ..functions import allgather_object
+                views = allgather_object(local)
+            except Exception:
+                pass  # pre-bring-up: own view is the best available
+        common = set(views[0][0])
+        for v in views[1:]:
+            common &= set(v[0])
+        fast_step = max(common) if common else None
+        orbax_views = {v[1] for v in views}
+        agreed_orbax = (orbax_step if orbax_views == {orbax_step}
+                        and orbax_step is not None else None)
+        if fast_step is None:
+            return None, False, agreed_orbax
+        if agreed_orbax is None:
+            return fast_step, True, None
+        max_fast_t = max((v[0].get(fast_step) or 0) for v in views)
+        max_orbax_t = max((v[2] for v in views if v[2] is not None),
+                          default=0)
+        if max_fast_t == max_orbax_t:
+            return fast_step, self.commit_format == "fast", agreed_orbax
+        return fast_step, max_fast_t > max_orbax_t, agreed_orbax
+
     def on_commit(self) -> None:
         if self.sharded_commit_dir:
             scalars = {f: getattr(self, f) for f in self._fields
                        if f not in ("params", "opt_state")}
-            mgr = self._manager()
-            mgr.save(self._commit_step, params=self.params,
-                     opt_state=self.opt_state, meta=scalars, force=True)
-            # commit() promises durability: a preemption right after this
-            # call must restore THIS step, so flush the async writers.
-            mgr.wait()
+            if self.commit_format == "fast":
+                # Durable on return (tmp+rename+marker inside).
+                self._fast().save(self._commit_step,
+                                  {"params": self.params,
+                                   "opt_state": self.opt_state},
+                                  meta=scalars)
+            else:
+                mgr = self._manager()
+                mgr.save(self._commit_step, params=self.params,
+                         opt_state=self.opt_state, meta=scalars,
+                         force=True)
+                # commit() promises durability: a preemption right after
+                # this call must restore THIS step, so flush the async
+                # writers.
+                mgr.wait()
             self._commit_step += 1
         if self.commit_path:
             tmp = self.commit_path + ".tmp"
@@ -164,31 +254,121 @@ class JaxState(State):
                 pickle.dump(host_state, f)
             os.replace(tmp, self.commit_path)
 
+    @staticmethod
+    def _all_hosts_agree(ok: bool) -> bool:
+        """All-or-nothing on a local outcome: one host restoring while a
+        peer fails would diverge params and hang the next collective."""
+        if jax.process_count() > 1:
+            try:
+                from ..functions import allgather_object
+                return all(allgather_object(bool(ok)))
+            except Exception:
+                pass  # pre-bring-up: local outcome is the best available
+        return ok
+
+    def _apply_restored(self, out: Dict[str, Any], step: int) -> None:
+        if out.get("params") is not None:
+            self.params = out["params"]
+        if out.get("opt_state") is not None:
+            self.opt_state = out["opt_state"]
+        for k, v in (out.get("meta") or {}).items():
+            setattr(self, k, v)
+        self._commit_step = step + 1
+        self.save()
+
+    def _restore_fast(self, fast, step: int) -> bool:
+        out = fast.restore(step, {"params": self.params,
+                                  "opt_state": self.opt_state})
+        if not self._all_hosts_agree(out is not None):
+            return False
+        self._apply_restored(out, step)
+        return True
+
+    def _restore_orbax(self, step: int) -> bool:
+        try:
+            out = self._manager().restore(step, params=self.params,
+                                          opt_state=self.opt_state)
+        except Exception:
+            # Unmappable commit (templates changed shape/dtype/
+            # structure): report a failed load, per the load_from_disk
+            # contract — the caller decides, it must not crash here.
+            out = None
+        if not self._all_hosts_agree(out is not None):
+            return False
+        self._apply_restored(out, step)
+        return True
+
     def load_from_disk(self) -> bool:
         """Restore a commit written by a previous incarnation of this
-        process (TPU slice restart path).  The sharded orbax commit wins
-        when both stores exist; the current params/opt_state act as the
-        restore templates (shapes + shardings)."""
+        process (TPU slice restart path).  The current params/opt_state
+        act as the restore templates (shapes + shardings).
+
+        Precedence: the NEWEST durable commit wins, judged by commit
+        wall-clock across both sharded stores (step counters restart per
+        incarnation, so they cannot order commits across stores).  If
+        that newest commit cannot be restored — typically a fast commit
+        after a topology or dtype change — load_from_disk does NOT fall
+        back to an older commit (silently rolling training back is worse
+        than reporting failure); it returns False and the caller decides
+        (cold-start from a real checkpoint via
+        checkpoint.CheckpointManager, or the commit_path pickle if
+        configured, which is consulted last and carries the same commit
+        freshness)."""
         if self.sharded_commit_dir:
-            mgr = self._manager()
-            step = mgr.latest_step()
-            if step is not None:
-                out = mgr.restore(step, params=self.params,
-                                  opt_state=self.opt_state)
-                if "params" in out:
-                    self.params = out["params"]
-                if "opt_state" in out:
-                    self.opt_state = out["opt_state"]
-                for k, v in (out.get("meta") or {}).items():
-                    setattr(self, k, v)
-                self._commit_step = step + 1
-                self.save()
-                return True
-        if not (self.commit_path and os.path.exists(self.commit_path)):
+            fast = self._fast()
+            fast_step, use_fast, agreed_orbax = \
+                self._agreed_restore_plan(fast)
+            if use_fast:
+                if self._restore_fast(fast, fast_step):
+                    return True
+                # Newest commit unrestorable (topology/dtype change):
+                # older orbax steps stay off-limits; only the pickle
+                # below (same commit freshness) may still serve.
+            elif agreed_orbax is not None:
+                if self._restore_orbax(agreed_orbax):
+                    return True
+                # Same rule as above: no rollback to older commits;
+                # fall through to the pickle.
+        host_state = None
+        if self.commit_path and os.path.exists(self.commit_path):
+            try:
+                with open(self.commit_path, "rb") as f:
+                    host_state = pickle.load(f)
+            except Exception:
+                host_state = None
+        # The pickle has no sharding metadata, but it must not resurrect
+        # state the validating stores just refused: any live template is
+        # a layout contract (structure + shapes + dtypes) here too.
+        ok = host_state is not None and all(
+            self._pickle_matches_template(getattr(self, name, None),
+                                          host_state.get(name))
+            for name in self.PYTREE_FIELDS)
+        # Same all-or-nothing rule as the sharded stores: one host
+        # loading its pickle while a peer's is missing/mismatched would
+        # diverge.  (Hosts whose pickles hold different commit points
+        # converge at the sync() that follows restore — rank 0
+        # broadcasts.)
+        if not self._all_hosts_agree(ok):
             return False
-        with open(self.commit_path, "rb") as f:
-            host_state = pickle.load(f)
         for k, v in host_state.items():
             setattr(self, k, v)
         self.save()
+        return True
+
+    @staticmethod
+    def _pickle_matches_template(template: Any, stored: Any) -> bool:
+        """No template (None) accepts anything; otherwise the stored
+        tree must match the template leaf-for-leaf in shape and dtype."""
+        if template is None or stored is None:
+            return True
+        t_leaves, t_def = jax.tree_util.tree_flatten(template)
+        s_leaves, s_def = jax.tree_util.tree_flatten(stored)
+        if t_def != s_def or len(t_leaves) != len(s_leaves):
+            return False
+        for t, s in zip(t_leaves, s_leaves):
+            if tuple(np.shape(t)) != tuple(np.shape(s)):
+                return False
+            if np.dtype(getattr(t, "dtype", np.asarray(t).dtype)) != \
+                    np.dtype(getattr(s, "dtype", np.asarray(s).dtype)):
+                return False
         return True

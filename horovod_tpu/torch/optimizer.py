@@ -157,7 +157,9 @@ class _HookReducingOptimizer(torch.optim.Optimizer):
                 "gradient tensors must not themselves require grad")
         self._passes_left[p] -= 1
         if self._passes_left[p] == 0:
-            if self._groups is not None:
+            # Explicit `groups` need not cover every parameter; uncovered
+            # ones reduce individually (the reference's contract).
+            if self._groups is not None and p in self._groups:
                 self._enqueue_grouped(p)
             else:
                 self._inflight[p] = self._dispatch_grad(p)

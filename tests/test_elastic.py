@@ -65,6 +65,273 @@ def test_jax_state_sync_and_disk_commit(hvd, tmp_path):
     assert s2.epoch == 2
 
 
+def test_fastcommit_sharded_roundtrip(hvd, tmp_path):
+    """Raw shard blobs round-trip a sharded + replicated + scalar mix,
+    preserving values, shardings, and meta (the elastic restart path)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.elastic.fastcommit import FastCommitStore
+
+    mesh = hvd.mesh()
+    axis = list(mesh.shape)[0]
+    sharded = NamedSharding(mesh, P(axis))
+    replicated = NamedSharding(mesh, P())
+    x = jax.device_put(jnp.arange(32.0), sharded)
+    w = jax.device_put(jnp.ones((4, 4)) * 2, replicated)
+    store = FastCommitStore(str(tmp_path / "fc"))
+    store.save(0, {"params": {"x": x, "w": w, "s": jnp.float32(3.5)},
+                   "opt_state": None}, meta={"epoch": 4})
+    # replication dedupe: the data file holds ONE copy of w, not 8
+    data = (tmp_path / "fc" / "step_0" / "host_0.bin").stat().st_size
+    assert data == x.nbytes + w.nbytes + 4, data
+
+    tmpl = {"x": jax.device_put(jnp.zeros(32), sharded),
+            "w": jax.device_put(jnp.zeros((4, 4)), replicated),
+            "s": jnp.float32(0)}
+    out = store.restore(0, {"params": tmpl, "opt_state": None})
+    assert out is not None and out["opt_state"] is None
+    np.testing.assert_allclose(np.asarray(out["params"]["x"]),
+                               np.arange(32.0))
+    np.testing.assert_allclose(np.asarray(out["params"]["w"]), 2.0)
+    assert float(out["params"]["s"]) == 3.5
+    assert out["params"]["x"].sharding.is_equivalent_to(sharded, 1)
+    assert out["params"]["w"].sharding.is_equivalent_to(replicated, 2)
+    assert out["meta"]["epoch"] == 4
+
+
+def test_fastcommit_mismatch_marker_and_gc(hvd, tmp_path):
+    """Layout changes return None (portable-path fallback), a commit
+    without its durability marker is invisible, and max_to_keep GCs."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.elastic.fastcommit import FastCommitStore
+
+    mesh = hvd.mesh()
+    axis = list(mesh.shape)[0]
+    sharded = NamedSharding(mesh, P(axis))
+    x = jax.device_put(jnp.arange(32.0), sharded)
+    store = FastCommitStore(str(tmp_path / "fc"), max_to_keep=2)
+    for step in (0, 1, 2):
+        store.save(step, {"params": {"x": x}}, meta={})
+    assert sorted(store.steps()) == [1, 2]  # step_0 GC'd
+
+    # wrong global shape -> None
+    bad = {"x": jax.device_put(jnp.zeros(16), sharded)}
+    assert store.restore(2, {"params": bad}) is None
+    # different partitioning (replicated template) -> None
+    repl = {"x": jax.device_put(jnp.zeros(32), NamedSharding(mesh, P()))}
+    assert store.restore(2, {"params": repl}) is None
+    # good template still restores
+    good = {"x": jax.device_put(jnp.zeros(32), sharded)}
+    assert store.restore(2, {"params": good}) is not None
+
+    # a crash between data and marker leaves the step invisible
+    os.remove(str(tmp_path / "fc" / "step_2" / "COMMIT_0"))
+    assert store.latest_step() == 1
+
+
+def test_jax_state_fast_and_orbax_commit_formats(hvd, tmp_path):
+    """JaxState's default durable commit is the fast store; the orbax
+    format remains available and both restore through load_from_disk."""
+    import jax.numpy as jnp
+
+    for fmt in ("fast", "orbax"):
+        d = str(tmp_path / fmt)
+        s = JaxState(params={"w": jnp.arange(4.0)}, opt_state=None,
+                     sharded_commit_dir=d, commit_format=fmt, epoch=1)
+        s.register_host_update_check(lambda: False)
+        s.commit()
+        s.epoch = 9
+        s.commit()  # latest step must win
+        s2 = JaxState(params={"w": jnp.zeros(4)}, opt_state=None,
+                      sharded_commit_dir=d, commit_format=fmt, epoch=0)
+        assert s2.load_from_disk(), fmt
+        np.testing.assert_allclose(np.asarray(s2.params["w"]),
+                                   [0, 1, 2, 3])
+        assert s2.epoch == 9, fmt
+
+
+def test_jax_state_orbax_format_ignores_stale_fast_commits(hvd, tmp_path):
+    """Switching commit_format to orbax must read orbax's own commits,
+    not be shadowed by an older fast-store step in the same directory."""
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "mixed")
+    s = JaxState(params={"w": jnp.zeros(4)}, opt_state=None,
+                 sharded_commit_dir=d, commit_format="fast", epoch=4)
+    s.register_host_update_check(lambda: False)
+    s.commit()
+    s2 = JaxState(params={"w": jnp.ones(4)}, opt_state=None,
+                  sharded_commit_dir=d, commit_format="orbax", epoch=9)
+    s2.register_host_update_check(lambda: False)
+    s2.commit()
+    s3 = JaxState(params={"w": jnp.zeros(4)}, opt_state=None,
+                  sharded_commit_dir=d, commit_format="orbax", epoch=0)
+    assert s3.load_from_disk()
+    assert s3.epoch == 9  # the orbax commit, not the stale fast step
+    np.testing.assert_allclose(np.asarray(s3.params["w"]), 1.0)
+
+
+def test_fastcommit_step_reuse_invalidates_old_marker(hvd, tmp_path):
+    """Re-saving an existing step number (commit counter reset after a
+    restart) must atomically replace it, and the data read back is the
+    new commit's."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.elastic.fastcommit import FastCommitStore
+
+    store = FastCommitStore(str(tmp_path / "fc"))
+    store.save(0, {"params": {"x": jnp.zeros(8)}}, meta={"epoch": 1})
+    store.save(0, {"params": {"x": jnp.ones(8) * 5}}, meta={"epoch": 2})
+    out = store.restore(0, {"params": {"x": jnp.zeros(8)}})
+    assert out is not None
+    np.testing.assert_allclose(np.asarray(out["params"]["x"]), 5.0)
+    assert out["meta"]["epoch"] == 2
+
+
+def test_fastcommit_0d_numpy_leaf_keeps_rank(hvd, tmp_path):
+    """Plain 0-d host leaves (loss scales, counters) must restore as
+    0-d, not the (1,) that the contiguous write path renders them as."""
+    from horovod_tpu.elastic.fastcommit import FastCommitStore
+
+    store = FastCommitStore(str(tmp_path / "fc"))
+    tree = {"scale": np.float32(512.0), "count": np.int64(7)}
+    store.save(0, {"opt_state": tree}, meta={})
+    out = store.restore(0, {"opt_state": {"scale": np.float32(0),
+                                          "count": np.int64(0)}})
+    assert out is not None
+    assert out["opt_state"]["scale"].shape == ()
+    assert float(out["opt_state"]["scale"]) == 512.0
+    assert int(out["opt_state"]["count"]) == 7
+
+
+def test_fast_mode_never_falls_back_to_stale_orbax(hvd, tmp_path):
+    """If fast commits exist but cannot be restored (topology change),
+    older orbax steps in the same dir must NOT silently roll training
+    back; load_from_disk reports failure instead."""
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "mixed2")
+    s_old = JaxState(params={"w": jnp.zeros(4)}, opt_state=None,
+                     sharded_commit_dir=d, commit_format="orbax", epoch=3)
+    s_old.register_host_update_check(lambda: False)
+    s_old.commit()
+    s_new = JaxState(params={"w": jnp.ones(4)}, opt_state=None,
+                     sharded_commit_dir=d, commit_format="fast", epoch=50)
+    s_new.register_host_update_check(lambda: False)
+    s_new.commit()
+    # a template the fast commit can't map onto (different shape)
+    s3 = JaxState(params={"w": jnp.zeros(8)}, opt_state=None,
+                  sharded_commit_dir=d, commit_format="fast", epoch=0)
+    assert not s3.load_from_disk()
+    assert s3.epoch == 0  # never regressed to the orbax epoch-3 state
+
+
+def test_fastcommit_counter_reset_purges_stale_timeline(hvd, tmp_path):
+    """A commit counter that restarted below stale steps begins a new
+    timeline: the stale steps must neither shadow latest_step() nor let
+    GC delete the commit just written (the durable-on-return promise)."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.elastic.fastcommit import FastCommitStore
+
+    store = FastCommitStore(str(tmp_path / "fc"), max_to_keep=2)
+    store.save(5, {"params": {"x": jnp.zeros(4)}}, meta={"epoch": 5})
+    store.save(6, {"params": {"x": jnp.zeros(4)}}, meta={"epoch": 6})
+    store.save(0, {"params": {"x": jnp.ones(4) * 9}}, meta={"epoch": 0})
+    assert store.steps() == [0]  # stale 5/6 purged, 0 survives its GC
+    out = store.restore(0, {"params": {"x": jnp.zeros(4)}})
+    assert out is not None
+    np.testing.assert_allclose(np.asarray(out["params"]["x"]), 9.0)
+
+
+def test_fastcommit_bf16_roundtrip(hvd, tmp_path):
+    """bfloat16 (the standard TPU dtype) must commit and restore: the
+    write path needs a uint8 view because numpy's buffer protocol
+    rejects ml_dtypes extension dtypes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.elastic.fastcommit import FastCommitStore
+
+    mesh = hvd.mesh()
+    sh = NamedSharding(mesh, P(list(mesh.shape)[0]))
+    x = jax.device_put(jnp.arange(32.0, dtype=jnp.bfloat16), sh)
+    store = FastCommitStore(str(tmp_path / "fc"))
+    store.save(0, {"params": {"x": x, "s": jnp.bfloat16(2.5)}}, meta={})
+    out = store.restore(0, {"params": {
+        "x": jax.device_put(jnp.zeros(32, jnp.bfloat16), sh),
+        "s": jnp.bfloat16(0)}})
+    assert out is not None
+    assert out["params"]["x"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out["params"]["x"], dtype=np.float32),
+        np.arange(32.0, dtype=np.float32))
+    assert float(out["params"]["s"]) == 2.5
+
+
+def test_fastcommit_dtype_change_is_layout_mismatch(hvd, tmp_path):
+    """Restoring into templates of a different dtype must refuse (None),
+    not silently resurrect the old precision."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.elastic.fastcommit import FastCommitStore
+
+    store = FastCommitStore(str(tmp_path / "fc"))
+    store.save(0, {"params": {"x": jnp.ones(8, jnp.float32)}}, meta={})
+    assert store.restore(
+        0, {"params": {"x": jnp.ones(8, jnp.bfloat16)}}) is None
+    assert store.restore(
+        0, {"params": {"x": jnp.zeros(8, jnp.float32)}}) is not None
+
+
+def test_fastcommit_reaps_markerless_crash_leftovers(hvd, tmp_path):
+    """Data written but no marker (crash mid-commit): invisible to
+    restore AND reclaimed by the next save, not leaked forever."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.elastic.fastcommit import FastCommitStore
+
+    store = FastCommitStore(str(tmp_path / "fc"))
+    store.save(7, {"params": {"x": jnp.zeros(4)}}, meta={})
+    os.remove(str(tmp_path / "fc" / "step_7" / "COMMIT_0"))  # the crash
+    assert store.steps() == []
+    store.save(0, {"params": {"x": jnp.ones(4)}}, meta={})
+    assert store.steps() == [0]
+    assert not (tmp_path / "fc" / "step_7").exists()  # blob reclaimed
+
+
+def test_pickle_commit_respects_template_layout(hvd, tmp_path):
+    """The commit_path pickle must not resurrect state whose layout the
+    sharded stores refused: a live template is a shape/dtype contract.
+    No template (params=None) keeps accepting anything, as before."""
+    import jax.numpy as jnp
+
+    path = str(tmp_path / "state.pkl")
+    s = JaxState(params={"w": jnp.arange(4.0)}, opt_state=None,
+                 commit_path=path, epoch=2)
+    s.register_host_update_check(lambda: False)
+    s.commit()
+    # reshaped template: refuse
+    s2 = JaxState(params={"w": jnp.zeros(8)}, opt_state=None,
+                  commit_path=path, epoch=0)
+    assert not s2.load_from_disk()
+    assert s2.epoch == 0
+    # re-precisioned template: refuse
+    s3 = JaxState(params={"w": jnp.zeros(4, jnp.bfloat16)},
+                  opt_state=None, commit_path=path, epoch=0)
+    assert not s3.load_from_disk()
+    # matching template: restore
+    s4 = JaxState(params={"w": jnp.zeros(4)}, opt_state=None,
+                  commit_path=path, epoch=0)
+    assert s4.load_from_disk() and s4.epoch == 2
+
+
 def test_run_wrapper_hard_reset(hvd):
     """HorovodInternalError -> shutdown/re-init/restore/retry (reference:
     common/elastic.py:151-175)."""
@@ -283,3 +550,7 @@ def test_jax_state_sharded_commit_restore_at_1gb(hvd, tmp_path, capsys):
     # minutes would make the restart-the-world elastic model unusable
     assert commit_s < 180, commit_s
     assert restore_s < 180, restore_s
+    # the r4 VERDICT bar: restore must keep within 2x of save (the old
+    # chunk-serial orbax restore ran 3-8x slower than save; the raw
+    # shard store restores from page cache at memory speed)
+    assert restore_s < 2 * commit_s + 2.0, (restore_s, commit_s)

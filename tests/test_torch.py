@@ -228,6 +228,19 @@ def test_distributed_optimizer_num_groups():
     assert losses[-1] < losses[0] * 0.5
 
 
+def test_distributed_optimizer_partial_groups_covers_rest():
+    """Explicit groups covering only SOME parameters: uncovered params
+    must reduce individually, not crash the grad hook."""
+    def make(model):
+        params = list(model.parameters())
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        return hvd.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters(),
+            groups=[params[:1]])  # everything else is ungrouped
+    losses = _train(make)
+    assert losses[-1] < losses[0] * 0.5
+
+
 def test_distributed_optimizer_backward_passes_per_step():
     torch.manual_seed(0)
     model = torch.nn.Linear(4, 1)
