@@ -1,0 +1,31 @@
+"""CPU virtualization BEFORE jax backend init — the canonical copy.
+
+The TPU image's sitecustomize (in /root/.axon_site) force-registers the
+hardware backend via jax.config whenever PALLAS_AXON_POOL_IPS is set,
+and that config update beats the JAX_PLATFORMS env var; a worker that
+misses the disarm grabs the tunnel backend and hangs when it is down.
+Every CPU-side multi-process entrypoint (integration workers, dryrun
+native leg, eager bench) calls bootstrap() as its first act so the
+subtlety lives in exactly one place.
+"""
+
+import os
+
+
+def bootstrap(default_chips: int = 1) -> None:
+    """Force the CPU backend with HVD_CPU_CHIPS virtual devices
+    (default `default_chips`) for this process and its children."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        chips = os.environ.get("HVD_CPU_CHIPS", str(default_chips))
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            + chips).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # other jax versions: default implementation already works

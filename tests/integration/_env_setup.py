@@ -4,26 +4,20 @@ Import this as the first statement of every integration worker:
 
     import _env_setup  # noqa: F401
 
-Each worker process drives 4 virtual CPU chips; with -np 2 the mesh is
-8 chips across 2 real processes.
+Each worker process drives 4 virtual CPU chips by default (HVD_CPU_CHIPS
+overrides); with -np 2 the mesh is 8 chips across 2 real processes.
+The actual env dance (sitecustomize disarm, device count, jax config)
+lives in ONE place — scripts/_cpu_bootstrap.py — shared with the dryrun
+native-controller worker and the eager bench.
 """
 
+import importlib.util
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-# Disarm the TPU-image site customization for this worker and anything it
-# spawns (it only registers the hardware backend when this var is set, and
-# its config update beats JAX_PLATFORMS — see tests/conftest.py).
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=4").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-try:
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-except Exception:
-    pass  # other jax versions: default implementation already works
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_spec = importlib.util.spec_from_file_location(
+    "_cpu_bootstrap", os.path.join(_REPO, "scripts", "_cpu_bootstrap.py"))
+_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+_mod.bootstrap(default_chips=4)
