@@ -124,10 +124,15 @@ def maybe_profile(args):
     return jax.profiler.trace(args.profile)
 
 
-def fail(reason: str, **extra) -> int:
+def fail(reason: str, cause: str = "bench-crash", **extra) -> int:
+    """Emit the error JSON.  ``cause`` is a closed taxonomy so artifacts
+    distinguish infrastructure failures from real bench bugs (the r4
+    flash-mxu rc=1 trio was unattributable without it):
+    tunnel-down | tunnel-down-during-run | timeout | invalid-result |
+    bench-crash."""
     print(json.dumps({"metric": "BENCH_INVALID", "value": 0,
                       "unit": "error", "vs_baseline": 0,
-                      "error": reason, **extra}))
+                      "cause": cause, "error": reason, **extra}))
     return 1
 
 
@@ -205,7 +210,8 @@ def supervise(argv) -> int:
     if "--cpu" not in argv:
         reason = probe_tpu(probe_timeout)
         if reason:
-            return fail(reason, probe_timeout_s=probe_timeout)
+            return fail(reason, cause="tunnel-down",
+                        probe_timeout_s=probe_timeout)
 
     def run_child(extra_args, budget_s):
         cmd = [sys.executable, os.path.abspath(__file__), "--inner",
@@ -239,7 +245,15 @@ def supervise(argv) -> int:
         if line:
             print(line)
             return 0 if "BENCH_INVALID" not in line else 1
-    return fail(f"bench child produced no JSON ({status})",
+    # Attribute the failure: a child that died (or hung — a dead tunnel
+    # usually presents as a hang) while the tunnel dropped is an
+    # infrastructure event, not a bench bug (the r4 flash-mxu trio was
+    # ambiguous exactly here).  One <=55s probe on an already-failed
+    # run is cheap.
+    cause = "timeout" if status == "timeout" else "bench-crash"
+    if "--cpu" not in argv and probe_tpu(probe_timeout):
+        cause = "tunnel-down-during-run"
+    return fail(f"bench child produced no JSON ({status})", cause=cause,
                 elapsed_s=round(time.monotonic() - t_start, 1))
 
 
@@ -420,7 +434,8 @@ def main() -> int:
     wparams, wopt, wlosses = run(params, opt_state, make_batches(args.steps))
     warm = np.asarray(wlosses)  # D2H fence
     if not np.all(np.isfinite(warm)):
-        return fail("non-finite warmup loss", losses=warm.tolist())
+        return fail("non-finite warmup loss", cause="invalid-result",
+                    losses=warm.tolist())
     params, opt_state = wparams, wopt
 
     batches = make_batches(args.steps)
@@ -432,12 +447,14 @@ def main() -> int:
 
     # --- sanity gates ---------------------------------------------------
     if losses_host.shape != (args.steps,):
-        return fail("loss shape mismatch", shape=list(losses_host.shape))
+        return fail("loss shape mismatch", cause="invalid-result",
+                    shape=list(losses_host.shape))
     if not np.all(np.isfinite(losses_host)):
-        return fail("non-finite loss in timed run",
+        return fail("non-finite loss in timed run", cause="invalid-result",
                     losses=losses_host.tolist())
     if args.steps > 1 and float(np.ptp(losses_host)) == 0.0:
         return fail("loss constant across steps — params not updating",
+                    cause="invalid-result",
                     loss=float(losses_host[0]))
 
     tokens = args.steps * global_batch * args.seq
@@ -452,6 +469,7 @@ def main() -> int:
     if not (0.0 < mfu < 1.0):
         return fail(
             f"MFU {mfu:.4f} outside (0,1) — timing or peak detection broken",
+            cause="invalid-result",
             chip=chip, tok_per_sec_chip=tok_per_sec_chip,
             loss_first=float(losses_host[0]), loss_last=float(losses_host[-1]))
 
@@ -462,6 +480,11 @@ def main() -> int:
                   f"{float(losses_host[-1]):.3f})",
         "value": round(tok_per_sec_chip, 1),
         "unit": "tokens/sec/chip",
+        # One schema, one meaning: vs_baseline IS the MFU for model
+        # benches; mfu/vs_baseline_is make that explicit in the artifact
+        # (a 65x-of-peak artifact can never masquerade as MFU again).
+        "mfu": round(mfu, 4),
+        "vs_baseline_is": "mfu",
         "vs_baseline": round(mfu, 4),
     }))
     return 0
@@ -491,7 +514,8 @@ def autotune_bench(args) -> int:
     n = hvd.size()
     tuner = hvd.autotuner()
     if tuner is None:
-        return fail("HOROVOD_AUTOTUNE=1 did not enable the autotuner")
+        return fail("HOROVOD_AUTOTUNE=1 did not enable the autotuner",
+                    cause="invalid-result")
 
     # A model-like gradient set: a few big tensors + a tail of small ones
     # (what makes bucketing matter).  ~100 MB on TPU, ~2 MB on CPU.
@@ -542,7 +566,8 @@ def autotune_bench(args) -> int:
             jax.block_until_ready(fn(*gs))
         steps += 1
     if not tuner.done:
-        return fail(f"autotune did not converge in {steps} steps")
+        return fail(f"autotune did not converge in {steps} steps",
+                    cause="invalid-result")
     tuned = tuner.fusion_threshold
 
     before = timed_sync(initial)
@@ -554,6 +579,7 @@ def autotune_bench(args) -> int:
                   f"log={log_path})",
         "value": round(after / 1e9, 3),
         "unit": "GB/s",
+        "vs_baseline_is": "speedup_vs_initial_threshold",
         "vs_baseline": round(after / max(before, 1e-9), 4),
     }))
     return 0
@@ -647,7 +673,8 @@ def resnet_bench(args) -> int:
     params, opt_state, warm = run(params, opt_state, x, y)
     warm = np.asarray(warm)  # D2H fence
     if not np.all(np.isfinite(warm)):
-        return fail("non-finite warmup loss", losses=warm.tolist())
+        return fail("non-finite warmup loss", cause="invalid-result",
+                    losses=warm.tolist())
 
     with maybe_profile(args):
         t0 = time.perf_counter()
@@ -656,7 +683,8 @@ def resnet_bench(args) -> int:
         dt = time.perf_counter() - t0
 
     if not np.all(np.isfinite(losses_host)):
-        return fail("non-finite loss", losses=losses_host.tolist())
+        return fail("non-finite loss", cause="invalid-result",
+                    losses=losses_host.tolist())
     # Params-not-updating shows as a constant loss WITHIN each scan; a
     # constant timed scan alone can be legitimate saturation (the tiny
     # cpu smoke memorizes its fixed batch to exactly 0.0 during warmup,
@@ -666,6 +694,7 @@ def resnet_bench(args) -> int:
     if steps > 1 and float(np.ptp(losses_host)) == 0.0 and \
             float(np.ptp(warm)) == 0.0:
         return fail("loss constant across steps — params not updating",
+                    cause="invalid-result",
                     losses=losses_host.tolist(), warmup=warm.tolist())
 
     # batch is PER CHIP: global throughput / n_chips == steps*batch/dt.
@@ -676,7 +705,8 @@ def resnet_bench(args) -> int:
     train_flops_per_img = 3.0 * fwd_gflop * scale_flops
     mfu = img_per_sec_chip * train_flops_per_img / peak
     if not (0.0 < mfu < 1.0):
-        return fail(f"MFU {mfu:.4f} outside (0,1)", chip=chip,
+        return fail(f"MFU {mfu:.4f} outside (0,1)",
+                    cause="invalid-result", chip=chip,
                     img_per_sec_chip=img_per_sec_chip)
 
     print(json.dumps({
@@ -686,6 +716,8 @@ def resnet_bench(args) -> int:
                   f"{float(losses_host[-1]):.3f})",
         "value": round(img_per_sec_chip, 1),
         "unit": "images/sec/chip",
+        "mfu": round(mfu, 4),
+        "vs_baseline_is": "mfu",
         "vs_baseline": round(mfu, 4),
     }))
     return 0

@@ -45,19 +45,25 @@ def main() -> int:
         return 1
     rows = sorted(latest.items(),
                   key=lambda kv: -kv[1].get("vs_baseline", 0))
-    print("| Config | Result | Unit | vs_baseline (MFU/ratio) |")
+
+    def ratio_label(res):
+        # post-2026-08-01 rows say what the ratio is; older rows don't
+        kind = res.get("vs_baseline_is")
+        val = res.get("mfu", res.get("vs_baseline"))
+        return f"{val} ({kind})" if kind else str(res.get("vs_baseline"))
+
+    print("| Config | Result | Unit | ratio |")
     print("|---|---|---|---|")
     for cfg, res in rows:
         print(f"| {cfg} | {res['value']} | {res['unit']} | "
-              f"{res['vs_baseline']} |")
+              f"{ratio_label(res)} |")
     if failed:
         print()
         print("Incomplete configs:")
         for cfg, err in sorted(failed.items()):
             print(f"- {cfg}: {err}")
     if rows:
-        print(f"\nBest vs_baseline: {rows[0][0]} at "
-              f"{rows[0][1]['vs_baseline']}")
+        print(f"\nBest: {rows[0][0]} at {ratio_label(rows[0][1])}")
     return 0
 
 

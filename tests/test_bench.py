@@ -104,6 +104,31 @@ def test_supervise_fast_fails_on_probe(monkeypatch, capsys):
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 1 and rec["metric"] == "BENCH_INVALID"
     assert "tunnel down" in rec["error"]
+    assert rec["cause"] == "tunnel-down"
+
+
+def test_supervise_attributes_crash_vs_tunnel(monkeypatch, capsys):
+    """An rc=1 child with the tunnel still healthy is a bench-crash; the
+    same child with the tunnel gone mid-run is tunnel-down-during-run
+    (the r4 flash-mxu ambiguity this field exists to remove)."""
+    import bench
+    monkeypatch.setenv("BENCH_DEADLINE_S", "100000")
+
+    def fake_run(cmd, **kw):
+        return _fake_result(1, "")
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+
+    probes = iter(["", ""])  # healthy before AND after -> crash
+    monkeypatch.setattr(bench, "probe_tpu", lambda t: next(probes))
+    rc = bench.supervise(["--steps", "5"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and rec["cause"] == "bench-crash"
+
+    probes = iter(["", "probe timeout"])  # healthy, then dead mid-run
+    monkeypatch.setattr(bench, "probe_tpu", lambda t: next(probes))
+    rc = bench.supervise(["--steps", "5"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and rec["cause"] == "tunnel-down-during-run"
 
 
 def test_supervise_reduced_steps_fallback(monkeypatch, capsys):
