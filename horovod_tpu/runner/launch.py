@@ -463,6 +463,13 @@ def resolve_hosts(args: argparse.Namespace) -> List[hosts_mod.HostInfo]:
         return hosts_mod.parse_hosts(spec)
     if args.hosts:
         return hosts_mod.parse_hosts(args.hosts)
+    # LSF allocation (bsub): the scheduler already granted hosts/slots;
+    # consume them like the reference's lsf.py so `hvdrun python t.py`
+    # works without -H.  Explicit flags above still win.
+    from .lsf import lsf_hosts
+    allocated = None if getattr(args, "tpu", False) else lsf_hosts()
+    if allocated is not None:
+        return allocated
     from .tpu_discovery import discover_tpu_hosts, tpu_worker_id
     tpu_flag = getattr(args, "tpu", False)
     slots = getattr(args, "slots", None) or 1

@@ -109,6 +109,27 @@ def _iter_train(loader, epoch: int, opts: Dict):
         yield transform(batch) if transform else batch
 
 
+def _iter_val_batches(val_path: str, batch_size: int, rank: int,
+                      size: int, fs=None, opts: Optional[Dict] = None):
+    """This worker's shard of the val set as (x, y) pairs, honoring the
+    shared data knobs (val_batch_size, transformation_fn,
+    validation_steps_per_epoch) — ONE definition for the predict-metrics
+    path and the lightning validation_step path."""
+    import itertools
+    opts = opts or {}
+    loader = ParquetDataLoader(val_path,
+                               opts.get("val_batch_size") or batch_size,
+                               rank=rank, num_workers=size, fs=fs)
+    transform = opts.get("transformation_fn")
+    val_cap = opts.get("validation_steps_per_epoch")
+    it = iter(loader) if val_cap is None else \
+        itertools.islice(loader, val_cap)
+    for batch in it:
+        if transform:
+            batch = transform(batch)
+        yield batch
+
+
 def _eval_metrics(predict: Callable, val_path: Optional[str],
                   feature_cols, label_cols, metrics, batch_size: int,
                   rank: int, size: int, sync, fs=None,
@@ -118,18 +139,9 @@ def _eval_metrics(predict: Callable, val_path: Optional[str],
     equals the global weighted mean regardless of shard imbalance."""
     if val_path is None or not metrics:
         return {}
-    opts = opts or {}
-    loader = ParquetDataLoader(val_path,
-                               opts.get("val_batch_size") or batch_size,
-                               rank=rank, num_workers=size, fs=fs)
     sums = np.zeros((len(metrics) + 1,), np.float64)
-    import itertools
-    transform = opts.get("transformation_fn")
-    val_cap = opts.get("validation_steps_per_epoch")
-    it = iter(loader) if val_cap is None else         itertools.islice(loader, val_cap)
-    for batch in it:
-        if transform:
-            batch = transform(batch)
+    for batch in _iter_val_batches(val_path, batch_size, rank, size,
+                                   fs=fs, opts=opts):
         x, y = _assemble_batch(batch, feature_cols, label_cols)
         p = np.asarray(predict(x))
         for j, (_, fn) in enumerate(metrics):
@@ -152,7 +164,9 @@ def _epoch_driver(store: Store, run_id: str, epochs: int, metrics,
                   predict: Callable[[np.ndarray], np.ndarray],
                   cold_start: Optional[Callable[[], None]] = None,
                   opts: Optional[Dict] = None,
-                  should_stop: Optional[Callable[[], bool]] = None) -> Dict:
+                  should_stop: Optional[Callable[[], bool]] = None,
+                  extra_eval: Optional[Callable[[int], Dict]] = None
+                  ) -> Dict:
     """The one epoch loop every train task shares: resume from the stored
     envelope (or run ``cold_start`` — typically the initial cross-worker
     parameter sync), then per epoch: train, eval val metrics, rank-0
@@ -177,6 +191,11 @@ def _epoch_driver(store: Store, run_id: str, epochs: int, metrics,
                                   size, sync, fs=store.fs,
                                   opts=opts).items():
             history.setdefault(k, []).append(v)
+        if extra_eval is not None:
+            # framework-specific per-epoch eval (e.g. lightning's
+            # validation_step protocol) merged into the same history
+            for k, v in (extra_eval(epoch) or {}).items():
+                history.setdefault(k, []).append(v)
         if rank == 0 and opts.get("verbose"):
             parts = [f"{k}={v[-1]:.4f}" for k, v in history.items()]
             print(f"[estimator] epoch {epoch}: " + " ".join(parts),

@@ -90,6 +90,43 @@ def _first_optimizer(configured):
     return configured, None
 
 
+class _TrainerProxy:
+    """The slim stand-in handed to callbacks where lightning passes its
+    Trainer (reference: remote.py builds a full pl.Trainer).  Carries
+    the attributes well-behaved callbacks read: current_epoch,
+    global_step, callback_metrics (the module.log sink), should_stop
+    (writable — EarlyStopping's stop signal), and is_global_zero."""
+
+    def __init__(self, rank: int):
+        self.current_epoch = 0
+        self.global_step = 0
+        self.callback_metrics: dict = {}
+        self.should_stop = False
+        self.is_global_zero = rank == 0
+        # widely-read flags, so simple real-lightning callbacks that
+        # check them don't crash (a FULL pl.Trainer surface is out of
+        # scope — see the estimator docstring)
+        self.sanity_checking = False
+        self.fast_dev_run = False
+
+
+class _CallbackList:
+    """Duck-typed lightning Callback dispatch: each hook fires when the
+    callback defines it (reference: estimator.py `callbacks` param,
+    forwarded to the Trainer)."""
+
+    def __init__(self, callbacks, proxy, module):
+        self.cbs = list(callbacks or ())
+        self.proxy = proxy
+        self.module = module
+
+    def fire(self, hook: str, *args) -> None:
+        for cb in self.cbs:
+            fn = getattr(cb, hook, None)
+            if fn is not None:
+                fn(self.proxy, self.module, *args)
+
+
 class LightningEstimator(Estimator):
     """Estimator over a LightningModule factory (reference:
     spark/lightning/estimator.py TorchEstimator(model=...)).
@@ -97,9 +134,43 @@ class LightningEstimator(Estimator):
     ``model_fn`` builds the module per worker (factories keep the fit
     payload small and make re-instantiation after elastic resets safe —
     the reference serializes the module itself for the same purpose).
+
+    Param-surface delta vs the reference lightning estimator
+    (estimator.py:203-240): the shared data/fit knobs (validation,
+    batch sizes, steps caps, shuffle_buffer_size, transformation_fn,
+    verbose) live on the base Estimator; this class adds the
+    lightning-specific surface —
+
+    * ``callbacks``: lightning-style Callback objects; the trainer loop
+      fires on_train_start/on_train_epoch_start/on_train_batch_end/
+      on_train_epoch_end/on_validation_epoch_end/on_train_end with a
+      Trainer PROXY (current_epoch, global_step, callback_metrics,
+      writable should_stop — cross-worker-synced).  EarlyStopping-style
+      callbacks that read callback_metrics and set should_stop work;
+      pytorch_lightning's own EarlyStopping class expects a full
+      pl.Trainer (trainer.state etc.) and needs a thin duck-typed
+      equivalent instead.
+    * ``logger`` + ``log_every_n_steps``: anything with
+      ``log_metrics(dict, step)`` (lightning Logger protocol);
+      ``self.log(...)`` calls inside training_step/validation_step are
+      captured and flushed on the cadence, rank 0 only.
+    * ``validation_step`` protocol: when the module defines it and a
+      validation set exists, it runs per epoch and its mean outputs
+      land in history as ``val_loss`` (plus any logged metrics) —
+      the reference's val dataloader path.
+    * ``gradient_clip_val``: the Trainer knob (clip-by-norm before
+      every step, reference Trainer(gradient_clip_val=...)).
+
+    Knobs with no analog here: reference's num_gpus/backend (TPU mesh
+    is the backend), train_minibatch_fn (training_step owns the step),
+    inmemory_cache_all/reader-pool knobs (streaming loaders read row
+    groups directly), profiler/terminate_on_nan (use the framework
+    timeline/xprof; non-finite losses raise in the metrics path).
     """
 
     def __init__(self, store: Store, model_fn: Callable, num_proc: int = 1,
+                 callbacks=(), logger=None, log_every_n_steps: int = 50,
+                 gradient_clip_val: float = None,
                  **kwargs):
         super().__init__(store, num_proc=num_proc, **kwargs)
         if self.sample_weight_col:
@@ -107,14 +178,31 @@ class LightningEstimator(Estimator):
                 "LightningEstimator does not support sample_weight_col: "
                 "training_step owns the loss — weight it inside the "
                 "module")
+        from .estimator import _resolve_metrics
+        if any(name == "loss"
+               for name, _ in _resolve_metrics(self.metrics)):
+            # _eval_metrics would emit 'val_loss' for it, colliding with
+            # the validation_step series of the same name — two appends
+            # per epoch to one history key.
+            raise ValueError(
+                "a metric named 'loss' collides with validation_step's "
+                "val_loss history series; rename the metric")
         self.model_fn = model_fn
+        self.callbacks = list(callbacks or ())
+        self.logger = logger
+        self.log_every_n_steps = int(log_every_n_steps)
+        self.gradient_clip_val = gradient_clip_val
 
     def _make_train_task(self) -> Callable:
         return _LightningTrainTask(self.store, self.run_id, self.model_fn,
                                    self.feature_cols, self.label_cols,
                                    self.batch_size, self.epochs,
                                    metrics=self.metrics,
-                                   opts=self._data_opts())
+                                   opts=self._data_opts(),
+                                   callbacks=self.callbacks,
+                                   logger=self.logger,
+                                   log_every_n_steps=self.log_every_n_steps,
+                                   gradient_clip_val=self.gradient_clip_val)
 
     def _load_model(self, payload: bytes) -> Callable:
         return _torch_predict_fn(self.model_fn, payload)
@@ -126,7 +214,9 @@ class _LightningTrainTask:
     RemoteTrainer's train function)."""
 
     def __init__(self, store, run_id, model_fn, feature_cols, label_cols,
-                 batch_size, epochs, metrics=(), opts=None):
+                 batch_size, epochs, metrics=(), opts=None,
+                 callbacks=(), logger=None, log_every_n_steps=50,
+                 gradient_clip_val=None):
         self.opts = dict(opts or {})
         self.store = store
         self.run_id = run_id
@@ -136,6 +226,10 @@ class _LightningTrainTask:
         self.batch_size = batch_size
         self.epochs = epochs
         self.metrics = list(metrics)
+        self.callbacks = list(callbacks or ())
+        self.logger = logger
+        self.log_every_n_steps = int(log_every_n_steps)
+        self.gradient_clip_val = gradient_clip_val
 
     def __call__(self, train_path: str, val_path=None):
         import io
@@ -150,6 +244,45 @@ class _LightningTrainTask:
         sched, interval, freq = sched_cfg or (None, "epoch", 1)
         step_counter = {"global_step": 0}
 
+        proxy = _TrainerProxy(rank)
+        cbs = _CallbackList(self.callbacks, proxy, module)
+        logger = self.logger if rank == 0 else None
+        pending_logs: dict = {}
+
+        def log_shim(name, value, *args, **kwargs):
+            # LightningModule.log without a Trainer attached: capture
+            # into callback_metrics (for callbacks like EarlyStopping)
+            # and the logger flush buffer.
+            v = float(value.detach() if hasattr(value, "detach")
+                      else value)
+            proxy.callback_metrics[name] = v
+            pending_logs[name] = v
+
+        module.log = log_shim  # instance attr shadows the real method
+
+        def flush_logs(force=False):
+            if logger is None or not pending_logs:
+                return
+            # cadence <= 0 means "epoch boundaries only" (guards the
+            # modulo too); forced flushes always go through
+            every = self.log_every_n_steps
+            if force or (every > 0 and proxy.global_step % every == 0):
+                logger.log_metrics(dict(pending_logs),
+                                   step=proxy.global_step)
+                pending_logs.clear()
+
+        def synced_should_stop() -> bool:
+            # lightning allreduces should_stop; an unsynced rank-local
+            # decision (e.g. set only under trainer.is_global_zero)
+            # would break one rank out of the epoch loop while the rest
+            # block in the next grad sync.
+            flag = 1.0 if proxy.should_stop else 0.0
+            if size > 1:
+                flag = float(np.asarray(
+                    sync([np.array([flag], np.float64)])[0]).max())
+            proxy.should_stop = flag > 0.0
+            return proxy.should_stop
+
         def restore(payload: bytes) -> None:
             module.load_state_dict(torch.load(io.BytesIO(payload),
                                               weights_only=True))
@@ -161,7 +294,26 @@ class _LightningTrainTask:
             torch.save(module.state_dict(), buf)
             return buf.getvalue()
 
+        started = {"done": False}
+
         def train_epoch(epoch: int) -> float:
+            if not started["done"]:  # after a possible resume-restore
+                started["done"] = True
+                if epoch > 0 and step_counter["global_step"] == 0:
+                    # Resume: rebuild an (approximate) monotonic step
+                    # count so logger series don't restart at 0 and
+                    # step-interval schedulers keep their cadence
+                    # position.  Exact per-epoch counts aren't in the
+                    # envelope; uniform epochs make this exact.
+                    per = len(loader)
+                    cap = self.opts.get("train_steps_per_epoch")
+                    if cap:
+                        per = min(per, int(cap))
+                    step_counter["global_step"] = epoch * per
+                    proxy.global_step = step_counter["global_step"]
+                cbs.fire("on_train_start")
+            proxy.current_epoch = epoch
+            cbs.fire("on_train_epoch_start")
             module.train()
             epoch_loss, nb = 0.0, 0
             for i, batch in enumerate(_iter_train(loader, epoch,
@@ -178,10 +330,16 @@ class _LightningTrainTask:
                 loss.backward()
                 if size > 1:
                     _torch_sync_grads(module, sync)
+                if self.gradient_clip_val:
+                    torch.nn.utils.clip_grad_norm_(
+                        module.parameters(), self.gradient_clip_val)
                 opt.step()
                 epoch_loss += float(loss.detach())
                 nb += 1
                 step_counter["global_step"] += 1
+                proxy.global_step = step_counter["global_step"]
+                cbs.fire("on_train_batch_end", out, bt, i)
+                flush_logs()
                 if sched is not None and interval == "step" and \
                         step_counter["global_step"] % freq == 0:
                     sched.step()
@@ -190,7 +348,58 @@ class _LightningTrainTask:
                 sched.step()
             if hasattr(module, "on_train_epoch_end"):
                 module.on_train_epoch_end()
+            # callbacks' on_train_epoch_end fires AFTER this epoch's
+            # validation (lightning's ordering) — see epoch_end below
             return epoch_loss / max(nb, 1)
+
+        def epoch_end(epoch: int) -> dict:
+            """Per-epoch tail in lightning's order: validation_step over
+            the sharded val set (transform + steps-cap honored, losses
+            averaged exactly across workers), THEN the callbacks' epoch
+            end — so stopping callbacks see THIS epoch's val_loss."""
+            out_hist = {}
+            if val_path is not None and \
+                    hasattr(module, "validation_step"):
+                from .estimator import _iter_val_batches
+                module.eval()
+                sums = np.zeros((2,), np.float64)
+                with torch.no_grad():
+                    for i, batch in enumerate(_iter_val_batches(
+                            val_path, self.batch_size, rank, size,
+                            fs=self.store.fs, opts=self.opts)):
+                        x, y = _assemble_batch(batch, self.feature_cols,
+                                               self.label_cols)
+                        bt = (torch.from_numpy(
+                                  np.ascontiguousarray(x, np.float32)),
+                              torch.from_numpy(
+                                  np.ascontiguousarray(y, np.float32)))
+                        out = module.validation_step(bt, i)
+                        if out is None:
+                            continue
+                        loss = out["loss"] if isinstance(out, dict) \
+                            else out
+                        # plain floats / numpy scalars are legal step
+                        # outputs too
+                        sums[0] += float(
+                            loss.detach() if hasattr(loss, "detach")
+                            else loss) * len(x)
+                        sums[1] += len(x)
+                if size > 1:
+                    sums = np.asarray(sync([sums])[0], np.float64)
+                if sums[1] > 0:
+                    # sums[1] == 0 means every batch returned None: a
+                    # real pl.LightningModule that never overrode the
+                    # base-class hook (hasattr is always true there) —
+                    # recording val_loss=0.0 would feed stopping
+                    # callbacks a perfect constant.
+                    val_loss = float(sums[0] / sums[1])
+                    proxy.callback_metrics["val_loss"] = val_loss
+                    pending_logs["val_loss"] = val_loss
+                    cbs.fire("on_validation_epoch_end")
+                    out_hist["val_loss"] = val_loss
+            cbs.fire("on_train_epoch_end")
+            flush_logs(force=True)
+            return out_hist
 
         history = _epoch_driver(
             self.store, self.run_id, self.epochs, self.metrics,
@@ -200,7 +409,13 @@ class _LightningTrainTask:
             restore=restore, serialize=serialize, train_epoch=train_epoch,
             predict=lambda x: _torch_eval_predict(module, x),
             cold_start=(lambda: _torch_sync_params(module, sync))
-            if size > 1 else None)
+            if size > 1 else None,
+            extra_eval=epoch_end,
+            should_stop=synced_should_stop)
+        cbs.fire("on_train_end")
+        flush_logs(force=True)
+        if logger is not None and hasattr(logger, "finalize"):
+            logger.finalize("success")
         return history["train_loss"][-1] if history["train_loss"] else 0.0
 
 

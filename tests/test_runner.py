@@ -343,6 +343,35 @@ def test_tpu_discovery_single_host_slice_is_none():
                               metadata_fetch=lambda a: None) is None
 
 
+def test_lsf_allocation_hosts(tmp_path, monkeypatch):
+    """Inside an LSF job, hvdrun consumes the granted allocation without
+    -H (reference: runner/util/lsf.py); hostname multiplicity = slots;
+    explicit flags still win; --tpu skips LSF."""
+    from horovod_tpu.runner.launch import resolve_hosts
+    from horovod_tpu.runner.lsf import lsf_hosts
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("batch1\nbatch1\nnode2\nnode2\nnode2\n")
+    got = lsf_hosts(environ={"LSB_DJOB_HOSTFILE": str(hf)})
+    assert [(h.hostname, h.slots) for h in got] == \
+        [("batch1", 2), ("node2", 3)]
+    # inline fallback
+    got = lsf_hosts(environ={"LSB_HOSTS": "a a b"})
+    assert [(h.hostname, h.slots) for h in got] == [("a", 2), ("b", 1)]
+    assert lsf_hosts(environ={}) is None
+
+    # wired through resolve_hosts
+    monkeypatch.setenv("LSB_HOSTS", "lsfa lsfa lsfb")
+    monkeypatch.delenv("LSB_DJOB_HOSTFILE", raising=False)
+    args = make_parser().parse_args(["-np", "3", "cmd"])
+    assert [(h.hostname, h.slots) for h in resolve_hosts(args)] == \
+        [("lsfa", 2), ("lsfb", 1)]
+    # explicit -H beats the allocation
+    args = make_parser().parse_args(["-np", "2", "-H", "x:2", "cmd"])
+    assert [(h.hostname, h.slots) for h in resolve_hosts(args)] == \
+        [("x", 2)]
+
+
 def test_tpu_flag_requires_discovery(monkeypatch):
     from horovod_tpu.runner.launch import resolve_hosts
     monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)

@@ -319,6 +319,126 @@ def test_lightning_estimator_end_to_end(tmp_path):
     assert mse < 0.5, mse
 
 
+class _LogValModule(_ToyLightningModule):
+    """Adds self.log calls and the validation_step protocol."""
+
+    def training_step(self, batch, batch_idx):
+        out = super().training_step(batch, batch_idx)
+        self.log("train_loss_logged", out["loss"])
+        return out
+
+    def validation_step(self, batch, batch_idx):
+        import torch
+        x, y = batch
+        loss = torch.nn.functional.mse_loss(self.net(x), y)
+        self.log("val_mae", (self.net(x) - y).abs().mean())
+        return loss
+
+
+_CLIP = 0.5
+
+
+class _FileRecorderCB:
+    """Duck-typed lightning Callback; the train task runs in a
+    subprocess, so observations go through files."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def _ev(self, s):
+        with open(self.path, "a") as f:
+            f.write(s + "\n")
+
+    def on_train_start(self, trainer, module):
+        self._ev("start")
+
+    def on_train_epoch_end(self, trainer, module):
+        self._ev(f"epoch{trainer.current_epoch}")
+
+    def on_train_batch_end(self, trainer, module, out, batch, i):
+        import torch
+        g = torch.sqrt(sum((p.grad ** 2).sum()
+                           for p in module.parameters()
+                           if p.grad is not None))
+        # gradient_clip_val bounds the norm seen by opt.step()
+        assert float(g) <= _CLIP + 1e-4, float(g)
+
+    def on_validation_epoch_end(self, trainer, module):
+        assert "val_loss" in trainer.callback_metrics
+        self._ev("val")
+
+    def on_train_end(self, trainer, module):
+        self._ev("end")
+
+
+class _StopAfter2CB:
+    """EarlyStopping-style: writes trainer.should_stop."""
+
+    def on_train_epoch_end(self, trainer, module):
+        if trainer.current_epoch >= 1:
+            trainer.should_stop = True
+
+
+class _FileLogger:
+    """lightning Logger protocol subset, file-backed for the
+    subprocess boundary."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def log_metrics(self, metrics, step=None):
+        import json
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"step": step, "metrics": metrics}) + "\n")
+
+    def finalize(self, status):
+        with open(self.path, "a") as f:
+            f.write('{"finalized": "%s"}\n' % status)
+
+
+def test_lightning_callbacks_logger_validation_and_clip(tmp_path):
+    """The lightning-specific estimator surface (reference
+    spark/lightning/estimator.py params): callbacks fire with a Trainer
+    proxy (EarlyStopping via writable should_stop works),
+    validation_step drives val_loss into history, self.log routes to
+    the logger on the log_every_n_steps cadence, and gradient_clip_val
+    bounds the grad norm before every step."""
+    import json
+    from horovod_tpu.spark import FilesystemStore, LightningEstimator
+
+    ev_path = str(tmp_path / "events.txt")
+    log_path = str(tmp_path / "logger.jsonl")
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 4).astype("float32")
+    y = (X @ np.array([[1.0], [-1.0], [0.5], [2.0]], "float32")
+         ).astype("float32")
+    est = LightningEstimator(
+        store=FilesystemStore(str(tmp_path)), model_fn=_LogValModule,
+        num_proc=1, feature_cols=["features"], label_cols=["label"],
+        batch_size=32, epochs=5, validation=0.25,
+        callbacks=[_FileRecorderCB(ev_path), _StopAfter2CB()],
+        logger=_FileLogger(log_path),
+        log_every_n_steps=2, gradient_clip_val=_CLIP)
+    model = est.fit({"features": X, "label": y})
+
+    events = open(ev_path).read().split()
+    assert events[0] == "start" and events[-1] == "end"
+    assert "epoch0" in events and "epoch1" in events
+    assert "epoch2" not in events  # should_stop honored
+    assert "val" in events
+    hist = model.history
+    assert "val_loss" in hist and len(hist["val_loss"]) >= 1
+
+    rows = [json.loads(ln) for ln in open(log_path)]
+    assert rows[-1].get("finalized") == "success"
+    logged = [r for r in rows if "metrics" in r]
+    assert logged, "logger never received metrics"
+    keys = set().union(*(set(r["metrics"]) for r in logged))
+    assert {"train_loss_logged", "val_mae", "val_loss"} <= keys
+    steps = [r["step"] for r in logged if r["step"] is not None]
+    assert steps == sorted(steps)
+
+
 def test_lightning_first_optimizer_unpacking():
     import torch
     from horovod_tpu.spark.lightning import _first_optimizer
