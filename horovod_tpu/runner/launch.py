@@ -464,11 +464,20 @@ def resolve_hosts(args: argparse.Namespace) -> List[hosts_mod.HostInfo]:
     if args.hosts:
         return hosts_mod.parse_hosts(args.hosts)
     # LSF allocation (bsub): the scheduler already granted hosts/slots;
-    # consume them like the reference's lsf.py so `hvdrun python t.py`
-    # works without -H.  Explicit flags above still win.
+    # consume them so `hvdrun python t.py` works without -H.  Explicit
+    # flags above still win, and -np beyond the granted slots falls back
+    # to local launch (same convention as the TPU-env path below — an
+    # interactive 1-slot bsub shell must not break `hvdrun -np 4`).
     from .lsf import lsf_hosts
     allocated = None if getattr(args, "tpu", False) else lsf_hosts()
     if allocated is not None:
+        total = sum(h.slots for h in allocated)
+        if args.num_proc and args.num_proc > total:
+            print(f"hvdrun: LSF allocation present ({len(allocated)} "
+                  f"hosts, {total} slots) but -np {args.num_proc} "
+                  "exceeds its slots; launching locally",
+                  file=sys.stderr)
+            return [hosts_mod.HostInfo("localhost", args.num_proc or 1)]
         return allocated
     from .tpu_discovery import discover_tpu_hosts, tpu_worker_id
     tpu_flag = getattr(args, "tpu", False)

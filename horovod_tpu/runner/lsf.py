@@ -1,11 +1,18 @@
-"""LSF allocation host discovery (reference: runner/util/lsf.py:1-103).
+"""LSF allocation host discovery (covers the role of the reference's
+runner/util/lsf.py:1-103, by a different mechanism).
 
 Inside an LSF job (`bsub`), the scheduler publishes the allocated hosts
 — ``LSB_DJOB_HOSTFILE`` points at a file listing one hostname per
 granted slot (repeats = slot count), with ``LSB_HOSTS`` as the inline
 fallback.  ``hvdrun`` consumes that allocation automatically so LSF
-users launch with a bare ``hvdrun python train.py``, exactly like the
-reference.
+users launch with a bare ``hvdrun python train.py``.
+
+Mechanism note for parity auditing: the reference queries CSM
+(``csm_allocation_query`` — compute nodes x gpus-per-node), which only
+exists on CORAL/Summit-class systems; the LSB_* variables are standard
+LSF on any cluster, so slot counts here come from hostname multiplicity
+and may include the launch host that CSM would exclude.  Use explicit
+``-H`` where that distinction matters.
 
 Deliberately NOT ported: the reference's jsrun/Spectrum-MPI launch
 vector (runner/js_run.py:1-146).  jsrun is IBM's MPI process starter

@@ -249,12 +249,22 @@ class _LightningTrainTask:
         logger = self.logger if rank == 0 else None
         pending_logs: dict = {}
 
+        val_ctx = {"active": False, "bs": 0, "accum": {}}
+
         def log_shim(name, value, *args, **kwargs):
             # LightningModule.log without a Trainer attached: capture
             # into callback_metrics (for callbacks like EarlyStopping)
-            # and the logger flush buffer.
+            # and the logger flush buffer.  Inside validation, ACCUMULATE
+            # instead (lightning's on_epoch=True default): the epoch
+            # value is the row-weighted mean over every batch and every
+            # worker, not the last batch rank-0 happened to see.
             v = float(value.detach() if hasattr(value, "detach")
                       else value)
+            if val_ctx["active"]:
+                s, n = val_ctx["accum"].get(name, (0.0, 0.0))
+                val_ctx["accum"][name] = (s + v * val_ctx["bs"],
+                                          n + val_ctx["bs"])
+                return
             proxy.callback_metrics[name] = v
             pending_logs[name] = v
 
@@ -363,27 +373,48 @@ class _LightningTrainTask:
                 from .estimator import _iter_val_batches
                 module.eval()
                 sums = np.zeros((2,), np.float64)
-                with torch.no_grad():
-                    for i, batch in enumerate(_iter_val_batches(
-                            val_path, self.batch_size, rank, size,
-                            fs=self.store.fs, opts=self.opts)):
-                        x, y = _assemble_batch(batch, self.feature_cols,
-                                               self.label_cols)
-                        bt = (torch.from_numpy(
-                                  np.ascontiguousarray(x, np.float32)),
-                              torch.from_numpy(
-                                  np.ascontiguousarray(y, np.float32)))
-                        out = module.validation_step(bt, i)
-                        if out is None:
-                            continue
-                        loss = out["loss"] if isinstance(out, dict) \
-                            else out
-                        # plain floats / numpy scalars are legal step
-                        # outputs too
-                        sums[0] += float(
-                            loss.detach() if hasattr(loss, "detach")
-                            else loss) * len(x)
-                        sums[1] += len(x)
+                val_ctx.update(active=True, accum={})
+                try:
+                    with torch.no_grad():
+                        for i, batch in enumerate(_iter_val_batches(
+                                val_path, self.batch_size, rank, size,
+                                fs=self.store.fs, opts=self.opts)):
+                            x, y = _assemble_batch(
+                                batch, self.feature_cols,
+                                self.label_cols)
+                            bt = (torch.from_numpy(
+                                      np.ascontiguousarray(x, np.float32)),
+                                  torch.from_numpy(
+                                      np.ascontiguousarray(y, np.float32)))
+                            val_ctx["bs"] = len(x)
+                            out = module.validation_step(bt, i)
+                            if out is None:
+                                continue
+                            loss = out["loss"] if isinstance(out, dict) \
+                                else out
+                            # plain floats / numpy scalars are legal
+                            # step outputs too
+                            sums[0] += float(
+                                loss.detach() if hasattr(loss, "detach")
+                                else loss) * len(x)
+                            sums[1] += len(x)
+                finally:
+                    val_ctx["active"] = False
+                # epoch means of everything validation_step logged,
+                # exact across workers (same weighted-sum combine as
+                # the loss), into callback_metrics/logger/history
+                if val_ctx["accum"]:
+                    names = sorted(val_ctx["accum"])
+                    m = np.array([val_ctx["accum"][k] for k in names],
+                                 np.float64)
+                    if size > 1:
+                        m = np.asarray(sync([m])[0], np.float64)
+                    for k, (s, n) in zip(names, m):
+                        if n > 0:
+                            mv = float(s / n)
+                            proxy.callback_metrics[k] = mv
+                            pending_logs[k] = mv
+                            out_hist[k] = mv
                 if size > 1:
                     sums = np.asarray(sync([sums])[0], np.float64)
                 if sums[1] > 0:

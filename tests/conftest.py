@@ -57,6 +57,95 @@ def pytest_configure(config):
         "test/integration/ tier)")
 
 
+# ----------------------------------------------------------- tier marking
+#
+# The full suite outgrew its pre-commit role (measured 30m21s cold,
+# 473 tests, 2026-08-01 — COVERAGE.md).  Tests costing >= ~8 s each
+# (1,445 s of the total between them) carry the `slow` marker, assigned
+# HERE from one list so the test files stay unmarked and the threshold
+# is maintained in one place.  pyproject addopts deselects
+# `slow` + `integration` by default (~6 min); the FULL suite is
+#     python -m pytest tests/ -m "" -q
+# and stays the milestone/round gate.  Deselection is not skipping:
+# both tiers run with 0 skips.
+
+_SLOW_FILES = {
+    # every test is a multi-second subprocess example smoke
+    "test_examples_smoke.py",
+}
+_SLOW_TESTS = {  # file::test (param ids stripped), >= ~8 s measured
+    "test_bench.py": {
+        # also individually marked slow (pre-existing) — listed for
+        # completeness since this table is the tier's source of truth
+        "test_bench_llama_cpu_contract", "test_bench_resnet_cpu_contract",
+        "test_bench_autotune_cpu_contract",
+    },
+    "test_models.py": {
+        "test_inception_v3_forward_and_grads",
+        "test_vgg16_features_train_and_param_count",
+        "test_resnet_forward_shape", "test_master_weights_bf16_compute",
+        "test_llama_chunked_ce_matches", "test_vgg_apply_adaptive_resolution",
+        "test_llama_fused_projections_match",
+    },
+    "test_pipeline.py": {
+        "test_pipelined_llama_matches_sequential",
+        "test_pipeline_composes_with_dp",
+        "test_pipeline_various_microbatch_counts",
+        "test_pipeline_gradients_match_sequential",
+    },
+    "test_expert.py": {
+        "test_moe_llama_ep_path_matches_dense",
+        "test_moe_llama_mixtral_config_trains",
+        "test_moe_gradients_flow", "test_moe_capacity_drops_tokens",
+    },
+    "test_spark_ray.py": {
+        "test_torch_estimator_end_to_end",
+        "test_lightning_estimator_end_to_end",
+        "test_lightning_callbacks_logger_validation_and_clip",
+        "test_elastic_ray_executor_runs_function_elastically",
+        "test_spark_run_local_executor_ranks_and_results",
+        "test_programmatic_run_api",
+        "test_ray_executor_local_pool_env_and_results",
+        "test_linear_estimator_end_to_end",
+        "test_linear_estimator_workers_converge_identically",
+        "test_keras_estimator_runs_callbacks",
+        "test_keras_estimator_early_stopping",
+    },
+    "test_spark_prepare.py": {
+        "test_estimator_fit_on_dataframe",
+        "test_prepare_dataframe_partition_parallel",
+        "test_hdfs_store_estimator_end_to_end",
+    },
+    "test_spark_estimator_depth.py": {
+        "test_run_elastic_shrinks_to_min_np",
+        "test_elastic_fit_survives_worker_kill",
+        "test_run_elastic_respects_reset_limit",
+    },
+    "test_tune.py": {
+        "test_distributed_trainable_forwards_worker_reports",
+        "test_distributed_trainable_runs_workers",
+    },
+    "test_real_backend_fakes.py": {
+        "test_ray_worker_pool_spread_placement_and_kill",
+        "test_linear_estimator_fit_on_spark_executor",
+    },
+    "test_tensorflow.py": {"test_tf_frontend_suite_subprocess"},
+    "test_sequence_parallel.py": {
+        "test_ring_attention_flash_gradients_match_full"},
+    "test_fsdp.py": {"test_fsdp_step_matches_replicated"},
+    "test_elastic.py": {"test_jax_state_sharded_commit_restore_at_1gb"},
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        path, _, rest = item.nodeid.partition("::")
+        fname = path.rsplit("/", 1)[-1]
+        test = rest.split("[", 1)[0]
+        if fname in _SLOW_FILES or test in _SLOW_TESTS.get(fname, ()):
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(scope="session")
 def hvd():
     import horovod_tpu as hvd

@@ -370,6 +370,12 @@ def test_lsf_allocation_hosts(tmp_path, monkeypatch):
     args = make_parser().parse_args(["-np", "2", "-H", "x:2", "cmd"])
     assert [(h.hostname, h.slots) for h in resolve_hosts(args)] == \
         [("x", 2)]
+    # -np beyond the granted slots: local fallback, not a hard error
+    # (interactive 1-slot bsub shells must not break `hvdrun -np 4`)
+    monkeypatch.setenv("LSB_HOSTS", "onehost")
+    args = make_parser().parse_args(["-np", "4", "cmd"])
+    assert [(h.hostname, h.slots) for h in resolve_hosts(args)] == \
+        [("localhost", 4)]
 
 
 def test_tpu_flag_requires_discovery(monkeypatch):

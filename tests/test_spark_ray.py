@@ -428,6 +428,9 @@ def test_lightning_callbacks_logger_validation_and_clip(tmp_path):
     assert "val" in events
     hist = model.history
     assert "val_loss" in hist and len(hist["val_loss"]) >= 1
+    # validation_step's logged metrics land in history as epoch means
+    assert "val_mae" in hist and len(hist["val_mae"]) >= 1
+    assert 0 < hist["val_mae"][-1] < 10
 
     rows = [json.loads(ln) for ln in open(log_path)]
     assert rows[-1].get("finalized") == "success"
