@@ -39,6 +39,19 @@ import numpy as np
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
+def _open_in_step_dir(d: str, path: str):
+    """open(path, 'wb') that survives a peer racing the directory away:
+    a sibling host's purge/GC may rmdir a just-created (still empty)
+    step dir between our makedirs and the first open — re-create and
+    retry once.  Own files are never touched by peers, so only the
+    directory can vanish."""
+    try:
+        return open(path, "wb")
+    except FileNotFoundError:
+        os.makedirs(d, exist_ok=True)
+        return open(path, "wb")
+
+
 def _pwrite_all(fd: int, buf, offset: int) -> None:
     """pwrite the WHOLE buffer: a single pwrite may write short (and is
     capped at ~2 GiB on Linux), which would leave silent zero tails in
@@ -145,7 +158,7 @@ class FastCommitStore:
 
         data_path = os.path.join(d, f"host_{self._proc}.bin")
         tmp = data_path + ".tmp"
-        with open(tmp, "wb") as f:
+        with _open_in_step_dir(d, tmp) as f:
             f.truncate(offset)
             fd = f.fileno()
             def write_shard(job):

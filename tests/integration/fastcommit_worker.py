@@ -1,0 +1,113 @@
+"""Per-rank worker: elastic fast-commit agreement across 2 REAL
+processes.
+
+The fastcommit store's subtlest behavior is multi-host agreement — the
+cross-process paths (common-step intersection, store choice, restore
+outcome, synced should_stop) fall back to local views in single-process
+tests, so this worker exercises them with process_size == 2 for real:
+
+  1. both hosts commit step 0 + step 1 into a SHARED dir (per-host
+     blobs);
+  2. host 1's step-1 marker is deleted (a mid-commit preemption:
+     host 0 finished, host 1 died) — the agreed step must be 0 on BOTH
+     hosts, never a split restore;
+  3. a corrupted host-1 manifest at the agreed step must make
+     load_from_disk return False on BOTH hosts (outcome agreement), not
+     restore on one and fail on the other.
+"""
+
+import os
+import sys
+
+if os.environ.get("FC_DEBUG"):  # dump stacks if we hang (flake triage)
+    import faulthandler
+    faulthandler.dump_traceback_later(90, exit=True)
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.elastic.state import JaxState  # noqa: E402
+
+
+def main() -> int:
+    hvd.init()
+    pr = hvd.process_rank()
+    assert hvd.process_size() == 2, hvd.process_size()
+    shared = os.environ["FASTCOMMIT_DIR"]
+
+    def make_state(epoch):
+        return JaxState(params={"w": jnp.full((8,), float(epoch))},
+                        opt_state=None, sharded_commit_dir=shared,
+                        epoch=epoch)
+
+    # -- 1. two commits from every host --------------------------------
+    s = make_state(0)
+    s.register_host_update_check(lambda: False)
+    s.commit()
+    s.params = {"w": jnp.full((8,), 1.0)}
+    s.epoch = 1
+    s.commit()
+
+    hvd.allreduce(np.zeros(1), op=hvd.Sum)  # barrier: peers committed
+
+    fc_dir = os.path.join(shared, "fastcommit")
+    for step in (0, 1):
+        for p in (0, 1):
+            assert os.path.exists(os.path.join(
+                fc_dir, f"step_{step}", f"COMMIT_{p}")), (step, p)
+
+    # -- 2. host 1 "died mid-commit" of step 1 -------------------------
+    # barrier BEFORE the mutation: rank 1 must finish the checks above
+    # before rank 0 injects the preemption, and again after so both see
+    # the mutated store
+    hvd.allreduce(np.zeros(1), op=hvd.Sum)
+    if pr == 0:
+        os.remove(os.path.join(fc_dir, "step_1", "COMMIT_1"))
+    hvd.allreduce(np.zeros(1), op=hvd.Sum)
+
+    s2 = make_state(-1)
+    s2.params = {"w": jnp.zeros(8)}
+    assert s2.load_from_disk(), "agreed restore failed"
+    # BOTH hosts must land on the agreed step 0 — host 0 holds a valid
+    # step-1 marker but host 1 does not.
+    assert s2.epoch == 0, f"rank {pr} restored epoch {s2.epoch}, want 0"
+    np.testing.assert_allclose(np.asarray(s2.params["w"]), 0.0)
+
+    # cross-host check: every host restored the same epoch
+    from horovod_tpu.functions import allgather_object
+    epochs = allgather_object(s2.epoch)
+    assert set(epochs) == {0}, epochs
+
+    # -- 3. corrupt host 1's manifest at the agreed step ---------------
+    hvd.allreduce(np.zeros(1), op=hvd.Sum)  # peer done with stage 2
+    if pr == 0:
+        man = os.path.join(fc_dir, "step_0", "host_1.manifest")
+        with open(man, "wb") as f:
+            f.write(b"garbage")
+    hvd.allreduce(np.zeros(1), op=hvd.Sum)  # both see the corruption
+
+    s3 = make_state(-1)
+    s3.params = {"w": jnp.zeros(8)}
+    ok = s3.load_from_disk()
+    # host 0 could read its own blob fine; outcome agreement must make
+    # BOTH hosts report failure so neither diverges.
+    assert not ok, f"rank {pr}: load_from_disk should fail for all"
+    assert s3.epoch == -1, s3.epoch
+    oks = allgather_object(ok)
+    assert set(oks) == {False}, oks
+
+    print(f"FASTCOMMIT-OK rank={pr}", flush=True)
+    # explicit teardown: the last op above is a cross-process gather;
+    # exiting with it barely drained can hang the coordination-service
+    # shutdown barrier under the launcher
+    hvd.allreduce(np.zeros(1), op=hvd.Sum)  # final barrier
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,9 +20,30 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 WORKERS = os.path.dirname(os.path.abspath(__file__))
 
 
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def run_hvdrun(worker: str, np_: int = 2, timeout: int = 420,
                extra_env: dict = None, launcher_args: list = None,
                check: bool = True):
+    # Every launch gets fresh coordinator AND controller ports:
+    # back-to-back tests on the fixed defaults (29500/29499) can collide
+    # with the previous test's still-draining sockets and hang
+    # jax.distributed init (300 s) or the native controller bind.
+    launcher_args = list(launcher_args or [])
+
+    def _has(flag):
+        return any(a == flag or a.startswith(flag + "=")
+                   for a in launcher_args)
+
+    if not _has("--coordinator-port"):
+        launcher_args += ["--coordinator-port", str(_free_port())]
+    extra_env = dict(extra_env or {})
+    extra_env.setdefault("HOROVOD_CONTROLLER_PORT", str(_free_port()))
     env = dict(os.environ)
     # Workers import the sibling _env_setup module and horovod_tpu by path.
     env["PYTHONPATH"] = (WORKERS + os.pathsep + REPO + os.pathsep
@@ -73,6 +94,18 @@ def test_np4_negotiation_and_cache_agreement():
         "np4_worker.py", np_=4,
         extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
     assert proc.stdout.count("OK") >= 4, proc.stdout
+
+
+@pytest.mark.integration
+def test_fastcommit_cross_host_agreement(tmp_path):
+    """Elastic fast-commit agreement with 2 REAL processes: a
+    mid-commit preemption (one host's marker missing) restores the
+    common step on BOTH hosts, and a corrupted peer blob fails the load
+    on BOTH hosts (outcome agreement) — the divergence/hang class the
+    single-process tests cannot reach."""
+    proc = run_hvdrun("fastcommit_worker.py",
+                      extra_env={"FASTCOMMIT_DIR": str(tmp_path / "fc")})
+    assert proc.stdout.count("FASTCOMMIT-OK") >= 2, proc.stdout
 
 
 @pytest.mark.integration
