@@ -42,6 +42,24 @@ def test_bench_resnet_cpu_contract():
 
 
 @pytest.mark.slow
+def test_bench_scaling_cpu_contract():
+    """--scaling: the reference's headline metric (scaling efficiency,
+    docs/benchmarks.rst) measured over mesh prefixes.  On the 8-device
+    virtual CPU mesh the absolute value reflects shared-core contention,
+    but the contract — efficiency in (0, 1.5], a rate per size, sizes
+    doubling from 1 — must hold."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    rec = _run_bench("--scaling", env=env)
+    assert rec["unit"] == "scaling_efficiency"
+    assert 0 < rec["value"] <= 1.5
+    rates = rec["rates_tok_s_chip"]
+    assert sorted(map(int, rates)) == [1, 2, 4, 8]
+    assert all(v > 0 for v in rates.values())
+    assert rec["vs_baseline_is"] == "weak_scaling_efficiency_vs_1chip"
+
+
+@pytest.mark.slow
 def test_bench_autotune_cpu_contract(tmp_path):
     env = dict(os.environ)
     env["HOROVOD_AUTOTUNE_LOG"] = str(tmp_path / "traj.csv")
