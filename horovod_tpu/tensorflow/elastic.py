@@ -17,7 +17,55 @@ from ..elastic.state import State
 from ..elastic.worker import run  # re-export: @hvd.elastic.run
 from .functions import broadcast_variables
 
-__all__ = ["TensorFlowKerasState", "run"]
+__all__ = ["TensorFlowKerasState", "TensorFlowState", "run"]
+
+
+class TensorFlowState(State):
+    """Elastic state over a raw list of ``tf.Variable`` — for custom
+    training loops that never build a keras Model (reference API:
+    tensorflow/elastic.py:156-196 TensorFlowState; the TF1
+    session/graph plumbing there has no TF2-eager analog and is
+    dropped).  ``variables`` is required: TF2 removed the global
+    variable collections the reference defaulted to."""
+
+    def __init__(self, variables, **kwargs):
+        self.variables = list(variables)
+        if not self.variables:
+            raise ValueError("TensorFlowState needs a non-empty list of "
+                             "tf.Variable to track")
+        self._var_snap = None
+        super().__init__(**kwargs)
+        self.save()
+
+    def save(self) -> None:
+        super().save()
+        self._var_snap = [np.asarray(v.numpy()) for v in self.variables]
+
+    def restore(self) -> None:
+        super().restore()
+        for var, val in zip(self.variables, self._var_snap or []):
+            var.assign(val)
+
+    def sync(self) -> None:
+        broadcast_variables(self.variables, root_rank=0)
+        _sync_scalar_fields(self)
+        self.save()
+
+
+def _sync_scalar_fields(state: State) -> None:
+    """Broadcast the scalar kwargs fields (step/epoch/...) from rank 0:
+    a rejoining worker constructs its state with fresh counters and must
+    adopt the incumbents' loop position, or collectives desynchronize
+    (the reference's TensorFlowState inherits ObjectState for exactly
+    this)."""
+    fields = [f for f in state._fields]
+    if not fields:
+        return
+    from ..functions import broadcast_object
+    values = broadcast_object({f: getattr(state, f) for f in fields},
+                              root_rank=0)
+    for k, v in values.items():
+        setattr(state, k, v)
 
 
 class TensorFlowKerasState(State):
@@ -58,4 +106,5 @@ class TensorFlowKerasState(State):
         broadcast_variables(self.model.variables, root_rank=0)
         if self._opt_vars():
             broadcast_variables(self._opt_vars(), root_rank=0)
+        _sync_scalar_fields(self)
         self.save()

@@ -245,6 +245,27 @@ def test_elastic_state_commit_restore():
         np.testing.assert_allclose(a, b)
 
 
+def test_elastic_raw_variable_state():
+    """TensorFlowState: raw tf.Variable tracking for custom loops
+    (reference: tensorflow/elastic.py:156-196)."""
+    from horovod_tpu.tensorflow.elastic import TensorFlowState
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable(3.0)
+    state = TensorFlowState([v1, v2], step=7)
+    state.commit()
+    v1.assign([9.0, 9.0])
+    v2.assign(-1.0)
+    state.step = 99
+    state.restore()
+    np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(v2.numpy(), 3.0)
+    assert state.step == 7
+    state.sync()  # single process: values unchanged, snapshot refreshed
+    np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+    with pytest.raises(ValueError, match="non-empty"):
+        TensorFlowState([])
+
+
 def test_broadcast_global_variables_raises_actionable():
     with pytest.raises(NotImplementedError, match="broadcast_variables"):
         hvd.broadcast_global_variables(0)
