@@ -1,0 +1,77 @@
+"""Per-rank worker: response-cache eviction/carry stress across 2 REAL
+processes.
+
+HOROVOD_CACHE_CAPACITY=2 with a 6-name working set forces constant
+FIFO eviction, so every cycle exercises the controller's subtlest
+machinery: ReplicaErase re-materializing in-flight requests onto
+carry_ (a hit bit riding an evicted slot must never drop a
+collective), identical slot assignment on every rank through grow/
+evict/reuse churn, and invalidation via signature changes mid-stream.
+Submission order is randomized per (rank, round) so negotiation — not
+luck — provides the ordering.  Reference analog: the response-cache
+torture paths of test/parallel/test_torch.py run under small
+HOROVOD_CACHE_CAPACITY.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("HOROVOD_CACHE_CAPACITY", "2")
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def main() -> int:
+    hvd.init()
+    pr = hvd.process_rank()
+    nproc = hvd.process_size()
+    assert nproc == 2, nproc
+    chips = hvd.size()
+    per_proc = chips // nproc
+
+    names = [f"s{i}" for i in range(6)]  # 3x the cache capacity
+    rounds = 12
+    for rnd in range(rounds):
+        order = list(names)
+        np.random.RandomState(1000 * rnd + pr).shuffle(order)
+        handles = {}
+        for n in order:
+            i = int(n[1:])
+            # signature changes every 4 rounds: same name, new shape —
+            # the controller must invalidate and renegotiate, never
+            # serve a stale cached response for the old shape
+            shape = (3 + (rnd // 4),)
+            val = torch.full(shape, float((pr + 1) * (i + 1) + rnd))
+            handles[n] = hvd.allreduce_async(val, name=n, op=hvd.Sum)
+        for n in names:
+            out = hvd.synchronize(handles[n])
+            i = int(n[1:])
+            want = per_proc * sum((p + 1) * (i + 1) + rnd
+                                  for p in range(nproc))
+            assert out.shape == (3 + (rnd // 4),), (rnd, n, out.shape)
+            assert torch.allclose(out, torch.full_like(out, want)), \
+                (rnd, n, out, want)
+
+    # controller stats sanity: eviction churn must have produced real
+    # cache traffic in BOTH directions
+    import horovod_tpu.runtime as rt
+    core = rt.get().ensure_core()
+    stats = core.stats()
+    assert stats["cache_misses"] > 0, stats
+    # capacity 2 over 6 names: hits can only come from back-to-back
+    # re-submissions surviving eviction; misses must dominate
+    assert stats["cache_misses"] >= stats["cache_hits"], stats
+
+    print(f"CACHE-STRESS-OK rank={pr}", flush=True)
+    hvd.allreduce(torch.zeros(1), op=hvd.Sum)  # drain before teardown
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

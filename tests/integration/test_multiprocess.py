@@ -97,6 +97,18 @@ def test_np4_negotiation_and_cache_agreement():
 
 
 @pytest.mark.integration
+def test_cache_eviction_stress_two_processes():
+    """HOROVOD_CACHE_CAPACITY=2 with a 6-name working set and permuted
+    per-rank submission orders: constant FIFO eviction exercises
+    ReplicaErase's in-flight carry, identical slot assignment through
+    churn, and signature-change invalidation — 12 rounds, every result
+    exact."""
+    proc = run_hvdrun("cache_stress_worker.py",
+                      extra_env={"HOROVOD_CACHE_CAPACITY": "2"})
+    assert proc.stdout.count("CACHE-STRESS-OK") >= 2, proc.stdout
+
+
+@pytest.mark.integration
 def test_fastcommit_cross_host_agreement(tmp_path):
     """Elastic fast-commit agreement with 2 REAL processes: a
     mid-commit preemption (one host's marker missing) restores the
