@@ -60,6 +60,13 @@ def test_spark_keras_estimator_example():
     assert "OK" in out
 
 
+def test_spark_lightning_estimator_example():
+    out = _run("spark/lightning_spark_mnist.py", "--cpu", "--epochs", "3")
+    assert "holdout accuracy" in out
+    assert "logger captured" in out
+    assert "OK" in out
+
+
 def test_ray_tf2_fit_example():
     out = _run("ray/tensorflow2_mnist_ray.py", "--local", "--epochs", "2")
     # Two worker processes report; their global ranks depend on how many
