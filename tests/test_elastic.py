@@ -275,6 +275,71 @@ def test_fastcommit_bf16_roundtrip(hvd, tmp_path):
     assert float(out["params"]["s"]) == 2.5
 
 
+def test_fastcommit_random_pytrees_roundtrip_exact(hvd, tmp_path):
+    """Property check: random nested trees with mixed dtypes
+    (f32/bf16/i32), ranks (0-d through 3-d, including zero-length
+    axes), and shardings (sharded / replicated / single-device) must
+    round-trip BIT-exactly through save+restore."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.elastic.fastcommit import FastCommitStore
+
+    mesh = hvd.mesh()
+    axis = list(mesh.shape)[0]
+    shardings = [NamedSharding(mesh, P(axis)), NamedSharding(mesh, P()),
+                 None]  # None = leave on the default single device
+    dtypes = [jnp.float32, jnp.bfloat16, jnp.int32]
+
+    def random_leaf(rng):
+        dt = dtypes[rng.randint(len(dtypes))]
+        rank = rng.randint(4)
+        if rank == 0:
+            shape = ()
+        else:
+            # first axis divisible by the mesh so sharding is legal;
+            # later axes may be zero-length
+            shape = tuple([8 * rng.randint(1, 3)]
+                          + [rng.randint(0, 4) for _ in range(rank - 1)])
+        vals = np.asarray(rng.randn(*shape)) * 100  # 0-d stays an array
+        arr = jnp.asarray(vals.astype(np.float64), dt)
+        sh = shardings[rng.randint(len(shardings))]
+        if sh is not None and shape:
+            arr = jax.device_put(arr, sh)
+        return arr
+
+    def random_tree(rng, depth=2):
+        if depth == 0 or rng.rand() < 0.3:
+            return random_leaf(rng)
+        n = rng.randint(1, 4)
+        if rng.rand() < 0.5:
+            return {f"k{i}": random_tree(rng, depth - 1) for i in range(n)}
+        return [random_tree(rng, depth - 1) for i in range(n)]
+
+    for seed in range(8):
+        rng = np.random.RandomState(seed)
+        tree = random_tree(rng)
+        store = FastCommitStore(str(tmp_path / f"fc{seed}"))
+        store.save(0, {"params": tree}, meta={"seed": seed})
+        tmpl = jax.tree_util.tree_map(
+            lambda a: jax.device_put(jnp.zeros_like(a), a.sharding), tree)
+        out = store.restore(0, {"params": tmpl})
+        assert out is not None, seed
+        orig_leaves = jax.tree_util.tree_leaves(tree)
+        back_leaves = jax.tree_util.tree_leaves(out["params"])
+        assert len(orig_leaves) == len(back_leaves), seed
+        for orig, back in zip(orig_leaves, back_leaves):
+            assert orig.dtype == back.dtype, seed
+            assert tuple(orig.shape) == tuple(back.shape), seed
+            np.testing.assert_array_equal(
+                np.asarray(orig, dtype=np.float64)
+                if orig.dtype == jnp.bfloat16 else np.asarray(orig),
+                np.asarray(back, dtype=np.float64)
+                if back.dtype == jnp.bfloat16 else np.asarray(back),
+                err_msg=str(seed))
+
+
 def test_fastcommit_dtype_change_is_layout_mismatch(hvd, tmp_path):
     """Restoring into templates of a different dtype must refuse (None),
     not silently resurrect the old precision."""
