@@ -1,0 +1,58 @@
+"""Summarize the sweep's tunnel-health polling into a round artifact.
+
+The perf axis has been blocked by axon-tunnel outages for several
+rounds; the honest evidence is the poll history the resumable sweep
+already produces.  This renders /tmp/resume_sweep.out (or a given log)
+into a compact summary: poll count, down/up windows, configs attempted
+and their outcomes — committed at round end so a BENCH error JSON with
+cause=tunnel-down is corroborated by a full-session record.
+
+    python scripts/tunnel_report.py [logfile] > TUNNEL_r05.md
+"""
+
+import re
+import sys
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/resume_sweep.out"
+    try:
+        lines = open(path, errors="replace").read().splitlines()
+    except OSError as e:
+        print(f"no sweep log at {path}: {e}", file=sys.stderr)
+        return 1
+    downs = []
+    runs = []     # (config, ok, tail)
+    for i, ln in enumerate(lines):
+        m = re.match(r"tunnel down \((\d\d:\d\d:\d\d)\);", ln)
+        if m:
+            downs.append(m.group(1))
+        m = re.match(r"=== (\S+): bench\.py (.*) ===", ln)
+        if m:
+            runs.append([m.group(1), m.group(2), None])
+        m = re.match(r"\s*-> (ok|FAILED): (.*)", ln)
+        if m and runs and runs[-1][2] is None:
+            runs[-1][2] = (m.group(1), m.group(2)[:160])
+
+    print("# Tunnel health record (resumable sweep poll log)")
+    print()
+    print(f"- polls that found the tunnel DOWN: **{len(downs)}** "
+          "(one per ~3 min of waiting)")
+    if downs:
+        print(f"- first down-poll: {downs[0]}   last down-poll: "
+              f"{downs[-1]}")
+    print(f"- bench configs attempted in healthy windows: {len(runs)}")
+    if runs:
+        print()
+        print("| config | args | outcome |")
+        print("|---|---|---|")
+        for name, args, res in runs:
+            ok, tail = res or ("?", "")
+            print(f"| {name} | `{args}` | {ok}: {tail} |")
+    else:
+        print("- no healthy window occurred: zero configs could run")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
