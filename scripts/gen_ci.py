@@ -103,18 +103,23 @@ def _step(label, command, env=None, timeout=30):
 def build_steps():
     py = "python"
     steps = []
+    # -m "": CI runs the FULL tiers — the repo's pytest addopts default
+    # to the fast pre-commit selection (not slow, not integration),
+    # which would silently hollow these steps out.
+    full = "-q -m \"\""
     for name, files in SUITES.items():
         steps.append(_step(
             f"unit: {name}",
-            f"{py} -m pytest {' '.join(files)} -q"))
+            f"{py} -m pytest {' '.join(files)} {full}"))
     for dim, env, suites in KNOB_DIMS:
         for name in suites:
             steps.append(_step(
                 f"knob {dim}: {name}",
-                f"{py} -m pytest {' '.join(SUITES[name])} -q", env=env))
+                f"{py} -m pytest {' '.join(SUITES[name])} {full}",
+                env=env))
     steps.append(_step(
         "integration: real launcher np=2/np=4",
-        f"{py} -m pytest tests/integration -q", timeout=45))
+        f"{py} -m pytest tests/integration {full}", timeout=45))
     steps.append(_step(
         "dryrun: 8-chip multichip shardings",
         f'{py} -c "import __graft_entry__ as g; g.dryrun_multichip(8)"',

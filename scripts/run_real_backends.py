@@ -45,7 +45,10 @@ def main() -> int:
         ran_any = True
         env = dict(os.environ, HOROVOD_REAL_BACKENDS="1")
         for t in targets:
-            cmd = [sys.executable, "-m", "pytest", *t.split(), "-q"]
+            # -m "": run the FULL selection — the repo default deselects
+            # slow tests, which includes several contract end-to-ends
+            cmd = [sys.executable, "-m", "pytest", *t.split(), "-q",
+                   "-m", ""]
             print(f"[real-backends] {pkg}: {' '.join(cmd)}", flush=True)
             rc |= subprocess.call(cmd, env=env)
     if not ran_any:

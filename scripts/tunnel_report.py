@@ -23,7 +23,7 @@ def main() -> int:
         return 1
     downs = []
     runs = []     # (config, ok, tail)
-    for i, ln in enumerate(lines):
+    for ln in lines:
         m = re.match(r"tunnel down \((\d\d:\d\d:\d\d)\);", ln)
         if m:
             downs.append(m.group(1))
@@ -34,13 +34,27 @@ def main() -> int:
         if m and runs and runs[-1][2] is None:
             runs[-1][2] = (m.group(1), m.group(2)[:160])
 
+    # Group consecutive down-polls into outage windows: polls run every
+    # ~3 min, so a gap > 10 min between them means the tunnel was up (a
+    # config ran) or the sweep restarted — a new window either way.
+    def secs(t):
+        h, m_, s = map(int, t.split(":"))
+        return h * 3600 + m_ * 60 + s
+
+    windows = []
+    for t in downs:
+        if windows and 0 <= secs(t) - secs(windows[-1][1]) <= 600:
+            windows[-1][1] = t
+        else:
+            windows.append([t, t])
+
     print("# Tunnel health record (resumable sweep poll log)")
     print()
     print(f"- polls that found the tunnel DOWN: **{len(downs)}** "
           "(one per ~3 min of waiting)")
-    if downs:
-        print(f"- first down-poll: {downs[0]}   last down-poll: "
-              f"{downs[-1]}")
+    if windows:
+        print(f"- contiguous down windows: {len(windows)} — "
+              + "; ".join(f"{a}→{b}" for a, b in windows))
     print(f"- bench configs attempted in healthy windows: {len(runs)}")
     if runs:
         print()
@@ -48,7 +62,9 @@ def main() -> int:
         print("|---|---|---|")
         for name, args, res in runs:
             ok, tail = res or ("?", "")
-            print(f"| {name} | `{args}` | {ok}: {tail} |")
+            esc = tail.replace("|", "\\|")
+            print(f"| {name} | `{args.replace('|', chr(92) + '|')}` "
+                  f"| {ok}: {esc} |")
     else:
         print("- no healthy window occurred: zero configs could run")
     return 0
