@@ -36,32 +36,47 @@ MATRIX = [
     # so the fused rows now pin --fuse explicitly.
     ("score-input-dtype", ["--fuse", "--score-dtype", "input",
                            "--steps", "30"]),
-    ("nofuse-control", ["--no-fuse", "--steps", "30"]),
+    ("nofuse-control", ["--no-fuse", "--score-dtype", "f32",
+                        "--steps", "30"]),
     ("nofuse-score-input", ["--no-fuse", "--score-dtype", "input",
                             "--steps", "30"]),
     # diagnostic: same token count, 1/4 the attention share — locates the
     # non-matmul time if MFU jumps.  All rows pin --no-fuse explicitly so
     # their protocol no longer depends on bench.py's default (none of
     # these had a valid recorded line before the default flip).
+    # (the three rows below measured 2026-08-01 under the then-default
+    # f32 scores; pinned explicitly when the default flipped to "input"
+    # the same day so the name keeps meaning what was measured)
     ("seq256-b64", ["--no-fuse", "--seq", "256", "--batch", "64",
-                    "--steps", "30"]),
+                    "--score-dtype", "f32", "--steps", "30"]),
     # loop-overhead probe: unrolled scan drops per-step control overhead
     # and lets XLA software-pipeline across step boundaries
-    ("unroll3-b16", ["--no-fuse", "--scan-unroll", "3", "--steps", "30"]),
-    ("batch-20", ["--no-fuse", "--batch", "20", "--steps", "30"]),
+    ("unroll3-b16", ["--no-fuse", "--scan-unroll", "3",
+                     "--score-dtype", "f32", "--steps", "30"]),
+    ("batch-20", ["--no-fuse", "--batch", "20",
+                  "--score-dtype", "f32", "--steps", "30"]),
     # re-measure of the demoted r2 session hint (README: 0.367, no
-    # artifact) — remat trades FLOPs for the score-slab HBM residency
+    # artifact) — remat trades FLOPs for the score-slab HBM residency.
+    # Pins f32 scores: the hint being re-measured predates the
+    # 2026-08-01 score-dtype default flip, and "a name is a protocol".
     ("batch32-remat", ["--no-fuse", "--batch", "32", "--remat",
-                       "--steps", "30"]),
-    ("llama1b-b8-remat-ce8",
+                       "--score-dtype", "f32", "--steps", "30"]),
+    # "-sdi" rows = the NEW default protocol (score-dtype input,
+    # measured +23% on the b16 A/B).  batch32-sdi probes whether the
+    # halved score slab lets batch 32 fit WITHOUT remat (f32 OOMed).
+    ("batch32-sdi", ["--no-fuse", "--batch", "32",
+                     "--score-dtype", "input", "--steps", "30"]),
+    ("batch32-remat-sdi", ["--no-fuse", "--batch", "32", "--remat",
+                           "--score-dtype", "input", "--steps", "30"]),
+    ("llama1b-b8-remat-ce8-sdi",
      ["--no-fuse", "--model", "1b", "--batch", "8", "--remat",
-      "--ce-chunks", "8", "--steps", "10"]),
-    ("seq2048-b8-ce8",
+      "--ce-chunks", "8", "--score-dtype", "input", "--steps", "10"]),
+    ("seq2048-b8-ce8-sdi",
      ["--no-fuse", "--seq", "2048", "--batch", "8", "--ce-chunks", "8",
-      "--steps", "10"]),
-    ("llama1b-b4-remat-ce8",
+      "--score-dtype", "input", "--steps", "10"]),
+    ("llama1b-b4-remat-ce8-sdi",
      ["--no-fuse", "--model", "1b", "--batch", "4", "--remat",
-      "--ce-chunks", "8", "--steps", "10"]),
+      "--ce-chunks", "8", "--score-dtype", "input", "--steps", "10"]),
     ("autotune", ["--autotune"]),
     # the reference's own headline rows (docs/benchmarks.rst:31-43 is
     # resnet101 img/sec); "-scan10" = the stage-scanned model at
