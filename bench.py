@@ -342,6 +342,12 @@ def main() -> int:
                     help="internal: run the measurement directly (no "
                          "probe/deadline supervisor)")
     args = ap.parse_args()
+    # Resolve the score-dtype default BEFORE any mode dispatch so every
+    # mode (throughput, --scaling, ...) sees the same resolved protocol.
+    # Explicitness is remembered for the --flash conflict warning below.
+    args.score_dtype_explicit = args.score_dtype is not None
+    if args.score_dtype is None:
+        args.score_dtype = "input"
 
     if not args.inner:
         return supervise([a for a in sys.argv[1:] if a != "--inner"])
@@ -404,15 +410,14 @@ def main() -> int:
     # Pallas flash attention on TPU (ops/flash_attention.py): blockwise
     # online softmax on the MXU, ~1.3x the XLA attention at seq 1024.
     attn_fn = None
-    if args.flash and not args.cpu and args.score_dtype == "input":
+    if (args.flash and not args.cpu and args.score_dtype_explicit
+            and args.score_dtype == "input"):
         # The flash kernel never materializes a score tensor, so the two
         # flags cannot combine; labeling such a row "input" would record
         # a measurement of nothing (ADVICE r3).  (Only an EXPLICIT
         # --score-dtype input warns; the resolved default stays silent.)
         print("--score-dtype input is ignored under --flash (the kernel "
               "has no score tensor)", file=sys.stderr)
-    if args.score_dtype is None:
-        args.score_dtype = "input"
     if args.flash and not args.cpu:
         import functools
         from horovod_tpu.ops.flash_attention import flash_attention
@@ -500,6 +505,11 @@ def main() -> int:
         "mfu": round(mfu, 4),
         "vs_baseline_is": "mfu",
         "vs_baseline": round(mfu, 4),
+        # Self-describing protocol: which attention path actually ran,
+        # so an artifact row never depends on remembering what the
+        # bench default was the day it was recorded.
+        "attn": ("flash" if (args.flash and not args.cpu)
+                 else f"xla-score-{args.score_dtype}"),
     }))
     return 0
 
@@ -548,6 +558,10 @@ def scaling_bench(args) -> int:
         from horovod_tpu.ops.flash_attention import flash_attention
         attn_fn = functools.partial(flash_attention, block_q=args.block_q,
                                     block_k=args.block_k)
+    elif args.score_dtype == "input":
+        import functools
+        from horovod_tpu.models import layers as L
+        attn_fn = functools.partial(L.causal_attention, score_dtype=None)
     if args.profile:
         print("--profile is ignored under --scaling (one trace per mesh "
               "size would overwrite itself)", file=sys.stderr)
@@ -609,6 +623,8 @@ def scaling_bench(args) -> int:
         "vs_baseline": round(eff, 4),
         "rates_tok_s_chip": {str(k): round(v, 1)
                              for k, v in rates.items()},
+        "attn": ("flash" if (args.flash and not args.cpu)
+                 else f"xla-score-{args.score_dtype}"),
     }))
     return 0
 

@@ -31,6 +31,12 @@ def test_bench_llama_cpu_contract():
     assert rec["unit"] == "tokens/sec/chip"
     assert rec["value"] > 0
     assert 0 < rec["vs_baseline"] < 1
+    # The headline protocol guard: a plain run must resolve the
+    # score-dtype default to 'input' (bf16 score slab, the measured
+    # +23% winner — sweep rows nofuse-score-input vs nofuse-control)
+    # and say so in the self-describing `attn` field, so a silent
+    # default drift fails here rather than in a BENCH_r{N} artifact.
+    assert rec["attn"] == "xla-score-input"
 
 
 @pytest.mark.slow
@@ -181,3 +187,13 @@ def test_supervise_explicit_steps_skips_fallback(monkeypatch, capsys):
     rc = bench.supervise(["--steps", "5"])
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 1 and rec["metric"] == "BENCH_INVALID"
+
+
+@pytest.mark.slow
+def test_bench_score_dtype_f32_selectable():
+    """`--score-dtype f32` must still select the full-precision score
+    path and label the artifact accordingly (the default-run assertion
+    lives in test_bench_llama_cpu_contract to avoid a third identical
+    bench subprocess in the slow tier)."""
+    rec_f32 = _run_bench("--score-dtype", "f32")
+    assert rec_f32["attn"] == "xla-score-f32"
