@@ -1,7 +1,13 @@
 """Benchmark: flagship training throughput on the available accelerator.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N,
+   "metrics": {...}}
+
+The "metrics" field embeds a condensed hvd.metrics_snapshot() (plan-cache
+hit rate, controller cycles/cache rate, collective op/byte counts, stall
+warnings — docs/metrics.md) so BENCH rows carry controller-level evidence
+alongside MFU.
 
 Protocol mirrors the reference's synthetic benchmarks (reference:
 examples/pytorch/pytorch_synthetic_benchmark.py:104-109 — timed iterations
@@ -134,6 +140,39 @@ def fail(reason: str, cause: str = "bench-crash", **extra) -> int:
                       "unit": "error", "vs_baseline": 0,
                       "cause": cause, "error": reason, **extra}))
     return 1
+
+
+def metrics_summary() -> dict:
+    """Condensed `hvd.metrics_snapshot()` embedded in every bench JSON so
+    artifact rows carry controller-level evidence (plan-cache hit rate,
+    cycles, stall warnings) alongside MFU.  Best-effort: a bench number
+    must never be lost to a telemetry hiccup."""
+    try:
+        import horovod_tpu as hvd
+        fams = hvd.metrics_snapshot().get("families", {})
+
+        def total(name):
+            return sum(s.get("value", 0)
+                       for s in fams.get(name, {}).get("samples", []))
+
+        def rate(hit, miss):
+            h, m = total(hit), total(miss)
+            return round(h / (h + m), 4) if h + m else None
+
+        return {
+            "schema": "hvd-metrics-summary-v1",
+            "plan_cache_hit_rate": rate("hvd_fusion_plan_cache_hits_total",
+                                        "hvd_fusion_plan_cache_misses_total"),
+            "controller_cycles": int(total("hvd_controller_cycles_total")),
+            "controller_cache_hit_rate": rate(
+                "hvd_controller_cache_hits_total",
+                "hvd_controller_cache_misses_total"),
+            "collective_ops": int(total("hvd_collective_ops_total")),
+            "collective_bytes": int(total("hvd_collective_bytes_total")),
+            "stall_warnings": int(total("hvd_stall_warnings_total")),
+        }
+    except Exception as e:
+        return {"schema": "hvd-metrics-summary-v1", "error": str(e)}
 
 
 def _enable_compile_cache(cpu: bool = False) -> None:
@@ -352,6 +391,16 @@ def main() -> int:
     if not args.inner:
         return supervise([a for a in sys.argv[1:] if a != "--inner"])
 
+    # The flash kernel never materializes a score tensor, so an EXPLICIT
+    # --score-dtype (either value) cannot combine with --flash; labeling
+    # such a row with a score dtype would record a measurement of nothing
+    # (ADVICE r3; symmetry + every-mode coverage ADVICE r5 #1).  Hoisted
+    # above the mode dispatch so --scaling runs warn too; the resolved
+    # default stays silent.
+    if args.flash and not args.cpu and args.score_dtype_explicit:
+        print(f"--score-dtype {args.score_dtype} is ignored under --flash "
+              "(the kernel has no score tensor)", file=sys.stderr)
+
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -410,14 +459,6 @@ def main() -> int:
     # Pallas flash attention on TPU (ops/flash_attention.py): blockwise
     # online softmax on the MXU, ~1.3x the XLA attention at seq 1024.
     attn_fn = None
-    if (args.flash and not args.cpu and args.score_dtype_explicit
-            and args.score_dtype == "input"):
-        # The flash kernel never materializes a score tensor, so the two
-        # flags cannot combine; labeling such a row "input" would record
-        # a measurement of nothing (ADVICE r3).  (Only an EXPLICIT
-        # --score-dtype input warns; the resolved default stays silent.)
-        print("--score-dtype input is ignored under --flash (the kernel "
-              "has no score tensor)", file=sys.stderr)
     if args.flash and not args.cpu:
         import functools
         from horovod_tpu.ops.flash_attention import flash_attention
@@ -510,6 +551,8 @@ def main() -> int:
         # bench default was the day it was recorded.
         "attn": ("flash" if (args.flash and not args.cpu)
                  else f"xla-score-{args.score_dtype}"),
+        # Controller-level evidence riding the artifact (docs/metrics.md).
+        "metrics": metrics_summary(),
     }))
     return 0
 
@@ -625,6 +668,7 @@ def scaling_bench(args) -> int:
                              for k, v in rates.items()},
         "attn": ("flash" if (args.flash and not args.cpu)
                  else f"xla-score-{args.score_dtype}"),
+        "metrics": metrics_summary(),
     }))
     return 0
 
@@ -720,6 +764,7 @@ def autotune_bench(args) -> int:
         "unit": "GB/s",
         "vs_baseline_is": "speedup_vs_initial_threshold",
         "vs_baseline": round(after / max(before, 1e-9), 4),
+        "metrics": metrics_summary(),
     }))
     return 0
 
@@ -858,6 +903,7 @@ def resnet_bench(args) -> int:
         "mfu": round(mfu, 4),
         "vs_baseline_is": "mfu",
         "vs_baseline": round(mfu, 4),
+        "metrics": metrics_summary(),
     }))
     return 0
 

@@ -182,6 +182,8 @@ void hvd_core_shutdown(void* h) {
   static_cast<ApiHandle*>(h)->core->Shutdown();
 }
 
+// DEPRECATED in favor of hvd_core_metrics (kept for compat): the fixed
+// 9-slot layout cannot grow without breaking every caller.
 // stats: cycles, cache_hits, cache_misses, stall_warnings, responses,
 //        cached_responses, bytes_gathered, bytes_broadcast, last_cycle_bytes
 void hvd_core_stats(void* h, unsigned long long* out9) {
@@ -195,6 +197,65 @@ void hvd_core_stats(void* h, unsigned long long* out9) {
   out9[6] = s.bytes_gathered;
   out9[7] = s.bytes_broadcast;
   out9[8] = s.last_cycle_bytes;
+}
+
+// Versioned metrics export superseding hvd_core_stats: writes a
+// self-describing text block —
+//   hvd_metrics_v1
+//   <counter> <value>            (one line per counter)
+//   hist <name> <count> <sum_us> <b0> ... <b27>
+// New counters/histograms APPEND; parsers must key on names, never on
+// line positions — that is the versioning contract.  Returns the full
+// length required; when it exceeds buflen-1 only buflen-1 bytes are
+// written (always NUL-terminated) and the caller retries with a larger
+// buffer.
+int hvd_core_metrics(void* h, char* buf, int buflen) {
+  Core* core = static_cast<ApiHandle*>(h)->core;
+  ControllerStats s = core->stats();
+  std::string t = "hvd_metrics_v1\n";
+  auto kv = [&t](const char* k, uint64_t v) {
+    t += k;
+    t += ' ';
+    t += std::to_string(v);
+    t += '\n';
+  };
+  kv("cycles", s.cycles);
+  kv("cache_hits", s.cache_hits);
+  kv("cache_misses", s.cache_misses);
+  kv("stall_warnings", s.stall_warnings);
+  kv("responses", s.responses);
+  kv("cached_responses", s.cached_responses);
+  kv("bytes_gathered", s.bytes_gathered);
+  kv("bytes_broadcast", s.bytes_broadcast);
+  kv("last_cycle_bytes", s.last_cycle_bytes);
+  kv("bytes_reduced", s.bytes_reduced);
+  kv("tensors_negotiated", s.tensors_negotiated);
+  kv("fused_batches", s.fused_batches);
+  kv("fused_batch_bytes", s.fused_batch_bytes);
+  kv("fusion_threshold_bytes",
+     static_cast<uint64_t>(core->fusion_threshold()));
+  auto hist = [&t](const char* name, const LatencyHistogram& hg) {
+    t += "hist ";
+    t += name;
+    t += ' ';
+    t += std::to_string(hg.count);
+    t += ' ';
+    t += std::to_string(hg.sum_us);
+    for (int i = 0; i < LatencyHistogram::kBuckets; i++) {
+      t += ' ';
+      t += std::to_string(hg.buckets[i]);
+    }
+    t += '\n';
+  };
+  hist("cycle_time_us", s.cycle_time_us);
+  hist("negotiation_age_us", s.negotiation_age_us);
+  int n = static_cast<int>(t.size());
+  if (buf && buflen > 0) {
+    int copy = n < buflen - 1 ? n : buflen - 1;
+    memcpy(buf, t.data(), copy);
+    buf[copy] = '\0';
+  }
+  return n;
 }
 
 // ------------------------------------------------------------------ autotune

@@ -188,6 +188,7 @@ std::vector<Response> Controller::BuildResponses() {
 
   Fuser fuser(opts_.fusion_threshold_bytes);
   std::vector<std::string> done_names;
+  auto now = std::chrono::steady_clock::now();
   for (const auto& name : arrival_order_) {
     auto it = table_.find(name);
     if (it == table_.end()) continue;
@@ -196,6 +197,9 @@ std::vector<Response> Controller::BuildResponses() {
     // zeros, controller.cc:254-307).
     if (static_cast<int>(entry.requests.size()) + num_joined < n) continue;
 
+    stats_.negotiation_age_us.Observe(static_cast<uint64_t>(
+        std::chrono::duration_cast<std::chrono::microseconds>(
+            now - entry.first_seen).count()));
     const Request& first = entry.requests.front();
     Response r;
     r.op = first.type;
@@ -234,6 +238,7 @@ std::vector<Response> Controller::BuildResponses() {
 bool Controller::RunCycle(const std::vector<Request>& pending,
                           bool shutdown_requested,
                           std::vector<Response>* out) {
+  auto cycle_start = std::chrono::steady_clock::now();
   stats_.cycles++;
   int n = size();
   size_t nslots = replica_.size();
@@ -430,6 +435,20 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
     out->push_back(std::move(r));
   }
   if (rank() == 0) stats_.responses += out->size();
+  // Fused-batch + payload accounting (identical on every rank: `out` is
+  // reconstructed from the same broadcast data everywhere).
+  for (const auto& r : *out) {
+    if (r.type != ResponseType::OK) continue;
+    stats_.fused_batches++;
+    stats_.fused_batch_bytes += static_cast<uint64_t>(r.total_bytes);
+    stats_.tensors_negotiated += r.names.size();
+    if (r.op == RequestType::ALLREDUCE ||
+        r.op == RequestType::REDUCESCATTER)
+      stats_.bytes_reduced += static_cast<uint64_t>(r.total_bytes);
+  }
+  stats_.cycle_time_us.Observe(static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::microseconds>(
+          std::chrono::steady_clock::now() - cycle_start).count()));
   return true;
 }
 

@@ -44,6 +44,25 @@ struct ControllerOptions {
   double stall_warn_seconds = 60.0;
 };
 
+// Fixed-bucket latency histogram: bucket b counts observations with
+// value <= 2^b µs; the last bucket absorbs overflow.  Fixed layout (no
+// allocation) so the C API exports it as a flat block and the Python
+// registry's power-of-2-µs bounds map onto it 1:1
+// (horovod_tpu/utils/metrics.py BUCKET_BOUNDS).
+struct LatencyHistogram {
+  static constexpr int kBuckets = 28;  // 1 µs .. ~134 s
+  uint64_t buckets[kBuckets] = {0};
+  uint64_t count = 0;
+  uint64_t sum_us = 0;
+  void Observe(uint64_t us) {
+    count++;
+    sum_us += us;
+    int b = 0;
+    while (b < kBuckets - 1 && us > (1ull << b)) b++;
+    buckets[b]++;
+  }
+};
+
 struct ControllerStats {
   uint64_t cycles = 0;
   uint64_t cache_hits = 0;       // requests served via the bit-vector path
@@ -54,6 +73,13 @@ struct ControllerStats {
   uint64_t bytes_gathered = 0;   // this rank's outbound gather frame bytes
   uint64_t bytes_broadcast = 0;  // broadcast frame bytes seen by this rank
   uint64_t last_cycle_bytes = 0; // gather+bcast bytes of the last cycle
+  // --- metrics-plane extensions (exported via hvd_core_metrics) ---
+  uint64_t bytes_reduced = 0;       // payload bytes of OK reduce-class resp.
+  uint64_t tensors_negotiated = 0;  // tensors across OK responses
+  uint64_t fused_batches = 0;       // OK response batches executed
+  uint64_t fused_batch_bytes = 0;   // payload bytes across those batches
+  LatencyHistogram cycle_time_us;       // RunCycle wall time, every rank
+  LatencyHistogram negotiation_age_us;  // first-seen -> ready, rank 0 only
 };
 
 class Controller {

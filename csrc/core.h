@@ -49,6 +49,7 @@ class Core {
   int rank() const { return controller_->rank(); }
   int size() const { return controller_->size(); }
   ControllerStats stats() const;
+  int64_t fusion_threshold() const { return controller_->fusion_threshold(); }
 
   // Turn on rank-0 autotuning of (fusion threshold, cycle time) scored by
   // negotiated bytes/sec (reference: ParameterManager + HOROVOD_AUTOTUNE,

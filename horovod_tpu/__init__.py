@@ -104,6 +104,15 @@ def is_homogeneous() -> bool:
     return rt.size() == rt.local_size() * rt.process_size()
 
 
+# ------------------------------------------------------------------ metrics
+def metrics_snapshot() -> dict:
+    """Point-in-time snapshot of every metric family this process records
+    (native controller counters/histograms, collectives/fusion, stall
+    inspector, elastic events) as a JSON-able dict — the same payload
+    workers publish for the ``/metrics`` fleet view (``docs/metrics.md``)."""
+    return _rt.get().metrics_snapshot()
+
+
 # ----------------------------------------------------------- built/enabled API
 # Build-capability probes (reference: operations.cc:845-915 horovod_mpi_built
 # etc.).  This framework has exactly one data plane: XLA over ICI/DCN.
@@ -203,5 +212,5 @@ __all__ = [
     "start_timeline", "stop_timeline", "profiler", "tune",
     "CheckpointManager", "save_checkpoint", "restore_checkpoint",
     "flash_attention", "run",
-    "__version__", "probe_backend",
+    "__version__", "probe_backend", "metrics_snapshot",
 ]

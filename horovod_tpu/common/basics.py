@@ -108,6 +108,8 @@ def load_library() -> ctypes.CDLL:
         lib.hvd_core_shutdown.argtypes = [ctypes.c_void_p]
         lib.hvd_core_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong)]
+        lib.hvd_core_metrics.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_int]
         # autotune / optim surface
         dptr = ctypes.POINTER(ctypes.c_double)
         lib.hvd_core_enable_autotune.argtypes = [
@@ -387,6 +389,8 @@ class CoordinationCore:
                 "done": bool(out[2]), "best_score": out[3]}
 
     def stats(self) -> dict:
+        """Legacy fixed 9-slot counters; superseded by :meth:`metrics`
+        (kept because external callers bound the old symbol)."""
         arr = (ctypes.c_ulonglong * 9)()
         self._lib.hvd_core_stats(self._h, arr)
         return {"cycles": arr[0], "cache_hits": arr[1],
@@ -394,6 +398,36 @@ class CoordinationCore:
                 "responses": arr[4], "cached_responses": arr[5],
                 "bytes_gathered": arr[6], "bytes_broadcast": arr[7],
                 "last_cycle_bytes": arr[8]}
+
+    def metrics(self) -> dict:
+        """Versioned native metrics (csrc/c_api.cc hvd_core_metrics):
+        ``{"version": 1, "counters": {...}, "histograms": {name:
+        {"count", "sum" (µs), "buckets": [28 power-of-2-µs bins]}}}``.
+        Unknown lines are ignored, so a newer library never breaks an
+        older parser — the versioning contract is name-keyed lines."""
+        n = self._lib.hvd_core_metrics(self._h, self._buf, len(self._buf))
+        if n >= len(self._buf):
+            self._grow(n)
+            n = self._lib.hvd_core_metrics(self._h, self._buf,
+                                           len(self._buf))
+        text = self._buf.value.decode()
+        lines = text.splitlines()
+        if not lines or not lines[0].startswith("hvd_metrics_v"):
+            raise RuntimeError(f"unrecognized native metrics header: "
+                               f"{lines[:1]!r}")
+        out = {"version": int(lines[0].split("hvd_metrics_v", 1)[1]),
+               "counters": {}, "histograms": {}}
+        for line in lines[1:]:
+            parts = line.split()
+            if not parts:
+                continue
+            if parts[0] == "hist" and len(parts) >= 4:
+                out["histograms"][parts[1]] = {
+                    "count": int(parts[2]), "sum": int(parts[3]),
+                    "buckets": [int(p) for p in parts[4:]]}
+            elif len(parts) == 2:
+                out["counters"][parts[0]] = int(parts[1])
+        return out
 
     def shutdown(self) -> None:
         """Ask the cycle loop to exit.  Multi-core teardown MUST call

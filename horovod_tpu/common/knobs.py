@@ -67,6 +67,16 @@ _knob("HOROVOD_TIMELINE", "", str,
       "registers the file lazily on horovod_start_timeline().")
 _knob("HOROVOD_TIMELINE_MARK_CYCLES", False, _parse_bool,
       "Mark coordination cycles in the timeline.")
+# --- metrics plane (TPU-native; no reference equivalent — the reference
+#     stops at timeline + stall inspection) ---
+_knob("HOROVOD_METRICS", False, _parse_bool,
+      "Enable the metrics plane: every worker records Counter/Gauge/"
+      "Histogram telemetry (utils/metrics.py) and publishes periodic "
+      "snapshots to the rendezvous KV; the launcher serves the fleet view "
+      "at /metrics (Prometheus text) and prints the end-of-run straggler "
+      "report.  hvdrun --metrics-port implies this.")
+_knob("HOROVOD_METRICS_INTERVAL", 5.0, float,
+      "Seconds between metric-snapshot publishes to the rendezvous KV.")
 # --- stall inspector (reference: stall_inspector.h:70-82) ---
 _knob("HOROVOD_STALL_CHECK_DISABLE", False, _parse_bool,
       "Disable the stalled-tensor watchdog.")

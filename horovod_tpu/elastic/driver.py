@@ -29,6 +29,7 @@ from ..common import hvdlogging as log
 from ..common.knobs import Knobs
 from ..runner import hosts as hosts_mod
 from ..runner.http_server import RendezvousServer
+from ..utils import metrics as _metrics
 from .discovery import HostDiscovery, HostDiscoveryScript, HostManager
 from .worker import HOST_UPDATE_SCOPE, HOST_UPDATE_KEY
 
@@ -73,7 +74,8 @@ class ElasticDriver:
                  discovery_interval: float = 1.0,
                  output_filename: Optional[str] = None,
                  network_interface: Optional[str] = None,
-                 prefix_output_with_timestamp: bool = False):
+                 prefix_output_with_timestamp: bool = False,
+                 metrics_port: Optional[int] = None):
         self.host_manager = HostManager(discovery)
         self.min_np = min_np
         self.max_np = max_np
@@ -90,7 +92,7 @@ class ElasticDriver:
         self._spawned_ranks: set = set()
 
         self.registry = WorkerStateRegistry()
-        self.rendezvous = RendezvousServer()
+        self.rendezvous = RendezvousServer(port=metrics_port or 0)
         self.rdv_port = self.rendezvous.start()
         self._host_update_counter = 0
         self._current_hosts: List[hosts_mod.HostInfo] = []
@@ -112,6 +114,12 @@ class ElasticDriver:
                     log.warning("elastic discovery failed: %s", e)
                     continue
                 if changed:
+                    prev = {h.hostname for h in self._current_hosts}
+                    now = {h.hostname for h in cur}
+                    if now - prev:
+                        _metrics.ELASTIC_HOSTS_ADDED.inc(len(now - prev))
+                    if prev - now:
+                        _metrics.ELASTIC_HOSTS_REMOVED.inc(len(prev - now))
                     self._current_hosts = cur
                     self._hosts_changed.set()
                     self._notify_host_update()
@@ -217,6 +225,7 @@ class ElasticDriver:
                 log.info("elastic round %d: %d workers on %s", resets,
                          len(slots),
                          ",".join(h.hostname for h in hosts))
+                round_start = time.monotonic()
                 self._procs = {s.rank: self._spawn_worker(s, coord_host)
                                for s in slots}
 
@@ -233,6 +242,7 @@ class ElasticDriver:
                                    else WorkerStateRegistry.FAILURE)
                         self.registry.record(r, outcome)
                         if outcome == WorkerStateRegistry.FAILURE:
+                            _metrics.ELASTIC_FAILURES.inc()
                             host = next((s.hostname for s in slots
                                          if s.rank == r), None)
                             if host:
@@ -246,12 +256,15 @@ class ElasticDriver:
                         break
                     time.sleep(0.2)
 
+                _metrics.ELASTIC_ROUND_DURATION.observe(
+                    time.monotonic() - round_start)
                 if not self._procs and not round_failed and \
                         not self._hosts_changed.is_set():
                     return 0  # clean finish
                 # reset round: stop everything, re-rendezvous
                 self._terminate_all()
                 resets += 1
+                _metrics.ELASTIC_RESETS.inc()
                 if self.reset_limit and resets > self.reset_limit:
                     log.error("elastic: reset limit %d exceeded",
                               self.reset_limit)
@@ -259,6 +272,11 @@ class ElasticDriver:
         finally:
             self._stop.set()
             self._terminate_all()
+            enabled = (self.extra_env.get("HOROVOD_METRICS")
+                       or os.environ.get("HOROVOD_METRICS", ""))
+            if enabled not in ("", "0", "false"):
+                from ..runner.launch import report_stragglers
+                report_stragglers(self.rendezvous)
             self.rendezvous.stop()
 
 
@@ -287,5 +305,6 @@ def run_elastic(args, command: List[str]) -> int:
         output_filename=getattr(args, "output_filename", None),
         network_interface=getattr(args, "network_interface", None),
         prefix_output_with_timestamp=getattr(
-            args, "prefix_output_with_timestamp", False))
+            args, "prefix_output_with_timestamp", False),
+        metrics_port=getattr(args, "metrics_port", None))
     return driver.run()

@@ -18,12 +18,14 @@ from __future__ import annotations
 import copy
 import os
 import pickle
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
 
 from ..common.exceptions import HostsUpdatedInterrupt
+from ..utils import metrics as _metrics
 
 
 class State:
@@ -65,14 +67,18 @@ class State:
             self._saved[f] = copy.deepcopy(getattr(self, f))
 
     def restore(self) -> None:
+        _metrics.ELASTIC_RESTORES.inc()
         for f, v in self._saved.items():
             setattr(self, f, copy.deepcopy(v))
 
     def commit(self) -> None:
         """Snapshot + host-update checkpoint boundary (reference:
         common/elastic.py:118-131: commit then check_host_updates)."""
+        t0 = time.monotonic()
         self.save()
         self.on_commit()
+        _metrics.ELASTIC_COMMITS.inc()
+        _metrics.ELASTIC_COMMIT_DURATION.observe(time.monotonic() - t0)
         self.check_host_updates()
 
     def on_commit(self) -> None:

@@ -2,9 +2,21 @@
 
 from __future__ import annotations
 
+import inspect
+
 try:  # jax >= 0.6: promoted to top level
-    from jax import shard_map  # type: ignore[attr-defined]
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
 except ImportError:  # jax 0.4.x / 0.5.x
-    from jax.experimental.shard_map import shard_map  # type: ignore
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    # Older jax spells the varying-mesh-axes check `check_rep`; callers in
+    # this repo use the current `check_vma` name — translate.
+    def shard_map(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, **kwargs)
 
 __all__ = ["shard_map"]

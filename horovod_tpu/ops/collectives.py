@@ -27,6 +27,7 @@ already async — the handle wraps the in-flight on-device value.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -36,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import runtime as _rt
 from ..common.reduce_op import ReduceOp, Average
+from ..utils import metrics as _metrics
 from . import spmd
 from .fusion import fused_apply
 
@@ -223,6 +225,16 @@ def _tl(rt, name: Optional[str], kind: str, nbytes: int) -> None:
         rt.timeline.record_op(name, kind, nbytes)
 
 
+def _rec(kind: str, nbytes: int, t0: float) -> None:
+    """Metrics emit for one eager collective: count, payload bytes, and
+    host-side latency (assembly + dispatch, plus completion wherever the
+    op blocks — the sync allreduce under the stall inspector does)."""
+    op = kind.lower()
+    _metrics.COLLECTIVE_OPS.inc(op=op)
+    _metrics.COLLECTIVE_BYTES.inc(nbytes, op=op)
+    _metrics.COLLECTIVE_LATENCY.observe(time.perf_counter() - t0, op=op)
+
+
 # ------------------------------------------------------------------ public API
 def allreduce(tensor: TensorLike,
               average: Optional[bool] = None,
@@ -235,6 +247,7 @@ def allreduce(tensor: TensorLike,
     Mirrors ``hvd.allreduce`` incl. the deprecated ``average`` flag
     (reference: tensorflow/__init__.py:54-155, torch/mpi_ops.py:95-139)."""
     rt = _rt.get()
+    t0 = time.perf_counter()
     if average is not None:
         op = ReduceOp.AVERAGE if average else ReduceOp.SUM
     if rt.stall_inspector is not None and name:
@@ -252,6 +265,7 @@ def allreduce(tensor: TensorLike,
         jax.block_until_ready(out)
         rt.stall_inspector.record_complete(name)
     res = _to_local(rt, out)
+    _rec("ALLREDUCE", int(local.nbytes), t0)
     return res if had_axis else res[0]
 
 
@@ -265,6 +279,7 @@ def grouped_allreduce(tensors: Sequence[TensorLike],
     EnqueueTensorAllreduces; torch ``grouped_allreduce``).  Tensors are
     bucketed by the fusion threshold and reduced in few large collectives."""
     rt = _rt.get()
+    t0 = time.perf_counter()
     if average is not None:
         op = ReduceOp.AVERAGE if average else ReduceOp.SUM
     pairs = [_per_chip(rt, t) for t in tensors]
@@ -283,6 +298,7 @@ def grouped_allreduce(tensors: Sequence[TensorLike],
     outs = fn(*gs)
     _tl(rt, name, "GROUPED_ALLREDUCE", int(sum(l.nbytes for l in locals_)))
     res = [_to_local(rt, o) for o in outs]
+    _rec("GROUPED_ALLREDUCE", int(sum(l.nbytes for l in locals_)), t0)
     return [r if h else r[0] for r, h in zip(res, had)]
 
 
@@ -292,11 +308,13 @@ def allgather(tensor: TensorLike, name: Optional[str] = None) -> Array:
     ``[local_size, rows, ...]``; output is ``[size*rows, ...]``.  For ragged
     first dims use :func:`allgather_ragged`."""
     rt = _rt.get()
+    t0 = time.perf_counter()
     local, had = _per_chip(rt, tensor)
     g = _make_global(rt, local)
     fn = _compiled(_mesh_key(rt), "allgather")
     out = fn(g)  # replicated full concat [size, rows, ...]
     _tl(rt, name, "ALLGATHER", int(local.nbytes))
+    _rec("ALLGATHER", int(local.nbytes), t0)
     out = jnp.reshape(out, (-1,) + out.shape[2:])
     return out
 
@@ -340,11 +358,13 @@ def broadcast(tensor: TensorLike, root_rank: int = 0,
     """Broadcast the value held by chip ``root_rank`` to all chips
     (reference: operations.cc:1096-1134)."""
     rt = _rt.get()
+    t0 = time.perf_counter()
     local, had = _per_chip(rt, tensor)
     g = _make_global(rt, local)
     fn = _compiled(_mesh_key(rt), "broadcast", root=int(root_rank))
     out = fn(g)
     _tl(rt, name, "BROADCAST", int(local.nbytes))
+    _rec("BROADCAST", int(local.nbytes), t0)
     res = _to_local(rt, out)
     return res if had else res[0]
 
@@ -357,6 +377,7 @@ def alltoall(tensor: TensorLike,
     759-841).  Per-chip input ``[local_size, rows, ...]``; ``splits`` is
     ``[local_size, size]`` (rows sent to each destination chip)."""
     rt = _rt.get()
+    t0 = time.perf_counter()
     n = rt.size()
     local, had = _per_chip(rt, tensor)
     if splits is None:
@@ -369,6 +390,7 @@ def alltoall(tensor: TensorLike,
         fn = _compiled(_mesh_key(rt), "alltoall")
         out = _to_local(rt, fn(g))
         _tl(rt, name, "ALLTOALL", int(local.nbytes))
+        _rec("ALLTOALL", int(local.nbytes), t0)
         recv = jnp.full((rt.local_size(), n), rows // n, jnp.int32)
         if not had:
             return out[0], recv[0]
@@ -406,6 +428,7 @@ def alltoall(tensor: TensorLike,
     fn = _compiled(_mesh_key(rt), "alltoall")
     out = _to_local(rt, fn(g))  # [ls, n*max_blk, ...]
     _tl(rt, name, "ALLTOALL", int(local.nbytes))
+    _rec("ALLTOALL", int(local.nbytes), t0)
     # recv_splits[i, src] = all_sp[src, mesh position of local chip i]
     local_pos = rt.local_chip_positions()
     recv_np = np.stack([all_sp[:, local_pos[i]] for i in range(ls)])
@@ -428,11 +451,13 @@ def reducescatter(tensor: TensorLike, op: ReduceOp = Average,
     """Reduce across chips and scatter shards: chip i gets rows
     ``[i*rows/n : (i+1)*rows/n]`` of the reduction."""
     rt = _rt.get()
+    t0 = time.perf_counter()
     local, had = _per_chip(rt, tensor)
     g = _make_global(rt, local)
     fn = _compiled(_mesh_key(rt), "reducescatter", op=int(op))
     out = _to_local(rt, fn(g))
     _tl(rt, name, "REDUCESCATTER", int(local.nbytes))
+    _rec("REDUCESCATTER", int(local.nbytes), t0)
     return out
 
 
@@ -440,10 +465,12 @@ def barrier() -> None:
     """Block until all processes/chips reach the barrier (reference:
     MPIController::Barrier, mpi_controller.cc:227)."""
     rt = _rt.get()
+    t0 = time.perf_counter()
     g = _make_global(rt, jnp.zeros((rt.local_size(), 1), jnp.int32))
     fn = _compiled(_mesh_key(rt), "barrier")
     jax.block_until_ready(fn(g))
     _tl(rt, None, "BARRIER", 0)
+    _rec("BARRIER", 0, t0)
 
 
 def process_allgather(x: np.ndarray) -> np.ndarray:

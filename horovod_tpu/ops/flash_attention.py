@@ -37,7 +37,10 @@ NEG_INF = -1e30
 # sequential online-softmax walk over K/V lives in an in-kernel
 # fori_loop, not on the grid — so Mosaic may pipeline/reorder grid
 # iterations freely.  Ignored in interpret mode.
-_GRID_SEMANTICS = pltpu.CompilerParams(
+# (CompilerParams was spelled TPUCompilerParams before jax 0.5.x.)
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+_GRID_SEMANTICS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "parallel"))
 
 

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import metrics as _metrics
+
 
 class Bucket:
     """One fused collective: a list of leaf indices sharing a dtype."""
@@ -85,21 +87,29 @@ def make_plan(shapes: Sequence[Tuple[int, ...]],
     """
     open_buckets: Dict[Any, Bucket] = {}
     done: List[Bucket] = []
+
+    def close(b: Bucket, reason: str) -> None:
+        done.append(b)
+        _metrics.FUSION_FLUSHES.inc(reason=reason)
+        _metrics.FUSION_BUCKET_BYTES.observe(b.nbytes)
+
     for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
         dt = jnp.dtype(dtype)
         nbytes = int(np.prod(shape)) * dt.itemsize if shape else dt.itemsize
         b = open_buckets.get(dt)
         if b is not None and b.nbytes + nbytes > threshold_bytes and b.indices:
-            done.append(b)
+            close(b, "threshold")  # next tensor would overflow the bucket
             b = None
         if b is None:
             b = Bucket(dt)
             open_buckets[dt] = b
         b.add(i, shape, nbytes)
         if b.nbytes >= threshold_bytes:
-            done.append(b)
+            close(b, "filled")
             del open_buckets[dt]
-    done.extend(b for b in open_buckets.values() if b.indices)
+    for b in open_buckets.values():
+        if b.indices:
+            close(b, "tail")  # end-of-step leftover
     return BucketPlan(done, len(shapes))
 
 

@@ -115,8 +115,11 @@ def hierarchical_allreduce(x: jax.Array,
     flat = jnp.ravel(x)
     n = flat.shape[0]
     # Axis sizes are static at trace time inside shard_map/pjit.
-    ici = int(lax.axis_size(ici_axis))
-    dcn = int(lax.axis_size(dcn_axis))
+    # (lax.axis_size is missing on older jax; psum(1, axis) is concrete
+    # at trace time inside shard_map the same way.)
+    _axis_size = getattr(lax, "axis_size", lambda a: lax.psum(1, a))
+    ici = int(_axis_size(ici_axis))
+    dcn = int(_axis_size(dcn_axis))
     pad = (-n) % ici
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])

@@ -1,4 +1,4 @@
-"""Rendezvous: a threaded HTTP key-value store.
+"""Rendezvous: a threaded HTTP key-value store + the /metrics route.
 
 Direct functional port of the reference's rendezvous server (reference:
 horovod/runner/http/http_server.py:35-201): PUT/GET on /scope/key paths
@@ -7,13 +7,22 @@ elastic host-change notifications, and anything that needs a tiny shared
 blackboard during launch.  The reference's C++ gloo HTTPStore speaks the
 same protocol; here the native core uses TCP directly, so this server
 serves the Python-side rendezvous and elastic signaling.
+
+``GET /metrics`` is special-cased: workers PUT periodic metric snapshots
+into the ``metrics`` scope (``utils/metrics.py`` MetricsPublisher), and
+this route renders them — plus the server process's own registry — as one
+fleet-wide Prometheus text exposition, each sample labeled with its rank
+(``hvdrun --metrics-port`` pins the port; see docs/metrics.md).
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
+
+METRICS_SCOPE = "metrics"
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -36,6 +45,9 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802
         scope, key = self._split()
+        if scope == METRICS_SCOPE and not key:
+            self._serve_metrics()
+            return
         with self.server.kv_lock:  # type: ignore[attr-defined]
             value = self.server.kv.get(scope, {}).get(key)  # type: ignore
         if value is None:
@@ -46,6 +58,28 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(value)))
         self.end_headers()
         self.wfile.write(value)
+
+    def _serve_metrics(self) -> None:
+        """Fleet Prometheus exposition: local (driver) registry + every
+        worker snapshot the ``metrics`` scope holds, rank-labeled."""
+        from ..utils import metrics as M
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            stored = dict(self.server.kv.get(METRICS_SCOPE, {}))  # type: ignore
+        snaps = [({"rank": "driver"}, M.REGISTRY.snapshot())]
+        for key in sorted(stored):
+            try:
+                snap = json.loads(stored[key])
+            except (ValueError, TypeError):
+                continue  # a torn PUT must not 500 the whole scrape
+            rank = str(snap.get("rank", key.rsplit(".", 1)[-1]))
+            snaps.append(({"rank": rank}, snap))
+        body = M.render_prometheus(snaps).encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def do_DELETE(self) -> None:  # noqa: N802
         scope, key = self._split()
@@ -99,6 +133,14 @@ class RendezvousServer:
             return self._final_kv.get(scope, {}).get(key)
         with self._httpd.kv_lock:  # type: ignore[attr-defined]
             return self._httpd.kv.get(scope, {}).get(key)  # type: ignore
+
+    def scope_items(self, scope: str) -> Dict[str, bytes]:
+        """All key->value pairs of a scope (valid after stop(), like
+        get()); used to harvest worker metric snapshots."""
+        if self._httpd is None:
+            return dict(self._final_kv.get(scope, {}))
+        with self._httpd.kv_lock:  # type: ignore[attr-defined]
+            return dict(self._httpd.kv.get(scope, {}))  # type: ignore
 
     def clear_scope(self, scope: str) -> None:
         """Drop every key in a scope (round-scoped state like elastic

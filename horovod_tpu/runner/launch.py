@@ -106,6 +106,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="eager dispatch parallelism (HOROVOD_NUM_STREAMS)")
     p.add_argument("--mesh", default=None,
                    help="mesh spec, e.g. 'data=8' or 'data=4,model=2'")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve the fleet Prometheus view at "
+                        "http://<driver>:PORT/metrics (pins the rendezvous "
+                        "server to PORT) and enable per-worker metric "
+                        "publishing + the end-of-run straggler report "
+                        "(HOROVOD_METRICS; docs/metrics.md)")
     p.add_argument("--timeline-filename", default=None)
     tl_mc = p.add_mutually_exclusive_group()
     tl_mc.add_argument("--timeline-mark-cycles", action="store_true",
@@ -246,6 +252,8 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_START_TIMEOUT"] = str(args.start_timeout)
     if args.mesh:
         env["HOROVOD_TPU_MESH"] = args.mesh
+    if args.metrics_port is not None:
+        env["HOROVOD_METRICS"] = "1"
     if args.timeline_filename:
         env["HOROVOD_TIMELINE"] = args.timeline_filename
     if args.timeline_mark_cycles is not None:
@@ -546,6 +554,26 @@ def build_worker_command(slot: hosts_mod.SlotInfo, command: List[str],
     return ssh_cmd
 
 
+def report_stragglers(rendezvous: RendezvousServer,
+                      sink=None) -> None:
+    """Harvest worker metric snapshots from the rendezvous KV and print
+    the rank-0 straggler report (per-rank negotiation-age p50/p99 naming
+    the slowest rank — the fleet extension of the stall inspector)."""
+    import json as _json
+    from ..utils import metrics as M
+    snaps = {}
+    for key, value in rendezvous.scope_items("metrics").items():
+        if not key.startswith("rank."):
+            continue
+        try:
+            snaps[int(key.split(".", 1)[1])] = _json.loads(value)
+        except (ValueError, TypeError):
+            continue
+    report = M.straggler_report(snaps)
+    if report:
+        print(report, file=sink or sys.stderr, flush=True)
+
+
 def launch_static(args: argparse.Namespace, command: List[str]) -> int:
     """Static (non-elastic) run (reference: _run_static launch.py:528-618
     + launch_gloo gloo_run.py:226-273)."""
@@ -553,7 +581,12 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
     np_ = args.num_proc or sum(h.slots for h in host_infos)
     slots = hosts_mod.get_host_assignments(host_infos, np_)
 
-    rendezvous = RendezvousServer()
+    # --metrics-port pins the rendezvous server so /metrics is scrapeable
+    # at a known address; metrics also engage via the ambient env knob.
+    metrics_enabled = (args.metrics_port is not None
+                       or os.environ.get("HOROVOD_METRICS", "") not in
+                       ("", "0", "false"))
+    rendezvous = RendezvousServer(port=args.metrics_port or 0)
     rdv_port = rendezvous.start()
     for slot in slots:
         rendezvous.put("rank", str(slot.rank),
@@ -613,6 +646,8 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
             p.wait()
         return 130
     finally:
+        if metrics_enabled:
+            report_stragglers(rendezvous)
         rendezvous.stop()
 
 

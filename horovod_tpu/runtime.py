@@ -141,6 +141,20 @@ class Runtime:
                 shutdown_seconds=self.knobs[
                     "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"])
 
+        # Metrics plane (utils/metrics.py): when enabled, this worker
+        # publishes periodic registry snapshots to the rendezvous KV so
+        # the launcher's /metrics route serves a fleet-wide Prometheus
+        # view and can print the end-of-run straggler report.
+        self.metrics_publisher = None
+        if self.knobs["HOROVOD_METRICS"]:
+            from .utils.metrics import MetricsPublisher
+            self.metrics_publisher = MetricsPublisher(
+                addr=self.knobs["HOROVOD_RENDEZVOUS_ADDR"],
+                port=self.knobs["HOROVOD_RENDEZVOUS_PORT"],
+                rank=self._process_index,
+                snapshot_fn=self.metrics_snapshot,
+                interval=self.knobs["HOROVOD_METRICS_INTERVAL"])
+
         # Native core (C++ controller/tensor-queue): negotiates a global
         # execution order for eager multi-process collectives (SPMD paths
         # don't need it — XLA programs are deterministic).  Reference:
@@ -285,11 +299,35 @@ class Runtime:
             return self.autotuner.fusion_threshold
         return self.knobs["HOROVOD_FUSION_THRESHOLD"]
 
+    # -------------------------------------------------------------- metrics
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Point-in-time view of every metric family this process holds
+        (the public ``hvd.metrics_snapshot()``): registry values refreshed
+        from their live sources — native controller counters/histograms,
+        bucket-plan cache, stall inspector — in one JSON-able dict."""
+        from .utils import metrics as M
+        M.RUNTIME_SIZE.set(self.size())
+        M.RUNTIME_LOCAL_SIZE.set(self.local_size())
+        M.PLAN_CACHE_HITS.set_total(self.plan_cache.hits)
+        M.PLAN_CACHE_MISSES.set_total(self.plan_cache.misses)
+        if self.stall_inspector is not None:
+            M.STALL_PENDING.set(self.stall_inspector.pending_count())
+        if self.core is not None and getattr(self.core, "_h", None):
+            try:
+                M.import_core_metrics(self.core.metrics())
+            except Exception:
+                pass  # a closing core must not break the snapshot
+        return M.REGISTRY.snapshot()
+
     # ------------------------------------------------------------- lifecycle
     def shutdown(self) -> None:
         if self._shutdown:
             return
         self._shutdown = True
+        # Final metrics publish while the native core is still alive, so
+        # the straggler report sees complete histograms.
+        if self.metrics_publisher is not None:
+            self.metrics_publisher.close()
         if self.timeline is not None:
             self.timeline.close()
         if self.autotuner is not None:

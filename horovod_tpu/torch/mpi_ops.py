@@ -40,6 +40,7 @@ no host round-trip (see docs/migration.md "What changes on TPU").
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -50,6 +51,7 @@ from ..common import basics as _basics
 from ..common.exceptions import HorovodInternalError
 from ..common.reduce_op import ReduceOp, Average, Sum, Adasum
 from ..ops import collectives as _C
+from ..utils import metrics as _metrics
 
 __all__ = [
     "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
@@ -184,7 +186,7 @@ def _auto_name(prefix: str) -> str:
 class _PendingOp:
     """A locally submitted op waiting for its negotiated execution slot."""
 
-    __slots__ = ("name", "handle", "execute", "kind")
+    __slots__ = ("name", "handle", "execute", "kind", "submitted")
 
     def __init__(self, name: str, handle: int, kind: str,
                  execute: Callable[[], Any]):
@@ -192,6 +194,7 @@ class _PendingOp:
         self.handle = handle
         self.kind = kind
         self.execute = execute
+        self.submitted = _time.monotonic()
 
 
 def _core():
@@ -238,6 +241,11 @@ def _execute_response(resp) -> None:
         with _pending_lock:
             op = _pending.pop(name, None)
         if op is not None:
+            # Submit -> agreed-response age: this rank's view of how long
+            # negotiation took — a slow peer inflates every OTHER rank's
+            # ages, which is what the straggler report quantizes.
+            _metrics.NEGOTIATION_AGE.observe(
+                _time.monotonic() - op.submitted)
             if tl is not None:
                 # agreed: negotiation over, queued for its batch slot
                 tl.end(name, "NEGOTIATE")

@@ -1,0 +1,635 @@
+"""Metrics plane: Counter/Gauge/Histogram registry + Prometheus exposition.
+
+The reference ships a timeline and a stall inspector but no *metrics*; a
+production job needs latency distributions and fleet-wide counters (the
+telemetry that adaptive systems like Adasum presuppose, arxiv 2006.02924).
+This module is the process-global registry every layer records into:
+
+  * native controller counters/histograms imported from the C++ core
+    (``csrc/c_api.cc`` ``hvd_core_metrics``) via :func:`import_core_metrics`,
+  * eager collectives + fusion planning (``ops/collectives.py``,
+    ``ops/fusion.py``), the stall inspector and the torch negotiated path,
+  * elastic driver/worker lifecycle events (``elastic/driver.py``,
+    ``elastic/state.py``).
+
+Exposition: each worker periodically PUTs a JSON :func:`MetricsRegistry.
+snapshot` to the rendezvous KV (``MetricsPublisher``); the rendezvous HTTP
+server's ``/metrics`` route renders the fleet-wide Prometheus text view
+(``runner/http_server.py``), and the launcher prints a rank-0 end-of-run
+straggler report (:func:`straggler_report`).
+
+Deliberately stdlib-only with no package-relative imports at module level,
+so the CI exposition linter (``scripts/check_metrics_format.py``) can load
+this file standalone, the way ``bench.py`` loads ``utils/probe.py``.
+
+Histogram buckets are power-of-2 microseconds (expressed in seconds),
+matching the native core's fixed-bucket layout so native histograms import
+loss-free (csrc/controller.h LatencyHistogram).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+SNAPSHOT_VERSION = 1
+
+# Power-of-2 µs upper bounds in seconds: bucket b counts observations
+# <= 2^b µs; the native core uses the identical layout (28 buckets,
+# ~134 s ceiling) so its histograms map 1:1.
+NATIVE_BUCKETS = 28
+BUCKET_BOUNDS: Tuple[float, ...] = tuple(
+    (1 << b) * 1e-6 for b in range(NATIVE_BUCKETS))
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+
+    def to_family(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonic counter; ``set_total`` imports an externally-accumulated
+    value (native core counters) instead of re-counting it."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str):
+        super().__init__(name, help)
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def inc(self, value: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def set_total(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def to_family(self) -> Dict[str, Any]:
+        with self._lock:
+            samples = [{"labels": dict(k), "value": v}
+                       for k, v in sorted(self._values.items())]
+        if not samples:
+            samples = [{"labels": {}, "value": 0.0}]
+        return {"kind": self.kind, "help": self.help, "samples": samples}
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+
+class Histogram(_Metric):
+    """Fixed-bound histogram (power-of-2 µs by default)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str,
+                 bounds: Tuple[float, ...] = BUCKET_BOUNDS):
+        super().__init__(name, help)
+        self.bounds = tuple(bounds)
+        self._series: Dict[Tuple[Tuple[str, str], ...], Dict[str, Any]] = {}
+
+    def _get(self, key):
+        s = self._series.get(key)
+        if s is None:
+            s = {"counts": [0] * len(self.bounds), "sum": 0.0, "count": 0}
+            self._series[key] = s
+        return s
+
+    def observe(self, value: float, **labels: str) -> None:
+        with self._lock:
+            s = self._get(_label_key(labels))
+            b = 0
+            while b < len(self.bounds) - 1 and value > self.bounds[b]:
+                b += 1
+            s["counts"][b] += 1
+            s["sum"] += float(value)
+            s["count"] += 1
+
+    def set_native(self, counts: List[int], total_sum: float, count: int,
+                   **labels: str) -> None:
+        """Replace a series with an externally-accumulated (native core)
+        histogram; counts are per-bucket, already in this bound layout."""
+        with self._lock:
+            s = self._get(_label_key(labels))
+            padded = list(counts)[:len(self.bounds)]
+            padded += [0] * (len(self.bounds) - len(padded))
+            s["counts"] = [int(c) for c in padded]
+            s["sum"] = float(total_sum)
+            s["count"] = int(count)
+
+    def quantile(self, q: float, **labels: str) -> Optional[float]:
+        """Upper-bound estimate of the q-quantile from the buckets."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if not s or not s["count"]:
+                return None
+            target = q * s["count"]
+            cum = 0
+            for c, bound in zip(s["counts"], self.bounds):
+                cum += c
+                if cum >= target:
+                    return bound
+            return self.bounds[-1]
+
+    def to_family(self) -> Dict[str, Any]:
+        with self._lock:
+            samples = [{"labels": dict(k), "counts": list(s["counts"]),
+                        "sum": s["sum"], "count": s["count"]}
+                       for k, s in sorted(self._series.items())]
+        if not samples:
+            samples = [{"labels": {}, "counts": [0] * len(self.bounds),
+                        "sum": 0.0, "count": 0}]
+        return {"kind": self.kind, "help": self.help,
+                "bounds": list(self.bounds), "samples": samples}
+
+
+class MetricsRegistry:
+    """Named metric families, get-or-create, order-preserving."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, cls, name: str, help: str, **kw) -> Any:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or type(m) is not cls:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}")
+                return m
+            m = cls(name, help, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str) -> Counter:
+        return self._register(Counter, name, help)
+
+    def gauge(self, name: str, help: str) -> Gauge:
+        return self._register(Gauge, name, help)
+
+    def histogram(self, name: str, help: str,
+                  bounds: Tuple[float, ...] = BUCKET_BOUNDS) -> Histogram:
+        return self._register(Histogram, name, help, bounds=bounds)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able snapshot: the wire format workers PUT to the KV."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        return {"version": SNAPSHOT_VERSION, "time": time.time(),
+                "families": {name: m.to_family() for name, m in metrics}}
+
+
+REGISTRY = MetricsRegistry()
+
+
+# --------------------------------------------------------- standard families
+# Declared centrally so every process (worker AND driver) exposes the same
+# family set — a fleet /metrics view always spans all four layers even when
+# a layer recorded nothing yet (zero-valued families, not absent ones).
+
+# Layer 1: native controller (imported from csrc via hvd_core_metrics).
+CONTROLLER_CYCLES = REGISTRY.counter(
+    "hvd_controller_cycles_total", "Controller negotiation cycles run.")
+CONTROLLER_CACHE_HITS = REGISTRY.counter(
+    "hvd_controller_cache_hits_total",
+    "Requests served via the response-cache bit-vector fast path.")
+CONTROLLER_CACHE_MISSES = REGISTRY.counter(
+    "hvd_controller_cache_misses_total",
+    "Requests that took the full gather negotiation path.")
+CONTROLLER_STALL_WARNINGS = REGISTRY.counter(
+    "hvd_controller_stall_warnings_total",
+    "Native stall-inspector warnings (ranks disagreeing about a tensor).")
+CONTROLLER_RESPONSES = REGISTRY.counter(
+    "hvd_controller_responses_total", "Negotiated responses emitted.")
+CONTROLLER_CACHED_RESPONSES = REGISTRY.counter(
+    "hvd_controller_cached_responses_total",
+    "Responses reconstructed from the replicated cache.")
+CONTROLLER_BYTES_GATHERED = REGISTRY.counter(
+    "hvd_controller_bytes_gathered_total",
+    "Outbound gather-frame coordination bytes.")
+CONTROLLER_BYTES_BROADCAST = REGISTRY.counter(
+    "hvd_controller_bytes_broadcast_total",
+    "Broadcast-frame coordination bytes seen by this rank.")
+CONTROLLER_BYTES_REDUCED = REGISTRY.counter(
+    "hvd_controller_bytes_reduced_total",
+    "Payload bytes of negotiated reduce-class collectives.")
+CONTROLLER_TENSORS = REGISTRY.counter(
+    "hvd_controller_tensors_negotiated_total",
+    "Tensors carried by OK responses (tensors/cycle numerator).")
+CONTROLLER_FUSED_BATCHES = REGISTRY.counter(
+    "hvd_controller_fused_batches_total",
+    "Fused response batches executed.")
+CONTROLLER_FUSED_BYTES = REGISTRY.counter(
+    "hvd_controller_fused_batch_bytes_total",
+    "Total payload bytes across fused response batches.")
+CONTROLLER_FILL_RATIO = REGISTRY.gauge(
+    "hvd_controller_fusion_fill_ratio",
+    "Mean fused-batch bytes / fusion threshold (fusion buffer fill).")
+CONTROLLER_CYCLE_TIME = REGISTRY.histogram(
+    "hvd_controller_cycle_time_seconds",
+    "Controller RunCycle wall time (native power-of-2 µs buckets).")
+CONTROLLER_NEGOTIATION_AGE = REGISTRY.histogram(
+    "hvd_controller_negotiation_age_seconds",
+    "Rank-0 per-tensor age from first submission to global readiness.")
+
+# Layer 2: collectives + fusion planning (Python data-plane).
+COLLECTIVE_OPS = REGISTRY.counter(
+    "hvd_collective_ops_total", "Eager collective calls by op kind.")
+COLLECTIVE_BYTES = REGISTRY.counter(
+    "hvd_collective_bytes_total", "Eager collective payload bytes by op.")
+COLLECTIVE_LATENCY = REGISTRY.histogram(
+    "hvd_collective_latency_seconds",
+    "Host-side latency of one eager collective call by op.")
+FUSION_BUCKET_BYTES = REGISTRY.histogram(
+    "hvd_fusion_bucket_bytes",
+    "Planned fusion bucket sizes in bytes.",
+    bounds=tuple(float(1 << b) for b in range(NATIVE_BUCKETS)))
+FUSION_FLUSHES = REGISTRY.counter(
+    "hvd_fusion_bucket_flush_total",
+    "Fusion buckets closed, by reason (threshold/filled/tail).")
+PLAN_CACHE_HITS = REGISTRY.counter(
+    "hvd_fusion_plan_cache_hits_total", "Bucket-plan cache hits.")
+PLAN_CACHE_MISSES = REGISTRY.counter(
+    "hvd_fusion_plan_cache_misses_total", "Bucket-plan cache misses.")
+
+# Layer 3: runtime (stall inspector + topology).
+RUNTIME_SIZE = REGISTRY.gauge(
+    "hvd_runtime_size", "Worker chips in the mesh.")
+RUNTIME_LOCAL_SIZE = REGISTRY.gauge(
+    "hvd_runtime_local_size", "Chips driven by this process.")
+STALL_WARNINGS = REGISTRY.counter(
+    "hvd_stall_warnings_total",
+    "Python stall-inspector warnings (submitted but not completed).")
+STALL_PENDING = REGISTRY.gauge(
+    "hvd_stall_pending_tensors",
+    "Collectives currently submitted but not completed.")
+NEGOTIATION_AGE = REGISTRY.histogram(
+    "hvd_negotiation_age_seconds",
+    "Per-rank submit-to-completion age of named collectives (the "
+    "straggler report's source: a slow rank drags every peer's ages up).")
+
+# Layer 4: elastic lifecycle.
+ELASTIC_RESETS = REGISTRY.counter(
+    "hvd_elastic_reset_rounds_total", "Elastic reset rounds started.")
+ELASTIC_FAILURES = REGISTRY.counter(
+    "hvd_elastic_worker_failures_total", "Worker processes that failed.")
+ELASTIC_HOSTS_ADDED = REGISTRY.counter(
+    "hvd_elastic_hosts_added_total", "Hosts added by discovery.")
+ELASTIC_HOSTS_REMOVED = REGISTRY.counter(
+    "hvd_elastic_hosts_removed_total",
+    "Hosts removed by discovery or blacklisting.")
+ELASTIC_ROUND_DURATION = REGISTRY.histogram(
+    "hvd_elastic_round_duration_seconds",
+    "Wall time of one elastic round (spawn to reset/finish).")
+ELASTIC_COMMITS = REGISTRY.counter(
+    "hvd_elastic_commits_total", "Elastic state commits.")
+ELASTIC_COMMIT_DURATION = REGISTRY.histogram(
+    "hvd_elastic_commit_duration_seconds",
+    "Wall time of one elastic state commit.")
+ELASTIC_RESTORES = REGISTRY.counter(
+    "hvd_elastic_restores_total", "Elastic state restores after reset.")
+
+
+def import_core_metrics(native: Dict[str, Any]) -> None:
+    """Map one native-core metrics dict (CoordinationCore.metrics()) onto
+    the controller families.  Native values are cumulative, so they are
+    imported with set_total/set_native rather than re-counted."""
+    c = native.get("counters", {})
+    CONTROLLER_CYCLES.set_total(c.get("cycles", 0))
+    CONTROLLER_CACHE_HITS.set_total(c.get("cache_hits", 0))
+    CONTROLLER_CACHE_MISSES.set_total(c.get("cache_misses", 0))
+    CONTROLLER_STALL_WARNINGS.set_total(c.get("stall_warnings", 0))
+    CONTROLLER_RESPONSES.set_total(c.get("responses", 0))
+    CONTROLLER_CACHED_RESPONSES.set_total(c.get("cached_responses", 0))
+    CONTROLLER_BYTES_GATHERED.set_total(c.get("bytes_gathered", 0))
+    CONTROLLER_BYTES_BROADCAST.set_total(c.get("bytes_broadcast", 0))
+    CONTROLLER_BYTES_REDUCED.set_total(c.get("bytes_reduced", 0))
+    CONTROLLER_TENSORS.set_total(c.get("tensors_negotiated", 0))
+    CONTROLLER_FUSED_BATCHES.set_total(c.get("fused_batches", 0))
+    CONTROLLER_FUSED_BYTES.set_total(c.get("fused_batch_bytes", 0))
+    batches = c.get("fused_batches", 0)
+    threshold = c.get("fusion_threshold_bytes", 0)
+    if batches and threshold:
+        CONTROLLER_FILL_RATIO.set(
+            c.get("fused_batch_bytes", 0) / (batches * threshold))
+    for hname, metric in (("cycle_time_us", CONTROLLER_CYCLE_TIME),
+                          ("negotiation_age_us", CONTROLLER_NEGOTIATION_AGE)):
+        h = native.get("histograms", {}).get(hname)
+        if h:
+            metric.set_native(h["buckets"], h["sum"] * 1e-6, h["count"])
+
+
+# --------------------------------------------------------------- exposition
+def _render_family(lines: List[str], name: str, fam: Dict[str, Any],
+                   extra_labels: Dict[str, str]) -> None:
+    for s in fam["samples"]:
+        labels = dict(s.get("labels", {}))
+        labels.update(extra_labels)
+        if fam["kind"] == "histogram":
+            cum = 0
+            base = {k: v for k, v in labels.items()}
+            for c, bound in zip(s["counts"], fam["bounds"]):
+                cum += c
+                lab = dict(base)
+                lab["le"] = repr(float(bound))
+                lines.append(f"{name}_bucket{_fmt_labels(lab)} {cum}")
+            lab = dict(base)
+            lab["le"] = "+Inf"
+            lines.append(f"{name}_bucket{_fmt_labels(lab)} {s['count']}")
+            lines.append(f"{name}_sum{_fmt_labels(base)} "
+                         f"{_fmt_value(s['sum'])}")
+            lines.append(f"{name}_count{_fmt_labels(base)} {s['count']}")
+        else:
+            lines.append(f"{name}{_fmt_labels(labels)} "
+                         f"{_fmt_value(s['value'])}")
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(str(v))}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def render_prometheus(snapshots: List[Tuple[Dict[str, str], Dict[str, Any]]]
+                      ) -> str:
+    """Prometheus text format (v0.0.4) from [(extra_labels, snapshot)].
+
+    Families are merged by name across snapshots; each snapshot's samples
+    carry its extra labels (e.g. ``rank="1"``), so one scrape shows the
+    whole fleet."""
+    order: List[str] = []
+    merged: Dict[str, List[Tuple[Dict[str, str], Dict[str, Any]]]] = {}
+    for extra, snap in snapshots:
+        for name, fam in snap.get("families", {}).items():
+            if name not in merged:
+                merged[name] = []
+                order.append(name)
+            merged[name].append((extra, fam))
+    lines: List[str] = []
+    for name in order:
+        first = merged[name][0][1]
+        lines.append(f"# HELP {name} {first['help']}")
+        lines.append(f"# TYPE {name} {first['kind']}")
+        for extra, fam in merged[name]:
+            _render_family(lines, name, fam, extra)
+    return "\n".join(lines) + "\n"
+
+
+def lint_exposition(text: str) -> List[str]:
+    """Pure-Python promtool-style check of Prometheus text format.
+
+    Returns a list of violations (empty = clean).  Covers the drift CI
+    must catch: TYPE/HELP pairing, sample↔family consistency, histogram
+    +Inf/_sum/_count completeness, numeric values, and duplicate series."""
+    import re
+    errors: List[str] = []
+    typed: Dict[str, str] = {}
+    seen_series = set()
+    hist_state: Dict[str, Dict[str, bool]] = {}
+    name_rx = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    sample_rx = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+    label_rx = re.compile(
+        r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) < 4 or not name_rx.match(parts[2]):
+                errors.append(f"line {i}: malformed HELP")
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                errors.append(f"line {i}: malformed TYPE")
+                continue
+            if parts[2] in typed:
+                errors.append(f"line {i}: duplicate TYPE for {parts[2]}")
+            typed[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = sample_rx.match(line)
+        if not m:
+            errors.append(f"line {i}: unparseable sample: {line!r}")
+            continue
+        name, labelstr, value = m.group(1), m.group(2) or "", m.group(3)
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in typed and \
+                    typed[name[:-len(suffix)]] == "histogram":
+                base = name[:-len(suffix)]
+        if base not in typed:
+            errors.append(f"line {i}: sample {name} has no TYPE declaration")
+            continue
+        if typed[base] == "histogram":
+            st = hist_state.setdefault(base, {})
+            if name.endswith("_bucket") and 'le="+Inf"' in labelstr:
+                st["inf"] = True
+            if name.endswith("_sum"):
+                st["sum"] = True
+            if name.endswith("_count"):
+                st["count"] = True
+            if name == base:
+                errors.append(
+                    f"line {i}: bare sample for histogram {base}")
+        if labelstr:
+            for pair in _split_labels(labelstr[1:-1]):
+                if pair and not label_rx.match(pair):
+                    errors.append(f"line {i}: malformed label {pair!r}")
+        if value not in ("+Inf", "-Inf", "NaN"):
+            try:
+                float(value)
+            except ValueError:
+                errors.append(f"line {i}: non-numeric value {value!r}")
+        key = (name, labelstr)
+        if key in seen_series:
+            errors.append(f"line {i}: duplicate series {name}{labelstr}")
+        seen_series.add(key)
+    for base, st in hist_state.items():
+        for part in ("inf", "sum", "count"):
+            if not st.get(part):
+                errors.append(f"histogram {base} missing "
+                              f"{'+Inf bucket' if part == 'inf' else '_' + part}")
+    return errors
+
+
+def _split_labels(inner: str) -> List[str]:
+    """Split 'a="x",b="y,z"' on commas outside quotes."""
+    parts, cur, in_q, esc = [], "", False, False
+    for ch in inner:
+        if esc:
+            cur += ch
+            esc = False
+        elif ch == "\\":
+            cur += ch
+            esc = True
+        elif ch == '"':
+            cur += ch
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        parts.append(cur)
+    return parts
+
+
+# ---------------------------------------------------------------- publisher
+class MetricsPublisher:
+    """Background thread PUT-ing periodic snapshots to the rendezvous KV
+    (scope ``metrics``, key ``rank.N``) so the driver's ``/metrics`` route
+    serves a fleet-wide view.  A final publish happens on close() so the
+    end-of-run straggler report sees complete histograms."""
+
+    SCOPE = "metrics"
+
+    def __init__(self, addr: str, port: int, rank: int,
+                 snapshot_fn: Callable[[], Dict[str, Any]],
+                 interval: float = 5.0):
+        self.addr = addr
+        self.port = int(port)
+        self.rank = int(rank)
+        self.interval = max(0.1, float(interval))
+        self._snapshot_fn = snapshot_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.addr and self.port:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def publish_now(self) -> bool:
+        if not (self.addr and self.port):
+            return False
+        try:
+            snap = self._snapshot_fn()
+            snap["rank"] = self.rank
+            body = json.dumps(snap).encode()
+            url = (f"http://{self.addr}:{self.port}/{self.SCOPE}/"
+                   f"rank.{self.rank}")
+            req = urllib.request.Request(url, data=body, method="PUT")
+            with urllib.request.urlopen(req, timeout=5):
+                pass
+            return True
+        except Exception:
+            return False  # metrics must never take the job down
+
+    def _loop(self) -> None:
+        self.publish_now()
+        while not self._stop.wait(self.interval):
+            self.publish_now()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.publish_now()
+
+
+# --------------------------------------------------------- straggler report
+def _fmt_seconds(v: Optional[float]) -> str:
+    if v is None:
+        return "n/a"
+    if v < 1e-3:
+        return f"{v * 1e6:.0f}us"
+    if v < 1.0:
+        return f"{v * 1e3:.1f}ms"
+    return f"{v:.2f}s"
+
+
+def _hist_quantile(fam: Dict[str, Any], q: float) -> Optional[float]:
+    """q-quantile (bucket upper bound) over ALL of a family's series."""
+    bounds = fam.get("bounds", [])
+    counts = [0] * len(bounds)
+    total = 0
+    for s in fam.get("samples", []):
+        for i, c in enumerate(s.get("counts", [])[:len(bounds)]):
+            counts[i] += c
+        total += s.get("count", 0)
+    if not total:
+        return None
+    target = q * total
+    cum = 0
+    for c, bound in zip(counts, bounds):
+        cum += c
+        if cum >= target:
+            return float(bound)
+    return float(bounds[-1]) if bounds else None
+
+
+def _hist_count(fam: Dict[str, Any]) -> int:
+    return sum(s.get("count", 0) for s in fam.get("samples", []))
+
+
+def straggler_report(snapshots: Dict[int, Dict[str, Any]],
+                     family: str = "hvd_negotiation_age_seconds") -> str:
+    """Rank-0 end-of-run report: per-rank negotiation-age p50/p99, naming
+    the slowest rank (the fleet-level extension of the stall inspector —
+    it tells you WHO was late, not only that someone was).
+
+    ``snapshots`` maps rank -> snapshot dict (MetricsRegistry.snapshot()
+    shape, as harvested from the rendezvous KV)."""
+    rows = []
+    for rank in sorted(snapshots):
+        fam = snapshots[rank].get("families", {}).get(family)
+        if not fam or not _hist_count(fam):
+            # eager ages absent (pure SPMD run): fall back to the native
+            # controller's negotiation ages, recorded on rank 0 only
+            fam = snapshots[rank].get("families", {}).get(
+                "hvd_controller_negotiation_age_seconds")
+        if not fam or not _hist_count(fam):
+            continue
+        rows.append((rank, _hist_quantile(fam, 0.5),
+                     _hist_quantile(fam, 0.99), _hist_count(fam)))
+    if not rows:
+        return ""
+    slowest = max(rows, key=lambda r: (r[2] or 0.0, r[1] or 0.0))
+    lines = ["[hvd] straggler report (negotiation age, per rank):"]
+    for rank, p50, p99, n in rows:
+        lines.append(f"  rank {rank}: p50={_fmt_seconds(p50)} "
+                     f"p99={_fmt_seconds(p99)} (n={n})")
+    lines.append(f"  slowest: rank {slowest[0]} "
+                 f"(p99 {_fmt_seconds(slowest[2])})")
+    return "\n".join(lines)

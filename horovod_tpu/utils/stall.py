@@ -19,6 +19,7 @@ from typing import Dict
 
 from ..common import hvdlogging as log
 from ..common.exceptions import StallError
+from . import metrics as _metrics
 
 
 class StallInspector:
@@ -64,8 +65,16 @@ class StallInspector:
 
     def record_complete(self, name: str) -> None:
         with self._lock:
-            self._pending.pop(name, None)
+            submitted = self._pending.pop(name, None)
             self._warned.pop(name, None)
+        if submitted is not None:
+            # Completion age feeds the per-rank negotiation-age histogram
+            # that the rank-0 straggler report quantizes (docs/metrics.md).
+            _metrics.NEGOTIATION_AGE.observe(time.monotonic() - submitted)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
 
     def check(self) -> None:
         """Warn/abort on overdue tensors (reference:
@@ -76,6 +85,7 @@ class StallInspector:
                        if now - t > self.warn_seconds]
         for name, age in stalled:
             if not self._warned.get(name):
+                _metrics.STALL_WARNINGS.inc()
                 log.warning(
                     "One or more tensors were submitted to be reduced/"
                     "gathered but were not completed for %.0f seconds: %s. "

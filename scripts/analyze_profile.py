@@ -88,7 +88,10 @@ def device_pids(pid_names) -> set:
 
 _HOST_FRAME = re.compile(
     r"^(\$|end: |PjitFunction|PjRt|PyClient|ExecuteSharded|ParseArguments|"
-    r"Handle inputs|CommonPjRt|ThreadpoolListener|TransferTo|CopyTo)")
+    r"Handle inputs|CommonPjRt|ThreadpoolListener|TransferTo|CopyTo|"
+    r"Tfrt\w*Executable|ThunkExecutor)")  # runtime-executor envelope/wait
+                                          # spans (newer jax CPU traces)
+                                          # cover the op spans: double-count
 
 
 def op_tids(events, pids, tid_names) -> Optional[set]:

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""CI gate: promtool-check-metrics-style validation of the /metrics
+exposition, with zero external dependencies.
+
+Generates a realistically-populated fleet snapshot (two worker ranks +
+the driver registry, every standard family, labeled series, native-style
+imported histograms), renders it through the SAME code path the
+rendezvous server's /metrics route uses, and runs the pure-Python
+exposition linter over the result — so any format drift (a malformed
+label, a histogram missing its +Inf bucket, duplicate series) fails CI
+fast instead of surfacing in someone's Prometheus scrape.
+
+Loads utils/metrics.py BY FILE PATH (the bench.py probe-loader pattern)
+so this gate never pays — or depends on — the jax-heavy package import.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_metrics():
+    path = os.path.join(REPO, "horovod_tpu", "utils", "metrics.py")
+    spec = importlib.util.spec_from_file_location("_hvd_metrics_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def populate(m) -> dict:
+    """Exercise every metric shape: plain counters, labeled counters,
+    gauges, observed histograms, and native-imported histograms."""
+    m.COLLECTIVE_OPS.inc(op="allreduce")
+    m.COLLECTIVE_OPS.inc(3, op="allgather")
+    m.COLLECTIVE_BYTES.inc(1 << 20, op="allreduce")
+    m.COLLECTIVE_LATENCY.observe(0.0031, op="allreduce")
+    m.COLLECTIVE_LATENCY.observe(0.27, op="allgather")
+    m.FUSION_FLUSHES.inc(reason="threshold")
+    m.FUSION_FLUSHES.inc(reason="tail")
+    m.FUSION_BUCKET_BYTES.observe(64 << 20)
+    m.PLAN_CACHE_HITS.set_total(42)
+    m.PLAN_CACHE_MISSES.set_total(7)
+    m.RUNTIME_SIZE.set(8)
+    m.NEGOTIATION_AGE.observe(0.002)
+    m.NEGOTIATION_AGE.observe(0.5)
+    m.ELASTIC_RESETS.inc()
+    m.ELASTIC_ROUND_DURATION.observe(12.5)
+    # Native-core shaped import: cumulative counters + µs bucket arrays.
+    m.import_core_metrics({
+        "counters": {"cycles": 340, "cache_hits": 90, "cache_misses": 10,
+                     "bytes_reduced": 1 << 24, "tensors_negotiated": 100,
+                     "fused_batches": 20, "fused_batch_bytes": 19 << 20,
+                     "fusion_threshold_bytes": 128 << 20},
+        "histograms": {
+            "cycle_time_us": {"count": 340, "sum": 68000,
+                              "buckets": [0] * 7 + [300, 40] +
+                                         [0] * (m.NATIVE_BUCKETS - 9)},
+            "negotiation_age_us": {"count": 15, "sum": 120000,
+                                   "buckets": [0] * 12 + [10, 5] +
+                                              [0] * (m.NATIVE_BUCKETS - 14)},
+        }})
+    return m.REGISTRY.snapshot()
+
+
+def main() -> int:
+    m = load_metrics()
+    snap = populate(m)
+    fleet = [({"rank": "driver"}, m.REGISTRY.snapshot()),
+             ({"rank": "0"}, snap), ({"rank": "1"}, snap)]
+    text = m.render_prometheus(fleet)
+    errors = m.lint_exposition(text)
+    families = sum(1 for line in text.splitlines()
+                   if line.startswith("# TYPE "))
+    if errors:
+        for e in errors:
+            print(f"EXPOSITION LINT: {e}", file=sys.stderr)
+        return 1
+    if families < 12:
+        print(f"EXPOSITION LINT: only {families} metric families "
+              "(acceptance floor is 12)", file=sys.stderr)
+        return 1
+    print(f"metrics exposition OK: {families} families, "
+          f"{len(text.splitlines())} lines, 0 lint errors")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

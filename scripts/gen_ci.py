@@ -55,6 +55,7 @@ SUITES = {
     "native-controller": [
         "tests/test_native_core.py", "tests/test_negotiated.py",
         "tests/test_autotune.py", "tests/test_aux.py",
+        "tests/test_metrics.py",
     ],
     "torch": ["tests/test_torch.py"],
     "tensorflow-keras": ["tests/test_tensorflow.py", "tests/test_keras.py"],
@@ -129,6 +130,13 @@ def build_steps():
     steps.append(_step(
         "bench: cpu smoke",
         f"{py} bench.py --cpu", timeout=15))
+    steps.append(_step(
+        # promtool-check-metrics-style gate, pure Python (no external
+        # dep): renders a populated fleet /metrics snapshot through the
+        # server's own code path and lints the exposition format so
+        # drift fails here, not in someone's Prometheus scrape.
+        "metrics: exposition-format lint",
+        f"{py} scripts/check_metrics_format.py", timeout=10))
     steps.append(_step(
         # Gated on availability: with real pyspark/ray installed this
         # validates the contract fakes against reality (reference:
