@@ -234,6 +234,14 @@ int hvd_core_metrics(void* h, char* buf, int buflen) {
   kv("fused_batch_bytes", s.fused_batch_bytes);
   kv("fusion_threshold_bytes",
      static_cast<uint64_t>(core->fusion_threshold()));
+  // transport resilience / chaos-plane counters (docs/chaos.md): appended
+  // per the name-keyed versioning contract above.
+  TransportStats ts = core->transport_stats();
+  kv("transport_reconnects", ts.reconnects);
+  kv("transport_reconnect_failures", ts.reconnect_failures);
+  kv("transport_frames_resent", ts.frames_resent);
+  kv("transport_frames_dropped", ts.frames_dropped);
+  kv("chaos_faults_injected", ts.chaos_faults);
   auto hist = [&t](const char* name, const LatencyHistogram& hg) {
     t += "hist ";
     t += name;

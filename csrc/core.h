@@ -49,6 +49,9 @@ class Core {
   int rank() const { return controller_->rank(); }
   int size() const { return controller_->size(); }
   ControllerStats stats() const;
+  TransportStats transport_stats() const {
+    return transport_->transport_stats();
+  }
   int64_t fusion_threshold() const { return controller_->fusion_threshold(); }
 
   // Turn on rank-0 autotuning of (fusion threshold, cycle time) scored by

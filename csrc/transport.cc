@@ -8,7 +8,9 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 
@@ -63,8 +65,21 @@ bool LoopbackHub::Bcast(int rank, std::string* frame,
   return true;
 }
 
-// ----------------------------------------------------------------------- tcp
+// ----------------------------------------------------------------------- env
 namespace {
+
+long EnvLong(const char* name, long def) {
+  const char* v = getenv(name);
+  if (!v || !*v) return def;
+  return strtol(v, nullptr, 10);
+}
+
+double EnvDouble(const char* name, double def) {
+  const char* v = getenv(name);
+  if (!v || !*v) return def;
+  return strtod(v, nullptr);
+}
+
 // Resolve a hostname or numeric address to an IPv4 sockaddr; false on
 // failure (the launcher hands out hostnames, not just dotted quads).
 bool ResolveIPv4(const std::string& host, uint16_t port, sockaddr_in* out) {
@@ -82,11 +97,86 @@ bool ResolveIPv4(const std::string& host, uint16_t port, sockaddr_in* out) {
   freeaddrinfo(res);
   return true;
 }
+
+void SetRecvTimeoutMs(int fd, long ms) {
+  timeval tv{ms / 1000, static_cast<suseconds_t>((ms % 1000) * 1000)};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+void PutU64(std::string* s, uint64_t v) {
+  char b[8];
+  memcpy(b, &v, 8);
+  s->append(b, 8);
+}
+
+uint64_t GetU64(const std::string& s, size_t off) {
+  uint64_t v = 0;
+  if (s.size() >= off + 8) memcpy(&v, s.data() + off, 8);
+  return v;
+}
+
+// Frame wire format on the channel: [u64 seq][payload].
+std::string SeqFrame(uint64_t seq, const std::string& payload) {
+  std::string out;
+  out.reserve(8 + payload.size());
+  PutU64(&out, seq);
+  out += payload;
+  return out;
+}
+
+constexpr size_t kHelloSize = 20;  // u32 rank + u64 gathers + u64 bcasts
+
 }  // namespace
 
+// -------------------------------------------------------------------- chaos
+ChaosInjector::ChaosInjector(int rank) {
+  long target = EnvLong("HOROVOD_CHAOS_TCP_RANK", -1);
+  close_after_ = EnvLong("HOROVOD_CHAOS_TCP_CLOSE_AFTER", 0);
+  close_rate_ = EnvDouble("HOROVOD_CHAOS_TCP_CLOSE_RATE", 0.0);
+  drop_rate_ = EnvDouble("HOROVOD_CHAOS_TCP_DROP_RATE", 0.0);
+  dup_rate_ = EnvDouble("HOROVOD_CHAOS_TCP_DUP_RATE", 0.0);
+  delay_rate_ = EnvDouble("HOROVOD_CHAOS_TCP_DELAY_RATE", 0.0);
+  delay_ms_ = static_cast<int>(EnvLong("HOROVOD_CHAOS_TCP_DELAY_MS", 0));
+  bool any = close_after_ > 0 || close_rate_ > 0 || drop_rate_ > 0 ||
+             dup_rate_ > 0 || delay_rate_ > 0;
+  bool targeted = target < 0 || target == rank;
+  enabled_ = any && targeted;
+  // Golden-ratio mix so every rank draws an independent stream from one
+  // job-wide seed (same scheme the Python injector uses).
+  uint64_t seed = static_cast<uint64_t>(EnvLong("HOROVOD_CHAOS_SEED", 0));
+  rng_.seed(seed ^ (0x9E3779B97F4A7C15ull * (rank + 1)));
+}
+
+ChaosInjector::Action ChaosInjector::Next() {
+  if (!enabled_) return Action::kNone;
+  op_index_++;
+  if (close_after_ > 0 &&
+      op_index_ == static_cast<uint64_t>(close_after_))
+    return Action::kClose;
+  std::uniform_real_distribution<double> u(0.0, 1.0);
+  double x = u(rng_);
+  if (x < close_rate_) return Action::kClose;
+  x -= close_rate_;
+  if (x < drop_rate_) return Action::kDrop;
+  x -= drop_rate_;
+  if (x < dup_rate_) return Action::kDup;
+  x -= dup_rate_;
+  if (x < delay_rate_) return Action::kDelay;
+  return Action::kNone;
+}
+
+// ----------------------------------------------------------------------- tcp
 TcpTransport::TcpTransport(int rank, int size, const std::string& addr,
                            int port, int timeout_ms)
-    : rank_(rank), size_(size) {
+    : rank_(rank), size_(size), coord_addr_(addr), coord_port_(port),
+      chaos_(rank) {
+  max_retries_ =
+      static_cast<int>(EnvLong("HOROVOD_CONTROLLER_RETRIES", 5));
+  backoff_base_ms_ =
+      static_cast<int>(EnvLong("HOROVOD_CONTROLLER_RETRY_BACKOFF_MS", 50));
+  jitter_rng_.seed(
+      static_cast<uint64_t>(EnvLong("HOROVOD_CHAOS_SEED", 1)) ^
+      (0xD1B54A32D192ED03ull * (rank + 1)));
   if (size <= 1) { ok_ = true; return; }
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
@@ -102,6 +192,7 @@ TcpTransport::TcpTransport(int rank, int size, const std::string& addr,
       return;
     if (listen(listen_fd_, size) != 0) return;
     worker_fds_.assign(size, -1);
+    gathers_from_.assign(size, 0);
     int connected = 0;
     while (connected < size - 1) {
       // bounded accept: a worker that never shows up must fail rank 0's
@@ -114,44 +205,23 @@ TcpTransport::TcpTransport(int rank, int size, const std::string& addr,
       if (pr <= 0) return;
       int fd = accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) return;
-      int one2 = 1;
-      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
-      // first frame from each worker is its rank; a stray connection
-      // (port scanner, liveness probe, stale worker) is discarded rather
-      // than failing the whole bring-up.  Bound the hello read so a silent
-      // stray socket can't eat the bring-up budget.
-      timeval tv{2, 0};
-      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-      std::string hello;
-      int r = -1;
-      if (RecvFrame(fd, &hello) && hello.size() == 4)
-        memcpy(&r, hello.data(), 4);
-      if (r <= 0 || r >= size || worker_fds_[r] != -1) {
+      int got = -1;
+      if (!ResyncAccepted(fd, &got)) continue;  // stray: discarded inside
+      if (worker_fds_[got] != -1) {  // duplicate hello for a live rank
         close(fd);
         continue;
       }
-      timeval tv0{0, 0};  // back to blocking for the cycle protocol
-      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv0, sizeof(tv0));
-      worker_fds_[r] = fd;
+      worker_fds_[got] = fd;
       connected++;
     }
     ok_ = true;
   } else {
-    sockaddr_in sa{};
-    if (!ResolveIPv4(addr, static_cast<uint16_t>(port), &sa)) return;
+    if (WorkerHandshake()) { ok_ = true; return; }
+    // Initial bring-up keeps the legacy behavior: retry plain connects
+    // until the overall deadline, not just max_retries_ attempts.
     while (std::chrono::steady_clock::now() < deadline) {
-      coord_fd_ = socket(AF_INET, SOCK_STREAM, 0);
-      if (connect(coord_fd_, reinterpret_cast<sockaddr*>(&sa),
-                  sizeof(sa)) == 0) {
-        int one = 1;
-        setsockopt(coord_fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-        std::string hello(4, '\0');
-        memcpy(&hello[0], &rank_, 4);
-        if (SendFrame(coord_fd_, hello)) { ok_ = true; return; }
-      }
-      close(coord_fd_);
-      coord_fd_ = -1;
       std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      if (WorkerHandshake()) { ok_ = true; return; }
     }
   }
 }
@@ -164,6 +234,7 @@ TcpTransport::~TcpTransport() {
 }
 
 bool TcpTransport::SendFrame(int fd, const std::string& s) {
+  if (fd < 0) return false;
   uint32_t len = static_cast<uint32_t>(s.size());
   char hdr[4];
   memcpy(hdr, &len, 4);
@@ -179,6 +250,7 @@ bool TcpTransport::SendFrame(int fd, const std::string& s) {
 }
 
 bool TcpTransport::RecvFrame(int fd, std::string* s) {
+  if (fd < 0) return false;
   char hdr[4];
   size_t off = 0;
   while (off < 4) {
@@ -199,6 +271,177 @@ bool TcpTransport::RecvFrame(int fd, std::string* s) {
   return true;
 }
 
+// ---------------------------------------------------------------- resilience
+bool TcpTransport::MaybeInject(int* fd, bool* dup) {
+  *dup = false;
+  if (!chaos_.enabled()) return true;
+  switch (chaos_.Next()) {
+    case ChaosInjector::Action::kNone:
+      return true;
+    case ChaosInjector::Action::kDelay:
+      stats_.chaos_faults++;
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(chaos_.delay_ms()));
+      return true;
+    case ChaosInjector::Action::kDup:
+      stats_.chaos_faults++;
+      *dup = true;
+      return true;
+    case ChaosInjector::Action::kClose:
+      stats_.chaos_faults++;
+      // shutdown (not close): the fd number stays valid, the next
+      // send/recv on it fails into the recovery path on BOTH ends.
+      if (*fd >= 0) ::shutdown(*fd, SHUT_RDWR);
+      return true;
+    case ChaosInjector::Action::kDrop:
+      stats_.chaos_faults++;
+      stats_.frames_dropped++;
+      // TCP cannot lose a frame on a live connection; an injected drop
+      // therefore manifests as frame-never-sent + connection break, which
+      // is exactly what the retransmission machinery must absorb.
+      if (*fd >= 0) ::shutdown(*fd, SHUT_RDWR);
+      return false;
+  }
+  return true;
+}
+
+int TcpTransport::ReacceptBudgetMs() const {
+  // Cover the worker's full backoff schedule plus connect/handshake slack.
+  long total = 0, step = backoff_base_ms_;
+  for (int i = 0; i < max_retries_; i++) {
+    total += step;
+    step = std::min<long>(step * 2, 2000);
+  }
+  return static_cast<int>(total) + 3000;
+}
+
+bool TcpTransport::WorkerHandshake() {
+  if (coord_fd_ >= 0) close(coord_fd_);
+  coord_fd_ = -1;
+  sockaddr_in sa{};
+  if (!ResolveIPv4(coord_addr_, static_cast<uint16_t>(coord_port_), &sa))
+    return false;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  if (connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0) {
+    close(fd);
+    return false;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // hello: rank + channel state, so rank 0 can resync this worker.
+  std::string hello(4, '\0');
+  memcpy(&hello[0], &rank_, 4);
+  PutU64(&hello, gathers_sent_);
+  PutU64(&hello, bcasts_seen_);
+  if (!SendFrame(fd, hello)) {
+    close(fd);
+    return false;
+  }
+  // resync-ack: rank 0's count of gather frames it holds from us.  Bounded
+  // read so a half-dead coordinator cannot hang the handshake.
+  SetRecvTimeoutMs(fd, 5000);
+  std::string ack;
+  if (!RecvFrame(fd, &ack) || ack.size() != 8) {
+    close(fd);
+    return false;
+  }
+  SetRecvTimeoutMs(fd, 0);
+  coord_fd_ = fd;
+  uint64_t coord_has = GetU64(ack, 0);
+  if (coord_has < gathers_sent_ && !last_gather_frame_.empty()) {
+    // The break lost our in-flight gather frame; replay it (idempotent:
+    // rank 0 dedups by seq).
+    stats_.frames_resent++;
+    if (!SendFrame(coord_fd_, last_gather_frame_)) return false;
+  }
+  return true;
+}
+
+bool TcpTransport::WorkerReconnect() {
+  long step = backoff_base_ms_;
+  for (int attempt = 0; attempt < max_retries_; attempt++) {
+    // full jitter: sleep U[step/2, step] so reconnect storms decorrelate
+    std::uniform_int_distribution<long> u(step / 2, step);
+    std::this_thread::sleep_for(std::chrono::milliseconds(u(jitter_rng_)));
+    step = std::min<long>(step * 2, 2000);
+    if (WorkerHandshake()) {
+      stats_.reconnects++;
+      return true;
+    }
+  }
+  stats_.reconnect_failures++;
+  return false;
+}
+
+bool TcpTransport::ResyncAccepted(int fd, int* got_rank) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // first frame from each worker is its hello; a stray connection (port
+  // scanner, liveness probe, stale worker) is discarded rather than
+  // failing bring-up.  Bound the read so a silent stray socket cannot
+  // eat the budget.
+  SetRecvTimeoutMs(fd, 2000);
+  std::string hello;
+  int r = -1;
+  if (RecvFrame(fd, &hello) && hello.size() == kHelloSize)
+    memcpy(&r, hello.data(), 4);
+  if (r <= 0 || r >= size_) {
+    close(fd);
+    return false;
+  }
+  uint64_t peer_gathers = GetU64(hello, 4);
+  uint64_t peer_bcasts = GetU64(hello, 12);
+  // resync-ack: how many gather frames of theirs we hold — the worker
+  // replays its pending frame iff we are behind.
+  std::string ack;
+  PutU64(&ack, gathers_from_[r]);
+  if (!SendFrame(fd, ack)) {
+    close(fd);
+    return false;
+  }
+  // The worker missed the latest bcast round: replay it now (lock-step
+  // bounds the gap to one frame; the worker dedups by seq regardless).
+  if (peer_bcasts < bcast_seq_ && !last_bcast_frame_.empty()) {
+    stats_.frames_resent++;
+    if (!SendFrame(fd, last_bcast_frame_)) {
+      close(fd);
+      return false;
+    }
+  }
+  (void)peer_gathers;
+  SetRecvTimeoutMs(fd, 0);
+  *got_rank = r;
+  return true;
+}
+
+bool TcpTransport::ReacceptWorker(int r) {
+  if (worker_fds_[r] >= 0) close(worker_fds_[r]);
+  worker_fds_[r] = -1;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(ReacceptBudgetMs());
+  while (std::chrono::steady_clock::now() < deadline) {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+        deadline - std::chrono::steady_clock::now()).count();
+    pollfd pfd{listen_fd_, POLLIN, 0};
+    int pr = poll(&pfd, 1, static_cast<int>(std::max<long>(left, 1)));
+    if (pr <= 0) break;
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) continue;
+    int got = -1;
+    if (!ResyncAccepted(fd, &got)) continue;
+    // Any reconnecting worker is resynced, not only the one we wait for —
+    // two workers may fail in the same cycle.
+    if (worker_fds_[got] >= 0) close(worker_fds_[got]);
+    worker_fds_[got] = fd;
+    stats_.reconnects++;
+    if (got == r) return true;
+  }
+  stats_.reconnect_failures++;
+  return false;
+}
+
+// -------------------------------------------------------------- collectives
 bool TcpTransport::Gather(const std::string& mine,
                           std::vector<std::string>* all) {
   if (size_ == 1) {
@@ -209,22 +452,72 @@ bool TcpTransport::Gather(const std::string& mine,
     all->assign(size_, "");
     (*all)[0] = mine;
     for (int r = 1; r < size_; r++) {
-      if (!RecvFrame(worker_fds_[r], &(*all)[r])) return false;
+      for (;;) {
+        bool dup = false;
+        MaybeInject(&worker_fds_[r], &dup);  // recv side: delay/close only
+        std::string raw;
+        if (!RecvFrame(worker_fds_[r], &raw)) {
+          if (!ReacceptWorker(r)) return false;
+          continue;
+        }
+        if (raw.size() < 8) return false;  // malformed: protocol error
+        uint64_t seq = GetU64(raw, 0);
+        if (seq <= gathers_from_[r]) continue;  // replayed dup: discard
+        gathers_from_[r] = seq;
+        (*all)[r] = raw.substr(8);
+        break;
+      }
     }
     return true;
   }
-  return SendFrame(coord_fd_, mine);
+  // worker: seq-tag, remember for replay, send with reconnect-on-failure.
+  last_gather_frame_ = SeqFrame(++gathers_sent_, mine);
+  bool dup = false;
+  bool send_it = MaybeInject(&coord_fd_, &dup);
+  if (send_it && SendFrame(coord_fd_, last_gather_frame_)) {
+    if (dup) SendFrame(coord_fd_, last_gather_frame_);  // rank 0 dedups
+    return true;
+  }
+  // Send failed (or the frame was chaos-dropped): the reconnect handshake
+  // replays last_gather_frame_ iff rank 0 does not hold it.
+  return WorkerReconnect();
 }
 
 bool TcpTransport::Bcast(std::string* frame) {
   if (size_ == 1) return true;
   if (rank_ == 0) {
+    last_bcast_frame_ = SeqFrame(++bcast_seq_, *frame);
     for (int r = 1; r < size_; r++) {
-      if (!SendFrame(worker_fds_[r], *frame)) return false;
+      for (;;) {
+        bool dup = false;
+        bool send_it = MaybeInject(&worker_fds_[r], &dup);
+        if (send_it && SendFrame(worker_fds_[r], last_bcast_frame_)) {
+          if (dup)
+            SendFrame(worker_fds_[r], last_bcast_frame_);  // worker dedups
+          break;
+        }
+        // ReacceptWorker's resync replays the frame when the worker
+        // reports it missed this round; retry the plain send otherwise.
+        if (!ReacceptWorker(r)) return false;
+      }
     }
     return true;
   }
-  return RecvFrame(coord_fd_, frame);
+  for (;;) {
+    bool dup = false;
+    MaybeInject(&coord_fd_, &dup);  // recv side: delay/close only
+    std::string raw;
+    if (!RecvFrame(coord_fd_, &raw)) {
+      if (!WorkerReconnect()) return false;
+      continue;
+    }
+    if (raw.size() < 8) return false;
+    uint64_t seq = GetU64(raw, 0);
+    if (seq <= bcasts_seen_) continue;  // replayed dup: discard
+    bcasts_seen_ = seq;
+    *frame = raw.substr(8);
+    return true;
+  }
 }
 
 }  // namespace hvdtpu

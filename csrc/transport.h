@@ -8,16 +8,69 @@
 //   * TcpTransport — zero-dependency sockets: rank 0 listens, workers
 //     connect; length-prefixed frames.  The gloo-rendezvous analog without
 //     the gloo dependency; TPU-VM pods have plain TCP between hosts.
+//
+// Resilience: the TCP channel frames are sequence-tagged and both sides
+// keep the one in-flight frame, so a dropped connection is survivable —
+// the worker reconnects with bounded exponential backoff + jitter, a
+// resync handshake (hello carries {rank, gathers_sent, bcasts_seen})
+// retransmits whatever the break lost, and seq dedup makes every
+// retransmission idempotent.  The lock-step cycle protocol (one gather,
+// one bcast per cycle) bounds the replay window to a single frame per
+// direction.  Fault injection for proving this lives in ChaosInjector,
+// gated on HOROVOD_CHAOS_* env knobs (see common/knobs.py, docs/chaos.md).
 
 #pragma once
 
 #include <condition_variable>
+#include <cstdint>
 #include <mutex>
 #include <queue>
+#include <random>
 #include <string>
 #include <vector>
 
 namespace hvdtpu {
+
+// Fault/retry counters surfaced through hvd_core_metrics (name-keyed
+// lines, the versioning contract of that API).
+struct TransportStats {
+  uint64_t reconnects = 0;           // successful reconnect handshakes
+  uint64_t reconnect_failures = 0;   // retry budget exhausted
+  uint64_t frames_resent = 0;        // gather/bcast frames retransmitted
+  uint64_t frames_dropped = 0;       // chaos-injected frame drops
+  uint64_t chaos_faults = 0;         // total injected faults fired
+};
+
+// Deterministic seeded fault injector for the TCP transport (the csrc
+// half of the chaos plane).  Configured entirely from env so the same
+// knobs reach every rank without touching the C API:
+//   HOROVOD_CHAOS_SEED            base seed (mixed with the rank)
+//   HOROVOD_CHAOS_TCP_RANK        restrict injection to one rank (-1=all)
+//   HOROVOD_CHAOS_TCP_CLOSE_AFTER close before the Nth frame op (one-shot)
+//   HOROVOD_CHAOS_TCP_CLOSE_RATE  per-op probability of a socket close
+//   HOROVOD_CHAOS_TCP_DROP_RATE   per-op probability of frame drop+close
+//   HOROVOD_CHAOS_TCP_DUP_RATE    per-op probability of frame duplication
+//   HOROVOD_CHAOS_TCP_DELAY_RATE  per-op probability of an injected delay
+//   HOROVOD_CHAOS_TCP_DELAY_MS    delay length
+class ChaosInjector {
+ public:
+  enum class Action { kNone, kDelay, kDup, kDrop, kClose };
+
+  explicit ChaosInjector(int rank);
+  bool enabled() const { return enabled_; }
+  int delay_ms() const { return delay_ms_; }
+  // One decision per frame operation; deterministic for a fixed seed.
+  Action Next();
+
+ private:
+  bool enabled_ = false;
+  uint64_t op_index_ = 0;
+  long close_after_ = 0;  // 0 = off; counts frame ops on this rank
+  double close_rate_ = 0.0, drop_rate_ = 0.0, dup_rate_ = 0.0,
+         delay_rate_ = 0.0;
+  int delay_ms_ = 0;
+  std::mt19937_64 rng_;
+};
 
 class Transport {
  public:
@@ -30,6 +83,8 @@ class Transport {
                       std::vector<std::string>* all) = 0;
   // Coordinator sends one frame to every worker; workers receive it.
   virtual bool Bcast(std::string* frame) = 0;
+  // Fault/retry counters; zero for transports without a wire.
+  virtual TransportStats transport_stats() const { return TransportStats(); }
 };
 
 // All ranks share one object; per-rank handles carry the rank id.
@@ -89,16 +144,51 @@ class TcpTransport : public Transport {
   bool Gather(const std::string& mine,
               std::vector<std::string>* all) override;
   bool Bcast(std::string* frame) override;
+  TransportStats transport_stats() const override { return stats_; }
 
  private:
   bool SendFrame(int fd, const std::string& s);
   bool RecvFrame(int fd, std::string* s);
+
+  // --- resilience machinery (see header comment) ---
+  // Chaos hook: one decision per frame op; may shutdown() *fd so the
+  // following send/recv fails into the recovery path.  Returns false when
+  // the frame should be skipped entirely (injected drop), true otherwise.
+  bool MaybeInject(int* fd, bool* dup);
+  int ReacceptBudgetMs() const;
+  // Worker: (re)establish the rank-0 connection and run the resync
+  // handshake; retransmits the pending gather frame when rank 0 lost it.
+  bool WorkerHandshake();
+  bool WorkerReconnect();
+  // Rank 0: accept + resync reconnecting workers until worker r is back.
+  bool ReacceptWorker(int r);
+  bool ResyncAccepted(int fd, int* got_rank);
 
   int rank_, size_;
   bool ok_ = false;
   int listen_fd_ = -1;
   int coord_fd_ = -1;                // worker's socket to rank 0
   std::vector<int> worker_fds_;      // rank 0: index = rank (0 unused)
+
+  // retry policy (env: HOROVOD_CONTROLLER_RETRIES / _RETRY_BACKOFF_MS)
+  int max_retries_ = 5;
+  int backoff_base_ms_ = 50;
+  std::mt19937_64 jitter_rng_;
+
+  // worker-side channel state
+  uint64_t gathers_sent_ = 0;        // seq of the last gather frame sent
+  uint64_t bcasts_seen_ = 0;         // seq of the last bcast frame consumed
+  std::string last_gather_frame_;    // seq-tagged, for retransmission
+  // rank-0 channel state
+  std::vector<uint64_t> gathers_from_;  // per worker: last gather seq seen
+  uint64_t bcast_seq_ = 0;
+  std::string last_bcast_frame_;     // seq-tagged, for resync replay
+
+  std::string coord_addr_;
+  int coord_port_ = 0;
+
+  ChaosInjector chaos_;
+  TransportStats stats_;
 };
 
 }  // namespace hvdtpu

@@ -189,6 +189,9 @@ from .utils import profiler  # noqa: E402
 # hyperparameter search over the native GP (reference:
 # docs/hyperparameter_search.rst's Ray Tune story)
 from . import tune  # noqa: E402
+# deterministic fault injection (hvdrun --chaos; docs/chaos.md) —
+# training loops call hvd.chaos.step(i) to clock scheduled faults
+from . import chaos  # noqa: E402
 
 
 __all__ = [
@@ -212,5 +215,5 @@ __all__ = [
     "start_timeline", "stop_timeline", "profiler", "tune",
     "CheckpointManager", "save_checkpoint", "restore_checkpoint",
     "flash_attention", "run",
-    "__version__", "probe_backend", "metrics_snapshot",
+    "__version__", "probe_backend", "metrics_snapshot", "chaos",
 ]

@@ -1,0 +1,149 @@
+"""Chaos spec: one declarative, seeded description of the faults a run
+injects — the experiment file of the chaos plane (docs/chaos.md).
+
+The reference's fault-tolerance story (elastic recovery, Sergeev & Del
+Balso, arxiv 1802.05799) is only ever exercised by hand-written
+worker-kill tests; this spec makes every failure mode a repeatable,
+CI-checkable experiment.  A spec names WHAT fails (kill / stall /
+kv_blackout / crash_commit events plus native transport faults), WHERE
+(rank), WHEN (step or call count) and under WHICH seed; ``hvdrun
+--chaos spec.yaml`` distributes it through the rendezvous KV so every
+rank injects from the same plan (runner/launch.py), and the per-rank
+:class:`~horovod_tpu.chaos.injector.ChaosInjector` executes it
+deterministically.
+
+YAML shape (both event spellings are accepted)::
+
+    seed: 42
+    state_dir: /tmp/chaos            # one-shot event memory across restarts
+    transport:                       # -> HOROVOD_CHAOS_TCP_* env (csrc)
+      close_after: 5
+      rank: 1
+    events:
+      - kill: {rank: 1, step: 2, exit_code: 1}
+      - stall: {rank: 1, point: negotiate, duration_ms: 30}
+      - kv_blackout: {op: put, count: 2}
+      - crash_commit: {rank: 0, step: 3, point: pre_marker}
+      - {kind: stall, rank: 0, step: 4, duration_ms: 100}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional
+
+EVENT_KINDS = ("kill", "stall", "kv_blackout", "crash_commit")
+
+# spec key -> env knob for the native transport injector (csrc/transport.cc
+# reads these directly; common/knobs.py registers them).
+TRANSPORT_ENV = {
+    "rank": "HOROVOD_CHAOS_TCP_RANK",
+    "close_after": "HOROVOD_CHAOS_TCP_CLOSE_AFTER",
+    "close_rate": "HOROVOD_CHAOS_TCP_CLOSE_RATE",
+    "drop_rate": "HOROVOD_CHAOS_TCP_DROP_RATE",
+    "dup_rate": "HOROVOD_CHAOS_TCP_DUP_RATE",
+    "delay_rate": "HOROVOD_CHAOS_TCP_DELAY_RATE",
+    "delay_ms": "HOROVOD_CHAOS_TCP_DELAY_MS",
+}
+
+
+@dataclasses.dataclass
+class ChaosEvent:
+    kind: str                 # kill | stall | kv_blackout | crash_commit
+    rank: int = -1            # target rank; -1 = every rank
+    step: int = -1            # fire at this step; -1 = every matching call
+    duration_ms: float = 0.0  # stall: sleep length
+    count: int = 0            # kv_blackout: consecutive KV ops to fail
+    exit_code: int = 1        # kill / crash_commit: process exit status
+    point: str = ""           # stall: injection point (e.g. "negotiate");
+                              # crash_commit: pre_marker | pre_manifest
+    op: str = ""              # kv_blackout: put | get | "" (any)
+
+    def matches_rank(self, rank: int) -> bool:
+        return self.rank < 0 or self.rank == rank
+
+    def matches_step(self, step: Optional[int]) -> bool:
+        return self.step < 0 or (step is not None and self.step == step)
+
+
+@dataclasses.dataclass
+class ChaosSpec:
+    seed: int = 0
+    state_dir: str = ""       # one-shot event memory surviving restarts
+    events: List[ChaosEvent] = dataclasses.field(default_factory=list)
+    transport: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def transport_env(self) -> Dict[str, str]:
+        """The HOROVOD_CHAOS_* env block the launcher exports so the
+        native transport injector sees the spec without a C API change."""
+        env = {}
+        for key, value in self.transport.items():
+            env[TRANSPORT_ENV[key]] = str(value)
+        if self.seed:
+            env["HOROVOD_CHAOS_SEED"] = str(self.seed)
+        return env
+
+    def to_json(self) -> str:
+        """Wire format for rendezvous-KV distribution (JSON: workers must
+        not need a YAML parser to join the plan)."""
+        return json.dumps({
+            "seed": self.seed,
+            "state_dir": self.state_dir,
+            "transport": self.transport,
+            "events": [dataclasses.asdict(e) for e in self.events],
+        }, sort_keys=True)
+
+
+def parse_spec(doc: Dict[str, Any]) -> ChaosSpec:
+    """Build + validate a spec from a parsed YAML/JSON document.  Raises
+    ``ValueError`` on unknown kinds/fields so a typo'd experiment fails at
+    launch, not silently at the injection site."""
+    if not isinstance(doc, dict):
+        raise ValueError(f"chaos spec must be a mapping, got {type(doc)}")
+    unknown = set(doc) - {"seed", "state_dir", "events", "transport"}
+    if unknown:
+        raise ValueError(f"chaos spec: unknown top-level keys {sorted(unknown)}")
+    transport = dict(doc.get("transport") or {})
+    bad = set(transport) - set(TRANSPORT_ENV)
+    if bad:
+        raise ValueError(
+            f"chaos spec: unknown transport faults {sorted(bad)} "
+            f"(known: {sorted(TRANSPORT_ENV)})")
+    events: List[ChaosEvent] = []
+    fields = {f.name for f in dataclasses.fields(ChaosEvent)}
+    for i, raw in enumerate(doc.get("events") or []):
+        if not isinstance(raw, dict):
+            raise ValueError(f"chaos spec: event #{i} must be a mapping")
+        if "kind" not in raw and len(raw) == 1:
+            # shorthand: - kill: {rank: 1, step: 2}
+            kind, body = next(iter(raw.items()))
+            raw = dict(body or {}, kind=kind)
+        if raw.get("kind") not in EVENT_KINDS:
+            raise ValueError(
+                f"chaos spec: event #{i} kind {raw.get('kind')!r} not in "
+                f"{EVENT_KINDS}")
+        bad = set(raw) - fields
+        if bad:
+            raise ValueError(
+                f"chaos spec: event #{i} unknown fields {sorted(bad)}")
+        events.append(ChaosEvent(**raw))
+    return ChaosSpec(seed=int(doc.get("seed") or 0),
+                     state_dir=str(doc.get("state_dir") or ""),
+                     events=events, transport=transport)
+
+
+def load_spec(path: str) -> ChaosSpec:
+    """Load a spec file: YAML (launcher side) or JSON (either)."""
+    with open(path) as f:
+        text = f.read()
+    return loads_spec(text)
+
+
+def loads_spec(text: str) -> ChaosSpec:
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        import yaml
+        doc = yaml.safe_load(text)
+    return parse_spec(doc or {})

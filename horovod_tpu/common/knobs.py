@@ -128,6 +128,49 @@ _knob("HOROVOD_CONTROLLER", "auto", str,
       "(reference: HOROVOD_CONTROLLER in {mpi,gloo}, operations.cc:654).")
 _knob("HOROVOD_CONTROLLER_PORT", 29499, int,
       "TCP port of the rank-0 controller listener.")
+_knob("HOROVOD_CONTROLLER_RETRIES", 5, int,
+      "Max reconnect attempts after a controller TCP connection drops "
+      "(exponential backoff + jitter); 0 fails on the first drop. "
+      "Read by the native core (csrc/transport.cc).")
+_knob("HOROVOD_CONTROLLER_RETRY_BACKOFF_MS", 50, int,
+      "Initial controller reconnect backoff in ms (doubles per attempt, "
+      "capped at 2000 ms, jittered).")
+_knob("HOROVOD_KV_RETRIES", 4, int,
+      "Max retries for rendezvous KV writes (slot publish, metrics PUT) "
+      "on transient connection errors, with exponential backoff + jitter.")
+_knob("HOROVOD_KV_RETRY_BACKOFF_MS", 100, int,
+      "Initial rendezvous KV retry backoff in ms (doubles per attempt, "
+      "capped at 2000 ms, jittered).")
+# --- chaos plane (TPU-native; docs/chaos.md — no reference equivalent:
+#     the reference's fault tolerance is only exercised by ad-hoc
+#     worker-kill integration tests) ---
+_knob("HOROVOD_CHAOS", False, _parse_bool,
+      "Enable the chaos plane: workers fetch the fault-injection spec "
+      "from the rendezvous KV (scope 'chaos', published by hvdrun "
+      "--chaos) and install a deterministic per-rank injector.")
+_knob("HOROVOD_CHAOS_SPEC", "", str,
+      "Path to a chaos spec YAML (horovod_tpu/chaos/spec.py); used when "
+      "no rendezvous-distributed spec is available.")
+_knob("HOROVOD_CHAOS_SEED", 0, int,
+      "Base seed of the chaos plane; every rank derives an independent "
+      "deterministic stream from it (native and Python injectors).")
+_knob("HOROVOD_CHAOS_TCP_RANK", -1, int,
+      "Restrict native transport fault injection to this rank (-1=all).")
+_knob("HOROVOD_CHAOS_TCP_CLOSE_AFTER", 0, int,
+      "Close the controller socket before the Nth frame operation "
+      "(one-shot, deterministic; 0 disables).")
+_knob("HOROVOD_CHAOS_TCP_CLOSE_RATE", 0.0, float,
+      "Per-frame-op probability of an injected controller socket close.")
+_knob("HOROVOD_CHAOS_TCP_DROP_RATE", 0.0, float,
+      "Per-frame-op probability of an injected frame drop (+ close: TCP "
+      "cannot lose a frame on a live connection).")
+_knob("HOROVOD_CHAOS_TCP_DUP_RATE", 0.0, float,
+      "Per-frame-op probability of an injected duplicate frame "
+      "(exercises receiver seq dedup).")
+_knob("HOROVOD_CHAOS_TCP_DELAY_RATE", 0.0, float,
+      "Per-frame-op probability of an injected delay.")
+_knob("HOROVOD_CHAOS_TCP_DELAY_MS", 0, int,
+      "Injected transport delay length in milliseconds.")
 _knob("HOROVOD_TF_JOIN", False, _parse_bool,
       "Route the TensorFlow frontend's dense collectives through the "
       "native controller so join() (uneven inputs) works: a joined rank "

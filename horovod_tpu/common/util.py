@@ -58,6 +58,28 @@ def check_num_rank_power_of_2(num_rank: int) -> bool:
     return num_rank > 0 and (num_rank & (num_rank - 1)) == 0
 
 
+def backoff_delays(retries: int, base_ms: float, cap_ms: float = 2000.0,
+                   rng=None) -> List[float]:
+    """Exponential-backoff schedule in SECONDS with jitter: attempt i
+    sleeps U[step/2, step] where step = min(cap, base * 2**i).
+
+    One implementation shared by every retry loop that talks to a peer
+    (rendezvous KV writes in ``runner/http_client.py``; the native
+    transport mirrors the same schedule in ``csrc/transport.cc``), so the
+    chaos suite can assert sequencing once.  ``rng`` (a
+    ``random.Random``) makes the jitter deterministic for tests; the
+    module-global stream is used otherwise."""
+    import random as _random
+    rng = rng or _random
+    out: List[float] = []
+    step = float(base_ms)
+    for _ in range(max(0, retries)):
+        step_c = min(step, float(cap_ms))
+        out.append(rng.uniform(step_c / 2.0, step_c) / 1000.0)
+        step *= 2.0
+    return out
+
+
 def split_list(items: Sequence, num_parts: int) -> List[list]:
     """Split into ``num_parts`` nearly-equal contiguous chunks
     (reference: util.py split_list, used by grouped allreduce)."""

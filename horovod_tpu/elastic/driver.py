@@ -307,4 +307,9 @@ def run_elastic(args, command: List[str]) -> int:
         prefix_output_with_timestamp=getattr(
             args, "prefix_output_with_timestamp", False),
         metrics_port=getattr(args, "metrics_port", None))
+    # Chaos plane: the spec rides the driver's rendezvous KV so every
+    # incarnation of every worker (reset rounds included) installs the
+    # same seeded plan (runner/launch.py publish_chaos_spec).
+    from ..runner.launch import publish_chaos_spec
+    publish_chaos_spec(args, driver.rendezvous)
     return driver.run()

@@ -39,6 +39,14 @@ import numpy as np
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
+def _chaos_crash(point: str, step: int) -> None:
+    """Chaos crash hooks at the durability protocol's exact weak spots
+    (between data and marker): a crash_commit event hard-exits here, and
+    the restore path must never see the torn step (docs/chaos.md)."""
+    from .. import chaos
+    chaos.crash_point(point, step)
+
+
 def _open_in_step_dir(d: str, path: str):
     """open(path, 'wb') that survives a peer racing the directory away:
     a sibling host's purge/GC may rmdir a just-created (still empty)
@@ -175,6 +183,7 @@ class FastCommitStore:
             if self.fsync:
                 os.fsync(fd)
         os.replace(tmp, data_path)
+        _chaos_crash("fastcommit.pre_manifest", step)
 
         man_path = os.path.join(d, f"host_{self._proc}.manifest")
         with open(man_path + ".tmp", "wb") as f:
@@ -182,6 +191,7 @@ class FastCommitStore:
             if self.fsync:
                 os.fsync(f.fileno())
         os.replace(man_path + ".tmp", man_path)
+        _chaos_crash("fastcommit.pre_marker", step)
         # The marker is what restore trusts; everything above is invisible
         # until it exists.
         marker = os.path.join(d, f"COMMIT_{self._proc}")

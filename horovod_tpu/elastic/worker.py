@@ -90,7 +90,13 @@ def run(func: Callable) -> Callable:
     """
     @functools.wraps(func)
     def wrapper(state, *args: Any, **kwargs: Any):
+        from .. import chaos as _chaos
         from .. import runtime as _rt
+        # Chaos plane: make sure this rank's injector exists even when the
+        # wrapped fn runs before hvd.init() (spec distributed by the
+        # elastic driver's rendezvous; see docs/chaos.md).  Training loops
+        # call hvd.chaos.step(i) to give kill/stall events a step clock.
+        _chaos.ensure_installed()
         notifier = WorkerNotificationManager()
         state.register_host_update_check(notifier.host_updated)
         reset_limit = Knobs()["HOROVOD_ELASTIC_RESET_LIMIT"]

@@ -124,6 +124,11 @@ class SyncNegotiator:
     def run(self, name: str, sig: str, op_type: int, nbytes: int,
             execute: Callable[[], Any], timeout_s: float = 300.0) -> Any:
         """Submit + pump until this op's negotiated slot runs it."""
+        # Chaos straggler hook: a stall event with point "negotiate"
+        # slows every negotiated op on the target rank, dragging its
+        # negotiation ages up so the straggler report names it.
+        from .. import chaos as _chaos
+        _chaos.maybe_stall("negotiate")
         core = self._core()
         with self._lock:
             self._pending[name] = execute

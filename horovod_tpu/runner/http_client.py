@@ -1,6 +1,16 @@
 """Tiny HTTP KV client for the rendezvous server (reference:
 horovod/runner/http/http_client.py:1-45: read_data_from_kvstore /
-put_data_into_kvstore)."""
+put_data_into_kvstore).
+
+Writers retry: a one-shot PUT meant a single transient connection refusal
+during slot publish or a metrics PUT killed the worker, while the
+wait-loop reader already rode outages out.  Both sides now use the shared
+bounded exponential-backoff-with-jitter schedule
+(``common/util.backoff_delays``; knobs ``HOROVOD_KV_RETRIES`` /
+``HOROVOD_KV_RETRY_BACKOFF_MS``).  The chaos plane's KV blackout fault
+injects here (docs/chaos.md), which is what proves the budget is neither
+decorative nor unbounded.
+"""
 
 from __future__ import annotations
 
@@ -10,12 +20,46 @@ import urllib.request
 from typing import Optional
 
 
+def _chaos_kv(op: str) -> None:
+    # Lazy import: chaos resolves its spec through this module's get_kv.
+    from .. import chaos
+    inj = chaos.active()
+    if inj is not None:
+        inj.maybe_fail_kv(op)
+
+
+def _retry_delays(retries: Optional[int]):
+    from ..common.knobs import current
+    from ..common.util import backoff_delays
+    if retries is None:
+        retries = int(current("HOROVOD_KV_RETRIES"))
+    return backoff_delays(retries, float(current(
+        "HOROVOD_KV_RETRY_BACKOFF_MS")))
+
+
+def _transient(e: Exception) -> bool:
+    """Retryable: connection-level failures and 5xx; a 4xx is a caller
+    bug and must surface immediately."""
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code >= 500
+    return isinstance(e, (urllib.error.URLError, ConnectionError,
+                          TimeoutError))
+
+
 def put_kv(addr: str, port: int, scope: str, key: str,
-           value: bytes) -> None:
+           value: bytes, retries: Optional[int] = None) -> None:
     url = f"http://{addr}:{port}/{scope}/{key}"
-    req = urllib.request.Request(url, data=value, method="PUT")
-    with urllib.request.urlopen(req, timeout=10):
-        pass
+    delays = _retry_delays(retries)
+    for attempt in range(len(delays) + 1):
+        try:
+            _chaos_kv("put")
+            req = urllib.request.Request(url, data=value, method="PUT")
+            with urllib.request.urlopen(req, timeout=10):
+                return
+        except Exception as e:
+            if attempt >= len(delays) or not _transient(e):
+                raise
+            time.sleep(delays[attempt])
 
 
 def get_kv(addr: str, port: int, scope: str, key: str,
@@ -25,7 +69,9 @@ def get_kv(addr: str, port: int, scope: str, key: str,
     launcher to publish slot info).  ``timeout=None`` reads
     HOROVOD_GLOO_TIMEOUT_SECONDS (reference: --gloo-timeout-seconds, the
     knob bounding how long workers wait on the rendezvous); pass 0 for
-    a non-blocking probe."""
+    a non-blocking probe.  Transient connection errors (server restarting,
+    chaos blackout) are retried until the deadline like a 404; at the
+    deadline they RAISE — an unreachable server is not an absent key."""
     if timeout is None:
         from ..common.knobs import current
         timeout = float(current("HOROVOD_GLOO_TIMEOUT_SECONDS"))
@@ -33,6 +79,7 @@ def get_kv(addr: str, port: int, scope: str, key: str,
     deadline = time.time() + timeout
     while True:
         try:
+            _chaos_kv("get")
             with urllib.request.urlopen(url, timeout=10) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
@@ -41,13 +88,26 @@ def get_kv(addr: str, port: int, scope: str, key: str,
             if time.time() >= deadline:
                 return None
             time.sleep(poll_interval)
+        except Exception as e:
+            if not _transient(e) or time.time() >= deadline:
+                raise
+            time.sleep(poll_interval)
 
 
-def delete_kv(addr: str, port: int, scope: str, key: str) -> bool:
+def delete_kv(addr: str, port: int, scope: str, key: str,
+              retries: Optional[int] = None) -> bool:
     url = f"http://{addr}:{port}/{scope}/{key}"
-    req = urllib.request.Request(url, method="DELETE")
-    try:
-        with urllib.request.urlopen(req, timeout=10):
-            return True
-    except urllib.error.HTTPError:
-        return False
+    delays = _retry_delays(retries)
+    for attempt in range(len(delays) + 1):
+        try:
+            _chaos_kv("put")  # a delete is a write for blackout purposes
+            req = urllib.request.Request(url, method="DELETE")
+            with urllib.request.urlopen(req, timeout=10):
+                return True
+        except urllib.error.HTTPError:
+            return False
+        except Exception as e:
+            if attempt >= len(delays) or not _transient(e):
+                raise
+            time.sleep(delays[attempt])
+    return False

@@ -168,6 +168,13 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--config-file", default=None,
                    help="YAML config (reference schema: params/autotune/"
                         "timeline/stall-check sections)")
+    p.add_argument("--chaos", default=None, metavar="SPEC_YAML",
+                   help="deterministic fault-injection spec "
+                        "(horovod_tpu/chaos; docs/chaos.md): validated at "
+                        "launch, published to the rendezvous KV so every "
+                        "rank injects from one seeded plan; transport "
+                        "faults export as HOROVOD_CHAOS_* env for the "
+                        "native core")
     # --- elastic (reference: launch.py:621-670) ---
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
@@ -303,7 +310,32 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_ELASTIC_TIMEOUT"] = str(args.elastic_timeout)
     if args.reset_limit is not None:
         env["HOROVOD_ELASTIC_RESET_LIMIT"] = str(args.reset_limit)
+    if getattr(args, "chaos", None):
+        spec = load_chaos_spec(args)
+        env["HOROVOD_CHAOS"] = "1"
+        env.update(spec.transport_env())
     return env
+
+
+def load_chaos_spec(args: argparse.Namespace):
+    """Parse + validate the --chaos spec once per launch (cached on the
+    args namespace so args_to_env and the KV publish share one parse —
+    a typo'd spec must fail the launch, not a worker mid-run)."""
+    if getattr(args, "_chaos_spec", None) is None:
+        from ..chaos import load_spec
+        args._chaos_spec = load_spec(args.chaos)
+    return args._chaos_spec
+
+
+def publish_chaos_spec(args: argparse.Namespace,
+                       rendezvous: RendezvousServer) -> None:
+    """Put the chaos spec on the rendezvous KV (scope ``chaos``) so every
+    rank — local or ssh-remote — installs its injector from one plan."""
+    if not getattr(args, "chaos", None):
+        return
+    from ..chaos import KV_KEY, KV_SCOPE
+    rendezvous.put(KV_SCOPE, KV_KEY,
+                   load_chaos_spec(args).to_json().encode())
 
 
 def _pump_prefixed(stream, sink, rank: int, close_sink: bool) -> None:
@@ -588,6 +620,7 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
                        ("", "0", "false"))
     rendezvous = RendezvousServer(port=args.metrics_port or 0)
     rdv_port = rendezvous.start()
+    publish_chaos_spec(args, rendezvous)
     for slot in slots:
         rendezvous.put("rank", str(slot.rank),
                        repr(slot.to_env()).encode())

@@ -155,6 +155,13 @@ class Runtime:
                 snapshot_fn=self.metrics_snapshot,
                 interval=self.knobs["HOROVOD_METRICS_INTERVAL"])
 
+        # Chaos plane (chaos/): install this rank's deterministic fault
+        # injector from the rendezvous-distributed spec (hvdrun --chaos)
+        # or a local spec file.  Must precede ensure_core(): the native
+        # transport reads its HOROVOD_CHAOS_* env at construction.
+        from . import chaos as _chaos
+        _chaos.ensure_installed(self.knobs, rank=self._process_index)
+
         # Native core (C++ controller/tensor-queue): negotiates a global
         # execution order for eager multi-process collectives (SPMD paths
         # don't need it — XLA programs are deterministic).  Reference:

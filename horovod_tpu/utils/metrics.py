@@ -258,6 +258,25 @@ CONTROLLER_FUSED_BYTES = REGISTRY.counter(
 CONTROLLER_FILL_RATIO = REGISTRY.gauge(
     "hvd_controller_fusion_fill_ratio",
     "Mean fused-batch bytes / fusion threshold (fusion buffer fill).")
+TRANSPORT_RECONNECTS = REGISTRY.counter(
+    "hvd_transport_reconnects_total",
+    "Controller TCP reconnects that succeeded (resync handshake done).")
+TRANSPORT_RECONNECT_FAILURES = REGISTRY.counter(
+    "hvd_transport_reconnect_failures_total",
+    "Controller TCP reconnect attempts that exhausted the retry budget.")
+TRANSPORT_FRAMES_RESENT = REGISTRY.counter(
+    "hvd_transport_frames_resent_total",
+    "Coordination frames retransmitted after a connection break.")
+TRANSPORT_FRAMES_DROPPED = REGISTRY.counter(
+    "hvd_transport_frames_dropped_total",
+    "Coordination frames dropped by chaos injection.")
+CHAOS_FAULTS_NATIVE = REGISTRY.counter(
+    "hvd_chaos_faults_native_total",
+    "Faults the native transport injector fired (csrc chaos plane).")
+CHAOS_INJECTIONS = REGISTRY.counter(
+    "hvd_chaos_injections_total",
+    "Faults the Python chaos injector fired, by kind "
+    "(kill/stall/kv_blackout/crash_commit).")
 CONTROLLER_CYCLE_TIME = REGISTRY.histogram(
     "hvd_controller_cycle_time_seconds",
     "Controller RunCycle wall time (native power-of-2 µs buckets).")
@@ -340,6 +359,12 @@ def import_core_metrics(native: Dict[str, Any]) -> None:
     CONTROLLER_TENSORS.set_total(c.get("tensors_negotiated", 0))
     CONTROLLER_FUSED_BATCHES.set_total(c.get("fused_batches", 0))
     CONTROLLER_FUSED_BYTES.set_total(c.get("fused_batch_bytes", 0))
+    TRANSPORT_RECONNECTS.set_total(c.get("transport_reconnects", 0))
+    TRANSPORT_RECONNECT_FAILURES.set_total(
+        c.get("transport_reconnect_failures", 0))
+    TRANSPORT_FRAMES_RESENT.set_total(c.get("transport_frames_resent", 0))
+    TRANSPORT_FRAMES_DROPPED.set_total(c.get("transport_frames_dropped", 0))
+    CHAOS_FAULTS_NATIVE.set_total(c.get("chaos_faults_injected", 0))
     batches = c.get("fused_batches", 0)
     threshold = c.get("fusion_threshold_bytes", 0)
     if batches and threshold:
@@ -542,7 +567,7 @@ class MetricsPublisher:
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
 
-    def publish_now(self) -> bool:
+    def publish_now(self, retries: int = 3) -> bool:
         if not (self.addr and self.port):
             return False
         try:
@@ -551,9 +576,23 @@ class MetricsPublisher:
             body = json.dumps(snap).encode()
             url = (f"http://{self.addr}:{self.port}/{self.SCOPE}/"
                    f"rank.{self.rank}")
-            req = urllib.request.Request(url, data=body, method="PUT")
-            with urllib.request.urlopen(req, timeout=5):
-                pass
+            # Bounded retry (stdlib-only by design — see module docstring;
+            # runner/http_client.put_kv carries the canonical schedule): a
+            # transient refusal must not lose the FINAL close() publish,
+            # which is what the straggler report reads.
+            delay = 0.1
+            for attempt in range(retries + 1):
+                try:
+                    req = urllib.request.Request(url, data=body,
+                                                 method="PUT")
+                    with urllib.request.urlopen(req, timeout=5):
+                        pass
+                    return True
+                except Exception:
+                    if attempt >= retries:
+                        raise
+                    time.sleep(delay)
+                    delay = min(delay * 2, 1.0)
             return True
         except Exception:
             return False  # metrics must never take the job down

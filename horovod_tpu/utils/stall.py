@@ -64,6 +64,14 @@ class StallInspector:
             self._pending.setdefault(name, time.monotonic())
 
     def record_complete(self, name: str) -> None:
+        # Chaos straggler hook: a stall event with point "complete" slows
+        # this rank between collective completion and its completion
+        # record — the slow-host straggler mode (late D2H, GC pause).
+        # Peers are NOT dragged along (the collective itself already
+        # finished), so the inflated ages attribute to the injected rank,
+        # which is what the straggler report must name (docs/chaos.md).
+        from .. import chaos
+        chaos.maybe_stall("complete")
         with self._lock:
             submitted = self._pending.pop(name, None)
             self._warned.pop(name, None)

@@ -55,7 +55,7 @@ SUITES = {
     "native-controller": [
         "tests/test_native_core.py", "tests/test_negotiated.py",
         "tests/test_autotune.py", "tests/test_aux.py",
-        "tests/test_metrics.py",
+        "tests/test_metrics.py", "tests/test_chaos.py",
     ],
     "torch": ["tests/test_torch.py"],
     "tensorflow-keras": ["tests/test_tensorflow.py", "tests/test_keras.py"],
@@ -121,6 +121,13 @@ def build_steps():
     steps.append(_step(
         "integration: real launcher np=2/np=4",
         f"{py} -m pytest tests/integration {full}", timeout=45))
+    steps.append(_step(
+        # chaos smoke: the resilience claims as experiments — a 2-process
+        # kill-and-recover dryrun plus the transport/fastcommit/straggler
+        # injections (docs/chaos.md), all CPU-virtual.
+        "chaos: 2-process kill-and-recover smoke",
+        f"{py} -m pytest tests/integration/test_chaos_integration.py {full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=20))
     steps.append(_step(
         "dryrun: 8-chip multichip shardings",
         f'{py} -c "import __graft_entry__ as g; g.dryrun_multichip(8)"',

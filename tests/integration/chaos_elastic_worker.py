@@ -1,0 +1,51 @@
+"""Per-rank worker for the chaos elastic kill-and-recover test.
+
+The chaos-plane version of elastic_worker.py: instead of a hand-rolled
+marker file, the kill comes from the distributed chaos spec — ``kill
+rank 1 at step 2`` with a ``state_dir`` so the event is one-shot across
+incarnations.  Each incarnation brings up the 2-process mesh, verifies
+an allreduce, then runs a step loop clocking ``hvd.chaos.step(i)``.
+First incarnation: rank 1 dies at step 2 (hard exit — the chaos model
+of preemption), the driver blacklists its host and runs a reset round.
+Second incarnation: the fired marker suppresses the kill, the loop
+completes on the rebuilt mesh, and every rank records success.
+"""
+
+import os
+import sys
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main() -> int:
+    out_dir = os.environ["CHAOS_TEST_DIR"]
+    hvd.init()
+    assert hvd.process_size() == 2
+    rt = hvd.runtime.get()
+    assert hvd.chaos.active() is not None, \
+        "chaos injector not installed from the rendezvous spec"
+    positions = rt.local_chip_positions()
+
+    x = np.stack([np.full((2,), float(pos), np.float32)
+                  for pos in positions])
+    out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+    want = float(sum(range(hvd.size())))
+    assert np.allclose(out, want), out
+
+    for step in range(5):
+        hvd.chaos.step(step)  # first incarnation: rank 1 dies at step 2
+        out = np.asarray(hvd.allreduce(x, name=f"step{step}", op=hvd.Sum))
+        assert np.allclose(out, want), (step, out)
+
+    rank = hvd.process_rank()
+    open(os.path.join(out_dir, f"chaos_ok_{rank}"), "w").write("done")
+    print(f"CHAOS-ELASTIC-OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

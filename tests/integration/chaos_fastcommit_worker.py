@@ -1,0 +1,73 @@
+"""Per-rank worker for the chaos torn-commit test.
+
+Proves the fastcommit durability promise at its exact weak spot: the
+chaos spec crashes rank 0 INSIDE ``FastCommitStore.save(step=3)`` —
+after the data blob and manifest land, before the durability marker —
+via the ``fastcommit.pre_marker`` crash point wired into
+``elastic/fastcommit.py``.  The elastic driver restarts everything; the
+second incarnation must see ``latest_step() == 2`` (the torn step 3 is
+invisible AND its leftovers are reaped), restore step 2 bit-exact, and
+then commit forward.  Each rank owns a private store directory — the
+per-host local-disk layout.
+"""
+
+import os
+import sys
+
+import _env_setup  # noqa: F401  (pins jax to CPU before first import)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import chaos  # noqa: E402
+from horovod_tpu.elastic.fastcommit import FastCommitStore  # noqa: E402
+
+
+def tree(step: int):
+    return {"model": {"w": np.full((8,), float(step), np.float32),
+                      "b": np.arange(4, dtype=np.float32) * step}}
+
+
+def main() -> int:
+    base = os.environ["CHAOS_TEST_DIR"]
+    hvd.init()  # gloo CPU collectives need jax.distributed up
+    rank = hvd.process_rank()
+    inj = chaos.active() or chaos.ensure_installed()
+    assert inj is not None, "chaos injector not installed from rendezvous"
+    # The injector's own one-shot marker doubles as the incarnation flag.
+    second = os.path.exists(os.path.join(
+        inj.spec.state_dir, "chaos_fired_0_rank0"))
+
+    store = FastCommitStore(os.path.join(base, f"store_rank{rank}"),
+                            max_to_keep=8)
+    if not second:
+        for step in (1, 2, 3, 4):
+            store.save(step, {"model": tree(step)["model"]})
+        if rank == 0:
+            print("CHAOS-FC-BUG rank 0 survived the injected crash",
+                  flush=True)
+            return 3
+    else:
+        if rank == 0:
+            # The torn step-3 commit must be invisible: marker never
+            # landed, so restore trusts step 2 only.
+            assert store.latest_step() == 2, store.steps()
+            got = store.restore(2, {"model": tree(0)["model"]})
+            assert got is not None, "restore of the last good step failed"
+            for key, want in tree(2)["model"].items():
+                assert np.allclose(np.asarray(got["model"][key]), want), key
+            for step in (3, 4):  # recovery continues past the crash step
+                store.save(step, {"model": tree(step)["model"]})
+            assert store.latest_step() == 4, store.steps()
+        else:
+            for step in (1, 2, 3, 4):
+                store.save(step, {"model": tree(step)["model"]})
+    open(os.path.join(base, f"fc_ok_{rank}_"
+                      f"{'second' if second else 'first'}"),
+         "w").write("done")
+    print(f"CHAOS-FASTCOMMIT-OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

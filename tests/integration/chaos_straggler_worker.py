@@ -1,0 +1,69 @@
+"""Per-rank worker for the chaos straggler-attribution test.
+
+The chaos spec stalls rank 1 for 40 ms at the ``complete`` point — the
+slow-host straggler mode (late D2H, GC pauses): the collective itself
+finishes fleet-wide, then the injected rank alone sits on the result
+before recording completion.  Its OWN negotiation-age histogram inflates
+while its peer's stays flat, so the end-of-run straggler report printed
+by the launcher must name rank 1 — attribution, not just detection.
+Also asserts the chaos fault counters are visible through the public
+``hvd.metrics_snapshot()`` surface (acceptance criterion d).
+"""
+
+import os
+import sys
+import time
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.process_size() == 2
+    rank = hvd.process_rank()
+    assert hvd.chaos.active() is not None, \
+        "chaos injector not installed from the rendezvous spec"
+
+    x = np.full((4,), float(rank + 1), np.float32)
+    # Unnamed warmup: compiles the collective and aligns both processes
+    # at its completion, so the tick clocks below start within ~ms of
+    # each other (spawn/init skew would otherwise masquerade as ages).
+    np.asarray(hvd.allreduce(x, op=hvd.Sum))
+    # Pace steps on absolute wall-clock ticks (all ranks share a host
+    # clock here): a free-running lock-step loop would smear the stall
+    # onto the peer — it blocks in the NEXT collective waiting for the
+    # stalled rank, and both ranks' ages tie.  With slack ticks, the
+    # 40 ms stall fits inside the straggler's own tick and only ITS
+    # submit->complete window inflates — attribution, the point of (d).
+    start = time.monotonic()
+    for i in range(25):
+        deadline = start + i * 0.1
+        now = time.monotonic()
+        if deadline > now:
+            time.sleep(deadline - now)
+        # Named ops feed the stall inspector's submit->complete ages —
+        # the per-rank histogram the straggler report quantizes.
+        out = np.asarray(hvd.allreduce(x, name=f"s{i}", op=hvd.Sum))
+        assert np.allclose(out, 3.0 * hvd.size() / 2), out
+
+    snap = hvd.metrics_snapshot()
+    fams = snap["families"]
+    ages = fams["hvd_negotiation_age_seconds"]
+    assert sum(s["count"] for s in ages["samples"]) >= 25, ages
+    # fault counters ride the same public snapshot (criterion d)
+    chaos_fam = fams["hvd_chaos_injections_total"]
+    fired = {tuple(sorted(s["labels"].items())): s["value"]
+             for s in chaos_fam["samples"]}
+    if rank == 1:
+        assert fired.get((("kind", "stall"),), 0) >= 25, fired
+    assert "hvd_transport_reconnects_total" in fams
+    print(f"CHAOS-STRAGGLER-OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

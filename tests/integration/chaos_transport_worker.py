@@ -1,0 +1,66 @@
+"""Per-rank worker for the transport chaos integration tests.
+
+Drives the native controller directly (no jax mesh — the fault under
+test lives entirely in csrc/transport.cc) through enough negotiated
+rounds that the chaos plane's injected disconnect fires mid-run:
+
+  * default mode: the run must COMPLETE — the worker reconnects with
+    backoff, the resync handshake replays the lost frame, and the
+    fault/retry counters come back through ``hvd_core_metrics``;
+  * CHAOS_EXPECT_FAIL=1 (retry budget 0): the run must FAIL LOUDLY —
+    an ERROR response surfaces, core.healthy() flips false, and the
+    worker exits nonzero so the launcher fails the job.
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+    from horovod_tpu.common.basics import CoordinationCore, OP_ALLREDUCE
+
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    port = int(os.environ["HOROVOD_CONTROLLER_PORT"])
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+    expect_fail = os.environ.get("CHAOS_EXPECT_FAIL") == "1"
+
+    core = CoordinationCore.tcp(rank, size, addr, port, cycle_ms=0.5)
+    failed = False
+    for i in range(12):
+        core.submit(f"t{i}", "f32:8:sum", OP_ALLREDUCE, 32)
+        r = core.wait(30.0)
+        if r is None or r.type == "ERROR":
+            failed = True
+            break
+        assert r.type == "OK" and r.names == [f"t{i}"], (i, r)
+
+    if expect_fail:
+        # Budget exhaustion must be loud: ERROR response + unhealthy core.
+        assert failed, "retry budget 0 should have failed the transport"
+        assert not core.healthy(), "core still healthy after transport loss"
+        print("CHAOS-TRANSPORT-FAILED-LOUDLY", flush=True)
+        core.close()
+        return 1  # the launcher must report a failed job
+
+    assert not failed, "negotiation failed despite reconnect budget"
+    c = core.metrics()["counters"]
+    # The injected disconnect targets rank 1; rank 0 re-accepts.  Both
+    # sides must witness the recovery in their counters.
+    assert c["transport_reconnects"] >= 1, c
+    if rank == int(os.environ.get("HOROVOD_CHAOS_TCP_RANK", -1)):
+        assert c["chaos_faults_injected"] >= 1, c
+        assert c["transport_frames_resent"] >= 0, c
+    assert c["transport_reconnect_failures"] == 0, c
+    print("CHAOS-TRANSPORT-OK", flush=True)
+    core.shutdown()
+    time.sleep(0.3)  # let the shutdown round drain on every rank
+    core.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""Chaos integration tier: the resilience claims as repeatable experiments.
+
+Each test runs real processes under the real launcher with a fault
+injected by the chaos plane (docs/chaos.md) and asserts the RECOVERY,
+not just the fault:
+
+  (a) elastic survives a spec-scheduled rank kill and completes;
+  (b) the native controller rides through an injected TCP disconnect via
+      reconnect — and fails loudly once the retry budget is zero;
+  (c) a crash injected mid-fastcommit never restores a torn commit;
+  (d) an injected straggler is named BY RANK in the end-of-run straggler
+      report, with fault counters visible in hvd.metrics_snapshot().
+"""
+
+import stat
+
+import pytest
+
+from test_multiprocess import run_hvdrun
+
+
+def _write_spec(path, text: str) -> str:
+    path.write_text(text)
+    return str(path)
+
+
+@pytest.mark.integration
+def test_chaos_transport_disconnect_recovers():
+    """(b) recovery half: an injected socket close on rank 1 mid-run is
+    absorbed by reconnect + resync replay; negotiation results stay
+    exact and both ranks report the recovery in their fault counters."""
+    proc = run_hvdrun(
+        "chaos_transport_worker.py",
+        extra_env={"HOROVOD_CHAOS_TCP_CLOSE_AFTER": "6",
+                   "HOROVOD_CHAOS_TCP_RANK": "1",
+                   "HOROVOD_CHAOS_SEED": "7",
+                   "HOROVOD_CONTROLLER_RETRY_BACKOFF_MS": "20"})
+    assert proc.stdout.count("CHAOS-TRANSPORT-OK") >= 2, proc.stdout
+
+
+@pytest.mark.integration
+def test_chaos_transport_retry_budget_exhaustion_fails_loudly():
+    """(b) loud-failure half: with HOROVOD_CONTROLLER_RETRIES=0 the same
+    injected disconnect must surface as a controller ERROR + unhealthy
+    core + nonzero job exit — never a hang or a silent wrong answer."""
+    proc = run_hvdrun(
+        "chaos_transport_worker.py", check=False, timeout=120,
+        extra_env={"HOROVOD_CHAOS_TCP_CLOSE_AFTER": "6",
+                   "HOROVOD_CHAOS_TCP_RANK": "1",
+                   "HOROVOD_CHAOS_SEED": "7",
+                   "HOROVOD_CONTROLLER_RETRIES": "0",
+                   "CHAOS_EXPECT_FAIL": "1"})
+    assert proc.returncode != 0, proc.stdout
+    assert "CHAOS-TRANSPORT-FAILED-LOUDLY" in proc.stdout, \
+        proc.stdout + proc.stderr
+
+
+@pytest.mark.integration
+def test_chaos_elastic_kill_recovers(tmp_path):
+    """(a) a chaos-scheduled kill of rank 1 at step 2 triggers an elastic
+    reset round; the second incarnation (one-shot state_dir suppresses
+    the re-kill) completes on the rebuilt mesh."""
+    disc = tmp_path / "discover.sh"
+    disc.write_text("#!/bin/sh\necho 'localhost:2'\necho '127.0.0.1:2'\n")
+    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+    spec = _write_spec(tmp_path / "chaos.yaml", f"""
+seed: 11
+state_dir: {tmp_path / 'chaos_state'}
+events:
+  - kill: {{rank: 1, step: 2}}
+""")
+    run_hvdrun("chaos_elastic_worker.py",
+               extra_env={"CHAOS_TEST_DIR": str(tmp_path)},
+               launcher_args=["--min-np", "2", "--max-np", "2",
+                              "--host-discovery-script", str(disc),
+                              "--elastic-timeout", "60",
+                              "--chaos", spec])
+    fired = tmp_path / "chaos_state" / "chaos_fired_0_rank1"
+    assert fired.exists(), "chaos kill never fired"
+    assert (tmp_path / "chaos_ok_0").exists()
+    assert (tmp_path / "chaos_ok_1").exists()
+
+
+@pytest.mark.integration
+def test_chaos_fastcommit_crash_never_restores_torn_commit(tmp_path):
+    """(c) rank 0 crashes between data and marker of the step-3 commit;
+    after the elastic restart the torn step is invisible, step 2 restores
+    bit-exact, and committing continues past the crash step."""
+    disc = tmp_path / "discover.sh"
+    disc.write_text("#!/bin/sh\necho 'localhost:2'\necho '127.0.0.1:2'\n")
+    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+    spec = _write_spec(tmp_path / "chaos.yaml", f"""
+seed: 13
+state_dir: {tmp_path / 'chaos_state'}
+events:
+  - crash_commit: {{rank: 0, step: 3, point: pre_marker}}
+""")
+    proc = run_hvdrun("chaos_fastcommit_worker.py",
+                      extra_env={"CHAOS_TEST_DIR": str(tmp_path),
+                                 "HVD_CPU_CHIPS": "1"},
+                      launcher_args=["--min-np", "2", "--max-np", "2",
+                                     "--host-discovery-script", str(disc),
+                                     "--elastic-timeout", "60",
+                                     "--chaos", spec])
+    assert "CHAOS-FC-BUG" not in proc.stdout, proc.stdout
+    assert (tmp_path / "chaos_state" / "chaos_fired_0_rank0").exists(), \
+        "chaos crash never fired"
+    assert (tmp_path / "fc_ok_0_second").exists()
+    assert (tmp_path / "fc_ok_1_second").exists()
+
+
+@pytest.mark.integration
+def test_chaos_straggler_named_in_report(tmp_path):
+    """(d) a 40 ms completion-side stall injected on rank 1 inflates that
+    rank's own negotiation ages; the launcher's end-of-run straggler
+    report must NAME rank 1 (attribution, not just detection)."""
+    spec = _write_spec(tmp_path / "chaos.yaml", """
+seed: 17
+events:
+  - stall: {rank: 1, point: complete, duration_ms: 40}
+""")
+    proc = run_hvdrun(
+        "chaos_straggler_worker.py",
+        extra_env={"HVD_CPU_CHIPS": "1",
+                   "HOROVOD_METRICS": "1",
+                   "HOROVOD_METRICS_INTERVAL": "0.3"},
+        launcher_args=["--chaos", spec])
+    assert proc.stdout.count("CHAOS-STRAGGLER-OK") >= 2, proc.stdout
+    out = proc.stdout + proc.stderr
+    assert "straggler report" in out, out[-4000:]
+    assert "slowest: rank 1" in out, out[-4000:]

@@ -1,0 +1,339 @@
+"""Fast tier for the chaos plane (no subprocess fan-out; the
+multi-process proofs live in tests/integration/test_chaos_integration.py):
+
+  * spec parsing — YAML/JSON, both event spellings, validation errors,
+    transport->env mapping;
+  * schedule determinism — fixed seed => identical per-rank decision
+    streams, different ranks => independent streams (the same
+    golden-ratio mix csrc/transport.cc applies);
+  * reconnect/backoff sequencing — the shared exponential+jitter
+    schedule both the KV client and the native transport follow;
+  * KV writer retry — put_kv rides out transient refusals and injected
+    blackouts, surfaces non-transient errors immediately;
+  * injector event semantics — kill/stall/crash_commit firing, one-shot
+    state_dir memory across incarnations;
+  * hvd_core_metrics round-trip — the native fault/retry counters come
+    back through the versioned metrics block, zero on a clean loopback
+    core and nonzero across a real chaos-injected TCP reconnect.
+"""
+
+import multiprocessing
+import os
+import random
+import time
+import urllib.error
+
+import pytest
+
+from horovod_tpu import chaos
+from horovod_tpu.chaos.injector import ChaosInjector, rank_stream_seed
+from horovod_tpu.common.util import backoff_delays
+
+
+# ----------------------------------------------------------------- spec
+def test_spec_yaml_both_event_spellings(tmp_path):
+    p = tmp_path / "spec.yaml"
+    p.write_text("""
+seed: 42
+state_dir: /tmp/x
+transport:
+  close_after: 5
+  rank: 1
+events:
+  - kill: {rank: 1, step: 2, exit_code: 3}
+  - {kind: stall, rank: 0, point: complete, duration_ms: 25}
+""")
+    spec = chaos.load_spec(str(p))
+    assert spec.seed == 42 and spec.state_dir == "/tmp/x"
+    assert [e.kind for e in spec.events] == ["kill", "stall"]
+    assert spec.events[0].rank == 1 and spec.events[0].exit_code == 3
+    assert spec.events[1].point == "complete"
+    env = spec.transport_env()
+    assert env["HOROVOD_CHAOS_TCP_CLOSE_AFTER"] == "5"
+    assert env["HOROVOD_CHAOS_TCP_RANK"] == "1"
+    assert env["HOROVOD_CHAOS_SEED"] == "42"
+    # every exported env var is a registered knob (the pipeline golden
+    # test enforces the same property on CI steps)
+    from horovod_tpu.common.knobs import KNOBS
+    assert set(env) <= set(KNOBS)
+
+
+def test_spec_json_roundtrip():
+    spec = chaos.parse_spec({
+        "seed": 9, "transport": {"dup_rate": 0.5},
+        "events": [{"kind": "kv_blackout", "op": "put", "count": 2}]})
+    again = chaos.loads_spec(spec.to_json())
+    assert again.events == spec.events
+    assert again.transport == spec.transport and again.seed == spec.seed
+
+
+@pytest.mark.parametrize("doc,msg", [
+    ({"events": [{"kind": "explode"}]}, "kind"),
+    ({"events": [{"kill": {"rank": 0}, "stall": {}}]}, "kind"),
+    ({"transport": {"nuke_rate": 1.0}}, "transport"),
+    ({"events": [{"kind": "kill", "blast_radius": 2}]}, "unknown fields"),
+    ({"chaos": True}, "top-level"),
+])
+def test_spec_validation_fails_loudly(doc, msg):
+    with pytest.raises(ValueError, match=msg):
+        chaos.parse_spec(doc)
+
+
+def test_ensure_installed_from_spec_file(tmp_path, monkeypatch):
+    p = tmp_path / "spec.yaml"
+    p.write_text("seed: 5\nevents:\n  - stall: {duration_ms: 1}\n")
+    monkeypatch.setenv("HOROVOD_CHAOS_SPEC", str(p))
+    monkeypatch.setenv("HOROVOD_RANK", "3")
+    chaos.uninstall()
+    try:
+        inj = chaos.ensure_installed()
+        assert inj is not None and inj.rank == 3
+        assert inj.spec.seed == 5
+        assert chaos.active() is inj
+    finally:
+        chaos.uninstall()
+
+
+def test_ensure_installed_from_rendezvous_kv(monkeypatch):
+    from horovod_tpu.runner.http_server import RendezvousServer
+    spec = chaos.parse_spec({"seed": 21, "events": [
+        {"kind": "stall", "rank": 0, "point": "x", "duration_ms": 1}]})
+    server = RendezvousServer()
+    port = server.start()
+    server.put(chaos.KV_SCOPE, chaos.KV_KEY, spec.to_json().encode())
+    monkeypatch.setenv("HOROVOD_CHAOS", "1")
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT", str(port))
+    monkeypatch.setenv("HOROVOD_RANK", "1")
+    chaos.uninstall()
+    try:
+        inj = chaos.ensure_installed()
+        assert inj is not None and inj.spec.seed == 21 and inj.rank == 1
+    finally:
+        chaos.uninstall()
+        server.stop()
+
+
+# ----------------------------------------------------------- determinism
+def test_rank_streams_deterministic_and_independent():
+    spec = chaos.parse_spec({"seed": 1234})
+    a1 = ChaosInjector(spec, rank=0).rng
+    a2 = ChaosInjector(spec, rank=0).rng
+    b = ChaosInjector(spec, rank=1).rng
+    seq_a1 = [a1.random() for _ in range(32)]
+    seq_a2 = [a2.random() for _ in range(32)]
+    seq_b = [b.random() for _ in range(32)]
+    assert seq_a1 == seq_a2          # same seed+rank => same schedule
+    assert seq_a1 != seq_b           # ranks draw independent streams
+    # the mix matches what csrc/transport.cc applies to HOROVOD_CHAOS_SEED
+    assert rank_stream_seed(1234, 0) == \
+        (1234 ^ (0x9E3779B97F4A7C15 * 1)) & 0xFFFFFFFFFFFFFFFF
+
+
+# -------------------------------------------------------------- backoff
+def test_backoff_schedule_sequencing():
+    rng = random.Random(7)
+    delays = backoff_delays(6, base_ms=50, cap_ms=2000, rng=rng)
+    assert len(delays) == 6
+    step = 50.0
+    for d in delays:
+        capped = min(step, 2000.0)
+        assert capped / 2000.0 <= d <= capped / 1000.0  # U[step/2, step]
+        step *= 2
+    # deterministic under a fixed rng seed
+    assert delays == backoff_delays(6, 50, 2000, rng=random.Random(7))
+    assert backoff_delays(0, 50) == []
+
+
+# ------------------------------------------------------------- KV retry
+def _flaky_urlopen(failures, exc=None):
+    calls = {"n": 0}
+
+    def fake(req, timeout=None):
+        calls["n"] += 1
+        if calls["n"] <= failures:
+            raise exc or urllib.error.URLError("connection refused")
+
+        class Resp:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def read(self):
+                return b"ok"
+        return Resp()
+    return fake, calls
+
+
+def test_put_kv_retries_transient_refusal(monkeypatch):
+    import horovod_tpu.runner.http_client as hc
+    fake, calls = _flaky_urlopen(2)
+    monkeypatch.setattr(hc.urllib.request, "urlopen", fake)
+    monkeypatch.setattr(hc.time, "sleep", lambda s: None)
+    hc.put_kv("127.0.0.1", 1, "s", "k", b"v", retries=3)
+    assert calls["n"] == 3  # 2 failures + 1 success
+
+
+def test_put_kv_budget_exhaustion_raises(monkeypatch):
+    import horovod_tpu.runner.http_client as hc
+    fake, calls = _flaky_urlopen(99)
+    monkeypatch.setattr(hc.urllib.request, "urlopen", fake)
+    monkeypatch.setattr(hc.time, "sleep", lambda s: None)
+    with pytest.raises(urllib.error.URLError):
+        hc.put_kv("127.0.0.1", 1, "s", "k", b"v", retries=2)
+    assert calls["n"] == 3  # initial + 2 retries, then surface
+
+
+def test_put_kv_client_error_not_retried(monkeypatch):
+    import horovod_tpu.runner.http_client as hc
+    fake, calls = _flaky_urlopen(
+        99, exc=urllib.error.HTTPError("u", 403, "forbidden", {}, None))
+    monkeypatch.setattr(hc.urllib.request, "urlopen", fake)
+    with pytest.raises(urllib.error.HTTPError):
+        hc.put_kv("127.0.0.1", 1, "s", "k", b"v", retries=5)
+    assert calls["n"] == 1  # a 4xx is a caller bug: no retry
+
+
+def test_put_kv_rides_out_injected_blackout():
+    """Blackout (2 ops) < retry budget (3): the writer must survive —
+    the interaction the chaos plane exists to prove."""
+    from horovod_tpu.runner.http_client import get_kv, put_kv
+    from horovod_tpu.runner.http_server import RendezvousServer
+    spec = chaos.parse_spec({"events": [
+        {"kind": "kv_blackout", "op": "put", "count": 2}]})
+    server = RendezvousServer()
+    port = server.start()
+    chaos.install(spec, rank=0)
+    try:
+        put_kv("127.0.0.1", port, "s", "k", b"v", retries=3)
+        assert get_kv("127.0.0.1", port, "s", "k", timeout=2) == b"v"
+    finally:
+        chaos.uninstall()
+        server.stop()
+
+
+# ------------------------------------------------------ injector events
+def _raise_exit(code):
+    raise SystemExit(code)
+
+
+def test_kill_fires_at_step_for_matching_rank():
+    spec = chaos.parse_spec({"events": [
+        {"kind": "kill", "rank": 1, "step": 2, "exit_code": 9}]})
+    inj = ChaosInjector(spec, rank=1, exit_fn=_raise_exit)
+    inj.on_step(0)
+    inj.on_step(1)
+    with pytest.raises(SystemExit) as e:
+        inj.on_step(2)
+    assert e.value.code == 9
+    ChaosInjector(spec, rank=0, exit_fn=_raise_exit).on_step(2)  # no-op
+
+
+def test_stall_points_and_step_stalls():
+    spec = chaos.parse_spec({"events": [
+        {"kind": "stall", "rank": 0, "point": "negotiate",
+         "duration_ms": 70},
+        {"kind": "stall", "rank": 0, "step": 4, "duration_ms": 30}]})
+    slept = []
+    inj = ChaosInjector(spec, rank=0, sleep_fn=slept.append)
+    inj.maybe_stall("negotiate")
+    inj.maybe_stall("other")       # point mismatch: nothing
+    inj.on_step(3)                 # step mismatch: nothing
+    inj.on_step(4)
+    assert slept == [0.07, 0.03]
+    ChaosInjector(spec, rank=1, sleep_fn=slept.append).maybe_stall(
+        "negotiate")               # rank mismatch: nothing
+    assert slept == [0.07, 0.03]
+
+
+def test_crash_commit_one_shot_across_incarnations(tmp_path):
+    spec = chaos.parse_spec({
+        "state_dir": str(tmp_path),
+        "events": [{"kind": "crash_commit", "rank": 0, "step": 3}]})
+    inj = ChaosInjector(spec, rank=0, exit_fn=_raise_exit)
+    inj.crash_point("fastcommit.pre_marker", 2)   # wrong step: no fire
+    inj.crash_point("fastcommit.pre_manifest", 3)  # wrong point: no fire
+    with pytest.raises(SystemExit):
+        inj.crash_point("fastcommit.pre_marker", 3)
+    # the restarted incarnation sees the fired marker and must NOT crash
+    again = ChaosInjector(spec, rank=0, exit_fn=_raise_exit)
+    again.crash_point("fastcommit.pre_marker", 3)
+
+
+# --------------------------------------------- native counter round-trip
+def test_loopback_core_metrics_carry_fault_counters():
+    """A clean loopback core reports the transport/chaos counters as
+    present-and-zero — absence would mean the name-keyed metrics contract
+    lost the families, zero means no phantom faults."""
+    from horovod_tpu.common.basics import CoordinationCore, LoopbackHub
+    hub = LoopbackHub(1)
+    core = CoordinationCore.loopback(hub, 0, cycle_ms=0.2)
+    try:
+        c = core.metrics()["counters"]
+        for key in ("transport_reconnects", "transport_reconnect_failures",
+                    "transport_frames_resent", "transport_frames_dropped",
+                    "chaos_faults_injected"):
+            assert c.get(key) == 0, (key, c)
+    finally:
+        core.shutdown()
+        core.close()
+        hub.close()
+
+
+def _tcp_chaos_worker(rank, port, results):
+    from horovod_tpu.common.basics import CoordinationCore, OP_ALLREDUCE
+    core = CoordinationCore.tcp(rank, 2, "127.0.0.1", port, cycle_ms=0.5)
+    for i in range(10):
+        core.submit(f"t{i}", "f32:8:sum", OP_ALLREDUCE, 32)
+        r = core.wait(20.0)
+        assert r is not None and r.type == "OK", (rank, i, r)
+    c = core.metrics()["counters"]
+    results[rank] = {k: v for k, v in c.items()
+                     if k.startswith(("transport_", "chaos_"))}
+    core.shutdown()
+    time.sleep(0.3)
+    core.close()
+
+
+def test_tcp_fault_counters_roundtrip_through_core_metrics():
+    """Two real processes, an injected disconnect on rank 1: negotiation
+    completes via reconnect and BOTH ranks' hvd_core_metrics blocks carry
+    the recovery (reconnects/resends on the worker, re-accept on rank 0)."""
+    env = {"HOROVOD_CHAOS_TCP_CLOSE_AFTER": "4",
+           "HOROVOD_CHAOS_TCP_RANK": "1",
+           "HOROVOD_CHAOS_SEED": "3",
+           "HOROVOD_CONTROLLER_RETRY_BACKOFF_MS": "20"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        ctx = multiprocessing.get_context("spawn")
+        mgr = ctx.Manager()
+        results = mgr.dict()
+        procs = [ctx.Process(target=_tcp_chaos_worker,
+                             args=(r, 29521, results)) for r in range(2)]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join(timeout=60)
+            assert not p.is_alive(), "tcp chaos worker hung"
+            assert p.exitcode == 0
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    assert results[1]["chaos_faults_injected"] >= 1, dict(results)
+    assert results[1]["transport_reconnects"] >= 1, dict(results)
+    assert results[0]["transport_reconnects"] >= 1, dict(results)
+    for r in (0, 1):
+        assert results[r]["transport_reconnect_failures"] == 0
+
+
+def test_python_chaos_counter_reaches_registry():
+    from horovod_tpu.utils import metrics as M
+    before = M.CHAOS_INJECTIONS.value(kind="stall")
+    spec = chaos.parse_spec({"events": [
+        {"kind": "stall", "rank": 0, "point": "p", "duration_ms": 0}]})
+    ChaosInjector(spec, rank=0, sleep_fn=lambda s: None).maybe_stall("p")
+    assert M.CHAOS_INJECTIONS.value(kind="stall") == before + 1
