@@ -369,4 +369,29 @@ double hvd_pm_best_score(void* h) {
   return static_cast<ParameterManager*>(h)->best_score();
 }
 
+// Standalone arm bandit (the wire-policy dimension of autotune: arms are
+// wire policies, deterministic UCB1, no RNG — see optim.h ArmBandit).
+void* hvd_bandit_create(int arms, int steps_per_sample, int max_pulls,
+                        double explore) {
+  return new ArmBandit(arms, steps_per_sample, max_pulls,
+                       explore > 0 ? explore : 0.5);
+}
+void hvd_bandit_destroy(void* h) { delete static_cast<ArmBandit*>(h); }
+// Returns 1 when the active arm changed (or the bandit finalized);
+// out3 = arm, done, pulls.
+int hvd_bandit_update(void* h, double score, double* out3) {
+  ArmBandit* b = static_cast<ArmBandit*>(h);
+  int changed = b->Update(score) ? 1 : 0;
+  out3[0] = b->arm();
+  out3[1] = b->done() ? 1 : 0;
+  out3[2] = static_cast<double>(b->pulls());
+  return changed;
+}
+int hvd_bandit_best_arm(void* h) {
+  return static_cast<ArmBandit*>(h)->best_arm();
+}
+double hvd_bandit_best_mean(void* h) {
+  return static_cast<ArmBandit*>(h)->best_mean();
+}
+
 }  // extern "C"

@@ -190,6 +190,84 @@ std::vector<double> BayesianOptimizer::NextSample(int candidates,
   return best;
 }
 
+// --------------------------------------------------------------- arm bandit
+ArmBandit::ArmBandit(int arms, int steps_per_sample, int max_pulls,
+                     double explore)
+    : arms_(arms > 0 ? arms : 1),
+      steps_per_sample_(steps_per_sample > 0 ? steps_per_sample : 1),
+      max_pulls_(max_pulls > 0 ? max_pulls : 4 * (arms > 0 ? arms : 1)),
+      explore_(explore),
+      mean_(arms_, 0.0),
+      count_(arms_, 0) {
+  if (arms_ == 1) done_ = true;  // nothing to choose
+}
+
+int ArmBandit::NextArm() const {
+  // Round-robin until every arm has one pull, then UCB1 on means
+  // normalized by the best mean (scores are unbounded bytes/sec; UCB1's
+  // [0,1] assumption is recovered by the normalization).
+  for (int i = 0; i < arms_; i++) {
+    if (count_[i] == 0) return i;
+  }
+  double top = 1e-300;
+  for (int i = 0; i < arms_; i++) top = std::max(top, mean_[i]);
+  int best = 0;
+  double best_ucb = -1e300;
+  for (int i = 0; i < arms_; i++) {
+    double ucb = mean_[i] / top +
+                 explore_ * std::sqrt(2.0 * std::log(static_cast<double>(
+                                          pulls_ + 1)) /
+                                      count_[i]);
+    if (ucb > best_ucb) {  // strict: ties keep the lower index
+      best_ucb = ucb;
+      best = i;
+    }
+  }
+  return best;
+}
+
+bool ArmBandit::Update(double score) {
+  if (done_) return false;
+  sample_score_ += score;
+  if (++steps_in_sample_ < steps_per_sample_) return false;
+
+  double pull_score = sample_score_ / steps_in_sample_;
+  count_[arm_]++;
+  mean_[arm_] += (pull_score - mean_[arm_]) / count_[arm_];
+  pulls_++;
+  steps_in_sample_ = 0;
+  sample_score_ = 0.0;
+
+  if (static_cast<int>(pulls_) >= max_pulls_) {
+    Finalize();
+    return true;
+  }
+  int next = NextArm();
+  bool changed = next != arm_;
+  arm_ = next;
+  return changed;
+}
+
+void ArmBandit::Finalize() {
+  arm_ = best_arm();
+  done_ = true;
+}
+
+int ArmBandit::best_arm() const {
+  int best = 0;
+  for (int i = 1; i < arms_; i++) {
+    // Unpulled arms never beat observed ones; ties keep the lower index.
+    if (count_[i] > 0 && (count_[best] == 0 || mean_[i] > mean_[best]))
+      best = i;
+  }
+  return best;
+}
+
+double ArmBandit::best_mean() const {
+  int b = best_arm();
+  return count_[b] > 0 ? mean_[b] : 0.0;
+}
+
 // ------------------------------------------------------------ param manager
 ParameterManager::ParameterManager(int64_t initial_threshold,
                                    double initial_cycle_ms,

@@ -97,6 +97,50 @@ class BayesianOptimizer {
   double best_y_ = -1e300;
 };
 
+// Deterministic UCB1 bandit over K discrete arms (the wire-policy
+// dimension of autotune: arms are wire policies, scores are effective
+// bytes/sec).  The continuous knobs (threshold, cycle) stay on the GP —
+// a GP over a categorical axis would have to one-hot it and its RBF
+// kernel would see unrelated policies as "near"; a bandit treats them
+// as what they are.  No RNG: ties break toward the lower arm index, so
+// replays and multi-process broadcasts can never diverge.
+class ArmBandit {
+ public:
+  // steps_per_sample: steps aggregated into one pull's score (matches
+  // the ParameterManager's sample cadence); max_pulls: total pulls
+  // before freezing at the best-mean arm.
+  ArmBandit(int arms, int steps_per_sample = 10, int max_pulls = 0,
+            double explore = 0.5);
+
+  // Record one step's score for the current arm.  Returns true when the
+  // active arm changed (caller re-reads arm()) or the bandit finalized.
+  bool Update(double score);
+
+  // Freeze at the best observed mean arm.
+  void Finalize();
+
+  int arm() const { return arm_; }
+  bool done() const { return done_; }
+  int best_arm() const;
+  double best_mean() const;
+  size_t pulls() const { return pulls_; }
+
+ private:
+  int NextArm() const;
+
+  int arms_;
+  int steps_per_sample_;
+  int max_pulls_;
+  double explore_;
+  int arm_ = 0;
+  bool done_ = false;
+  size_t pulls_ = 0;
+  int steps_in_sample_ = 0;
+  double sample_score_ = 0.0;
+  std::vector<double> mean_;   // running mean score per arm
+  std::vector<int> count_;     // pulls per arm
+};
+
 // Autotuner for the runtime knobs (reference: parameter_manager.{h,cc}:
 // tunes fusion threshold bytes + cycle time ms, scoring bytes/sec, with
 // warmup discard and multi-cycle samples).

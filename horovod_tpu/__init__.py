@@ -37,8 +37,10 @@ from .ops.collectives import (allreduce, allreduce_async, grouped_allreduce,
                               process_allgather, process_local, Handle)
 from .ops.compression import Compression
 from .ops import spmd
+from .ops import wire
 from .optimizer import (DistributedOptimizer, distributed_optimizer,
-                        sync_gradients, distributed_grad)
+                        sync_gradients, sync_gradients_ef,
+                        wire_residual_report, distributed_grad)
 from .functions import (broadcast_parameters, broadcast_optimizer_state,
                         broadcast_object, allgather_object)
 from .checkpoint import (CheckpointManager, save_checkpoint,
@@ -203,6 +205,7 @@ __all__ = [
     "alltoall", "reducescatter", "barrier", "synchronize", "poll",
     "process_allgather", "process_local", "Handle",
     "DistributedOptimizer", "distributed_optimizer", "sync_gradients",
+    "sync_gradients_ef", "wire_residual_report", "wire",
     "distributed_grad",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",

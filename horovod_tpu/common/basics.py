@@ -144,6 +144,15 @@ def load_library() -> ctypes.CDLL:
                                       ctypes.c_double, dptr]
         lib.hvd_pm_best_score.restype = ctypes.c_double
         lib.hvd_pm_best_score.argtypes = [ctypes.c_void_p]
+        lib.hvd_bandit_create.restype = ctypes.c_void_p
+        lib.hvd_bandit_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_int, ctypes.c_double]
+        lib.hvd_bandit_destroy.argtypes = [ctypes.c_void_p]
+        lib.hvd_bandit_update.argtypes = [ctypes.c_void_p, ctypes.c_double,
+                                          dptr]
+        lib.hvd_bandit_best_arm.argtypes = [ctypes.c_void_p]
+        lib.hvd_bandit_best_mean.restype = ctypes.c_double
+        lib.hvd_bandit_best_mean.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -249,6 +258,46 @@ class NativeParameterManager:
     def __del__(self):
         if getattr(self, "_h", None):
             self._lib.hvd_pm_destroy(self._h)
+            self._h = None
+
+
+class NativeArmBandit:
+    """Deterministic UCB1 bandit over K discrete arms (csrc/optim.cc
+    ArmBandit) — the wire-policy dimension of autotune: arms are wire
+    policies, scores are effective bytes/sec.  No RNG, ties break toward
+    the lower arm index, so every process that replays the same score
+    stream lands on the same arm."""
+
+    def __init__(self, arms: int, steps_per_sample: int = 10,
+                 max_pulls: int = 0, explore: float = 0.5):
+        self._lib = load_library()
+        self._h = self._lib.hvd_bandit_create(arms, steps_per_sample,
+                                              max_pulls, explore)
+        self.arms = arms
+        self.arm = 0
+        self.done = arms <= 1
+        self.pulls = 0
+
+    def update(self, score: float) -> bool:
+        """Record one step's score; True when the active arm changed."""
+        out = (ctypes.c_double * 3)()
+        changed = self._lib.hvd_bandit_update(self._h, float(score), out)
+        self.arm = int(out[0])
+        self.done = bool(out[1])
+        self.pulls = int(out[2])
+        return bool(changed)
+
+    @property
+    def best_arm(self) -> int:
+        return self._lib.hvd_bandit_best_arm(self._h)
+
+    @property
+    def best_mean(self) -> float:
+        return self._lib.hvd_bandit_best_mean(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.hvd_bandit_destroy(self._h)
             self._h = None
 
 

@@ -53,6 +53,19 @@ _knob("HOROVOD_HIERARCHICAL_ALLREDUCE", False, _parse_bool,
       "allgather over ICI.")
 _knob("HOROVOD_HIERARCHICAL_ALLGATHER", False, _parse_bool,
       "Force two-level allgather across the DCN axis.")
+# --- wire-policy plane (TPU-native; docs/tensor-fusion.md — the reference
+#     stops at a single global fp16-compression flag) ---
+_knob("HOROVOD_WIRE_POLICY", "none", str,
+      "Per-bucket wire format for the fused SPMD gradient sync "
+      "(ops/wire.py): 'none', 'bf16', 'fp16', 'int8_ring', 'dcn_int8' "
+      "apply one format to every bucket; 'auto' picks per bucket by "
+      "(nbytes, dtype, axis topology) and is bandit-tuned online when "
+      "HOROVOD_AUTOTUNE is on.  Unknown names fail at hvd.init().")
+_knob("HOROVOD_WIRE_EF", True, _parse_bool,
+      "Error-feedback residuals for lossy wire formats (EF-SGD): the "
+      "per-bucket quantization/cast error is kept as optimizer state and "
+      "added back into the next step's gradient before compression.  "
+      "Only consulted when a lossy wire policy is active.")
 # --- autotune (reference: common.h:70-75) ---
 _knob("HOROVOD_AUTOTUNE", False, _parse_bool,
       "Enable Bayesian autotuning of fusion threshold and cycle time.")

@@ -76,3 +76,13 @@ class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+
+    @staticmethod
+    def by_name(name: str) -> type[Compressor]:
+        """Wire-format name -> compressor (the cast formats of the
+        wire-policy plane, ops/wire.py)."""
+        try:
+            return {"none": NoneCompressor, "fp16": FP16Compressor,
+                    "bf16": BF16Compressor}[name]
+        except KeyError:
+            raise ValueError(f"no compressor named {name!r}") from None

@@ -175,8 +175,20 @@ def fused_apply(leaves: Sequence[jax.Array],
     ``fn`` receives the flat 1-D bucket buffer and must return a same-shaped
     buffer (e.g. ``lambda b: lax.psum(b, axis)``).
     """
+    return fused_apply_per_bucket(leaves, plan,
+                                  [fn] * plan.num_buckets)
+
+
+def fused_apply_per_bucket(leaves: Sequence[jax.Array],
+                           plan: BucketPlan,
+                           fns: Sequence) -> List[jax.Array]:
+    """Like :func:`fused_apply` with one ``fn`` PER BUCKET — the
+    wire-policy plane (ops/wire.py) reduces each bucket in its own wire
+    format, so the collective differs bucket to bucket."""
+    if len(fns) != plan.num_buckets:
+        raise ValueError(f"{len(fns)} fns for {plan.num_buckets} buckets")
     out: List[Optional[jax.Array]] = [None] * plan.num_leaves
-    for bucket in plan.buckets:
+    for bucket, fn in zip(plan.buckets, fns):
         buf = pack_bucket(leaves, bucket)
         buf = fn(buf)
         unpack_bucket(buf, bucket, out)

@@ -46,6 +46,15 @@ def _dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
     return q.astype(jnp.float32) * scale
 
 
+def int8_roundtrip(x: jax.Array) -> jax.Array:
+    """``dequantize(quantize(x))`` — the one-shot codec model of the int8
+    wire.  The wire-policy plane's error feedback (ops/wire.py) uses
+    ``x - int8_roundtrip(x)`` as the rank-local compensable encode error:
+    exactly the EF-SGD residual ``x - C(x)`` for this quantizer."""
+    q, scale = _quantize(x.astype(jnp.float32))
+    return _dequantize(q, scale).astype(x.dtype)
+
+
 def quantized_ring_allreduce(x: jax.Array, axis_name: AxisName,
                              average: bool = True) -> jax.Array:
     """Allreduce ``x`` over ``axis_name`` with int8 wire traffic.

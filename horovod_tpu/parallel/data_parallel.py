@@ -74,7 +74,9 @@ def make_train_step(loss_fn: Callable,
                     fusion_threshold_bytes: Optional[int] = None,
                     donate: Optional[bool] = None,
                     has_aux: bool = False,
-                    compute_dtype=None) -> Callable:
+                    compute_dtype=None,
+                    wire_policy=None,
+                    error_feedback: Optional[bool] = None) -> Callable:
     """Build ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
 
     ``loss_fn(params, *batch_shard)`` is evaluated per chip on the local
@@ -90,14 +92,17 @@ def make_train_step(loss_fn: Callable,
     HBM — the analog of the reference's persistent fusion buffer residency
     (default: the HOROVOD_TPU_DONATE_BUFFERS knob).  ``axis_name`` may be a
     logical name that resolves to a two-level dcn/ici axis pair on
-    multi-slice meshes (parallel/hierarchical.py).
+    multi-slice meshes (parallel/hierarchical.py).  ``wire_policy`` /
+    ``error_feedback`` select per-bucket wire formats with EF residuals
+    for the gradient sync (ops/wire.py; docs/tensor-fusion.md).
     """
     axis_name = resolve_axis(axis_name, mesh)
     donate = _resolve_donate(donate)
     dist_opt = distributed_optimizer(
         optimizer, axis_name=axis_name, op=op, compression=compression,
         backward_passes_per_step=backward_passes_per_step,
-        fusion_threshold_bytes=fusion_threshold_bytes)
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        wire_policy=wire_policy, error_feedback=error_feedback)
 
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     loss_fn = _compute_cast(loss_fn, compute_dtype)
@@ -154,7 +159,10 @@ def make_scanned_train_step(loss_fn: Callable,
                             donate: Optional[bool] = None,
                             remat: bool = False,
                             compute_dtype=None,
-                            unroll: int = 1) -> Callable:
+                            unroll: int = 1,
+                            wire_policy=None,
+                            error_feedback: Optional[bool] = None
+                            ) -> Callable:
     """Build ``run(params, opt_state, batches) -> (params, opt_state, losses)``
     executing ``batches.shape[0]`` optimizer steps inside ONE compiled program
     via ``lax.scan``.
@@ -179,7 +187,8 @@ def make_scanned_train_step(loss_fn: Callable,
     donate = _resolve_donate(donate)
     dist_opt = distributed_optimizer(
         optimizer, axis_name=axis_name, op=op, compression=compression,
-        fusion_threshold_bytes=fusion_threshold_bytes)
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        wire_policy=wire_policy, error_feedback=error_feedback)
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
 
     fn = _compute_cast(loss_fn, compute_dtype)

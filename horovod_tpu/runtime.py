@@ -123,15 +123,31 @@ class Runtime:
                                      mark_cycles=self.knobs[
                                          "HOROVOD_TIMELINE_MARK_CYCLES"])
 
+        # Wire-policy plane (ops/wire.py): validate HOROVOD_WIRE_POLICY
+        # now — an unknown policy name must fail AT INIT, not as a trace
+        # error deep inside the first compiled step.
+        from .ops.wire import validate_policy_name
+        validate_policy_name(self.knobs["HOROVOD_WIRE_POLICY"])
+
         # Autotune (reference: HOROVOD_AUTOTUNE + ParameterManager,
         # parameter_manager.{h,cc}): Bayesian optimization over (fusion
-        # threshold, cycle time), native math in csrc/optim.cc.
+        # threshold, cycle time), native math in csrc/optim.cc.  When the
+        # wire policy is 'auto', the policy dimension joins the search as
+        # a bandit over policy arms (mesh-aware: dcn_int8 is only an arm
+        # on a two-level mesh).
         self.autotuner = None
         if self.knobs["HOROVOD_AUTOTUNE"]:
             from .utils.autotune import Autotuner
+            policy_arms = None
+            if self.knobs["HOROVOD_WIRE_POLICY"] == "auto":
+                policy_arms = ["auto", "none", "bf16", "int8_ring"]
+                if any(str(a).startswith("dcn.")
+                       for a in self.mesh.axis_names):
+                    policy_arms.append("dcn_int8")
             self.autotuner = Autotuner(self.knobs,
                                        process_rank=self._process_index,
-                                       process_size=self._process_count)
+                                       process_size=self._process_count,
+                                       policy_arms=policy_arms)
 
         self.stall_inspector = None
         if not self.knobs["HOROVOD_STALL_CHECK_DISABLE"]:
@@ -305,6 +321,24 @@ class Runtime:
         if self.autotuner is not None:
             return self.autotuner.fusion_threshold
         return self.knobs["HOROVOD_FUSION_THRESHOLD"]
+
+    def wire_policy(self) -> str:
+        """Live wire-policy name for the fused gradient sync (ops/wire.py).
+
+        Reads the knob via ``current`` (env wins, so tests and launchers
+        can flip it without re-initializing) and, when tuning is on,
+        refines 'auto' to the bandit's current policy arm — which rank 0
+        broadcasts with the threshold, so every process compiles the same
+        SPMD program.  A policy change re-traces, like a threshold change.
+        """
+        from .common.knobs import current
+        from .ops.wire import validate_policy_name
+        name = validate_policy_name(current("HOROVOD_WIRE_POLICY"))
+        if name == "auto" and self.autotuner is not None:
+            arm = self.autotuner.wire_policy
+            if arm is not None:
+                return arm
+        return name
 
     # -------------------------------------------------------------- metrics
     def metrics_snapshot(self) -> Dict[str, Any]:

@@ -42,15 +42,27 @@ from ..common.basics import NativeParameterManager
 
 class Autotuner:
     """Feeds step measurements into the native parameter manager and exposes
-    the live fusion threshold (reference: ParameterManager::Update)."""
+    the live fusion threshold (reference: ParameterManager::Update).
 
-    def __init__(self, knobs, process_rank: int = 0, process_size: int = 1):
+    With ``policy_arms`` (HOROVOD_WIRE_POLICY=auto), the wire-policy
+    dimension joins the search: a deterministic UCB1 bandit
+    (csrc/optim.cc ArmBandit) over the arm names, scored like the GP in
+    effective bytes/sec.  The categorical axis stays OFF the GP — its RBF
+    kernel would invent distances between unrelated policies.  The chosen
+    arm index rides the same rank-0 broadcast as the threshold, so every
+    process compiles identical SPMD programs."""
+
+    def __init__(self, knobs, process_rank: int = 0, process_size: int = 1,
+                 policy_arms=None):
         self._process_rank = process_rank
         self._process_size = process_size
         self._threshold = int(knobs["HOROVOD_FUSION_THRESHOLD"])
         self._cycle_ms = float(knobs["HOROVOD_CYCLE_TIME"])
         self._done = False
         self._pm = None
+        self._arms = tuple(policy_arms) if policy_arms else ()
+        self._policy_arm = 0
+        self._bandit = None
         if process_rank == 0:
             self._pm = NativeParameterManager(
                 initial_threshold=self._threshold,
@@ -59,6 +71,13 @@ class Autotuner:
                 steps_per_sample=knobs["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"],
                 max_samples=knobs["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"],
                 gp_noise=knobs["HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"])
+            if len(self._arms) > 1:
+                from ..common.basics import NativeArmBandit
+                self._bandit = NativeArmBandit(
+                    len(self._arms),
+                    steps_per_sample=knobs[
+                        "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"],
+                    max_pulls=4 * len(self._arms))
         self._log_fh = None
         log_path = knobs["HOROVOD_AUTOTUNE_LOG"]
         if log_path and process_rank == 0:
@@ -85,38 +104,62 @@ class Autotuner:
     def best_score(self) -> float:
         return self._pm.best_score if self._pm is not None else 0.0
 
+    @property
+    def wire_policy(self) -> Optional[str]:
+        """The current wire-policy arm name, or None when the policy
+        dimension is not being tuned (consumed by Runtime.wire_policy)."""
+        if not self._arms:
+            return None
+        return self._arms[self._policy_arm]
+
     def _sync(self) -> None:
-        """Broadcast (threshold, cycle, done) from process 0 so every
-        process plans identical buckets.  No-op single-process."""
+        """Broadcast (threshold, cycle, done, policy arm) from process 0
+        so every process plans identical buckets AND wire formats.
+        No-op single-process."""
         if self._process_size <= 1:
             return
         from jax.experimental import multihost_utils
         vals = multihost_utils.broadcast_one_to_all(
             np.array([self._threshold, self._cycle_ms,
-                      1.0 if self._done else 0.0], np.float64))
+                      1.0 if self._done else 0.0,
+                      float(self._policy_arm)], np.float64))
         self._threshold = int(vals[0])
         self._cycle_ms = float(vals[1])
         self._done = bool(vals[2])
+        self._policy_arm = int(vals[3])
 
     def record(self, nbytes: int, seconds: float) -> bool:
-        """Record one step's traffic; returns True when tunables changed.
-        Collective across processes while tuning is live."""
+        """Record one step's traffic; returns True when tunables changed
+        (threshold, cycle, or wire-policy arm — any of which means the
+        caller should re-trace).  Collective across processes while tuning
+        is live."""
         if self._done:
             return False
         changed = False
         if self._pm is not None:
-            changed = self._pm.update(nbytes, seconds)
-            self._threshold = self._pm.threshold
-            self._cycle_ms = self._pm.cycle_ms
-            self._done = self._pm.done
-            if changed and self._log_fh:
-                self._log_fh.write(
-                    f"{self._threshold},{self._cycle_ms:.3f},"
-                    f"{self._pm.best_score:.1f}\n")
-                self._log_fh.flush()
+            if not self._pm.done:
+                changed = self._pm.update(nbytes, seconds)
+                self._threshold = self._pm.threshold
+                self._cycle_ms = self._pm.cycle_ms
+                if changed and self._log_fh:
+                    self._log_fh.write(
+                        f"{self._threshold},{self._cycle_ms:.3f},"
+                        f"{self._pm.best_score:.1f}\n")
+                    self._log_fh.flush()
+            if self._bandit is not None and not self._bandit.done:
+                # Same score the GP sees: logical payload bytes per second
+                # — a compressed wire moves the same payload faster, so
+                # "effective bytes/sec" rewards the formats that help and
+                # punishes quantize/cast overhead that doesn't pay off.
+                if self._bandit.update(nbytes / max(seconds, 1e-12)):
+                    self._policy_arm = self._bandit.arm
+                    changed = True
+            self._done = self._pm.done and (
+                self._bandit is None or self._bandit.done)
             if changed:
-                log.debug("autotune: threshold=%d cycle=%.2fms done=%s",
-                          self._threshold, self._cycle_ms, self._done)
+                log.debug("autotune: threshold=%d cycle=%.2fms policy=%s "
+                          "done=%s", self._threshold, self._cycle_ms,
+                          self.wire_policy, self._done)
         self._sync()
         return changed
 

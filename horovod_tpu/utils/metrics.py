@@ -303,6 +303,21 @@ PLAN_CACHE_HITS = REGISTRY.counter(
     "hvd_fusion_plan_cache_hits_total", "Bucket-plan cache hits.")
 PLAN_CACHE_MISSES = REGISTRY.counter(
     "hvd_fusion_plan_cache_misses_total", "Bucket-plan cache misses.")
+# Wire-policy plane (ops/wire.py).  Decisions happen at TRACE time (one
+# compiled program syncs the same buckets every step), so these count per
+# trace, like the fusion-planning families above; multiply by steps for
+# volume.  docs/tensor-fusion.md#wire-policies.
+WIRE_BUCKETS = REGISTRY.counter(
+    "hvd_wire_buckets_total",
+    "Fusion buckets routed by the wire-policy plane, by chosen format.")
+WIRE_BYTES_SAVED = REGISTRY.counter(
+    "hvd_wire_bytes_saved_total",
+    "Modeled wire bytes saved per compiled step vs the uncompressed "
+    "format, by chosen format (bottleneck-fabric model, ops/wire.py).")
+WIRE_RESIDUAL_NORM = REGISTRY.gauge(
+    "hvd_wire_residual_norm",
+    "L2 norm of the error-feedback residual, by bucket index (host-side "
+    "report: optimizer.wire_residual_report).")
 
 # Layer 3: runtime (stall inspector + topology).
 RUNTIME_SIZE = REGISTRY.gauge(

@@ -46,6 +46,7 @@ SUITES = {
         "tests/test_optimizer.py", "tests/test_fsdp.py",
         "tests/test_zero.py", "tests/test_adasum.py",
         "tests/test_hierarchical.py", "tests/test_quantized.py",
+        "tests/test_wire.py",
     ],
     "models-kernels": [
         "tests/test_models.py", "tests/test_flash_attention.py",
@@ -87,6 +88,8 @@ KNOB_DIMS = [
     ("streams-4", {"HOROVOD_NUM_STREAMS": "4"},
      ["torch"]),
     ("no-donate", {"HOROVOD_TPU_DONATE_BUFFERS": "0"},
+     ["jax-core"]),
+    ("wire-auto", {"HOROVOD_WIRE_POLICY": "auto"},
      ["jax-core"]),
     ("tf-join", {"HOROVOD_TF_JOIN": "1"},
      ["tensorflow-keras"]),
@@ -137,6 +140,13 @@ def build_steps():
     steps.append(_step(
         "bench: cpu smoke",
         f"{py} bench.py --cpu", timeout=15))
+    steps.append(_step(
+        # wire-policy sweep smoke: every wire format round-trips on the
+        # 8-device virtual mesh, int8 carries <= 1/2 bf16's modeled
+        # bytes, EF residuals and decode determinism asserted
+        # (docs/tensor-fusion.md#wire-policies) — all CPU-virtual.
+        "bench: wire-policy sweep smoke",
+        f"{py} bench.py --wire --cpu", timeout=15))
     steps.append(_step(
         # promtool-check-metrics-style gate, pure Python (no external
         # dep): renders a populated fleet /metrics snapshot through the

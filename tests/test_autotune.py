@@ -189,13 +189,16 @@ def test_tuned_threshold_propagates_to_bucket_planner(hvd, monkeypatch):
     dtypes = [np.dtype(np.float32)] * 6
 
     recorded = {}
-    real_make_plan = make_plan
+    # The SPMD sync path routes through the runtime's BucketPlanCache
+    # (same cache the eager path uses — the hvd_fusion_plan_cache_*
+    # metrics move for both); spy there to see the threshold it plans at.
+    real_get = rt.plan_cache.get
 
     def spy(shapes_, dtypes_, threshold):
         recorded["threshold"] = threshold
-        return real_make_plan(shapes_, dtypes_, threshold)
+        return real_get(shapes_, dtypes_, threshold)
 
-    monkeypatch.setattr("horovod_tpu.optimizer.make_plan", spy)
+    monkeypatch.setattr(rt.plan_cache, "get", spy)
 
     def run():
         def body(*leaves):
@@ -212,6 +215,6 @@ def test_tuned_threshold_propagates_to_bucket_planner(hvd, monkeypatch):
     tuner._threshold = 300
     run()
     assert recorded["threshold"] == 300
-    plan = real_make_plan(shapes, dtypes, 300)
+    plan = make_plan(shapes, dtypes, 300)
     assert all(len(b.indices) == 1 for b in plan.buckets)
     tuner.close()

@@ -82,6 +82,33 @@ def test_bench_autotune_cpu_contract(tmp_path):
     assert len(lines) >= 3
 
 
+@pytest.mark.slow
+def test_bench_wire_cpu_contract():
+    """--wire: the wire-policy sweep artifact (ISSUE 3 acceptance): int8
+    policies at <= 1/2 bf16's (<= 1/4 fp32's) modeled wire bytes on the
+    bucket mix, per-bucket EF residual norms for every lossy policy,
+    decode determinism flagged per policy, 'auto' mixing formats across
+    buckets, and the explicit CPU-virtual labeling."""
+    env = dict(os.environ)
+    env["BENCH_DEADLINE_S"] = "300"
+    rec = _run_bench("--wire", env=env, timeout=400)
+    assert rec["unit"] == "wire_bytes_ratio_int8_vs_fp32"
+    assert "CPU-virtual" in rec["label"]
+    pol = rec["policies"]
+    assert pol["int8_ring"]["wire_bytes_per_step"] * 2 <= \
+        pol["bf16"]["wire_bytes_per_step"]
+    assert pol["int8_ring"]["wire_bytes_per_step"] * 4 <= \
+        pol["none"]["wire_bytes_per_step"]
+    assert all(p["decode_deterministic"] for p in pol.values())
+    for lossy in ("bf16", "fp16", "int8_ring"):
+        assert pol[lossy]["residual_norm"], lossy
+    # auto demonstrably picks per-bucket formats on the mix
+    assert len(pol["auto"]["wire_bytes_by_format"]) >= 2
+    two = rec["two_level"]
+    assert two["dcn_int8"]["dcn_wire_bytes_per_step"] < \
+        two["int8_ring"]["dcn_wire_bytes_per_step"]
+
+
 # ------------------------------------------------- supervisor unit tests
 def _fake_result(rc=0, stdout=""):
     class R:
