@@ -394,4 +394,31 @@ double hvd_bandit_best_mean(void* h) {
   return static_cast<ArmBandit*>(h)->best_mean();
 }
 
+// Factored two-dimensional bandit (wire policy x overlap depth — the
+// overlap plane's autotune dimension, ops/overlap.py; see optim.h
+// ProductBandit).  Same determinism contract as hvd_bandit_*.
+void* hvd_bandit2_create(int arms_a, int arms_b, int steps_per_sample,
+                         int max_pulls, double explore) {
+  return new ProductBandit(arms_a, arms_b, steps_per_sample, max_pulls,
+                           explore > 0 ? explore : 0.5);
+}
+void hvd_bandit2_destroy(void* h) { delete static_cast<ProductBandit*>(h); }
+// Returns 1 when the active pair changed (or the bandit finalized);
+// out4 = arm_a, arm_b, done, pulls.
+int hvd_bandit2_update(void* h, double score, double* out4) {
+  ProductBandit* b = static_cast<ProductBandit*>(h);
+  int changed = b->Update(score) ? 1 : 0;
+  out4[0] = b->arm_a();
+  out4[1] = b->arm_b();
+  out4[2] = b->done() ? 1 : 0;
+  out4[3] = static_cast<double>(b->pulls());
+  return changed;
+}
+int hvd_bandit2_best_a(void* h) {
+  return static_cast<ProductBandit*>(h)->best_a();
+}
+int hvd_bandit2_best_b(void* h) {
+  return static_cast<ProductBandit*>(h)->best_b();
+}
+
 }  // extern "C"

@@ -268,6 +268,15 @@ double ArmBandit::best_mean() const {
   return count_[b] > 0 ? mean_[b] : 0.0;
 }
 
+// ------------------------------------------------------------ product bandit
+ProductBandit::ProductBandit(int arms_a, int arms_b, int steps_per_sample,
+                             int max_pulls, double explore)
+    : arms_b_(arms_b > 0 ? arms_b : 1),
+      inner_((arms_a > 0 ? arms_a : 1) * (arms_b > 0 ? arms_b : 1),
+             steps_per_sample, max_pulls, explore) {}
+
+bool ProductBandit::Update(double score) { return inner_.Update(score); }
+
 // ------------------------------------------------------------ param manager
 ParameterManager::ParameterManager(int64_t initial_threshold,
                                    double initial_cycle_ms,

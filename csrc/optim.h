@@ -141,6 +141,35 @@ class ArmBandit {
   std::vector<int> count_;     // pulls per arm
 };
 
+// Two-dimensional factored bandit: one deterministic UCB1 over the
+// (arms_a x arms_b) product space with per-dimension arm decoding.  The
+// dimensions are autotune's categorical axes — wire policy x overlap
+// pipeline depth (ops/overlap.py) — searched JOINTLY, not per dimension:
+// the best depth depends on the policy (an int8 wire shortens exactly
+// the sync the pipeline is hiding).  Inherits ArmBandit's determinism
+// (no RNG, ties to the lower flat index), so the decoded pair is safe to
+// broadcast with the fusion threshold.
+class ProductBandit {
+ public:
+  ProductBandit(int arms_a, int arms_b, int steps_per_sample = 10,
+                int max_pulls = 0, double explore = 0.5);
+
+  // Record one step's score for the current (a, b) pair.  Returns true
+  // when the active pair changed or the bandit finalized.
+  bool Update(double score);
+
+  int arm_a() const { return inner_.arm() / arms_b_; }
+  int arm_b() const { return inner_.arm() % arms_b_; }
+  bool done() const { return inner_.done(); }
+  size_t pulls() const { return inner_.pulls(); }
+  int best_a() const { return inner_.best_arm() / arms_b_; }
+  int best_b() const { return inner_.best_arm() % arms_b_; }
+
+ private:
+  int arms_b_;
+  ArmBandit inner_;
+};
+
 // Autotuner for the runtime knobs (reference: parameter_manager.{h,cc}:
 // tunes fusion threshold bytes + cycle time ms, scoring bytes/sec, with
 // warmup discard and multi-cycle samples).

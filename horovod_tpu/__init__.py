@@ -38,6 +38,8 @@ from .ops.collectives import (allreduce, allreduce_async, grouped_allreduce,
 from .ops.compression import Compression
 from .ops import spmd
 from .ops import wire
+from .ops import overlap
+from .data.loader import prefetch
 from .optimizer import (DistributedOptimizer, distributed_optimizer,
                         sync_gradients, sync_gradients_ef,
                         wire_residual_report, distributed_grad)
@@ -205,8 +207,8 @@ __all__ = [
     "alltoall", "reducescatter", "barrier", "synchronize", "poll",
     "process_allgather", "process_local", "Handle",
     "DistributedOptimizer", "distributed_optimizer", "sync_gradients",
-    "sync_gradients_ef", "wire_residual_report", "wire",
-    "distributed_grad",
+    "sync_gradients_ef", "wire_residual_report", "wire", "overlap",
+    "prefetch", "distributed_grad",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object",
     "Compression", "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max",

@@ -153,6 +153,15 @@ def load_library() -> ctypes.CDLL:
         lib.hvd_bandit_best_arm.argtypes = [ctypes.c_void_p]
         lib.hvd_bandit_best_mean.restype = ctypes.c_double
         lib.hvd_bandit_best_mean.argtypes = [ctypes.c_void_p]
+        lib.hvd_bandit2_create.restype = ctypes.c_void_p
+        lib.hvd_bandit2_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                           ctypes.c_int, ctypes.c_int,
+                                           ctypes.c_double]
+        lib.hvd_bandit2_destroy.argtypes = [ctypes.c_void_p]
+        lib.hvd_bandit2_update.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_double, dptr]
+        lib.hvd_bandit2_best_a.argtypes = [ctypes.c_void_p]
+        lib.hvd_bandit2_best_b.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -298,6 +307,50 @@ class NativeArmBandit:
     def __del__(self):
         if getattr(self, "_h", None):
             self._lib.hvd_bandit_destroy(self._h)
+            self._h = None
+
+
+class NativeProductBandit:
+    """Deterministic UCB1 over a factored (arms_a x arms_b) space
+    (csrc/optim.cc ProductBandit) — autotune's joint (wire policy,
+    overlap depth) search: one flat bandit over the product, decoded to
+    per-dimension arm indices, so the two categorical axes are searched
+    together (the best depth depends on the policy) with the same
+    no-RNG replay determinism as NativeArmBandit."""
+
+    def __init__(self, arms_a: int, arms_b: int,
+                 steps_per_sample: int = 10, max_pulls: int = 0,
+                 explore: float = 0.5):
+        self._lib = load_library()
+        self._h = self._lib.hvd_bandit2_create(arms_a, arms_b,
+                                               steps_per_sample,
+                                               max_pulls, explore)
+        self.arm_a = 0
+        self.arm_b = 0
+        self.done = arms_a * arms_b <= 1
+        self.pulls = 0
+
+    def update(self, score: float) -> bool:
+        """Record one step's score; True when the active pair changed."""
+        out = (ctypes.c_double * 4)()
+        changed = self._lib.hvd_bandit2_update(self._h, float(score), out)
+        self.arm_a = int(out[0])
+        self.arm_b = int(out[1])
+        self.done = bool(out[2])
+        self.pulls = int(out[3])
+        return bool(changed)
+
+    @property
+    def best_a(self) -> int:
+        return self._lib.hvd_bandit2_best_a(self._h)
+
+    @property
+    def best_b(self) -> int:
+        return self._lib.hvd_bandit2_best_b(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.hvd_bandit2_destroy(self._h)
             self._h = None
 
 

@@ -66,6 +66,25 @@ _knob("HOROVOD_WIRE_EF", True, _parse_bool,
       "per-bucket quantization/cast error is kept as optimizer state and "
       "added back into the next step's gradient before compression.  "
       "Only consulted when a lossy wire policy is active.")
+# --- overlap plane (TPU-native; docs/overlap.md — the reference's analog
+#     is the whole background-thread architecture, which exists to
+#     overlap allreduce with backward compute) ---
+_knob("HOROVOD_OVERLAP", False, _parse_bool,
+      "Enable the overlap plane (ops/overlap.py): with "
+      "backward_passes_per_step > 1 the fused gradient sync of "
+      "microbatch i is issued while microbatch i+1's forward/backward "
+      "computes (software pipeline; numerically a scheduling change "
+      "only).  Validated at hvd.init().")
+_knob("HOROVOD_OVERLAP_DEPTH", 1, int,
+      "Microbatch-pipeline depth: how many in-flight gradient syncs the "
+      "one-slot-per-depth double buffer holds before draining (1 = the "
+      "classic double buffer).  Must be in [1, 8]; rejected at "
+      "hvd.init() otherwise.  Bandit-autotuned when HOROVOD_AUTOTUNE "
+      "and HOROVOD_OVERLAP are both on.")
+_knob("HOROVOD_PREFETCH_DEPTH", 2, int,
+      "Device-prefetch depth of data.loader.prefetch(): how many batches "
+      "are jax.device_put ahead of the step consuming them (2 = double "
+      "buffered).  Must be >= 1; rejected at hvd.init() otherwise.")
 # --- autotune (reference: common.h:70-75) ---
 _knob("HOROVOD_AUTOTUNE", False, _parse_bool,
       "Enable Bayesian autotuning of fusion threshold and cycle time.")

@@ -7,7 +7,7 @@ from .loader import (AsyncDataLoaderMixin, AsyncImageFolderDataLoader,
                      ImageFolderDataLoader, NumpyDataLoader,
                      ParquetDataLoader, ShuffleBufferLoader,
                      StreamingParquetDataLoader,
-                     shard_indices)
+                     prefetch, shard_indices)
 
 __all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "NumpyDataLoader",
            "AsyncNumpyDataLoader", "ParquetDataLoader",
@@ -15,4 +15,4 @@ __all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "NumpyDataLoader",
            "AsyncStreamingParquetDataLoader", "ImageFolderDataLoader",
            "AsyncImageFolderDataLoader", "ShuffleBufferLoader", "BaseFS",
            "LocalFS",
-           "shard_indices"]
+           "prefetch", "shard_indices"]

@@ -125,6 +125,55 @@ class AsyncDataLoaderMixin:
             self.close()
 
 
+def prefetch(iterator, depth: Optional[int] = None,
+             transfer=None) -> Iterator[Any]:
+    """Double-buffered device prefetch: issue the host->device transfer
+    of batch i+1..i+depth while the chips run step i (the overlap
+    plane's input leg, docs/overlap.md — without it the loader hands
+    host arrays straight to the step and every step eats a full H2D
+    transfer on its critical path).
+
+    ``transfer`` maps one host batch to device (default:
+    ``jax.device_put`` of the whole pytree; pass e.g.
+    ``functools.partial(shard_batch, mesh=mesh)`` for sharded inputs).
+    ``depth`` defaults to the HOROVOD_PREFETCH_DEPTH knob (2 = classic
+    double buffer; validated at hvd.init, and >= 1 here for direct
+    callers).  jax transfers are async — ``device_put`` returns
+    immediately and the copy proceeds while the producer iterates — so
+    a depth-deep deque of in-flight transfers is all the machinery
+    needed; the chips never wait on a cold batch unless the host falls
+    ``depth`` batches behind.
+    """
+    if depth is None:
+        from ..common.knobs import current
+        depth = int(current("HOROVOD_PREFETCH_DEPTH"))
+    depth = int(depth)
+    if depth < 1:
+        raise ValueError(
+            f"prefetch depth {depth} invalid; must be >= 1 "
+            "(HOROVOD_PREFETCH_DEPTH, docs/overlap.md)")
+    if transfer is None:
+        import jax
+        transfer = jax.device_put
+
+    import collections
+    queue: "collections.deque" = collections.deque()
+    it = iter(iterator)
+
+    def enqueue(k: int) -> None:
+        for _ in range(k):
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            queue.append(transfer(batch))
+
+    enqueue(depth)
+    while queue:
+        yield queue.popleft()
+        enqueue(1)
+
+
 def shard_indices(n: int, rank: int, num_workers: int,
                   shuffle: bool = False, seed: int = 0) -> np.ndarray:
     """Rank's index shard with wrap-padding so every worker sees the same

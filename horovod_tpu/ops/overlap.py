@@ -1,0 +1,304 @@
+"""Overlap plane: schedule collectives concurrently with compute.
+
+The reference's entire native architecture — background thread, tensor
+queue, cycle-time batching (reference: operations.cc:115 BackgroundThread,
+horovod/common/controller.cc RunLoopOnce) — exists for ONE reason: to
+overlap allreduce with backward compute (Sergeev & Del Balso,
+arXiv:1802.05799 §3).  On TPU there is no background thread; the program
+IS the schedule, so overlap must be restructured into the traced step.
+This module owns that restructuring at three levels:
+
+  * **Microbatch pipelining** (:func:`make_pipelined_transform`): with
+    ``backward_passes_per_step = k > 1`` the classic path accumulates k
+    microbatch gradients and syncs once at the end — the allreduce sits
+    fully exposed after the last backward.  The pipelined path holds a
+    ``depth``-slot ring buffer of unsynced gradients: the fused sync of
+    microbatch *i* is issued in the same program region as microbatch
+    *i + depth*'s forward/backward, where XLA's latency-hiding scheduler
+    can run them concurrently, and a final flush drains the buffer before
+    the optimizer update.  Strictly a SCHEDULING change: the same
+    per-microbatch syncs run in the same order on the same values, so the
+    result is bit-near the unpipelined issue order (tests/test_overlap.py
+    asserts it per wire format, EF on and off).
+  * **Bucket-interleaved ZeRO-1** (:func:`priority_order`, consumed by
+    parallel/zero.py): the monolithic flat-vector RS -> shard-update ->
+    AG chain becomes a per-fusion-bucket pipeline, bucket *b*'s sharded
+    update overlapping bucket *b+1*'s in-flight reduce_scatter, with
+    issue order reversed (last buckets first — the Horovod convention of
+    negotiating tensors in reverse registration order, and
+    ByteScheduler's priority ordering, arXiv — PAPERS.md) so the
+    next step's first-needed parameters finish gathering earliest.
+  * **Observability + autotuning**: the ``hvd_overlap_*`` gauges record
+    the analytical exposed-vs-overlapped byte split per trace
+    (:func:`record_overlap`), and the pipeline depth joins the autotune
+    search as a bandit arm dimension (utils/autotune.py, csrc/optim.cc
+    ProductBandit) broadcast with the fusion threshold so every rank
+    compiles the same SPMD program.
+
+CPU-virtual caveat: on the host-device test harness the "overlap" is a
+program-order restructure only — wall-clock wins need a real TPU, whose
+XLA scheduler hides collective latency behind compute (docs/overlap.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils import metrics as _metrics
+
+# The env knob's legal range; kwargs additionally accept depth 0 (the
+# sequential issue order of the same per-microbatch syncs — the reference
+# schedule the equivalence tests pin the pipeline against).
+MAX_OVERLAP_DEPTH = 8
+
+
+def validate_overlap_knobs(knobs) -> None:
+    """Fail loudly AT INIT on invalid overlap/prefetch knob values
+    (consumed by hvd.init, the HOROVOD_WIRE_POLICY validation pattern) —
+    a bad depth must not surface as a trace error deep inside the first
+    compiled step."""
+    depth = int(knobs["HOROVOD_OVERLAP_DEPTH"])
+    if not 1 <= depth <= MAX_OVERLAP_DEPTH:
+        raise ValueError(
+            f"HOROVOD_OVERLAP_DEPTH={depth} invalid; the pipeline depth "
+            f"must be in [1, {MAX_OVERLAP_DEPTH}] (docs/overlap.md)")
+    pre = int(knobs["HOROVOD_PREFETCH_DEPTH"])
+    if pre < 1:
+        raise ValueError(
+            f"HOROVOD_PREFETCH_DEPTH={pre} invalid; the device-prefetch "
+            "depth must be >= 1 (docs/overlap.md)")
+
+
+def overlap_enabled(overlap: Optional[bool] = None) -> bool:
+    """Kwarg wins; the HOROVOD_OVERLAP knob (env-live via ``current``)
+    decides otherwise — so ``HOROVOD_OVERLAP=1`` alone activates the
+    pipeline for ``backward_passes_per_step > 1`` users with zero code
+    changes (the state restructure is safe there: k > 1 state always
+    comes from the wrapper's own ``init``, never the inner optimizer's).
+    """
+    if overlap is not None:
+        return bool(overlap)
+    from ..common.knobs import current
+    return bool(current("HOROVOD_OVERLAP"))
+
+
+def resolve_depth(depth: Optional[int] = None) -> int:
+    """Live pipeline depth: kwarg > tuned bandit arm > knob.  Kwarg 0 is
+    the sequential reference schedule; the env knob is clamped to
+    [1, MAX_OVERLAP_DEPTH] at hvd.init."""
+    if depth is None:
+        from .. import runtime as _rt
+        if _rt.is_initialized():
+            depth = _rt.get().overlap_depth()
+        else:
+            from ..common.knobs import current
+            depth = int(current("HOROVOD_OVERLAP_DEPTH"))
+    depth = int(depth)
+    if not 0 <= depth <= MAX_OVERLAP_DEPTH:
+        raise ValueError(
+            f"overlap depth {depth} out of range [0, {MAX_OVERLAP_DEPTH}]")
+    return depth
+
+
+# ------------------------------------------------------- priority ordering
+def priority_order(plan) -> Tuple[int, ...]:
+    """Bucket ISSUE order for the interleaved ZeRO-1 pipeline: reversed
+    plan order (last buckets first).  Backprop produces the last layers'
+    gradients first and the reference negotiates tensors in reverse
+    registration order for exactly this reason; issuing the tail buckets'
+    reduce_scatter first means the head buckets — whose parameters the
+    next forward consumes first — run their all_gather at the END of the
+    pipeline, freshly resident when step N+1 begins.  Deterministic (a
+    pure function of the plan) and therefore plan-cache-keyed: identical
+    (shapes, dtypes, threshold) signatures reuse both the plan and its
+    order."""
+    return tuple(reversed(range(plan.num_buckets)))
+
+
+# ----------------------------------------------------------- byte model
+def record_overlap(total_bytes: float, exposed_bytes: float,
+                   plane: str) -> dict:
+    """Publish one trace's analytical overlap split to the
+    ``hvd_overlap_{exposed_bytes,overlapped_fraction}`` gauges.  A
+    *model*, not a measurement (like the wire-byte model, ops/wire.py):
+    bytes are modeled payload traffic, 'exposed' means issued with no
+    concurrent compute to hide behind."""
+    frac = 0.0
+    if total_bytes > 0:
+        frac = max(0.0, min(1.0, 1.0 - exposed_bytes / total_bytes))
+    _metrics.OVERLAP_EXPOSED_BYTES.set(exposed_bytes, plane=plane)
+    _metrics.OVERLAP_FRACTION.set(frac, plane=plane)
+    return {"total_bytes": total_bytes, "exposed_bytes": exposed_bytes,
+            "overlapped_fraction": frac}
+
+
+def microbatch_overlap_model(leaves, axis_name, k: int,
+                             depth: int) -> dict:
+    """Analytical exposed/overlapped split of the microbatch pipeline:
+    each of the k per-microbatch syncs moves the same modeled payload;
+    the ``max(0, k - depth)`` syncs drained while a later microbatch's
+    backward runs count as overlapped, the final flush (and everything,
+    at depth 0) as exposed.  Runs at trace time, like plan_formats."""
+    from ..common.reduce_op import ReduceOp
+    from . import wire as _wire
+    from .fusion import make_plan
+
+    shapes = [tuple(l.shape) for l in leaves]
+    dtypes = [l.dtype for l in leaves]
+    from .. import runtime as _rt
+    threshold = (_rt.get().fusion_threshold() if _rt.is_initialized()
+                 else 128 * 1024 * 1024)
+    plan = make_plan(shapes, dtypes, threshold)
+    sizes = _wire._axis_sizes(axis_name)
+    per_sync = 0.0
+    for b in plan.buckets:
+        per_sync += _wire.modeled_wire_bytes(
+            sum(b.sizes), jnp.dtype(b.dtype).itemsize, "none",
+            sizes)["bottleneck"]
+    overlapped = max(0, k - depth) if depth >= 1 else 0
+    total = k * per_sync
+    exposed = (k - overlapped) * per_sync
+    return record_overlap(total, exposed, plane="microbatch")
+
+
+# ------------------------------------------------------ pipelined transform
+class _OverlapState(NamedTuple):
+    """Optimizer state of the microbatch-pipelined sync path: the core
+    state (inner optimizer, or _WireState when error feedback is on), the
+    microbatch counter, the running sum of already-synced microbatch
+    gradients, and the depth-slot ring buffer of gradients whose sync has
+    not been issued yet (None at depth 0)."""
+    inner: Any
+    counter: jax.Array
+    synced: Any
+    pending: Any
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _tree_zeros(t):
+    return jax.tree_util.tree_map(jnp.zeros_like, t)
+
+
+def make_pipelined_transform(core_init: Callable,
+                             sync_fn: Callable,
+                             apply_fn: Callable,
+                             k: int,
+                             depth: int,
+                             on_trace: Optional[Callable] = None):
+    """Build the pipelined ``backward_passes_per_step=k`` optax transform
+    (consumed by optimizer.distributed_optimizer when the overlap plane
+    is on).
+
+    ``sync_fn(grads, core_state) -> (synced, core_state)`` issues ONE
+    microbatch's fused sync (threading EF residuals through the core
+    state when error feedback is on); ``apply_fn(mean, core_state,
+    params, **extra) -> (updates, core_state)`` runs the inner optimizer
+    only.  Call *i* of a cycle stashes its gradients in slot ``i % depth``
+    and issues the sync of the gradients stashed ``depth`` calls ago — so
+    inside a ``lax.scan`` over microbatches (or an unrolled loop in one
+    jit) the sync of microbatch *i* sits in the program region of
+    microbatch *i+depth*'s forward/backward, with no data dependence
+    between them: exactly what a latency-hiding scheduler needs.  The
+    final call drains the buffer (oldest first), restoring the one global
+    sync order 0..k-1 — which is why every depth (including 0, the
+    unbuffered sequential schedule) computes bit-near identical results.
+    """
+    import optax
+
+    if k < 2:
+        raise ValueError("the microbatch pipeline needs "
+                         f"backward_passes_per_step >= 2 (got {k})")
+    d = min(int(depth), k - 1)  # depth >= k would never drain in-loop
+
+    def init_fn(params):
+        pending = None
+        if d > 0:
+            pending = jax.tree_util.tree_map(
+                lambda z: jnp.zeros((d,) + z.shape, z.dtype), params)
+        return _OverlapState(inner=core_init(params),
+                             counter=jnp.zeros((), jnp.int32),
+                             synced=_tree_zeros(params),
+                             pending=pending)
+
+    def update_fn(grads, state: _OverlapState, params=None, **extra):
+        if on_trace is not None:
+            on_trace(grads, k, d)
+        pos = state.counter % k
+        is_final = (pos + 1) == k
+        tmap = jax.tree_util.tree_map
+
+        if d == 0:
+            # Sequential reference schedule: sync immediately, in call
+            # order.  Same math as every pipelined depth; nothing is
+            # buffered, nothing overlaps.
+            s, inner = sync_fn(grads, state.inner)
+            acc = _tree_add(state.synced, s)
+
+            def apply_now(op):
+                acc, inner = op
+                mean = tmap(lambda a: a / k, acc)
+                updates, inner = apply_fn(mean, inner, params, **extra)
+                return updates, inner, _tree_zeros(acc)
+
+            def carry(op):
+                acc, inner = op
+                return _tree_zeros(grads), inner, acc
+
+            updates, inner, acc = lax.cond(is_final, apply_now, carry,
+                                           (acc, inner))
+            return updates, _OverlapState(inner, state.counter + 1, acc,
+                                          None)
+
+        slot = pos % d
+        oldest = tmap(
+            lambda p: lax.dynamic_index_in_dim(p, slot, keepdims=False),
+            state.pending)
+
+        # Drain the sync of the microbatch stashed d calls ago — the
+        # issue point that interleaves with THIS microbatch's compute.
+        def drain(op):
+            oldest, inner, synced = op
+            s, inner = sync_fn(oldest, inner)
+            return _tree_add(synced, s), inner
+
+        def hold(op):
+            _, inner, synced = op
+            return synced, inner
+
+        synced, inner = lax.cond(pos >= d, drain, hold,
+                                 (oldest, state.inner, state.synced))
+        pending = tmap(
+            lambda p, g: lax.dynamic_update_index_in_dim(p, g, slot, 0),
+            state.pending, grads)
+
+        def flush(op):
+            synced, inner, pending = op
+            # d microbatches (stashed at calls k-d .. k-1) are still
+            # unsynced; drain oldest-first so the global sync order is
+            # 0..k-1 at every depth.
+            for j in range(d):
+                idx = (k - d + j) % d
+                item = tmap(lambda p: p[idx], pending)
+                s, inner = sync_fn(item, inner)
+                synced = _tree_add(synced, s)
+            mean = tmap(lambda a: a / k, synced)
+            updates, inner = apply_fn(mean, inner, params, **extra)
+            return updates, inner, _tree_zeros(synced)
+
+        def carry(op):
+            synced, inner, _ = op
+            return _tree_zeros(grads), inner, synced
+
+        updates, inner, synced = lax.cond(is_final, flush, carry,
+                                          (synced, inner, pending))
+        return updates, _OverlapState(inner, state.counter + 1, synced,
+                                      pending)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -269,6 +269,8 @@ def distributed_optimizer(optimizer: optax.GradientTransformation,
                           quantized_wire: bool = False,
                           wire_policy: Optional[WirePolicy] = None,
                           error_feedback: Optional[bool] = None,
+                          overlap: Optional[bool] = None,
+                          overlap_depth: Optional[int] = None,
                           ) -> optax.GradientTransformation:
     """Wrap ``optimizer`` so updates see globally-synced gradients.
 
@@ -286,6 +288,13 @@ def distributed_optimizer(optimizer: optax.GradientTransformation,
       * ``error_feedback``         — EF-SGD residuals as optimizer state
         for the lossy wire formats; default: the HOROVOD_WIRE_EF knob
         when a wire policy is active.
+      * ``overlap`` / ``overlap_depth`` — the overlap plane
+        (ops/overlap.py; docs/overlap.md): with
+        ``backward_passes_per_step = k > 1``, pipeline the per-microbatch
+        fused syncs against the next microbatch's compute instead of one
+        sync after microbatch k (default: the HOROVOD_OVERLAP /
+        HOROVOD_OVERLAP_DEPTH knobs — the reference's background-thread
+        overlap, restructured into the traced program).
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
@@ -296,32 +305,56 @@ def distributed_optimizer(optimizer: optax.GradientTransformation,
                    fusion_threshold_bytes=fusion_threshold_bytes,
                    quantized_wire=quantized_wire, wire_policy=wire_policy)
 
-    # The synced core: inner optimizer fed globally-reduced gradients,
-    # carrying EF residual state when error feedback is on.
+    # The synced core, split into its two halves — sync (collective, EF
+    # residuals threaded through the core state) and apply (inner
+    # optimizer only) — so the microbatch pipeline below can issue syncs
+    # independently of the update.  core_update is their composition: the
+    # path every non-pipelined call takes.
     if _ef_enabled(error_feedback, wire_policy, quantized_wire, compression):
         def core_init(params):
             return _WireState(
                 inner=optimizer.init(params),
                 residual=jax.tree_util.tree_map(jnp.zeros_like, params))
 
-        def core_update(grads, state: _WireState, params=None, **extra):
+        def core_sync(grads, state: _WireState):
             synced, res = sync_gradients_ef(grads, state.residual,
                                             axis_name, **sync_kw)
+            return synced, _WireState(state.inner, res)
+
+        def core_apply(synced, state: _WireState, params=None, **extra):
             updates, inner = optimizer.update(synced, state.inner, params,
                                               **extra)
-            return updates, _WireState(inner, res)
+            return updates, _WireState(inner, state.residual)
     else:
         def core_init(params):
             return optimizer.init(params)
 
-        def core_update(grads, state, params=None, **extra):
-            synced = sync_gradients(grads, axis_name, **sync_kw)
+        def core_sync(grads, state):
+            return sync_gradients(grads, axis_name, **sync_kw), state
+
+        def core_apply(synced, state, params=None, **extra):
             return optimizer.update(synced, state, params, **extra)
+
+    def core_update(grads, state, params=None, **extra):
+        synced, state = core_sync(grads, state)
+        return core_apply(synced, state, params, **extra)
 
     if backward_passes_per_step == 1:
         return optax.GradientTransformation(core_init, core_update)
 
     n = backward_passes_per_step
+
+    from .ops import overlap as _overlap
+    if _overlap.overlap_enabled(overlap):
+        depth = _overlap.resolve_depth(overlap_depth)
+
+        def on_trace(grads, k, d):
+            leaves = jax.tree_util.tree_leaves(grads)
+            if leaves:
+                _overlap.microbatch_overlap_model(leaves, axis_name, k, d)
+
+        return _overlap.make_pipelined_transform(
+            core_init, core_sync, core_apply, n, depth, on_trace=on_trace)
 
     def init_fn(params):
         zeros = jax.tree_util.tree_map(jnp.zeros_like, params)

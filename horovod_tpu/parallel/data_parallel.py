@@ -76,7 +76,9 @@ def make_train_step(loss_fn: Callable,
                     has_aux: bool = False,
                     compute_dtype=None,
                     wire_policy=None,
-                    error_feedback: Optional[bool] = None) -> Callable:
+                    error_feedback: Optional[bool] = None,
+                    overlap: Optional[bool] = None,
+                    overlap_depth: Optional[int] = None) -> Callable:
     """Build ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
 
     ``loss_fn(params, *batch_shard)`` is evaluated per chip on the local
@@ -95,6 +97,11 @@ def make_train_step(loss_fn: Callable,
     multi-slice meshes (parallel/hierarchical.py).  ``wire_policy`` /
     ``error_feedback`` select per-bucket wire formats with EF residuals
     for the gradient sync (ops/wire.py; docs/tensor-fusion.md).
+    ``overlap`` / ``overlap_depth`` pipeline the per-microbatch syncs
+    when ``backward_passes_per_step > 1`` (ops/overlap.py;
+    docs/overlap.md — for the syncs to actually interleave with the
+    next microbatch's compute, drive the k calls inside ONE program:
+    :func:`make_microbatched_train_step`).
     """
     axis_name = resolve_axis(axis_name, mesh)
     donate = _resolve_donate(donate)
@@ -102,7 +109,8 @@ def make_train_step(loss_fn: Callable,
         optimizer, axis_name=axis_name, op=op, compression=compression,
         backward_passes_per_step=backward_passes_per_step,
         fusion_threshold_bytes=fusion_threshold_bytes,
-        wire_policy=wire_policy, error_feedback=error_feedback)
+        wire_policy=wire_policy, error_feedback=error_feedback,
+        overlap=overlap, overlap_depth=overlap_depth)
 
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     loss_fn = _compute_cast(loss_fn, compute_dtype)
@@ -147,6 +155,73 @@ def make_train_step(loss_fn: Callable,
         return out
 
     return step
+
+
+def make_microbatched_train_step(loss_fn: Callable,
+                                 optimizer: optax.GradientTransformation,
+                                 mesh: Mesh,
+                                 backward_passes_per_step: int,
+                                 axis_name: AxisName = "hvd",
+                                 op: ReduceOp = Average,
+                                 fusion_threshold_bytes: Optional[int] = None,
+                                 donate: Optional[bool] = None,
+                                 remat: bool = False,
+                                 compute_dtype=None,
+                                 wire_policy=None,
+                                 error_feedback: Optional[bool] = None,
+                                 overlap: Optional[bool] = None,
+                                 overlap_depth: Optional[int] = None
+                                 ) -> Callable:
+    """Build ``step(params, opt_state, batch) -> (params, opt_state,
+    loss)`` running ONE optimizer step over ``k =
+    backward_passes_per_step`` microbatches inside a single compiled
+    program via ``lax.scan`` — the overlap plane's lax.scan software
+    pipeline (ops/overlap.py; docs/overlap.md).
+
+    ``batch`` leaves are shaped ``(k, global_batch, ...)``; each scan
+    iteration runs one microbatch's forward/backward and one pipelined
+    ``dist_opt.update`` call, so with overlap on the fused sync of
+    microbatch *i* is issued in iteration *i + depth* — inside the same
+    program region as that microbatch's compute, where XLA can run them
+    concurrently.  The final iteration drains the buffer and applies the
+    inner optimizer.  With overlap off this is exactly the classic
+    accumulate-k-then-sync step, scanned.  ``opt_state`` comes from this
+    wrapper's own ``init`` (the k > 1 contract of distributed_optimizer).
+    """
+    axis_name = resolve_axis(axis_name, mesh)
+    donate = _resolve_donate(donate)
+    k = backward_passes_per_step
+    if k < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+    dist_opt = distributed_optimizer(
+        optimizer, axis_name=axis_name, op=op,
+        backward_passes_per_step=k,
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        wire_policy=wire_policy, error_feedback=error_feedback,
+        overlap=overlap, overlap_depth=overlap_depth)
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    fn = _compute_cast(loss_fn, compute_dtype)
+    fn = jax.checkpoint(fn) if remat else fn
+
+    def body(params, opt_state, batch):
+        def one(carry, mb):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(fn)(params, mb)
+            # non-final microbatches return zero updates: applying them
+            # keeps the carry structure uniform and costs one no-op add
+            updates, opt_state = dist_opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), jax.lax.pmean(loss, axis_name)
+
+        (params, opt_state), losses = jax.lax.scan(
+            one, (params, opt_state), batch)
+        return params, opt_state, jnp.mean(losses)
+
+    # batch: (k, global_batch, ...) — shard the batch dim (axis 1).
+    f = shard_map(body, mesh=mesh,
+                  in_specs=(P(), P(), P(None, axes)),
+                  out_specs=(P(), P(), P()), check_vma=False)
+    return jax.jit(f, donate_argnums=(0, 1) if donate else ())
 
 
 def make_scanned_train_step(loss_fn: Callable,

@@ -19,6 +19,24 @@ Works with any optax transformation whose state is elementwise over the
 parameters (sgd/momentum/adam/adamw/...): the whole pytree is flattened
 to one fp32 vector, padded to a multiple of the axis size, and the shard
 geometry is static — XLA sees fixed-shape RS/AG collectives riding ICI.
+
+Two step shapes (``interleaved=`` on both the state init and the step
+builder — state layouts differ, so the flag is kwarg-gated and must
+match):
+
+  * **monolithic** (default): one flat vector, one RS, one sharded
+    update, one AG — the whole chain serialized on the critical path.
+  * **bucket-interleaved** (the overlap plane, ops/overlap.py): the
+    flat vector is split along the fusion-bucket plan (plan-cache keyed
+    like the gradient sync), and the chain becomes a software pipeline —
+    bucket *b*'s sharded optimizer update runs while bucket *b+1*'s
+    reduce_scatter is in flight, in reverse-priority issue order
+    (overlap.priority_order: last buckets first, so the next step's
+    first-needed params finish their all_gather last and freshest).
+    The paper behind this module (arXiv:2004.13336 §4) motivates exactly
+    this software pipelining of the RS -> update -> AG chain.  Per
+    element the same math runs in the same order across the axis, so
+    results are bit-near the monolithic path (tests/test_overlap.py).
 """
 
 from __future__ import annotations
@@ -76,21 +94,82 @@ def _unflatten_like(flat: jnp.ndarray, tree: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def _bucket_plan(params: Any, threshold_bytes: Any):
+    """Fusion-bucket plan over the fp32-flattened parameter leaves,
+    through the runtime's BucketPlanCache when initialized — the
+    interleaved pipeline's bucket split and its (reversed) issue order
+    are pure functions of this plan, so identical (shapes, threshold)
+    signatures reuse both."""
+    leaves = jax.tree_util.tree_leaves(params)
+    shapes = [tuple(l.shape) for l in leaves]
+    # update math is fp32 regardless of storage dtype (see _flatten)
+    dtypes = [jnp.float32] * len(leaves)
+    from .. import runtime as _rt
+    if threshold_bytes is None:
+        from ..optimizer import DEFAULT_FUSION_BYTES
+        threshold_bytes = (_rt.get().fusion_threshold()
+                           if _rt.is_initialized() else DEFAULT_FUSION_BYTES)
+    if _rt.is_initialized():
+        return _rt.get().plan_cache.get(shapes, dtypes, threshold_bytes)
+    from ..ops.fusion import make_plan
+    return make_plan(shapes, dtypes, threshold_bytes)
+
+
+def _f32_leaves(tree: Any):
+    return [l.astype(jnp.float32)
+            for l in jax.tree_util.tree_leaves(tree)]
+
+
+def _pack_padded(leaves, bucket, n: int) -> jnp.ndarray:
+    """One bucket's leaves as a flat fp32 vector padded to a multiple of
+    the axis size (static shapes; the pad is the per-bucket analog of the
+    monolithic path's tail pad)."""
+    from ..ops.fusion import pack_bucket
+    flat = pack_bucket(leaves, bucket)
+    total = flat.shape[0]
+    padded = -(-total // n) * n
+    return jnp.pad(flat, (0, padded - total))
+
+
 def init_sharded_opt_state(optimizer: optax.GradientTransformation,
                            params: Any, mesh: Mesh,
-                           axis_name="hvd") -> Any:
+                           axis_name="hvd",
+                           interleaved: bool = False,
+                           fusion_threshold_bytes: Any = None) -> Any:
     """Optimizer state over the flat parameter shards: leaf layout is
     ``[n, padded/n, ...]`` with dim 0 sharded over the axis, so each chip
-    materializes state for exactly 1/n of the parameters."""
+    materializes state for exactly 1/n of the parameters.
+
+    ``interleaved=True`` returns the bucket-interleaved layout instead —
+    a tuple with one such sharded block PER FUSION BUCKET (plan order) —
+    and must pair with ``make_zero1_train_step(..., interleaved=True)``:
+    the layouts differ structurally, which is why the flag is a kwarg
+    and never an env knob (state inited one way must not meet a step
+    compiled the other way).  Per parameter the stored VALUES are
+    identical in both layouts — only the element -> chip mapping moves.
+    """
     axis = _single_axis(axis_name, mesh)
     n = int(mesh.shape[axis])
-    total = _flat_size(params)
-    padded = -(-total // n) * n
 
-    def init(params):
-        flat = jnp.pad(_flatten(params), (0, padded - total))
-        shards = flat.reshape(n, padded // n)
-        return jax.vmap(optimizer.init)(shards)
+    if interleaved:
+        plan = _bucket_plan(params, fusion_threshold_bytes)
+
+        def init(params):
+            leaves = _f32_leaves(params)
+            out = []
+            for b in plan.buckets:
+                flat = _pack_padded(leaves, b, n)
+                out.append(jax.vmap(optimizer.init)(
+                    flat.reshape(n, flat.shape[0] // n)))
+            return tuple(out)
+    else:
+        total = _flat_size(params)
+        padded = -(-total // n) * n
+
+        def init(params):
+            flat = jnp.pad(_flatten(params), (0, padded - total))
+            shards = flat.reshape(n, padded // n)
+            return jax.vmap(optimizer.init)(shards)
 
     # out_shardings: each chip WRITES only its 1/n block — materializing
     # the full state replicated first would OOM exactly the large-model
@@ -107,15 +186,20 @@ def make_zero1_train_step(loss_fn: Callable,
                           axis_name="hvd",
                           op: ReduceOp = Average,
                           donate=None,
-                          remat: bool = False) -> Callable:
+                          remat: bool = False,
+                          interleaved: bool = False,
+                          fusion_threshold_bytes: Any = None) -> Callable:
     """Build ``step(params, opt_state, batch) -> (params, opt_state,
     loss)`` with the weight update sharded across ``axis_name``.
 
-    ``opt_state`` comes from :func:`init_sharded_opt_state`; ``batch`` is
+    ``opt_state`` comes from :func:`init_sharded_opt_state` (same
+    ``interleaved`` flag — the layouts must match); ``batch`` is
     sharded over the axis like :func:`..data_parallel.make_train_step`'s.
     Numerics match the replicated-update step exactly (same mean
     gradient, same elementwise update) — only WHERE the update runs
-    changes.
+    changes.  ``interleaved=True`` runs the bucket-interleaved pipeline
+    (module docstring): same per-element math, scheduled so bucket b's
+    sharded update overlaps bucket b+1's in-flight reduce_scatter.
     """
     if op != Average:
         raise ValueError("zero-1 update sharding reduces with Average "
@@ -125,6 +209,10 @@ def make_zero1_train_step(loss_fn: Callable,
     fn = jax.checkpoint(loss_fn) if remat else loss_fn
     from .data_parallel import _resolve_donate
     donate = _resolve_donate(donate)
+
+    if interleaved:
+        return _make_interleaved_step(fn, optimizer, mesh, axis, n,
+                                      donate, fusion_threshold_bytes)
 
     def body(params, opt_state, batch):
         loss, grads = jax.value_and_grad(fn)(params, batch)
@@ -162,4 +250,99 @@ def make_zero1_train_step(loss_fn: Callable,
 
     # donate the old params/opt_state buffers so XLA updates in place
     # (the same knob-driven default as data_parallel.make_train_step)
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def _make_interleaved_step(fn: Callable,
+                           optimizer: optax.GradientTransformation,
+                           mesh: Mesh, axis: str, n: int, donate: bool,
+                           fusion_threshold_bytes: Any) -> Callable:
+    """The bucket-interleaved ZeRO-1 pipeline (overlap plane).
+
+    Per bucket the chain is exactly the monolithic path's —
+    psum_scatter, /n, sharded elementwise update on the local state
+    block, all_gather — but issued as a software pipeline over the
+    fusion plan's buckets in reverse-priority order: the reduce_scatter
+    of the NEXT bucket goes into the program before the current bucket's
+    update + all_gather, giving a latency-hiding scheduler a sharded
+    optimizer update to run under every in-flight RS.  The element ->
+    chip mapping changes (per-bucket shard boundaries instead of one
+    global split) but every element sees the same reduction over the
+    same axis and the same elementwise update — bit-near the monolithic
+    result by construction."""
+    from ..ops.fusion import unpack_bucket
+    from ..ops.overlap import priority_order, record_overlap
+    from ..ops.wire import modeled_wire_bytes
+
+    def body(params, opt_state, batch):
+        plan = _bucket_plan(params, fusion_threshold_bytes)
+        order = priority_order(plan)
+        nb = plan.num_buckets
+        loss, grads = jax.value_and_grad(fn)(params, batch)
+        gleaves_raw, treedef = jax.tree_util.tree_flatten(grads)
+        gleaves = [l.astype(jnp.float32) for l in gleaves_raw]
+        pleaves = _f32_leaves(params)
+        my = lax.axis_index(axis)
+
+        # Analytical overlap split (trace time): every bucket moves
+        # RS+AG == one ring allreduce of its elements; the pipeline
+        # leaves the first-issued RS and the last-issued update+AG
+        # exposed (half a bucket's traffic each), everything between
+        # runs under an in-flight neighbor.
+        per_bucket = [modeled_wire_bytes(sum(b.sizes), 4, "none",
+                                         {"flat": n})["bottleneck"]
+                      for b in plan.buckets]
+        total_bytes = float(sum(per_bucket))
+        exposed = (total_bytes if nb <= 1 else
+                   0.5 * (per_bucket[order[0]] + per_bucket[order[-1]]))
+        record_overlap(total_bytes, exposed, plane="zero1")
+
+        def reduce_scatter(bi: int) -> jnp.ndarray:
+            flat = _pack_padded(gleaves, plan.buckets[bi], n)
+            shard_len = flat.shape[0] // n
+            gshard = lax.psum_scatter(flat.reshape(n, shard_len), axis,
+                                      scatter_dimension=0, tiled=True)
+            return gshard.reshape(shard_len) / n
+
+        def update_and_gather(bi: int, gshard: jnp.ndarray):
+            shard_len = gshard.shape[0]
+            pflat = _pack_padded(pleaves, plan.buckets[bi], n)
+            pshard = lax.dynamic_slice_in_dim(pflat, my * shard_len,
+                                              shard_len)
+            state_local = jax.tree_util.tree_map(lambda x: x[0],
+                                                 opt_state[bi])
+            updates, state_local = optimizer.update(gshard, state_local,
+                                                    pshard)
+            new_state = jax.tree_util.tree_map(lambda x: x[None],
+                                               state_local)
+            return lax.all_gather(updates, axis, axis=0,
+                                  tiled=True), new_state
+
+        # One-slot software pipeline in reverse-priority issue order:
+        # RS(order[j+1]) enters the program before update+AG(order[j]).
+        new_states = [None] * nb
+        ufulls = [None] * nb
+        inflight = reduce_scatter(order[0])
+        for j in range(nb):
+            nxt = reduce_scatter(order[j + 1]) if j + 1 < nb else None
+            ufull, st = update_and_gather(order[j], inflight)
+            ufulls[order[j]], new_states[order[j]] = ufull, st
+            inflight = nxt
+
+        out = [None] * plan.num_leaves
+        for bi, b in enumerate(plan.buckets):
+            unpack_bucket(ufulls[bi][:sum(b.sizes)], b, out)
+        updates_tree = jax.tree_util.tree_unflatten(
+            treedef, [u.astype(l.dtype)
+                      for u, l in zip(out, gleaves_raw)])
+        params = optax.apply_updates(params, updates_tree)
+        return params, tuple(new_states), lax.pmean(loss, axis)
+
+    def step(params, opt_state, batch):
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=(P(), P(axis), P()),
+            check_vma=False)(params, opt_state, batch)
+
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())

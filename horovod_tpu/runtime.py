@@ -129,6 +129,23 @@ class Runtime:
         from .ops.wire import validate_policy_name
         validate_policy_name(self.knobs["HOROVOD_WIRE_POLICY"])
 
+        # Overlap plane (ops/overlap.py): same init-validation contract
+        # for HOROVOD_OVERLAP_DEPTH / HOROVOD_PREFETCH_DEPTH — plus the
+        # negative-value checks the wire-era validation never grew for
+        # the core numeric knobs.
+        from .ops.overlap import validate_overlap_knobs
+        validate_overlap_knobs(self.knobs)
+        if self.knobs["HOROVOD_FUSION_THRESHOLD"] <= 0:
+            raise ValueError(
+                f"HOROVOD_FUSION_THRESHOLD="
+                f"{self.knobs['HOROVOD_FUSION_THRESHOLD']} invalid; the "
+                "bucket threshold must be a positive byte count")
+        if self.knobs["HOROVOD_CACHE_CAPACITY"] < 0:
+            raise ValueError(
+                f"HOROVOD_CACHE_CAPACITY="
+                f"{self.knobs['HOROVOD_CACHE_CAPACITY']} invalid; use 0 "
+                "to disable caching, a positive entry count otherwise")
+
         # Autotune (reference: HOROVOD_AUTOTUNE + ParameterManager,
         # parameter_manager.{h,cc}): Bayesian optimization over (fusion
         # threshold, cycle time), native math in csrc/optim.cc.  When the
@@ -144,10 +161,18 @@ class Runtime:
                 if any(str(a).startswith("dcn.")
                        for a in self.mesh.axis_names):
                     policy_arms.append("dcn_int8")
+            # Overlap-depth arm dimension (ops/overlap.py): only worth
+            # searching when the pipeline is on; the knob's depth stays
+            # an arm so tuning can conclude it was right.
+            depth_arms = None
+            if self.knobs["HOROVOD_OVERLAP"]:
+                knob_d = int(self.knobs["HOROVOD_OVERLAP_DEPTH"])
+                depth_arms = sorted({1, 2, 4, knob_d})
             self.autotuner = Autotuner(self.knobs,
                                        process_rank=self._process_index,
                                        process_size=self._process_count,
-                                       policy_arms=policy_arms)
+                                       policy_arms=policy_arms,
+                                       depth_arms=depth_arms)
 
         self.stall_inspector = None
         if not self.knobs["HOROVOD_STALL_CHECK_DISABLE"]:
@@ -339,6 +364,30 @@ class Runtime:
             if arm is not None:
                 return arm
         return name
+
+    def overlap_enabled(self) -> bool:
+        """Live overlap-plane switch (env wins, the `current` contract —
+        ops/overlap.py; docs/overlap.md)."""
+        from .common.knobs import current
+        return bool(current("HOROVOD_OVERLAP"))
+
+    def overlap_depth(self) -> int:
+        """Live microbatch-pipeline depth: the knob, refined to the
+        bandit's current depth arm when tuning is on — broadcast with the
+        threshold so all ranks compile identical SPMD programs (a depth
+        change re-traces, like a threshold change)."""
+        from .common.knobs import current
+        from .ops.overlap import MAX_OVERLAP_DEPTH
+        depth = int(current("HOROVOD_OVERLAP_DEPTH"))
+        if not 1 <= depth <= MAX_OVERLAP_DEPTH:
+            raise ValueError(
+                f"HOROVOD_OVERLAP_DEPTH={depth} invalid; must be in "
+                f"[1, {MAX_OVERLAP_DEPTH}] (docs/overlap.md)")
+        if self.autotuner is not None:
+            arm = self.autotuner.overlap_depth
+            if arm is not None:
+                return arm
+        return depth
 
     # -------------------------------------------------------------- metrics
     def metrics_snapshot(self) -> Dict[str, Any]:

@@ -48,12 +48,17 @@ class Autotuner:
     dimension joins the search: a deterministic UCB1 bandit
     (csrc/optim.cc ArmBandit) over the arm names, scored like the GP in
     effective bytes/sec.  The categorical axis stays OFF the GP — its RBF
-    kernel would invent distances between unrelated policies.  The chosen
-    arm index rides the same rank-0 broadcast as the threshold, so every
-    process compiles identical SPMD programs."""
+    kernel would invent distances between unrelated policies.  With
+    ``depth_arms`` (HOROVOD_OVERLAP on), the overlap pipeline depth
+    (ops/overlap.py) is a second arm dimension; when both are present
+    the two are searched JOINTLY over the product space (csrc/optim.cc
+    ProductBandit — the best depth depends on the policy, since a
+    compressed wire shortens exactly the sync the pipeline hides).  The
+    chosen arm indices ride the same rank-0 broadcast as the threshold,
+    so every process compiles identical SPMD programs."""
 
     def __init__(self, knobs, process_rank: int = 0, process_size: int = 1,
-                 policy_arms=None):
+                 policy_arms=None, depth_arms=None):
         self._process_rank = process_rank
         self._process_size = process_size
         self._threshold = int(knobs["HOROVOD_FUSION_THRESHOLD"])
@@ -61,8 +66,12 @@ class Autotuner:
         self._done = False
         self._pm = None
         self._arms = tuple(policy_arms) if policy_arms else ()
+        self._depths = tuple(int(d) for d in depth_arms) if depth_arms \
+            else ()
         self._policy_arm = 0
+        self._depth_arm = 0
         self._bandit = None
+        self._bandit_kind = None
         if process_rank == 0:
             self._pm = NativeParameterManager(
                 initial_threshold=self._threshold,
@@ -71,13 +80,24 @@ class Autotuner:
                 steps_per_sample=knobs["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"],
                 max_samples=knobs["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"],
                 gp_noise=knobs["HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"])
-            if len(self._arms) > 1:
+            sps = knobs["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"]
+            n_pol, n_dep = len(self._arms), len(self._depths)
+            if n_pol > 1 and n_dep > 1:
+                from ..common.basics import NativeProductBandit
+                self._bandit = NativeProductBandit(
+                    n_pol, n_dep, steps_per_sample=sps,
+                    max_pulls=4 * n_pol * n_dep)
+                self._bandit_kind = "product"
+            elif n_pol > 1:
                 from ..common.basics import NativeArmBandit
-                self._bandit = NativeArmBandit(
-                    len(self._arms),
-                    steps_per_sample=knobs[
-                        "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"],
-                    max_pulls=4 * len(self._arms))
+                self._bandit = NativeArmBandit(n_pol, steps_per_sample=sps,
+                                               max_pulls=4 * n_pol)
+                self._bandit_kind = "policy"
+            elif n_dep > 1:
+                from ..common.basics import NativeArmBandit
+                self._bandit = NativeArmBandit(n_dep, steps_per_sample=sps,
+                                               max_pulls=4 * n_dep)
+                self._bandit_kind = "depth"
         self._log_fh = None
         log_path = knobs["HOROVOD_AUTOTUNE_LOG"]
         if log_path and process_rank == 0:
@@ -112,21 +132,32 @@ class Autotuner:
             return None
         return self._arms[self._policy_arm]
 
+    @property
+    def overlap_depth(self) -> Optional[int]:
+        """The current overlap-depth arm value, or None when the depth
+        dimension is not being tuned (consumed by
+        Runtime.overlap_depth)."""
+        if not self._depths:
+            return None
+        return self._depths[self._depth_arm]
+
     def _sync(self) -> None:
-        """Broadcast (threshold, cycle, done, policy arm) from process 0
-        so every process plans identical buckets AND wire formats.
-        No-op single-process."""
+        """Broadcast (threshold, cycle, done, policy arm, depth arm)
+        from process 0 so every process plans identical buckets, wire
+        formats AND pipeline depths.  No-op single-process."""
         if self._process_size <= 1:
             return
         from jax.experimental import multihost_utils
         vals = multihost_utils.broadcast_one_to_all(
             np.array([self._threshold, self._cycle_ms,
                       1.0 if self._done else 0.0,
-                      float(self._policy_arm)], np.float64))
+                      float(self._policy_arm),
+                      float(self._depth_arm)], np.float64))
         self._threshold = int(vals[0])
         self._cycle_ms = float(vals[1])
         self._done = bool(vals[2])
         self._policy_arm = int(vals[3])
+        self._depth_arm = int(vals[4])
 
     def record(self, nbytes: int, seconds: float) -> bool:
         """Record one step's traffic; returns True when tunables changed
@@ -152,14 +183,21 @@ class Autotuner:
                 # "effective bytes/sec" rewards the formats that help and
                 # punishes quantize/cast overhead that doesn't pay off.
                 if self._bandit.update(nbytes / max(seconds, 1e-12)):
-                    self._policy_arm = self._bandit.arm
                     changed = True
+                if self._bandit_kind == "product":
+                    self._policy_arm = self._bandit.arm_a
+                    self._depth_arm = self._bandit.arm_b
+                elif self._bandit_kind == "policy":
+                    self._policy_arm = self._bandit.arm
+                else:
+                    self._depth_arm = self._bandit.arm
             self._done = self._pm.done and (
                 self._bandit is None or self._bandit.done)
             if changed:
                 log.debug("autotune: threshold=%d cycle=%.2fms policy=%s "
-                          "done=%s", self._threshold, self._cycle_ms,
-                          self.wire_policy, self._done)
+                          "depth=%s done=%s", self._threshold,
+                          self._cycle_ms, self.wire_policy,
+                          self.overlap_depth, self._done)
         self._sync()
         return changed
 

@@ -318,6 +318,19 @@ WIRE_RESIDUAL_NORM = REGISTRY.gauge(
     "hvd_wire_residual_norm",
     "L2 norm of the error-feedback residual, by bucket index (host-side "
     "report: optimizer.wire_residual_report).")
+# Overlap plane (ops/overlap.py).  Set at TRACE time from the analytical
+# byte model, like the wire families above: 'exposed' bytes are sync
+# traffic issued with no concurrent compute to hide behind (the flush
+# tail of the microbatch pipeline; the pipeline ends of the interleaved
+# ZeRO-1 chain), by plane (microbatch/zero1).  docs/overlap.md.
+OVERLAP_EXPOSED_BYTES = REGISTRY.gauge(
+    "hvd_overlap_exposed_bytes",
+    "Modeled sync bytes left on the critical path (not overlapped with "
+    "compute) per compiled step, by plane (ops/overlap.py byte model).")
+OVERLAP_FRACTION = REGISTRY.gauge(
+    "hvd_overlap_overlapped_fraction",
+    "Fraction of modeled sync bytes issued concurrently with compute "
+    "per compiled step, by plane (1 - exposed/total; ops/overlap.py).")
 
 # Layer 3: runtime (stall inspector + topology).
 RUNTIME_SIZE = REGISTRY.gauge(

@@ -46,7 +46,7 @@ SUITES = {
         "tests/test_optimizer.py", "tests/test_fsdp.py",
         "tests/test_zero.py", "tests/test_adasum.py",
         "tests/test_hierarchical.py", "tests/test_quantized.py",
-        "tests/test_wire.py",
+        "tests/test_wire.py", "tests/test_overlap.py",
     ],
     "models-kernels": [
         "tests/test_models.py", "tests/test_flash_attention.py",
@@ -90,6 +90,8 @@ KNOB_DIMS = [
     ("no-donate", {"HOROVOD_TPU_DONATE_BUFFERS": "0"},
      ["jax-core"]),
     ("wire-auto", {"HOROVOD_WIRE_POLICY": "auto"},
+     ["jax-core"]),
+    ("overlap", {"HOROVOD_OVERLAP": "1", "HOROVOD_OVERLAP_DEPTH": "2"},
      ["jax-core"]),
     ("tf-join", {"HOROVOD_TF_JOIN": "1"},
      ["tensorflow-keras"]),
@@ -147,6 +149,14 @@ def build_steps():
         # (docs/tensor-fusion.md#wire-policies) — all CPU-virtual.
         "bench: wire-policy sweep smoke",
         f"{py} bench.py --wire --cpu", timeout=15))
+    steps.append(_step(
+        # overlap-plane sweep smoke: the microbatch pipeline at each
+        # depth lands the same params as the sequential schedule, the
+        # interleaved ZeRO-1 matches monolithic, and the analytical
+        # exposed/overlapped split rides the artifact
+        # (docs/overlap.md) — all CPU-virtual.
+        "bench: overlap sweep smoke",
+        f"{py} bench.py --overlap --cpu", timeout=15))
     steps.append(_step(
         # promtool-check-metrics-style gate, pure Python (no external
         # dep): renders a populated fleet /metrics snapshot through the

@@ -1,0 +1,14 @@
+"""Overlap-plane integration tier: the microbatch-pipelined sync under
+the real launcher — 2 processes x 4 virtual chips, real cross-process
+XLA collectives — converging on the quadratic toy with bit-identical
+parameters everywhere (docs/overlap.md)."""
+
+import pytest
+
+from test_multiprocess import run_hvdrun
+
+
+@pytest.mark.integration
+def test_overlapped_sync_converges_two_processes():
+    proc = run_hvdrun("overlap_worker.py")
+    assert proc.stdout.count("OVERLAP-OK") >= 2, proc.stdout

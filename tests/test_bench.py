@@ -109,6 +109,40 @@ def test_bench_wire_cpu_contract():
         two["int8_ring"]["dcn_wire_bytes_per_step"]
 
 
+@pytest.mark.slow
+def test_bench_overlap_cpu_contract():
+    """--overlap: the overlap-plane sweep artifact (docs/overlap.md):
+    per-depth {step_time, exposed_comm_bytes (analytical),
+    overlapped_fraction}, the legacy baseline fully exposed, depth 1
+    hiding the largest fraction, a zero1 section with the interleaved
+    pipeline's split, the pipelined ≡ sequential equivalence asserted
+    inside the bench, and the explicit CPU-virtual labeling."""
+    env = dict(os.environ)
+    env["BENCH_DEADLINE_S"] = "300"
+    rec = _run_bench("--overlap", env=env, timeout=400)
+    assert rec["unit"] == "overlapped_fraction"
+    assert "CPU-virtual" in rec["label"]
+    assert rec["equivalence_asserted"] is True
+    depths = rec["depths"]
+    assert set(depths) >= {"off", "0", "1", "2"}
+    for row in depths.values():
+        assert row["step_time_s"] > 0
+        assert row["exposed_comm_bytes"] >= 0
+        assert 0.0 <= row["overlapped_fraction"] <= 1.0
+    # the baseline and the sequential schedule hide nothing; the
+    # shallowest pipeline hides the most (deeper buffers drain more at
+    # the flush)
+    assert depths["off"]["overlapped_fraction"] == 0.0
+    assert depths["0"]["overlapped_fraction"] == 0.0
+    assert depths["1"]["overlapped_fraction"] >= \
+        depths["2"]["overlapped_fraction"] > 0.0
+    assert depths["1"]["exposed_comm_bytes"] < \
+        depths["off"]["exposed_comm_bytes"]
+    zero1 = rec["zero1"]
+    assert zero1["monolithic"]["step_time_s"] > 0
+    assert 0.0 < zero1["interleaved"]["overlapped_fraction"] <= 1.0
+
+
 # ------------------------------------------------- supervisor unit tests
 def _fake_result(rc=0, stdout=""):
     class R:
