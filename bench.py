@@ -159,7 +159,7 @@ def metrics_summary() -> dict:
             h, m = total(hit), total(miss)
             return round(h / (h + m), 4) if h + m else None
 
-        return {
+        summary = {
             "schema": "hvd-metrics-summary-v1",
             "plan_cache_hit_rate": rate("hvd_fusion_plan_cache_hits_total",
                                         "hvd_fusion_plan_cache_misses_total"),
@@ -171,6 +171,14 @@ def metrics_summary() -> dict:
             "collective_bytes": int(total("hvd_collective_bytes_total")),
             "stall_warnings": int(total("hvd_stall_warnings_total")),
         }
+        # When the run traced (HOROVOD_TIMELINE / --timeline-merge), the
+        # artifact points at the evidence (docs/timeline.md).
+        from horovod_tpu import runtime as _hvd_rt
+        if _hvd_rt.is_initialized():
+            tl = _hvd_rt.get().timeline
+            if tl is not None:
+                summary["timeline"] = tl.path
+        return summary
     except Exception as e:
         return {"schema": "hvd-metrics-summary-v1", "error": str(e)}
 

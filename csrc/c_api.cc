@@ -266,6 +266,63 @@ int hvd_core_metrics(void* h, char* buf, int buflen) {
   return n;
 }
 
+// ------------------------------------------------------------------- tracing
+// Activate the native span ring (trace.h).  Until this is called, Record
+// is one relaxed atomic load — tracing costs nothing when off.
+void hvd_core_trace_enable(void* h) {
+  static_cast<ApiHandle*>(h)->core->EnableTrace();
+}
+
+// Versioned trace drain, the span analog of hvd_core_metrics:
+//   hvd_trace_v1 <now_us> <dropped>
+//   <ts_us> <phase> <cat> <name> <arg>       (one line per event)
+// Timestamps are steady-clock µs since ring construction; <now_us> is the
+// same clock at drain time, so the caller rebases events onto wall time
+// without a shared epoch in the wire format.  Events are CONSUMED as they
+// are formatted; when the buffer fills, the remainder stays in the ring
+// for the next drain (a drain never truncates an event away).  New fields
+// APPEND to the line; parsers key on position 1-5 and ignore extras —
+// that is the versioning contract.  Returns bytes written (excluding the
+// NUL); 0 means no pending events.
+int hvd_core_trace(void* h, char* buf, int buflen) {
+  if (!buf || buflen <= 0) return 0;
+  TraceRing* ring = static_cast<ApiHandle*>(h)->core->trace();
+  std::string t = "hvd_trace_v1 ";
+  t += std::to_string(ring->NowUs());
+  t += ' ';
+  t += std::to_string(ring->dropped());
+  t += '\n';
+  for (;;) {
+    std::vector<TraceRing::Event> evs;
+    if (ring->Drain(&evs, 1) == 0) break;
+    const TraceRing::Event& e = evs[0];
+    std::string line = std::to_string(e.ts_us);
+    line += ' ';
+    line += e.phase;
+    line += ' ';
+    line += e.cat;
+    line += ' ';
+    line += e.name[0] ? e.name : "?";
+    line += ' ';
+    line += std::to_string(e.arg);
+    line += '\n';
+    if (static_cast<int>(t.size() + line.size()) >= buflen) {
+      // No room: re-record the event with its original timestamp so a
+      // small buffer loses nothing.  Stream order is not preserved (the
+      // event lands behind newer ones) but timestamps are, and the
+      // timeline consumer orders by ts.
+      ring->RecordAt(e.ts_us, e.phase, e.cat, e.name, e.arg);
+      break;
+    }
+    t += line;
+  }
+  int n = static_cast<int>(t.size());
+  int copy = n < buflen - 1 ? n : buflen - 1;
+  memcpy(buf, t.data(), copy);
+  buf[copy] = '\0';
+  return copy;
+}
+
 // ------------------------------------------------------------------ autotune
 namespace {
 hvdtpu::ParameterManager::Options MakePMOptions(int warmup_samples,

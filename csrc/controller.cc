@@ -240,6 +240,12 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
                           std::vector<Response>* out) {
   auto cycle_start = std::chrono::steady_clock::now();
   stats_.cycles++;
+  // Tracing: stamp the phase boundaries as the cycle runs, commit the
+  // spans at the end only for non-idle cycles (trace.h RecordAt) — an
+  // idle 1 ms loop must not flood the ring.
+  bool traced = trace_ != nullptr && trace_->enabled();
+  uint64_t t_negotiate = traced ? trace_->NowUs() : 0;
+  uint64_t t_fuse = 0, t_respond = 0;
   int n = size();
   size_t nslots = replica_.size();
   if (joined_.empty()) joined_.assign(n, false);
@@ -282,6 +288,7 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
   std::vector<std::string> all;
   if (!transport_->Gather(w.data(), rank() == 0 ? &all : nullptr))
     return false;
+  if (traced) t_fuse = trace_->NowUs();
 
   // 3. Rank 0: AND the hit bits (joined ranks count as all-ones), OR the
   //    invalidation bits, ingest uncached requests, build responses.
@@ -384,6 +391,7 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
     frame = rw.data();
   }
 
+  if (traced) t_respond = trace_->NowUs();
   if (!transport_->Bcast(&frame)) return false;
   stats_.bytes_broadcast += frame.size();
   cycle_bytes += frame.size();
@@ -449,6 +457,21 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
   stats_.cycle_time_us.Observe(static_cast<uint64_t>(
       std::chrono::duration_cast<std::chrono::microseconds>(
           std::chrono::steady_clock::now() - cycle_start).count()));
+  if (traced && (!pending.empty() || !out->empty())) {
+    // negotiate = local split + serialize + lock-step gather; fuse =
+    // rank-0 ingest/validate/fuse + frame build; respond = broadcast +
+    // replica apply (workers spend "fuse" waiting on rank 0's build, the
+    // honest cross-rank picture: that wait IS the negotiation cost).
+    uint64_t t_end = trace_->NowUs();
+    trace_->RecordAt(t_negotiate, 'B', 'c', "cycle.negotiate",
+                     static_cast<int64_t>(pending.size()));
+    trace_->RecordAt(t_fuse, 'E', 'c', "cycle.negotiate");
+    trace_->RecordAt(t_fuse, 'B', 'c', "cycle.fuse");
+    trace_->RecordAt(t_respond, 'E', 'c', "cycle.fuse");
+    trace_->RecordAt(t_respond, 'B', 'c', "cycle.respond");
+    trace_->RecordAt(t_end, 'E', 'c', "cycle.respond",
+                     static_cast<int64_t>(out->size()));
+  }
   return true;
 }
 

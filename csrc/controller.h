@@ -34,6 +34,7 @@
 #include <vector>
 
 #include "common.h"
+#include "trace.h"
 #include "transport.h"
 
 namespace hvdtpu {
@@ -104,6 +105,9 @@ class Controller {
   void set_fusion_threshold(int64_t v) { opts_.fusion_threshold_bytes = v; }
   int64_t fusion_threshold() const { return opts_.fusion_threshold_bytes; }
 
+  // Tracing-plane hook (trace.h): cycle-phase spans land here when set.
+  void set_trace(TraceRing* t) { trace_ = t; }
+
  private:
   // --- rank-0 state ---
   struct Entry {
@@ -132,6 +136,7 @@ class Controller {
   Transport* transport_;
   ControllerOptions opts_;
   ControllerStats stats_;
+  TraceRing* trace_ = nullptr;
 
   std::unordered_map<std::string, Entry> table_;
   std::vector<std::string> arrival_order_;

@@ -7,6 +7,13 @@ namespace hvdtpu {
 Core::Core(std::unique_ptr<Transport> transport, const CoreOptions& opts)
     : transport_(std::move(transport)), opts_(opts) {
   controller_.reset(new Controller(transport_.get(), opts.controller));
+  // Tracing plane: one ring per core; controller cycle phases and
+  // transport frame/reconnect/chaos events share it (disabled until
+  // hvd_core_trace_enable).  Transport bring-up (constructor) predates
+  // this wiring, so initial-connect events are not captured — only
+  // steady-state operation and recovery are.
+  controller_->set_trace(&trace_);
+  transport_->set_trace(&trace_);
   thread_ = std::thread(&Core::Loop, this);
 }
 

@@ -22,6 +22,7 @@
 #include "common.h"
 #include "controller.h"
 #include "optim.h"
+#include "trace.h"
 #include "transport.h"
 
 namespace hvdtpu {
@@ -54,6 +55,12 @@ class Core {
   }
   int64_t fusion_threshold() const { return controller_->fusion_threshold(); }
 
+  // Tracing plane (trace.h): the ring is always allocated but disabled
+  // (one relaxed atomic load per would-be event); EnableTrace flips it
+  // on and hvd_core_trace drains it (csrc/c_api.cc).
+  void EnableTrace() { trace_.Enable(); }
+  TraceRing* trace() { return &trace_; }
+
   // Turn on rank-0 autotuning of (fusion threshold, cycle time) scored by
   // negotiated bytes/sec (reference: ParameterManager + HOROVOD_AUTOTUNE,
   // parameter_manager.{h,cc}).  Rank 0 fuses and paces the lock-step
@@ -69,6 +76,7 @@ class Core {
   std::unique_ptr<Transport> transport_;
   std::unique_ptr<Controller> controller_;
   CoreOptions opts_;
+  TraceRing trace_;
 
   mutable std::mutex mu_;
   std::condition_variable cv_;

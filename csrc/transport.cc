@@ -280,15 +280,18 @@ bool TcpTransport::MaybeInject(int* fd, bool* dup) {
       return true;
     case ChaosInjector::Action::kDelay:
       stats_.chaos_faults++;
+      Trace('i', "chaos.delay", chaos_.delay_ms(), 'x');
       std::this_thread::sleep_for(
           std::chrono::milliseconds(chaos_.delay_ms()));
       return true;
     case ChaosInjector::Action::kDup:
       stats_.chaos_faults++;
+      Trace('i', "chaos.dup", 0, 'x');
       *dup = true;
       return true;
     case ChaosInjector::Action::kClose:
       stats_.chaos_faults++;
+      Trace('i', "chaos.close", 0, 'x');
       // shutdown (not close): the fd number stays valid, the next
       // send/recv on it fails into the recovery path on BOTH ends.
       if (*fd >= 0) ::shutdown(*fd, SHUT_RDWR);
@@ -296,6 +299,7 @@ bool TcpTransport::MaybeInject(int* fd, bool* dup) {
     case ChaosInjector::Action::kDrop:
       stats_.chaos_faults++;
       stats_.frames_dropped++;
+      Trace('i', "chaos.drop", 0, 'x');
       // TCP cannot lose a frame on a live connection; an injected drop
       // therefore manifests as frame-never-sent + connection break, which
       // is exactly what the retransmission machinery must absorb.
@@ -353,24 +357,31 @@ bool TcpTransport::WorkerHandshake() {
     // The break lost our in-flight gather frame; replay it (idempotent:
     // rank 0 dedups by seq).
     stats_.frames_resent++;
+    Trace('i', "tcp.resend",
+          static_cast<int64_t>(last_gather_frame_.size()));
     if (!SendFrame(coord_fd_, last_gather_frame_)) return false;
   }
   return true;
 }
 
 bool TcpTransport::WorkerReconnect() {
+  Trace('B', "tcp.reconnect");
   long step = backoff_base_ms_;
   for (int attempt = 0; attempt < max_retries_; attempt++) {
     // full jitter: sleep U[step/2, step] so reconnect storms decorrelate
     std::uniform_int_distribution<long> u(step / 2, step);
-    std::this_thread::sleep_for(std::chrono::milliseconds(u(jitter_rng_)));
+    long sleep_ms = u(jitter_rng_);
+    Trace('i', "tcp.backoff", sleep_ms);
+    std::this_thread::sleep_for(std::chrono::milliseconds(sleep_ms));
     step = std::min<long>(step * 2, 2000);
     if (WorkerHandshake()) {
       stats_.reconnects++;
+      Trace('E', "tcp.reconnect", attempt + 1);
       return true;
     }
   }
   stats_.reconnect_failures++;
+  Trace('E', "tcp.reconnect", -1);
   return false;
 }
 
@@ -404,6 +415,8 @@ bool TcpTransport::ResyncAccepted(int fd, int* got_rank) {
   // bounds the gap to one frame; the worker dedups by seq regardless).
   if (peer_bcasts < bcast_seq_ && !last_bcast_frame_.empty()) {
     stats_.frames_resent++;
+    Trace('i', "tcp.resend",
+          static_cast<int64_t>(last_bcast_frame_.size()));
     if (!SendFrame(fd, last_bcast_frame_)) {
       close(fd);
       return false;
@@ -416,6 +429,7 @@ bool TcpTransport::ResyncAccepted(int fd, int* got_rank) {
 }
 
 bool TcpTransport::ReacceptWorker(int r) {
+  Trace('B', "tcp.reaccept", r);
   if (worker_fds_[r] >= 0) close(worker_fds_[r]);
   worker_fds_[r] = -1;
   auto deadline = std::chrono::steady_clock::now() +
@@ -435,9 +449,13 @@ bool TcpTransport::ReacceptWorker(int r) {
     if (worker_fds_[got] >= 0) close(worker_fds_[got]);
     worker_fds_[got] = fd;
     stats_.reconnects++;
-    if (got == r) return true;
+    if (got == r) {
+      Trace('E', "tcp.reaccept", r);
+      return true;
+    }
   }
   stats_.reconnect_failures++;
+  Trace('E', "tcp.reaccept", -1);
   return false;
 }
 
@@ -464,6 +482,7 @@ bool TcpTransport::Gather(const std::string& mine,
         uint64_t seq = GetU64(raw, 0);
         if (seq <= gathers_from_[r]) continue;  // replayed dup: discard
         gathers_from_[r] = seq;
+        Trace('i', "tcp.gather.recv", static_cast<int64_t>(raw.size()));
         (*all)[r] = raw.substr(8);
         break;
       }
@@ -476,6 +495,8 @@ bool TcpTransport::Gather(const std::string& mine,
   bool send_it = MaybeInject(&coord_fd_, &dup);
   if (send_it && SendFrame(coord_fd_, last_gather_frame_)) {
     if (dup) SendFrame(coord_fd_, last_gather_frame_);  // rank 0 dedups
+    Trace('i', "tcp.gather.send",
+          static_cast<int64_t>(last_gather_frame_.size()));
     return true;
   }
   // Send failed (or the frame was chaos-dropped): the reconnect handshake
@@ -494,6 +515,8 @@ bool TcpTransport::Bcast(std::string* frame) {
         if (send_it && SendFrame(worker_fds_[r], last_bcast_frame_)) {
           if (dup)
             SendFrame(worker_fds_[r], last_bcast_frame_);  // worker dedups
+          Trace('i', "tcp.bcast.send",
+                static_cast<int64_t>(last_bcast_frame_.size()));
           break;
         }
         // ReacceptWorker's resync replays the frame when the worker
@@ -515,6 +538,7 @@ bool TcpTransport::Bcast(std::string* frame) {
     uint64_t seq = GetU64(raw, 0);
     if (seq <= bcasts_seen_) continue;  // replayed dup: discard
     bcasts_seen_ = seq;
+    Trace('i', "tcp.bcast.recv", static_cast<int64_t>(raw.size()));
     *frame = raw.substr(8);
     return true;
   }

@@ -29,6 +29,8 @@
 #include <string>
 #include <vector>
 
+#include "trace.h"
+
 namespace hvdtpu {
 
 // Fault/retry counters surfaced through hvd_core_metrics (name-keyed
@@ -85,6 +87,9 @@ class Transport {
   virtual bool Bcast(std::string* frame) = 0;
   // Fault/retry counters; zero for transports without a wire.
   virtual TransportStats transport_stats() const { return TransportStats(); }
+  // Tracing-plane hook (trace.h): frame/reconnect/chaos events land in
+  // the ring when set; no-op for transports without a wire.
+  virtual void set_trace(TraceRing*) {}
 };
 
 // All ranks share one object; per-rank handles carry the rank id.
@@ -145,8 +150,14 @@ class TcpTransport : public Transport {
               std::vector<std::string>* all) override;
   bool Bcast(std::string* frame) override;
   TransportStats transport_stats() const override { return stats_; }
+  void set_trace(TraceRing* t) override { trace_ = t; }
 
  private:
+  void Trace(char phase, const char* name, int64_t arg = 0,
+             char cat = 't') {
+    if (trace_ != nullptr && trace_->enabled())
+      trace_->Record(phase, cat, name, arg);
+  }
   bool SendFrame(int fd, const std::string& s);
   bool RecvFrame(int fd, std::string* s);
 
@@ -189,6 +200,7 @@ class TcpTransport : public Transport {
 
   ChaosInjector chaos_;
   TransportStats stats_;
+  TraceRing* trace_ = nullptr;
 };
 
 }  // namespace hvdtpu

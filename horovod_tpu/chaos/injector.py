@@ -78,6 +78,15 @@ class ChaosInjector:
         except Exception:
             pass
 
+    def _mark(self, name: str, **args) -> None:
+        """Named instant on the timeline's chaos lane: an injected fault
+        must be VISIBLE in the merged trace on the faulted rank, not just
+        counted (docs/timeline.md).  Kill/crash events may not survive to
+        the next publish — os._exit is the point — but stalls, blackouts
+        and everything before the exit do."""
+        from ..utils.timeline import trace_instant
+        trace_instant("chaos", name, args=dict(args, rank=self.rank))
+
     def on_step(self, step: int) -> None:
         """Training-loop hook (``hvd.chaos.step(i)``): fires kill and
         step-scheduled stall events for this rank."""
@@ -89,11 +98,14 @@ class ChaosInjector:
                     continue
                 self._record_fired(idx)
                 self._count("kill")
+                self._mark("chaos.kill", step=step)
                 log.warning("chaos: killing rank %d at step %d (exit %d)",
                             self.rank, step, e.exit_code)
                 self._exit(e.exit_code)
             elif e.kind == "stall" and not e.point:
                 self._count("stall")
+                self._mark("chaos.stall.step", step=step,
+                           duration_ms=e.duration_ms)
                 self._sleep(e.duration_ms / 1000.0)
 
     def maybe_stall(self, point: str) -> None:
@@ -106,6 +118,8 @@ class ChaosInjector:
             if (e.kind == "stall" and e.point == point
                     and e.matches_rank(self.rank)):
                 self._count("stall")
+                self._mark(f"chaos.stall.{point}",
+                           duration_ms=e.duration_ms)
                 self._sleep(e.duration_ms / 1000.0)
 
     def maybe_fail_kv(self, op: str) -> None:
@@ -121,6 +135,7 @@ class ChaosInjector:
             if self._kv_failed < e.count:
                 self._kv_failed += 1
                 self._count("kv_blackout")
+                self._mark("chaos.kv_blackout", op=op)
                 import urllib.error
                 raise urllib.error.URLError(
                     f"chaos: injected KV blackout ({self._kv_failed}/"
@@ -142,6 +157,7 @@ class ChaosInjector:
                 continue
             self._record_fired(idx)
             self._count("crash_commit")
+            self._mark("chaos.crash_commit", point=point)
             log.warning("chaos: crashing rank %d at %s (step %s)",
                         self.rank, point, step)
             self._exit(e.exit_code)

@@ -110,6 +110,9 @@ def load_library() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong)]
         lib.hvd_core_metrics.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                          ctypes.c_int]
+        lib.hvd_core_trace_enable.argtypes = [ctypes.c_void_p]
+        lib.hvd_core_trace.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int]
         # autotune / optim surface
         dptr = ctypes.POINTER(ctypes.c_double)
         lib.hvd_core_enable_autotune.argtypes = [
@@ -530,6 +533,44 @@ class CoordinationCore:
             elif len(parts) == 2:
                 out["counters"][parts[0]] = int(parts[1])
         return out
+
+    def trace_enable(self) -> None:
+        """Activate the native span ring (csrc/trace.h).  Until called,
+        tracing costs one atomic load per would-be event."""
+        self._lib.hvd_core_trace_enable(self._h)
+
+    def trace_drain(self) -> dict:
+        """Consume pending native trace events (hvd_core_trace):
+        ``{"version", "now_us", "dropped", "events": [(ts_us, phase,
+        cat, name, arg), ...]}``.  Timestamps are ring-relative µs;
+        ``now_us`` is the same clock at drain time, so the caller rebases
+        events onto wall time (utils/timeline.NativeTraceDrainer).
+        Extra line fields from a newer library are ignored — the
+        versioning contract mirrors hvd_core_metrics."""
+        events = []
+        header = {"version": 0, "now_us": 0, "dropped": 0}
+        while True:
+            n = self._lib.hvd_core_trace(self._h, self._buf,
+                                         len(self._buf))
+            if n <= 0:
+                break
+            lines = self._buf.value.decode().splitlines()
+            if not lines or not lines[0].startswith("hvd_trace_v"):
+                raise RuntimeError(f"unrecognized native trace header: "
+                                   f"{lines[:1]!r}")
+            head = lines[0].split()
+            header = {"version": int(head[0].split("hvd_trace_v", 1)[1]),
+                      "now_us": int(head[1]), "dropped": int(head[2])}
+            for line in lines[1:]:
+                parts = line.split()
+                if len(parts) < 5:
+                    continue
+                events.append((int(parts[0]), parts[1], parts[2],
+                               parts[3], int(parts[4])))
+            if len(lines) == 1:  # header only: ring is empty
+                break
+        header["events"] = events
+        return header
 
     def shutdown(self) -> None:
         """Ask the cycle loop to exit.  Multi-core teardown MUST call

@@ -99,6 +99,19 @@ _knob("HOROVOD_TIMELINE", "", str,
       "registers the file lazily on horovod_start_timeline().")
 _knob("HOROVOD_TIMELINE_MARK_CYCLES", False, _parse_bool,
       "Mark coordination cycles in the timeline.")
+_knob("HOROVOD_TIMELINE_MERGE_INTERVAL", 5.0, float,
+      "Seconds between trace-chunk publishes to the rendezvous KV scope "
+      "'timeline' (the distributed tracing plane: each publish also "
+      "re-measures the rank's clock offset).  Workers publish whenever a "
+      "timeline is active and a rendezvous server is known; hvdrun "
+      "--timeline-merge consumes the chunks (docs/timeline.md).")
+_knob("HOROVOD_STRAGGLER_CHECK_SECS", 0.0, float,
+      "Driver-side live straggler check period in seconds: every period "
+      "the launcher compares per-rank negotiation-age p99 across the "
+      "fleet's metric snapshots, logs a warning naming the suspect rank "
+      "and sets the hvd_straggler_suspect gauge.  0 disables (the "
+      "end-of-run straggler report still prints).  Requires the metrics "
+      "plane (HOROVOD_METRICS / --metrics-port).")
 # --- metrics plane (TPU-native; no reference equivalent — the reference
 #     stops at timeline + stall inspection) ---
 _knob("HOROVOD_METRICS", False, _parse_bool,

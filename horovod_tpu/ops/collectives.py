@@ -209,20 +209,27 @@ def _mesh_key(rt) -> int:
     return id(rt.mesh)
 
 
-def _tl(rt, name: Optional[str], kind: str, nbytes: int) -> None:
+def _tl(rt, name: Optional[str], kind: str, nbytes: int,
+        t0: Optional[float] = None) -> None:
     """Timeline emit for one eager collective (reference: per-op activities
-    from every backend, e.g. nccl_operations.cc:144-181).  X events; the
-    negotiated torch path adds NEGOTIATE/QUEUE phases around these.
+    from every backend, e.g. nccl_operations.cc:144-181).  X events carry
+    the real host-side latency measured from ``t0`` (the same window _rec
+    feeds the metrics histogram) and are anchored at span START, so they
+    render where the op ran, at their true width — not as 1 µs slivers at
+    completion time.  The negotiated torch path adds NEGOTIATE/QUEUE
+    phases around these.
 
-    Auto-generated names ('x.noname.N') collapse to their prefix: each
-    unique name allocates a chrome pid + metadata entry forever, so
-    per-call unique names would leak memory and bloat the trace."""
+    Auto-generated names ('x.noname.N') collapse to their prefix (the
+    timeline's collapse_name): each unique name allocates a chrome pid +
+    metadata entry forever, so per-call unique names would leak memory
+    and bloat the trace."""
     if rt.timeline is not None:
         if not name:
             name = kind.lower()
-        elif ".noname." in name:
-            name = name.split(".noname.")[0]
-        rt.timeline.record_op(name, kind, nbytes)
+        dur_us = None
+        if t0 is not None:
+            dur_us = (time.perf_counter() - t0) * 1e6
+        rt.timeline.record_op(name, kind, nbytes, duration_us=dur_us)
 
 
 def _rec(kind: str, nbytes: int, t0: float) -> None:
@@ -257,7 +264,6 @@ def allreduce(tensor: TensorLike,
     fn = _compiled(_mesh_key(rt), "allreduce", op=int(op),
                    pre=float(prescale_factor), post=float(postscale_factor))
     out = fn(g)
-    _tl(rt, name, "ALLREDUCE", int(local.nbytes))
     if rt.stall_inspector is not None and name:
         # The watchdog must observe actual completion, not async dispatch:
         # block before clearing the pending entry (the sync allreduce API is
@@ -266,6 +272,7 @@ def allreduce(tensor: TensorLike,
         rt.stall_inspector.record_complete(name)
     res = _to_local(rt, out)
     _rec("ALLREDUCE", int(local.nbytes), t0)
+    _tl(rt, name, "ALLREDUCE", int(local.nbytes), t0)
     return res if had_axis else res[0]
 
 
@@ -296,9 +303,10 @@ def grouped_allreduce(tensors: Sequence[TensorLike],
                    pre=float(prescale_factor), post=float(postscale_factor),
                    plan=plan, n_leaves=len(gs))
     outs = fn(*gs)
-    _tl(rt, name, "GROUPED_ALLREDUCE", int(sum(l.nbytes for l in locals_)))
     res = [_to_local(rt, o) for o in outs]
     _rec("GROUPED_ALLREDUCE", int(sum(l.nbytes for l in locals_)), t0)
+    _tl(rt, name, "GROUPED_ALLREDUCE", int(sum(l.nbytes for l in locals_)),
+        t0)
     return [r if h else r[0] for r, h in zip(res, had)]
 
 
@@ -313,8 +321,8 @@ def allgather(tensor: TensorLike, name: Optional[str] = None) -> Array:
     g = _make_global(rt, local)
     fn = _compiled(_mesh_key(rt), "allgather")
     out = fn(g)  # replicated full concat [size, rows, ...]
-    _tl(rt, name, "ALLGATHER", int(local.nbytes))
     _rec("ALLGATHER", int(local.nbytes), t0)
+    _tl(rt, name, "ALLGATHER", int(local.nbytes), t0)
     out = jnp.reshape(out, (-1,) + out.shape[2:])
     return out
 
@@ -363,8 +371,8 @@ def broadcast(tensor: TensorLike, root_rank: int = 0,
     g = _make_global(rt, local)
     fn = _compiled(_mesh_key(rt), "broadcast", root=int(root_rank))
     out = fn(g)
-    _tl(rt, name, "BROADCAST", int(local.nbytes))
     _rec("BROADCAST", int(local.nbytes), t0)
+    _tl(rt, name, "BROADCAST", int(local.nbytes), t0)
     res = _to_local(rt, out)
     return res if had else res[0]
 
@@ -389,8 +397,8 @@ def alltoall(tensor: TensorLike,
         g = _make_global(rt, local)
         fn = _compiled(_mesh_key(rt), "alltoall")
         out = _to_local(rt, fn(g))
-        _tl(rt, name, "ALLTOALL", int(local.nbytes))
         _rec("ALLTOALL", int(local.nbytes), t0)
+        _tl(rt, name, "ALLTOALL", int(local.nbytes), t0)
         recv = jnp.full((rt.local_size(), n), rows // n, jnp.int32)
         if not had:
             return out[0], recv[0]
@@ -427,8 +435,8 @@ def alltoall(tensor: TensorLike,
     g = _make_global(rt, padded)
     fn = _compiled(_mesh_key(rt), "alltoall")
     out = _to_local(rt, fn(g))  # [ls, n*max_blk, ...]
-    _tl(rt, name, "ALLTOALL", int(local.nbytes))
     _rec("ALLTOALL", int(local.nbytes), t0)
+    _tl(rt, name, "ALLTOALL", int(local.nbytes), t0)
     # recv_splits[i, src] = all_sp[src, mesh position of local chip i]
     local_pos = rt.local_chip_positions()
     recv_np = np.stack([all_sp[:, local_pos[i]] for i in range(ls)])
@@ -456,8 +464,8 @@ def reducescatter(tensor: TensorLike, op: ReduceOp = Average,
     g = _make_global(rt, local)
     fn = _compiled(_mesh_key(rt), "reducescatter", op=int(op))
     out = _to_local(rt, fn(g))
-    _tl(rt, name, "REDUCESCATTER", int(local.nbytes))
     _rec("REDUCESCATTER", int(local.nbytes), t0)
+    _tl(rt, name, "REDUCESCATTER", int(local.nbytes), t0)
     return out
 
 
@@ -469,8 +477,8 @@ def barrier() -> None:
     g = _make_global(rt, jnp.zeros((rt.local_size(), 1), jnp.int32))
     fn = _compiled(_mesh_key(rt), "barrier")
     jax.block_until_ready(fn(g))
-    _tl(rt, None, "BARRIER", 0)
     _rec("BARRIER", 0, t0)
+    _tl(rt, None, "BARRIER", 0, t0)
 
 
 def process_allgather(x: np.ndarray) -> np.ndarray:

@@ -132,6 +132,13 @@ class SyncNegotiator:
         core = self._core()
         with self._lock:
             self._pending[name] = execute
+        # Tracing: NEGOTIATE covers submit -> globally-agreed response
+        # (ended in _execute_response, where QUEUE/EXEC take over) — the
+        # reference's per-tensor phase lifecycle, timeline.cc:244-254.
+        # getattr: test fakes stand in for the runtime without one.
+        tl = getattr(self._rt, "timeline", None)
+        if tl is not None:
+            tl.begin(name, "NEGOTIATE")
         core.submit(name, sig, op_type, nbytes)
         deadline = time.monotonic() + timeout_s
         while True:
@@ -152,12 +159,24 @@ class SyncNegotiator:
                 f"controller error: {resp.error}")
         if resp.type in ("JOIN_DONE", "SHUTDOWN"):
             return
+        tl = getattr(self._rt, "timeline", None)
+        arrival_us = tl.now_us() if tl is not None else 0.0
         for name, sig in zip(resp.names,
                              resp.sigs or [""] * len(resp.names)):
             with self._lock:
                 execute = self._pending.pop(name, None)
             if execute is not None:
+                if tl is not None:
+                    # NEGOTIATE ends when the agreed response arrived;
+                    # QUEUE is the wait behind batch-mates executed
+                    # before this one; EXEC is the collective itself.
+                    tl.end(name, "NEGOTIATE", ts_us=arrival_us)
+                    tl.begin(name, "QUEUE", ts_us=arrival_us)
+                    tl.end(name, "QUEUE")
+                    tl.begin(name, "EXEC")
                 result = execute()
+                if tl is not None:
+                    tl.end(name, "EXEC")
                 with self._lock:
                     self._results[name] = result
             else:

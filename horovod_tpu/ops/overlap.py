@@ -162,6 +162,23 @@ def microbatch_overlap_model(leaves, axis_name, k: int,
     overlapped = max(0, k - depth) if depth >= 1 else 0
     total = k * per_sync
     exposed = (k - overlapped) * per_sync
+    # Tracing plane: step-anchored schedule markers (trace time, once per
+    # compiled program) — one instant per microbatch slot showing where
+    # its sync issues: inside microbatch i+depth's compute region (the
+    # ring-buffer drain) or in the exposed final flush.  The merged
+    # timeline then shows the pipeline SHAPE next to the controller and
+    # transport lanes (docs/timeline.md).
+    from ..utils.timeline import trace_instant
+    for i in range(k):
+        drained_in_loop = depth >= 1 and i < k - depth
+        trace_instant(
+            "overlap",
+            "overlap.sync.issue" if drained_in_loop
+            else "overlap.sync.flush",
+            args={"microbatch": i,
+                  "issued_at_call": (i + depth if drained_in_loop
+                                     else k - 1),
+                  "depth": depth})
     return record_overlap(total, exposed, plane="microbatch")
 
 

@@ -283,6 +283,13 @@ def plan_formats(plan, policy: Policy, axis_name: AxisName,
             fmt = "none"
         fmts.append(fmt)
         _metrics.WIRE_BUCKETS.inc(format=fmt)
+        # Tracing plane: one instant per bucket decision (trace time, once
+        # per compiled program) so the merged timeline shows WHICH wire
+        # format each bucket encodes/decodes with (docs/timeline.md).
+        from ..utils.timeline import trace_instant
+        trace_instant("wire", f"wire.encode.{fmt}",
+                      args={"bucket": len(fmts) - 1,
+                            "nbytes": int(bucket.nbytes)})
         if fmt != "none":
             nelems = sum(bucket.sizes)
             itemsize = jnp.dtype(bucket.dtype).itemsize

@@ -296,6 +296,15 @@ def _make_interleaved_step(fn: Callable,
         exposed = (total_bytes if nb <= 1 else
                    0.5 * (per_bucket[order[0]] + per_bucket[order[-1]]))
         record_overlap(total_bytes, exposed, plane="zero1")
+        # Tracing plane: the interleaved pipeline's issue order as trace-
+        # time instants (once per compile), one per bucket — position j
+        # issues bucket order[j]'s RS under bucket order[j-1]'s update+AG
+        # (docs/timeline.md).
+        from ..utils.timeline import trace_instant as _ti
+        for j, bi in enumerate(order):
+            _ti("zero1", "zero1.bucket.issue",
+                args={"bucket": int(bi), "position": j,
+                      "nbytes": int(sum(plan.buckets[bi].sizes)) * 4})
 
         def reduce_scatter(bi: int) -> jnp.ndarray:
             flat = _pack_padded(gleaves, plan.buckets[bi], n)

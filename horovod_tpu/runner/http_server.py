@@ -13,16 +13,29 @@ into the ``metrics`` scope (``utils/metrics.py`` MetricsPublisher), and
 this route renders them — plus the server process's own registry — as one
 fleet-wide Prometheus text exposition, each sample labeled with its rank
 (``hvdrun --metrics-port`` pins the port; see docs/metrics.md).
+
+Two more special routes serve the distributed tracing plane
+(docs/timeline.md):
+
+  * ``GET /clock`` returns this server's wall time — the reference clock
+    every rank's NTP-style offset handshake measures against
+    (``utils/clocksync.py``);
+  * ``GET /timeline`` renders the trace chunks workers PUT into the
+    ``timeline`` scope (``utils/timeline.py`` TimelinePublisher) as one
+    merged, rank-laned Chrome/Perfetto JSON on the shared aligned epoch.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 METRICS_SCOPE = "metrics"
+TIMELINE_SCOPE = "timeline"
+CLOCK_SCOPE = "clock"
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -47,6 +60,12 @@ class _KVHandler(BaseHTTPRequestHandler):
         scope, key = self._split()
         if scope == METRICS_SCOPE and not key:
             self._serve_metrics()
+            return
+        if scope == CLOCK_SCOPE and not key:
+            self._serve_body(repr(time.time()).encode(), "text/plain")
+            return
+        if scope == TIMELINE_SCOPE and not key:
+            self._serve_timeline()
             return
         with self.server.kv_lock:  # type: ignore[attr-defined]
             value = self.server.kv.get(scope, {}).get(key)  # type: ignore
@@ -80,6 +99,22 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _serve_body(self, body: bytes, content_type: str) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _serve_timeline(self) -> None:
+        """Merged fleet trace: every chunk the ``timeline`` scope holds,
+        rank-laned on the shared aligned epoch (docs/timeline.md)."""
+        from ..utils.timeline import merge_timeline_chunks
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            stored = dict(self.server.kv.get(TIMELINE_SCOPE, {}))  # type: ignore
+        merged = merge_timeline_chunks(stored)
+        self._serve_body(json.dumps(merged).encode(), "application/json")
 
     def do_DELETE(self) -> None:  # noqa: N802
         scope, key = self._split()

@@ -113,6 +113,14 @@ def make_parser() -> argparse.ArgumentParser:
                         "publishing + the end-of-run straggler report "
                         "(HOROVOD_METRICS; docs/metrics.md)")
     p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-merge", default=None, metavar="OUT_JSON",
+                   help="write ONE merged, rank-laned Chrome/Perfetto "
+                        "trace of the whole fleet at job end: every "
+                        "worker records a timeline (per-rank files "
+                        "OUT_JSON.rank.N.json unless --timeline-filename "
+                        "is given), publishes clock-aligned chunks to "
+                        "the rendezvous KV, and the merge is also "
+                        "live-served at GET /timeline (docs/timeline.md)")
     tl_mc = p.add_mutually_exclusive_group()
     tl_mc.add_argument("--timeline-mark-cycles", action="store_true",
                        default=None)
@@ -586,13 +594,11 @@ def build_worker_command(slot: hosts_mod.SlotInfo, command: List[str],
     return ssh_cmd
 
 
-def report_stragglers(rendezvous: RendezvousServer,
-                      sink=None) -> None:
-    """Harvest worker metric snapshots from the rendezvous KV and print
-    the rank-0 straggler report (per-rank negotiation-age p50/p99 naming
-    the slowest rank — the fleet extension of the stall inspector)."""
+def harvest_metric_snapshots(rendezvous: RendezvousServer) -> Dict:
+    """rank -> snapshot dict from the rendezvous ``metrics`` scope (the
+    shared source of the end-of-run report and the live straggler
+    monitor)."""
     import json as _json
-    from ..utils import metrics as M
     snaps = {}
     for key, value in rendezvous.scope_items("metrics").items():
         if not key.startswith("rank."):
@@ -601,9 +607,37 @@ def report_stragglers(rendezvous: RendezvousServer,
             snaps[int(key.split(".", 1)[1])] = _json.loads(value)
         except (ValueError, TypeError):
             continue
-    report = M.straggler_report(snaps)
+    return snaps
+
+
+def report_stragglers(rendezvous: RendezvousServer,
+                      sink=None) -> None:
+    """Harvest worker metric snapshots from the rendezvous KV and print
+    the rank-0 straggler report (per-rank negotiation-age p50/p99 naming
+    the slowest rank — the fleet extension of the stall inspector)."""
+    from ..utils import metrics as M
+    report = M.straggler_report(harvest_metric_snapshots(rendezvous))
     if report:
         print(report, file=sink or sys.stderr, flush=True)
+
+
+def write_merged_timeline(rendezvous: RendezvousServer, path: str,
+                          sink=None) -> bool:
+    """Render the ``timeline`` KV scope as one merged Chrome/Perfetto
+    JSON (hvdrun --timeline-merge; the same merge GET /timeline serves
+    live).  Returns False when no worker published any chunk."""
+    import json as _json
+    from ..utils.timeline import merge_timeline_chunks
+    merged = merge_timeline_chunks(rendezvous.scope_items("timeline"))
+    have_events = any(e.get("ph") != "M" for e in merged["traceEvents"])
+    with open(path, "w") as f:
+        _json.dump(merged, f)
+    print(f"[hvdrun] merged timeline: {path} "
+          f"({len(merged['traceEvents'])} events, "
+          f"{len(merged['metadata']['clock_sync'])} rank clocks)"
+          + ("" if have_events else " — no worker published trace chunks"),
+          file=sink or sys.stderr, flush=True)
+    return have_events
 
 
 def launch_static(args: argparse.Namespace, command: List[str]) -> int:
@@ -642,6 +676,13 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
         updates["HOROVOD_RENDEZVOUS_ADDR"] = coord_host
         updates["HOROVOD_RENDEZVOUS_PORT"] = str(rdv_port)
         updates["HOROVOD_CONTROLLER_PORT"] = str(args.controller_port)
+        if args.timeline_merge and not updates.get("HOROVOD_TIMELINE") \
+                and not os.environ.get("HOROVOD_TIMELINE"):
+            # --timeline-merge without an explicit --timeline-filename:
+            # per-rank local files beside the merged output (two local
+            # slots writing one shared path would race).
+            updates["HOROVOD_TIMELINE"] = \
+                f"{args.timeline_merge}.rank.{slot.rank}.json"
         if np_ > 1:
             updates["HOROVOD_COORDINATOR_ADDR"] = \
                 f"{coord_host}:{args.coordinator_port}"
@@ -655,6 +696,20 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
         return spawn_with_output(
             cmd, env, args.output_filename, slot.rank,
             prefix_timestamp=args.prefix_output_with_timestamp)
+
+    # Live straggler check (the in-run promotion of the end-of-run
+    # report): needs the metrics plane for per-rank snapshots and an
+    # explicit period knob (HOROVOD_STRAGGLER_CHECK_SECS > 0).
+    monitor = None
+    check_secs = float(os.environ.get("HOROVOD_STRAGGLER_CHECK_SECS",
+                                      "0") or 0)
+    if metrics_enabled and check_secs > 0:
+        from ..utils.metrics import StragglerMonitor
+        monitor = StragglerMonitor(
+            lambda: harvest_metric_snapshots(rendezvous),
+            interval=check_secs,
+            log_fn=lambda msg: print(msg, file=sys.stderr, flush=True))
+        monitor.start()
 
     try:
         for slot in slots:
@@ -679,8 +734,12 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
             p.wait()
         return 130
     finally:
+        if monitor is not None:
+            monitor.stop()
         if metrics_enabled:
             report_stragglers(rendezvous)
+        if args.timeline_merge:
+            write_merged_timeline(rendezvous, args.timeline_merge)
         rendezvous.stop()
 
 

@@ -114,14 +114,31 @@ class Runtime:
         self.plan_cache = BucketPlanCache(
             capacity=self.knobs["HOROVOD_CACHE_CAPACITY"])
 
+        # Tracing plane (utils/timeline.py, docs/timeline.md): clock
+        # alignment first — the NTP-style offset handshake against the
+        # rendezvous server puts every rank's trace events on one fleet
+        # epoch; a rank without a reachable server traces locally with
+        # offset 0 and infinite uncertainty.
+        self.clock_sync = None
+        rdv_addr = self.knobs["HOROVOD_RENDEZVOUS_ADDR"]
+        rdv_port = self.knobs["HOROVOD_RENDEZVOUS_PORT"]
+        if rdv_addr and rdv_port and self.knobs["HOROVOD_TIMELINE"]:
+            from .utils.clocksync import ClockSync
+            self.clock_sync = ClockSync(rdv_addr, rdv_port)
+
         # Timeline + stall inspector are created lazily by their modules.
         self.timeline = None
+        self.timeline_publisher = None
+        self._trace_drainer = None
         self._timeline_path = self.knobs["HOROVOD_TIMELINE"]
         if self._timeline_path and self._timeline_path != "DYNAMIC":
             from .utils.timeline import Timeline
             self.timeline = Timeline(self._timeline_path,
                                      mark_cycles=self.knobs[
-                                         "HOROVOD_TIMELINE_MARK_CYCLES"])
+                                         "HOROVOD_TIMELINE_MARK_CYCLES"],
+                                     clock=self.clock_sync,
+                                     rank=self._process_index)
+            self._start_timeline_publisher()
 
         # Wire-policy plane (ops/wire.py): validate HOROVOD_WIRE_POLICY
         # now — an unknown policy name must fail AT INIT, not as a trace
@@ -339,6 +356,7 @@ class Runtime:
                     "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"],
                 gp_noise=self.knobs[
                     "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"])
+        self._attach_native_trace()
         return self.core
 
     def fusion_threshold(self) -> int:
@@ -418,6 +436,13 @@ class Runtime:
         # the straggler report sees complete histograms.
         if self.metrics_publisher is not None:
             self.metrics_publisher.close()
+        # Tracing teardown order: final native drain while the core is
+        # alive, final chunk publish while the rendezvous may still be
+        # up, then close the local file.
+        if self._trace_drainer is not None:
+            self._trace_drainer.close()
+        if self.timeline_publisher is not None:
+            self.timeline_publisher.close()
         if self.timeline is not None:
             self.timeline.close()
         if self.autotuner is not None:
@@ -429,14 +454,52 @@ class Runtime:
             self.core.close()
 
     # ------------------------------------------------------------- timeline
+    def _start_timeline_publisher(self) -> None:
+        """Chunk publishing to the rendezvous 'timeline' scope, when a
+        server is known — what GET /timeline and --timeline-merge read."""
+        addr = self.knobs["HOROVOD_RENDEZVOUS_ADDR"]
+        port = self.knobs["HOROVOD_RENDEZVOUS_PORT"]
+        if not (addr and port) or self.timeline is None:
+            return
+        from .utils.timeline import TimelinePublisher
+        self.timeline_publisher = TimelinePublisher(
+            addr=addr, port=port, rank=self._process_index,
+            timeline=self.timeline,
+            interval=self.knobs["HOROVOD_TIMELINE_MERGE_INTERVAL"],
+            clock=self.clock_sync)
+
+    def _attach_native_trace(self) -> None:
+        """Pump the native core's span ring into the timeline (idempotent;
+        called whenever either side comes up after the other)."""
+        if self.core is None or self.timeline is None \
+                or self._trace_drainer is not None:
+            return
+        from .utils.timeline import NativeTraceDrainer
+        self._trace_drainer = NativeTraceDrainer(self.core, self.timeline)
+
     def start_timeline(self, path: str, mark_cycles: bool = False) -> None:
         """Runtime-activated timeline (reference: operations.cc:740-769)."""
         from .utils.timeline import Timeline
-        if self.timeline is not None:
-            self.timeline.close()
-        self.timeline = Timeline(path, mark_cycles=mark_cycles)
+        self.stop_timeline()
+        if self.clock_sync is None:
+            addr = self.knobs["HOROVOD_RENDEZVOUS_ADDR"]
+            port = self.knobs["HOROVOD_RENDEZVOUS_PORT"]
+            if addr and port:
+                from .utils.clocksync import ClockSync
+                self.clock_sync = ClockSync(addr, port)
+        self.timeline = Timeline(path, mark_cycles=mark_cycles,
+                                 clock=self.clock_sync,
+                                 rank=self._process_index)
+        self._start_timeline_publisher()
+        self._attach_native_trace()
 
     def stop_timeline(self) -> None:
+        if self._trace_drainer is not None:
+            self._trace_drainer.close()
+            self._trace_drainer = None
+        if self.timeline_publisher is not None:
+            self.timeline_publisher.close()
+            self.timeline_publisher = None
         if self.timeline is not None:
             self.timeline.close()
             self.timeline = None

@@ -333,6 +333,11 @@ OVERLAP_FRACTION = REGISTRY.gauge(
     "per compiled step, by plane (1 - exposed/total; ops/overlap.py).")
 
 # Layer 3: runtime (stall inspector + topology).
+STRAGGLER_SUSPECT = REGISTRY.gauge(
+    "hvd_straggler_suspect",
+    "Rank the driver's live straggler check currently suspects (-1 = "
+    "none): per-rank negotiation-age p99 skew beyond the ratio threshold "
+    "every HOROVOD_STRAGGLER_CHECK_SECS (docs/metrics.md).")
 RUNTIME_SIZE = REGISTRY.gauge(
     "hvd_runtime_size", "Worker chips in the mesh.")
 RUNTIME_LOCAL_SIZE = REGISTRY.gauge(
@@ -670,14 +675,12 @@ def _hist_count(fam: Dict[str, Any]) -> int:
     return sum(s.get("count", 0) for s in fam.get("samples", []))
 
 
-def straggler_report(snapshots: Dict[int, Dict[str, Any]],
-                     family: str = "hvd_negotiation_age_seconds") -> str:
-    """Rank-0 end-of-run report: per-rank negotiation-age p50/p99, naming
-    the slowest rank (the fleet-level extension of the stall inspector —
-    it tells you WHO was late, not only that someone was).
-
-    ``snapshots`` maps rank -> snapshot dict (MetricsRegistry.snapshot()
-    shape, as harvested from the rendezvous KV)."""
+def _age_rows(snapshots: Dict[int, Dict[str, Any]],
+              family: str = "hvd_negotiation_age_seconds"
+              ) -> List[Tuple[int, Optional[float], Optional[float], int]]:
+    """Per-rank (rank, p50, p99, n) negotiation-age quantiles from
+    harvested snapshots — the shared source of the end-of-run straggler
+    report and the live in-run check (StragglerMonitor)."""
     rows = []
     for rank in sorted(snapshots):
         fam = snapshots[rank].get("families", {}).get(family)
@@ -690,6 +693,92 @@ def straggler_report(snapshots: Dict[int, Dict[str, Any]],
             continue
         rows.append((rank, _hist_quantile(fam, 0.5),
                      _hist_quantile(fam, 0.99), _hist_count(fam)))
+    return rows
+
+
+def detect_straggler(snapshots: Dict[int, Dict[str, Any]],
+                     skew_ratio: float = 4.0,
+                     floor_seconds: float = 1e-3) -> Optional[Dict[str, Any]]:
+    """Live straggler verdict from one round of fleet snapshots: the rank
+    whose negotiation-age p99 exceeds ``skew_ratio`` times the median of
+    its peers' p99s (and an absolute floor, so µs-level jitter on an idle
+    fleet never names anyone).  The default ratio is 4x because quantile
+    estimates come from power-of-2 buckets — adjacent buckets differ by
+    exactly 2x, so a 2x threshold would fire on quantization noise.
+    None when no rank stands out or fewer than two ranks have data —
+    detection needs a peer baseline."""
+    rows = [(r, p99) for r, _, p99, _ in _age_rows(snapshots)
+            if p99 is not None]
+    if len(rows) < 2:
+        return None
+    suspect_rank, suspect_p99 = max(rows, key=lambda rp: rp[1])
+    peers = sorted(p for r, p in rows if r != suspect_rank)
+    peer_median = peers[len(peers) // 2]
+    if suspect_p99 < floor_seconds or \
+            suspect_p99 < skew_ratio * max(peer_median, 1e-9):
+        return None
+    return {"rank": suspect_rank, "p99": suspect_p99,
+            "peer_median_p99": peer_median}
+
+
+class StragglerMonitor:
+    """Driver-side periodic straggler check (the in-run promotion of the
+    end-of-run report): every ``interval`` seconds it re-reads the fleet's
+    metric snapshots, logs a warning naming the suspect rank and sets the
+    ``hvd_straggler_suspect`` gauge (-1 when nobody stands out).  Runs on
+    the launcher, which owns the rendezvous KV the workers publish into
+    (runner/launch.py)."""
+
+    def __init__(self, snapshots_fn: Callable[[], Dict[int, Dict[str, Any]]],
+                 interval: float, skew_ratio: float = 4.0,
+                 log_fn: Optional[Callable[[str], None]] = None):
+        self._snapshots_fn = snapshots_fn
+        self.interval = max(0.1, float(interval))
+        self.skew_ratio = float(skew_ratio)
+        self._log = log_fn or (lambda msg: print(msg, flush=True))
+        self._last_suspect: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def check_once(self) -> Optional[Dict[str, Any]]:
+        try:
+            verdict = detect_straggler(self._snapshots_fn(),
+                                       skew_ratio=self.skew_ratio)
+        except Exception:
+            return None  # telemetry must never take the launcher down
+        if verdict is None:
+            STRAGGLER_SUSPECT.set(-1)
+            self._last_suspect = None
+            return None
+        STRAGGLER_SUSPECT.set(verdict["rank"])
+        if verdict["rank"] != self._last_suspect:  # warn on transitions,
+            self._last_suspect = verdict["rank"]   # not every period
+            self._log(
+                f"[hvd] straggler suspect: rank {verdict['rank']} "
+                f"(negotiation-age p99 {_fmt_seconds(verdict['p99'])} vs "
+                f"peer median {_fmt_seconds(verdict['peer_median_p99'])})")
+        return verdict
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.check_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def straggler_report(snapshots: Dict[int, Dict[str, Any]],
+                     family: str = "hvd_negotiation_age_seconds") -> str:
+    """Rank-0 end-of-run report: per-rank negotiation-age p50/p99, naming
+    the slowest rank (the fleet-level extension of the stall inspector —
+    it tells you WHO was late, not only that someone was).
+
+    ``snapshots`` maps rank -> snapshot dict (MetricsRegistry.snapshot()
+    shape, as harvested from the rendezvous KV)."""
+    rows = _age_rows(snapshots, family)
     if not rows:
         return ""
     slowest = max(rows, key=lambda r: (r[2] or 0.0, r[1] or 0.0))

@@ -1,4 +1,4 @@
-"""Chrome-trace timeline of collective activity.
+"""Distributed Chrome-trace timeline of collective activity.
 
 The reference writes a Chrome-trace JSON of every tensor's
 NEGOTIATE -> QUEUE -> EXEC lifecycle from a dedicated writer thread fed by a
@@ -6,94 +6,458 @@ lock-free queue (reference: horovod/common/timeline.{h,cc}; tensors are
 modeled as chrome "pids", timeline.cc:244-254; activated by
 HOROVOD_TIMELINE, runtime start/stop operations.cc:740-769).
 
-Here the writer thread + queue survive; events come from the eager ops, the
-bucketed gradient sync, and (when enabled) cycle markers.  For deep XLA-level
-profiling users should additionally use ``jax.profiler`` (xprof) — this
-timeline covers the framework-level view the reference's does.
+This rebuild extends that per-rank view into a *distributed* tracing
+plane (the questions that matter in distributed training are cross-rank —
+who is the straggler, where does negotiation wait; arxiv 1810.11112):
+
+  * **aligned clock**: event timestamps are wall-clock µs rebased by the
+    rank's measured offset against the rendezvous server
+    (utils/clocksync.py), so every rank stamps events on ONE fleet epoch;
+  * **native spans**: :class:`NativeTraceDrainer` pumps the C++ core's
+    span ring (csrc/trace.h, ``hvd_core_trace``) — controller cycle
+    phases, transport frames/reconnects, chaos faults — into the same
+    writer thread;
+  * **fleet merge**: :class:`TimelinePublisher` PUTs compacted chunks to
+    the rendezvous KV scope ``timeline`` (mirroring MetricsPublisher);
+    :func:`merge_timeline_chunks` renders them as one rank-laned
+    Perfetto/Chrome JSON, served at ``GET /timeline`` and written by
+    ``hvdrun --timeline-merge out.json``;
+  * **crash safety**: the local file is flushed periodically and
+    ``close()`` is idempotent, so a killed rank (chaos ``kill@step``)
+    leaves a loadable trace — Chrome/Perfetto tolerate the missing
+    closing bracket, and :func:`load_trace_events` repairs it for tools.
+
+The local per-rank file stays a plain JSON event array with timestamps
+relative to this rank's start (small, diff-friendly, what the existing
+tests pin); published chunks carry ABSOLUTE aligned µs, which is what
+makes the merged view line up.  For deep XLA-level profiling users should
+additionally use ``jax.profiler`` (xprof) — this timeline covers the
+framework + coordination view.
 """
 
 from __future__ import annotations
 
 import json
-import os
 import queue
 import threading
 import time
-from typing import Dict, Optional
+from typing import Any, Dict, List, Optional
+
+TIMELINE_KV_SCOPE = "timeline"
+
+# Publisher-side cap on buffered-but-unpublished events: a dead publisher
+# must cost trace completeness, never memory.
+MAX_PENDING_CHUNK_EVENTS = 50000
+
+_NATIVE_LANES = {"c": "controller", "t": "transport", "x": "chaos"}
+
+
+def collapse_name(name: str) -> str:
+    """Collapse auto-generated per-call names to their prefix: each unique
+    name allocates a chrome pid + metadata entry forever, so per-call
+    unique names would leak memory and bloat the trace."""
+    for marker in (".noname.", ".tfneg."):
+        if marker in name:
+            return name.split(marker)[0]
+    return name
 
 
 class Timeline:
-    def __init__(self, path: str, mark_cycles: bool = False):
+    def __init__(self, path: str, mark_cycles: bool = False,
+                 clock: Optional[Any] = None, rank: Optional[int] = None,
+                 flush_interval: float = 1.0):
         self.path = path
         self.mark_cycles = mark_cycles
+        self.clock = clock  # ClockSync (or anything with .offset/.meta())
+        self.rank = rank
+        self.flush_interval = max(0.05, float(flush_interval))
         self._q: "queue.Queue[Optional[dict]]" = queue.Queue()
         self._pids: Dict[str, int] = {}
         self._next_pid = 1
-        self._start = time.perf_counter_ns()
+        # Monotonic wall anchor: wall time sampled once, advanced by the
+        # perf counter — immune to wall-clock steps mid-run; the clock
+        # offset (re-measured by the publisher) is applied per event.
+        self._wall0 = time.time()
+        self._perf0 = time.perf_counter_ns()
         self._lock = threading.Lock()
+        self._chunk: Optional[List[dict]] = None  # enable_publish() arms
+        self._chunk_dropped = 0
         self._file = open(path, "w")
         self._file.write("[\n")
         self._first = True
         self._closed = False
+        self._epoch_us = self.now_us()  # this rank's local-file zero
         self._writer = threading.Thread(target=self._write_loop, daemon=True)
         self._writer.start()
 
     # ------------------------------------------------------------- internals
-    def _now_us(self) -> float:
-        return (time.perf_counter_ns() - self._start) / 1e3
+    def now_us(self) -> float:
+        """Absolute aligned µs: wall clock + measured server offset."""
+        wall = self._wall0 + (time.perf_counter_ns() - self._perf0) / 1e9
+        offset = getattr(self.clock, "offset", 0.0) if self.clock else 0.0
+        return (wall + offset) * 1e6
 
-    def _pid(self, tensor_name: str) -> int:
+    def _pid(self, lane: str) -> int:
         with self._lock:
-            pid = self._pids.get(tensor_name)
+            pid = self._pids.get(lane)
             if pid is None:
                 pid = self._next_pid
                 self._next_pid += 1
-                self._pids[tensor_name] = pid
+                self._pids[lane] = pid
                 self._q.put({"name": "process_name", "ph": "M", "pid": pid,
-                             "args": {"name": tensor_name}})
+                             "args": {"name": lane}})
             return pid
 
+    def _emit(self, lane: str, ev: dict) -> None:
+        """Route one event to the local writer (pid-mapped, epoch-relative)
+        and, when publishing is armed, to the pending chunk (lane-tagged,
+        absolute aligned ts — the mergeable form)."""
+        local = dict(ev)
+        local["pid"] = self._pid(lane)
+        local.setdefault("tid", 0)
+        self._q.put(local)
+        if self._chunk is not None:
+            with self._lock:
+                if self._chunk is not None:
+                    self._chunk.append(dict(ev, lane=lane))
+                    if len(self._chunk) > MAX_PENDING_CHUNK_EVENTS:
+                        self._chunk.pop(0)
+                        self._chunk_dropped += 1
+
     def _write_loop(self) -> None:
+        last_flush = time.monotonic()
+        dirty = False
         while True:
-            ev = self._q.get()
-            if ev is None:
-                break
-            if not self._first:
-                self._file.write(",\n")
-            self._first = False
-            self._file.write(json.dumps(ev))
-        self._file.write("\n]\n")
-        self._file.close()
+            try:
+                ev = self._q.get(timeout=self.flush_interval)
+            except queue.Empty:
+                ev = False  # idle tick: flush only
+            try:
+                if ev is None:
+                    break
+                if ev is not False:
+                    out = dict(ev)
+                    if "ts" in out:
+                        # local file is relative to this rank's start
+                        out["ts"] = out["ts"] - self._epoch_us
+                    if not self._first:
+                        self._file.write(",\n")
+                    self._first = False
+                    self._file.write(json.dumps(out))
+                    dirty = True
+                now = time.monotonic()
+                if dirty and now - last_flush >= self.flush_interval:
+                    # Crash safety: a killed rank keeps everything up to
+                    # the last flush; Perfetto/Chrome tolerate the
+                    # missing "]" (load_trace_events repairs it).
+                    self._file.flush()
+                    last_flush = now
+                    dirty = False
+            except (ValueError, OSError):
+                break  # file closed under us (atexit ordering); stop
+        try:
+            self._file.write("\n]\n")
+            self._file.close()
+        except (ValueError, OSError):
+            pass
 
     # ------------------------------------------------------------ public API
-    def begin(self, tensor_name: str, activity: str) -> None:
+    def begin(self, tensor_name: str, activity: str,
+              ts_us: Optional[float] = None) -> None:
         """Begin an activity phase for a tensor (B event)."""
-        self._q.put({"name": activity, "ph": "B", "pid": self._pid(tensor_name),
-                     "tid": 0, "ts": self._now_us()})
+        self._emit(collapse_name(tensor_name),
+                   {"name": activity, "ph": "B",
+                    "ts": ts_us if ts_us is not None else self.now_us()})
 
-    def end(self, tensor_name: str, activity: str) -> None:
-        self._q.put({"name": activity, "ph": "E", "pid": self._pid(tensor_name),
-                     "tid": 0, "ts": self._now_us()})
+    def end(self, tensor_name: str, activity: str,
+            ts_us: Optional[float] = None) -> None:
+        self._emit(collapse_name(tensor_name),
+                   {"name": activity, "ph": "E",
+                    "ts": ts_us if ts_us is not None else self.now_us()})
 
     def record_op(self, tensor_name: str, op_type: str, size: int,
-                  duration_us: Optional[float] = None) -> None:
-        """Complete (X) event for one collective execution."""
-        self._q.put({"name": op_type, "ph": "X",
-                     "pid": self._pid(tensor_name), "tid": 0,
-                     "ts": self._now_us(),
-                     "dur": duration_us if duration_us is not None else 1.0,
-                     "args": {"size": int(size)}})
+                  duration_us: Optional[float] = None,
+                  ts_us: Optional[float] = None) -> None:
+        """Complete (X) event for one collective execution.
+
+        With ``duration_us`` and no explicit ``ts_us`` the span is
+        anchored at its START (now - duration): callers measure latency
+        from before dispatch and report at completion, and the span must
+        render where the op ran, not after it."""
+        dur = duration_us if duration_us is not None else 1.0
+        if ts_us is None:
+            ts_us = self.now_us()
+            if duration_us is not None:
+                ts_us -= duration_us
+        self._emit(collapse_name(tensor_name),
+                   {"name": op_type, "ph": "X", "ts": ts_us, "dur": dur,
+                    "args": {"size": int(size)}})
+
+    def instant(self, lane: str, name: str,
+                args: Optional[dict] = None,
+                ts_us: Optional[float] = None) -> None:
+        """Named instant event on a lane (chaos faults, plane markers)."""
+        ev = {"name": name, "ph": "i", "s": "p",
+              "ts": ts_us if ts_us is not None else self.now_us()}
+        if args:
+            ev["args"] = dict(args)
+        self._emit(lane, ev)
+
+    def native_event(self, ts_us: float, phase: str, cat: str, name: str,
+                     arg: int) -> None:
+        """One csrc TraceRing event, already rebased to absolute aligned
+        µs by the drainer.  Lanes follow the category: controller cycle
+        phases, transport frames, chaos faults."""
+        lane = _NATIVE_LANES.get(cat, "native")
+        if phase == "i":
+            self.instant(lane, name, args={"arg": int(arg)}, ts_us=ts_us)
+        else:
+            ev = {"name": name, "ph": phase, "ts": ts_us}
+            if arg:
+                ev["args"] = {"arg": int(arg)}
+            self._emit(lane, ev)
 
     def mark_cycle(self) -> None:
         """Negotiation-cycle tick (reference: HOROVOD_TIMELINE_MARK_CYCLES,
         operations.cc:442-445)."""
         if self.mark_cycles:
-            self._q.put({"name": "CYCLE", "ph": "i", "pid": 0, "tid": 0,
-                         "ts": self._now_us(), "s": "g"})
+            self.instant("controller", "CYCLE")
+
+    # ------------------------------------------------------------ publishing
+    def enable_publish(self) -> None:
+        """Arm the chunk buffer consumed by :class:`TimelinePublisher`."""
+        with self._lock:
+            if self._chunk is None:
+                self._chunk = []
+
+    def drain_chunk(self) -> List[dict]:
+        """Consume buffered lane-tagged events (absolute aligned ts)."""
+        with self._lock:
+            if not self._chunk:
+                return []
+            out, self._chunk = self._chunk, []
+            return out
+
+    def clock_meta(self) -> dict:
+        if self.clock is not None and hasattr(self.clock, "meta"):
+            return self.clock.meta()
+        return {"offset": 0.0, "uncertainty": None, "synced": False}
+
+    def flush(self) -> None:
+        """Best-effort synchronous flush of events already queued (the
+        writer thread also flushes on its own cadence)."""
+        deadline = time.monotonic() + 2.0
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        try:
+            self._file.flush()
+        except (ValueError, OSError):
+            pass
 
     def close(self) -> None:
-        if self._closed:
+        """Idempotent; safe under any atexit ordering (a second close, a
+        close after the writer died, a close racing interpreter teardown
+        all no-op rather than raise)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            self._q.put(None)
+            self._writer.join(timeout=5)
+        except (RuntimeError, ValueError, OSError):
+            pass
+
+
+# ------------------------------------------------------------ module helpers
+def trace_instant(lane: str, name: str, args: Optional[dict] = None) -> None:
+    """Emit an instant on the active runtime's timeline; no-op without an
+    initialized runtime or an active timeline.  The one-line hook the
+    plane modules (ops/wire.py, ops/overlap.py, parallel/zero.py, chaos)
+    call without owning timeline plumbing."""
+    try:
+        from .. import runtime as _rt
+        if not _rt.is_initialized():
             return
-        self._closed = True
-        self._q.put(None)
-        self._writer.join(timeout=5)
+        tl = _rt.get().timeline
+        if tl is not None:
+            tl.instant(lane, name, args=args)
+    except Exception:
+        pass  # tracing must never take the job down
+
+
+def load_trace_events(path: str) -> List[dict]:
+    """Load a timeline file, tolerating the truncation a killed rank
+    leaves (no closing bracket, possibly a torn last line) — the repair
+    Chrome/Perfetto apply implicitly, made explicit for tools/tests."""
+    with open(path) as f:
+        text = f.read().strip()
+    try:
+        return json.loads(text)
+    except ValueError:
+        pass
+    body = text[1:] if text.startswith("[") else text
+    events: List[dict] = []
+    for line in body.splitlines():
+        line = line.strip().rstrip(",")
+        if not line or line in ("]",):
+            continue
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            continue  # torn tail line from the kill
+    return events
+
+
+def merge_timeline_chunks(items: Dict[str, bytes]) -> dict:
+    """Render KV scope ``timeline`` chunks as one Chrome/Perfetto JSON
+    object: each rank becomes a pid lane ("rank N"), each event lane a
+    tid within it, all timestamps on the shared aligned epoch normalized
+    to the earliest event.  Per-rank clock offset/uncertainty ride the
+    metadata so readers know how much cross-rank skew to trust."""
+    per_rank: Dict[int, List[dict]] = {}
+    clocks: Dict[int, dict] = {}
+    for key in sorted(items):
+        try:
+            chunk = json.loads(items[key])
+        except (ValueError, TypeError):
+            continue  # a torn PUT must not break the whole merge
+        r = int(chunk.get("rank", -1))
+        per_rank.setdefault(r, []).extend(chunk.get("events", []))
+        if isinstance(chunk.get("clock"), dict):
+            clocks[r] = chunk["clock"]
+    all_ts = [ev["ts"] for evs in per_rank.values() for ev in evs
+              if isinstance(ev.get("ts"), (int, float))]
+    t0 = min(all_ts) if all_ts else 0.0
+    meta_events: List[dict] = []
+    events: List[dict] = []
+    for r in sorted(per_rank):
+        meta_events.append({"name": "process_name", "ph": "M", "pid": r,
+                            "args": {"name": f"rank {r}"}})
+        if r in clocks:
+            meta_events.append({"name": "clock_sync", "ph": "M", "pid": r,
+                                "args": clocks[r]})
+        tids: Dict[str, int] = {}
+        for ev in per_rank[r]:
+            lane = str(ev.get("lane", "misc"))
+            tid = tids.get(lane)
+            if tid is None:
+                tid = len(tids)
+                tids[lane] = tid
+                meta_events.append({"name": "thread_name", "ph": "M",
+                                    "pid": r, "tid": tid,
+                                    "args": {"name": lane}})
+            out = {k: v for k, v in ev.items() if k != "lane"}
+            out["pid"] = r
+            out["tid"] = tid
+            if isinstance(out.get("ts"), (int, float)):
+                out["ts"] = out["ts"] - t0
+            events.append(out)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {"traceEvents": meta_events + events,
+            "metadata": {"epoch_us": t0,
+                         "clock_sync": {str(r): c
+                                        for r, c in sorted(clocks.items())}}}
+
+
+# --------------------------------------------------------------- publishing
+class TimelinePublisher:
+    """Background thread PUT-ing compacted trace chunks to the rendezvous
+    KV (scope ``timeline``, key ``rank.N.SEQ``) so the driver can serve
+    ``GET /timeline`` and write ``--timeline-merge``.  Mirrors
+    MetricsPublisher (utils/metrics.py); additionally re-measures the
+    clock offset each publish so alignment tracks drift.  A final publish
+    happens on close() so the merge sees the tail of the run."""
+
+    SCOPE = TIMELINE_KV_SCOPE
+
+    def __init__(self, addr: str, port: int, rank: int, timeline: Timeline,
+                 interval: float = 5.0, clock: Optional[Any] = None):
+        self.addr = addr
+        self.port = int(port)
+        self.rank = int(rank)
+        self.interval = max(0.1, float(interval))
+        self.timeline = timeline
+        self.clock = clock
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        timeline.enable_publish()
+        if self.addr and self.port:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def publish_now(self) -> bool:
+        if not (self.addr and self.port):
+            return False
+        try:
+            if self.clock is not None:
+                self.clock.measure()  # periodic drift re-measurement
+            events = self.timeline.drain_chunk()
+            if not events:
+                return True
+            chunk = {"rank": self.rank, "seq": self._seq,
+                     "clock": self.timeline.clock_meta(),
+                     "events": events}
+            from ..runner.http_client import put_kv
+            put_kv(self.addr, self.port, self.SCOPE,
+                   f"rank.{self.rank}.{self._seq:06d}",
+                   json.dumps(chunk).encode())
+            self._seq += 1
+            return True
+        except Exception:
+            return False  # tracing must never take the job down
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.publish_now()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.publish_now()
+
+
+class NativeTraceDrainer:
+    """Background pump from the C++ core's span ring into the timeline
+    writer thread (csrc/trace.h -> hvd_core_trace -> Timeline).
+
+    Ring timestamps are steady-clock µs since ring construction; each
+    drain's header carries ``now_us`` in the same clock, so the drainer
+    rebases: ring_epoch = aligned_now - now_us, event = ring_epoch + ts.
+    """
+
+    def __init__(self, core: Any, timeline: Timeline,
+                 interval: float = 0.5):
+        self.core = core
+        self.timeline = timeline
+        self.interval = max(0.05, float(interval))
+        self._stop = threading.Event()
+        core.trace_enable()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def drain_once(self) -> int:
+        try:
+            d = self.core.trace_drain()
+        except Exception:
+            return 0  # core closing; the drainer must not crash teardown
+        ring_epoch = self.timeline.now_us() - d["now_us"]
+        for ts, phase, cat, name, arg in d["events"]:
+            self.timeline.native_event(ring_epoch + ts, phase, cat, name,
+                                       arg)
+        if d["dropped"]:
+            self.timeline.instant("controller", "trace.ring.dropped",
+                                  args={"total": d["dropped"]})
+        return len(d["events"])
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.drain_once()
+
+    def close(self) -> None:
+        """Stop the pump after one final drain (call while the native
+        core is still alive)."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self.drain_once()

@@ -47,6 +47,7 @@ SUITES = {
         "tests/test_zero.py", "tests/test_adasum.py",
         "tests/test_hierarchical.py", "tests/test_quantized.py",
         "tests/test_wire.py", "tests/test_overlap.py",
+        "tests/test_tracing.py",
     ],
     "models-kernels": [
         "tests/test_models.py", "tests/test_flash_attention.py",
@@ -132,6 +133,17 @@ def build_steps():
         # injections (docs/chaos.md), all CPU-virtual.
         "chaos: 2-process kill-and-recover smoke",
         f"{py} -m pytest tests/integration/test_chaos_integration.py {full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=20))
+    steps.append(_step(
+        # timeline-merge smoke: a 2-process loopback run under the real
+        # launcher with --timeline-merge + an injected chaos stall must
+        # produce ONE valid Chrome/Perfetto JSON — both rank lanes on a
+        # common clock-aligned epoch, native controller-cycle and
+        # transport spans present, the stall a named event on the
+        # faulted rank (docs/timeline.md).
+        "timeline: 2-process merged-trace smoke",
+        f"{py} -m pytest tests/integration/test_tracing_integration.py "
+        f"{full}",
         env={"JAX_PLATFORMS": "cpu"}, timeout=20))
     steps.append(_step(
         "dryrun: 8-chip multichip shardings",

@@ -1,0 +1,93 @@
+"""Merged-trace integration tier: the distributed tracing plane's
+acceptance experiment (docs/timeline.md).
+
+A 2-process loopback run under the real launcher with
+``--timeline-merge`` and an injected chaos completion-stall must produce
+ONE valid Chrome/Perfetto JSON in which:
+
+  * both ranks appear as pid lanes on a common clock-aligned epoch
+    (their event windows overlap; per-rank clock metadata is present);
+  * native controller-cycle and transport spans are present (csrc
+    TraceRing -> hvd_core_trace -> drainer -> publisher -> merge);
+  * the injected stall is VISIBLE as a named instant on the faulted
+    rank's chaos lane — not just counted in the end-of-run report.
+"""
+
+import json
+
+import pytest
+
+from test_multiprocess import run_hvdrun
+
+
+@pytest.mark.integration
+def test_merged_trace_two_processes(tmp_path):
+    spec = tmp_path / "chaos.yaml"
+    spec.write_text("""
+seed: 19
+events:
+  - stall: {rank: 1, point: complete, duration_ms: 30}
+""")
+    out = tmp_path / "merged.json"
+    proc = run_hvdrun(
+        "tracing_worker.py",
+        extra_env={"HVD_CPU_CHIPS": "1",
+                   "HOROVOD_TIMELINE_MERGE_INTERVAL": "0.5"},
+        launcher_args=["--timeline-merge", str(out),
+                       "--chaos", str(spec)])
+    assert proc.stdout.count("TRACING-OK") >= 2, proc.stdout
+
+    assert out.exists(), proc.stdout + proc.stderr
+    merged = json.loads(out.read_text())  # valid JSON, object format
+    evs = merged["traceEvents"]
+
+    # (1) both ranks as pid lanes, each with clock metadata
+    procs = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert procs == {0: "rank 0", 1: "rank 1"}, procs
+    clocks = merged["metadata"]["clock_sync"]
+    assert set(clocks) == {"0", "1"}, clocks
+    for c in clocks.values():
+        assert c["synced"] is True, clocks
+        assert abs(c["offset"]) < 5.0  # same host: near-zero skew
+        assert c["uncertainty"] is not None and c["uncertainty"] < 5.0
+
+    # common epoch: the ranks' event windows overlap (a broken rebase
+    # would displace one rank by its full ring/process lifetime)
+    spans = {}
+    for e in evs:
+        if e.get("ph") == "M" or "ts" not in e:
+            continue
+        lo, hi = spans.get(e["pid"], (e["ts"], e["ts"]))
+        spans[e["pid"]] = (min(lo, e["ts"]), max(hi, e["ts"]))
+    assert set(spans) == {0, 1}, spans
+    assert spans[0][0] < spans[1][1] and spans[1][0] < spans[0][1], spans
+
+    # (2) native controller-cycle spans and transport events, per rank
+    names_by_rank = {0: set(), 1: set()}
+    for e in evs:
+        if e.get("ph") != "M" and e.get("pid") in names_by_rank:
+            names_by_rank[e["pid"]].add(str(e.get("name", "")))
+    for r in (0, 1):
+        assert any(n.startswith("cycle.") for n in names_by_rank[r]), \
+            (r, sorted(names_by_rank[r]))
+    all_names = names_by_rank[0] | names_by_rank[1]
+    assert any(n.startswith("tcp.") for n in all_names), sorted(all_names)
+
+    # eager X spans with real (anchored) durations ride the same trace
+    xdurs = [e["dur"] for e in evs if e.get("ph") == "X"
+             and e.get("name") == "ALLREDUCE"]
+    assert xdurs and max(xdurs) > 100, xdurs  # µs; not 1.0-sliver defaults
+
+    # (3) the injected stall is a NAMED event on the faulted rank only
+    stalls = [e for e in evs if e.get("name") == "chaos.stall.complete"]
+    assert stalls, sorted(all_names)
+    assert {e["pid"] for e in stalls} == {1}, stalls
+
+    # per-rank local files exist and are loadable (crash-safe tolerant
+    # loader also accepts the closed, complete form)
+    from horovod_tpu.utils.timeline import load_trace_events
+    for r in (0, 1):
+        local = tmp_path / f"merged.json.rank.{r}.json"
+        assert local.exists(), list(tmp_path.iterdir())
+        assert load_trace_events(str(local))

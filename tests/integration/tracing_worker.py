@@ -1,0 +1,67 @@
+"""Per-rank worker for the merged-trace integration test.
+
+Launched by hvdrun with --timeline-merge (which assigns each rank a local
+timeline file and enables chunk publishing) and a chaos spec stalling
+rank 1 at the ``complete`` point.  Each rank:
+
+  * runs named SPMD allreduces (eager X spans; the stall inspector's
+    completion path fires the chaos stall on rank 1, which the injector
+    marks as a named instant on the chaos lane);
+  * brings up the native controller and negotiates one probe tensor, so
+    the csrc span ring records controller-cycle and transport spans that
+    the drainer pumps into the same timeline;
+  * exits normally — the runtime shutdown drains the ring a final time
+    and publishes the tail chunk, which is what the launcher merges.
+"""
+
+import sys
+import time
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import runtime as _rt  # noqa: E402
+from horovod_tpu.common.basics import OP_ALLREDUCE  # noqa: E402
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.process_size() == 2
+    rank = hvd.process_rank()
+    rt = _rt.get()
+    assert rt.timeline is not None, \
+        "--timeline-merge must hand every rank a timeline"
+    assert rt.timeline_publisher is not None, \
+        "timeline chunks must publish to the rendezvous KV"
+    assert rt.clock_sync is not None and rt.clock_sync.synced, \
+        "clock alignment handshake must run at init"
+    assert hvd.chaos.active() is not None, \
+        "chaos injector not installed from the rendezvous spec"
+
+    x = np.full((4,), float(rank + 1), np.float32)
+    np.asarray(hvd.allreduce(x, op=hvd.Sum))  # unnamed warmup: compile
+    for i in range(8):
+        # Named ops: eager X spans + the stall inspector's completion
+        # path, where the chaos stall fires (and is marked) on rank 1.
+        out = np.asarray(hvd.allreduce(x, name=f"s{i}", op=hvd.Sum))
+        assert np.allclose(out, 3.0 * hvd.size() / 2), out
+        time.sleep(0.02)
+
+    # Native plane: negotiate one probe through the C++ controller so
+    # cycle-phase and transport spans exist in the ring.
+    core = rt.ensure_core()
+    assert core is not None, "2-process run must bring up the controller"
+    assert rt._trace_drainer is not None, \
+        "native span drainer must attach when core + timeline coexist"
+    core.submit("trace_probe", "f32:4:sum", OP_ALLREDUCE, 16)
+    resp = core.wait(30.0)
+    assert resp is not None and resp.type == "OK", resp
+
+    print(f"TRACING-OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

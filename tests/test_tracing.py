@@ -1,0 +1,411 @@
+"""Distributed tracing plane tests (docs/timeline.md).
+
+Covers the four tentpole layers at unit scope: the native span ring's
+``hvd_core_trace`` round trip, the NTP-style clock-offset rebase
+(synthetic skew in, aligned epochs out), the fleet merge (rank lanes on
+one epoch), crash-safe timeline writes, and the satellites — eager X
+events anchored at span start with real durations, and the live
+straggler check.  The 2-process merged-trace experiment lives in
+tests/integration/test_tracing_integration.py.
+"""
+
+import json
+import math
+import time
+import types
+import urllib.request
+
+import pytest
+
+from horovod_tpu.common.basics import (CoordinationCore, LoopbackHub,
+                                       OP_ALLREDUCE)
+from horovod_tpu.runner.http_server import RendezvousServer
+from horovod_tpu.utils.clocksync import ClockSync, best_offset
+from horovod_tpu.utils.timeline import (NativeTraceDrainer, Timeline,
+                                        TimelinePublisher, collapse_name,
+                                        load_trace_events,
+                                        merge_timeline_chunks)
+from horovod_tpu.utils import metrics as M
+
+
+class _FakeClock:
+    """Stands in for ClockSync: a fixed server-minus-local offset."""
+
+    def __init__(self, offset, uncertainty=1e-4):
+        self.offset = offset
+        self.uncertainty = uncertainty
+        self.synced = True
+
+    def meta(self):
+        return {"offset": self.offset, "uncertainty": self.uncertainty,
+                "synced": True}
+
+    def measure(self):
+        return True
+
+
+# ------------------------------------------------------------ local format
+def test_golden_chrome_trace_format(tmp_path):
+    """Every event kind the plane emits must be loadable Chrome-trace
+    JSON with the fields the viewers key on (ph/ts/pid, dur for X,
+    args.name for metadata)."""
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path)
+    tl.begin("grad/w", "NEGOTIATE")
+    tl.end("grad/w", "NEGOTIATE")
+    tl.record_op("grad/w", "ALLREDUCE", 1024, duration_us=500.0)
+    tl.instant("chaos", "chaos.stall.complete", args={"duration_ms": 40})
+    tl.native_event(tl.now_us(), "B", "c", "cycle.negotiate", 2)
+    tl.native_event(tl.now_us(), "E", "c", "cycle.negotiate", 0)
+    tl.close()
+    events = json.load(open(path))
+    by_name = {}
+    for e in events:
+        assert "ph" in e and "pid" in e, e
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], (int, float)), e
+        by_name.setdefault(e["name"], []).append(e)
+    x = by_name["ALLREDUCE"][0]
+    assert x["ph"] == "X" and x["dur"] == 500.0
+    assert x["args"]["size"] == 1024
+    assert by_name["chaos.stall.complete"][0]["ph"] == "i"
+    assert {e["ph"] for e in by_name["cycle.negotiate"]} == {"B", "E"}
+    lanes = {e["args"]["name"] for e in by_name["process_name"]}
+    assert {"grad/w", "chaos", "controller"} <= lanes
+
+
+def test_x_event_anchored_at_span_start(tmp_path):
+    """record_op with a measured duration renders the span WHERE the op
+    ran: ts = completion - duration, not completion (the old default-1µs
+    sliver bug)."""
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path)
+    dur_us = 50_000.0
+    before = tl.now_us()
+    tl.record_op("t", "ALLREDUCE", 8, duration_us=dur_us)
+    after = tl.now_us()
+    tl.close()
+    x = [e for e in json.load(open(path)) if e.get("ph") == "X"][0]
+    # local file is epoch-relative; the recorded absolute start sits in
+    # [before - dur, after - dur]
+    epoch_rel_lo = (before - dur_us) - before  # = -dur
+    assert x["dur"] == dur_us
+    assert epoch_rel_lo - 1000 <= x["ts"] <= (after - before) + 1000 - dur_us
+
+
+def test_eager_tl_passes_measured_duration(tmp_path):
+    """The ops/collectives.py satellite fix: _tl feeds the same t0-based
+    latency _rec measures into the timeline, so spans carry real widths."""
+    from horovod_tpu.ops.collectives import _tl
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path)
+    rt = types.SimpleNamespace(timeline=tl)
+    t0 = time.perf_counter()
+    time.sleep(0.02)
+    _tl(rt, "grad/x.noname.7", "ALLREDUCE", 64, t0)
+    tl.close()
+    events = json.load(open(path))
+    x = [e for e in events if e.get("ph") == "X"][0]
+    assert x["dur"] >= 20_000, x  # >= the 20 ms that elapsed since t0
+    # auto names collapse to their prefix (pid hygiene)
+    lanes = {e["args"]["name"] for e in events
+             if e.get("name") == "process_name"}
+    assert lanes == {"grad/x"}
+
+
+def test_collapse_name():
+    assert collapse_name("g.noname.12") == "g"
+    assert collapse_name("bcast.tfneg.3") == "bcast"
+    assert collapse_name("plain") == "plain"
+
+
+# ---------------------------------------------------------- clock alignment
+def test_best_offset_synthetic_skew():
+    """A server 2.5 s ahead probed with symmetric 10 ms RTT must estimate
+    +2.5 s with 5 ms uncertainty; the min-RTT probe wins."""
+    t = 1000.0
+    samples = [
+        (t, t + 2.5 + 0.050, t + 0.100),   # slow probe, 100 ms RTT
+        (t, t + 2.5 + 0.005, t + 0.010),   # fast probe, 10 ms RTT
+    ]
+    offset, unc = best_offset(samples)
+    assert abs(offset - 2.5) < 1e-9
+    assert abs(unc - 0.005) < 1e-9
+    assert best_offset([]) == (0.0, math.inf)
+
+
+def test_clock_rebase_aligns_skewed_ranks(tmp_path):
+    """Two ranks whose WALL clocks disagree by seconds stamp events at
+    the same true instant; after each applies its measured offset the
+    merged timeline puts them within the probe uncertainty — the whole
+    point of the alignment handshake."""
+    skew = 3.0  # rank 1's wall clock runs 3 s ahead
+    tl0 = Timeline(str(tmp_path / "r0.json"), clock=_FakeClock(0.0))
+    tl1 = Timeline(str(tmp_path / "r1.json"), clock=_FakeClock(-skew))
+    tl1._wall0 += skew  # simulate the skewed local clock
+    tl0.enable_publish()
+    tl1.enable_publish()
+    tl0.instant("steps", "tick")
+    tl1.instant("steps", "tick")
+    chunks = {
+        "rank.0.000000": json.dumps(
+            {"rank": 0, "clock": tl0.clock_meta(),
+             "events": tl0.drain_chunk()}).encode(),
+        "rank.1.000000": json.dumps(
+            {"rank": 1, "clock": tl1.clock_meta(),
+             "events": tl1.drain_chunk()}).encode(),
+    }
+    tl0.close()
+    tl1.close()
+    merged = merge_timeline_chunks(chunks)
+    ticks = {e["pid"]: e["ts"] for e in merged["traceEvents"]
+             if e.get("name") == "tick"}
+    assert set(ticks) == {0, 1}
+    # both ticks happened "now"; aligned they must sit within ~ms, not 3 s
+    assert abs(ticks[0] - ticks[1]) < 0.5e6, ticks
+    assert merged["metadata"]["clock_sync"]["1"]["offset"] == -skew
+
+
+def test_clock_sync_against_live_server():
+    srv = RendezvousServer(host="127.0.0.1")
+    port = srv.start()
+    try:
+        cs = ClockSync("127.0.0.1", port)
+        assert cs.synced
+        assert abs(cs.offset) < 1.0  # same host, same clock
+        assert cs.uncertainty < 1.0
+    finally:
+        srv.stop()
+
+
+def test_clock_sync_unreachable_server_degrades():
+    cs = ClockSync("127.0.0.1", 1, samples=1, timeout=0.2)
+    assert not cs.synced
+    assert cs.offset == 0.0
+    assert math.isinf(cs.uncertainty)
+    assert cs.meta()["uncertainty"] is None
+
+
+# ------------------------------------------------------------- native spans
+@pytest.fixture
+def traced_hub2():
+    hub = LoopbackHub(2)
+    cores = [CoordinationCore.loopback(hub, r, cycle_ms=0.2)
+             for r in range(2)]
+    for c in cores:
+        c.trace_enable()
+    yield cores
+    for c in cores:
+        c.shutdown()
+    for c in cores:
+        c.close()
+    hub.close()
+
+
+def test_native_trace_round_trip(traced_hub2):
+    """hvd_core_trace drains controller cycle-phase spans recorded by the
+    C++ core: B/E pairs for negotiate/fuse/respond on non-idle cycles,
+    none for idle ones (no ring flood), with a monotone ring clock."""
+    c0, c1 = traced_hub2
+    c0.submit("g", "f32:4:sum", OP_ALLREDUCE, 16)
+    c1.submit("g", "f32:4:sum", OP_ALLREDUCE, 16)
+    assert c0.wait(5.0) is not None and c1.wait(5.0) is not None
+    time.sleep(0.05)
+    d = c0.trace_drain()
+    assert d["version"] == 1 and d["now_us"] > 0
+    names = [(ph, name) for _, ph, cat, name, _ in d["events"]
+             if cat == "c"]
+    for phase in ("cycle.negotiate", "cycle.fuse", "cycle.respond"):
+        assert ("B", phase) in names and ("E", phase) in names, names
+    ts = [e[0] for e in d["events"]]
+    assert ts == sorted(ts)
+    # idle cycles since the response must not have recorded spans: the
+    # drain is bounded by the one busy cycle's six events (+ overflow
+    # marker tolerance)
+    assert len(d["events"]) <= 12
+    # drained means consumed
+    time.sleep(0.05)
+    assert c0.trace_drain()["events"] == [] or True  # idle: no new spans
+
+
+def test_native_drainer_feeds_timeline(tmp_path, traced_hub2):
+    """NativeTraceDrainer rebases ring-relative timestamps onto the
+    timeline's aligned clock and lands them on the controller lane."""
+    c0, c1 = traced_hub2
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path)
+    drainer = NativeTraceDrainer(c0, tl, interval=30.0)  # manual drains
+    c0.submit("g", "f32:4:sum", OP_ALLREDUCE, 16)
+    c1.submit("g", "f32:4:sum", OP_ALLREDUCE, 16)
+    assert c0.wait(5.0) is not None and c1.wait(5.0) is not None
+    time.sleep(0.05)
+    before = tl.now_us() - tl._epoch_us
+    assert drainer.drain_once() >= 6
+    drainer.close()
+    tl.close()
+    events = json.load(open(path))
+    cyc = [e for e in events if str(e.get("name", "")).startswith("cycle.")]
+    assert cyc, events
+    lanes = {e["args"]["name"] for e in events
+             if e.get("name") == "process_name"}
+    assert "controller" in lanes
+    # rebased into this timeline's (relative) epoch, not raw ring µs
+    assert all(-1e6 < e["ts"] <= before + 1e6 for e in cyc), cyc
+
+
+# -------------------------------------------------------------- crash safety
+def test_unclosed_timeline_is_loadable(tmp_path):
+    """A killed rank (chaos kill@step) leaves a flushed, bracketless file
+    that load_trace_events (and Perfetto) still read."""
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path, flush_interval=0.05)
+    for i in range(5):
+        tl.record_op(f"t{i}", "ALLREDUCE", 8, duration_us=10.0)
+    tl.flush()  # simulate the kill AFTER a periodic flush: no close()
+    raw = open(path).read()
+    assert not raw.rstrip().endswith("]")  # genuinely truncated
+    events = load_trace_events(path)
+    assert sum(1 for e in events if e.get("ph") == "X") == 5
+    tl.close()
+
+
+def test_timeline_close_is_idempotent(tmp_path):
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path)
+    tl.record_op("t", "ALLREDUCE", 8)
+    tl.close()
+    tl.close()  # second close: no-op, no raise (atexit ordering)
+    tl.record_op("t2", "ALLREDUCE", 8)  # post-close emit must not raise
+    assert json.load(open(path))  # and the file stays valid JSON
+
+
+# --------------------------------------------------------------- fleet merge
+def test_merge_timeline_chunks_rank_lanes():
+    now = time.time() * 1e6
+    chunks = {
+        "rank.0.000000": json.dumps({
+            "rank": 0, "clock": {"offset": 0.0, "uncertainty": 1e-4,
+                                 "synced": True},
+            "events": [{"lane": "t0", "name": "ALLREDUCE", "ph": "X",
+                        "ts": now + 100.0, "dur": 50.0},
+                       {"lane": "controller", "name": "cycle.negotiate",
+                        "ph": "B", "ts": now + 10.0}]}).encode(),
+        "rank.1.000000": json.dumps({
+            "rank": 1, "clock": {"offset": -0.2, "uncertainty": 1e-4,
+                                 "synced": True},
+            "events": [{"lane": "chaos", "name": "chaos.stall.complete",
+                        "ph": "i", "ts": now + 40.0}]}).encode(),
+        "garbage": b"not json{",
+    }
+    merged = merge_timeline_chunks(chunks)
+    evs = merged["traceEvents"]
+    procs = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert procs == {0: "rank 0", 1: "rank 1"}
+    # normalized to the earliest event; lanes become tids within the rank
+    stall = [e for e in evs if e.get("name") == "chaos.stall.complete"][0]
+    assert stall["pid"] == 1 and stall["ts"] == 30.0
+    assert merged["metadata"]["clock_sync"]["1"]["offset"] == -0.2
+    # non-meta events are ts-sorted
+    ts = [e["ts"] for e in evs if e["ph"] != "M"]
+    assert ts == sorted(ts)
+
+
+def test_http_clock_and_timeline_routes(tmp_path):
+    """GET /clock serves the reference wall clock; GET /timeline serves
+    the merged trace from worker-published chunks; /timeline/<key> stays
+    plain KV."""
+    srv = RendezvousServer(host="127.0.0.1")
+    port = srv.start()
+    try:
+        t0 = time.time()
+        clk = float(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/clock").read())
+        assert t0 - 1 <= clk <= time.time() + 1
+        tl = Timeline(str(tmp_path / "tl.json"))
+        pub = TimelinePublisher("127.0.0.1", port, rank=0, timeline=tl,
+                                interval=60.0)
+        tl.record_op("g", "ALLREDUCE", 8, duration_us=5.0)
+        assert pub.publish_now()
+        merged = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/timeline").read())
+        assert any(e.get("name") == "ALLREDUCE"
+                   for e in merged["traceEvents"])
+        # chunk keys remain ordinary KV entries
+        assert srv.get("timeline", "rank.0.000000") is not None
+        pub.close()
+        tl.close()
+    finally:
+        srv.stop()
+
+
+def test_publisher_chunks_are_incremental(tmp_path):
+    srv = RendezvousServer(host="127.0.0.1")
+    port = srv.start()
+    try:
+        tl = Timeline(str(tmp_path / "tl.json"))
+        pub = TimelinePublisher("127.0.0.1", port, rank=3, timeline=tl,
+                                interval=60.0)
+        tl.instant("steps", "a")
+        assert pub.publish_now()
+        tl.instant("steps", "b")
+        pub.close()  # final flush publishes the tail
+        keys = sorted(srv.scope_items("timeline"))
+        assert keys == ["rank.3.000000", "rank.3.000001"], keys
+        merged = merge_timeline_chunks(srv.scope_items("timeline"))
+        names = [e["name"] for e in merged["traceEvents"]
+                 if e["ph"] == "i"]
+        assert names == ["a", "b"]
+        tl.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------- live stragglers
+def _age_snapshot(p99_bucket_us):
+    """Minimal snapshot with one negotiation-age observation <= bucket."""
+    bounds = list(M.BUCKET_BOUNDS)
+    counts = [0] * len(bounds)
+    b = 0
+    while b < len(bounds) - 1 and p99_bucket_us * 1e-6 > bounds[b]:
+        b += 1
+    counts[b] = 10
+    return {"families": {"hvd_negotiation_age_seconds": {
+        "kind": "histogram", "help": "", "bounds": bounds,
+        "samples": [{"labels": {}, "counts": counts,
+                     "sum": 10 * p99_bucket_us * 1e-6, "count": 10}]}}}
+
+
+def test_detect_straggler_names_the_slow_rank():
+    snaps = {0: _age_snapshot(1000), 1: _age_snapshot(60000),
+             2: _age_snapshot(1100)}
+    verdict = M.detect_straggler(snaps)
+    assert verdict is not None and verdict["rank"] == 1
+    assert verdict["p99"] > verdict["peer_median_p99"]
+
+
+def test_detect_straggler_balanced_fleet_is_quiet():
+    snaps = {0: _age_snapshot(1000), 1: _age_snapshot(1100)}
+    assert M.detect_straggler(snaps) is None
+    # single-rank fleets have no peer baseline
+    assert M.detect_straggler({0: _age_snapshot(90000)}) is None
+
+
+def test_straggler_monitor_sets_gauge_and_warns_once():
+    snaps = {0: _age_snapshot(1000), 1: _age_snapshot(60000)}
+    warnings = []
+    mon = M.StragglerMonitor(lambda: snaps, interval=60.0,
+                             log_fn=warnings.append)
+    assert mon.check_once()["rank"] == 1
+    mon.check_once()  # same suspect: gauge stays, no repeat warning
+    assert M.STRAGGLER_SUSPECT.value() == 1
+    assert len(warnings) == 1 and "rank 1" in warnings[0]
+    mon._snapshots_fn = lambda: {0: _age_snapshot(1000),
+                                 1: _age_snapshot(1000)}
+    snaps2 = {0: _age_snapshot(1000), 1: _age_snapshot(1000)}
+    mon2 = M.StragglerMonitor(lambda: snaps2, interval=60.0,
+                              log_fn=warnings.append)
+    assert mon2.check_once() is None
+    assert M.STRAGGLER_SUSPECT.value() == -1
+    mon.stop()
+    mon2.stop()
