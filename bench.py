@@ -142,6 +142,25 @@ def fail(reason: str, cause: str = "bench-crash", **extra) -> int:
     return 1
 
 
+def classify_child_exit(rc) -> str:
+    """Child exit status -> taxonomy label (the sweep-row / artifact
+    counterpart of horovod_tpu.postmortem.classify_exit — duplicated so
+    the bench supervisor stays importable without the package): a
+    negative rc is a signal death, which is exactly the flash-crash
+    attribution VERDICT r5 Weak #3 was missing behind a bare rc=1."""
+    if rc is None:
+        return "timeout"
+    if rc == 0:
+        return "clean"
+    if rc < 0:
+        import signal as _sig
+        try:
+            return f"signal:{_sig.Signals(-rc).name}"
+        except ValueError:
+            return f"signal:{-rc}"
+    return f"error:rc={rc}"
+
+
 def metrics_summary() -> dict:
     """Condensed `hvd.metrics_snapshot()` embedded in every bench JSON so
     artifact rows carry controller-level evidence (plan-cache hit rate,
@@ -261,23 +280,41 @@ def supervise(argv) -> int:
                         probe_timeout_s=probe_timeout)
 
     def run_child(extra_args, budget_s):
+        """(json_line|None, status, exit_cause, stderr_tail).
+
+        stderr is captured and re-emitted after the child exits: the
+        console/nohup log keeps the full story while the last ~2 KB ride
+        the artifact, so a crash leaves its traceback in the JSON row
+        instead of scrolled off a console (VERDICT r5 Weak #3: three
+        rounds of flash rows said `rc=1` and nothing else)."""
         cmd = [sys.executable, os.path.abspath(__file__), "--inner",
                *argv, *extra_args]
         try:
-            res = subprocess.run(cmd, stdout=subprocess.PIPE, text=True,
+            res = subprocess.run(cmd, stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE, text=True,
                                  timeout=max(30.0, budget_s))
-        except subprocess.TimeoutExpired:
-            return None, "timeout"
+            rc, stderr = res.returncode, res.stderr or ""
+        except subprocess.TimeoutExpired as e:
+            rc = None
+            stderr = (e.stderr.decode(errors="replace")
+                      if isinstance(e.stderr, bytes) else (e.stderr or ""))
+        if stderr:
+            sys.stderr.write(stderr)
+            sys.stderr.flush()
+        stderr_tail = stderr[-2000:]
+        if rc is None:
+            return None, "timeout", "timeout", stderr_tail
         line = ""
         for ln in (res.stdout or "").strip().splitlines():
             if ln.startswith("{"):
                 line = ln
-        return (line or None), f"rc={res.returncode}"
+        return (line or None), f"rc={rc}", classify_child_exit(rc), \
+            stderr_tail
 
     # Reserve enough of the deadline that the --steps 10 fallback (guarded
     # on >120s below) is actually reachable when the full bench times out.
     remaining = deadline - (time.monotonic() - t_start)
-    line, status = run_child([], remaining - 180.0)
+    line, status, exit_cause, stderr_tail = run_child([], remaining - 180.0)
     if line:
         print(line)
         return 0 if "BENCH_INVALID" not in line else 1
@@ -288,7 +325,8 @@ def supervise(argv) -> int:
     if remaining > 120.0 and "--steps" not in " ".join(argv):
         print(f"full bench failed ({status}); retrying with --steps 10 "
               f"({remaining:.0f}s left)", file=sys.stderr)
-        line, status = run_child(["--steps", "10"], remaining - 15.0)
+        line, status, exit_cause, stderr_tail = \
+            run_child(["--steps", "10"], remaining - 15.0)
         if line:
             print(line)
             return 0 if "BENCH_INVALID" not in line else 1
@@ -296,11 +334,14 @@ def supervise(argv) -> int:
     # usually presents as a hang) while the tunnel dropped is an
     # infrastructure event, not a bench bug (the r4 flash-mxu trio was
     # ambiguous exactly here).  One <=55s probe on an already-failed
-    # run is cheap.
+    # run is cheap.  The artifact carries the exit classification AND
+    # the stderr tail so the next hardware window can attribute the
+    # crash without re-reproducing it.
     cause = "timeout" if status == "timeout" else "bench-crash"
     if "--cpu" not in argv and probe_tpu(probe_timeout):
         cause = "tunnel-down-during-run"
     return fail(f"bench child produced no JSON ({status})", cause=cause,
+                exit_cause=exit_cause, stderr_tail=stderr_tail,
                 elapsed_s=round(time.monotonic() - t_start, 1))
 
 

@@ -7,6 +7,7 @@
 #include <string>
 
 #include "core.h"
+#include "postmortem.h"
 
 using namespace hvdtpu;
 
@@ -117,6 +118,9 @@ void* hvd_core_create_tcp(int rank, int size, const char* addr, int port,
 
 void hvd_core_destroy(void* h) {
   ApiHandle* ah = static_cast<ApiHandle*>(h);
+  // A fatal signal after this point must find no registration, not a
+  // dangling pointer (postmortem.cc flight recorder).
+  FlightRecorderDisarm(ah->core);
   delete ah->core;
   delete ah;
 }
@@ -264,6 +268,54 @@ int hvd_core_metrics(void* h, char* buf, int buflen) {
     buf[copy] = '\0';
   }
   return n;
+}
+
+// ---------------------------------------------------------------- postmortem
+// Liveness snapshot (postmortem plane, docs/postmortem.md): a versioned
+// text block in the hvd_core_metrics mold —
+//   hvd_health_v1
+//   <key> <value>               (one line per field)
+// New keys APPEND; parsers key on names — the versioning contract.
+// Returns the full length required; truncation semantics match
+// hvd_core_metrics (always NUL-terminated, caller retries bigger).
+int hvd_core_health(void* h, char* buf, int buflen) {
+  Core* core = static_cast<ApiHandle*>(h)->core;
+  Core::HealthSnapshot hs = core->health_snapshot();
+  std::string t = "hvd_health_v1\n";
+  auto kv = [&t](const char* k, long long v) {
+    t += k;
+    t += ' ';
+    t += std::to_string(v);
+    t += '\n';
+  };
+  kv("now_us", static_cast<long long>(hs.now_us));
+  kv("cycles", static_cast<long long>(hs.cycles));
+  kv("last_progress_age_us", static_cast<long long>(hs.last_progress_age_us));
+  kv("queue_depth", hs.queue_depth);
+  kv("responses_pending", hs.responses_pending);
+  kv("transport_healthy", hs.transport_healthy ? 1 : 0);
+  kv("shutdown", hs.shutdown ? 1 : 0);
+  int n = static_cast<int>(t.size());
+  if (buf && buflen > 0) {
+    int copy = n < buflen - 1 ? n : buflen - 1;
+    memcpy(buf, t.data(), copy);
+    buf[copy] = '\0';
+  }
+  return n;
+}
+
+// Arm the crash-time flight recorder: fatal signals / std::terminate
+// dump this core's flight record to `path` (postmortem.cc).  Implies
+// trace-ring recording so the record's span tail is populated.
+void hvd_core_flight_enable(void* h, const char* path) {
+  FlightRecorderArm(static_cast<ApiHandle*>(h)->core, path);
+}
+
+// Explicit flight dump ("take a black-box snapshot now"): same record
+// format, reason "explicit:<reason>".  0 on success, -1 on open failure.
+int hvd_core_flight_dump(void* h, const char* path, const char* reason) {
+  if (!path || !path[0]) return -1;
+  return FlightDump(static_cast<ApiHandle*>(h)->core, path, reason);
 }
 
 // ------------------------------------------------------------------- tracing

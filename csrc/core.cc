@@ -29,6 +29,8 @@ int Core::Submit(const Request& req) {
     return -1;  // reference: DUPLICATE_NAME_ERROR (tensor_queue.cc)
   inflight_.insert(req.name);
   pending_.push_back(req);
+  inflight_count_.store(static_cast<int64_t>(inflight_.size()),
+                        std::memory_order_relaxed);
   return 0;
 }
 
@@ -37,6 +39,8 @@ bool Core::Poll(Response* out) {
   if (responses_.empty()) return false;
   *out = responses_.front();
   responses_.pop();
+  responses_pending_.store(static_cast<int64_t>(responses_.size()),
+                           std::memory_order_relaxed);
   return true;
 }
 
@@ -48,12 +52,29 @@ bool Core::Wait(Response* out, double timeout_s) {
   if (!got || responses_.empty()) return false;
   *out = responses_.front();
   responses_.pop();
+  responses_pending_.store(static_cast<int64_t>(responses_.size()),
+                           std::memory_order_relaxed);
   return true;
 }
 
 void Core::Shutdown() { shutdown_requested_.store(true); }
 
 ControllerStats Core::stats() const { return controller_->stats(); }
+
+Core::HealthSnapshot Core::health_snapshot() const {
+  HealthSnapshot h;
+  h.now_us = trace_.NowUs();
+  // Plain read of the cycle-loop-owned counter: a torn value is a
+  // cycle count off by one, acceptable for a liveness probe.
+  h.cycles = controller_->stats().cycles;
+  uint64_t lp = last_progress_us_.load(std::memory_order_relaxed);
+  h.last_progress_age_us = h.now_us > lp ? h.now_us - lp : 0;
+  h.queue_depth = inflight_count_.load(std::memory_order_relaxed);
+  h.responses_pending = responses_pending_.load(std::memory_order_relaxed);
+  h.transport_healthy = healthy_.load(std::memory_order_relaxed);
+  h.shutdown = stopped_.load(std::memory_order_relaxed);
+  return h;
+}
 
 void Core::EnableAutotune(const ParameterManager::Options& opts) {
   std::lock_guard<std::mutex> lk(mu_);
@@ -110,8 +131,15 @@ void Core::Loop() {
         for (const auto& n : r.names) inflight_.erase(n);
         responses_.push(std::move(r));
       }
+      inflight_count_.store(static_cast<int64_t>(inflight_.size()),
+                            std::memory_order_relaxed);
+      responses_pending_.store(static_cast<int64_t>(responses_.size()),
+                               std::memory_order_relaxed);
       if (!out.empty()) cv_.notify_all();
     }
+    // Postmortem plane: a completed cycle IS the liveness heartbeat of
+    // this core — health_snapshot ages against this stamp.
+    last_progress_us_.store(trace_.NowUs(), std::memory_order_relaxed);
     if (got_shutdown) {
       stopped_.store(true);
       cv_.notify_all();

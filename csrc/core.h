@@ -55,6 +55,22 @@ class Core {
   }
   int64_t fusion_threshold() const { return controller_->fusion_threshold(); }
 
+  // Liveness snapshot for the postmortem plane (hvd_core_health +
+  // flight records, csrc/postmortem.cc).  Built from atomics and plain
+  // reads only — NO locks — so it is safe from a fatal-signal handler
+  // and can never block a healthy caller behind a wedged cycle loop
+  // (the one situation where you most want to read it).
+  struct HealthSnapshot {
+    uint64_t now_us = 0;               // ring steady clock at snapshot
+    uint64_t cycles = 0;               // controller cycles completed
+    uint64_t last_progress_age_us = 0; // ring µs since the last cycle
+    int64_t queue_depth = 0;           // submitted, not yet responded
+    int64_t responses_pending = 0;     // responded, not yet consumed
+    bool transport_healthy = false;
+    bool shutdown = false;
+  };
+  HealthSnapshot health_snapshot() const;
+
   // Tracing plane (trace.h): the ring is always allocated but disabled
   // (one relaxed atomic load per would-be event); EnableTrace flips it
   // on and hvd_core_trace drains it (csrc/c_api.cc).
@@ -88,6 +104,12 @@ class Core {
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> stopped_{false};
   std::atomic<bool> healthy_{true};
+  // Postmortem-plane counters (health_snapshot): maintained as atomics
+  // beside the mu_-guarded structures they shadow, because the crash
+  // path must read them lock-free.
+  std::atomic<uint64_t> last_progress_us_{0};
+  std::atomic<int64_t> inflight_count_{0};
+  std::atomic<int64_t> responses_pending_{0};
   std::thread thread_;
 };
 

@@ -100,8 +100,34 @@ class TraceRing {
     return d;
   }
 
+  // Crash-time, non-consuming copy of the newest <= max_events events
+  // (flight recorder, csrc/postmortem.cc).  Lock acquisition is a BOUNDED
+  // spin: a fatal-signal handler may run while the interrupted thread
+  // holds the spinlock, and a handler that spins forever turns a crash
+  // into a hang — forensics prefers a possibly-torn read over no dump.
+  // Returns the event count; *dropped_out (optional) gets the overwrite
+  // counter from the same best-effort read.
+  size_t SnapshotTail(Event* out, size_t max_events,
+                      uint64_t* dropped_out = nullptr) {
+    bool locked = TryLock(100000);
+    size_t n = head_ - tail_;
+    if (n > buf_.size()) n = buf_.size();
+    if (n > max_events) n = max_events;
+    size_t start = head_ - n;
+    for (size_t i = 0; i < n; i++)
+      out[i] = buf_[(start + i) % buf_.size()];
+    if (dropped_out) *dropped_out = dropped_;
+    if (locked) Unlock();
+    return n;
+  }
+
  private:
   void Lock() { while (lock_.test_and_set(std::memory_order_acquire)) {} }
+  bool TryLock(int spins) {
+    for (int i = 0; i < spins; i++)
+      if (!lock_.test_and_set(std::memory_order_acquire)) return true;
+    return false;
+  }
   void Unlock() { lock_.clear(std::memory_order_release); }
 
   std::vector<Event> buf_;

@@ -196,6 +196,10 @@ from . import tune  # noqa: E402
 # deterministic fault injection (hvdrun --chaos; docs/chaos.md) —
 # training loops call hvd.chaos.step(i) to clock scheduled faults
 from . import chaos  # noqa: E402
+# crash forensics (hvdrun --postmortem / hvdrun doctor;
+# docs/postmortem.md) — training loops call
+# hvd.postmortem.record_step(i) so heartbeats carry step progress
+from . import postmortem  # noqa: E402
 
 
 __all__ = [
@@ -221,4 +225,5 @@ __all__ = [
     "CheckpointManager", "save_checkpoint", "restore_checkpoint",
     "flash_attention", "run",
     "__version__", "probe_backend", "metrics_snapshot", "chaos",
+    "postmortem",
 ]

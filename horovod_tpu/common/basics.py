@@ -113,6 +113,14 @@ def load_library() -> ctypes.CDLL:
         lib.hvd_core_trace_enable.argtypes = [ctypes.c_void_p]
         lib.hvd_core_trace.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                        ctypes.c_int]
+        # postmortem plane (csrc/postmortem.{h,cc}; docs/postmortem.md)
+        lib.hvd_core_health.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int]
+        lib.hvd_core_flight_enable.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p]
+        lib.hvd_core_flight_dump.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p,
+                                             ctypes.c_char_p]
         # autotune / optim surface
         dptr = ctypes.POINTER(ctypes.c_double)
         lib.hvd_core_enable_autotune.argtypes = [
@@ -533,6 +541,49 @@ class CoordinationCore:
             elif len(parts) == 2:
                 out["counters"][parts[0]] = int(parts[1])
         return out
+
+    def health(self) -> dict:
+        """Liveness snapshot (csrc/c_api.cc ``hvd_core_health``): name-
+        keyed integer fields — ``now_us`` (ring steady clock), ``cycles``,
+        ``last_progress_age_us``, ``queue_depth``, ``responses_pending``,
+        ``transport_healthy``, ``shutdown``.  Built lock-free natively, so
+        it answers even while the cycle loop is wedged — which is when
+        the postmortem plane asks (docs/postmortem.md).  Unknown lines
+        from a newer library are ignored (hvd_core_metrics contract)."""
+        n = self._lib.hvd_core_health(self._h, self._buf, len(self._buf))
+        if n >= len(self._buf):
+            self._grow(n)
+            n = self._lib.hvd_core_health(self._h, self._buf,
+                                          len(self._buf))
+        lines = self._buf.value.decode().splitlines()
+        if not lines or not lines[0].startswith("hvd_health_v"):
+            raise RuntimeError(f"unrecognized native health header: "
+                               f"{lines[:1]!r}")
+        out = {"version": int(lines[0].split("hvd_health_v", 1)[1])}
+        for line in lines[1:]:
+            parts = line.split()
+            if len(parts) == 2:
+                try:
+                    out[parts[0]] = int(parts[1])
+                except ValueError:
+                    continue
+        return out
+
+    def flight_enable(self, path: str) -> None:
+        """Arm the crash-time flight recorder: fatal signals and
+        std::terminate dump this core's flight record to ``path``
+        (csrc/postmortem.cc); implies trace-ring recording so the span
+        tail is populated.  Parse the record with
+        ``horovod_tpu.postmortem.parse_flight_record``."""
+        self._lib.hvd_core_flight_enable(self._h, path.encode())
+
+    def flight_dump(self, path: str, reason: str = "") -> bool:
+        """Explicit flight dump (``hvd_core_flight_dump``): write the
+        black-box record now, without waiting for a crash.  True when
+        the file was written."""
+        rc = self._lib.hvd_core_flight_dump(self._h, path.encode(),
+                                            reason.encode())
+        return rc == 0
 
     def trace_enable(self) -> None:
         """Activate the native span ring (csrc/trace.h).  Until called,

@@ -122,6 +122,34 @@ _knob("HOROVOD_METRICS", False, _parse_bool,
       "report.  hvdrun --metrics-port implies this.")
 _knob("HOROVOD_METRICS_INTERVAL", 5.0, float,
       "Seconds between metric-snapshot publishes to the rendezvous KV.")
+# --- postmortem plane (TPU-native; docs/postmortem.md — no reference
+#     equivalent: the reference leaves a dead run as a bare exit status) ---
+_knob("HOROVOD_HEARTBEAT", False, _parse_bool,
+      "Enable per-rank heartbeats: a background thread PUTs a liveness "
+      "snapshot (step, native cycle progress, queue depth, pending "
+      "collectives) to the rendezvous KV scope 'health' on the aligned "
+      "fleet clock; the launcher serves the fleet view at /health with "
+      "per-rank staleness and supervises progress.  hvdrun --postmortem "
+      "implies this.")
+_knob("HOROVOD_HEARTBEAT_INTERVAL", 1.0, float,
+      "Seconds between heartbeat publishes to the rendezvous KV.")
+_knob("HOROVOD_HEARTBEAT_TIMEOUT", 10.0, float,
+      "Driver-side supervision threshold in seconds: a rank whose "
+      "heartbeat goes silent for this long is declared heartbeat-lost; "
+      "a rank whose recorded step stops advancing for this long while "
+      "heartbeats continue is declared stalled and killed with SIGABRT "
+      "so its flight record is captured (hvdrun --postmortem).")
+_knob("HOROVOD_FLIGHT_RECORD", "", str,
+      "Path of this rank's crash-time flight record: when set, the "
+      "native core arms fatal-signal/std::terminate handlers that dump "
+      "the trace-ring tail, metrics snapshot and tensor-queue/transport "
+      "state there (csrc/postmortem.cc).  hvdrun --postmortem sets a "
+      "per-rank path automatically.")
+_knob("HOROVOD_POSTMORTEM_DIR", "", str,
+      "Directory for crash forensics: hvdrun collects per-rank flight "
+      "records, log tails and final heartbeats there and writes "
+      "postmortem.json on abnormal exit (render with `hvdrun doctor`). "
+      "Equivalent to the --postmortem flag.")
 # --- stall inspector (reference: stall_inspector.h:70-82) ---
 _knob("HOROVOD_STALL_CHECK_DISABLE", False, _parse_bool,
       "Disable the stalled-tensor watchdog.")

@@ -200,6 +200,9 @@ class ElasticDriver:
                 p.wait(timeout=max(0.1, deadline - time.time()))
             except subprocess.TimeoutExpired:
                 p.kill()
+            # Reset-round survivors die at the driver's hand: taxonomy
+            # "terminated", never a failure attribution.
+            _metrics.WORKER_EXITS.inc(cause="terminated")
             from ..runner.launch import join_output_pumps
             join_output_pumps(p, timeout=2.0)
         self._procs.clear()
@@ -241,6 +244,12 @@ class ElasticDriver:
                                    if p.returncode == 0
                                    else WorkerStateRegistry.FAILURE)
                         self.registry.record(r, outcome)
+                        # Postmortem-plane exit taxonomy: every worker
+                        # exit lands in hvd_worker_exits_total{cause=...}
+                        # (visible at /metrics; docs/postmortem.md).
+                        from ..postmortem import classify_exit
+                        _metrics.WORKER_EXITS.inc(
+                            cause=classify_exit(p.returncode))
                         if outcome == WorkerStateRegistry.FAILURE:
                             _metrics.ELASTIC_FAILURES.inc()
                             host = next((s.hostname for s in slots

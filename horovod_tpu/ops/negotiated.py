@@ -173,10 +173,17 @@ class SyncNegotiator:
                     tl.end(name, "NEGOTIATE", ts_us=arrival_us)
                     tl.begin(name, "QUEUE", ts_us=arrival_us)
                     tl.end(name, "QUEUE")
-                    tl.begin(name, "EXEC")
-                result = execute()
+                # Measured execution (utils/profiler.timed): the xprof
+                # range correlates with device activity, and the real
+                # duration lands on the EXEC span as a complete (X)
+                # event anchored at the op's start — so the timeline
+                # carries per-collective durations, not zero-width
+                # begin/end pairs.
+                from ..utils.profiler import timed
+                result, dur_us = timed(execute, name="HOROVOD_EXEC")
                 if tl is not None:
-                    tl.end(name, "EXEC")
+                    tl.record_op(name, "EXEC", resp.total_bytes,
+                                 duration_us=dur_us)
                 with self._lock:
                     self._results[name] = result
             else:

@@ -1,0 +1,151 @@
+"""``hvdrun doctor``: render a postmortem root-cause-first.
+
+The launcher writes ``postmortem.json`` when a supervised run dies
+(``hvdrun --postmortem DIR``; horovod_tpu/postmortem.py builds it).
+This subcommand is the human end of the plane: given the file (or the
+directory holding it) it prints, in order of what an on-call reader
+needs —
+
+  1. the ROOT CAUSE line: first-failing rank + suspect classification,
+  2. the evidence behind the classification,
+  3. the per-rank exit taxonomy,
+  4. the fleet-clock-ordered last events (exits, final heartbeats, the
+     flight records' native span tails),
+  5. per-rank forensics detail (flight-record health, log tail).
+
+Usage:
+  hvdrun doctor /path/to/postmortem_dir
+  hvdrun doctor /path/to/postmortem.json --events 40
+  hvdrun doctor run_dir --json          # raw JSON for tooling
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List
+
+from ..postmortem import load_postmortem
+
+
+def _fmt_t(t: Any, t0: float) -> str:
+    if not isinstance(t, (int, float)):
+        return "      ?"
+    return f"{t - t0:+8.3f}s"
+
+
+def _fmt_clock(t: Any) -> str:
+    if not isinstance(t, (int, float)):
+        return "?"
+    return time.strftime("%H:%M:%S", time.localtime(t)) + \
+        f".{int((t % 1) * 1000):03d}"
+
+
+def render(pm: Dict[str, Any], max_events: int = 25) -> str:
+    """Root-cause-first text rendering of one postmortem dict."""
+    lines: List[str] = []
+    job = pm.get("job", {})
+    suspect = pm.get("suspect", {})
+    first = pm.get("first_failure")
+    ranks = pm.get("ranks", {})
+    events = pm.get("events", [])
+
+    cmd = " ".join(job.get("command", [])) or "?"
+    lines.append(f"== hvdrun doctor: postmortem of `{cmd}` "
+                 f"(np={job.get('np', '?')}) ==")
+    if first is None:
+        lines.append("ROOT CAUSE: no failing rank recorded — the job "
+                     "ended without a classified failure")
+    else:
+        lines.append(
+            f"ROOT CAUSE: rank {first['rank']} — "
+            f"{suspect.get('classification', 'unknown')} "
+            f"(first failure {first['classification']} at "
+            f"{_fmt_clock(first.get('time'))} fleet clock)")
+    for ev in suspect.get("evidence", []):
+        lines.append(f"  evidence: {ev}")
+
+    lines.append("")
+    lines.append("Exit taxonomy:")
+    for r in sorted(ranks, key=int):
+        e = ranks[r].get("exit", {})
+        hb = ranks[r].get("heartbeat") or {}
+        step = hb.get("step")
+        extra = f", last step {step}" if step is not None else ""
+        lines.append(f"  rank {r}: {e.get('classification', '?')} "
+                     f"(rc={e.get('rc')}{extra})")
+
+    if events:
+        t0 = next((ev["t"] for ev in events
+                   if isinstance(ev.get("t"), (int, float))), 0.0)
+        lines.append("")
+        lines.append(f"Last events (fleet clock, t0={_fmt_clock(t0)}; "
+                     f"showing {min(len(events), max_events)}"
+                     f"/{len(events)}):")
+        for ev in events[-max_events:]:
+            name = ev.get("name", "?")
+            if ev.get("kind") == "span":
+                name = f"{name} [{ev.get('phase', '?')}]"
+            lines.append(f"  {_fmt_t(ev.get('t'), t0)}  rank "
+                         f"{ev.get('rank', '?')}  {ev.get('kind', '?')}: "
+                         f"{name}")
+
+    for r in sorted(ranks, key=int):
+        info = ranks[r]
+        fr = info.get("flight_record")
+        tail = info.get("log_tail")
+        if not fr and not tail:
+            continue
+        lines.append("")
+        lines.append(f"-- rank {r} forensics --")
+        if fr:
+            h = fr.get("health", {})
+            lines.append(
+                f"  flight record: reason={fr.get('reason')} "
+                f"complete={fr.get('complete')} "
+                f"spans={len(fr.get('trace', []))}")
+            lines.append(
+                f"    cycles={h.get('cycles')} "
+                f"last_progress_age_us={h.get('last_progress_age_us')} "
+                f"queue_depth={h.get('queue_depth')} "
+                f"transport_healthy={h.get('transport_healthy')}")
+            for ts, phase, cat, name, arg in fr.get("trace", [])[-5:]:
+                lines.append(f"    span {ts}us {phase}/{cat} {name} {arg}")
+        if tail:
+            lines.append("  log tail:")
+            for ln in tail.strip().splitlines()[-8:]:
+                lines.append(f"    | {ln}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hvdrun doctor",
+        description="Render a postmortem.json root-cause-first "
+                    "(docs/postmortem.md)")
+    ap.add_argument("path",
+                    help="postmortem.json or the --postmortem directory "
+                         "holding it")
+    ap.add_argument("--events", type=int, default=25,
+                    help="how many fleet-clock-ordered last events to show")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw postmortem JSON instead of the "
+                         "rendering")
+    args = ap.parse_args(argv)
+    try:
+        pm = load_postmortem(args.path)
+    except (OSError, ValueError) as e:
+        print(f"hvdrun doctor: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump(pm, sys.stdout, indent=1)
+        print()
+    else:
+        print(render(pm, max_events=args.events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,12 @@ Two more special routes serve the distributed tracing plane
   * ``GET /timeline`` renders the trace chunks workers PUT into the
     ``timeline`` scope (``utils/timeline.py`` TimelinePublisher) as one
     merged, rank-laned Chrome/Perfetto JSON on the shared aligned epoch.
+
+``GET /health`` serves the postmortem plane's live leg
+(docs/postmortem.md): workers PUT heartbeats into the ``health`` scope
+(``utils/health.py`` HeartbeatPublisher) and this route renders the
+fleet liveness view with per-rank staleness judged from the server's
+own receipt times (``?stale_after=SECS`` tunes the patience).
 """
 
 from __future__ import annotations
@@ -36,13 +42,15 @@ from typing import Dict, Optional, Tuple
 METRICS_SCOPE = "metrics"
 TIMELINE_SCOPE = "timeline"
 CLOCK_SCOPE = "clock"
+HEALTH_SCOPE = "health"
 
 
 class _KVHandler(BaseHTTPRequestHandler):
     server_version = "hvdtpu-rendezvous/1.0"
 
     def _split(self) -> Tuple[str, str]:
-        parts = self.path.strip("/").split("/", 1)
+        path, _, self._query = self.path.partition("?")
+        parts = path.strip("/").split("/", 1)
         scope = parts[0] if parts else ""
         key = parts[1] if len(parts) > 1 else ""
         return scope, key
@@ -53,6 +61,10 @@ class _KVHandler(BaseHTTPRequestHandler):
         value = self.rfile.read(length)
         with self.server.kv_lock:  # type: ignore[attr-defined]
             self.server.kv.setdefault(scope, {})[key] = value  # type: ignore
+            # Receipt stamp: the server-side truth /health staleness is
+            # computed from (a worker with a broken clock still ages).
+            self.server.kv_times.setdefault(scope, {})[key] = \
+                time.time()  # type: ignore[attr-defined]
         self.send_response(200)
         self.end_headers()
 
@@ -66,6 +78,9 @@ class _KVHandler(BaseHTTPRequestHandler):
             return
         if scope == TIMELINE_SCOPE and not key:
             self._serve_timeline()
+            return
+        if scope == HEALTH_SCOPE and not key:
+            self._serve_health()
             return
         with self.server.kv_lock:  # type: ignore[attr-defined]
             value = self.server.kv.get(scope, {}).get(key)  # type: ignore
@@ -116,10 +131,33 @@ class _KVHandler(BaseHTTPRequestHandler):
         merged = merge_timeline_chunks(stored)
         self._serve_body(json.dumps(merged).encode(), "application/json")
 
+    def _serve_health(self) -> None:
+        """Fleet liveness view (postmortem plane, docs/postmortem.md):
+        the ``health`` scope's heartbeats as JSON with per-rank
+        staleness judged from the server's receipt times.  The staleness
+        threshold is tunable per request (``GET /health?stale_after=2``)
+        so dashboards and tests pick their own patience."""
+        from urllib.parse import parse_qs
+        from ..utils.health import fleet_health
+        stale_after = 10.0
+        try:
+            q = parse_qs(getattr(self, "_query", ""))
+            if q.get("stale_after"):
+                stale_after = float(q["stale_after"][0])
+        except (ValueError, TypeError):
+            pass  # malformed query: fall back to the default patience
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            stored = dict(self.server.kv.get(HEALTH_SCOPE, {}))  # type: ignore
+            times = dict(self.server.kv_times.get(  # type: ignore
+                HEALTH_SCOPE, {}))
+        view = fleet_health(stored, times, stale_after=stale_after)
+        self._serve_body(json.dumps(view).encode(), "application/json")
+
     def do_DELETE(self) -> None:  # noqa: N802
         scope, key = self._split()
         with self.server.kv_lock:  # type: ignore[attr-defined]
             existed = self.server.kv.get(scope, {}).pop(key, None)  # type: ignore
+            self.server.kv_times.get(scope, {}).pop(key, None)  # type: ignore
         self.send_response(200 if existed is not None else 404)
         self.end_headers()
 
@@ -137,11 +175,13 @@ class RendezvousServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._final_kv: dict = {}
+        self._final_kv_times: dict = {}
 
     def start(self) -> int:
         self._httpd = ThreadingHTTPServer((self._host, self._port),
                                           _KVHandler)
         self._httpd.kv = {}  # type: ignore[attr-defined]
+        self._httpd.kv_times = {}  # type: ignore[attr-defined]
         self._httpd.kv_lock = threading.Lock()  # type: ignore[attr-defined]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -159,6 +199,8 @@ class RendezvousServer:
         assert self._httpd is not None
         with self._httpd.kv_lock:  # type: ignore[attr-defined]
             self._httpd.kv.setdefault(scope, {})[key] = value  # type: ignore
+            self._httpd.kv_times.setdefault(scope, {})[key] = \
+                time.time()  # type: ignore[attr-defined]
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         if self._httpd is None:
@@ -177,18 +219,31 @@ class RendezvousServer:
         with self._httpd.kv_lock:  # type: ignore[attr-defined]
             return dict(self._httpd.kv.get(scope, {}))  # type: ignore
 
+    def scope_receipt_times(self, scope: str) -> Dict[str, float]:
+        """Wall-clock receipt time of every key in a scope (valid after
+        stop(), like scope_items) — the server-side truth heartbeat
+        staleness is judged from (utils/health.fleet_health)."""
+        if self._httpd is None:
+            return dict(self._final_kv_times.get(scope, {}))
+        with self._httpd.kv_lock:  # type: ignore[attr-defined]
+            return dict(self._httpd.kv_times.get(scope, {}))  # type: ignore
+
     def clear_scope(self, scope: str) -> None:
         """Drop every key in a scope (round-scoped state like elastic
         worker results)."""
         assert self._httpd is not None
         with self._httpd.kv_lock:  # type: ignore[attr-defined]
             self._httpd.kv.pop(scope, None)  # type: ignore[attr-defined]
+            self._httpd.kv_times.pop(scope, None)  # type: ignore
 
     def stop(self) -> None:
         if self._httpd is not None:
             with self._httpd.kv_lock:  # type: ignore[attr-defined]
                 self._final_kv = {s: dict(d) for s, d
                                   in self._httpd.kv.items()}  # type: ignore
+                self._final_kv_times = {
+                    s: dict(d) for s, d
+                    in self._httpd.kv_times.items()}  # type: ignore
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None

@@ -31,6 +31,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 from typing import Dict, List, Optional
 
 from . import hosts as hosts_mod
@@ -176,6 +177,14 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--config-file", default=None,
                    help="YAML config (reference schema: params/autotune/"
                         "timeline/stall-check sections)")
+    p.add_argument("--postmortem", default=None, metavar="DIR",
+                   help="crash-forensics directory (docs/postmortem.md): "
+                        "workers arm the native flight recorder "
+                        "(per-rank DIR/flight.rank.N) and publish "
+                        "heartbeats served at GET /health; the launcher "
+                        "supervises heartbeat loss / progress stalls and "
+                        "on abnormal exit writes DIR/postmortem.json — "
+                        "render it with `hvdrun doctor DIR`")
     p.add_argument("--chaos", default=None, metavar="SPEC_YAML",
                    help="deterministic fault-injection spec "
                         "(horovod_tpu/chaos; docs/chaos.md): validated at "
@@ -640,6 +649,63 @@ def write_merged_timeline(rendezvous: RendezvousServer, path: str,
     return have_events
 
 
+def _log_tail(output_filename: str, rank: int, limit: int = 4000) -> str:
+    """Last bytes of a rank's redirected streams (stderr carries the
+    tracebacks and chaos/stall warnings the classifier keys on)."""
+    tail = ""
+    for stream in ("stdout", "stderr"):
+        fp = os.path.join(output_filename, f"rank.{rank}", stream)
+        try:
+            with open(fp, errors="replace") as f:
+                data = f.read()
+        except OSError:
+            continue
+        if data.strip():
+            tail += data[-limit:]
+    return tail
+
+
+def write_job_postmortem(rendezvous: RendezvousServer, postmortem_dir: str,
+                         exits: Dict[int, dict], command: List[str],
+                         np_: int, output_filename: Optional[str] = None,
+                         sink=None) -> str:
+    """Collect the fleet's crash forensics — per-rank flight records,
+    log tails, final heartbeats and condensed metric snapshots — and
+    write ``postmortem.json`` (docs/postmortem.md).  The launcher calls
+    this on abnormal exit; ``hvdrun doctor`` renders the result."""
+    from .. import postmortem as PM
+    from ..utils.health import fleet_health
+    view = fleet_health(rendezvous.scope_items("health"),
+                        rendezvous.scope_receipt_times("health"))
+    flights = {}
+    for rank in exits:
+        p = os.path.join(postmortem_dir, f"flight.rank.{rank}")
+        if os.path.exists(p):
+            try:
+                flights[rank] = PM.parse_flight_record(p)
+            except (OSError, ValueError):
+                continue  # a torn record is absent evidence, not a crash
+    tails = {}
+    if output_filename:
+        for rank in exits:
+            t = _log_tail(output_filename, rank)
+            if t:
+                tails[rank] = t
+    pm = PM.build_postmortem(
+        job={"np": np_, "command": list(command)},
+        exits=exits, health_view=view, flight_records=flights,
+        log_tails=tails,
+        metric_snapshots=harvest_metric_snapshots(rendezvous))
+    path = PM.write_postmortem(
+        pm, os.path.join(postmortem_dir, "postmortem.json"))
+    suspect = pm.get("suspect", {})
+    print(f"[hvdrun] postmortem: {path} — suspect rank "
+          f"{suspect.get('rank')} ({suspect.get('classification')}); "
+          f"render: hvdrun doctor {path}",
+          file=sink or sys.stderr, flush=True)
+    return path
+
+
 def launch_static(args: argparse.Namespace, command: List[str]) -> int:
     """Static (non-elastic) run (reference: _run_static launch.py:528-618
     + launch_gloo gloo_run.py:226-273)."""
@@ -652,6 +718,16 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
     metrics_enabled = (args.metrics_port is not None
                        or os.environ.get("HOROVOD_METRICS", "") not in
                        ("", "0", "false"))
+    # Postmortem plane (docs/postmortem.md): flight records + heartbeats
+    # + supervision + postmortem.json on abnormal exit.
+    postmortem_dir = (getattr(args, "postmortem", None)
+                      or os.environ.get("HOROVOD_POSTMORTEM_DIR") or None)
+    if postmortem_dir:
+        os.makedirs(postmortem_dir, exist_ok=True)
+        if not args.output_filename:
+            # Log tails are postmortem evidence; capture them by default
+            # (the classifier keys on stderr's tracebacks and warnings).
+            args.output_filename = os.path.join(postmortem_dir, "logs")
     rendezvous = RendezvousServer(port=args.metrics_port or 0)
     rdv_port = rendezvous.start()
     publish_chaos_spec(args, rendezvous)
@@ -683,6 +759,12 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
             # slots writing one shared path would race).
             updates["HOROVOD_TIMELINE"] = \
                 f"{args.timeline_merge}.rank.{slot.rank}.json"
+        if postmortem_dir:
+            # Heartbeats feed /health + supervision; the per-rank flight
+            # record path arms the native crash recorder at hvd.init.
+            updates.setdefault("HOROVOD_HEARTBEAT", "1")
+            updates["HOROVOD_FLIGHT_RECORD"] = os.path.join(
+                postmortem_dir, f"flight.rank.{slot.rank}")
         if np_ > 1:
             updates["HOROVOD_COORDINATOR_ADDR"] = \
                 f"{coord_host}:{args.coordinator_port}"
@@ -711,20 +793,89 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
             log_fn=lambda msg: print(msg, file=sys.stderr, flush=True))
         monitor.start()
 
+    # Postmortem supervision: heartbeat-loss / progress-stall verdicts
+    # from the fleet's health scope (utils/health.HealthMonitor).
+    health_mon = None
+    if postmortem_dir:
+        from ..utils.health import HealthMonitor, fleet_health
+        hb_timeout = float(os.environ.get("HOROVOD_HEARTBEAT_TIMEOUT",
+                                          "10") or 10)
+        health_mon = HealthMonitor(
+            lambda: fleet_health(
+                rendezvous.scope_items("health"),
+                rendezvous.scope_receipt_times("health"),
+                stale_after=hb_timeout),
+            timeout=hb_timeout)
+
+    procs_by_rank: Dict[int, subprocess.Popen] = {}
+    exits: Dict[int, dict] = {}
+    exit_code = 0
+
+    def reap(rank: int, proc: subprocess.Popen,
+             cause: Optional[str] = None,
+             by_launcher: bool = False) -> None:
+        """Record one worker exit: taxonomy metric + postmortem row."""
+        nonlocal exit_code
+        rc = proc.wait()
+        join_output_pumps(proc)
+        exits[rank] = {"rc": rc, "time": time.time(), "cause": cause,
+                       "by_launcher": by_launcher}
+        from ..postmortem import classify_exit
+        from ..utils import metrics as M
+        M.WORKER_EXITS.inc(cause=classify_exit(rc, by_launcher, cause))
+        if (rc != 0 or cause) and not by_launcher and exit_code == 0:
+            exit_code = rc if rc != 0 else 1
+
     try:
         for slot in slots:
-            procs.append(spawn(slot))
-        exit_code = 0
-        for p in procs:
-            rc = p.wait()
-            join_output_pumps(p)
-            if rc != 0 and exit_code == 0:
-                exit_code = rc
-                # fail fast: kill the rest (reference: gloo_run terminates
-                # remaining workers on first failure)
-                for q in procs:
-                    if q.poll() is None:
-                        q.terminate()
+            p = spawn(slot)
+            procs_by_rank[slot.rank] = p
+            procs.append(p)  # KeyboardInterrupt path sees partial spawns
+        while len(exits) < len(procs_by_rank):
+            progressed = False
+            for rank, p in procs_by_rank.items():
+                if rank not in exits and p.poll() is not None:
+                    reap(rank, p)
+                    progressed = True
+            live = [r for r in procs_by_rank if r not in exits]
+            if exit_code != 0 and live:
+                # fail fast: kill the rest (reference: gloo_run
+                # terminates remaining workers on first failure).
+                # Escalate to SIGKILL after a bounded grace — a survivor
+                # wedged in jax.distributed's shutdown barrier otherwise
+                # holds the launcher (and the postmortem) for minutes.
+                for r in live:
+                    p = procs_by_rank[r]
+                    if p.poll() is None:
+                        p.terminate()
+                deadline = time.time() + 10
+                for r in live:
+                    p = procs_by_rank[r]
+                    try:
+                        p.wait(timeout=max(0.1, deadline - time.time()))
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                    reap(r, p, by_launcher=True)
+                break
+            if health_mon is not None and live:
+                for r, cause in health_mon.verdicts(live).items():
+                    p = procs_by_rank[r]
+                    if p.poll() is None:
+                        # SIGABRT, not SIGTERM: aborting trips the armed
+                        # flight recorder, so the kill that confirms the
+                        # stall also captures the rank's black box.
+                        print(f"[hvdrun] rank {r}: {cause} beyond "
+                              f"{health_mon.timeout:.0f}s — aborting for "
+                              "forensics", file=sys.stderr, flush=True)
+                        p.send_signal(signal.SIGABRT)
+                        try:
+                            p.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                    reap(r, p, cause=cause)
+                    progressed = True
+            if not progressed:
+                time.sleep(0.2)
         return exit_code
     except KeyboardInterrupt:
         for p in procs:
@@ -740,10 +891,25 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
             report_stragglers(rendezvous)
         if args.timeline_merge:
             write_merged_timeline(rendezvous, args.timeline_merge)
+        if postmortem_dir and exits and exit_code != 0:
+            try:
+                write_job_postmortem(rendezvous, postmortem_dir, exits,
+                                     command, np_,
+                                     output_filename=args.output_filename)
+            except Exception as e:  # forensics must never mask the rc
+                print(f"[hvdrun] postmortem collection failed: {e}",
+                      file=sys.stderr, flush=True)
         rendezvous.stop()
 
 
 def run_commandline(argv: Optional[List[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "doctor":
+        # `hvdrun doctor <postmortem>`: the read side of the postmortem
+        # plane — no launch, no rendezvous, just the rendering.
+        from .doctor import main as doctor_main
+        return doctor_main(argv[1:])
     args = make_parser().parse_args(argv)
     if args.version:
         from .. import __version__

@@ -122,7 +122,10 @@ class Runtime:
         self.clock_sync = None
         rdv_addr = self.knobs["HOROVOD_RENDEZVOUS_ADDR"]
         rdv_port = self.knobs["HOROVOD_RENDEZVOUS_PORT"]
-        if rdv_addr and rdv_port and self.knobs["HOROVOD_TIMELINE"]:
+        if rdv_addr and rdv_port and (self.knobs["HOROVOD_TIMELINE"]
+                                      or self.knobs["HOROVOD_HEARTBEAT"]):
+            # Heartbeats ride the same aligned fleet clock as the trace
+            # (postmortem ordering depends on it, docs/postmortem.md).
             from .utils.clocksync import ClockSync
             self.clock_sync = ClockSync(rdv_addr, rdv_port)
 
@@ -212,6 +215,21 @@ class Runtime:
                 rank=self._process_index,
                 snapshot_fn=self.metrics_snapshot,
                 interval=self.knobs["HOROVOD_METRICS_INTERVAL"])
+
+        # Postmortem plane (docs/postmortem.md): per-rank heartbeats to
+        # the rendezvous KV scope 'health' — step progress, native cycle
+        # liveness and pending-collective counts on the aligned fleet
+        # clock — so the launcher can supervise progress (/health,
+        # hvdrun --postmortem) and the postmortem can order last events.
+        self.heartbeat = None
+        if self.knobs["HOROVOD_HEARTBEAT"]:
+            from .utils.health import HeartbeatPublisher
+            self.heartbeat = HeartbeatPublisher(
+                addr=self.knobs["HOROVOD_RENDEZVOUS_ADDR"],
+                port=self.knobs["HOROVOD_RENDEZVOUS_PORT"],
+                rank=self._process_index,
+                payload_fn=self._heartbeat_payload,
+                interval=self.knobs["HOROVOD_HEARTBEAT_INTERVAL"])
 
         # Chaos plane (chaos/): install this rank's deterministic fault
         # injector from the rendezvous-distributed spec (hvdrun --chaos)
@@ -356,6 +374,11 @@ class Runtime:
                     "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"],
                 gp_noise=self.knobs[
                     "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"])
+        # Postmortem plane: arm the crash-time flight recorder as soon
+        # as there is a core to record (csrc/postmortem.cc; the launcher
+        # sets a per-rank path under --postmortem).
+        if self.knobs["HOROVOD_FLIGHT_RECORD"]:
+            self.core.flight_enable(self.knobs["HOROVOD_FLIGHT_RECORD"])
         self._attach_native_trace()
         return self.core
 
@@ -427,11 +450,30 @@ class Runtime:
                 pass  # a closing core must not break the snapshot
         return M.REGISTRY.snapshot()
 
+    def _heartbeat_payload(self) -> Dict[str, Any]:
+        """One heartbeat for the health plane (utils/health.py): step
+        progress, native core liveness and the pending-collective count
+        — the field fleet-stall attribution keys on."""
+        from .utils.health import heartbeat_payload
+        pending = None
+        if self.stall_inspector is not None:
+            pending = self.stall_inspector.pending_count()
+        core = self.core
+        if core is not None and not getattr(core, "_h", None):
+            core = None  # closing core: heartbeat must not touch it
+        return heartbeat_payload(self._process_index,
+                                 clock=self.clock_sync, core=core,
+                                 pending_collectives=pending)
+
     # ------------------------------------------------------------- lifecycle
     def shutdown(self) -> None:
         if self._shutdown:
             return
         self._shutdown = True
+        # Final heartbeat while the core is still alive: the postmortem's
+        # last-known state for this rank.
+        if self.heartbeat is not None:
+            self.heartbeat.close()
         # Final metrics publish while the native core is still alive, so
         # the straggler report sees complete histograms.
         if self.metrics_publisher is not None:

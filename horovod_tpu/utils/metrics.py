@@ -354,6 +354,12 @@ NEGOTIATION_AGE = REGISTRY.histogram(
     "straggler report's source: a slow rank drags every peer's ages up).")
 
 # Layer 4: elastic lifecycle.
+WORKER_EXITS = REGISTRY.counter(
+    "hvd_worker_exits_total",
+    "Worker process exits observed by the launcher/elastic driver, by "
+    "cause (clean / error:N / signal:NAME / stall / heartbeat-lost / "
+    "terminated — the postmortem plane's exit taxonomy, "
+    "docs/postmortem.md).")
 ELASTIC_RESETS = REGISTRY.counter(
     "hvd_elastic_reset_rounds_total", "Elastic reset rounds started.")
 ELASTIC_FAILURES = REGISTRY.counter(

@@ -58,6 +58,7 @@ SUITES = {
         "tests/test_native_core.py", "tests/test_negotiated.py",
         "tests/test_autotune.py", "tests/test_aux.py",
         "tests/test_metrics.py", "tests/test_chaos.py",
+        "tests/test_postmortem.py",
     ],
     "torch": ["tests/test_torch.py"],
     "tensorflow-keras": ["tests/test_tensorflow.py", "tests/test_keras.py"],
@@ -133,6 +134,17 @@ def build_steps():
         # injections (docs/chaos.md), all CPU-virtual.
         "chaos: 2-process kill-and-recover smoke",
         f"{py} -m pytest tests/integration/test_chaos_integration.py {full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=20))
+    steps.append(_step(
+        # postmortem doctor smoke: a chaos-killed (and separately a
+        # chaos-stalled) 2-process run under hvdrun --postmortem must
+        # produce a postmortem.json attributing the injected fault to
+        # the right rank and cause, with the stalled rank's SIGABRT
+        # flight record parseable and span-bearing, and `hvdrun doctor`
+        # rendering it root-cause-first (docs/postmortem.md).
+        "postmortem: chaos-killed 2-process doctor smoke",
+        f"{py} -m pytest tests/integration/test_postmortem_integration.py "
+        f"{full}",
         env={"JAX_PLATFORMS": "cpu"}, timeout=20))
     steps.append(_step(
         # timeline-merge smoke: a 2-process loopback run under the real

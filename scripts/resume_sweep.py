@@ -137,25 +137,55 @@ def probe_ok(timeout_s=55.0) -> bool:
     return (res.stdout or "").strip().endswith("OK")
 
 
+def classify_exit(rc):
+    """rc -> exit-cause label for the sweep row (mirrors bench.py's
+    classify_child_exit; a signal death is the flash-crash attribution
+    VERDICT r5 Weak #3 wanted behind the bare rc=1)."""
+    import signal as _sig
+    if rc is None:
+        return "timeout"
+    if rc == 0:
+        return "clean"
+    if rc < 0:
+        try:
+            return f"signal:{_sig.Signals(-rc).name}"
+        except ValueError:
+            return f"signal:{-rc}"
+    return f"error:rc={rc}"
+
+
 def run_config(name, args, deadline_s) -> bool:
     env = dict(os.environ, BENCH_DEADLINE_S=str(int(deadline_s)))
     print(f"=== {name}: bench.py {' '.join(args)} ===", flush=True)
+    rc, stderr = None, ""
     try:
         res = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"), *args],
-            stdout=subprocess.PIPE, text=True, env=env, cwd=REPO,
-            timeout=deadline_s + 120)
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO, timeout=deadline_s + 120)
+        rc, stderr = res.returncode, res.stderr or ""
         line = ""
         for ln in (res.stdout or "").strip().splitlines():
             if ln.startswith("{"):
                 line = ln
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         line = ""
+        stderr = (e.stderr.decode(errors="replace")
+                  if isinstance(e.stderr, bytes) else (e.stderr or ""))
+    # stderr was captured, not inherited: re-emit it so the nohup log
+    # keeps the full story, while the ROW keeps the attribution —
+    # exit cause + stderr tail, never again a bare rc=1.
+    if stderr:
+        sys.stderr.write(stderr)
+        sys.stderr.flush()
     rec = {"config": name,
            "result": json.loads(line) if line else None}
+    ok = bool(line) and "BENCH_INVALID" not in line
+    if not ok:
+        rec["exit"] = {"rc": rc, "cause": classify_exit(rc),
+                       "stderr_tail": stderr[-2000:]}
     with open(OUT, "a") as f:
         f.write(json.dumps(rec) + "\n")
-    ok = bool(line) and "BENCH_INVALID" not in line
     print(f"    -> {'ok' if ok else 'FAILED'}: {line[:160]}", flush=True)
     return ok
 

@@ -1,0 +1,47 @@
+"""Per-rank worker for the postmortem attribution experiments.
+
+A plain training loop wearing the full observability harness: it clocks
+the chaos injector (``hvd.chaos.step``) so the spec decides WHAT fails,
+records step progress for the heartbeats (``hvd.postmortem.record_step``)
+and brings the native controller up so the launcher-armed flight
+recorder has spans to dump.  The kill experiment schedules ``kill@step``
+for rank 1; the stall experiment a near-infinite ``stall@step`` — in
+both cases the surviving machinery (heartbeats, logs, flight records,
+exit codes) must let the postmortem name the faulted rank and cause.
+"""
+
+import sys
+import time
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.process_size() == 2
+    assert hvd.chaos.active() is not None, \
+        "chaos injector not installed from the rendezvous spec"
+    rt = hvd.runtime.get()
+    # Controller up-front: the flight recorder (HOROVOD_FLIGHT_RECORD,
+    # armed inside ensure_core) records its cycle/transport spans.
+    assert rt.ensure_core() is not None
+    assert rt.heartbeat is not None, "heartbeats not enabled (--postmortem)"
+
+    x = np.ones((2,), np.float32)
+    for step in range(6):
+        hvd.postmortem.record_step(step)
+        hvd.chaos.step(step)  # kill or stall fires here per the spec
+        out = np.asarray(hvd.allreduce(x, name=f"s{step}", op=hvd.Sum))
+        assert np.allclose(out, float(hvd.size())), (step, out)
+        time.sleep(0.4)  # heartbeats flow between steps
+
+    print(f"POSTMORTEM-OK {hvd.process_rank()}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

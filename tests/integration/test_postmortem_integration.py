@@ -1,0 +1,122 @@
+"""Postmortem integration tier: crash forensics as repeatable experiments.
+
+The acceptance experiments of the postmortem plane (docs/postmortem.md):
+drive the PR-2 chaos injector under a REAL `hvdrun --postmortem` launch
+and assert the ATTRIBUTION, not just the death —
+
+  (a) a chaos `kill@step` of rank 1 produces postmortem.json whose
+      first-failing rank is 1, suspect classification `kill`, with the
+      fleet-clock-ordered last events and the chaos log line as
+      evidence; `hvdrun doctor` renders it root-cause-first;
+  (b) a chaos `stall@step` (near-infinite sleep) on rank 1 is detected
+      by heartbeat supervision, killed with SIGABRT so the native
+      flight recorder fires, and attributed as suspect `stall` on
+      rank 1 — with rank 1's flight record parseable and carrying
+      native spans (the crash-time black box, end to end).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from test_multiprocess import REPO, run_hvdrun
+
+
+def _postmortem_env(extra=None):
+    env = {"HVD_CPU_CHIPS": "1",
+           "HOROVOD_HEARTBEAT_INTERVAL": "0.3",
+           "HOROVOD_HEARTBEAT_TIMEOUT": "4"}
+    env.update(extra or {})
+    return env
+
+
+def _run_doctor(pm_dir):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "doctor",
+         str(pm_dir)],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+
+
+@pytest.mark.integration
+def test_postmortem_chaos_kill_attributed(tmp_path):
+    """(a) kill@step: rank 1 dies at step 2; the postmortem names rank 1
+    / kill, orders the last events on the fleet clock, and the doctor
+    renders the root cause."""
+    pm_dir = tmp_path / "pm"
+    spec = tmp_path / "chaos.yaml"
+    spec.write_text("seed: 23\nevents:\n"
+                    "  - kill: {rank: 1, step: 2, exit_code: 1}\n")
+    proc = run_hvdrun("postmortem_worker.py", check=False,
+                      extra_env=_postmortem_env(),
+                      launcher_args=["--postmortem", str(pm_dir),
+                                     "--chaos", str(spec)])
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "postmortem:" in proc.stderr, proc.stderr[-4000:]
+
+    pm = json.loads((pm_dir / "postmortem.json").read_text())
+    assert pm["first_failure"]["rank"] == 1, pm["first_failure"]
+    assert pm["first_failure"]["classification"] == "error:1"
+    assert pm["suspect"]["rank"] == 1
+    assert pm["suspect"]["classification"] == "kill", pm["suspect"]
+    # rank 0 was collateral (fail-fast), never the attributed failure
+    assert pm["ranks"]["0"]["exit"]["classification"] in (
+        "terminated", "error:1")
+    # the chaos log line is the collected evidence
+    assert "chaos: killing rank 1 at step 2" in \
+        (pm["ranks"]["1"]["log_tail"] or "")
+    # last events ride one fleet clock, ordered, and include both the
+    # final heartbeats and the exits
+    ts = [e["t"] for e in pm["events"]]
+    assert ts == sorted(ts) and len(ts) >= 3
+    kinds = {e["kind"] for e in pm["events"]}
+    assert "exit" in kinds and "heartbeat" in kinds
+
+    doc = _run_doctor(pm_dir)
+    assert doc.returncode == 0, doc.stderr
+    assert "ROOT CAUSE: rank 1 — kill" in doc.stdout, doc.stdout
+
+
+@pytest.mark.integration
+def test_postmortem_chaos_stall_attributed_with_flight_record(tmp_path):
+    """(b) stall@step: rank 1 freezes at step 3; supervision detects the
+    frozen progress (rank 0 is blocked INSIDE the collective, rank 1 has
+    nothing pending — the attribution rule), aborts rank 1 for
+    forensics, and the postmortem carries rank 1's flight record with
+    native spans."""
+    pm_dir = tmp_path / "pm"
+    spec = tmp_path / "chaos.yaml"
+    spec.write_text("seed: 29\nevents:\n"
+                    "  - stall: {rank: 1, step: 3, duration_ms: 600000}\n")
+    proc = run_hvdrun("postmortem_worker.py", check=False,
+                      extra_env=_postmortem_env(),
+                      launcher_args=["--postmortem", str(pm_dir),
+                                     "--chaos", str(spec)])
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "stall beyond" in proc.stderr, proc.stderr[-4000:]
+
+    pm = json.loads((pm_dir / "postmortem.json").read_text())
+    assert pm["first_failure"]["rank"] == 1, pm["first_failure"]
+    assert pm["suspect"]["rank"] == 1
+    assert pm["suspect"]["classification"] == "stall", pm["suspect"]
+    assert pm["ranks"]["1"]["exit"]["classification"] == "stall"
+
+    # the SIGABRT kill tripped the native flight recorder: the record
+    # is parseable and carries native spans (csrc black box, end to end)
+    fr = pm["ranks"]["1"]["flight_record"]
+    assert fr is not None, "flight record not collected"
+    assert fr["reason"] == "signal:SIGABRT"
+    assert fr["complete"] is True
+    assert fr["trace"], "flight record carries no native spans"
+    # the frozen rank's last heartbeat shows the stalled step with
+    # nothing pending — the evidence the verdict keyed on
+    hb = pm["ranks"]["1"]["heartbeat"]
+    assert hb["step"] == 3 and hb["pending_collectives"] == 0
+
+    doc = _run_doctor(pm_dir)
+    assert doc.returncode == 0, doc.stderr
+    assert "ROOT CAUSE: rank 1 — stall" in doc.stdout, doc.stdout
+    assert "flight record: reason=signal:SIGABRT" in doc.stdout

@@ -164,3 +164,44 @@ def test_sync_negotiator_requires_core():
     neg = SyncNegotiator(_FakeRuntime(None))
     with pytest.raises(HorovodInternalError, match="native core"):
         neg.run("x", "f32:1:allreduce:", OP_ALLREDUCE, 4, lambda: None)
+
+
+def test_negotiated_exec_span_carries_measured_duration(tmp_path):
+    """The EXEC phase of an eager negotiated op is a complete (X) event
+    whose duration is the MEASURED execution time (utils/profiler.timed
+    feeding Timeline.record_op) — not a zero-width begin/end pair
+    (VERDICT r5 Next #7: per-op device-duration enrichment)."""
+    import time as _time
+
+    from horovod_tpu.utils.timeline import Timeline, load_trace_events
+
+    hub = LoopbackHub(1)
+    core = CoordinationCore.loopback(hub, rank=0)
+    tl_path = str(tmp_path / "neg_tl.json")
+    tl = Timeline(tl_path)
+    try:
+        rt = _FakeRuntime(core)
+        rt.timeline = tl
+        neg = SyncNegotiator(rt)
+        arr = np.ones((4,), np.float32)
+
+        def execute():
+            _time.sleep(0.005)  # the duration the span must carry
+            return "done"
+
+        assert neg.run("timed_op", np_signature(arr, "allreduce", "1"),
+                       OP_ALLREDUCE, arr.nbytes, execute) == "done"
+    finally:
+        tl.close()
+        core.shutdown()
+        core.close()
+        hub.close()
+    events = load_trace_events(tl_path)
+    execs = [e for e in events
+             if e.get("name") == "EXEC" and e.get("ph") == "X"]
+    assert execs, f"no EXEC X event in {events}"
+    assert execs[0]["dur"] >= 4000, execs[0]  # measured >= ~5 ms sleep
+    assert execs[0]["args"]["size"] == arr.nbytes
+    # NEGOTIATE/QUEUE keep their begin/end lifecycle around it
+    assert any(e.get("name") == "NEGOTIATE" and e.get("ph") == "B"
+               for e in events)

@@ -1,0 +1,376 @@
+"""Postmortem plane, fast tier (docs/postmortem.md):
+
+  * native flight recorder — explicit-dump round trip, a REAL simulated
+    fatal signal in a subprocess leaving a parseable record with native
+    spans, torn-record tolerance, the lock-free health snapshot;
+  * heartbeats — payload shape, the /health route's staleness semantics
+    (server receipt time, tunable patience), publisher round trip;
+  * supervision — HealthMonitor's heartbeat-lost and stall verdicts,
+    including the pending-collectives attribution rule;
+  * forensics — exit taxonomy, suspect classification precedence,
+    build_postmortem assembly, and the `hvdrun doctor` rendering golden.
+
+The 2-process kill/stall attribution experiments live in
+tests/integration/test_postmortem_integration.py.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from horovod_tpu import postmortem as PM
+from horovod_tpu.common.basics import (CoordinationCore, LoopbackHub,
+                                       OP_ALLREDUCE)
+from horovod_tpu.utils import health as H
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASICS = os.path.join(REPO, "horovod_tpu", "common", "basics.py")
+
+
+@pytest.fixture
+def loopback_core():
+    hub = LoopbackHub(1)
+    core = CoordinationCore.loopback(hub, rank=0)
+    yield core
+    core.shutdown()
+    core.close()
+    hub.close()
+
+
+# ------------------------------------------------------------ flight record
+def _negotiate_one(core):
+    core.submit("t0", "f32:4", OP_ALLREDUCE, 16)
+    assert core.wait(5.0) is not None
+
+
+def test_flight_dump_round_trip(tmp_path, loopback_core):
+    """Explicit dump -> parse: header, health, metrics, native cycle
+    spans and the completion marker all survive the trip."""
+    path = str(tmp_path / "flight.0")
+    loopback_core.flight_enable(path)  # arms the ring
+    _negotiate_one(loopback_core)
+    assert loopback_core.flight_dump(path, "round-trip")
+    fr = PM.parse_flight_record(path)
+    assert fr["version"] == 1
+    assert fr["reason"] == "explicit:round-trip"
+    assert fr["rank"] == 0 and fr["size"] == 1
+    assert fr["complete"] is True
+    assert fr["health"]["transport_healthy"] == 1
+    assert fr["metrics"]["responses"] >= 1
+    names = [e[3] for e in fr["trace"]]
+    assert "cycle.negotiate" in names, names
+
+
+def test_flight_record_written_on_fatal_signal(tmp_path):
+    """The acceptance experiment: a simulated SIGSEGV leaves a parseable
+    flight record containing native spans, and the process still dies
+    with the signal status its supervisor expects."""
+    path = str(tmp_path / "flight.sig")
+    script = f"""
+import importlib.util, os, signal
+spec = importlib.util.spec_from_file_location("hvd_basics", {BASICS!r})
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+hub = m.LoopbackHub(1)
+core = m.CoordinationCore.loopback(hub, rank=0)
+core.flight_enable({path!r})
+core.submit("t0", "f32:4", m.OP_ALLREDUCE, 16)
+core.wait(5.0)
+os.kill(os.getpid(), signal.SIGSEGV)
+"""
+    res = subprocess.run([sys.executable, "-c", script], cwd=REPO,
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == -signal.SIGSEGV, (res.returncode, res.stderr)
+    fr = PM.parse_flight_record(path)
+    assert fr["reason"] == "signal:SIGSEGV"
+    assert fr["complete"] is True
+    assert any(e[3].startswith("cycle.") for e in fr["trace"]), fr["trace"]
+
+
+def test_parse_flight_record_tolerates_torn_write(tmp_path, loopback_core):
+    """A record truncated mid-crash parses with complete=False — torn
+    evidence is partial evidence, never a parser error."""
+    path = str(tmp_path / "flight.torn")
+    loopback_core.flight_enable(path)
+    _negotiate_one(loopback_core)
+    assert loopback_core.flight_dump(path, "torn")
+    text = open(path).read()
+    cut = text[:text.index("[end]")].rstrip("\n")
+    torn = cut[:-7]  # tear the final trace line too
+    fr = PM.parse_flight_record(torn)
+    assert fr["complete"] is False
+    assert fr["reason"] == "explicit:torn"
+    assert fr["health"]  # earlier sections intact
+
+
+def test_parse_flight_record_rejects_non_record():
+    with pytest.raises(ValueError, match="flight record"):
+        PM.parse_flight_record("not a record\nat all\n")
+
+
+def test_native_health_snapshot_is_live(loopback_core):
+    h = loopback_core.health()
+    assert h["version"] == 1
+    assert h["transport_healthy"] == 1 and h["shutdown"] == 0
+    cycles0 = h["cycles"]
+    _negotiate_one(loopback_core)
+    h2 = loopback_core.health()
+    assert h2["cycles"] > cycles0
+    assert h2["queue_depth"] == 0 and h2["responses_pending"] == 0
+    # the progress stamp tracks the cycle loop, so its age stays far
+    # below the 1 ms cycle time x a generous scheduling margin
+    assert h2["last_progress_age_us"] < 5_000_000
+
+
+# ---------------------------------------------------------------- heartbeats
+def test_heartbeat_payload_carries_progress_and_core():
+    H.reset_step()
+    try:
+        hb = H.heartbeat_payload(3)
+        assert hb["rank"] == 3 and hb["step"] is None
+        H.record_step(17)
+        hb = H.heartbeat_payload(3, pending_collectives=2)
+        assert hb["step"] == 17
+        assert abs(hb["step_time"] - time.time()) < 5.0
+        assert hb["pending_collectives"] == 2
+
+        class _Clock:
+            offset = 100.0
+        hb_aligned = H.heartbeat_payload(3, clock=_Clock())
+        assert hb_aligned["time"] - hb["time"] > 90.0  # offset applied
+    finally:
+        H.reset_step()
+
+
+def test_health_route_staleness_semantics():
+    """GET /health: fresh heartbeat -> stale False with a small age;
+    the same heartbeat under ?stale_after=0 -> stale True.  Staleness
+    judges the SERVER's receipt time, so a worker with a broken clock
+    still ages honestly."""
+    from horovod_tpu.runner.http_server import RendezvousServer
+    server = RendezvousServer(host="127.0.0.1")
+    port = server.start()
+    try:
+        H.record_step(5)
+        pub = H.HeartbeatPublisher(
+            "127.0.0.1", port, rank=0,
+            payload_fn=lambda: H.heartbeat_payload(0))
+        assert pub.publish_now()
+        pub.close()
+
+        def get(url):
+            with urllib.request.urlopen(url, timeout=5) as r:
+                return json.loads(r.read())
+
+        view = get(f"http://127.0.0.1:{port}/health")
+        info = view["ranks"]["0"]
+        assert info["stale"] is False and info["age_s"] < 5.0
+        assert info["heartbeat"]["step"] == 5
+
+        impatient = get(f"http://127.0.0.1:{port}/health?stale_after=0")
+        assert impatient["ranks"]["0"]["stale"] is True
+        assert impatient["stale_after_s"] == 0.0
+    finally:
+        H.reset_step()
+        server.stop()
+
+
+def test_fleet_health_tolerates_torn_put():
+    view = H.fleet_health({"rank.0": b"{not json", "junk": b"{}"},
+                          {"rank.0": time.time()})
+    assert view["ranks"] == {}  # torn PUT skipped, junk key ignored
+
+
+# ---------------------------------------------------------------- monitor
+def _view(now, ranks):
+    return {"now": now, "stale_after_s": 10.0, "ranks": ranks}
+
+
+def test_monitor_heartbeat_lost():
+    view = _view(100.0, {"1": {"age_s": 20.0, "stale": True,
+                               "heartbeat": {"time": 80.0}}})
+    mon = H.HealthMonitor(lambda: view, timeout=5.0)
+    assert mon.verdicts([1]) == {1: "heartbeat-lost"}
+    # a rank that never heartbeated is bring-up, not a loss
+    assert mon.verdicts([0, 1]) == {1: "heartbeat-lost"}
+
+
+def test_monitor_stall_attributes_idle_rank():
+    """Fleet-wide freeze: the rank with pending_collectives == 0 is the
+    one that stopped feeding; the peers blocked INSIDE a collective are
+    victims, not suspects."""
+    view = _view(100.0, {
+        "0": {"age_s": 0.3, "heartbeat": {"step_time": 90.0,
+                                          "pending_collectives": 1}},
+        "1": {"age_s": 0.3, "heartbeat": {"step_time": 90.5,
+                                          "pending_collectives": 0}},
+    })
+    mon = H.HealthMonitor(lambda: view, timeout=5.0)
+    assert mon.verdicts([0, 1]) == {1: "stall"}
+
+
+def test_monitor_stall_whole_fleet_blocked_names_oldest():
+    view = _view(100.0, {
+        "0": {"age_s": 0.3, "heartbeat": {"step_time": 88.0,
+                                          "pending_collectives": 1}},
+        "1": {"age_s": 0.3, "heartbeat": {"step_time": 91.0,
+                                          "pending_collectives": 2}},
+    })
+    mon = H.HealthMonitor(lambda: view, timeout=5.0)
+    assert mon.verdicts([0, 1]) == {0: "stall"}
+    # a PARTIAL freeze with every frozen rank blocked points at a peer
+    # that already exited — no verdict
+    assert mon.verdicts([0, 1, 2]) == {}
+
+
+def test_monitor_healthy_fleet_no_verdicts():
+    view = _view(100.0, {
+        "0": {"age_s": 0.3, "heartbeat": {"step_time": 99.0,
+                                          "pending_collectives": 0}},
+    })
+    mon = H.HealthMonitor(lambda: view, timeout=5.0)
+    assert mon.verdicts([0]) == {}
+
+
+# ------------------------------------------------------------ exit taxonomy
+def test_classify_exit():
+    assert PM.classify_exit(0) == "clean"
+    assert PM.classify_exit(1) == "error:1"
+    assert PM.classify_exit(-signal.SIGKILL) == "signal:SIGKILL"
+    assert PM.classify_exit(-signal.SIGABRT) == "signal:SIGABRT"
+    assert PM.classify_exit(PM.STALL_SHUTDOWN_EXIT) == "stall"
+    assert PM.classify_exit(1, by_launcher=True) == "terminated"
+    # the supervision verdict wins over the SIGABRT it was enforced with
+    assert PM.classify_exit(-signal.SIGABRT,
+                            supervision_cause="stall") == "stall"
+    assert PM.classify_exit(None) == "unknown"
+
+
+def test_classify_suspect_precedence():
+    def info(cls="error:1", tail="", fr=None, met=None):
+        return {"exit": {"classification": cls}, "log_tail": tail,
+                "flight_record": fr, "metrics": met}
+
+    assert PM.classify_suspect(
+        info(tail="chaos: crashing rank 0 at fastcommit.pre_marker")
+    )[0] == "torn_commit"
+    assert PM.classify_suspect(
+        info(tail="urllib.error.URLError: chaos: injected KV blackout")
+    )[0] == "kv_blackout"
+    assert PM.classify_suspect(info(cls="stall"))[0] == "stall"
+    assert PM.classify_suspect(info(cls="heartbeat-lost"))[0] == "stall"
+    assert PM.classify_suspect(
+        info(fr={"metrics": {"transport_reconnect_failures": 2},
+                 "health": {}}))[0] == "transport"
+    assert PM.classify_suspect(
+        info(tail="controller transport failure (peer died?)")
+    )[0] == "transport"
+    assert PM.classify_suspect(
+        info(tail="chaos: killing rank 1 at step 2"))[0] == "kill"
+    assert PM.classify_suspect(info(cls="signal:SIGKILL"))[0] == "kill"
+    assert PM.classify_suspect(
+        info(met={"chaos_injections": {"kill": 1}}))[0] == "kill"
+    assert PM.classify_suspect(info())[0] == "unknown"
+
+
+# ---------------------------------------------------------------- builder
+def _sample_pm():
+    exits = {
+        0: {"rc": -signal.SIGTERM, "time": 1000.9, "by_launcher": True},
+        1: {"rc": 1, "time": 1000.5},
+    }
+    health_view = _view(1000.9, {
+        "1": {"age_s": 0.4, "heartbeat": {
+            "rank": 1, "time": 1000.2, "step": 2, "step_time": 1000.1,
+            "core": {"now_us": 700_000}}},
+    })
+    flights = {1: {"version": 1, "reason": "signal:SIGABRT", "rank": 1,
+                   "complete": True, "health": {"cycles": 9},
+                   "metrics": {},
+                   "trace": [(600_000, "i", "t", "tcp.gather.send", 33)]}}
+    return PM.build_postmortem(
+        job={"np": 2, "command": ["python", "train.py"]},
+        exits=exits, health_view=health_view, flight_records=flights,
+        log_tails={1: "chaos: killing rank 1 at step 2\n"})
+
+
+def test_build_postmortem_attributes_first_failure():
+    pm = _sample_pm()
+    assert pm["schema"] == PM.SCHEMA
+    assert pm["first_failure"]["rank"] == 1
+    assert pm["first_failure"]["classification"] == "error:1"
+    assert pm["suspect"] == {
+        "rank": 1, "classification": "kill",
+        "evidence": ["exit classification error:1",
+                     "chaos injector logged the kill"]}
+    # rank 0 died at the launcher's hand: collateral, not a failure
+    assert pm["ranks"]["0"]["exit"]["classification"] == "terminated"
+    # events ride the fleet clock, sorted; the flight span was anchored
+    # via the heartbeat (epoch = hb.time - core.now_us/1e6 -> t=1000.1)
+    ts = [e["t"] for e in pm["events"]]
+    assert ts == sorted(ts) and len(ts) >= 4
+    span = next(e for e in pm["events"] if e["kind"] == "span")
+    assert span["name"] == "tcp.gather.send"
+    assert abs(span["t"] - 1000.1) < 1e-6
+
+
+def test_postmortem_json_round_trip(tmp_path):
+    pm = _sample_pm()
+    path = PM.write_postmortem(pm, str(tmp_path / "postmortem.json"))
+    # load accepts the file AND the directory holding it
+    assert PM.load_postmortem(path)["suspect"]["rank"] == 1
+    assert PM.load_postmortem(str(tmp_path))["suspect"]["rank"] == 1
+    with pytest.raises(ValueError, match="schema"):
+        bad = str(tmp_path / "bad")
+        os.mkdir(bad)
+        with open(os.path.join(bad, "postmortem.json"), "w") as f:
+            json.dump({"schema": "nope"}, f)
+        PM.load_postmortem(bad)
+
+
+# ------------------------------------------------------------------ doctor
+def test_doctor_rendering_golden():
+    """Root-cause-first contract: the first line a reader sees names the
+    failing rank and classification; taxonomy, fleet-clock events and
+    per-rank forensics follow."""
+    from horovod_tpu.runner.doctor import render
+    out = render(_sample_pm())
+    lines = out.splitlines()
+    assert lines[0].startswith("== hvdrun doctor: postmortem of "
+                               "`python train.py` (np=2)")
+    assert lines[1].startswith("ROOT CAUSE: rank 1 — kill "
+                               "(first failure error:1")
+    assert "  evidence: chaos injector logged the kill" in lines
+    assert "  rank 0: terminated (rc=-15)" in lines
+    assert "  rank 1: error:1 (rc=1, last step 2)" in lines
+    assert any("Last events (fleet clock" in ln for ln in lines)
+    assert any("span: tcp.gather.send" in ln for ln in lines)
+    assert "-- rank 1 forensics --" in lines
+    assert any("flight record: reason=signal:SIGABRT complete=True "
+               "spans=1" in ln for ln in lines)
+    assert any("| chaos: killing rank 1 at step 2" in ln for ln in lines)
+
+
+def test_doctor_cli_renders_and_rejects(tmp_path, capsys):
+    from horovod_tpu.runner.doctor import main
+    PM.write_postmortem(_sample_pm(), str(tmp_path / "postmortem.json"))
+    assert main([str(tmp_path)]) == 0
+    assert "ROOT CAUSE: rank 1" in capsys.readouterr().out
+    assert main([str(tmp_path), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["suspect"]["rank"] == 1
+    assert main([str(tmp_path / "missing.json")]) == 2
+
+
+def test_hvdrun_doctor_subcommand_dispatch(tmp_path, capsys):
+    """`hvdrun doctor <dir>` routes to the doctor before the launcher's
+    parser (which would otherwise demand -np and a command)."""
+    from horovod_tpu.runner.launch import run_commandline
+    PM.write_postmortem(_sample_pm(), str(tmp_path / "postmortem.json"))
+    assert run_commandline(["doctor", str(tmp_path)]) == 0
+    assert "ROOT CAUSE: rank 1" in capsys.readouterr().out
