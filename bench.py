@@ -433,6 +433,13 @@ def main() -> int:
                          "per-depth {step_time, exposed_comm_bytes "
                          "(analytical), overlapped_fraction} with the "
                          "pipelined ≡ sequential params guard asserted")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving load-generator sweep (serve/engine.py; "
+                         "docs/serving.md): drive the continuous-"
+                         "batching engine closed-loop (fixed concurrent "
+                         "users) and with Poisson arrivals, emitting "
+                         "{throughput_tok_s, ttft_p50/p99, tpot_p50/p99, "
+                         "batch_fill} per mode, CPU-virtual labeled")
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU (smoke mode)")
     ap.add_argument("--profile", metavar="DIR", default=None,
@@ -496,6 +503,12 @@ def main() -> int:
                   "per depth would overwrite itself); ignoring",
                   file=sys.stderr)
         return overlap_bench(args)
+    if args.serve:
+        if args.profile:
+            print("--profile is not supported with --serve (the tick "
+                  "loop is not one scanned program); ignoring",
+                  file=sys.stderr)
+        return serve_bench(args)
     if args.autotune:
         if args.profile:
             print("--profile is not supported with --autotune (its timing "
@@ -1193,6 +1206,139 @@ def overlap_bench(args) -> int:
         "depths": results,
         "zero1": zero1,
         "equivalence_asserted": True,
+        "metrics": metrics_summary(),
+    }))
+    return 0
+
+
+def serve_bench(args) -> int:
+    """Serving load-generator sweep (serve/engine.py; docs/serving.md):
+    the continuous-batching engine under two canonical load shapes —
+    CLOSED-LOOP (a fixed pool of concurrent users, each resubmitting on
+    completion: the throughput ceiling) and POISSON arrivals (open-loop
+    at ~60%% of the measured closed-loop request rate: the latency-
+    under-load view).  Per mode the artifact records {throughput_tok_s,
+    ttft_p50/p99, tpot_p50/p99, batch_fill}; on the CPU-virtual harness
+    the absolute numbers measure the host scheduler + XLA-CPU decode,
+    not chip serving — the mode exists to prove the machinery and give
+    the trajectory, and is labeled accordingly."""
+    import jax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import llama
+    from horovod_tpu.serve.config import ServeConfig
+    from horovod_tpu.serve.engine import ServeEngine
+
+    _init_with_retry(hvd, expect_tpu=not args.cpu)
+    if args.cpu:
+        cfg = llama.CONFIGS["tiny"]
+        prompt_len, max_new, total, users = 12, 8, 16, 4
+        scfg = ServeConfig(max_slots=4, block_size=4, cache_blocks=64,
+                           max_seq_len=64, max_batch_tokens=32,
+                           prefill_chunk=16)
+    else:
+        cfg = llama.CONFIGS[args.model if args.model != "bench"
+                            else "mini"]
+        prompt_len, max_new, total, users = 128, 64, 64, 8
+        scfg = ServeConfig(max_slots=16, block_size=16,
+                           cache_blocks=1024,
+                           max_seq_len=min(1024, cfg.max_seq),
+                           max_batch_tokens=512, prefill_chunk=128)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    engine = ServeEngine(llama, cfg, params, scfg, mesh=hvd.mesh())
+    rng = np.random.RandomState(0)
+
+    def new_prompt():
+        # +/-25% length jitter so slots genuinely desynchronize
+        n = max(2, int(prompt_len * (0.75 + 0.5 * rng.rand())))
+        return rng.randint(0, cfg.vocab, n).tolist()
+
+    def drain(arrival_times):
+        """Run the engine against an arrival schedule (None = closed
+        loop: resubmit on completion).  Returns the mode's SLO row."""
+        t0 = time.perf_counter()
+        tok0 = engine._tokens_prefill + engine._tokens_decode
+        submitted = 0
+        done = []
+        fills = []
+
+        def submit_one():
+            nonlocal submitted
+            engine.submit(new_prompt(), max_new,
+                          req_id=f"lg-{submitted}")
+            submitted += 1
+
+        if arrival_times is None:
+            for _ in range(min(users, total)):
+                submit_one()
+        while len(done) < total:
+            now = time.perf_counter() - t0
+            if arrival_times is not None:
+                while submitted < total and \
+                        arrival_times[submitted] <= now:
+                    submit_one()
+                if not engine.has_work() and submitted < total:
+                    time.sleep(min(0.005,
+                                   arrival_times[submitted] - now))
+            rep = engine.step()
+            if rep["processed"]:
+                fills.append(rep["processed"] / scfg.max_batch_tokens)
+            for req in rep["finished"]:
+                done.append(req)
+                if arrival_times is None and submitted < total:
+                    submit_one()
+        wall = time.perf_counter() - t0
+        tokens = engine._tokens_prefill + engine._tokens_decode - tok0
+        ttfts = [r.ttft() for r in done]
+        tpots = [r.tpot() for r in done if r.tpot() is not None]
+        return {
+            "requests": len(done),
+            "wall_s": round(wall, 4),
+            "throughput_tok_s": round(tokens / wall, 2),
+            "requests_per_s": round(len(done) / wall, 3),
+            "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 5),
+            "ttft_p99_s": round(float(np.percentile(ttfts, 99)), 5),
+            "tpot_p50_s": round(float(np.percentile(tpots, 50)), 5),
+            "tpot_p99_s": round(float(np.percentile(tpots, 99)), 5),
+            "batch_fill": round(float(np.mean(fills)), 4),
+        }
+
+    closed = drain(None)
+    # Open-loop Poisson at ~60% of the measured closed-loop request
+    # rate: under the saturation knee, so the row shows latency, not
+    # queue blow-up.
+    lam = max(0.1, 0.6 * closed["requests_per_s"])
+    arrivals = np.cumsum(rng.exponential(1.0 / lam, size=total))
+    poisson = drain(arrivals.tolist())
+
+    for mode, row in (("closed_loop", closed), ("poisson", poisson)):
+        if row["requests"] != total or row["ttft_p50_s"] <= 0 or \
+                row["tpot_p50_s"] <= 0:
+            return fail(f"serve {mode} row implausible: {row}",
+                        cause="invalid-result")
+    chip = detect_chip()
+    label = (f"CPU-virtual ({hvd.size()} XLA host devices; no chip — "
+             "latencies measure the host scheduler + XLA-CPU decode, "
+             "not chip serving)" if chip == "cpu" else chip)
+    print(json.dumps({
+        "metric": f"serve load-gen: closed-loop "
+                  f"{closed['throughput_tok_s']:.0f} tok/s at batch "
+                  f"fill {closed['batch_fill']:.2f}, Poisson ttft p99 "
+                  f"{poisson['ttft_p99_s'] * 1e3:.1f} ms "
+                  f"({total} reqs, prompt~{prompt_len}, gen {max_new}) "
+                  f"[{label}]",
+        "value": closed["throughput_tok_s"],
+        "unit": "tokens/sec",
+        "vs_baseline_is": "closed_loop_batch_fill",
+        "vs_baseline": closed["batch_fill"],
+        "label": label,
+        "closed_loop": closed,
+        "poisson": poisson,
+        "serve_config": {"max_slots": scfg.max_slots,
+                         "block_size": scfg.block_size,
+                         "cache_blocks": scfg.cache_blocks,
+                         "max_batch_tokens": scfg.max_batch_tokens,
+                         "prefill_chunk": scfg.prefill_chunk},
         "metrics": metrics_summary(),
     }))
     return 0

@@ -200,6 +200,10 @@ from . import chaos  # noqa: E402
 # docs/postmortem.md) — training loops call
 # hvd.postmortem.record_step(i) so heartbeats carry step progress
 from . import postmortem  # noqa: E402
+# serving plane (hvdrun --serve; docs/serving.md) — continuous-batching
+# multi-host inference over the trained models; engine and router load
+# lazily inside the subpackage
+from . import serve  # noqa: E402
 
 
 __all__ = [
@@ -225,5 +229,5 @@ __all__ = [
     "CheckpointManager", "save_checkpoint", "restore_checkpoint",
     "flash_attention", "run",
     "__version__", "probe_backend", "metrics_snapshot", "chaos",
-    "postmortem",
+    "postmortem", "serve",
 ]

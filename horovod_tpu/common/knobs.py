@@ -85,6 +85,33 @@ _knob("HOROVOD_PREFETCH_DEPTH", 2, int,
       "Device-prefetch depth of data.loader.prefetch(): how many batches "
       "are jax.device_put ahead of the step consuming them (2 = double "
       "buffered).  Must be >= 1; rejected at hvd.init() otherwise.")
+# --- serving plane (TPU-native; docs/serving.md — the reference has no
+#     inference path: its docs/inference.rst only covers exporting
+#     checkpoints OUT of the training framework) ---
+_knob("HOROVOD_SERVE_PORT", 0, int,
+      "Port the serving fleet's request router listens on (the "
+      "rendezvous HTTP server's POST /generate + GET /serve/stats "
+      "routes): hvdrun --serve pins the rendezvous server to it.  "
+      "0 = ephemeral (the launcher prints the bound port).  Must be in "
+      "[0, 65535]; rejected at hvd.init() otherwise.")
+_knob("HOROVOD_SERVE_MAX_BATCH_TOKENS", 2048, int,
+      "Continuous-batching admission budget: the total number of "
+      "prompt+decode tokens one engine tick may process across the slot "
+      "table (serve/engine.py).  Decode slots cost 1 each; prefill "
+      "chunks cost their length; new requests are admitted FCFS only "
+      "into leftover budget.  Must be positive; rejected at hvd.init().")
+_knob("HOROVOD_SERVE_MAX_SEQ_LEN", 2048, int,
+      "Per-request sequence cap (prompt + generated) for the serving "
+      "plane; requests beyond it are rejected at the router and the "
+      "paged-cache block tables are sized by it.  Must be positive and "
+      "no larger than the served model's max_seq; rejected at "
+      "hvd.init() / engine init otherwise.")
+_knob("HOROVOD_SERVE_CACHE_BLOCKS", 4096, int,
+      "Number of fixed-size blocks in the preallocated, mesh-sharded "
+      "paged KV cache pool (models/llama.py init_cache).  Admission "
+      "stalls (FCFS head-of-line) when a request's worst-case block "
+      "need exceeds the free pool.  Must be positive; rejected at "
+      "hvd.init().")
 # --- autotune (reference: common.h:70-75) ---
 _knob("HOROVOD_AUTOTUNE", False, _parse_bool,
       "Enable Bayesian autotuning of fusion threshold and cycle time.")

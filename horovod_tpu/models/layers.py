@@ -189,6 +189,21 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
                            axis=-1).astype(x.dtype)
 
 
+def apply_rope_at(x: jax.Array, cos: jax.Array, sin: jax.Array,
+                  positions: jax.Array) -> jax.Array:
+    """x: [B, S, heads, head_dim]; rotary embedding at EXPLICIT per-token
+    positions [B, S] — the decode-path generalization of
+    :func:`apply_rope`'s single scalar offset, where every batch row
+    (serving slot) sits at its own sequence position.  Same rotation
+    math on the same tables, so prefill+decode logits stay bit-near the
+    full-sequence forward (docs/serving.md)."""
+    c = jnp.take(cos, positions, axis=0)[..., None, :]
+    s = jnp.take(sin, positions, axis=0)[..., None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(x.dtype)
+
+
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      causal: bool = True,
                      mask: Optional[jax.Array] = None,

@@ -225,6 +225,114 @@ def loss_fn(params: Dict[str, Any], ids: jax.Array, cfg: LlamaConfig,
     return jnp.mean(L.softmax_cross_entropy(logits, targets))
 
 
+# ----------------------------------------------------------- decode path
+# Serving-plane KV cache (docs/serving.md): one PREALLOCATED pool of
+# fixed-size blocks per layer, shared by every in-flight sequence — a
+# sequence owns whole blocks via its block-table row, so sequences of
+# different lengths coexist in static shapes (the paged-attention
+# layout).  Block tables use -1 for unassigned entries; positions past a
+# slot's live length are masked with the score dtype's minimum, which
+# the fp32 softmax turns into an exact 0 — so the cached forward sums
+# the same terms as the full-sequence forward and prefill + N decode
+# steps reproduce apply()'s logits bit-near (tests/test_serve.py).
+
+
+def init_cache(cfg: LlamaConfig, num_blocks: int, block_size: int,
+               dtype=None) -> Dict[str, jax.Array]:
+    """Preallocate the paged KV pool: ``{"k","v"}`` of shape
+    ``[n_layers, num_blocks, block_size, n_kv_heads, head_dim]``.  Shard
+    it along the existing mesh axes with serve.engine.cache_shardings
+    (blocks over the data axis, kv heads over a model axis)."""
+    dtype = dtype if dtype is not None else cfg.dtype
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _attn_cached(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig,
+                 cos: jax.Array, sin: jax.Array,
+                 k_pool: jax.Array, v_pool: jax.Array,
+                 block_tables: jax.Array, positions: jax.Array,
+                 valid: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One layer's attention over the paged cache.
+
+    x: [S, C, dim] — S serving slots each contributing a chunk of C new
+    token positions (prefill consumes whole chunks; decode uses C with
+    one valid token).  The chunk's k/v are scattered into the pool
+    FIRST, then each query attends over its slot's full gathered context
+    with a per-position causal mask — so a single compiled step serves
+    mixed prefill/decode ticks.  Projections always take the unfused
+    path (fuse_proj is a training-throughput lever; TP shards the
+    separate kernels)."""
+    S, C, _ = x.shape
+    num_blocks, block_size = k_pool.shape[0], k_pool.shape[1]
+    max_blocks = block_tables.shape[1]
+    q = L.dense(p["wq"], x).reshape(S, C, cfg.n_heads, cfg.head_dim)
+    k = L.dense(p["wk"], x).reshape(S, C, cfg.n_kv_heads, cfg.head_dim)
+    v = L.dense(p["wv"], x).reshape(S, C, cfg.n_kv_heads, cfg.head_dim)
+    pos_c = jnp.minimum(positions, cfg.max_seq - 1)
+    q = L.apply_rope_at(q, cos, sin, pos_c)
+    k = L.apply_rope_at(k, cos, sin, pos_c)
+    # Scatter the chunk's k/v into the pool: token at global position P
+    # lands in block_tables[s, P // bs] at offset P % bs.  Invalid
+    # (padding / inactive-slot) positions are routed out of bounds and
+    # dropped, so a dead slot's stale table row is never written.
+    slot_idx = jnp.minimum(positions // block_size, max_blocks - 1)
+    blk = jnp.take_along_axis(block_tables, slot_idx, axis=1)
+    blk = jnp.where(valid, jnp.maximum(blk, 0), num_blocks)
+    off = positions % block_size
+    k_pool = k_pool.at[blk, off].set(k, mode="drop")
+    v_pool = v_pool.at[blk, off].set(v, mode="drop")
+    # Gather each slot's full context.  Table slot j covers global
+    # positions [j*bs, (j+1)*bs), so gathered index t IS global position
+    # t; unassigned entries (-1 -> block 0) only cover positions the
+    # causal mask excludes, and masked scores softmax to exactly 0.
+    bt = jnp.maximum(block_tables, 0)
+    k_ctx = jnp.take(k_pool, bt, axis=0).reshape(
+        S, max_blocks * block_size, cfg.n_kv_heads, cfg.head_dim)
+    v_ctx = jnp.take(v_pool, bt, axis=0).reshape(
+        S, max_blocks * block_size, cfg.n_kv_heads, cfg.head_dim)
+    key_pos = jnp.arange(max_blocks * block_size)
+    mask = (key_pos[None, None, :] <= positions[:, :, None])[:, None]
+    o = L.causal_attention(q, k_ctx, v_ctx, causal=False, mask=mask)
+    return (L.dense(p["wo"], o.reshape(S, C, cfg.n_heads * cfg.head_dim)),
+            k_pool, v_pool)
+
+
+def apply_cached(params: Dict[str, Any], tokens: jax.Array,
+                 cfg: LlamaConfig, cache: Dict[str, jax.Array],
+                 block_tables: jax.Array, lengths: jax.Array,
+                 n_new: jax.Array
+                 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Mixed prefill/decode forward over the paged cache.
+
+    ``tokens`` [S, C] int32 — slot s's next ``n_new[s]`` tokens (0 =
+    inactive slot), starting at context length ``lengths[s]``;
+    ``block_tables`` [S, max_blocks] int32 indexes the pool (-1 =
+    unassigned).  Returns (logits [S, C, vocab], updated cache); the
+    caller samples from position ``n_new[s] - 1``.  Prefill a prompt in
+    ceil(len/C) calls, then decode one token per call — the serving
+    engine's one jit'd tick (horovod_tpu/serve/engine.py)."""
+    S, C = tokens.shape
+    cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    positions = lengths[:, None] + jnp.arange(C, dtype=lengths.dtype)[None]
+    valid = jnp.arange(C)[None, :] < n_new[:, None]
+    x = L.embedding(params["embed"], tokens).astype(cfg.dtype)
+    ks, vs = [], []
+    for i, p in enumerate(params["layers"]):
+        a, k_pool, v_pool = _attn_cached(
+            p, L.rmsnorm(p["attn_norm"], x), cfg, cos, sin,
+            cache["k"][i], cache["v"][i], block_tables, positions, valid)
+        x = x + a
+        x = x + _ffn(p, L.rmsnorm(p["ffn_norm"], x), cfg)
+        ks.append(k_pool)
+        vs.append(v_pool)
+    x = L.rmsnorm(params["final_norm"], x)
+    return (L.dense(params["lm_head"], x),
+            {"k": jnp.stack(ks), "v": jnp.stack(vs)})
+
+
 def param_count(cfg: LlamaConfig) -> int:
     per_layer = (cfg.dim * cfg.n_heads * cfg.head_dim
                  + 2 * cfg.dim * cfg.n_kv_heads * cfg.head_dim

@@ -137,6 +137,66 @@ def loss_fn(params: Dict[str, Any], ids: jax.Array, cfg: MoeLlamaConfig,
     return jnp.mean(nll) + cfg.router_aux_coef * aux
 
 
+# ----------------------------------------------------------- decode path
+def dropfree_moe_fn(cfg: MoeLlamaConfig) -> Callable:
+    """Batch-invariant dense MoE for serving: capacity equals the token
+    count, so no token is ever capacity-dropped and a request's logits
+    cannot depend on its batchmates.  Training's capacity-bounded
+    routing drops tokens by batch position — under continuous batching
+    that would make a sequence's output a function of which other
+    requests share its tick, which serving must never allow (and which
+    would break the prefill+decode ≡ full-forward equivalence).  Pass
+    the same fn to :func:`apply` when comparing against the cached path
+    (tests/test_serve.py; docs/serving.md)."""
+    def fn(p_moe: Dict[str, Any], tokens: jax.Array):
+        return moe_dense_reference(p_moe, tokens, cfg.n_experts,
+                                   capacity=tokens.shape[0],
+                                   experts_per_token=cfg.experts_per_token)
+    return fn
+
+
+def init_cache(cfg: MoeLlamaConfig, num_blocks: int, block_size: int,
+               dtype=None) -> Dict[str, jax.Array]:
+    """Paged KV pool for the attention half — exactly llama's layout
+    (the attention IS llama's, so the pool is too)."""
+    return Ll.init_cache(_llama_cfg(cfg), num_blocks, block_size,
+                         dtype=dtype)
+
+
+def apply_cached(params: Dict[str, Any], tokens: jax.Array,
+                 cfg: MoeLlamaConfig, cache: Dict[str, jax.Array],
+                 block_tables: jax.Array, lengths: jax.Array,
+                 n_new: jax.Array, moe_fn: Optional[Callable] = None
+                 ) -> tuple[jax.Array, Dict[str, jax.Array], jax.Array]:
+    """Mixed prefill/decode forward over the paged cache (the moe twin
+    of llama.apply_cached; same slot-table contract).  Returns (logits
+    [S, C, vocab], updated cache, mean router aux).  ``moe_fn`` defaults
+    to the drop-free dense path — the batch-invariant serving routing."""
+    S, C = tokens.shape
+    lcfg = _llama_cfg(cfg)
+    moe_fn = moe_fn if moe_fn is not None else dropfree_moe_fn(cfg)
+    cos, sin = L.rope_freqs(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    positions = lengths[:, None] + jnp.arange(C, dtype=lengths.dtype)[None]
+    valid = jnp.arange(C)[None, :] < n_new[:, None]
+    x = L.embedding(params["embed"], tokens).astype(cfg.dtype)
+    ks, vs, auxes = [], [], []
+    for i, p in enumerate(params["layers"]):
+        a, k_pool, v_pool = Ll._attn_cached(
+            p, L.rmsnorm(p["attn_norm"], x), lcfg, cos, sin,
+            cache["k"][i], cache["v"][i], block_tables, positions, valid)
+        x = x + a
+        y, aux = _moe_block(p["moe"], L.rmsnorm(p["ffn_norm"], x), cfg,
+                            moe_fn)
+        x = x + y
+        ks.append(k_pool)
+        vs.append(v_pool)
+        auxes.append(aux)
+    x = L.rmsnorm(params["final_norm"], x)
+    return (L.dense(params["lm_head"], x),
+            {"k": jnp.stack(ks), "v": jnp.stack(vs)},
+            jnp.mean(jnp.stack(auxes)))
+
+
 def param_count(cfg: MoeLlamaConfig) -> int:
     attn = (cfg.dim * cfg.n_heads * cfg.head_dim
             + 2 * cfg.dim * cfg.n_kv_heads * cfg.head_dim
@@ -148,4 +208,4 @@ def param_count(cfg: MoeLlamaConfig) -> int:
 
 
 __all__ = ["MoeLlamaConfig", "CONFIGS", "init", "apply", "loss_fn",
-           "param_count"]
+           "param_count", "init_cache", "apply_cached", "dropfree_moe_fn"]

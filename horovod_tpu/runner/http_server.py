@@ -29,6 +29,15 @@ Two more special routes serve the distributed tracing plane
 (``utils/health.py`` HeartbeatPublisher) and this route renders the
 fleet liveness view with per-rank staleness judged from the server's
 own receipt times (``?stale_after=SECS`` tunes the patience).
+
+The serving plane (docs/serving.md) adds the front door:
+
+  * ``POST /generate`` enqueues a generation request onto the
+    ``serve_req`` scope and streams the engine fleet's tokens back as
+    ndjson (``horovod_tpu/serve/router.py`` — backpressure, sequence
+    numbering, result streaming);
+  * ``GET /serve/stats`` merges router counters with the engine's
+    self-published stats (scope ``serve`` key ``stats``).
 """
 
 from __future__ import annotations
@@ -43,6 +52,8 @@ METRICS_SCOPE = "metrics"
 TIMELINE_SCOPE = "timeline"
 CLOCK_SCOPE = "clock"
 HEALTH_SCOPE = "health"
+SERVE_SCOPE = "serve"
+GENERATE_ROUTE = "generate"
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -68,8 +79,26 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.end_headers()
 
+    def do_POST(self) -> None:  # noqa: N802
+        scope, key = self._split()
+        if scope == GENERATE_ROUTE and not key:
+            # Serving front door (docs/serving.md): parse, backpressure,
+            # enqueue to the KV, stream the engine's tokens back.
+            from ..serve import router as serve_router
+            serve_router.handle_generate(self)
+            return
+        self.send_response(404)
+        self.end_headers()
+
     def do_GET(self) -> None:  # noqa: N802
         scope, key = self._split()
+        if scope == SERVE_SCOPE and key == "stats":
+            import json as _json
+            from ..serve import router as serve_router
+            self._serve_body(
+                _json.dumps(serve_router.render_stats(self.server)
+                            ).encode(), "application/json")
+            return
         if scope == METRICS_SCOPE and not key:
             self._serve_metrics()
             return

@@ -185,6 +185,24 @@ def make_parser() -> argparse.ArgumentParser:
                         "supervises heartbeat loss / progress stalls and "
                         "on abnormal exit writes DIR/postmortem.json — "
                         "render it with `hvdrun doctor DIR`")
+    p.add_argument("--serve", default=None, metavar="CKPT_DIR",
+                   help="serving mode (docs/serving.md): instead of a "
+                        "training command, every slot runs a "
+                        "continuous-batching inference worker over the "
+                        "servable checkpoint directory (serve.json + "
+                        "checkpoint); the rendezvous server grows a "
+                        "POST /generate request router and GET "
+                        "/serve/stats, and the metrics + heartbeat "
+                        "planes are enabled so /metrics carries the "
+                        "hvd_serve_* SLO families")
+    p.add_argument("--serve-port", type=int, default=None,
+                   help="pin the rendezvous/router port for --serve "
+                        "(HOROVOD_SERVE_PORT; default: the knob, else "
+                        "an ephemeral port printed at startup)")
+    p.add_argument("--serve-ttl", type=float, default=None,
+                   help="seconds the serving fleet stays up before a "
+                        "clean exit (0/omitted = until interrupted); "
+                        "bounded CI smokes use this")
     p.add_argument("--chaos", default=None, metavar="SPEC_YAML",
                    help="deterministic fault-injection spec "
                         "(horovod_tpu/chaos; docs/chaos.md): validated at "
@@ -331,6 +349,11 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
         spec = load_chaos_spec(args)
         env["HOROVOD_CHAOS"] = "1"
         env.update(spec.transport_env())
+    if getattr(args, "serve", None):
+        # SLO observability for free (docs/serving.md): serving workers
+        # publish hvd_serve_* metrics and heartbeats like any trainer.
+        env.setdefault("HOROVOD_METRICS", "1")
+        env.setdefault("HOROVOD_HEARTBEAT", "1")
     return env
 
 
@@ -706,6 +729,29 @@ def write_job_postmortem(rendezvous: RendezvousServer, postmortem_dir: str,
     return path
 
 
+def resolve_serve_port(args: argparse.Namespace) -> int:
+    """--serve's router port: flag > HOROVOD_SERVE_PORT env/knob > 0
+    (ephemeral; the startup banner prints the bound port)."""
+    if not getattr(args, "serve", None):
+        return 0
+    if getattr(args, "serve_port", None) is not None:
+        return args.serve_port
+    try:
+        return int(os.environ.get("HOROVOD_SERVE_PORT", "") or 0)
+    except ValueError:
+        return 0
+
+
+def serve_worker_command(args: argparse.Namespace) -> List[str]:
+    """The worker vector --serve substitutes for a training command:
+    one continuous-batching inference worker per slot
+    (horovod_tpu/serve/worker.py; docs/serving.md)."""
+    cmd = [sys.executable, "-m", "horovod_tpu.serve.worker", args.serve]
+    if getattr(args, "serve_ttl", None):
+        cmd += ["--ttl", str(args.serve_ttl)]
+    return cmd
+
+
 def launch_static(args: argparse.Namespace, command: List[str]) -> int:
     """Static (non-elastic) run (reference: _run_static launch.py:528-618
     + launch_gloo gloo_run.py:226-273)."""
@@ -715,7 +761,9 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
 
     # --metrics-port pins the rendezvous server so /metrics is scrapeable
     # at a known address; metrics also engage via the ambient env knob.
+    # --serve implies the metrics plane (hvd_serve_* SLO families).
     metrics_enabled = (args.metrics_port is not None
+                       or getattr(args, "serve", None) is not None
                        or os.environ.get("HOROVOD_METRICS", "") not in
                        ("", "0", "false"))
     # Postmortem plane (docs/postmortem.md): flight records + heartbeats
@@ -728,8 +776,17 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
             # Log tails are postmortem evidence; capture them by default
             # (the classifier keys on stderr's tracebacks and warnings).
             args.output_filename = os.path.join(postmortem_dir, "logs")
-    rendezvous = RendezvousServer(port=args.metrics_port or 0)
+    # Port priority: --metrics-port (back compat) > --serve-port >
+    # HOROVOD_SERVE_PORT knob > ephemeral.
+    serve_port = resolve_serve_port(args)
+    rendezvous = RendezvousServer(port=args.metrics_port or serve_port
+                                  or 0)
     rdv_port = rendezvous.start()
+    if getattr(args, "serve", None):
+        print(f"[hvdrun] serving {args.serve}: POST http://"
+              f"{socket.gethostname()}:{rdv_port}/generate  (stats: "
+              f"GET /serve/stats, metrics: GET /metrics)",
+              file=sys.stderr, flush=True)
     publish_chaos_spec(args, rendezvous)
     for slot in slots:
         rendezvous.put("rank", str(slot.rank),
@@ -921,6 +978,18 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
+    if args.serve:
+        if command:
+            print("hvdrun: --serve supplies the worker command; drop "
+                  f"the trailing command ({' '.join(command)})",
+                  file=sys.stderr)
+            return 2
+        if args.host_discovery_script or args.min_np or args.max_np:
+            print("hvdrun: --serve runs a static fleet; elastic flags "
+                  "(--min-np/--max-np/--host-discovery-script) are not "
+                  "supported with it", file=sys.stderr)
+            return 2
+        command = serve_worker_command(args)
     if not command:
         print("hvdrun: no training command given", file=sys.stderr)
         return 2

@@ -155,6 +155,11 @@ class Runtime:
         # the core numeric knobs.
         from .ops.overlap import validate_overlap_knobs
         validate_overlap_knobs(self.knobs)
+        # Serving plane (serve/; docs/serving.md): same init-validation
+        # contract for the HOROVOD_SERVE_* knob surface (port range,
+        # positive budgets) — config-only import, no model/jax cost.
+        from .serve.config import validate_serve_knobs
+        validate_serve_knobs(self.knobs)
         if self.knobs["HOROVOD_FUSION_THRESHOLD"] <= 0:
             raise ValueError(
                 f"HOROVOD_FUSION_THRESHOLD="

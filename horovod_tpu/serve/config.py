@@ -1,0 +1,84 @@
+"""Serving-plane configuration: the HOROVOD_SERVE_* knob surface.
+
+Deliberately free of jax/model imports so ``hvd.init()`` can validate
+the knobs (runtime.py) without paying the serving plane's import cost,
+mirroring how the wire/overlap planes validate at init
+(docs/serving.md; docs/knobs.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Static shape/budget contract of one continuous-batching engine.
+
+    ``max_slots`` and ``prefill_chunk`` fix the compiled step's shapes
+    (slot table height and chunk width); the knobs bound admission.
+    """
+
+    port: int = 0
+    max_batch_tokens: int = 2048
+    max_seq_len: int = 2048
+    cache_blocks: int = 4096
+    block_size: int = 16
+    max_slots: int = 8
+    prefill_chunk: int = 64
+    eos_id: Optional[int] = None
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return -(-self.max_seq_len // self.block_size)  # ceil
+
+    def validate(self, model_max_seq: Optional[int] = None) -> None:
+        if not (0 <= self.port <= 65535):
+            raise ValueError(
+                f"HOROVOD_SERVE_PORT={self.port} invalid; must be in "
+                "[0, 65535] (0 = ephemeral; docs/serving.md)")
+        for name, v in (("HOROVOD_SERVE_MAX_BATCH_TOKENS",
+                         self.max_batch_tokens),
+                        ("HOROVOD_SERVE_MAX_SEQ_LEN", self.max_seq_len),
+                        ("HOROVOD_SERVE_CACHE_BLOCKS", self.cache_blocks)):
+            if v <= 0:
+                raise ValueError(
+                    f"{name}={v} invalid; must be positive "
+                    "(docs/serving.md)")
+        if self.block_size <= 0 or self.max_slots <= 0:
+            raise ValueError(
+                f"serve block_size={self.block_size} / "
+                f"max_slots={self.max_slots} invalid; must be positive")
+        if self.prefill_chunk <= 0 or \
+                self.prefill_chunk > self.max_batch_tokens:
+            raise ValueError(
+                f"serve prefill_chunk={self.prefill_chunk} invalid; must "
+                "be in [1, max_batch_tokens="
+                f"{self.max_batch_tokens}] (docs/serving.md)")
+        if model_max_seq is not None and self.max_seq_len > model_max_seq:
+            raise ValueError(
+                f"HOROVOD_SERVE_MAX_SEQ_LEN={self.max_seq_len} exceeds "
+                f"the served model's max_seq={model_max_seq}; RoPE "
+                "tables end there (docs/serving.md)")
+
+
+def from_knobs(knobs: Any, **overrides: Any) -> ServeConfig:
+    """Build a validated ServeConfig from a knob snapshot
+    (common/knobs.Knobs or any mapping with __getitem__)."""
+    kw = dict(
+        port=int(knobs["HOROVOD_SERVE_PORT"]),
+        max_batch_tokens=int(knobs["HOROVOD_SERVE_MAX_BATCH_TOKENS"]),
+        max_seq_len=int(knobs["HOROVOD_SERVE_MAX_SEQ_LEN"]),
+        cache_blocks=int(knobs["HOROVOD_SERVE_CACHE_BLOCKS"]),
+    )
+    kw.update(overrides)
+    cfg = ServeConfig(**kw)
+    cfg.validate()
+    return cfg
+
+
+def validate_serve_knobs(knobs: Any) -> None:
+    """Init-time validation contract (runtime.py): a bad HOROVOD_SERVE_*
+    value must fail hvd.init(), not a serving tick hours later."""
+    from_knobs(knobs)

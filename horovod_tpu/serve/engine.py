@@ -1,0 +1,573 @@
+"""Continuous-batching inference engine over the trained models.
+
+The serving plane's core (docs/serving.md): one preallocated,
+mesh-sharded paged KV cache (models/llama.py ``init_cache``), a
+static-shape slot table, and ONE jit'd mixed prefill/decode step per
+tick.  Horovod's product was "wrap your optimizer, training scales"
+(arxiv 1802.05799); the serving analog here is "hand the engine your
+trained checkpoint, it serves" — no model rewrite, the same mesh,
+launcher and observability stack as training.
+
+Scheduling (in-flight/continuous batching, the Orca/vLLM discipline):
+
+  * **admit-on-slot-free**: the waiting queue is FCFS; a request is
+    admitted the tick a slot AND its worst-case cache blocks are free,
+    never at epoch/batch boundaries;
+  * **max_batch_tokens admission**: each tick processes at most that
+    many tokens across the table — decode slots cost 1 each (served
+    first: latency-critical), prefill slots consume chunks of
+    ``prefill_chunk``, new admissions eat leftover budget;
+  * **evict-on-EOS/max-len**: a finished request frees its slot and
+    blocks the same tick, so the next waiting request replaces it
+    mid-flight.
+
+The tick is pipelined one deep (the ``data/loader.py prefetch`` deque
+pattern on the host<->device legs): ``step()`` first harvests the
+PREVIOUS tick's device results, then plans/assembles/dispatches the next
+tick asynchronously — host scheduling overlaps device compute instead of
+serializing after it.
+
+Determinism: greedy (argmax) sampling on device, FCFS admission, LIFO
+block reuse — given the same request sequence every rank computes the
+same plans and tokens, which is what lets a multi-host fleet run the
+engine in lockstep from a rank-0-published plan stream (serve/worker.py)
+with no new transport.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .config import ServeConfig
+
+
+# ------------------------------------------------------------ block pool
+class BlockAllocator:
+    """Free-list over the paged cache pool.  LIFO reuse: the blocks a
+    finished request frees are the first ones the next request gets —
+    deterministic across ranks and trivially observable in tests
+    (paged-cache block reuse)."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = int(num_blocks)
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """All-or-nothing: a request that cannot get its worst-case
+        block count is not admitted (no mid-flight OOM-evict)."""
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, blocks: List[int]) -> None:
+        for b in reversed(blocks):
+            self._free.append(b)
+
+
+# --------------------------------------------------------------- request
+class Request:
+    """One generation request moving waiting -> prefill -> decode ->
+    done.  ``ctx_len`` counts tokens written into the cache; ``pos``
+    counts prompt tokens consumed."""
+
+    def __init__(self, tokens, max_new_tokens: int,
+                 req_id: Optional[str] = None,
+                 eos_id: Optional[int] = None):
+        self.tokens = [int(t) for t in tokens]
+        if not self.tokens:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens={max_new_tokens} invalid")
+        self.max_new_tokens = int(max_new_tokens)
+        self.req_id = req_id or f"req-{id(self):x}"
+        self.eos_id = eos_id
+        self.state = "waiting"
+        self.out_tokens: List[int] = []
+        self.pos = 0        # prompt tokens consumed
+        self.ctx_len = 0    # tokens written into the cache
+        self.slot: Optional[int] = None
+        self.blocks: List[int] = []
+        self.submitted_t = time.perf_counter()
+        self.admitted_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.done_t: Optional[float] = None
+        self.finish_reason: Optional[str] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.tokens)
+
+    def ttft(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submitted_t
+
+    def tpot(self) -> Optional[float]:
+        if self.done_t is None or self.first_token_t is None or \
+                len(self.out_tokens) < 2:
+            return None
+        return (self.done_t - self.first_token_t) / \
+            (len(self.out_tokens) - 1)
+
+
+# ------------------------------------------------------------- scheduler
+class Scheduler:
+    """Deterministic slot-table scheduler (pure host state, no jax) —
+    unit-testable without a model.  ``plan()`` returns this tick's
+    (slot, request, n_tokens) work list and performs admissions;
+    ``finish()`` evicts."""
+
+    def __init__(self, cfg: ServeConfig):
+        self.cfg = cfg
+        self.slots: List[Optional[Request]] = [None] * cfg.max_slots
+        self.waiting: "collections.deque[Request]" = collections.deque()
+        self.allocator = BlockAllocator(cfg.cache_blocks)
+        self.block_tables = -np.ones(
+            (cfg.max_slots, cfg.max_blocks_per_seq), np.int32)
+        self.completed = 0
+
+    # ------------------------------------------------------------ intake
+    def submit(self, req: Request) -> Request:
+        if req.prompt_len + req.max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"request {req.req_id}: prompt {req.prompt_len} + "
+                f"max_new {req.max_new_tokens} exceeds "
+                f"HOROVOD_SERVE_MAX_SEQ_LEN={self.cfg.max_seq_len}")
+        self.waiting.append(req)
+        return req
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def active(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def has_work(self) -> bool:
+        return self.active > 0 or bool(self.waiting)
+
+    # -------------------------------------------------------------- plan
+    def plan(self) -> List[Tuple[int, Request, int]]:
+        """One tick's work under the token budget: decode slots first
+        (1 token each, latency-critical), prefill continuations next,
+        FCFS admissions into the remainder.  Deterministic given state."""
+        budget = self.cfg.max_batch_tokens
+        chunk = self.cfg.prefill_chunk
+        work: List[Tuple[int, Request, int]] = []
+        for i, req in enumerate(self.slots):
+            if req is not None and req.state == "decode" and budget >= 1:
+                work.append((i, req, 1))
+                budget -= 1
+        for i, req in enumerate(self.slots):
+            if req is not None and req.state == "prefill" and budget >= 1:
+                n = min(chunk, req.prompt_len - req.pos, budget)
+                if n >= 1:
+                    work.append((i, req, n))
+                    budget -= n
+        while self.waiting and budget >= 1:
+            free_slots = [i for i, s in enumerate(self.slots) if s is None]
+            if not free_slots:
+                break
+            req = self.waiting[0]
+            need = -(-(req.prompt_len + req.max_new_tokens)
+                     // self.cfg.block_size)
+            blocks = self.allocator.alloc(need)
+            if blocks is None:
+                break  # FCFS head-of-line: no skip-ahead, deterministic
+            self.waiting.popleft()
+            slot = free_slots[0]
+            req.slot, req.blocks = slot, blocks
+            req.state = "prefill"
+            req.admitted_t = time.perf_counter()
+            self.slots[slot] = req
+            self.block_tables[slot, :] = -1
+            self.block_tables[slot, :need] = blocks
+            n = min(chunk, req.prompt_len, budget)
+            work.append((slot, req, n))
+            budget -= n
+        return work
+
+    # ------------------------------------------------------------- evict
+    def finish(self, req: Request, reason: str) -> None:
+        req.state = "done"
+        req.finish_reason = reason
+        req.done_t = time.perf_counter()
+        if req.slot is not None:
+            self.block_tables[req.slot, :] = -1
+            self.slots[req.slot] = None
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        req.slot = None
+        self.completed += 1
+
+
+# ------------------------------------------------------------ shardings
+def cache_shardings(mesh, num_blocks: int, n_kv_heads: int):
+    """NamedSharding for the paged pool [L, blocks, bs, kv_heads, hd]:
+    kv heads over a model/tp axis when one exists and divides, blocks
+    over the first remaining (data) axis that divides — the cache rides
+    the training mesh's existing axes (docs/serving.md)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    head_axis = None
+    for a in mesh.axis_names:
+        if str(a).split(".")[-1] in ("model", "tp") and \
+                n_kv_heads % mesh.shape[a] == 0:
+            head_axis = a
+            break
+    block_axis = None
+    for a in mesh.axis_names:
+        if a != head_axis and num_blocks % mesh.shape[a] == 0:
+            block_axis = a
+            break
+    return NamedSharding(mesh, P(None, block_axis, None, head_axis, None))
+
+
+def _make_global(arr: np.ndarray, sharding):
+    """Host array -> global jax.Array under ``sharding``.  Works in
+    multi-controller runs (every process holds the full host value and
+    contributes its addressable shards) — jax.device_put alone cannot
+    target non-addressable devices."""
+    import jax
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
+def _global_zeros(shape, dtype, sharding):
+    import jax
+
+    def cb(idx):
+        slice_shape = tuple(
+            len(range(*s.indices(d))) for s, d in zip(idx, shape))
+        return np.zeros(slice_shape, dtype)  # ml_dtypes covers bf16
+    return jax.make_array_from_callback(tuple(shape), sharding, cb)
+
+
+def replicate_global(tree, mesh):
+    """Replicate a host pytree over the whole (possibly multi-process)
+    mesh — the serving twin of parallel/data_parallel.replicate, built
+    on make_array_from_callback so it also works multi-controller."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: _make_global(np.asarray(x), sharding), tree)
+
+
+# ---------------------------------------------------------------- engine
+class ServeEngine:
+    """The continuous-batching engine: host scheduler + one jit'd mixed
+    prefill/decode step over the paged cache.
+
+    ``model`` is a model module exposing ``init_cache`` / ``apply_cached``
+    (models/llama.py, models/moe_llama.py); ``model_cfg`` its config
+    dataclass; ``params`` the trained pytree (host or global arrays).
+    """
+
+    def __init__(self, model, model_cfg, params, cfg: ServeConfig,
+                 mesh=None):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cfg.validate(model_max_seq=model_cfg.max_seq)
+        self.model = model
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        if mesh is None:
+            from .. import runtime as _rt
+            mesh = _rt.get().mesh
+        self.mesh = mesh
+        self.scheduler = Scheduler(cfg)
+        self._repl = NamedSharding(mesh, P())
+        self._cache_shd = cache_shardings(mesh, cfg.cache_blocks,
+                                          model_cfg.n_kv_heads)
+        leaves = jax.tree_util.tree_leaves(params)
+        if leaves and isinstance(leaves[0], jax.Array):
+            self.params = params
+        else:
+            self.params = replicate_global(params, mesh)
+        cache_struct = jax.eval_shape(
+            lambda: model.init_cache(model_cfg, cfg.cache_blocks,
+                                     cfg.block_size))
+        self.cache = jax.tree_util.tree_map(
+            lambda x: _global_zeros(x.shape, x.dtype, self._cache_shd),
+            cache_struct)
+        self._step_fn = self._build_step()
+        # One-deep tick pipeline (the loader.prefetch deque pattern):
+        # holds (plan, device next-token array) until the next step()
+        # harvests it, so host scheduling overlaps device compute.
+        self._inflight: "collections.deque" = collections.deque()
+        self.tick = 0
+        self._tokens_prefill = 0
+        self._tokens_decode = 0
+        self._last_fill = 0.0
+
+    # ----------------------------------------------------------- compile
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        model, mcfg = self.model, self.model_cfg
+
+        def step_fn(params, cache, block_tables, lengths, n_new, tokens):
+            out = model.apply_cached(params, tokens, mcfg, cache,
+                                     block_tables, lengths, n_new)
+            logits, cache = out[0], out[1]  # moe also returns aux
+            last = jnp.maximum(n_new - 1, 0)
+            logits_last = jnp.take_along_axis(
+                logits, last[:, None, None], axis=1)[:, 0]
+            # Greedy sampling ON DEVICE: the token feeds the next tick
+            # without a host round trip in the value chain, and argmax
+            # ties break identically on every rank (SPMD determinism).
+            next_tokens = jnp.argmax(
+                logits_last.astype(jnp.float32), axis=-1).astype(jnp.int32)
+            return cache, next_tokens
+
+        return jax.jit(
+            step_fn,
+            donate_argnums=(1,),
+            out_shardings=(
+                jax.tree_util.tree_map(lambda _: self._cache_shd,
+                                       self.cache),
+                self._repl))
+
+    # ------------------------------------------------------------ intake
+    def submit(self, tokens, max_new_tokens: int,
+               req_id: Optional[str] = None,
+               eos_id: Optional[int] = None) -> Request:
+        req = Request(tokens, max_new_tokens, req_id=req_id,
+                      eos_id=eos_id if eos_id is not None
+                      else self.cfg.eos_id)
+        return self.scheduler.submit(req)
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work() or bool(self._inflight)
+
+    # -------------------------------------------------------------- tick
+    def step(self) -> Dict[str, Any]:
+        """Run one engine tick.  Returns the COMPLETED tick's report
+        (one tick of pipeline lag): {"tick", "processed", "emitted":
+        {req_id: [new tokens]}, "finished": [Request]} — an idle report
+        when nothing completed."""
+        report = self._harvest()
+        self._dispatch()
+        self._update_gauges()
+        return report
+
+    def flush(self) -> List[Dict[str, Any]]:
+        """Drain until idle (no planned work, nothing in flight)."""
+        out = []
+        while self.has_work():
+            out.append(self.step())
+        return out
+
+    def _dispatch(self) -> None:
+        work = self.scheduler.plan()
+        for slot, req, n in work:
+            if req.admitted_t is not None and not req.pos and \
+                    req.state == "prefill" and req.ctx_len == 0:
+                # queue-wait span, emitted once at admission
+                self._span("NEGOTIATE", req,
+                           req.admitted_t - req.submitted_t,
+                           end_t=req.admitted_t)
+        if not work:
+            return
+        cfg = self.cfg
+        S, C = cfg.max_slots, cfg.prefill_chunk
+        tokens = np.zeros((S, C), np.int32)
+        lengths = np.zeros(S, np.int32)
+        n_new = np.zeros(S, np.int32)
+        for slot, req, n in work:
+            if req.state == "prefill":
+                tokens[slot, :n] = req.tokens[req.pos:req.pos + n]
+            else:
+                tokens[slot, 0] = req.out_tokens[-1]
+            lengths[slot] = req.ctx_len
+            n_new[slot] = n
+        # Async dispatch: device_put + jit return immediately; the next
+        # step() harvests, so this tick's H2D staging and compute run
+        # behind the caller's host work (the double-buffer pattern).
+        dev = [_make_global(a, self._repl)
+               for a in (np.asarray(self.scheduler.block_tables),
+                         lengths, n_new, tokens)]
+        self.cache, next_tokens = self._step_fn(
+            self.params, self.cache, *dev)
+        used = int(n_new.sum())
+        self._last_fill = used / cfg.max_batch_tokens
+        self._inflight.append((self.tick, work, next_tokens, used))
+        self.tick += 1
+
+    def _harvest(self) -> Dict[str, Any]:
+        if not self._inflight:
+            return {"tick": None, "processed": 0, "emitted": {},
+                    "finished": []}
+        from ..utils import metrics as M
+        tick, work, next_tokens, used = self._inflight.popleft()
+        tokens_host = np.asarray(next_tokens)  # D2H fence for this tick
+        now = time.perf_counter()
+        emitted: Dict[str, List[int]] = {}
+        finished: List[Request] = []
+        for slot, req, n in work:
+            if req.state == "prefill":
+                req.pos += n
+                req.ctx_len += n
+                self._tokens_prefill += n
+                M.SERVE_TOKENS.inc(n, phase="prefill")
+                if req.pos < req.prompt_len:
+                    continue  # still prefilling next tick
+                req.state = "decode"
+            else:
+                req.ctx_len += 1
+                self._tokens_decode += 1
+                M.SERVE_TOKENS.inc(phase="decode")
+            tok = int(tokens_host[slot])
+            req.out_tokens.append(tok)
+            emitted.setdefault(req.req_id, []).append(tok)
+            if req.first_token_t is None:
+                req.first_token_t = now
+                M.SERVE_TTFT.observe(req.ttft())
+                self._span("PREFILL", req, now - req.admitted_t,
+                           end_t=now, extra={"prompt": req.prompt_len})
+            if (req.eos_id is not None and tok == req.eos_id) or \
+                    len(req.out_tokens) >= req.max_new_tokens:
+                reason = ("eos" if req.eos_id is not None
+                          and tok == req.eos_id else "completed")
+                self.scheduler.finish(req, reason)
+                finished.append(req)
+                tpot = req.tpot()
+                if tpot is not None:
+                    M.SERVE_TPOT.observe(tpot)
+                M.SERVE_REQUESTS.inc(outcome=reason)
+                self._span("DECODE", req, req.done_t - req.first_token_t,
+                           end_t=req.done_t,
+                           extra={"generated": len(req.out_tokens)})
+        from .. import postmortem as PM
+        PM.record_step(tick)  # engine liveness on the /health plane
+        return {"tick": tick, "processed": used, "emitted": emitted,
+                "finished": finished}
+
+    def _update_gauges(self) -> None:
+        from ..utils import metrics as M
+        M.SERVE_QUEUE_DEPTH.set(self.scheduler.queue_depth)
+        M.SERVE_BATCH_FILL.set(self._last_fill)
+
+    # ------------------------------------------------------------- spans
+    def _span(self, phase: str, req: Request, duration_s: float,
+              end_t: float, extra: Optional[dict] = None) -> None:
+        """Per-request phase span on the merged timeline's 'serve' lane
+        (utils/timeline.record_span); no-op without an active timeline."""
+        try:
+            from .. import runtime as _rt
+            if not _rt.is_initialized():
+                return
+            tl = getattr(_rt.get(), "timeline", None)
+            if tl is None:
+                return
+            args = {"req": req.req_id}
+            if extra:
+                args.update(extra)
+            lag_us = (time.perf_counter() - end_t) * 1e6
+            tl.record_span("serve", phase, max(duration_s, 0.0) * 1e6,
+                           args=args, ts_us=tl.now_us() - lag_us
+                           - max(duration_s, 0.0) * 1e6)
+        except Exception:
+            pass  # tracing must never take serving down
+
+    # -------------------------------------------------------------- view
+    def stats(self) -> Dict[str, Any]:
+        s = self.scheduler
+        return {
+            "tick": self.tick,
+            "active": s.active,
+            "waiting": s.queue_depth,
+            "completed": s.completed,
+            "free_blocks": s.allocator.free_count,
+            "batch_fill": round(self._last_fill, 4),
+            "tokens_prefill": self._tokens_prefill,
+            "tokens_decode": self._tokens_decode,
+        }
+
+
+# ----------------------------------------------------- servable loading
+SERVE_MANIFEST = "serve.json"
+
+_MODEL_MODULES = {"llama": "horovod_tpu.models.llama",
+                  "moe_llama": "horovod_tpu.models.moe_llama"}
+
+
+def save_servable(directory: str, model_name: str, config, params,
+                  step: int = 0) -> None:
+    """Write a servable directory: ``serve.json`` (model family +
+    config) beside a sharded checkpoint (checkpoint.py) — what
+    ``hvdrun --serve DIR`` consumes."""
+    import dataclasses
+    from .. import checkpoint as ckpt
+    os.makedirs(directory, exist_ok=True)
+    cfg_dict = {k: v for k, v in dataclasses.asdict(config).items()
+                if not hasattr(v, "dtype")}
+    cfg_dict.pop("dtype", None)
+    with open(os.path.join(directory, SERVE_MANIFEST), "w") as f:
+        json.dump({"model": model_name, "config": cfg_dict}, f)
+    ckpt.save_checkpoint(directory, step, params=params)
+
+
+def load_servable(directory: str, mesh) -> Tuple[Any, Any, Any]:
+    """Read a servable directory -> (model module, model config, global
+    replicated params).  ``serve.json``: {"model": "llama"|"moe_llama",
+    "config": <name in CONFIGS or kwarg dict>, "seed": int?}.  Params
+    come from the latest checkpoint under the directory (restored
+    through checkpoint.py into replicated shardings); with no
+    checkpoint present, a seeded random init serves — the CPU-virtual
+    smoke path, loudly labeled."""
+    import importlib
+    import sys
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    with open(os.path.join(directory, SERVE_MANIFEST)) as f:
+        manifest = json.load(f)
+    name = manifest.get("model", "llama")
+    if name not in _MODEL_MODULES:
+        raise ValueError(f"serve.json model {name!r} unknown; expected "
+                         f"one of {sorted(_MODEL_MODULES)}")
+    model = importlib.import_module(_MODEL_MODULES[name])
+    spec = manifest.get("config", "tiny")
+    if isinstance(spec, str):
+        model_cfg = model.CONFIGS[spec]
+    else:
+        model_cfg = type(model.CONFIGS["tiny"])(**spec)
+
+    seed = int(manifest.get("seed", 0))
+    host = model.init(jax.random.PRNGKey(seed), model_cfg)
+    repl = NamedSharding(mesh, P())
+    from .. import checkpoint as ckpt
+    try:
+        mgr = ckpt.CheckpointManager(directory, max_to_keep=10_000)
+        try:
+            latest = mgr.latest_step()
+            if latest is not None:
+                template = jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                   sharding=repl), host)
+                params = mgr.restore(latest, params=template)["params"]
+                return model, model_cfg, params
+        finally:
+            mgr.close()
+    except FileNotFoundError:
+        pass
+    print(f"[hvd.serve] no checkpoint under {directory}; serving "
+          f"seed={seed} random-init params (smoke mode)",
+          file=sys.stderr, flush=True)
+    return model, model_cfg, replicate_global(host, mesh)

@@ -1,0 +1,197 @@
+"""Request router: the serving plane's front door on the rendezvous
+HTTP server (docs/serving.md).
+
+``POST /generate`` accepts ``{"tokens": [...], "max_new_tokens": N}``,
+enqueues the request onto the rendezvous KV (scope ``serve_req`` —
+the SAME transport every other plane rides), and streams the engine's
+tokens back as newline-delimited JSON while rank 0 of the engine fleet
+publishes them (scope ``serve_out``).  ``GET /serve/stats`` merges the
+router's queue counters with the engine's self-published stats (scope
+``serve`` key ``stats``).
+
+Backpressure: the router is the admission valve in front of the
+engine's own max_batch_tokens budget — beyond ``max_pending``
+unfinished requests it answers 429 immediately instead of growing an
+unbounded queue (tested in tests/test_serve.py).
+
+The handler side runs inside runner/http_server.py's threaded server
+(one thread per in-flight stream — the async queue is the KV scope, the
+threads are just the drains), so the router needs no process of its
+own: ``hvdrun --serve`` gives the fleet a router for free.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Optional
+
+REQ_SCOPE = "serve_req"
+OUT_SCOPE = "serve_out"
+PLAN_SCOPE = "serve_plan"
+STATS_SCOPE = "serve"
+STATS_KEY = "stats"
+
+DEFAULT_MAX_PENDING = 64
+DEFAULT_STREAM_TIMEOUT_S = 120.0
+_POLL_S = 0.02
+
+
+def req_key(seq: int) -> str:
+    return f"req.{seq:06d}"
+
+
+class RouterState:
+    """Router-side counters: submitted/completed/rejected + the dense
+    sequence numbering the engine fleet consumes in order."""
+
+    def __init__(self, max_pending: int = DEFAULT_MAX_PENDING,
+                 stream_timeout_s: float = DEFAULT_STREAM_TIMEOUT_S):
+        self.max_pending = int(max_pending)
+        self.stream_timeout_s = float(stream_timeout_s)
+        self._lock = threading.Lock()
+        self.next_seq = 0
+        self.completed = 0
+        self.rejected = 0
+
+    def try_claim(self) -> Optional[int]:
+        """Next sequence number, or None under backpressure."""
+        with self._lock:
+            if self.next_seq - self.completed >= self.max_pending:
+                self.rejected += 1
+                return None
+            seq = self.next_seq
+            self.next_seq += 1
+            return seq
+
+    def finish_stream(self) -> None:
+        with self._lock:
+            self.completed += 1
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {"submitted": self.next_seq,
+                    "completed": self.completed,
+                    "rejected": self.rejected,
+                    "pending": self.next_seq - self.completed,
+                    "max_pending": self.max_pending}
+
+
+def get_router_state(server) -> RouterState:
+    """Lazily attach one RouterState to the rendezvous HTTP server."""
+    state = getattr(server, "serve_router", None)
+    if state is None:
+        state = server.serve_router = RouterState()
+    return state
+
+
+def parse_generate_body(raw: bytes) -> Dict[str, Any]:
+    """Validate one /generate body; raises ValueError with a
+    client-renderable message."""
+    try:
+        body = json.loads(raw or b"{}")
+    except ValueError:
+        raise ValueError("body is not valid JSON")
+    tokens = body.get("tokens")
+    if not isinstance(tokens, list) or not tokens or \
+            not all(isinstance(t, int) and t >= 0 for t in tokens):
+        raise ValueError("'tokens' must be a non-empty list of token ids "
+                         "(no server-side tokenizer; docs/serving.md)")
+    max_new = body.get("max_new_tokens", 16)
+    if not isinstance(max_new, int) or max_new < 1:
+        raise ValueError("'max_new_tokens' must be a positive int")
+    out = {"tokens": tokens, "max_new_tokens": max_new}
+    if body.get("eos_id") is not None:
+        if not isinstance(body["eos_id"], int):
+            raise ValueError("'eos_id' must be an int")
+        out["eos_id"] = body["eos_id"]
+    return out
+
+
+def handle_generate(handler) -> None:
+    """POST /generate on the rendezvous server: enqueue to the KV, then
+    stream ndjson lines ({"tokens": [...]} parts, then {"done": ...})
+    as the engine publishes them.  Connection close delimits the body
+    (HTTP/1.0 semantics of the rendezvous server)."""
+    server = handler.server
+    state = get_router_state(server)
+    length = int(handler.headers.get("Content-Length", 0))
+    raw = handler.rfile.read(length)
+    try:
+        req = parse_generate_body(raw)
+    except ValueError as e:
+        _json_response(handler, 400, {"error": str(e)})
+        return
+    seq = state.try_claim()
+    if seq is None:
+        _json_response(handler, 429, {
+            "error": "serving queue full",
+            **state.counters()})
+        return
+    key = req_key(seq)
+    req["id"] = key
+    req["submitted_t"] = time.time()
+    try:
+        with server.kv_lock:
+            server.kv.setdefault(REQ_SCOPE, {})[key] = \
+                json.dumps(req).encode()
+            server.kv_times.setdefault(REQ_SCOPE, {})[key] = time.time()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("X-Serve-Request-Id", key)
+        handler.end_headers()
+        _stream_results(handler, server, key, state.stream_timeout_s)
+    finally:
+        state.finish_stream()
+
+
+def _stream_results(handler, server, key: str, timeout_s: float) -> None:
+    """Drain ``serve_out`` parts for one request to the client as they
+    arrive; ends with the ``.done`` record (or a timeout record)."""
+    deadline = time.time() + timeout_s
+    part = 0
+    while True:
+        with server.kv_lock:
+            scope = server.kv.get(OUT_SCOPE, {})
+            chunk = scope.get(f"{key}.part.{part:06d}")
+            done = scope.get(f"{key}.done")
+        if chunk is not None:
+            handler.wfile.write(chunk + b"\n")
+            handler.wfile.flush()
+            part += 1
+            continue
+        if done is not None:
+            handler.wfile.write(done + b"\n")
+            handler.wfile.flush()
+            return
+        if time.time() >= deadline:
+            handler.wfile.write(json.dumps(
+                {"error": f"timed out after {timeout_s:.0f}s waiting for "
+                          f"{key}"}).encode() + b"\n")
+            return
+        time.sleep(_POLL_S)
+
+
+def render_stats(server) -> Dict[str, Any]:
+    """GET /serve/stats: router counters + the engine fleet's
+    self-published stats (KV scope ``serve`` key ``stats``)."""
+    state = get_router_state(server)
+    out: Dict[str, Any] = {"router": state.counters()}
+    with server.kv_lock:
+        raw = server.kv.get(STATS_SCOPE, {}).get(STATS_KEY)
+    if raw is not None:
+        try:
+            out["engine"] = json.loads(raw)
+        except (ValueError, TypeError):
+            pass  # a torn PUT must not 500 the stats view
+    return out
+
+
+def _json_response(handler, code: int, obj: Dict[str, Any]) -> None:
+    body = json.dumps(obj).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)

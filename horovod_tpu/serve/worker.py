@@ -1,0 +1,264 @@
+"""Serving worker: `hvdrun --serve CKPT_DIR` runs one of these per host.
+
+Bring-up mirrors a training worker — ``hvd.init()`` assembles the same
+mesh from the same launcher env — then the engine serves instead of
+trains.  Fleet coordination rides the existing rendezvous KV:
+
+  * the router (runner/http_server.py + serve/router.py) enqueues
+    requests with dense sequence numbers into scope ``serve_req``;
+  * rank 0 drains them, publishes a per-tick PLAN (scope ``serve_plan``
+    key ``tick.N``) carrying the admitted requests verbatim, and every
+    rank — rank 0 included — applies the same plan to its own engine
+    copy.  Engine scheduling and sampling are deterministic
+    (serve/engine.py), so the fleet stays in lockstep without any new
+    transport: the plan stream is the only coordination channel, and it
+    is the same KV the chaos/metrics/timeline planes already exercise;
+  * rank 0 publishes results (scope ``serve_out``: per-tick token parts
+    + a final ``.done`` record) that the router streams to clients, and
+    a periodic engine-stats snapshot (scope ``serve`` key ``stats``)
+    for ``GET /serve/stats``.
+
+SLO observability is inherited, not added: the engine records
+hvd_serve_* metrics (published by MetricsPublisher to /metrics),
+per-request spans into the merged timeline, and
+``hvd.postmortem.record_step`` ticks so /health supervision sees a
+wedged engine exactly like a wedged train loop (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from .router import (OUT_SCOPE, PLAN_SCOPE, REQ_SCOPE, STATS_KEY,
+                     STATS_SCOPE, req_key)
+
+_IDLE_SLEEP_S = 0.02
+_STATS_INTERVAL_S = 1.0
+
+
+def plan_key(tick: int) -> str:
+    return f"tick.{tick:09d}"
+
+
+class FleetFrontend:
+    """Drives one engine in fleet lockstep (see module docstring).
+    ``addr``/``port`` empty means standalone (no KV; local submissions
+    only — the bench/load-generator path)."""
+
+    def __init__(self, engine, addr: str, port: int, rank: int,
+                 nprocs: int, plan_timeout_s: float = 120.0):
+        self.engine = engine
+        self.addr = addr
+        self.port = int(port or 0)
+        self.rank = int(rank)
+        self.nprocs = int(nprocs)
+        self.plan_timeout_s = float(plan_timeout_s)
+        self.tick = 0
+        self._next_seq = 0
+        self._parts: Dict[str, int] = {}
+        self._results: Dict[str, List[int]] = {}
+        self._last_stats = 0.0
+
+    # ------------------------------------------------------------ KV I/O
+    def _kv(self):
+        from ..runner import http_client
+        return http_client
+
+    def _drain_requests(self) -> List[Dict[str, Any]]:
+        """Rank 0: consume newly-arrived requests in sequence order
+        (dense router numbering -> nonblocking probes, no listing)."""
+        reqs = []
+        kv = self._kv()
+        while True:
+            raw = kv.get_kv(self.addr, self.port, REQ_SCOPE,
+                            req_key(self._next_seq), timeout=0)
+            if raw is None:
+                return reqs
+            try:
+                reqs.append(json.loads(raw))
+            except (ValueError, TypeError):
+                reqs.append(None)  # torn PUT: hold the dense numbering
+            self._next_seq += 1
+
+    def _publish_plan(self, reqs: List[Dict[str, Any]],
+                      stop: bool = False) -> None:
+        self._kv().put_kv(self.addr, self.port, PLAN_SCOPE,
+                          plan_key(self.tick),
+                          json.dumps({"tick": self.tick, "stop": stop,
+                                      "reqs": reqs}).encode())
+
+    def _fetch_plan(self) -> Dict[str, Any]:
+        raw = self._kv().get_kv(self.addr, self.port, PLAN_SCOPE,
+                                plan_key(self.tick),
+                                timeout=self.plan_timeout_s)
+        if raw is None:
+            raise TimeoutError(
+                f"rank {self.rank}: no plan {plan_key(self.tick)} after "
+                f"{self.plan_timeout_s:.0f}s — rank 0 gone?")
+        return json.loads(raw)
+
+    # ----------------------------------------------------------- outputs
+    def _publish_report(self, report: Dict[str, Any]) -> None:
+        kv = self._kv()
+        for rid, toks in report["emitted"].items():
+            self._results.setdefault(rid, []).extend(toks)
+            part = self._parts.get(rid, 0)
+            kv.put_kv(self.addr, self.port, OUT_SCOPE,
+                      f"{rid}.part.{part:06d}",
+                      json.dumps({"tokens": toks}).encode())
+            self._parts[rid] = part + 1
+        for req in report["finished"]:
+            kv.put_kv(self.addr, self.port, OUT_SCOPE,
+                      f"{req.req_id}.done",
+                      json.dumps({
+                          "done": True,
+                          "tokens": self._results.pop(req.req_id, []),
+                          "finish_reason": req.finish_reason,
+                          "ttft_s": req.ttft(),
+                          "tpot_s": req.tpot(),
+                      }).encode())
+            self._parts.pop(req.req_id, None)
+
+    def _publish_stats(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_stats < _STATS_INTERVAL_S:
+            return
+        self._last_stats = now
+        self._kv().put_kv(self.addr, self.port, STATS_SCOPE, STATS_KEY,
+                          json.dumps(self.engine.stats()).encode())
+
+    # -------------------------------------------------------------- loop
+    def run(self, ttl_s: float = 0.0) -> int:
+        """Serve until ``ttl_s`` elapses (0 = until interrupted).  Rank 0
+        paces the fleet; followers block on the plan stream."""
+        fleet = self.nprocs > 1 and bool(self.addr and self.port)
+        solo_kv = self.nprocs == 1 and bool(self.addr and self.port)
+        t0 = time.monotonic()
+        stop = False
+        try:
+            while True:
+                if self.rank == 0:
+                    reqs = self._drain_requests() if (fleet or solo_kv) \
+                        else []
+                    stop = bool(ttl_s and time.monotonic() - t0 >= ttl_s
+                                and not self.engine.has_work())
+                    if fleet:
+                        self._publish_plan(reqs, stop=stop)
+                else:
+                    plan = self._fetch_plan()
+                    reqs, stop = plan["reqs"], plan["stop"]
+                self.tick += 1
+                if stop:
+                    break
+                for r in reqs:
+                    if r is None:
+                        continue
+                    try:
+                        self.engine.submit(r["tokens"],
+                                           r["max_new_tokens"],
+                                           req_id=r.get("id"),
+                                           eos_id=r.get("eos_id"))
+                    except ValueError as e:
+                        # invalid per the engine's limits: answer it so
+                        # the router stream doesn't hang to timeout
+                        if self.rank == 0 and r.get("id") and \
+                                (fleet or solo_kv):
+                            self._kv().put_kv(
+                                self.addr, self.port, OUT_SCOPE,
+                                f"{r['id']}.done",
+                                json.dumps({"done": True, "tokens": [],
+                                            "error": str(e)}).encode())
+                report = self.engine.step()
+                if self.rank == 0 and (fleet or solo_kv):
+                    self._publish_report(report)
+                    self._publish_stats()
+                if not self.engine.has_work() and not reqs:
+                    if self.rank == 0:
+                        time.sleep(_IDLE_SLEEP_S)
+        except KeyboardInterrupt:
+            if self.rank == 0 and fleet:
+                # release the followers blocked on the plan stream
+                try:
+                    self._publish_plan([], stop=True)
+                except Exception:
+                    pass
+            raise
+        if self.rank == 0 and (fleet or solo_kv):
+            self._publish_stats(force=True)
+        return 0
+
+
+def _cpu_virtual_bootstrap() -> None:
+    """CPU-virtual fleet guard (the packaged twin of the test tier's
+    scripts/_cpu_bootstrap.py): when the launcher pinned this worker to
+    the CPU backend, disarm the TPU image's sitecustomize and select
+    gloo CPU collectives BEFORE any backend-touching call — orbax
+    restore and the mesh both run multi-process psums, which XLA's
+    default CPU client cannot do across processes.  HVD_CPU_CHIPS (>1)
+    virtualizes that many devices per process, like the test workers."""
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        return
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    chips = os.environ.get("HVD_CPU_CHIPS")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if chips and "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            + chips).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # other jax versions: default implementation already works
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.serve.worker",
+        description="Serving-fleet worker (launched by hvdrun --serve)")
+    ap.add_argument("ckpt_dir", help="servable directory: serve.json + "
+                                     "checkpoint (docs/serving.md)")
+    ap.add_argument("--ttl", type=float, default=0.0,
+                    help="seconds to serve before a clean exit "
+                         "(0 = until interrupted); bounded CI smokes "
+                         "use this")
+    args = ap.parse_args(argv)
+
+    _cpu_virtual_bootstrap()
+    import horovod_tpu as hvd
+    hvd.init()
+    rt = __import__("horovod_tpu.runtime", fromlist=["get"]).get()
+    from .config import from_knobs
+    from .engine import ServeEngine, load_servable
+    scfg = from_knobs(rt.knobs)
+    model, model_cfg, params = load_servable(args.ckpt_dir, hvd.mesh())
+    # The knob default (2048) may exceed a small model's max_seq; clamp
+    # rather than fail — the model is the binding constraint.
+    if scfg.max_seq_len > model_cfg.max_seq:
+        import dataclasses
+        scfg = dataclasses.replace(scfg, max_seq_len=model_cfg.max_seq)
+    engine = ServeEngine(model, model_cfg, params, scfg, mesh=hvd.mesh())
+    frontend = FleetFrontend(
+        engine,
+        rt.knobs["HOROVOD_RENDEZVOUS_ADDR"],
+        rt.knobs["HOROVOD_RENDEZVOUS_PORT"],
+        hvd.process_rank(), hvd.process_size())
+    print(f"SERVE-READY rank {hvd.process_rank()} "
+          f"({type(model_cfg).__name__}, slots={scfg.max_slots}, "
+          f"blocks={scfg.cache_blocks}x{scfg.block_size})", flush=True)
+    if hvd.process_rank() == 0 and frontend.addr and frontend.port:
+        frontend._publish_stats(force=True)  # readiness for the router
+    try:
+        return frontend.run(ttl_s=args.ttl)
+    except KeyboardInterrupt:
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -332,6 +332,35 @@ OVERLAP_FRACTION = REGISTRY.gauge(
     "Fraction of modeled sync bytes issued concurrently with compute "
     "per compiled step, by plane (1 - exposed/total; ops/overlap.py).")
 
+# Serving plane (serve/engine.py; docs/serving.md).  SLO telemetry for
+# the continuous-batching engine: latency distributions per REQUEST
+# (ttft = submit->first token including queue wait; tpot = per-token
+# decode latency after the first token) and per-tick utilization gauges.
+# Rides the same publisher/exposition path as training, so /metrics and
+# the straggler machinery answer serving questions for free.
+SERVE_TTFT = REGISTRY.histogram(
+    "hvd_serve_ttft_seconds",
+    "Serving time-to-first-token per request: submit (queue entry) to "
+    "the first generated token, including queue wait and prefill.")
+SERVE_TPOT = REGISTRY.histogram(
+    "hvd_serve_tpot_seconds",
+    "Serving time-per-output-token per request: mean decode-step "
+    "latency after the first token (requests with >= 2 tokens).")
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "hvd_serve_queue_depth",
+    "Requests waiting for a serving slot (admitted = out of the queue).")
+SERVE_BATCH_FILL = REGISTRY.gauge(
+    "hvd_serve_batch_fill",
+    "Fraction of the max_batch_tokens admission budget the last engine "
+    "tick actually processed (continuous-batching utilization).")
+SERVE_REQUESTS = REGISTRY.counter(
+    "hvd_serve_requests_total",
+    "Serving requests by outcome (completed / eos / rejected).")
+SERVE_TOKENS = REGISTRY.counter(
+    "hvd_serve_tokens_total",
+    "Tokens processed by the serving engine, by phase "
+    "(prefill = prompt tokens cached, decode = tokens generated).")
+
 # Layer 3: runtime (stall inspector + topology).
 STRAGGLER_SUSPECT = REGISTRY.gauge(
     "hvd_straggler_suspect",

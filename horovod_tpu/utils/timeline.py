@@ -193,6 +193,24 @@ class Timeline:
                    {"name": op_type, "ph": "X", "ts": ts_us, "dur": dur,
                     "args": {"size": int(size)}})
 
+    def record_span(self, lane: str, name: str, duration_us: float,
+                    args: Optional[dict] = None,
+                    ts_us: Optional[float] = None) -> None:
+        """Complete (X) event with arbitrary args on a named lane —
+        :meth:`record_op` generalized for non-collective planes (the
+        serving engine's per-request NEGOTIATE/PREFILL/DECODE phases
+        ride this, args carrying the request id; docs/serving.md).
+        Without an explicit ``ts_us`` the span is anchored at its START
+        (now - duration), matching record_op's measured-at-completion
+        convention."""
+        if ts_us is None:
+            ts_us = self.now_us() - duration_us
+        ev = {"name": name, "ph": "X", "ts": ts_us,
+              "dur": float(duration_us)}
+        if args:
+            ev["args"] = dict(args)
+        self._emit(collapse_name(lane), ev)
+
     def instant(self, lane: str, name: str,
                 args: Optional[dict] = None,
                 ts_us: Optional[float] = None) -> None:

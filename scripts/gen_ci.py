@@ -74,6 +74,7 @@ SUITES = {
         "tests/test_elastic.py", "tests/test_tune.py",
         "tests/test_platform_utils.py",
     ],
+    "serving": ["tests/test_serve.py"],
     "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py",
                        "tests/test_profile_analyzer.py"],
 }
@@ -158,6 +159,18 @@ def build_steps():
         f"{full}",
         env={"JAX_PLATFORMS": "cpu"}, timeout=20))
     steps.append(_step(
+        # serving smoke: the full front door on a 2-process CPU-virtual
+        # fleet — hvdrun --serve restores a checkpoint.py servable,
+        # completes concurrent POST /generate requests with streamed
+        # tokens, exports nonzero hvd_serve_ttft at /metrics, leaves
+        # per-request spans in the merged timeline, and the plan-stream
+        # lockstep digests match across ranks (docs/serving.md).
+        # Tunnel-independent: loopback TCP + XLA-CPU decode only.
+        "serve: 2-process hvdrun --serve /generate smoke",
+        f"{py} -m pytest tests/integration/test_serve_integration.py "
+        f"{full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=20))
+    steps.append(_step(
         "dryrun: 8-chip multichip shardings",
         f'{py} -c "import __graft_entry__ as g; g.dryrun_multichip(8)"',
         env={"JAX_PLATFORMS": "cpu",
@@ -181,6 +194,13 @@ def build_steps():
         # (docs/overlap.md) — all CPU-virtual.
         "bench: overlap sweep smoke",
         f"{py} bench.py --overlap --cpu", timeout=15))
+    steps.append(_step(
+        # serving load-gen smoke: the continuous-batching engine under
+        # closed-loop and Poisson load emits plausible SLO rows (every
+        # request completes, percentiles ordered, batch fill in (0,1]),
+        # CPU-virtual labeled (docs/serving.md) — all CPU-virtual.
+        "bench: serve load-gen smoke",
+        f"{py} bench.py --serve --cpu", timeout=15))
     steps.append(_step(
         # promtool-check-metrics-style gate, pure Python (no external
         # dep): renders a populated fleet /metrics snapshot through the

@@ -1,0 +1,108 @@
+"""Per-rank worker for the serving-plane fleet-lockstep integration test.
+
+Launched by hvdrun with -np 2 (4 virtual CPU chips each, the 8-chip
+cross-process mesh): every rank builds the SAME engine from the servable
+manifest in $SERVE_TEST_DIR and runs serve.worker.FleetFrontend against
+the launcher's rendezvous KV — rank 0 drains the request scope and
+publishes the per-tick plan stream; rank 1 follows it.  A client thread
+on rank 0 plays the router: it enqueues requests with dense sequence
+numbers and waits for the ``.done`` records.
+
+The lockstep claim under test: engine scheduling and greedy sampling are
+deterministic, so both ranks finish the same requests with IDENTICAL
+token streams coordinated by nothing but the plan stream over the
+existing KV transport (docs/serving.md).  Each rank prints a digest of
+its engine's finished {req_id: tokens}; the test asserts the digests
+match across ranks.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import threading
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import horovod_tpu as hvd  # noqa: E402
+
+N_REQUESTS = 3
+MAX_NEW = 4
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.process_size() == 2, hvd.process_size()
+
+    import jax  # noqa: E402
+    from horovod_tpu.runner import http_client
+    from horovod_tpu.runtime import get as get_rt
+    from horovod_tpu.serve.config import ServeConfig
+    from horovod_tpu.serve.engine import ServeEngine, load_servable
+    from horovod_tpu.serve.router import OUT_SCOPE, REQ_SCOPE, req_key
+    from horovod_tpu.serve.worker import FleetFrontend
+    from horovod_tpu.utils import metrics as M
+
+    rt = get_rt()
+    addr = rt.knobs["HOROVOD_RENDEZVOUS_ADDR"]
+    port = int(rt.knobs["HOROVOD_RENDEZVOUS_PORT"])
+    assert addr and port, "launcher must provide the rendezvous KV"
+
+    model, cfg, params = load_servable(os.environ["SERVE_TEST_DIR"],
+                                       hvd.mesh())
+    scfg = ServeConfig(max_slots=2, block_size=4, cache_blocks=32,
+                       max_seq_len=32, max_batch_tokens=16,
+                       prefill_chunk=8)
+    engine = ServeEngine(model, cfg, params, scfg, mesh=hvd.mesh())
+
+    # Record every finished request's tokens on THIS rank (the frontend
+    # only tracks results on rank 0, but lockstep is a per-rank claim).
+    finished = {}
+    orig_step = engine.step
+
+    def recording_step():
+        rep = orig_step()
+        for r in rep["finished"]:
+            finished[r.req_id] = list(r.out_tokens)
+        return rep
+
+    engine.step = recording_step
+
+    if hvd.process_rank() == 0:
+        def client():
+            rng_tokens = [[(7 * i + j) % cfg.vocab
+                           for j in range(5 + 2 * i)]
+                          for i in range(N_REQUESTS)]
+            for i, toks in enumerate(rng_tokens):
+                http_client.put_kv(addr, port, REQ_SCOPE, req_key(i),
+                                   json.dumps({
+                                       "id": req_key(i), "tokens": toks,
+                                       "max_new_tokens": MAX_NEW}).encode())
+            for i in range(N_REQUESTS):
+                raw = http_client.get_kv(addr, port, OUT_SCOPE,
+                                         f"{req_key(i)}.done", timeout=60)
+                assert raw is not None, f"no done record for req {i}"
+                done = json.loads(raw)
+                assert len(done["tokens"]) == MAX_NEW, done
+                assert done["ttft_s"] and done["ttft_s"] > 0, done
+            print("CLIENT-OK", flush=True)
+
+        threading.Thread(target=client, daemon=True).start()
+
+    frontend = FleetFrontend(engine, addr, port, hvd.process_rank(),
+                             hvd.process_size())
+    frontend.run(ttl_s=8.0)
+
+    assert len(finished) == N_REQUESTS, sorted(finished)
+    # ttft observations moved on every rank (the SLO plane is per-rank)
+    ttft = sum(s["count"] for s in M.SERVE_TTFT.to_family()["samples"])
+    assert ttft >= N_REQUESTS, ttft
+    digest = hashlib.sha1(json.dumps(
+        sorted(finished.items())).encode()).hexdigest()[:16]
+    print(f"SERVE-OK rank {hvd.process_rank()} digest {digest}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

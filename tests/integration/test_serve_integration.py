@@ -1,0 +1,162 @@
+"""Serving-plane integration tier (docs/serving.md), two claims:
+
+* **fleet lockstep** — 2 engine ranks coordinated by nothing but rank
+  0's plan stream over the rendezvous KV finish identical requests with
+  identical tokens (serve_worker.py digests match);
+* **the full front door** — `hvdrun --serve CKPT_DIR` restores a real
+  checkpoint.py servable, serves concurrent `POST /generate` requests
+  with streamed ndjson tokens, exports nonzero hvd_serve_ttft
+  observations at `/metrics`, and leaves per-request PREFILL/DECODE
+  spans in the `--timeline-merge` merged Perfetto trace — the ISSUE 7
+  acceptance experiment.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from test_multiprocess import REPO, _free_port, run_hvdrun
+
+
+@pytest.mark.integration
+def test_serve_fleet_lockstep_two_processes(tmp_path):
+    """Both ranks serve the same 3 requests in KV-plan lockstep and
+    print identical token digests; rank 0's router-playing client sees
+    every .done record with a positive ttft."""
+    servable = tmp_path / "servable"
+    servable.mkdir()
+    (servable / "serve.json").write_text(
+        json.dumps({"model": "llama", "config": "tiny", "seed": 3}))
+    proc = run_hvdrun("serve_worker.py",
+                      extra_env={"SERVE_TEST_DIR": str(servable)})
+    assert proc.stdout.count("SERVE-OK") >= 2, proc.stdout
+    assert "CLIENT-OK" in proc.stdout, proc.stdout
+    digests = {ln.rsplit(" ", 1)[-1]
+               for ln in proc.stdout.splitlines() if "SERVE-OK" in ln}
+    assert len(digests) == 1, f"ranks diverged: {proc.stdout}"
+
+
+def _post_generate(port, tokens, max_new, out, idx, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps({"tokens": tokens,
+                         "max_new_tokens": max_new}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        out[idx] = [json.loads(ln) for ln in r.read().splitlines()]
+
+
+@pytest.mark.integration
+def test_hvdrun_serve_end_to_end(tmp_path):
+    """hvdrun --serve over a checkpoint.py servable: concurrent
+    /generate requests stream tokens, /metrics carries hvd_serve_ttft,
+    /serve/stats merges router + engine views, and the merged timeline
+    holds per-request serve spans."""
+    import jax
+    from horovod_tpu.models import llama
+    from horovod_tpu.serve.engine import save_servable
+
+    servable = str(tmp_path / "servable")
+    cfg = llama.CONFIGS["tiny"]
+    save_servable(servable, "llama", cfg,
+                  llama.init(jax.random.PRNGKey(0), cfg), step=7)
+
+    port = _free_port()
+    merged = str(tmp_path / "merged.json")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["HOROVOD_CONTROLLER_PORT"] = str(_free_port())
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "--coordinator-port", str(_free_port()),
+         "--serve", servable, "--serve-port", str(port),
+         "--serve-ttl", "45", "--timeline-merge", merged],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+    try:
+        # readiness: the rank-0 engine publishes its stats snapshot
+        deadline = time.time() + 240
+        ready = False
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/serve/stats",
+                        timeout=5) as r:
+                    if "engine" in json.loads(r.read()):
+                        ready = True
+                        break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.5)
+        assert ready, f"serving fleet never became ready (rc={proc.poll()})"
+
+        # concurrent requests through the router
+        results = [None] * 3
+        threads = [threading.Thread(
+            target=_post_generate, args=(port, [11 * i + 2] * (4 + i), 4,
+                                         results, i))
+            for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for i, lines in enumerate(results):
+            assert lines, f"request {i} got no response"
+            done = lines[-1]
+            assert done.get("done") is True, lines
+            assert len(done["tokens"]) == 4, done
+            assert done["ttft_s"] > 0, done
+
+        # stats reflect the completed requests
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/serve/stats", timeout=5) as r:
+            stats = json.loads(r.read())
+        assert stats["router"]["completed"] == 3, stats
+
+        # /metrics: nonzero hvd_serve_ttft observations (publisher
+        # interval 5 s — poll while the fleet drains its ttl)
+        ttft_seen = False
+        deadline = time.time() + 60
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                    text = r.read().decode()
+                for ln in text.splitlines():
+                    if ln.startswith("hvd_serve_ttft_seconds_count") \
+                            and float(ln.rsplit(" ", 1)[-1]) > 0:
+                        ttft_seen = True
+                if ttft_seen:
+                    break
+            except OSError:
+                pass
+            time.sleep(1.0)
+        assert ttft_seen, "no hvd_serve_ttft observations at /metrics"
+
+        out, _ = proc.communicate(timeout=180)
+        assert proc.returncode == 0, out[-4000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+    # merged timeline: per-request serve spans from the engine ranks
+    with open(merged) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"] if isinstance(trace, dict) else trace
+    serve_spans = [e for e in evs
+                   if e.get("ph") == "X" and e.get("name") in
+                   ("PREFILL", "DECODE")
+                   and str(e.get("args", {}).get("req", ""))
+                   .startswith("req.")]
+    assert serve_spans, "no per-request serve spans in the merged trace"
+    assert {e["name"] for e in serve_spans} >= {"PREFILL", "DECODE"}

@@ -143,6 +143,33 @@ def test_bench_overlap_cpu_contract():
     assert 0.0 < zero1["interleaved"]["overlapped_fraction"] <= 1.0
 
 
+@pytest.mark.slow
+def test_bench_serve_cpu_contract():
+    """--serve: the serving load-generator artifact (docs/serving.md):
+    a closed-loop row (fixed user pool, the throughput ceiling) and a
+    Poisson open-loop row, each carrying {throughput_tok_s,
+    ttft_p50/p99, tpot_p50/p99, batch_fill}, every request completing,
+    and the explicit CPU-virtual labeling."""
+    env = dict(os.environ)
+    env["BENCH_DEADLINE_S"] = "300"
+    rec = _run_bench("--serve", env=env, timeout=400)
+    assert rec["unit"] == "tokens/sec"
+    assert "CPU-virtual" in rec["label"]
+    assert rec["vs_baseline_is"] == "closed_loop_batch_fill"
+    for mode in ("closed_loop", "poisson"):
+        row = rec[mode]
+        assert row["requests"] == 16, row
+        assert row["throughput_tok_s"] > 0
+        assert 0 < row["ttft_p50_s"] <= row["ttft_p99_s"]
+        assert 0 < row["tpot_p50_s"] <= row["tpot_p99_s"]
+        assert 0.0 < row["batch_fill"] <= 1.0
+    # the closed loop keeps slots fuller than the sub-saturation
+    # Poisson arrivals (60% of its measured request rate)
+    assert rec["closed_loop"]["batch_fill"] >= \
+        rec["poisson"]["batch_fill"]
+    assert rec["serve_config"]["max_batch_tokens"] > 0
+
+
 # ------------------------------------------------- supervisor unit tests
 def _fake_result(rc=0, stdout=""):
     class R:

@@ -48,11 +48,21 @@ def test_finds_and_aggregates_device_ops(trace_dir):
     per_op, busy_us, span_us = ap.summarize(
         events, pids, ap.op_tids(events, pids, tid_names))
     assert busy_us > 0 and span_us > 0
-    # the jitted program is two matmuls + tanh: a dot op must dominate
+    # the jitted program is two matmuls + tanh: both a dot op and the
+    # tanh must be found and categorized.  Which of the two WINS on
+    # total time is a CPU-thread-scheduling outcome, not a property of
+    # the analyzer — under a loaded full-suite run the 5 tanh
+    # dispatches can out-time the 256x256 dots (observed: tanh.3 at
+    # 154 µs > the dots) — so the top op is only asserted to be one of
+    # the program's compute ops, never a runtime/envelope frame.
     names = " ".join(per_op)
     assert "dot" in names, names
+    dots = {n: v for n, v in per_op.items()
+            if ap.categorize(n) == "matmul/conv"}
+    assert dots and all(us > 0 for us, _ in dots.values()), per_op
     top = max(per_op.items(), key=lambda kv: kv[1][0])
-    assert ap.categorize(top[0]) == "matmul/conv", top
+    assert ap.categorize(top[0]) in ("matmul/conv",
+                                     "elementwise/fusion"), top
     # python-frame / runtime-dispatch / envelope events are excluded
     for n in per_op:
         assert not n.startswith(("$", "end: ", "PjitFunction", "PjRt",

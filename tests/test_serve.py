@@ -1,0 +1,524 @@
+"""Serving plane (horovod_tpu/serve; docs/serving.md): the scheduler's
+admission/eviction discipline, paged-cache block reuse, the prefill+decode
+≡ full-forward equivalence on both model families, router backpressure,
+and the HOROVOD_SERVE_* knob validation contract."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.serve.config import (ServeConfig, from_knobs,
+                                      validate_serve_knobs)
+from horovod_tpu.serve.engine import (BlockAllocator, Request, Scheduler,
+                                      ServeEngine, cache_shardings)
+
+
+def _cfg(**kw):
+    base = dict(max_slots=2, block_size=4, cache_blocks=16, max_seq_len=32,
+                max_batch_tokens=16, prefill_chunk=8)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _one_device_mesh():
+    return jax.sharding.Mesh(np.array(jax.devices()[:1]), ("hvd",))
+
+
+# ------------------------------------------------------------- scheduler
+def test_scheduler_admits_fcfs_within_token_budget():
+    """One tick's plan: decode slots first (1 token each), then prefill
+    continuations, then FCFS admissions into leftover budget only."""
+    s = Scheduler(_cfg(max_slots=3, max_batch_tokens=10, prefill_chunk=8))
+    a = s.submit(Request([1] * 12, 4, req_id="a"))
+    b = s.submit(Request([2] * 6, 4, req_id="b"))
+    plan = s.plan()
+    # a eats one whole chunk (8), b gets the remaining 2-token budget
+    assert [(r.req_id, n) for _, r, n in plan] == [("a", 8), ("b", 2)]
+    assert a.state == "prefill" and b.state == "prefill"
+    assert s.queue_depth == 0 and s.active == 2
+
+
+def test_scheduler_decode_preempts_prefill_budget():
+    """Decode slots are latency-critical: they are planned before any
+    prefill work regardless of slot order, and a chunked prefill admits
+    new work only into leftover budget."""
+    s = Scheduler(_cfg(max_slots=2, max_batch_tokens=5, prefill_chunk=4))
+    p = s.submit(Request([1] * 12, 4, req_id="p"))
+    s.plan()  # admit p: first prefill chunk (4 of 12)
+    p.pos = p.ctx_len = 4
+    d = s.submit(Request([2, 3], 4, req_id="d"))
+    plan = s.plan()  # p continues (4); d admitted into the last token
+    assert [(r.req_id, n) for _, r, n in plan] == [("p", 4), ("d", 1)]
+    p.pos = p.ctx_len = 8
+    d.pos = d.ctx_len = 2
+    d.state = "decode"
+    d.out_tokens = [7]
+    plan = s.plan()
+    # d (decode, slot 1) outranks p (prefill, slot 0)
+    assert (plan[0][1].req_id, plan[0][2]) == ("d", 1)
+    assert (plan[1][1].req_id, plan[1][2]) == ("p", 4)
+
+
+def test_scheduler_admit_on_slot_free_and_evict():
+    """A finished request frees its slot and blocks the same tick, so
+    the next waiting request replaces it mid-flight (continuous
+    batching, not epoch batching)."""
+    cfg = _cfg(max_slots=1, cache_blocks=4, max_seq_len=16)
+    s = Scheduler(cfg)
+    a = s.submit(Request([1] * 4, 4, req_id="a"))
+    b = s.submit(Request([2] * 4, 4, req_id="b"))
+    s.plan()
+    assert a.slot == 0 and b.state == "waiting"  # no free slot for b
+    assert s.plan() and b.state == "waiting"
+    s.finish(a, "completed")
+    assert a.finish_reason == "completed" and a.slot is None
+    plan = s.plan()  # b admitted into a's slot the next plan
+    assert plan[0][1] is b and b.slot == 0
+    assert s.completed == 1
+
+
+def test_scheduler_fcfs_head_of_line_blocks_deterministically():
+    """Admission is strict FCFS: a head request that cannot get its
+    worst-case blocks blocks everything behind it — no skip-ahead, so
+    every rank's admission stream is identical."""
+    cfg = _cfg(max_slots=2, cache_blocks=4, block_size=4, max_seq_len=32)
+    s = Scheduler(cfg)
+    big = s.submit(Request([1] * 20, 12, req_id="big"))  # needs 8 blocks
+    small = s.submit(Request([2] * 4, 4, req_id="small"))  # would fit
+    assert s.plan() == []
+    assert big.state == "waiting" and small.state == "waiting"
+
+
+def test_scheduler_plan_stream_deterministic():
+    """Same submission sequence -> byte-identical plan stream (the
+    property that lets the fleet run lockstep from a plan log)."""
+    def run():
+        s = Scheduler(_cfg(max_slots=2, max_batch_tokens=8,
+                           prefill_chunk=4))
+        stream = []
+        for i in range(3):
+            s.submit(Request([i + 1] * (3 + i), 3, req_id=f"r{i}"))
+        for _ in range(12):
+            plan = s.plan()
+            stream.append([(r.req_id, slot, n) for slot, r, n in plan])
+            for slot, r, n in plan:
+                if r.state == "prefill":
+                    r.pos += n
+                    r.ctx_len += n
+                    if r.pos >= r.prompt_len:
+                        r.state = "decode"
+                else:
+                    r.ctx_len += 1
+                    r.out_tokens.append(0)
+                if r.state == "decode" and \
+                        len(r.out_tokens) >= r.max_new_tokens:
+                    s.finish(r, "completed")
+        return stream
+    assert run() == run()
+
+
+def test_scheduler_rejects_overlong_request():
+    s = Scheduler(_cfg(max_seq_len=16))
+    with pytest.raises(ValueError, match="HOROVOD_SERVE_MAX_SEQ_LEN"):
+        s.submit(Request([1] * 10, 8))
+
+
+def test_request_validation():
+    with pytest.raises(ValueError, match="empty prompt"):
+        Request([], 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        Request([1], 0)
+
+
+def test_block_allocator_lifo_reuse_and_all_or_nothing():
+    """LIFO reuse: the blocks a finished request frees are the first
+    ones the next request gets; an alloc that cannot be fully satisfied
+    takes nothing."""
+    a = BlockAllocator(4)
+    first = a.alloc(2)
+    assert first == [0, 1] and a.free_count == 2
+    assert a.alloc(3) is None and a.free_count == 2  # nothing taken
+    a.free(first)
+    assert a.alloc(2) == [0, 1]  # freed blocks come back first
+
+
+# ---------------------------------------------------- paged-cache engine
+@pytest.fixture(scope="module")
+def llama_tiny():
+    from horovod_tpu.models import llama
+    cfg = llama.CONFIGS["tiny"]
+    return llama, cfg, llama.init(jax.random.PRNGKey(0), cfg)
+
+
+@pytest.fixture(scope="module")
+def moe_tiny():
+    from horovod_tpu.models import moe_llama
+    cfg = moe_llama.CONFIGS["tiny"]
+    return moe_llama, cfg, moe_llama.init(jax.random.PRNGKey(1), cfg)
+
+
+def _full_logits(model, cfg, params, ids):
+    """Full-sequence forward logits; moe uses the batch-invariant
+    drop-free routing (the serving contract)."""
+    kw = {}
+    if hasattr(model, "dropfree_moe_fn"):
+        kw["moe_fn"] = model.dropfree_moe_fn(cfg)
+    out = model.apply(params, jnp.asarray(ids), cfg, **kw)
+    return np.asarray(out[0] if isinstance(out, tuple) else out)
+
+
+def _cached_logits(model, cfg, params, ids, prefill, block_size=4):
+    """Prefill the first ``prefill`` tokens in one chunk, then decode
+    the rest one token per call — the engine's tick contract, driven by
+    hand so the test owns the block table."""
+    T = len(ids)
+    nb = -(-T // block_size) + 1
+    cache = model.init_cache(cfg, nb, block_size)
+    bt = -np.ones((1, nb), np.int32)
+    bt[0, : nb - 1] = np.arange(nb - 1)
+    bt = jnp.asarray(bt)
+    C = prefill
+    rows = []
+    toks = np.zeros((1, C), np.int32)
+    toks[0, :prefill] = ids[:prefill]
+    out = model.apply_cached(params, jnp.asarray(toks), cfg, cache, bt,
+                             jnp.array([0]), jnp.array([prefill]))
+    logits, cache = out[0], out[1]
+    rows.append(np.asarray(logits[0, :prefill]))
+    for t in range(prefill, T):
+        toks = np.zeros((1, C), np.int32)
+        toks[0, 0] = ids[t]
+        out = model.apply_cached(params, jnp.asarray(toks), cfg, cache,
+                                 bt, jnp.array([t]), jnp.array([1]))
+        logits, cache = out[0], out[1]
+        rows.append(np.asarray(logits[0, :1]))
+    return np.concatenate(rows, axis=0)
+
+
+@pytest.mark.parametrize("family", ["llama", "moe"])
+def test_prefill_decode_bit_near_full_forward(family, llama_tiny,
+                                              moe_tiny):
+    """THE decode-path correctness contract (ISSUE 7 acceptance):
+    prefill + N decode steps over the paged cache reproduce the
+    full-sequence apply() logits bit-near on the shared prefix."""
+    model, cfg, params = llama_tiny if family == "llama" else moe_tiny
+    T = 12
+    ids = np.random.RandomState(7).randint(0, cfg.vocab, T)
+    full = _full_logits(model, cfg, params, ids[None])[0]
+    cached = _cached_logits(model, cfg, params, ids, prefill=8)
+    err = np.abs(cached - full).max()
+    assert err < 1e-5, f"{family}: max |cached - full| = {err}"
+
+
+def test_paged_layout_is_length_invariant(llama_tiny):
+    """Two sequences of different lengths share one pool with disjoint
+    block tables; each reproduces its own full forward — blocks are
+    genuinely isolated, not strided per slot."""
+    model, cfg, params = llama_tiny
+    rng = np.random.RandomState(3)
+    ids_a = rng.randint(0, cfg.vocab, 11)
+    ids_b = rng.randint(0, cfg.vocab, 5)
+    bs = 4
+    cache = model.init_cache(cfg, 8, bs)
+    bt = -np.ones((2, 4), np.int32)
+    bt[0, :3] = [0, 1, 2]   # a: up to 12 positions
+    bt[1, :2] = [5, 6]      # b: disjoint, out of order vs a
+    bt = jnp.asarray(bt)
+    C = 11
+    toks = np.zeros((2, C), np.int32)
+    toks[0, :11] = ids_a
+    toks[1, :5] = ids_b
+    out = model.apply_cached(params, jnp.asarray(toks), cfg, cache, bt,
+                             jnp.array([0, 0]), jnp.array([11, 5]))
+    full_a = _full_logits(model, cfg, params, ids_a[None])[0]
+    full_b = _full_logits(model, cfg, params, ids_b[None])[0]
+    assert np.abs(np.asarray(out[0][0, :11]) - full_a).max() < 1e-5
+    assert np.abs(np.asarray(out[0][1, :5]) - full_b).max() < 1e-5
+
+
+def _reference_greedy(model, cfg, params, prompt, n_new):
+    """Greedy continuation via the FULL forward, one token at a time —
+    the oracle the continuous-batching engine must match exactly."""
+    ids = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits = _full_logits(model, cfg, params,
+                              np.asarray(ids, np.int32)[None])
+        tok = int(np.argmax(logits[0, -1].astype(np.float32)))
+        out.append(tok)
+        ids.append(tok)
+    return out
+
+
+@pytest.mark.parametrize("family", ["llama", "moe"])
+def test_engine_matches_reference_greedy_decode(family, llama_tiny,
+                                                moe_tiny):
+    """Continuous batching must be invisible: mixed-length requests
+    admitted/evicted mid-flight produce exactly the tokens each would
+    get decoding alone through the full forward."""
+    model, cfg, params = llama_tiny if family == "llama" else moe_tiny
+    scfg = _cfg(max_slots=2, block_size=4, cache_blocks=32,
+                max_seq_len=32, max_batch_tokens=12, prefill_chunk=8)
+    engine = ServeEngine(model, cfg, params, scfg,
+                         mesh=_one_device_mesh())
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, cfg.vocab, n).tolist()
+               for n in (9, 4, 6, 11)]
+    reqs = [engine.submit(p, 5, req_id=f"r{i}")
+            for i, p in enumerate(prompts)]
+    engine.flush()
+    assert all(r.state == "done" for r in reqs)
+    for i, (p, r) in enumerate(zip(prompts, reqs)):
+        expect = _reference_greedy(model, cfg, params, p, 5)
+        assert r.out_tokens == expect, f"req {i}"
+
+
+def test_engine_block_reuse_and_eos_eviction(llama_tiny):
+    """Eviction frees blocks back to the pool (same free count after a
+    full drain) and an EOS hit finishes a request early with
+    finish_reason='eos'; the freed blocks are reused by a later
+    admission (LIFO observable through the allocator)."""
+    model, cfg, params = llama_tiny
+    scfg = _cfg(max_slots=1, block_size=4, cache_blocks=8,
+                max_seq_len=32, max_batch_tokens=8, prefill_chunk=8)
+    engine = ServeEngine(model, cfg, params, scfg,
+                         mesh=_one_device_mesh())
+    free0 = engine.scheduler.allocator.free_count
+    prompt = np.random.RandomState(2).randint(0, cfg.vocab, 6).tolist()
+    first = engine.submit(prompt, 4, req_id="probe")
+    engine.flush()
+    blocks_first = None
+    # run the same prompt with eos = its first generated token
+    eos = first.out_tokens[0]
+    engine2 = ServeEngine(model, cfg, params, scfg,
+                          mesh=_one_device_mesh())
+    r = engine2.submit(prompt, 4, req_id="eos-req", eos_id=eos)
+    engine2.step()
+    blocks_first = list(engine2.scheduler.slots[0].blocks)
+    engine2.flush()
+    assert r.finish_reason == "eos" and r.out_tokens == [eos]
+    assert engine2.scheduler.allocator.free_count == free0
+    # next admission reuses the just-freed blocks (LIFO free list: the
+    # earliest-freed block is appended last, so it pops first)
+    r2 = engine2.submit(prompt, 1, req_id="next")
+    engine2.step()
+    assert r2.blocks == blocks_first[: len(r2.blocks)]
+    engine2.flush()
+
+
+def test_engine_serve_metrics_move(llama_tiny, hvd):
+    """hvd_serve_* SLO families move when the engine serves: ttft/tpot
+    histogram counts, request outcome counters, token phase counters."""
+    model, cfg, params = llama_tiny
+    from horovod_tpu.utils import metrics as M
+    ttft0 = sum(s["count"] for s in M.SERVE_TTFT.to_family()["samples"])
+    req0 = sum(s["value"]
+               for s in M.SERVE_REQUESTS.to_family()["samples"])
+    engine = ServeEngine(model, cfg, params, _cfg(),
+                         mesh=_one_device_mesh())
+    engine.submit([1, 2, 3], 3, req_id="m")
+    engine.flush()
+    fams = hvd.metrics_snapshot()["families"]
+    ttft = sum(s["count"]
+               for s in fams["hvd_serve_ttft_seconds"]["samples"])
+    assert ttft == ttft0 + 1
+    outcomes = {s["labels"].get("outcome"): s["value"]
+                for s in fams["hvd_serve_requests_total"]["samples"]}
+    assert sum(outcomes.values()) == req0 + 1
+    phases = {s["labels"].get("phase"): s["value"]
+              for s in fams["hvd_serve_tokens_total"]["samples"]}
+    # 3 prompt tokens prefilled; the first output token rides the
+    # prefill tick, so 3 generated tokens = 2 decode-phase tokens
+    assert phases.get("prefill", 0) >= 3 and phases.get("decode", 0) >= 2
+
+
+def test_cache_shardings_ride_existing_axes():
+    """The paged pool shards along the training mesh's own axes: kv
+    heads over a model/tp axis when it divides, blocks over a data
+    axis; a 1-D mesh puts blocks on it and replicates heads."""
+    devs = np.array(jax.devices()[:8])
+    mesh2 = jax.sharding.Mesh(devs.reshape(4, 2), ("data", "model"))
+    spec = cache_shardings(mesh2, num_blocks=64, n_kv_heads=4).spec
+    assert spec == jax.sharding.PartitionSpec(
+        None, "data", None, "model", None)
+    # heads NOT divisible by the model axis -> replicated, blocks still
+    # land on the first dividing axis
+    spec = cache_shardings(mesh2, num_blocks=64, n_kv_heads=3).spec
+    assert spec[3] is None and spec[1] == "data"
+    mesh1 = jax.sharding.Mesh(devs, ("hvd",))
+    spec = cache_shardings(mesh1, num_blocks=64, n_kv_heads=4).spec
+    assert spec == jax.sharding.PartitionSpec(
+        None, "hvd", None, None, None)
+
+
+# ------------------------------------------------------ timeline spans
+def test_timeline_record_span_anchored_at_start(tmp_path):
+    from horovod_tpu.utils.timeline import Timeline, load_trace_events
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path)
+    t0 = tl.now_us()
+    tl.record_span("serve", "PREFILL", 2000.0, args={"req": "r1"})
+    tl.close()
+    evs = [e for e in load_trace_events(path) if e.get("name") == "PREFILL"]
+    assert len(evs) == 1 and evs[0]["ph"] == "X"
+    assert evs[0]["dur"] == 2000.0
+    assert evs[0]["args"]["req"] == "r1"
+    # anchored at start: ts ~ (emit time - dur), so >= t0 - dur - slack
+    assert evs[0]["ts"] + 2000.0 >= t0 - tl._epoch_us - 1e4
+
+
+# --------------------------------------------------------------- router
+def test_router_backpressure_claims():
+    from horovod_tpu.serve.router import RouterState
+    st = RouterState(max_pending=2)
+    assert st.try_claim() == 0 and st.try_claim() == 1
+    assert st.try_claim() is None  # full
+    st.finish_stream()
+    assert st.try_claim() == 2  # slot freed
+    c = st.counters()
+    assert c["rejected"] == 1 and c["pending"] == 2 and c["submitted"] == 3
+
+
+def test_parse_generate_body_validation():
+    from horovod_tpu.serve.router import parse_generate_body
+    ok = parse_generate_body(
+        json.dumps({"tokens": [1, 2], "max_new_tokens": 3,
+                    "eos_id": 0}).encode())
+    assert ok == {"tokens": [1, 2], "max_new_tokens": 3, "eos_id": 0}
+    assert parse_generate_body(
+        json.dumps({"tokens": [5]}).encode())["max_new_tokens"] == 16
+    for bad, msg in ((b"{nope", "not valid JSON"),
+                     (b"{}", "'tokens'"),
+                     (json.dumps({"tokens": []}).encode(), "'tokens'"),
+                     (json.dumps({"tokens": ["x"]}).encode(), "'tokens'"),
+                     (json.dumps({"tokens": [1],
+                                  "max_new_tokens": 0}).encode(),
+                      "max_new_tokens"),
+                     (json.dumps({"tokens": [1],
+                                  "eos_id": "e"}).encode(), "eos_id")):
+        with pytest.raises(ValueError, match=msg):
+            parse_generate_body(bad)
+
+
+@pytest.fixture()
+def rendezvous():
+    """(RendezvousServer, inner httpd, port): the handler-visible state
+    (kv, kv_lock, serve_router) lives on the inner ThreadingHTTPServer."""
+    from horovod_tpu.runner.http_server import RendezvousServer
+    server = RendezvousServer(host="127.0.0.1")
+    port = server.start()
+    yield server, server._httpd, port
+    server.stop()
+
+
+def _post(port, body, timeout=10):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_generate_route_streams_engine_results(rendezvous):
+    """Full front-door path with a scripted engine behind the KV: POST
+    /generate streams ndjson parts then the done record; /serve/stats
+    merges router counters with the engine's published stats."""
+    from horovod_tpu.serve import router as R
+    server, httpd, port = rendezvous
+
+    def fake_engine():
+        # wait for the router's enqueue, then publish two parts + done
+        deadline = time.time() + 10
+        raw = None
+        while time.time() < deadline:
+            raw = server.get(R.REQ_SCOPE, R.req_key(0))
+            if raw is not None:
+                break
+            time.sleep(0.01)
+        req = json.loads(raw)
+        assert req["tokens"] == [1, 2, 3] and req["max_new_tokens"] == 4
+        server.put(R.OUT_SCOPE, f"{req['id']}.part.000000",
+                   json.dumps({"tokens": [10, 11]}).encode())
+        time.sleep(0.05)
+        server.put(R.OUT_SCOPE, f"{req['id']}.part.000001",
+                   json.dumps({"tokens": [12]}).encode())
+        server.put(R.OUT_SCOPE, f"{req['id']}.done",
+                   json.dumps({"done": True, "tokens": [10, 11, 12],
+                               "finish_reason": "completed",
+                               "ttft_s": 0.01, "tpot_s": 0.002}).encode())
+        server.put(R.STATS_SCOPE, R.STATS_KEY,
+                   json.dumps({"tick": 3, "completed": 1}).encode())
+
+    t = threading.Thread(target=fake_engine)
+    t.start()
+    try:
+        with _post(port, {"tokens": [1, 2, 3], "max_new_tokens": 4}) as r:
+            assert r.status == 200
+            assert r.headers["X-Serve-Request-Id"] == "req.000000"
+            lines = [json.loads(ln) for ln in r.read().splitlines()]
+    finally:
+        t.join()
+    assert [ln.get("tokens") for ln in lines[:2]] == [[10, 11], [12]]
+    assert lines[-1]["done"] is True
+    assert lines[-1]["tokens"] == [10, 11, 12]
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/serve/stats", timeout=5) as r:
+        stats = json.loads(r.read())
+    assert stats["router"]["completed"] == 1
+    assert stats["engine"]["tick"] == 3
+
+
+def test_generate_route_rejects_bad_body_and_backpressures(rendezvous):
+    from horovod_tpu.serve.router import RouterState
+    server, httpd, port = rendezvous
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(port, {"tokens": []})
+    assert exc.value.code == 400
+    assert "tokens" in json.loads(exc.value.read())["error"]
+    # backpressure: a zero-capacity router answers 429 immediately
+    httpd.serve_router = RouterState(max_pending=0)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(port, {"tokens": [1]})
+    assert exc.value.code == 429
+    body = json.loads(exc.value.read())
+    assert body["rejected"] == 1 and "queue full" in body["error"]
+
+
+# ---------------------------------------------------------------- knobs
+def test_serve_config_validation_matrix():
+    with pytest.raises(ValueError, match="HOROVOD_SERVE_PORT"):
+        _cfg(port=70000).validate()
+    with pytest.raises(ValueError, match="HOROVOD_SERVE_MAX_BATCH_TOKENS"):
+        _cfg(max_batch_tokens=0).validate()
+    with pytest.raises(ValueError, match="HOROVOD_SERVE_MAX_SEQ_LEN"):
+        _cfg(max_seq_len=-1).validate()
+    with pytest.raises(ValueError, match="HOROVOD_SERVE_CACHE_BLOCKS"):
+        _cfg(cache_blocks=0).validate()
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        _cfg(prefill_chunk=32, max_batch_tokens=16).validate()
+    with pytest.raises(ValueError, match="max_seq"):
+        _cfg(max_seq_len=64).validate(model_max_seq=32)
+    _cfg().validate(model_max_seq=32)  # valid config passes
+
+
+def test_serve_knobs_validated_at_init():
+    """The init-time contract (runtime.py): a bad HOROVOD_SERVE_* knob
+    fails hvd.init(), not a serving tick hours later."""
+    good = {"HOROVOD_SERVE_PORT": 0,
+            "HOROVOD_SERVE_MAX_BATCH_TOKENS": 2048,
+            "HOROVOD_SERVE_MAX_SEQ_LEN": 2048,
+            "HOROVOD_SERVE_CACHE_BLOCKS": 4096}
+    validate_serve_knobs(good)
+    cfg = from_knobs(dict(good, HOROVOD_SERVE_MAX_SEQ_LEN=128),
+                     max_slots=4)
+    assert cfg.max_seq_len == 128 and cfg.max_slots == 4
+    with pytest.raises(ValueError, match="HOROVOD_SERVE_CACHE_BLOCKS"):
+        validate_serve_knobs(dict(good, HOROVOD_SERVE_CACHE_BLOCKS=-1))
+    with pytest.raises(ValueError, match="HOROVOD_SERVE_PORT"):
+        validate_serve_knobs(dict(good, HOROVOD_SERVE_PORT=-2))
