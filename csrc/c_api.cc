@@ -270,6 +270,43 @@ int hvd_core_metrics(void* h, char* buf, int buflen) {
   return n;
 }
 
+// ----------------------------------------------------------------- op stats
+// Perf-attribution plane (docs/profiling.md): per-op-name enqueue->done
+// aggregates, a versioned text block in the hvd_core_metrics mold —
+//   hvd_op_stats_v1
+//   <name> <count> <bytes> <sum_us> <max_us>   (one line per name)
+// Names are collapsed (CollapseOpName) and whitespace-sanitized so the
+// line stays field-splittable; new fields APPEND and parsers key on
+// position 1-5 ignoring extras — the versioning contract.  Truncation
+// semantics match hvd_core_metrics: returns the full length required,
+// writes at most buflen-1 bytes, always NUL-terminated.
+int hvd_core_op_stats(void* h, char* buf, int buflen) {
+  Core* core = static_cast<ApiHandle*>(h)->core;
+  std::string t = "hvd_op_stats_v1\n";
+  for (const auto& kv : core->op_stats()) {
+    std::string name = kv.first.empty() ? "?" : kv.first;
+    for (auto& c : name)
+      if (c == ' ' || c == '\t' || c == '\n' || c == '\r') c = '_';
+    t += name;
+    t += ' ';
+    t += std::to_string(kv.second.count);
+    t += ' ';
+    t += std::to_string(kv.second.bytes);
+    t += ' ';
+    t += std::to_string(kv.second.sum_us);
+    t += ' ';
+    t += std::to_string(kv.second.max_us);
+    t += '\n';
+  }
+  int n = static_cast<int>(t.size());
+  if (buf && buflen > 0) {
+    int copy = n < buflen - 1 ? n : buflen - 1;
+    memcpy(buf, t.data(), copy);
+    buf[copy] = '\0';
+  }
+  return n;
+}
+
 // ---------------------------------------------------------------- postmortem
 // Liveness snapshot (postmortem plane, docs/postmortem.md): a versioned
 // text block in the hvd_core_metrics mold —

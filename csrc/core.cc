@@ -4,6 +4,22 @@
 
 namespace hvdtpu {
 
+namespace {
+
+// Collapse auto-generated per-call names to their prefix — the same rule
+// as the timeline's collapse_name (utils/timeline.py): unbounded
+// per-call names would otherwise exhaust the op-stats cardinality bound
+// in one epoch.
+std::string CollapseOpName(const std::string& name) {
+  for (const char* marker : {".noname.", ".tfneg."}) {
+    auto pos = name.find(marker);
+    if (pos != std::string::npos) return name.substr(0, pos);
+  }
+  return name;
+}
+
+}  // namespace
+
 Core::Core(std::unique_ptr<Transport> transport, const CoreOptions& opts)
     : transport_(std::move(transport)), opts_(opts) {
   controller_.reset(new Controller(transport_.get(), opts.controller));
@@ -28,10 +44,20 @@ int Core::Submit(const Request& req) {
   if (req.type != RequestType::JOIN && inflight_.count(req.name))
     return -1;  // reference: DUPLICATE_NAME_ERROR (tensor_queue.cc)
   inflight_.insert(req.name);
+  // Perf plane: enqueue stamp for the op-stats enqueue->done latency
+  // (hvd_core_op_stats).  JOIN excluded — it is a barrier, not an op.
+  if (req.type != RequestType::JOIN)
+    submit_us_[req.name] = trace_.NowUs();
   pending_.push_back(req);
   inflight_count_.store(static_cast<int64_t>(inflight_.size()),
                         std::memory_order_relaxed);
   return 0;
+}
+
+std::vector<std::pair<std::string, Core::OpStat>> Core::op_stats() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return std::vector<std::pair<std::string, OpStat>>(op_stats_.begin(),
+                                                     op_stats_.end());
 }
 
 bool Core::Poll(Response* out) {
@@ -128,7 +154,27 @@ void Core::Loop() {
           continue;
         }
         if (r.type == ResponseType::OK) cycle_bytes += r.total_bytes;
-        for (const auto& n : r.names) inflight_.erase(n);
+        // Perf plane: fold each named op's enqueue->done latency and
+        // payload bytes into the per-collapsed-name aggregates
+        // (hvd_core_op_stats) before the response is handed off.
+        uint64_t done_us = trace_.NowUs();
+        for (size_t i = 0; i < r.names.size(); i++) {
+          const std::string& n = r.names[i];
+          inflight_.erase(n);
+          auto it = submit_us_.find(n);
+          if (it == submit_us_.end()) continue;
+          uint64_t age = done_us > it->second ? done_us - it->second : 0;
+          submit_us_.erase(it);
+          std::string key = CollapseOpName(n);
+          if (op_stats_.size() >= kMaxOpStatNames && !op_stats_.count(key))
+            key = "__other__";
+          OpStat& s = op_stats_[key];
+          s.count++;
+          s.sum_us += age;
+          if (age > s.max_us) s.max_us = age;
+          if (i < r.sizes.size() && r.sizes[i] > 0)
+            s.bytes += static_cast<uint64_t>(r.sizes[i]);
+        }
         responses_.push(std::move(r));
       }
       inflight_count_.store(static_cast<int64_t>(inflight_.size()),

@@ -16,7 +16,9 @@
 #include <queue>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <unordered_set>
+#include <utility>
 #include <vector>
 
 #include "common.h"
@@ -71,6 +73,22 @@ class Core {
   };
   HealthSnapshot health_snapshot() const;
 
+  // Perf-attribution plane (docs/profiling.md): per-op-name
+  // enqueue->done aggregates, keyed by the collapsed tensor name so the
+  // controller path's cycle cost attributes to the ops that caused it.
+  // Exported through the versioned hvd_core_op_stats C API.
+  struct OpStat {
+    uint64_t count = 0;
+    uint64_t bytes = 0;
+    uint64_t sum_us = 0;
+    uint64_t max_us = 0;
+  };
+  // Cardinality bound: beyond this many distinct names new ops
+  // aggregate under "__other__" (names are collapsed like the timeline's
+  // collapse_name, so steady-state workloads stay far below it).
+  static constexpr size_t kMaxOpStatNames = 256;
+  std::vector<std::pair<std::string, OpStat>> op_stats() const;
+
   // Tracing plane (trace.h): the ring is always allocated but disabled
   // (one relaxed atomic load per would-be event); EnableTrace flips it
   // on and hvd_core_trace drains it (csrc/c_api.cc).
@@ -100,6 +118,10 @@ class Core {
   std::vector<Request> pending_;
   std::unordered_set<std::string> inflight_;
   std::queue<Response> responses_;
+  // perf plane (guarded by mu_): submit timestamps by raw name, plus
+  // the per-collapsed-name aggregates op_stats() snapshots.
+  std::unordered_map<std::string, uint64_t> submit_us_;
+  std::unordered_map<std::string, OpStat> op_stats_;
 
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> stopped_{false};

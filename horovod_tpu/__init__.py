@@ -117,6 +117,18 @@ def metrics_snapshot() -> dict:
     return _rt.get().metrics_snapshot()
 
 
+def perf_report() -> dict:
+    """This rank's step-time attribution report (``docs/profiling.md``):
+    the measured compute / exposed-comm / host-input / stall
+    decomposition (summing exactly to measured step time), the roofline
+    model's predicted step and its drift, the native per-op-name
+    aggregates, and the local bottleneck verdict — the same payload
+    workers publish for the ``GET /perf`` fleet view.  Record steps with
+    ``hvd.perf.timed_step()`` / ``hvd.perf.record_step``."""
+    from .perf import report as _perf_report
+    return _perf_report()
+
+
 # ----------------------------------------------------------- built/enabled API
 # Build-capability probes (reference: operations.cc:845-915 horovod_mpi_built
 # etc.).  This framework has exactly one data plane: XLA over ICI/DCN.
@@ -204,6 +216,10 @@ from . import postmortem  # noqa: E402
 # multi-host inference over the trained models; engine and router load
 # lazily inside the subpackage
 from . import serve  # noqa: E402
+# perf-attribution plane (docs/profiling.md) — roofline cost model +
+# step-time decomposition ledger; training loops record steps via
+# hvd.perf.timed_step() and read hvd.perf_report()
+from . import perf  # noqa: E402
 
 
 __all__ = [
@@ -229,5 +245,5 @@ __all__ = [
     "CheckpointManager", "save_checkpoint", "restore_checkpoint",
     "flash_attention", "run",
     "__version__", "probe_backend", "metrics_snapshot", "chaos",
-    "postmortem", "serve",
+    "postmortem", "serve", "perf", "perf_report",
 ]

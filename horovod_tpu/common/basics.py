@@ -110,6 +110,8 @@ def load_library() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong)]
         lib.hvd_core_metrics.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                          ctypes.c_int]
+        lib.hvd_core_op_stats.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_char_p, ctypes.c_int]
         lib.hvd_core_trace_enable.argtypes = [ctypes.c_void_p]
         lib.hvd_core_trace.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                        ctypes.c_int]
@@ -540,6 +542,34 @@ class CoordinationCore:
                     "buckets": [int(p) for p in parts[4:]]}
             elif len(parts) == 2:
                 out["counters"][parts[0]] = int(parts[1])
+        return out
+
+    def op_stats(self) -> dict:
+        """Per-op-name enqueue->done aggregates (csrc/c_api.cc
+        ``hvd_core_op_stats``): ``{name: {"count", "bytes", "sum_us",
+        "max_us"}}``, names collapsed like the timeline's collapse_name
+        and bounded in cardinality (overflow under ``__other__``) — the
+        native leg of the perf-attribution plane (docs/profiling.md).
+        Extra line fields from a newer library are ignored, the
+        hvd_core_metrics versioning contract."""
+        n = self._lib.hvd_core_op_stats(self._h, self._buf, len(self._buf))
+        if n >= len(self._buf):
+            self._grow(n)
+            n = self._lib.hvd_core_op_stats(self._h, self._buf,
+                                            len(self._buf))
+        lines = self._buf.value.decode().splitlines()
+        if not lines or not lines[0].startswith("hvd_op_stats_v"):
+            raise RuntimeError(f"unrecognized native op-stats header: "
+                               f"{lines[:1]!r}")
+        out = {}
+        for line in lines[1:]:
+            parts = line.split()
+            if len(parts) < 5:
+                continue
+            out[parts[0]] = {"count": int(parts[1]),
+                             "bytes": int(parts[2]),
+                             "sum_us": int(parts[3]),
+                             "max_us": int(parts[4])}
         return out
 
     def health(self) -> dict:

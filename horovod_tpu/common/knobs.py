@@ -149,6 +149,22 @@ _knob("HOROVOD_METRICS", False, _parse_bool,
       "report.  hvdrun --metrics-port implies this.")
 _knob("HOROVOD_METRICS_INTERVAL", 5.0, float,
       "Seconds between metric-snapshot publishes to the rendezvous KV.")
+# --- perf-attribution plane (TPU-native; docs/profiling.md — the
+#     reference's analog is reading the timeline by hand) ---
+_knob("HOROVOD_PERF", False, _parse_bool,
+      "Enable the performance-attribution plane: the step-time "
+      "decomposition ledger (hvd.perf_report(), hvd_perf_* metric "
+      "families) publishes per-rank perf reports to the rendezvous KV "
+      "scope 'perf', merged at GET /perf and rendered by "
+      "`hvdrun doctor --perf` (horovod_tpu/perf/).")
+_knob("HOROVOD_PERF_INTERVAL", 5.0, float,
+      "Seconds between perf-report publishes to the rendezvous KV.  "
+      "Must be positive; rejected at hvd.init() otherwise.")
+_knob("HOROVOD_PERF_LINK", "auto", str,
+      "Link class the roofline cost model prices gradient sync with: "
+      "'ici', 'dcn', 'loopback', or 'auto' (by mesh topology: a dcn.* "
+      "axis -> dcn, a real TPU mesh -> ici, CPU-virtual -> loopback).  "
+      "Unknown names fail at hvd.init().")
 # --- postmortem plane (TPU-native; docs/postmortem.md — no reference
 #     equivalent: the reference leaves a dead run as a bare exit status) ---
 _knob("HOROVOD_HEARTBEAT", False, _parse_bool,

@@ -157,16 +157,31 @@ def prefetch(iterator, depth: Optional[int] = None,
         transfer = jax.device_put
 
     import collections
+    import time as _time
     queue: "collections.deque" = collections.deque()
     it = iter(iterator)
 
+    # Perf-attribution hook (docs/profiling.md): time spent pulling and
+    # staging the next batch is host-input time on the step's critical
+    # path; the ledger folds it into the decomposition's host_input
+    # component.  Best-effort — input accounting must never break a
+    # loader.
+    def _account(dt: float) -> None:
+        try:
+            from ..perf.ledger import add_input_wait
+            add_input_wait(dt)
+        except Exception:
+            pass
+
     def enqueue(k: int) -> None:
+        t0 = _time.perf_counter()
         for _ in range(k):
             try:
                 batch = next(it)
             except StopIteration:
-                return
+                break
             queue.append(transfer(batch))
+        _account(_time.perf_counter() - t0)
 
     enqueue(depth)
     while queue:

@@ -1,0 +1,82 @@
+"""Performance-attribution plane: why is the step slow, what would make
+it faster (docs/profiling.md).
+
+Four legs over the observability stack the earlier planes built:
+
+  * ``costmodel`` — analytical FLOP/byte accounting and the roofline
+    predicted step time (the single source of bench.py's MFU constants);
+  * ``ledger`` — the measured step-time decomposition
+    (compute / exposed-comm / host-input / stall, summing exactly to the
+    measured step), ``hvd.perf_report()``, the ``hvd_perf_*`` metric
+    families and the KV publisher behind ``GET /perf``;
+  * the native leg — per-op-name enqueue→done aggregates from csrc via
+    ``hvd_core_op_stats`` (``ledger.native_op_stats``);
+  * ``gate`` — the median±MAD bench-artifact regression gate behind
+    ``scripts/perf_gate.py``.
+
+Training loops opt in with two calls:
+
+    hvd.perf.configure(flops_per_step=..., comm_bytes_per_step=...)
+    with hvd.perf.timed_step():
+        params, opt_state, loss = train_step(...)
+    print(hvd.perf_report()["verdict"])
+"""
+
+from __future__ import annotations
+
+from .ledger import (GLOBAL, PerfLedger, PerfPublisher, add_input_wait,
+                     configure, merge_perf_reports, native_op_stats,
+                     record_step, report, reset, timed_step)
+
+# perf_report is the hvd-level spelling (hvd.perf_report()); report the
+# module-level one (hvd.perf.report()).
+perf_report = report
+
+
+def configure_from_overlap_gauges() -> bool:
+    return GLOBAL.configure_from_overlap_gauges()
+
+
+def validate_perf_knobs(knobs) -> None:
+    """Init-time validation of the HOROVOD_PERF_* knob surface (the
+    contract every plane follows: an invalid knob fails at hvd.init(),
+    not as a late runtime surprise).  Consumed by runtime.Runtime."""
+    from .costmodel import LINK_CLASSES
+    link = str(knobs["HOROVOD_PERF_LINK"])
+    if link != "auto" and link not in LINK_CLASSES:
+        raise ValueError(
+            f"HOROVOD_PERF_LINK={link!r} invalid; use 'auto' or one of "
+            f"{', '.join(LINK_CLASSES)} (docs/profiling.md)")
+    if knobs["HOROVOD_PERF_INTERVAL"] <= 0:
+        raise ValueError(
+            f"HOROVOD_PERF_INTERVAL={knobs['HOROVOD_PERF_INTERVAL']} "
+            "invalid; the perf-report publish period must be positive "
+            "seconds (docs/profiling.md)")
+
+
+def resolve_link(knobs, mesh=None) -> str:
+    """The link class the roofline prices comm with: the knob when
+    explicit, else by topology — a dcn.* mesh axis means the slow fabric
+    bounds the sync, a real TPU mesh means ICI, a CPU-virtual mesh means
+    loopback."""
+    link = str(knobs["HOROVOD_PERF_LINK"])
+    if link != "auto":
+        return link
+    if mesh is not None:
+        try:
+            if any(str(a).startswith("dcn.") for a in mesh.axis_names):
+                return "dcn"
+            devs = mesh.devices.flatten()
+            if len(devs) and devs[0].platform != "cpu":
+                return "ici"
+        except Exception:
+            pass
+    return "loopback"
+
+
+__all__ = [
+    "GLOBAL", "PerfLedger", "PerfPublisher", "add_input_wait",
+    "configure", "configure_from_overlap_gauges", "merge_perf_reports",
+    "native_op_stats", "perf_report", "record_step", "report", "reset",
+    "resolve_link", "timed_step", "validate_perf_knobs",
+]

@@ -1,0 +1,236 @@
+"""Analytical cost model: the predicted half of the attribution plane.
+
+The Horovod paper justified tensor fusion by characterizing where step
+time went BY HAND with its timeline (arxiv 1802.05799 §4); arxiv
+1810.11112 argues that characterization must be systematic.  This module
+is the systematic half: trace-time FLOP/byte accounting that yields a
+roofline-style *predicted* step time per link class, which the ledger
+(``perf/ledger.py``) holds against the *measured* decomposition — so the
+model's own drift is observable (``docs/profiling.md``).
+
+One source of truth: ``bench.py``'s MFU math (``PEAK_TFLOPS``, the
+6·N FLOPs/token convention) lives HERE and is imported by the bench, the
+ledger and the tests — the constants can no longer fork.
+
+Deliberately stdlib-only at module level (no jax, no package-relative
+imports), so ``bench.py``'s light supervisor and ``scripts/perf_gate.py``
+can load this file standalone by path, the way ``bench.py`` loads
+``utils/probe.py``.  Functions that consume jax objects (bucket plans,
+compiled programs) import lazily inside their bodies.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+# ---------------------------------------------------------------- hardware
+# bf16 peak TFLOP/s per chip by TPU generation (public spec sheets).
+# 'cpu' is nominal so CPU-virtual smoke runs produce a finite ratio.
+PEAK_TFLOPS: Dict[str, float] = {
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+    "cpu": 0.5,
+}
+
+# Per-chip link bandwidth by fabric class, GB/s (order-of-magnitude public
+# figures: ICI ~ hundreds of GB/s per chip, DCN ~ tens, loopback is a
+# same-host memcpy).  The roofline uses these to turn modeled wire bytes
+# into seconds; absolute accuracy matters less than the ICI/DCN ratio —
+# the quantity that decides comm-bound vs compute-bound.
+LINK_GBPS: Dict[str, float] = {
+    "ici": 100.0,
+    "dcn": 6.25,       # ~50 Gbit/s per host
+    "loopback": 10.0,  # CPU-virtual: one-process memcpy "fabric"
+}
+LINK_CLASSES = tuple(sorted(LINK_GBPS))
+
+
+def peak_flops(chip: str) -> float:
+    """Chip name -> peak FLOP/s (falls back to v5e like bench.py)."""
+    return PEAK_TFLOPS.get(chip, PEAK_TFLOPS["v5e"]) * 1e12
+
+
+def link_bandwidth(link: str) -> float:
+    """Link class name -> bytes/s."""
+    if link not in LINK_GBPS:
+        raise ValueError(
+            f"unknown link class {link!r}; valid: {', '.join(LINK_CLASSES)} "
+            "(HOROVOD_PERF_LINK, docs/profiling.md)")
+    return LINK_GBPS[link] * 1e9
+
+
+# ------------------------------------------------------------------- flops
+def train_flops_per_token(n_params: int,
+                          attention: Optional[Dict[str, Any]] = None
+                          ) -> float:
+    """Training FLOPs per token.
+
+    Baseline convention (what bench.py's MFU always used): ``6·N`` —
+    2·N for the forward matmuls, 4·N for backward, attention score/value
+    matmuls EXCLUDED.  This is the standard, conservative MFU convention.
+
+    ``attention={"n_layers", "dim", "seq", "causal"}`` adds the attention
+    term: per layer and token the score (q·Kᵀ) and value (p·V) matmuls
+    are 2·2·seq·dim MACs = 4·seq·dim forward FLOPs, tripled for the
+    backward pass -> ``12·n_layers·seq·dim`` per token; ``causal=True``
+    (default) halves it, since position t attends to t+1 of seq keys on
+    average.  MFU computed with the attention term included is reported
+    as ``mfu_attn`` beside the conservative ``mfu`` (docs/profiling.md).
+    """
+    flops = 6.0 * float(n_params)
+    if attention:
+        layers = float(attention["n_layers"])
+        dim = float(attention["dim"])
+        seq = float(attention["seq"])
+        attn = 12.0 * layers * seq * dim
+        if attention.get("causal", True):
+            attn *= 0.5
+        flops += attn
+    return flops
+
+
+# ------------------------------------------------------------ param counts
+def llama_param_count(vocab: int, dim: int, n_layers: int, n_heads: int,
+                      n_kv_heads: int, ffn_dim: int) -> int:
+    """Exact parameter count of ``models/llama.py`` init() from config
+    shapes — no device allocation needed, so golden tests and the cost
+    model can price the bench configs analytically."""
+    head_dim = dim // n_heads
+    per_layer = (
+        dim * n_heads * head_dim          # wq
+        + 2 * dim * n_kv_heads * head_dim  # wk, wv
+        + n_heads * head_dim * dim         # wo
+        + 3 * dim * ffn_dim                # w_gate, w_up, w_down
+        + 2 * dim                          # attn_norm, ffn_norm
+    )
+    return (vocab * dim                    # embed
+            + n_layers * per_layer
+            + dim                          # final_norm
+            + dim * vocab)                 # lm_head
+
+
+def moe_llama_param_count(vocab: int, dim: int, n_layers: int,
+                          n_heads: int, n_kv_heads: int, moe_hidden: int,
+                          n_experts: int) -> int:
+    """Exact parameter count of ``models/moe_llama.py`` init(): llama
+    attention blocks with the dense FFN replaced by router + stacked
+    expert FFNs (``parallel/expert.py`` init_moe_params layout)."""
+    head_dim = dim // n_heads
+    per_layer = (
+        dim * n_heads * head_dim
+        + 2 * dim * n_kv_heads * head_dim
+        + n_heads * head_dim * dim
+        + 2 * dim                                  # attn_norm, ffn_norm
+        + dim * n_experts                          # router
+        + 2 * n_experts * dim * moe_hidden         # wi, wo
+    )
+    return vocab * dim + n_layers * per_layer + dim + dim * vocab
+
+
+def moe_llama_active_param_count(vocab: int, dim: int, n_layers: int,
+                                 n_heads: int, n_kv_heads: int,
+                                 moe_hidden: int, n_experts: int,
+                                 experts_per_token: int) -> int:
+    """Parameters a single token's forward pass actually touches (the N
+    that belongs in 6·N for MoE MFU): all non-expert weights plus
+    ``experts_per_token`` expert FFNs per layer."""
+    total = moe_llama_param_count(vocab, dim, n_layers, n_heads,
+                                  n_kv_heads, moe_hidden, n_experts)
+    inactive_experts = n_experts - experts_per_token
+    return total - n_layers * 2 * inactive_experts * dim * moe_hidden
+
+
+# ---------------------------------------------------------------- roofline
+def ring_wire_bytes(nelems: int, itemsize: float, n: int) -> float:
+    """Per-chip wire bytes of one ring allreduce (the same model as
+    ``ops/wire.modeled_wire_bytes``'s flat case, restated stdlib-only:
+    each chip sends 2(n-1) chunks of ceil(nelems/n) elements)."""
+    if n <= 1:
+        return 0.0
+    return 2.0 * (n - 1) * math.ceil(nelems / n) * itemsize
+
+
+def predicted_step_time(flops: float, comm_bytes: float, *,
+                        chip: str = "cpu", link: str = "loopback",
+                        overlap_fraction: float = 0.0,
+                        input_seconds: float = 0.0) -> Dict[str, float]:
+    """Roofline-style predicted step decomposition, in seconds.
+
+    ``compute`` = flops / chip peak; ``exposed_comm`` = the
+    non-overlapped share of comm bytes over the link-class bandwidth
+    (overlapped comm hides behind compute by construction, so only the
+    exposed share lands on the critical path); ``step`` adds the
+    host-input term.  A prediction, not a measurement — the ledger
+    records the deltas against measured time so model drift is itself
+    observable (``hvd_perf_model_drift_ratio``)."""
+    if not 0.0 <= overlap_fraction <= 1.0:
+        raise ValueError(
+            f"overlap_fraction {overlap_fraction} outside [0, 1]")
+    compute = float(flops) / peak_flops(chip)
+    exposed = (float(comm_bytes) * (1.0 - overlap_fraction)
+               / link_bandwidth(link))
+    return {
+        "compute_s": compute,
+        "exposed_comm_s": exposed,
+        "host_input_s": float(input_seconds),
+        "step_s": compute + exposed + float(input_seconds),
+        "chip": chip,
+        "link": link,
+    }
+
+
+# ----------------------------------------------- plan-cache comm accounting
+def plan_comm_bytes(plan, policy: str, axis_sizes: Dict[str, int],
+                    op=None) -> Dict[str, Any]:
+    """Per-fusion-bucket comm bytes of one gradient sync under a wire
+    policy: the plan cache's bucket plan × the wire-policy format of each
+    bucket × the ring model, summed per fabric — the analytical comm leg
+    of the predicted step (uses ``ops/wire.py`` as the byte-model source
+    of truth; imported lazily, this is the one jax-touching entry point).
+    """
+    from ..common.reduce_op import ReduceOp
+    from ..ops import wire
+
+    op = ReduceOp.AVERAGE if op is None else op
+    axis_name = ("dcn.data", "ici.data") if "dcn" in axis_sizes else "data"
+    pol = wire.get_policy(policy)
+    total = 0.0
+    per_fabric: Dict[str, float] = {}
+    per_format: Dict[str, float] = {}
+    for b in plan.buckets:
+        import numpy as np
+        fmt = wire.resolve_format(pol(b.nbytes, b.dtype, axis_name),
+                                  b.dtype, axis_name, op)
+        m = wire.modeled_wire_bytes(sum(b.sizes),
+                                    np.dtype(b.dtype).itemsize, fmt,
+                                    axis_sizes)
+        total += m["bottleneck"]
+        per_format[fmt] = per_format.get(fmt, 0.0) + m["bottleneck"]
+        for fabric, v in m["per_fabric"].items():
+            per_fabric[fabric] = per_fabric.get(fabric, 0.0) + v
+    return {"bottleneck": int(total),
+            "per_fabric": {k: int(v) for k, v in sorted(per_fabric.items())},
+            "per_format": {k: int(v) for k, v in sorted(per_format.items())}}
+
+
+def compiled_flops(fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one call of ``fn(*args)`` from XLA's own
+    ``cost_analysis()`` where the backend provides it (jit lower ->
+    compile -> cost_analysis), None otherwise — callers fall back to the
+    6·N analytical model (``train_flops_per_token``), which stays the
+    single convention the MFU numbers are defined by."""
+    try:
+        import jax
+        compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax: one dict per device
+            ca = ca[0] if ca else None
+        if not ca:
+            return None
+        flops = ca.get("flops")
+        return float(flops) if flops and flops > 0 else None
+    except Exception:
+        return None

@@ -1,0 +1,438 @@
+"""Step-time decomposition ledger: the measured half of the attribution
+plane (docs/profiling.md).
+
+Every recorded step is split into four components that sum EXACTLY to the
+measured wall time —
+
+  * ``host_input_s``  — measured host-side input wait (the loader's
+    ``prefetch`` hook feeds it; ``add_input_wait`` for custom loops);
+  * ``compute_s``     — the cost model's FLOPs / chip peak;
+  * ``exposed_comm_s``— the cost model's non-overlapped comm bytes over
+    the link-class bandwidth (the ``hvd_overlap_*`` gauge model);
+  * ``stall_s``       — the residual: time the model cannot attribute
+    (scheduler gaps, stragglers, host jitter).
+
+When the model predicts MORE than the measured step leaves room for, the
+modeled components are scaled down to fit and the overshoot is recorded
+as ``model_drift_ratio`` (> 1 = the model over-predicts) — predicted vs
+measured deltas are first-class outputs, so cost-model drift is itself
+observable rather than silently corrupting the attribution.
+
+The module-global ledger backs ``hvd.perf_report()`` and the new
+``hvd_perf_*`` metric families; :class:`PerfPublisher` PUTs per-rank
+reports to the rendezvous KV scope ``perf`` (MetricsPublisher's pattern),
+which ``GET /perf`` merges into the fleet view and ``hvdrun doctor
+--perf`` renders (runner/http_server.py, runner/doctor.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+PERF_SCOPE = "perf"
+REPORT_VERSION = 1
+
+# Bottleneck verdicts, in the order doctor renders them (docs/profiling.md).
+VERDICTS = ("compute-bound", "comm-bound", "input-bound", "stall-bound",
+            "straggler-bound")
+
+
+class PerfLedger:
+    """Per-process decomposition ledger.  Thread-safe; cheap enough to
+    record every step."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._steps = 0
+            self._sum = {"step": 0.0, "compute": 0.0, "exposed_comm": 0.0,
+                         "host_input": 0.0, "stall": 0.0}
+            self._last: Optional[Dict[str, float]] = None
+            self._pending_input = 0.0
+            self._drift_sum = 0.0
+            self._drift_n = 0
+            # model inputs (configure()); None = component unmodeled
+            self._flops: Optional[float] = None
+            self._comm_bytes: Optional[float] = None
+            self._overlap_fraction = 0.0
+            self._chip = "cpu"
+            self._link = "loopback"
+
+    # ------------------------------------------------------------ configure
+    def configure(self, *, flops_per_step: Optional[float] = None,
+                  comm_bytes_per_step: Optional[float] = None,
+                  overlap_fraction: Optional[float] = None,
+                  chip: Optional[str] = None,
+                  link: Optional[str] = None) -> None:
+        """Set the cost-model inputs the decomposition prices steps with.
+        Unset components stay as they were; an unconfigured model
+        attributes everything beyond measured input wait to ``stall``."""
+        from .costmodel import LINK_CLASSES
+        with self._lock:
+            if flops_per_step is not None:
+                self._flops = float(flops_per_step)
+            if comm_bytes_per_step is not None:
+                self._comm_bytes = float(comm_bytes_per_step)
+            if overlap_fraction is not None:
+                if not 0.0 <= overlap_fraction <= 1.0:
+                    raise ValueError(f"overlap_fraction {overlap_fraction} "
+                                     "outside [0, 1]")
+                self._overlap_fraction = float(overlap_fraction)
+            if chip is not None:
+                self._chip = str(chip)
+            if link is not None:
+                if link not in LINK_CLASSES:
+                    raise ValueError(
+                        f"unknown link class {link!r}; valid: "
+                        f"{', '.join(LINK_CLASSES)}")
+                self._link = str(link)
+
+    def configure_from_overlap_gauges(self) -> bool:
+        """Adopt the overlap plane's trace-time byte model (the
+        ``hvd_overlap_*`` gauges, ops/overlap.py) as this ledger's comm
+        leg: exposed bytes and overlapped fraction of the microbatch
+        plane when it recorded anything.  True when gauges were live."""
+        from ..utils import metrics as M
+        exposed = M.OVERLAP_EXPOSED_BYTES.value(plane="microbatch")
+        frac = M.OVERLAP_FRACTION.value(plane="microbatch")
+        if exposed <= 0.0 and frac <= 0.0:
+            return False
+        # The gauge already reports EXPOSED bytes: feed them through with
+        # overlap 0 so they are not discounted twice.
+        self.configure(comm_bytes_per_step=exposed, overlap_fraction=0.0)
+        return True
+
+    # --------------------------------------------------------------- record
+    def add_input_wait(self, seconds: float) -> None:
+        """Accumulate host-side input wait since the last recorded step
+        (fed by data/loader.prefetch; call directly from custom loops)."""
+        if seconds > 0:
+            with self._lock:
+                self._pending_input += float(seconds)
+
+    def record_step(self, step_time_s: float) -> Dict[str, float]:
+        """Split one measured step and fold it into the ledger.  Returns
+        the step's decomposition (components sum to ``step_time_s``
+        exactly — the invariant tests/test_perf.py pins)."""
+        from .costmodel import link_bandwidth, peak_flops
+        dt = max(float(step_time_s), 0.0)
+        with self._lock:
+            host_input = min(self._pending_input, dt)
+            self._pending_input = 0.0
+            compute = (self._flops / peak_flops(self._chip)
+                       if self._flops else 0.0)
+            comm = ((self._comm_bytes * (1.0 - self._overlap_fraction)
+                     / link_bandwidth(self._link))
+                    if self._comm_bytes else 0.0)
+            modeled = compute + comm
+            avail = dt - host_input
+            if dt > 0:
+                # drift = what the model (plus measured input) prices the
+                # step at, over what the wall clock measured.
+                self._drift_sum += (modeled + host_input) / dt
+                self._drift_n += 1
+            if modeled > avail and modeled > 0:
+                # Over-prediction: scale the modeled components into the
+                # measured budget (the drift ratio above keeps the
+                # overshoot observable) instead of letting the
+                # components sum past the step.
+                scale = max(avail, 0.0) / modeled
+                compute *= scale
+                comm *= scale
+                stall = 0.0
+            else:
+                stall = avail - modeled
+            row = {"step": dt, "compute": compute, "exposed_comm": comm,
+                   "host_input": host_input, "stall": stall}
+            for k, v in row.items():
+                self._sum[k] += v
+            self._steps += 1
+            self._last = row
+        self._update_metrics(row)
+        return {f"{k}_s" if k != "step" else "step_time_s": v
+                for k, v in row.items()}
+
+    def timed_step(self):
+        """``with ledger.timed_step(): <one train step>`` — measures the
+        block's wall time and records it."""
+        ledger = self
+
+        class _Timer:
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                if exc[0] is None:
+                    ledger.record_step(time.perf_counter() - self._t0)
+                return False
+
+        return _Timer()
+
+    def _update_metrics(self, row: Dict[str, float]) -> None:
+        from ..utils import metrics as M
+        M.PERF_STEPS.inc()
+        M.PERF_STEP_TIME.observe(row["step"])
+        for comp in ("compute", "exposed_comm", "host_input", "stall"):
+            M.PERF_COMPONENT.set(row[comp], component=comp)
+        drift = self.model_drift_ratio()
+        if drift is not None:
+            M.PERF_MODEL_DRIFT.set(drift)
+
+    # --------------------------------------------------------------- report
+    def model_drift_ratio(self) -> Optional[float]:
+        """Mean modeled/measured ratio over recorded steps (1.0 = the
+        model prices exactly what the wall clock measures)."""
+        if not self._drift_n:
+            return None
+        return self._drift_sum / self._drift_n
+
+    def report(self) -> Dict[str, Any]:
+        """The per-rank perf report: measured decomposition means,
+        predicted step from the configured model, deltas, and the local
+        bottleneck verdict.  JSON-able; this exact payload is what the
+        publisher PUTs to KV scope ``perf``."""
+        from .costmodel import predicted_step_time
+        with self._lock:
+            steps = self._steps
+            sums = dict(self._sum)
+            last = dict(self._last) if self._last else None
+            flops, comm_bytes = self._flops, self._comm_bytes
+            overlap, chip, link = (self._overlap_fraction, self._chip,
+                                   self._link)
+            drift = (self._drift_sum / self._drift_n
+                     if self._drift_n else None)
+        mean = {k: (v / steps if steps else 0.0) for k, v in sums.items()}
+        decomposition = {
+            "compute_s": mean["compute"],
+            "exposed_comm_s": mean["exposed_comm"],
+            "host_input_s": mean["host_input"],
+            "stall_s": mean["stall"],
+        }
+        fractions = {k: (v / mean["step"] if mean["step"] else 0.0)
+                     for k, v in decomposition.items()}
+        predicted = predicted_step_time(
+            flops or 0.0, comm_bytes or 0.0, chip=chip, link=link,
+            overlap_fraction=overlap,
+            input_seconds=mean["host_input"]) if steps else None
+        report: Dict[str, Any] = {
+            "version": REPORT_VERSION,
+            "time": time.time(),
+            "steps": steps,
+            "step_time_s": {"mean": mean["step"],
+                            "last": last["step"] if last else None},
+            "decomposition": decomposition,
+            "fractions": fractions,
+            "verdict": local_verdict(fractions) if steps else None,
+            "model": {"flops_per_step": flops,
+                      "comm_bytes_per_step": comm_bytes,
+                      "overlap_fraction": overlap,
+                      "chip": chip, "link": link},
+            "predicted": predicted,
+            "model_drift_ratio": drift,
+        }
+        if predicted and mean["step"] > 0:
+            report["predicted_vs_measured"] = {
+                "step_delta_s": predicted["step_s"] - mean["step"],
+                "step_ratio": predicted["step_s"] / mean["step"],
+            }
+        ops = native_op_stats()
+        if ops:
+            report["native_ops"] = ops
+        return report
+
+
+def local_verdict(fractions: Dict[str, float]) -> str:
+    """One rank's bottleneck classification: the dominant component of
+    the mean decomposition (straggler-bound is a FLEET verdict — one
+    rank cannot see that it is the slow one; merge_perf_reports adds
+    it)."""
+    order = (("exposed_comm_s", "comm-bound"),
+             ("host_input_s", "input-bound"),
+             ("stall_s", "stall-bound"),
+             ("compute_s", "compute-bound"))
+    best = max(order, key=lambda kv: fractions.get(kv[0], 0.0))
+    return best[1]
+
+
+# ------------------------------------------------------------- native leg
+def native_op_stats(core=None, top: int = 10) -> List[Dict[str, Any]]:
+    """Top per-op-name enqueue→done aggregates from the native core
+    (``hvd_core_op_stats``, csrc/c_api.cc), largest total latency first —
+    the controller path's share of the attribution.  Empty when no core
+    is up (pure SPMD runs negotiate nothing)."""
+    if core is None:
+        from .. import runtime as _rt
+        if not _rt.is_initialized():
+            return []
+        core = _rt.get().core
+    if core is None or not getattr(core, "_h", None):
+        return []
+    try:
+        stats = core.op_stats()
+    except Exception:
+        return []  # a closing core must not break the report
+    rows = sorted(stats.items(), key=lambda kv: -kv[1]["sum_us"])[:top]
+    return [{"name": name,
+             "count": s["count"],
+             "bytes": s["bytes"],
+             "mean_us": (s["sum_us"] / s["count"]) if s["count"] else 0.0,
+             "max_us": s["max_us"]}
+            for name, s in rows]
+
+
+def import_op_stats(core) -> None:
+    """Fold the native per-op aggregates into the ``hvd_perf_native_op_*``
+    registry families (called from Runtime.metrics_snapshot, beside
+    import_core_metrics).  Cumulative native values import with
+    set_total, never re-counted."""
+    from ..utils import metrics as M
+    for row in native_op_stats(core, top=32):
+        M.PERF_NATIVE_OP_US.set_total(row["count"] * row["mean_us"],
+                                      name=row["name"])
+        M.PERF_NATIVE_OP_BYTES.set_total(row["bytes"], name=row["name"])
+
+
+# ---------------------------------------------------------- module global
+GLOBAL = PerfLedger()
+
+
+def configure(**kw) -> None:
+    GLOBAL.configure(**kw)
+
+
+def add_input_wait(seconds: float) -> None:
+    GLOBAL.add_input_wait(seconds)
+
+
+def record_step(step_time_s: float) -> Dict[str, float]:
+    return GLOBAL.record_step(step_time_s)
+
+
+def timed_step():
+    return GLOBAL.timed_step()
+
+
+def report() -> Dict[str, Any]:
+    return GLOBAL.report()
+
+
+def reset() -> None:
+    GLOBAL.reset()
+
+
+# -------------------------------------------------------------- publisher
+class PerfPublisher:
+    """Background thread PUT-ing this rank's perf report to the
+    rendezvous KV (scope ``perf``, key ``rank.N``) so ``GET /perf``
+    serves the merged fleet view.  MetricsPublisher's shape: plain
+    urllib, bounded retry, final publish on close()."""
+
+    SCOPE = PERF_SCOPE
+
+    def __init__(self, addr: str, port: int, rank: int,
+                 report_fn: Callable[[], Dict[str, Any]] = report,
+                 interval: float = 5.0):
+        self.addr = addr
+        self.port = int(port)
+        self.rank = int(rank)
+        self.interval = max(0.1, float(interval))
+        self._report_fn = report_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.addr and self.port:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def publish_now(self, retries: int = 3) -> bool:
+        if not (self.addr and self.port):
+            return False
+        try:
+            rep = self._report_fn()
+            rep["rank"] = self.rank
+            body = json.dumps(rep).encode()
+            url = (f"http://{self.addr}:{self.port}/{self.SCOPE}/"
+                   f"rank.{self.rank}")
+            delay = 0.1
+            for attempt in range(retries + 1):
+                try:
+                    req = urllib.request.Request(url, data=body,
+                                                 method="PUT")
+                    with urllib.request.urlopen(req, timeout=5):
+                        pass
+                    return True
+                except Exception:
+                    if attempt >= retries:
+                        raise
+                    time.sleep(delay)
+                    delay = min(delay * 2, 1.0)
+            return True
+        except Exception:
+            return False  # attribution must never take the job down
+
+    def _loop(self) -> None:
+        self.publish_now()
+        while not self._stop.wait(self.interval):
+            self.publish_now()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.publish_now()
+
+
+# ------------------------------------------------------------- fleet merge
+def merge_perf_reports(stored: Dict[str, bytes],
+                       straggler_ratio: float = 1.5) -> Dict[str, Any]:
+    """The ``GET /perf`` payload: every rank's published report plus the
+    FLEET verdict.  Straggler-bound (one rank's mean step time beyond
+    ``straggler_ratio`` × the peer median) outranks the component
+    verdicts — a fleet paced by one slow rank shows comm-bound
+    everywhere else, and naming the rank IS the root cause."""
+    ranks: Dict[str, Any] = {}
+    for key in sorted(stored):
+        try:
+            rep = json.loads(stored[key])
+        except (ValueError, TypeError):
+            continue  # a torn PUT must not 500 the whole view
+        rank = str(rep.get("rank", key.rsplit(".", 1)[-1]))
+        ranks[rank] = rep
+    fleet: Dict[str, Any] = {"verdict": None, "ranks": len(ranks)}
+    rows = [(r, rep["step_time_s"]["mean"]) for r, rep in ranks.items()
+            if rep.get("steps") and rep.get("step_time_s", {}).get("mean")]
+    if rows:
+        fleet["step_time_by_rank"] = {r: t for r, t in rows}
+        slowest_rank, slowest = max(rows, key=lambda rt: rt[1])
+        peers = sorted(t for r, t in rows if r != slowest_rank)
+        if peers:
+            peer_median = peers[len(peers) // 2]
+            if peer_median > 0 and slowest > straggler_ratio * peer_median:
+                fleet["verdict"] = "straggler-bound"
+                fleet["straggler"] = {"rank": slowest_rank,
+                                      "step_time_s": slowest,
+                                      "peer_median_s": peer_median}
+        if fleet["verdict"] is None:
+            # Componentwise fleet mean -> dominant component verdict.
+            agg = {"compute_s": 0.0, "exposed_comm_s": 0.0,
+                   "host_input_s": 0.0, "stall_s": 0.0}
+            n = 0
+            for _, rep in ranks.items():
+                d = rep.get("decomposition")
+                if d:
+                    n += 1
+                    for k in agg:
+                        agg[k] += d.get(k, 0.0)
+            if n:
+                total = sum(agg.values())
+                fleet["verdict"] = local_verdict(
+                    {k: (v / total if total else 0.0)
+                     for k, v in agg.items()})
+                fleet["decomposition"] = {k: v / n for k, v in agg.items()}
+    return {"version": REPORT_VERSION, "time": time.time(),
+            "fleet": fleet, "ranks": ranks}

@@ -13,10 +13,19 @@ needs —
      flight records' native span tails),
   5. per-rank forensics detail (flight-record health, log tail).
 
+``--perf`` switches to the perf-attribution plane (docs/profiling.md):
+the argument is a ``GET /perf`` URL (or ``host:port``), a saved /perf
+JSON, or a directory holding ``perf.json`` — rendered bottleneck-verdict
+first (straggler-bound / comm-bound / compute-bound / input-bound /
+stall-bound) with the per-rank step-time decomposition, model drift and
+top native ops behind it.
+
 Usage:
   hvdrun doctor /path/to/postmortem_dir
   hvdrun doctor /path/to/postmortem.json --events 40
   hvdrun doctor run_dir --json          # raw JSON for tooling
+  hvdrun doctor --perf http://127.0.0.1:8080/perf
+  hvdrun doctor --perf saved_perf.json
 """
 
 from __future__ import annotations
@@ -120,20 +129,141 @@ def render(pm: Dict[str, Any], max_events: int = 25) -> str:
     return "\n".join(lines)
 
 
+# ------------------------------------------------------------ perf plane
+def _fmt_ms(v: Any) -> str:
+    if not isinstance(v, (int, float)):
+        return "?"
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    return f"{v * 1e3:.2f}ms"
+
+
+def load_perf_view(source: str) -> Dict[str, Any]:
+    """Resolve a ``--perf`` argument to the merged /perf payload: an
+    http URL or bare host:port fetches the live route; a directory reads
+    its ``perf.json``; anything else is a saved JSON file.  A saved
+    single-rank report (``hvd.perf_report()`` output) is wrapped into a
+    one-rank fleet view so both forms render."""
+    import json as _json
+    import os
+    import urllib.request
+    if source.startswith(("http://", "https://")) or (
+            ":" in source and not os.path.exists(source)
+            and "/" not in source):
+        url = source if source.startswith("http") else f"http://{source}"
+        if not url.rstrip("/").endswith("/perf"):
+            url = url.rstrip("/") + "/perf"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            view = _json.loads(resp.read())
+    else:
+        path = source
+        if os.path.isdir(path):
+            path = os.path.join(path, "perf.json")
+        with open(path) as f:
+            view = _json.load(f)
+    if "ranks" not in view or "fleet" not in view:
+        # single-rank hvd.perf_report() payload: wrap it
+        rank = str(view.get("rank", 0))
+        from ..perf.ledger import merge_perf_reports
+        view = merge_perf_reports({f"rank.{rank}":
+                                   _json.dumps(view).encode()})
+    return view
+
+
+def render_perf(view: Dict[str, Any]) -> str:
+    """Bottleneck-verdict-first text rendering of one merged /perf view
+    (the same numbers GET /perf serves — docs/profiling.md)."""
+    lines: List[str] = []
+    fleet = view.get("fleet", {})
+    ranks = view.get("ranks", {})
+    lines.append(f"== hvdrun doctor --perf: step-time attribution "
+                 f"({len(ranks)} rank(s)) ==")
+    verdict = fleet.get("verdict")
+    if verdict is None:
+        lines.append("BOTTLENECK: no perf reports recorded — enable "
+                     "HOROVOD_PERF and record steps with "
+                     "hvd.perf.timed_step() (docs/profiling.md)")
+        return "\n".join(lines)
+    if verdict == "straggler-bound":
+        s = fleet.get("straggler", {})
+        lines.append(
+            f"BOTTLENECK: straggler-bound — rank {s.get('rank')} at "
+            f"{_fmt_ms(s.get('step_time_s'))}/step vs peer median "
+            f"{_fmt_ms(s.get('peer_median_s'))}")
+    else:
+        d = fleet.get("decomposition", {})
+        total = sum(v for v in d.values()
+                    if isinstance(v, (int, float))) or 1.0
+        split = " | ".join(
+            f"{k[:-2].replace('_', '-')} {100.0 * v / total:.0f}%"
+            for k, v in d.items())
+        lines.append(f"BOTTLENECK: {verdict} (fleet mean decomposition: "
+                     f"{split})")
+    lines.append("")
+    lines.append("Per-rank decomposition (mean seconds/step; components "
+                 "sum to the measured step):")
+    for r in sorted(ranks, key=lambda x: int(x) if x.isdigit() else 0):
+        rep = ranks[r]
+        d = rep.get("decomposition", {})
+        st = rep.get("step_time_s", {}).get("mean")
+        lines.append(
+            f"  rank {r}: step {_fmt_ms(st)} = "
+            f"compute {_fmt_ms(d.get('compute_s'))} + "
+            f"comm {_fmt_ms(d.get('exposed_comm_s'))} + "
+            f"input {_fmt_ms(d.get('host_input_s'))} + "
+            f"stall {_fmt_ms(d.get('stall_s'))}  "
+            f"[{rep.get('verdict', '?')}, {rep.get('steps', 0)} steps]")
+    drifts = [(r, ranks[r].get("model_drift_ratio")) for r in sorted(ranks)
+              if ranks[r].get("model_drift_ratio") is not None]
+    if drifts:
+        lines.append("")
+        lines.append("Cost-model drift (modeled/measured; 1.0 = exact): "
+                     + ", ".join(f"rank {r} {v:.2f}x" for r, v in drifts))
+    for r in sorted(ranks):
+        ops = ranks[r].get("native_ops")
+        if not ops:
+            continue
+        lines.append("")
+        lines.append(f"-- rank {r} native ops (enqueue->done) --")
+        for op in ops[:5]:
+            lines.append(
+                f"  {op.get('name')}: n={op.get('count')} "
+                f"mean={op.get('mean_us', 0):.0f}us "
+                f"max={op.get('max_us')}us bytes={op.get('bytes')}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="hvdrun doctor",
         description="Render a postmortem.json root-cause-first "
-                    "(docs/postmortem.md)")
+                    "(docs/postmortem.md), or — with --perf — the fleet "
+                    "step-time attribution (docs/profiling.md)")
     ap.add_argument("path",
                     help="postmortem.json or the --postmortem directory "
-                         "holding it")
+                         "holding it; with --perf: a GET /perf URL (or "
+                         "host:port), a saved /perf JSON, or a directory "
+                         "holding perf.json")
     ap.add_argument("--events", type=int, default=25,
                     help="how many fleet-clock-ordered last events to show")
+    ap.add_argument("--perf", action="store_true",
+                    help="render the perf-attribution view instead of a "
+                         "postmortem (docs/profiling.md)")
     ap.add_argument("--json", action="store_true",
-                    help="dump the raw postmortem JSON instead of the "
-                         "rendering")
+                    help="dump the raw JSON instead of the rendering")
     args = ap.parse_args(argv)
+    if args.perf:
+        try:
+            view = load_perf_view(args.path)
+        except Exception as e:
+            print(f"hvdrun doctor: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            json.dump(view, sys.stdout, indent=1)
+            print()
+        else:
+            print(render_perf(view))
+        return 0
     try:
         pm = load_postmortem(args.path)
     except (OSError, ValueError) as e:

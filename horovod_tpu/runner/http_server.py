@@ -30,6 +30,11 @@ Two more special routes serve the distributed tracing plane
 fleet liveness view with per-rank staleness judged from the server's
 own receipt times (``?stale_after=SECS`` tunes the patience).
 
+``GET /perf`` serves the perf-attribution plane (docs/profiling.md):
+workers PUT step-time decomposition reports into the ``perf`` scope
+(``horovod_tpu/perf/ledger.py`` PerfPublisher) and this route renders
+the merged fleet view with the bottleneck verdict root-cause-first.
+
 The serving plane (docs/serving.md) adds the front door:
 
   * ``POST /generate`` enqueues a generation request onto the
@@ -53,6 +58,7 @@ TIMELINE_SCOPE = "timeline"
 CLOCK_SCOPE = "clock"
 HEALTH_SCOPE = "health"
 SERVE_SCOPE = "serve"
+PERF_SCOPE = "perf"
 GENERATE_ROUTE = "generate"
 
 
@@ -110,6 +116,9 @@ class _KVHandler(BaseHTTPRequestHandler):
             return
         if scope == HEALTH_SCOPE and not key:
             self._serve_health()
+            return
+        if scope == PERF_SCOPE and not key:
+            self._serve_perf()
             return
         with self.server.kv_lock:  # type: ignore[attr-defined]
             value = self.server.kv.get(scope, {}).get(key)  # type: ignore
@@ -180,6 +189,18 @@ class _KVHandler(BaseHTTPRequestHandler):
             times = dict(self.server.kv_times.get(  # type: ignore
                 HEALTH_SCOPE, {}))
         view = fleet_health(stored, times, stale_after=stale_after)
+        self._serve_body(json.dumps(view).encode(), "application/json")
+
+    def _serve_perf(self) -> None:
+        """Merged fleet perf-attribution view (docs/profiling.md): the
+        ``perf`` scope's per-rank reports plus the fleet bottleneck
+        verdict (straggler-bound / comm-bound / compute-bound /
+        input-bound / stall-bound), root cause first — the same payload
+        ``hvdrun doctor --perf`` renders."""
+        from ..perf.ledger import merge_perf_reports
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            stored = dict(self.server.kv.get(PERF_SCOPE, {}))  # type: ignore
+        view = merge_perf_reports(stored)
         self._serve_body(json.dumps(view).encode(), "application/json")
 
     def do_DELETE(self) -> None:  # noqa: N802

@@ -160,6 +160,11 @@ class Runtime:
         # positive budgets) — config-only import, no model/jax cost.
         from .serve.config import validate_serve_knobs
         validate_serve_knobs(self.knobs)
+        # Perf-attribution plane (perf/; docs/profiling.md): same
+        # init-validation contract for HOROVOD_PERF_* (link class,
+        # positive publish period).
+        from .perf import validate_perf_knobs
+        validate_perf_knobs(self.knobs)
         if self.knobs["HOROVOD_FUSION_THRESHOLD"] <= 0:
             raise ValueError(
                 f"HOROVOD_FUSION_THRESHOLD="
@@ -220,6 +225,25 @@ class Runtime:
                 rank=self._process_index,
                 snapshot_fn=self.metrics_snapshot,
                 interval=self.knobs["HOROVOD_METRICS_INTERVAL"])
+
+        # Perf-attribution plane (perf/; docs/profiling.md): when
+        # enabled, this worker publishes its step-time decomposition
+        # report to the rendezvous KV scope 'perf' so GET /perf serves
+        # the merged fleet view and doctor --perf can render it.  The
+        # ledger itself is always live (recording costs nothing until a
+        # step is recorded); the knob gates only the publisher thread.
+        self.perf_publisher = None
+        if self.knobs["HOROVOD_PERF"]:
+            from .perf import resolve_link
+            from .perf.ledger import GLOBAL as _perf_ledger
+            from .perf.ledger import PerfPublisher
+            _perf_ledger.configure(link=resolve_link(self.knobs,
+                                                     self.mesh))
+            self.perf_publisher = PerfPublisher(
+                addr=self.knobs["HOROVOD_RENDEZVOUS_ADDR"],
+                port=self.knobs["HOROVOD_RENDEZVOUS_PORT"],
+                rank=self._process_index,
+                interval=self.knobs["HOROVOD_PERF_INTERVAL"])
 
         # Postmortem plane (docs/postmortem.md): per-rank heartbeats to
         # the rendezvous KV scope 'health' — step progress, native cycle
@@ -453,6 +477,13 @@ class Runtime:
                 M.import_core_metrics(self.core.metrics())
             except Exception:
                 pass  # a closing core must not break the snapshot
+            # Perf plane: the native per-op-name aggregates ride the
+            # same snapshot (hvd_perf_native_op_* families).
+            try:
+                from .perf.ledger import import_op_stats
+                import_op_stats(self.core)
+            except Exception:
+                pass
         return M.REGISTRY.snapshot()
 
     def _heartbeat_payload(self) -> Dict[str, Any]:
@@ -483,6 +514,10 @@ class Runtime:
         # the straggler report sees complete histograms.
         if self.metrics_publisher is not None:
             self.metrics_publisher.close()
+        # Final perf-report publish: the fleet /perf view keeps this
+        # rank's last decomposition after it exits.
+        if self.perf_publisher is not None:
+            self.perf_publisher.close()
         # Tracing teardown order: final native drain while the core is
         # alive, final chunk publish while the rendezvous may still be
         # up, then close the local file.

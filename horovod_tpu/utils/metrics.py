@@ -361,6 +361,39 @@ SERVE_TOKENS = REGISTRY.counter(
     "Tokens processed by the serving engine, by phase "
     "(prefill = prompt tokens cached, decode = tokens generated).")
 
+# Perf-attribution plane (horovod_tpu/perf/; docs/profiling.md).  The
+# step-time decomposition ledger records here: measured step times, the
+# per-component split (components sum exactly to the measured step), the
+# roofline model's self-assessed drift, and the native controller's
+# per-op-name aggregates imported from hvd_core_op_stats.
+PERF_STEPS = REGISTRY.counter(
+    "hvd_perf_steps_total",
+    "Train steps recorded by the perf-attribution ledger "
+    "(hvd.perf.record_step / timed_step).")
+PERF_STEP_TIME = REGISTRY.histogram(
+    "hvd_perf_step_time_seconds",
+    "Measured wall time of recorded train steps (the quantity the "
+    "decomposition components sum to).")
+PERF_COMPONENT = REGISTRY.gauge(
+    "hvd_perf_component_seconds",
+    "Last recorded step's decomposition by component "
+    "(compute / exposed_comm / host_input / stall — docs/profiling.md; "
+    "the four sum exactly to the measured step time).")
+PERF_MODEL_DRIFT = REGISTRY.gauge(
+    "hvd_perf_model_drift_ratio",
+    "Mean (modeled + measured-input) / measured step-time ratio over "
+    "recorded steps: 1.0 = the roofline cost model prices exactly what "
+    "the wall clock measures; drift is itself observable.")
+PERF_NATIVE_OP_US = REGISTRY.counter(
+    "hvd_perf_native_op_us_total",
+    "Cumulative enqueue->done latency (µs) of negotiated collectives by "
+    "collapsed op name (csrc hvd_core_op_stats — the native leg of the "
+    "attribution plane).")
+PERF_NATIVE_OP_BYTES = REGISTRY.counter(
+    "hvd_perf_native_op_bytes_total",
+    "Cumulative payload bytes of negotiated collectives by collapsed "
+    "op name (csrc hvd_core_op_stats).")
+
 # Layer 3: runtime (stall inspector + topology).
 STRAGGLER_SUSPECT = REGISTRY.gauge(
     "hvd_straggler_suspect",

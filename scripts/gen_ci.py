@@ -75,6 +75,7 @@ SUITES = {
         "tests/test_platform_utils.py",
     ],
     "serving": ["tests/test_serve.py"],
+    "perf": ["tests/test_perf.py"],
     "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py",
                        "tests/test_profile_analyzer.py"],
 }
@@ -171,6 +172,16 @@ def build_steps():
         f"{full}",
         env={"JAX_PLATFORMS": "cpu"}, timeout=20))
     steps.append(_step(
+        # perf-attribution smoke: a 2-process CPU-virtual fleet records
+        # steps through the decomposition ledger; the components sum to
+        # the measured step time within 10%, the merged GET /perf view
+        # serves the same numbers, and `hvdrun doctor --perf` renders
+        # that exact payload (docs/profiling.md).
+        "perf: 2-process attribution /perf + doctor smoke",
+        f"{py} -m pytest tests/integration/test_perf_integration.py "
+        f"{full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=20))
+    steps.append(_step(
         "dryrun: 8-chip multichip shardings",
         f'{py} -c "import __graft_entry__ as g; g.dryrun_multichip(8)"',
         env={"JAX_PLATFORMS": "cpu",
@@ -201,6 +212,13 @@ def build_steps():
         # CPU-virtual labeled (docs/serving.md) — all CPU-virtual.
         "bench: serve load-gen smoke",
         f"{py} bench.py --serve --cpu", timeout=15))
+    steps.append(_step(
+        # perf regression gate smoke: bench.py --cpu runs three times —
+        # two baseline the host's noise, the unmodified re-run must
+        # PASS the median±MAD gate, and an injected synthetic 2x
+        # slowdown must TRIP it (docs/profiling.md#regression-gate).
+        "perf: regression-gate smoke (re-run passes, 2x trips)",
+        f"{py} scripts/perf_gate.py --smoke", timeout=20))
     steps.append(_step(
         # promtool-check-metrics-style gate, pure Python (no external
         # dep): renders a populated fleet /metrics snapshot through the

@@ -122,6 +122,26 @@ _SLOW_TESTS = {  # file::test (param ids stripped), >= ~8 s measured
         "test_run_elastic_shrinks_to_min_np",
         "test_elastic_fit_survives_worker_kill",
         "test_run_elastic_respects_reset_limit",
+        # ~13 s each (tier-1 headroom, PR 8): full estimator fits; the
+        # cheaper estimator-depth tests keep the fast-tier coverage and
+        # the CI cluster leg (-m "") still runs these
+        "test_sample_weight_col_torch_and_custom_loss_guard",
+        "test_torch_estimator_cross_entropy_and_accuracy",
+    },
+    "test_serve.py": {
+        # ~12 s per model family (tier-1 headroom, PR 8): the exact
+        # engine==reference-greedy equivalence; the CI serving leg
+        # (-m "") runs it, and the cheaper bit-near/eviction/scheduler
+        # serve tests keep fast-tier coverage
+        "test_engine_matches_reference_greedy_decode",
+    },
+    "test_serve_integration.py": {
+        # 55 s — the single most expensive tier-1 test (tier-1 headroom,
+        # PR 8): the full hvdrun --serve E2E (orbax restore + 3 streamed
+        # /generate).  The 2-proc fleet-lockstep serve test stays fast-
+        # tier, and the CI serve smoke leg (-m "") runs this one on
+        # every pipeline.
+        "test_hvdrun_serve_end_to_end",
     },
     "test_tune.py": {
         "test_distributed_trainable_forwards_worker_reports",

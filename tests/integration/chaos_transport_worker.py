@@ -31,7 +31,20 @@ def main() -> int:
     core = CoordinationCore.tcp(rank, size, addr, port, cycle_ms=0.5)
     failed = False
     for i in range(12):
-        core.submit(f"t{i}", "f32:8:sum", OP_ALLREDUCE, 32)
+        try:
+            core.submit(f"t{i}", "f32:8:sum", OP_ALLREDUCE, 32)
+        except RuntimeError:
+            # Submit after the core already stopped (rc=-2): the injected
+            # disconnect exhausted the retry budget BEFORE this
+            # submission.  Idle cycles exchange frames too, so under CPU
+            # load the Nth frame op can land arbitrarily early relative
+            # to the submissions — this is the same loud transport
+            # failure, observed one call later.  Without this the worker
+            # died on the uncaught exception and never printed its
+            # marker (the occasional full-tier-1 red; passes in
+            # isolation where the close always lands mid-run).
+            failed = True
+            break
         r = core.wait(30.0)
         if r is None or r.type == "ERROR":
             failed = True

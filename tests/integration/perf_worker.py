@@ -1,0 +1,106 @@
+"""Per-rank worker for the perf-attribution integration test.
+
+A small training-shaped loop wearing the attribution plane end to end
+(docs/profiling.md): the ledger is configured with the analytical cost
+model (flops + the ring-model bytes of the step's ACTUAL allreduce),
+every step is timed with ``hvd.perf.timed_step()`` around a real
+cross-process negotiated collective (so the native ``hvd_core_op_stats``
+leg aggregates real enqueue→done latencies), and the resulting
+``hvd.perf_report()`` must satisfy the acceptance criterion — the
+decomposition components sum to the measured step time within 10% —
+BEFORE the same payload is published to KV scope ``perf`` and
+cross-checked against the launcher's merged ``GET /perf`` view.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+STEPS = 8
+NELEMS = 1024
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.process_size() == 2, hvd.process_size()
+    rt = hvd.runtime.get()
+    assert rt.perf_publisher is not None, \
+        "HOROVOD_PERF=1 did not wire the perf publisher"
+    # Native controller up so the op-stats leg has real negotiated
+    # collectives to attribute (csrc hvd_core_op_stats).
+    core = rt.ensure_core()
+    assert core is not None
+
+    from horovod_tpu.perf import costmodel as cm
+    hvd.perf.reset()
+    hvd.perf.configure(
+        flops_per_step=2.0e6,
+        comm_bytes_per_step=cm.ring_wire_bytes(NELEMS, 4, hvd.size()),
+        chip="cpu", link="loopback")
+
+    from horovod_tpu.common.basics import OP_ALLREDUCE
+
+    x = np.ones((NELEMS,), np.float32)
+    for step in range(STEPS):
+        with hvd.perf.timed_step():
+            # The SPMD data plane carries the payload...
+            out = np.asarray(hvd.allreduce(
+                x, name=f"sync.{step}", op=hvd.Sum))
+            # ...and a negotiated round trips the cross-process
+            # controller so the native op-stats leg attributes real
+            # enqueue->done latency (per-call .noname. suffixes must
+            # collapse to ONE key).
+            core.submit(f"grad.noname.{step}", f"f32:{NELEMS}:sum",
+                        OP_ALLREDUCE, 4 * NELEMS)
+            resp = core.wait(30.0)
+            assert resp is not None and resp.type == "OK", resp
+        assert np.allclose(out, float(hvd.size())), (step, out[:4])
+
+    rep = hvd.perf_report()
+    assert rep["steps"] == STEPS, rep["steps"]
+    mean = rep["step_time_s"]["mean"]
+    total = sum(rep["decomposition"].values())
+    # The acceptance criterion: components sum to measured step time
+    # within 10% (the ledger holds it exactly by construction).
+    assert abs(total - mean) <= 0.10 * mean, (total, mean)
+    ops = rep.get("native_ops")
+    assert ops and ops[0]["name"] == "grad", ops
+    assert ops[0]["count"] == STEPS, ops
+
+    # Publish the final report, then fence so BOTH ranks' PUTs precede
+    # rank 0's fleet read.
+    assert rt.perf_publisher.publish_now()
+    hvd.allreduce(np.ones(1, np.float32), name="pub.barrier", op=hvd.Sum)
+
+    if hvd.process_rank() == 0:
+        addr = rt.knobs["HOROVOD_RENDEZVOUS_ADDR"]
+        port = rt.knobs["HOROVOD_RENDEZVOUS_PORT"]
+        with urllib.request.urlopen(f"http://{addr}:{port}/perf",
+                                    timeout=10) as resp:
+            view = json.loads(resp.read())
+        assert set(view["ranks"]) == {"0", "1"}, sorted(view["ranks"])
+        mine = view["ranks"]["0"]
+        # The fleet view serves the SAME numbers this rank measured.
+        assert mine["steps"] == STEPS, mine["steps"]
+        assert abs(mine["step_time_s"]["mean"] - mean) < 1e-12
+        for k, v in rep["decomposition"].items():
+            assert abs(mine["decomposition"][k] - v) < 1e-12, k
+        assert view["fleet"]["verdict"], view["fleet"]
+        out_path = os.environ.get("PERF_IT_OUT")
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(view, f)
+
+    print(f"PERF-OK {hvd.process_rank()} mean={mean:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""Perf-attribution integration tier: the acceptance experiment of
+docs/profiling.md on a CPU-virtual 2-process fleet under the real
+launcher — ``hvd.perf_report()``'s decomposition sums to the measured
+step time within 10%, the SAME numbers appear in the merged ``GET
+/perf`` view (the worker cross-checks its local report against the
+launcher's route), and ``hvdrun doctor --perf`` renders that exact
+payload."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from test_multiprocess import REPO, run_hvdrun
+
+
+@pytest.mark.integration
+def test_perf_attribution_two_processes(tmp_path):
+    out = tmp_path / "perf.json"
+    proc = run_hvdrun("perf_worker.py", extra_env={
+        "HVD_CPU_CHIPS": "1",
+        "HOROVOD_PERF": "1",
+        "HOROVOD_PERF_INTERVAL": "0.5",
+        "PERF_IT_OUT": str(out)})
+    assert proc.stdout.count("PERF-OK") >= 2, proc.stdout
+
+    # The fleet view rank 0 fetched from GET /perf: both ranks present,
+    # each decomposition summing to its measured mean step within 10%,
+    # with the native op-stats leg populated from real negotiated
+    # collectives.
+    view = json.loads(out.read_text())
+    assert set(view["ranks"]) == {"0", "1"}
+    for r in ("0", "1"):
+        rep = view["ranks"][r]
+        assert rep["steps"] == 8, rep["steps"]
+        mean = rep["step_time_s"]["mean"]
+        assert mean > 0
+        assert abs(sum(rep["decomposition"].values()) - mean) \
+            <= 0.10 * mean
+        ops = {o["name"]: o for o in rep["native_ops"]}
+        assert ops["grad"]["count"] == 8, ops
+    assert view["fleet"]["verdict"] in (
+        "compute-bound", "comm-bound", "input-bound", "stall-bound",
+        "straggler-bound")
+
+    # `hvdrun doctor --perf` renders the SAME payload: its stdout is
+    # byte-for-byte the library rendering of the fetched view.
+    from horovod_tpu.runner.doctor import render_perf
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    doc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "doctor",
+         "--perf", str(out)],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert doc.returncode == 0, doc.stderr
+    assert doc.stdout.strip() == render_perf(view).strip()
+    assert "BOTTLENECK:" in doc.stdout
