@@ -238,6 +238,11 @@ int hvd_core_metrics(void* h, char* buf, int buflen) {
   kv("fused_batch_bytes", s.fused_batch_bytes);
   kv("fusion_threshold_bytes",
      static_cast<uint64_t>(core->fusion_threshold()));
+  // plan-epoch fast path (docs/tensor-fusion.md#steady-state): appended
+  // per the name-keyed versioning contract above.
+  kv("bypass_cycles", s.bypass_cycles);
+  kv("epoch_locks", s.epoch_locks);
+  kv("epoch_invalidations", s.epoch_invalidations);
   // transport resilience / chaos-plane counters (docs/chaos.md): appended
   // per the name-keyed versioning contract above.
   TransportStats ts = core->transport_stats();
@@ -245,6 +250,8 @@ int hvd_core_metrics(void* h, char* buf, int buflen) {
   kv("transport_reconnect_failures", ts.reconnect_failures);
   kv("transport_frames_resent", ts.frames_resent);
   kv("transport_frames_dropped", ts.frames_dropped);
+  kv("transport_frames_coalesced", ts.frames_coalesced);
+  kv("transport_coalesced_bytes", ts.coalesced_bytes);
   kv("chaos_faults_injected", ts.chaos_faults);
   auto hist = [&t](const char* name, const LatencyHistogram& hg) {
     t += "hist ";

@@ -2,10 +2,27 @@
 
 #include <algorithm>
 #include <cstdio>
+#include <cctype>
+#include <cstdlib>
 
 namespace hvdtpu {
 
 namespace {
+
+long CtlEnvLong(const char* name, long def) {
+  const char* v = getenv(name);
+  if (!v || !*v) return def;
+  return strtol(v, nullptr, 10);
+}
+
+bool CtlEnvBool(const char* name, bool def) {
+  const char* v = getenv(name);
+  if (!v || !*v) return def;
+  std::string s(v);
+  for (auto& c : s) c = static_cast<char>(tolower(c));
+  // Same truthy set as the Python knob parser (common/knobs.py).
+  return s == "1" || s == "true" || s == "yes" || s == "on";
+}
 
 // Leading token of the signature is the dtype (frontend contract:
 // "dtype:shape:op:..."), used for same-dtype fusion grouping like the
@@ -65,6 +82,127 @@ std::vector<char> UnpackBits(const std::string& s, size_t n) {
 }
 
 }  // namespace
+
+Controller::Controller(Transport* transport, const ControllerOptions& opts)
+    : transport_(transport), opts_(opts) {
+  // The bypass knobs reach the native layer through env (the chaos/retry
+  // precedent, transport.cc): the C-API create signatures stay stable.
+  opts_.bypass_enabled =
+      CtlEnvBool("HOROVOD_BYPASS", opts_.bypass_enabled);
+  long k = CtlEnvLong("HOROVOD_BYPASS_STABLE_CYCLES",
+                      opts_.bypass_stable_cycles);
+  if (k >= 1) opts_.bypass_stable_cycles = static_cast<int>(k);
+}
+
+// ---------------------------------------------------------- plan-epoch bypass
+void Controller::BreakEpochLocked(const char* reason) {
+  if (!epoch_locked_.load(std::memory_order_acquire)) return;
+  epoch_locked_.store(false, std::memory_order_release);
+  stats_.epoch_invalidations++;
+  // Partial-round submissions re-materialize through carry_ — the full
+  // path renegotiates them (the submitter cannot resubmit past the
+  // DUPLICATE_NAME guard, the ReplicaErase precedent).
+  for (auto& r : round_received_) carry_.push_back(std::move(r));
+  round_received_.clear();
+  round_names_.clear();
+  round_missing_.clear();
+  round_emitted_ = 0;
+  // Relocking needs K fresh stable bursts observed on the wire.
+  r0_burst_sig_.clear();
+  r0_last_sig_.clear();
+  r0_burst_valid_ = false;
+  r0_stable_ = 0;
+  burst_plan_.clear();
+  burst_valid_ = false;
+  // A locked worker only rejoins the lock-step wire when it deviates
+  // itself or sees a kick; rank 0 therefore announces every local break
+  // before its next gather so the fleet converges promptly.
+  if (rank() == 0) kick_pending_ = true;
+  if (trace_ != nullptr && trace_->enabled())
+    trace_->Record('i', 'c', "epoch.invalidate",
+                   static_cast<int64_t>(epoch_));
+  (void)reason;
+}
+
+void Controller::BreakEpoch(const char* reason) {
+  std::lock_guard<std::mutex> lk(bypass_mu_);
+  BreakEpochLocked(reason);
+}
+
+Controller::BypassResult Controller::TryBypassSubmit(
+    const Request& req, std::vector<Response>* out) {
+  std::lock_guard<std::mutex> lk(bypass_mu_);
+  if (!epoch_locked_.load(std::memory_order_acquire))
+    return BypassResult::kNotLocked;
+  if (req.type == RequestType::JOIN) {
+    BreakEpochLocked("join");
+    return BypassResult::kBreak;
+  }
+  auto it = locked_set_.find(req.name);
+  if (it == locked_set_.end() || it->second.first != req.signature ||
+      it->second.second != req.type || round_names_.count(req.name)) {
+    // New tensor, changed signature, or a resubmission before the round
+    // completed (a tensor of the locked set went missing): the steady
+    // state is over — renegotiate.
+    BreakEpochLocked("deviation");
+    return BypassResult::kBreak;
+  }
+  if (round_received_.empty()) {
+    round_started_ = std::chrono::steady_clock::now();
+    if (trace_ != nullptr && trace_->enabled())
+      trace_->Record('B', 'c', "cycle.bypass",
+                     static_cast<int64_t>(epoch_));
+  }
+  round_names_.insert(req.name);
+  round_received_.push_back(req);
+  stats_.cache_hits++;
+  round_missing_[plan_batch_of_[req.name]]--;
+  // Emit completed batches strictly in plan order — the identical global
+  // order every rank's negotiated steady step produced.
+  while (round_emitted_ < locked_plan_.size() &&
+         round_missing_[round_emitted_] == 0) {
+    const Response& r = locked_plan_[round_emitted_];
+    out->push_back(r);
+    stats_.cached_responses += r.names.size();
+    stats_.fused_batches++;
+    stats_.fused_batch_bytes += static_cast<uint64_t>(r.total_bytes);
+    stats_.tensors_negotiated += r.names.size();
+    if (r.op == RequestType::ALLREDUCE ||
+        r.op == RequestType::REDUCESCATTER)
+      stats_.bytes_reduced += static_cast<uint64_t>(r.total_bytes);
+    round_emitted_++;
+  }
+  if (round_emitted_ == locked_plan_.size()) {
+    // One steady step served with zero transport round trips.
+    stats_.bypass_cycles++;
+    if (trace_ != nullptr && trace_->enabled())
+      trace_->Record('E', 'c', "cycle.bypass",
+                     static_cast<int64_t>(round_received_.size()));
+    round_received_.clear();
+    round_names_.clear();
+    round_emitted_ = 0;
+    for (size_t i = 0; i < locked_plan_.size(); i++)
+      round_missing_[i] = static_cast<int>(locked_plan_[i].names.size());
+  }
+  return BypassResult::kServed;
+}
+
+bool Controller::BypassRoundTimedOut() {
+  std::lock_guard<std::mutex> lk(bypass_mu_);
+  if (!epoch_locked_.load(std::memory_order_acquire) ||
+      round_received_.empty())
+    return false;
+  double age = std::chrono::duration<double>(
+      std::chrono::steady_clock::now() - round_started_).count();
+  if (age <= opts_.bypass_partial_round_break_seconds) return false;
+  fprintf(stderr,
+          "[hvd_tpu_core] WARNING: locked-epoch replay round partial for "
+          "%.1fs (%zu/%zu batches) — tensor missing from the steady set; "
+          "falling back to full negotiation\n",
+          age, round_emitted_, locked_plan_.size());
+  BreakEpochLocked("partial-round-timeout");
+  return true;
+}
 
 // ------------------------------------------------------------- cache replica
 void Controller::ReplicaInsert(const std::string& name, const std::string& sig,
@@ -240,6 +378,17 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
                           std::vector<Response>* out) {
   auto cycle_start = std::chrono::steady_clock::now();
   stats_.cycles++;
+  // A rank-0 epoch break is announced to still-locked workers before the
+  // gather (they only watch the wire through Peek while locked).
+  {
+    bool kick = false;
+    {
+      std::lock_guard<std::mutex> lk(bypass_mu_);
+      kick = kick_pending_;
+      kick_pending_ = false;
+    }
+    if (kick) transport_->Kick();
+  }
   // Tracing: stamp the phase boundaries as the cycle runs, commit the
   // spans at the end only for non-idle cycles (trace.h RecordAt) — an
   // idle 1 ms loop must not flood the ring.
@@ -381,11 +530,68 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
       s.type = ResponseType::SHUTDOWN;
       resp.push_back(s);
     }
-    // 4. Broadcast: [nslots][agreed bits][inv bits][negotiated responses]
+    // Plan-epoch stability: fingerprint each burst of agreed-hit cycles.
+    // A burst closes only on a genuinely IDLE cycle (nothing agreed,
+    // nothing pending — the `busy` bit makes mid-step skew cycles
+    // neutral rather than false boundaries); K identical consecutive
+    // bursts arm the epoch-lock flag on the boundary broadcast.  The
+    // counting uses EXACTLY the values serialized below, so the flag is
+    // consistent with what every rank applies.
+    uint8_t epoch_flags = 0;  // bit 0: lock, bit 1: busy (not a boundary)
+    if (opts_.bypass_enabled && opts_.cache_capacity > 0) {
+      bool any_agreed = std::any_of(agreed.begin(), agreed.end(),
+                                    [](char c) { return c != 0; });
+      bool any_inv = std::any_of(inv.begin(), inv.end(),
+                                 [](char c) { return c != 0; });
+      bool busy = !table_.empty() ||
+                  std::any_of(any_hit.begin(), any_hit.end(),
+                              [](char c) { return c != 0; });
+      if (busy) epoch_flags |= 2;
+      std::lock_guard<std::mutex> lk(bypass_mu_);
+      if (any_inv || !resp.empty()) {
+        // Negotiation completed (or membership/shutdown traffic): the
+        // tensor set is not steady — restart the stability count.
+        r0_burst_sig_.clear();
+        r0_last_sig_.clear();
+        r0_burst_valid_ = false;
+        r0_stable_ = 0;
+      } else if (any_agreed) {  // contributing cycle: extend the burst
+        if (r0_burst_valid_) {
+          // Union of agreed slots, NOT per-cycle concat: how a step's
+          // agreements chunk across cycles is timing-dependent, but the
+          // tensor SET is the steady-state invariant being fingerprinted.
+          std::string bits = PackBits(agreed);
+          if (r0_burst_sig_.size() < bits.size())
+            r0_burst_sig_.resize(bits.size(), '\0');
+          for (size_t i = 0; i < bits.size(); i++)
+            r0_burst_sig_[i] |= bits[i];
+        }
+      } else if (!busy) {  // idle cycle = burst boundary
+        if (r0_burst_valid_ && !r0_burst_sig_.empty()) {
+          if (r0_burst_sig_ == r0_last_sig_) {
+            r0_stable_++;
+          } else {
+            r0_stable_ = 1;
+            r0_last_sig_ = r0_burst_sig_;
+          }
+          if (r0_stable_ >= opts_.bypass_stable_cycles &&
+              !epoch_locked_.load(std::memory_order_acquire)) {
+            epoch_flags |= 1;
+            r0_stable_ = 0;
+          }
+        }
+        r0_burst_sig_.clear();
+        r0_burst_valid_ = true;
+      }
+      // busy && !any_agreed: mid-step skew — neutral, burst stays open.
+    }
+    // 4. Broadcast: [nslots][agreed bits][inv bits][epoch flags]
+    //    [negotiated responses]
     Writer rw;
     rw.u32(static_cast<uint32_t>(nslots));
     rw.str(PackBits(agreed));
     rw.str(PackBits(inv));
+    rw.u8(epoch_flags);
     rw.u32(static_cast<uint32_t>(resp.size()));
     for (const auto& r : resp) SerializeResponse(r, &rw);
     frame = rw.data();
@@ -404,6 +610,7 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
   uint32_t bc_slots = rd.u32();
   std::vector<char> agreed = UnpackBits(rd.str(), bc_slots);
   std::vector<char> inv = UnpackBits(rd.str(), bc_slots);
+  uint8_t epoch_flags = rd.u8();
 
   for (uint32_t i = 0; i < bc_slots && i < replica_.size(); i++) {
     if (!inv[i] || !replica_[i].valid) continue;
@@ -431,6 +638,56 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
   *out = std::move(cached.out());
 
   uint32_t cnt = rd.u32();
+  // Plan-epoch accumulation + lock application: driven purely by the
+  // broadcast content just parsed (agreed/inv bits, negotiated count,
+  // lock flag) and the cached responses reconstructed above — identical
+  // inputs on every rank, so every rank freezes the identical plan.
+  if (opts_.bypass_enabled && opts_.cache_capacity > 0) {
+    bool any_agreed = std::any_of(agreed.begin(), agreed.end(),
+                                  [](char c) { return c != 0; });
+    bool any_inv = std::any_of(inv.begin(), inv.end(),
+                               [](char c) { return c != 0; });
+    bool busy = (epoch_flags & 2) != 0;
+    std::lock_guard<std::mutex> lk(bypass_mu_);
+    if (any_inv || cnt > 0) {
+      burst_plan_.clear();
+      burst_valid_ = false;
+    } else if (any_agreed) {
+      // Contributing cycle: its cached responses extend the burst (out
+      // currently holds exactly the cached portion).
+      if (burst_valid_)
+        burst_plan_.insert(burst_plan_.end(), out->begin(), out->end());
+    } else if (!busy) {  // idle cycle = burst boundary
+      if ((epoch_flags & 1) && burst_valid_ && !burst_plan_.empty() &&
+          !epoch_locked_.load(std::memory_order_acquire)) {
+        locked_plan_ = burst_plan_;
+        locked_set_.clear();
+        plan_batch_of_.clear();
+        round_missing_.assign(locked_plan_.size(), 0);
+        for (size_t b = 0; b < locked_plan_.size(); b++) {
+          const Response& r = locked_plan_[b];
+          round_missing_[b] = static_cast<int>(r.names.size());
+          for (size_t t = 0; t < r.names.size(); t++) {
+            plan_batch_of_[r.names[t]] = static_cast<int>(b);
+            locked_set_[r.names[t]] = {
+                t < r.sigs.size() ? r.sigs[t] : "", r.op};
+          }
+        }
+        round_received_.clear();
+        round_names_.clear();
+        round_emitted_ = 0;
+        epoch_++;
+        stats_.epoch_locks++;
+        epoch_locked_.store(true, std::memory_order_release);
+        if (trace_ != nullptr && trace_->enabled())
+          trace_->Record('i', 'c', "epoch.lock",
+                         static_cast<int64_t>(epoch_));
+      }
+      burst_plan_.clear();
+      burst_valid_ = true;
+    }
+    // busy && !any_agreed: mid-step skew — neutral, burst stays open.
+  }
   out->reserve(out->size() + cnt);
   for (uint32_t i = 0; i < cnt; i++) {
     Response r = DeserializeResponse(&rd);

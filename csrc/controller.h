@@ -23,14 +23,34 @@
 // bit-vectors instead of full request lists; agreed hits are reconstructed
 // locally from the replica and fused, collapsing per-cycle coordination
 // bytes to ~2*ceil(slots/8) once a workload repeats.
+//
+// Plan-epoch fast path (the layer ABOVE the bit-vector cache): rank 0
+// fingerprints each *burst* of agreed-hit cycles (bursts are delimited by
+// idle cycles, so a burst is one steady step's worth of cached responses).
+// When the fingerprint repeats for HOROVOD_BYPASS_STABLE_CYCLES consecutive
+// bursts, rank 0 rides an epoch-lock flag on the boundary broadcast; every
+// rank (applying identical broadcast data) then freezes the burst's fused
+// response sequence as the *locked plan* and serves subsequent steps by
+// replaying it locally — ZERO transport round trips per step.  The lock
+// breaks symmetrically on any deviation: a new/changed tensor, a JOIN, a
+// shutdown request, a partial replay round outliving its timeout (the
+// missing-tensor case), or a remote break observed through Transport::Peek
+// (a peer resumed the lock-step wire).  Breaking falls back to full
+// negotiation (partial-round submissions re-materialize through carry_),
+// and the replica cache underneath is untouched — relocking needs only K
+// fresh stable bursts.  An elastic reset destroys the core, and the epoch
+// with it.
 
 #pragma once
 
+#include <atomic>
 #include <chrono>
 #include <cstdint>
 #include <list>
+#include <mutex>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "common.h"
@@ -43,6 +63,15 @@ struct ControllerOptions {
   int64_t fusion_threshold_bytes = 128LL * 1024 * 1024;
   int cache_capacity = 1024;
   double stall_warn_seconds = 60.0;
+  // Plan-epoch negotiation bypass (env HOROVOD_BYPASS /
+  // HOROVOD_BYPASS_STABLE_CYCLES override these at construction; the
+  // knobs are validated Python-side at hvd.init, common/knobs.py).
+  bool bypass_enabled = true;
+  int bypass_stable_cycles = 5;
+  // A locked-epoch replay round left partial for this long means a
+  // tensor of the locked set went missing (or a rank wedged): break the
+  // epoch so the full path's cross-rank stall machinery takes over.
+  double bypass_partial_round_break_seconds = 1.0;
 };
 
 // Fixed-bucket latency histogram: bucket b counts observations with
@@ -79,14 +108,17 @@ struct ControllerStats {
   uint64_t tensors_negotiated = 0;  // tensors across OK responses
   uint64_t fused_batches = 0;       // OK response batches executed
   uint64_t fused_batch_bytes = 0;   // payload bytes across those batches
+  // --- plan-epoch fast path (docs/tensor-fusion.md#steady-state) ---
+  uint64_t bypass_cycles = 0;       // replay rounds served w/o transport
+  uint64_t epoch_locks = 0;         // epoch-lock broadcasts applied
+  uint64_t epoch_invalidations = 0; // epoch breaks (any cause)
   LatencyHistogram cycle_time_us;       // RunCycle wall time, every rank
   LatencyHistogram negotiation_age_us;  // first-seen -> ready, rank 0 only
 };
 
 class Controller {
  public:
-  Controller(Transport* transport, const ControllerOptions& opts)
-      : transport_(transport), opts_(opts) {}
+  Controller(Transport* transport, const ControllerOptions& opts);
 
   // One lock-step cycle: contribute `pending` local requests, receive the
   // globally agreed response list (identical on every rank).
@@ -94,6 +126,27 @@ class Controller {
   // response is emitted.  Returns false on transport failure.
   bool RunCycle(const std::vector<Request>& pending, bool shutdown_requested,
                 std::vector<Response>* out);
+
+  // --- plan-epoch fast path -------------------------------------------
+  // Locked-epoch verdict for one submission (thread-safe: callable from
+  // the submitter's thread, which is how responses are built inline at
+  // submit time).  kServed consumed the request into the current replay
+  // round and appended any plan batches it completed to `out`; kBreak
+  // broke the epoch (partial-round requests re-materialized via carry_)
+  // and the caller must route the request through the full path.
+  enum class BypassResult { kNotLocked, kServed, kBreak };
+  BypassResult TryBypassSubmit(const Request& req,
+                               std::vector<Response>* out);
+  // True (and the epoch broken) when the current replay round has been
+  // partial longer than bypass_partial_round_break_seconds — the
+  // missing-tensor / wedged-peer escape hatch.
+  bool BypassRoundTimedOut();
+  // Unconditional epoch break (shutdown, remote Peek, JOIN).  No-op when
+  // not locked.
+  void BreakEpoch(const char* reason);
+  bool epoch_locked() const {
+    return epoch_locked_.load(std::memory_order_acquire);
+  }
 
   const ControllerStats& stats() const { return stats_; }
   int rank() const { return transport_->rank(); }
@@ -153,6 +206,35 @@ class Controller {
   // rank-0: per-slot first-partial-hit time for stall detection (0 = none)
   std::vector<std::chrono::steady_clock::time_point> partial_since_;
   std::vector<char> partial_warned_;
+
+  // --- plan-epoch state (guarded by bypass_mu_; epoch_locked_ is also
+  // an atomic so hot paths can check it without the lock).  The
+  // replicated accumulation (burst_plan_) is driven purely by broadcast
+  // content, so every rank freezes an identical locked plan; the rank-0
+  // stability counter (r0_*) is driven by the same pre-broadcast values
+  // that get serialized, so its lock flag is consistent by construction.
+  void BreakEpochLocked(const char* reason);  // bypass_mu_ held
+  mutable std::mutex bypass_mu_;
+  std::atomic<bool> epoch_locked_{false};
+  uint64_t epoch_ = 0;
+  std::vector<Response> locked_plan_;           // one round, emission order
+  std::unordered_map<std::string, int> plan_batch_of_;   // name -> batch
+  std::unordered_map<std::string, std::pair<std::string, RequestType>>
+      locked_set_;                               // name -> (sig, op)
+  std::vector<int> round_missing_;               // per batch, names awaited
+  size_t round_emitted_ = 0;                     // batches emitted in order
+  std::vector<Request> round_received_;          // for carry_ on break
+  std::unordered_set<std::string> round_names_;
+  std::chrono::steady_clock::time_point round_started_;
+  bool kick_pending_ = false;                    // rank 0: Kick before next cycle
+  // replicated burst accumulation (all ranks, apply phase)
+  std::vector<Response> burst_plan_;
+  bool burst_valid_ = true;
+  // rank-0 burst fingerprint + stability counter (pre-broadcast phase)
+  std::string r0_burst_sig_;
+  std::string r0_last_sig_;
+  bool r0_burst_valid_ = true;
+  int r0_stable_ = 0;
 };
 
 }  // namespace hvdtpu

@@ -48,9 +48,28 @@ int Core::Submit(const Request& req) {
   // (hvd_core_op_stats).  JOIN excluded — it is a barrier, not an op.
   if (req.type != RequestType::JOIN)
     submit_us_[req.name] = trace_.NowUs();
+  // Locked-epoch fast path: a steady-set submission is served right
+  // here, on the submitter's thread, from the cached plan — zero
+  // transport, zero thread handoff.  A deviation breaks the epoch
+  // inside TryBypassSubmit and falls through to the negotiated queue.
+  if (req.type != RequestType::JOIN && controller_->epoch_locked()) {
+    std::vector<Response> out;
+    auto v = controller_->TryBypassSubmit(req, &out);
+    if (v == Controller::BypassResult::kServed) {
+      if (!out.empty()) {
+        bool got_shutdown = false;
+        int64_t bytes = 0;
+        PublishResponsesLocked(&out, &got_shutdown, &bytes);
+      }
+      inflight_count_.store(static_cast<int64_t>(inflight_.size()),
+                            std::memory_order_relaxed);
+      return 0;
+    }
+  }
   pending_.push_back(req);
   inflight_count_.store(static_cast<int64_t>(inflight_.size()),
                         std::memory_order_relaxed);
+  submit_cv_.notify_one();
   return 0;
 }
 
@@ -83,7 +102,11 @@ bool Core::Wait(Response* out, double timeout_s) {
   return true;
 }
 
-void Core::Shutdown() { shutdown_requested_.store(true); }
+void Core::Shutdown() {
+  shutdown_requested_.store(true);
+  std::lock_guard<std::mutex> lk(mu_);
+  submit_cv_.notify_all();
+}
 
 ControllerStats Core::stats() const { return controller_->stats(); }
 
@@ -120,6 +143,47 @@ bool Core::AutotuneState(int64_t* threshold, double* cycle_ms, int* done,
   return true;
 }
 
+// mu_ held by the caller.
+void Core::PublishResponsesLocked(std::vector<Response>* out,
+                                  bool* got_shutdown,
+                                  int64_t* cycle_bytes) {
+  for (auto& r : *out) {
+    if (r.type == ResponseType::SHUTDOWN) {
+      *got_shutdown = true;
+      continue;
+    }
+    if (r.type == ResponseType::OK) *cycle_bytes += r.total_bytes;
+    // Perf plane: fold each named op's enqueue->done latency and
+    // payload bytes into the per-collapsed-name aggregates
+    // (hvd_core_op_stats) before the response is handed off.
+    uint64_t done_us = trace_.NowUs();
+    for (size_t i = 0; i < r.names.size(); i++) {
+      const std::string& n = r.names[i];
+      inflight_.erase(n);
+      auto it = submit_us_.find(n);
+      if (it == submit_us_.end()) continue;
+      uint64_t age = done_us > it->second ? done_us - it->second : 0;
+      submit_us_.erase(it);
+      std::string key = CollapseOpName(n);
+      if (op_stats_.size() >= kMaxOpStatNames && !op_stats_.count(key))
+        key = "__other__";
+      OpStat& s = op_stats_[key];
+      s.count++;
+      s.sum_us += age;
+      if (age > s.max_us) s.max_us = age;
+      if (i < r.sizes.size() && r.sizes[i] > 0)
+        s.bytes += static_cast<uint64_t>(r.sizes[i]);
+    }
+    responses_.push(std::move(r));
+  }
+  inflight_count_.store(static_cast<int64_t>(inflight_.size()),
+                        std::memory_order_relaxed);
+  responses_pending_.store(static_cast<int64_t>(responses_.size()),
+                           std::memory_order_relaxed);
+  if (!out->empty()) cv_.notify_all();
+  out->clear();
+}
+
 void Core::Loop() {
   using clock = std::chrono::steady_clock;
   while (!stopped_.load()) {
@@ -128,6 +192,55 @@ void Core::Loop() {
     {
       std::lock_guard<std::mutex> lk(mu_);
       batch.swap(pending_);
+    }
+    // Locked-epoch state: the inline-submit path serves steady traffic,
+    // so the loop only (a) routes queue remnants through the bypass
+    // (requests that raced a lock transition), (b) watches for epoch
+    // breaks — a shutdown request, a partial replay round outliving its
+    // timeout (missing tensor), or a peer resuming the lock-step wire
+    // (transport Peek) — and (c) keeps the liveness stamp fresh.  When
+    // the epoch breaks, fall through into full negotiation.
+    if (controller_->epoch_locked()) {
+      if (shutdown_requested_.load()) {
+        controller_->BreakEpoch("shutdown");
+      } else {
+        std::vector<Request> fall;
+        {
+          // Serve + publish under mu_, like the inline-submit path: an
+          // interleaved inline serve must not publish a later plan
+          // batch ahead of this one (responses_ order IS the agreed
+          // execution order).
+          std::lock_guard<std::mutex> lk(mu_);
+          std::vector<Response> out;
+          for (auto& req : batch) {
+            if (controller_->TryBypassSubmit(req, &out) !=
+                Controller::BypassResult::kServed)
+              fall.push_back(std::move(req));
+          }
+          bool got_shutdown = false;
+          int64_t bytes = 0;
+          PublishResponsesLocked(&out, &got_shutdown, &bytes);
+        }
+        batch = std::move(fall);
+        if (controller_->epoch_locked() && batch.empty()) {
+          if (transport_->Peek()) {
+            controller_->BreakEpoch("remote");
+          } else if (!controller_->BypassRoundTimedOut()) {
+            last_progress_us_.store(trace_.NowUs(),
+                                    std::memory_order_relaxed);
+            std::unique_lock<std::mutex> lk(mu_);
+            submit_cv_.wait_for(
+                lk,
+                std::chrono::duration<double, std::milli>(
+                    opts_.cycle_time_ms),
+                [&] {
+                  return !pending_.empty() || stopped_.load() ||
+                         shutdown_requested_.load();
+                });
+            continue;
+          }
+        }
+      }
     }
     std::vector<Response> out;
     if (!controller_->RunCycle(batch, shutdown_requested_.load(), &out)) {
@@ -148,40 +261,7 @@ void Core::Loop() {
     int64_t cycle_bytes = 0;
     {
       std::lock_guard<std::mutex> lk(mu_);
-      for (auto& r : out) {
-        if (r.type == ResponseType::SHUTDOWN) {
-          got_shutdown = true;
-          continue;
-        }
-        if (r.type == ResponseType::OK) cycle_bytes += r.total_bytes;
-        // Perf plane: fold each named op's enqueue->done latency and
-        // payload bytes into the per-collapsed-name aggregates
-        // (hvd_core_op_stats) before the response is handed off.
-        uint64_t done_us = trace_.NowUs();
-        for (size_t i = 0; i < r.names.size(); i++) {
-          const std::string& n = r.names[i];
-          inflight_.erase(n);
-          auto it = submit_us_.find(n);
-          if (it == submit_us_.end()) continue;
-          uint64_t age = done_us > it->second ? done_us - it->second : 0;
-          submit_us_.erase(it);
-          std::string key = CollapseOpName(n);
-          if (op_stats_.size() >= kMaxOpStatNames && !op_stats_.count(key))
-            key = "__other__";
-          OpStat& s = op_stats_[key];
-          s.count++;
-          s.sum_us += age;
-          if (age > s.max_us) s.max_us = age;
-          if (i < r.sizes.size() && r.sizes[i] > 0)
-            s.bytes += static_cast<uint64_t>(r.sizes[i]);
-        }
-        responses_.push(std::move(r));
-      }
-      inflight_count_.store(static_cast<int64_t>(inflight_.size()),
-                            std::memory_order_relaxed);
-      responses_pending_.store(static_cast<int64_t>(responses_.size()),
-                               std::memory_order_relaxed);
-      if (!out.empty()) cv_.notify_all();
+      PublishResponsesLocked(&out, &got_shutdown, &cycle_bytes);
     }
     // Postmortem plane: a completed cycle IS the liveness heartbeat of
     // this core — health_snapshot ages against this stamp.
@@ -191,12 +271,29 @@ void Core::Loop() {
       cv_.notify_all();
       return;
     }
-    // sleep out the remainder of the cycle (reference: operations.cc:592)
+    // Event-driven cycle tail (was: a fixed sleep_for): wait out the
+    // remainder of the cycle OR wake the instant a submission lands, so
+    // a lone sync op pays a fraction of a tick instead of a full one.
+    // The timeout keeps the idle cadence — lock-step peers expect a
+    // frame per cycle, and stall/autotune housekeeping rides it.  An
+    // early wake is followed by a short accumulation nap (cycle/5):
+    // the cycle time stays the fusion batching window for bursts —
+    // autograd-hook submissions land microseconds apart, so the burst
+    // fuses — without re-imposing the full tick on a lone op.
     auto elapsed = clock::now() - start;
     auto cycle = std::chrono::duration<double, std::milli>(
         opts_.cycle_time_ms);
     if (elapsed < cycle) {
-      std::this_thread::sleep_for(cycle - elapsed);
+      bool woke_early;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        woke_early = submit_cv_.wait_for(lk, cycle - elapsed, [&] {
+          return !pending_.empty() || stopped_.load() ||
+                 shutdown_requested_.load();
+        });
+      }
+      if (woke_early && !stopped_.load() && !shutdown_requested_.load())
+        std::this_thread::sleep_for(cycle / 5);
     }
     // Autotune on total cycle wall time (reference scores bytes/sec over
     // the sampled cycles, parameter_manager.cc Update).

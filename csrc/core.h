@@ -5,7 +5,12 @@
 // operations.cc:589-647 RunLoopOnce, spawned at operations.cc:690-691).
 // Here the thread owns negotiation only — execution happens in the frontend
 // (XLA) in the agreed order — so the loop is: drain submit queue, RunCycle,
-// publish responses, sleep the remainder of the cycle.
+// publish responses, wait for the next submission OR the cycle-time tick
+// (a condition variable, not a fixed sleep: a lone sync op wakes the loop
+// in microseconds, and idle ticks keep housekeeping/stall checks alive).
+// In the locked-epoch state (controller.h plan epochs) submissions are
+// served inline at submit time from the cached plan — the loop only ticks
+// to watch for epoch breaks (partial-round timeout, transport Peek).
 
 #pragma once
 
@@ -106,6 +111,10 @@ class Core {
 
  private:
   void Loop();
+  // Hand a cycle's (or a bypass round's) responses to consumers: op-stat
+  // aggregation, inflight clearing, queue push + wakeup.  mu_ held.
+  void PublishResponsesLocked(std::vector<Response>* out,
+                              bool* got_shutdown, int64_t* cycle_bytes);
 
   std::unique_ptr<Transport> transport_;
   std::unique_ptr<Controller> controller_;
@@ -114,6 +123,11 @@ class Core {
 
   mutable std::mutex mu_;
   std::condition_variable cv_;
+  // Event-driven cycle pacing: Submit/Shutdown signal this so a lone
+  // sync op pays microseconds, not a cycle-time tick (the tick remains
+  // as the wait timeout — idle housekeeping, stall checks and epoch
+  // timeouts still run on the cycle cadence).
+  std::condition_variable submit_cv_;
   std::unique_ptr<ParameterManager> pm_;  // guarded by mu_
   std::vector<Request> pending_;
   std::unordered_set<std::string> inflight_;

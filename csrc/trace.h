@@ -5,10 +5,14 @@
 // happened in this library with no spans at all — the reference's timeline
 // has the same blind spot (its writer thread lives frontend-side,
 // timeline.{h,cc}).  This ring records BEGIN/END/INSTANT events from the
-// cycle loop, the TCP transport and the chaos injector; Python drains it
-// through the versioned `hvd_core_trace` C API (csrc/c_api.cc) into the
-// timeline writer thread, which rebases ring timestamps onto the
-// clock-aligned fleet epoch (utils/clocksync.py).
+// cycle loop, the TCP transport, the chaos injector AND the plan-epoch
+// fast path (cycle.bypass spans + epoch.lock/epoch.invalidate instants,
+// controller.h) — the latter fired from the SUBMITTER's thread, since
+// locked-epoch responses are built inline at submit time; the spinlock
+// makes recording safe from any thread.  Python drains it through the
+// versioned `hvd_core_trace` C API (csrc/c_api.cc) into the timeline
+// writer thread, which rebases ring timestamps onto the clock-aligned
+// fleet epoch (utils/clocksync.py).
 //
 // Design constraints:
 //   * recording must be cheap on the cycle-loop hot path: one atomic load

@@ -6,6 +6,7 @@
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -40,6 +41,22 @@ bool LoopbackHub::Gather(int rank, const std::string& mine,
     cv_.wait(lk, [&] { return gather_gen_ != gen; });
   }
   return true;
+}
+
+bool LoopbackHub::Peek(int rank, uint64_t* kicks_seen) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (rank == 0) return gather_count_ > 0;
+  if (kick_gen_ > *kicks_seen) {
+    *kicks_seen = kick_gen_;
+    return true;
+  }
+  return false;
+}
+
+void LoopbackHub::Kick() {
+  std::lock_guard<std::mutex> lk(mu_);
+  kick_gen_++;
+  cv_.notify_all();
 }
 
 bool LoopbackHub::Bcast(int rank, std::string* frame,
@@ -233,20 +250,59 @@ TcpTransport::~TcpTransport() {
     if (fd >= 0) close(fd);
 }
 
-bool TcpTransport::SendFrame(int fd, const std::string& s) {
-  if (fd < 0) return false;
-  uint32_t len = static_cast<uint32_t>(s.size());
-  char hdr[4];
-  memcpy(hdr, &len, 4);
-  std::string buf(hdr, 4);
-  buf += s;
-  size_t off = 0;
-  while (off < buf.size()) {
-    ssize_t n = send(fd, buf.data() + off, buf.size() - off, MSG_NOSIGNAL);
-    if (n <= 0) return false;
-    off += static_cast<size_t>(n);
+bool TcpTransport::SendFramesV(int fd, const std::string* const* frames,
+                               int n) {
+  if (fd < 0 || n <= 0) return false;
+  constexpr int kMax = 8;  // protocol sends at most a few frames per batch
+  if (n > kMax) return false;
+  uint32_t hdrs[kMax];
+  iovec iov[2 * kMax];
+  int iovcnt = 0;
+  size_t total = 0;
+  for (int i = 0; i < n; i++) {
+    hdrs[i] = static_cast<uint32_t>(frames[i]->size());
+    iov[iovcnt].iov_base = &hdrs[i];
+    iov[iovcnt].iov_len = 4;
+    iovcnt++;
+    if (!frames[i]->empty()) {
+      iov[iovcnt].iov_base = const_cast<char*>(frames[i]->data());
+      iov[iovcnt].iov_len = frames[i]->size();
+      iovcnt++;
+    }
+    total += 4 + frames[i]->size();
   }
+  msghdr msg{};
+  msg.msg_iov = iov;
+  msg.msg_iovlen = iovcnt;
+  int idx = 0;
+  size_t sent_total = 0;
+  while (sent_total < total) {
+    ssize_t w = sendmsg(fd, &msg, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    sent_total += static_cast<size_t>(w);
+    // advance the iovec window past fully-written entries
+    size_t left = static_cast<size_t>(w);
+    while (left > 0 && idx < iovcnt) {
+      if (left >= iov[idx].iov_len) {
+        left -= iov[idx].iov_len;
+        idx++;
+      } else {
+        iov[idx].iov_base = static_cast<char*>(iov[idx].iov_base) + left;
+        iov[idx].iov_len -= left;
+        left = 0;
+      }
+    }
+    msg.msg_iov = iov + idx;
+    msg.msg_iovlen = iovcnt - idx;
+  }
+  stats_.coalesced_bytes += total;
+  if (n > 1) stats_.frames_coalesced += static_cast<uint64_t>(n);
   return true;
+}
+
+bool TcpTransport::SendFrame(int fd, const std::string& s) {
+  const std::string* one[1] = {&s};
+  return SendFramesV(fd, one, 1);
 }
 
 bool TcpTransport::RecvFrame(int fd, std::string* s) {
@@ -404,23 +460,22 @@ bool TcpTransport::ResyncAccepted(int fd, int* got_rank) {
   uint64_t peer_gathers = GetU64(hello, 4);
   uint64_t peer_bcasts = GetU64(hello, 12);
   // resync-ack: how many gather frames of theirs we hold — the worker
-  // replays its pending frame iff we are behind.
+  // replays its pending frame iff we are behind.  When the worker also
+  // missed the latest bcast round, the replay rides the SAME vectored
+  // write as the ack (coalesced frame IO; lock-step bounds the gap to
+  // one frame and the worker dedups by seq regardless).
   std::string ack;
   PutU64(&ack, gathers_from_[r]);
-  if (!SendFrame(fd, ack)) {
-    close(fd);
-    return false;
-  }
-  // The worker missed the latest bcast round: replay it now (lock-step
-  // bounds the gap to one frame; the worker dedups by seq regardless).
-  if (peer_bcasts < bcast_seq_ && !last_bcast_frame_.empty()) {
+  bool replay = peer_bcasts < bcast_seq_ && !last_bcast_frame_.empty();
+  const std::string* frames[2] = {&ack, &last_bcast_frame_};
+  if (replay) {
     stats_.frames_resent++;
     Trace('i', "tcp.resend",
           static_cast<int64_t>(last_bcast_frame_.size()));
-    if (!SendFrame(fd, last_bcast_frame_)) {
-      close(fd);
-      return false;
-    }
+  }
+  if (!SendFramesV(fd, frames, replay ? 2 : 1)) {
+    close(fd);
+    return false;
   }
   (void)peer_gathers;
   SetRecvTimeoutMs(fd, 0);
@@ -457,6 +512,35 @@ bool TcpTransport::ReacceptWorker(int r) {
   stats_.reconnect_failures++;
   Trace('E', "tcp.reaccept", -1);
   return false;
+}
+
+// ------------------------------------------------------------- plan epochs
+bool TcpTransport::Peek() {
+  if (size_ == 1) return false;
+  if (rank_ == 0) {
+    for (int r = 1; r < size_; r++) {
+      if (worker_fds_[r] < 0) continue;
+      pollfd pfd{worker_fds_[r], POLLIN, 0};
+      if (poll(&pfd, 1, 0) > 0) return true;
+    }
+    return false;
+  }
+  if (coord_fd_ < 0) return false;
+  pollfd pfd{coord_fd_, POLLIN, 0};
+  return poll(&pfd, 1, 0) > 0;
+}
+
+void TcpTransport::Kick() {
+  // Rank 0 only: a zero-length advisory frame per worker.  Not
+  // seq-tagged and not replayed — a kick lost to a connection break is
+  // re-issued by the next break (the receiver treats any pending frame
+  // as the wake signal anyway).  Best-effort: a dead fd fails into the
+  // normal reaccept path on the next real frame op.
+  if (rank_ != 0 || size_ == 1) return;
+  static const std::string kEmpty;
+  const std::string* one[1] = {&kEmpty};
+  for (int r = 1; r < size_; r++)
+    if (worker_fds_[r] >= 0) SendFramesV(worker_fds_[r], one, 1);
 }
 
 // -------------------------------------------------------------- collectives
@@ -534,6 +618,7 @@ bool TcpTransport::Bcast(std::string* frame) {
       if (!WorkerReconnect()) return false;
       continue;
     }
+    if (raw.empty()) continue;  // rank-0 kick: advisory; real bcast follows
     if (raw.size() < 8) return false;
     uint64_t seq = GetU64(raw, 0);
     if (seq <= bcasts_seen_) continue;  // replayed dup: discard

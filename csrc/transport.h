@@ -41,6 +41,12 @@ struct TransportStats {
   uint64_t frames_resent = 0;        // gather/bcast frames retransmitted
   uint64_t frames_dropped = 0;       // chaos-injected frame drops
   uint64_t chaos_faults = 0;         // total injected faults fired
+  // Coalesced frame IO (one writev per peer per cycle): frames that
+  // shared a vectored write with at least one sibling (resync ack +
+  // replay, hello + retransmit), and bytes through the vectored path
+  // (every frame — the header/seq/payload assembly copy is gone).
+  uint64_t frames_coalesced = 0;
+  uint64_t coalesced_bytes = 0;
 };
 
 // Deterministic seeded fault injector for the TCP transport (the csrc
@@ -85,6 +91,15 @@ class Transport {
                       std::vector<std::string>* all) = 0;
   // Coordinator sends one frame to every worker; workers receive it.
   virtual bool Bcast(std::string* frame) = 0;
+  // Plan-epoch support (controller.h): while an epoch is locked no rank
+  // touches the lock-step wire, so a rank that resumes it must be
+  // noticeable without blocking.  Peek is a non-blocking "is a frame
+  // pending for me" probe (rank 0: any worker frame; worker: a kick or
+  // replay); Kick is rank 0's zero-length advisory frame telling locked
+  // workers to rejoin the wire.  Defaults are inert for transports
+  // without a wire.
+  virtual bool Peek() { return false; }
+  virtual void Kick() {}
   // Fault/retry counters; zero for transports without a wire.
   virtual TransportStats transport_stats() const { return TransportStats(); }
   // Tracing-plane hook (trace.h): frame/reconnect/chaos events land in
@@ -102,6 +117,10 @@ class LoopbackHub {
   // consumed_rounds: per-caller count of bcast rounds already read; lets a
   // late worker recognize an already-posted round (lock-step protocol).
   bool Bcast(int rank, std::string* frame, uint64_t* consumed_rounds);
+  // Plan-epoch support: rank 0 peeks for parked worker contributions;
+  // workers peek for a kick (consumed per caller via kicks_seen).
+  bool Peek(int rank, uint64_t* kicks_seen);
+  void Kick();
   int size() const { return size_; }
 
  private:
@@ -114,6 +133,7 @@ class LoopbackHub {
   std::string bcast_frame_;
   uint64_t bcast_gen_ = 0;
   int bcast_reads_ = 0;
+  uint64_t kick_gen_ = 0;
 };
 
 class LoopbackTransport : public Transport {
@@ -128,11 +148,16 @@ class LoopbackTransport : public Transport {
   bool Bcast(std::string* frame) override {
     return hub_->Bcast(rank_, frame, &consumed_rounds_);
   }
+  bool Peek() override { return hub_->Peek(rank_, &kicks_seen_); }
+  void Kick() override {
+    if (rank_ == 0) hub_->Kick();
+  }
 
  private:
   LoopbackHub* hub_;
   int rank_;
   uint64_t consumed_rounds_ = 0;
+  uint64_t kicks_seen_ = 0;
 };
 
 class TcpTransport : public Transport {
@@ -149,6 +174,8 @@ class TcpTransport : public Transport {
   bool Gather(const std::string& mine,
               std::vector<std::string>* all) override;
   bool Bcast(std::string* frame) override;
+  bool Peek() override;
+  void Kick() override;
   TransportStats transport_stats() const override { return stats_; }
   void set_trace(TraceRing* t) override { trace_ = t; }
 
@@ -158,6 +185,10 @@ class TcpTransport : public Transport {
     if (trace_ != nullptr && trace_->enabled())
       trace_->Record(phase, cat, name, arg);
   }
+  // Coalesced frame IO: n length-prefixed frames in ONE writev — no
+  // header+payload assembly copy, one syscall per peer per cycle; n > 1
+  // batches the resync ack+replay pairs (stats_.frames_coalesced).
+  bool SendFramesV(int fd, const std::string* const* frames, int n);
   bool SendFrame(int fd, const std::string& s);
   bool RecvFrame(int fd, std::string* s);
 

@@ -48,6 +48,18 @@ _knob("HOROVOD_CYCLE_TIME", 1.0, float,
       "Background coordination cycle time in milliseconds (eager frontends).")
 _knob("HOROVOD_CACHE_CAPACITY", 1024, int,
       "Response/bucket-plan cache capacity (entries). 0 disables caching.")
+_knob("HOROVOD_BYPASS", True, _parse_bool,
+      "Steady-state negotiation bypass (plan epochs, csrc/controller.cc): "
+      "once the negotiated tensor set repeats for "
+      "HOROVOD_BYPASS_STABLE_CYCLES consecutive steps, every rank replays "
+      "the cached fused response plan locally with zero controller round "
+      "trips, invalidated on any new/missing tensor, JOIN, shutdown or "
+      "elastic reset.  Read by the native core at construction.")
+_knob("HOROVOD_BYPASS_STABLE_CYCLES", 5, int,
+      "Consecutive identical negotiated steps (burst fingerprints) rank 0 "
+      "requires before broadcasting an epoch lock.  Must be >= 1; "
+      "rejected at hvd.init() otherwise.  Read by the native core at "
+      "construction.")
 _knob("HOROVOD_HIERARCHICAL_ALLREDUCE", False, _parse_bool,
       "Force two-level allreduce: reduce-scatter over ICI, allreduce over DCN, "
       "allgather over ICI.")

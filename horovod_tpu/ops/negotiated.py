@@ -149,7 +149,11 @@ class SyncNegotiator:
                 raise HorovodInternalError(
                     f"timed out after {timeout_s}s negotiating {name!r} "
                     "(stalled peer?)")
-            resp = core.wait(timeout_s=1.0)
+            # Poll-first: in the locked-epoch steady state the response
+            # was built inline by submit() (csrc plan epochs), so the
+            # non-blocking pop usually lands it without entering the
+            # native condition-variable wait at all.
+            resp = core.poll() or core.wait(timeout_s=1.0)
             if resp is not None:
                 self._execute_response(resp)
 

@@ -175,6 +175,15 @@ class Runtime:
                 f"HOROVOD_CACHE_CAPACITY="
                 f"{self.knobs['HOROVOD_CACHE_CAPACITY']} invalid; use 0 "
                 "to disable caching, a positive entry count otherwise")
+        # Plan-epoch fast path (csrc/controller.cc; docs/tensor-fusion.md):
+        # the native core reads these from env at construction, so a bad
+        # value must fail HERE, not as a silently-never-locking epoch.
+        if self.knobs["HOROVOD_BYPASS_STABLE_CYCLES"] < 1:
+            raise ValueError(
+                f"HOROVOD_BYPASS_STABLE_CYCLES="
+                f"{self.knobs['HOROVOD_BYPASS_STABLE_CYCLES']} invalid; "
+                "the epoch lock needs at least 1 stable step "
+                "(docs/knobs.md)")
 
         # Autotune (reference: HOROVOD_AUTOTUNE + ParameterManager,
         # parameter_manager.{h,cc}): Bayesian optimization over (fusion

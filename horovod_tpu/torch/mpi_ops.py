@@ -278,7 +278,10 @@ def _drain(handle: Optional[int] = None, timeout_s: float = 300.0) -> None:
             with _pending_lock:
                 if not _pending:
                     return
-        resp = core.wait(timeout_s=min(1.0, timeout_s))
+        # Poll-first: in the locked-epoch steady state (csrc plan
+        # epochs) the response was built inline by submit(), so the
+        # non-blocking pop usually skips the native cv wait entirely.
+        resp = core.poll() or core.wait(timeout_s=min(1.0, timeout_s))
         if resp is not None:
             _execute_response(resp)
         elif time.monotonic() > deadline:

@@ -258,6 +258,18 @@ CONTROLLER_FUSED_BYTES = REGISTRY.counter(
 CONTROLLER_FILL_RATIO = REGISTRY.gauge(
     "hvd_controller_fusion_fill_ratio",
     "Mean fused-batch bytes / fusion threshold (fusion buffer fill).")
+CONTROLLER_BYPASS_CYCLES = REGISTRY.counter(
+    "hvd_controller_bypass_cycles_total",
+    "Steady-state replay rounds served from the locked plan epoch with "
+    "ZERO controller transport round trips (docs/tensor-fusion.md).")
+CONTROLLER_EPOCH_LOCKS = REGISTRY.counter(
+    "hvd_controller_epoch_locks_total",
+    "Plan-epoch locks applied (rank 0 saw HOROVOD_BYPASS_STABLE_CYCLES "
+    "identical negotiated steps and broadcast the lock).")
+CONTROLLER_EPOCH_INVALIDATIONS = REGISTRY.counter(
+    "hvd_controller_epoch_invalidations_total",
+    "Plan-epoch breaks (new/missing tensor, JOIN, shutdown, remote "
+    "break) — each falls back to full negotiation.")
 TRANSPORT_RECONNECTS = REGISTRY.counter(
     "hvd_transport_reconnects_total",
     "Controller TCP reconnects that succeeded (resync handshake done).")
@@ -270,6 +282,14 @@ TRANSPORT_FRAMES_RESENT = REGISTRY.counter(
 TRANSPORT_FRAMES_DROPPED = REGISTRY.counter(
     "hvd_transport_frames_dropped_total",
     "Coordination frames dropped by chaos injection.")
+TRANSPORT_FRAMES_COALESCED = REGISTRY.counter(
+    "hvd_transport_frames_coalesced_total",
+    "Coordination frames that shared one vectored write with a sibling "
+    "(resync ack+replay batches — coalesced frame IO).")
+TRANSPORT_COALESCED_BYTES = REGISTRY.counter(
+    "hvd_transport_coalesced_bytes_total",
+    "Bytes sent through the vectored (writev/sendmsg) frame path — one "
+    "syscall per peer per cycle, no header/payload assembly copy.")
 CHAOS_FAULTS_NATIVE = REGISTRY.counter(
     "hvd_chaos_faults_native_total",
     "Faults the native transport injector fired (csrc chaos plane).")
@@ -460,11 +480,19 @@ def import_core_metrics(native: Dict[str, Any]) -> None:
     CONTROLLER_TENSORS.set_total(c.get("tensors_negotiated", 0))
     CONTROLLER_FUSED_BATCHES.set_total(c.get("fused_batches", 0))
     CONTROLLER_FUSED_BYTES.set_total(c.get("fused_batch_bytes", 0))
+    CONTROLLER_BYPASS_CYCLES.set_total(c.get("bypass_cycles", 0))
+    CONTROLLER_EPOCH_LOCKS.set_total(c.get("epoch_locks", 0))
+    CONTROLLER_EPOCH_INVALIDATIONS.set_total(
+        c.get("epoch_invalidations", 0))
     TRANSPORT_RECONNECTS.set_total(c.get("transport_reconnects", 0))
     TRANSPORT_RECONNECT_FAILURES.set_total(
         c.get("transport_reconnect_failures", 0))
     TRANSPORT_FRAMES_RESENT.set_total(c.get("transport_frames_resent", 0))
     TRANSPORT_FRAMES_DROPPED.set_total(c.get("transport_frames_dropped", 0))
+    TRANSPORT_FRAMES_COALESCED.set_total(
+        c.get("transport_frames_coalesced", 0))
+    TRANSPORT_COALESCED_BYTES.set_total(
+        c.get("transport_coalesced_bytes", 0))
     CHAOS_FAULTS_NATIVE.set_total(c.get("chaos_faults_injected", 0))
     batches = c.get("fused_batches", 0)
     threshold = c.get("fusion_threshold_bytes", 0)

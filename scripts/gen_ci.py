@@ -89,6 +89,8 @@ KNOB_DIMS = [
      ["jax-core"]),
     ("cache-off", {"HOROVOD_CACHE_CAPACITY": "0"},
      ["native-controller"]),
+    ("bypass-off", {"HOROVOD_BYPASS": "0"},
+     ["native-controller"]),
     ("streams-4", {"HOROVOD_NUM_STREAMS": "4"},
      ["torch"]),
     ("no-donate", {"HOROVOD_TPU_DONATE_BUFFERS": "0"},
@@ -190,6 +192,16 @@ def build_steps():
     steps.append(_step(
         "bench: cpu smoke",
         f"{py} bench.py --cpu", timeout=15))
+    steps.append(_step(
+        # eager fast-path smoke: the steady-state plan epoch must lock
+        # at np=2 under the real launcher and hold the <1.2 cycles/op
+        # bound with a sub-ms locked negotiation round trip — the
+        # docs/benchmarks.md steady-state claim as a gate
+        # (scripts/bench_eager.py; docs/tensor-fusion.md#steady-state).
+        "bench: eager fast-path smoke (np=2, cycles/op bound)",
+        f"{py} -m pytest tests/integration/test_multiprocess.py "
+        f"-q -m \"\" -k eager_bench_bounds",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=15))
     steps.append(_step(
         # wire-policy sweep smoke: every wire format round-trips on the
         # 8-device virtual mesh, int8 carries <= 1/2 bf16's modeled

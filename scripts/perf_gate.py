@@ -62,10 +62,12 @@ def _print_results(res: dict) -> None:
             print(f"  NO-BASELINE  {key}  (median "
                   f"{r['current_median']:.6g}; run `update` to adopt)")
             continue
+        ratio = ("n/a" if r["ratio"] is None  # zero baseline median
+                 else f"{r['ratio']:.3f}")
         print(f"  {status.upper():<12} {key}  baseline "
               f"{r['baseline_median']:.6g}±{r['baseline_mad']:.2g} -> "
               f"current {r['current_median']:.6g} "
-              f"(ratio {r['ratio']:.3f}, threshold ±{r['threshold']:.2g})")
+              f"(ratio {ratio}, threshold ±{r['threshold']:.2g})")
 
 
 def cmd_check(gate, args) -> int:

@@ -1,0 +1,108 @@
+"""Per-rank worker for the plan-epoch chaos integration test.
+
+Each incarnation drives the native controller to a locked plan epoch
+(steady named steps over real TCP), asserting along the way that the
+locked replays are BIT-EXACT the negotiated steady step's responses.
+Then the chaos clock ticks: in the first incarnation the distributed
+spec kills rank 1 at step 2 — MID-EPOCH, while every rank is serving
+submissions with zero transport round trips — and the elastic driver
+runs a reset round.  The second incarnation (the one-shot ``state_dir``
+suppresses the re-kill) starts from a fresh core: the epoch died with
+it, full negotiation resumes, the steady set re-locks, and the run
+completes.  Markers record the per-incarnation lock counts so the test
+can assert the fast path was active on BOTH sides of the fault.
+"""
+
+import os
+import sys
+import time
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main() -> int:
+    out_dir = os.environ["CHAOS_TEST_DIR"]
+    os.environ.setdefault("HOROVOD_BYPASS_STABLE_CYCLES", "3")
+    hvd.init()
+    assert hvd.process_size() == 2
+    rt = hvd.runtime.get()
+    assert hvd.chaos.active() is not None, \
+        "chaos injector not installed from the rendezvous spec"
+    rank = hvd.process_rank()
+    # The chaos one-shot marker tells incarnations apart: it exists only
+    # after the scheduled kill fired (i.e. in the post-reset incarnation).
+    fired_marker = os.path.join(out_dir, "chaos_state",
+                                "chaos_fired_0_rank1")
+    phase = "post" if os.path.exists(fired_marker) else "pre"
+
+    core = rt.ensure_core()
+    assert core is not None
+    names = [f"g{i}" for i in range(4)]
+
+    def step(tag, timeout=20.0):
+        """One steady step; returns the response batch sequence."""
+        for n in names:
+            core.submit(n, "f32:16:sum", 0, 64)
+        got, batches = [], []
+        deadline = time.time() + timeout
+        while len(got) < len(names) and time.time() < deadline:
+            r = core.poll()
+            if r:
+                assert r.type == "OK", (tag, r)
+                batches.append((tuple(r.names), tuple(r.sigs)))
+                got.extend(r.names)
+            time.sleep(0.002)
+        assert sorted(got) == sorted(names), (tag, rank, got)
+        return tuple(batches)
+
+    # negotiated phase: capture the steady step's response sequence
+    negotiated = None
+    for s in range(3):
+        negotiated = step(f"warm{s}")
+        time.sleep(0.01)
+
+    # drive to the epoch lock
+    locked = False
+    for s in range(30):
+        seq = step(f"lock{s}")
+        assert seq == negotiated, (seq, negotiated)  # bit-exact pre-lock
+        time.sleep(0.01)
+        if core.metrics()["counters"]["epoch_locks"] >= 1:
+            locked = True
+            break
+    assert locked, core.metrics()["counters"]
+
+    # locked phase: replayed responses must be bit-exact the negotiated
+    # sequence — and the chaos clock ticks INSIDE it, so the first
+    # incarnation's rank-1 kill lands mid-epoch.
+    for s in range(5):
+        hvd.chaos.step(s)  # first incarnation: rank 1 dies at step 2
+        seq = step(f"epoch{s}")
+        assert seq == negotiated, (seq, negotiated)
+    c = core.metrics()["counters"]
+    assert c["bypass_cycles"] > 0, c
+
+    # Cross-rank barrier on the DATA plane: the first incarnation's
+    # survivor blocks here (its peer died mid-epoch — local replays
+    # kept IT going, but the collective cannot complete), so the
+    # elastic driver's reset round tears it down; the second
+    # incarnation completes on the rebuilt fleet.
+    x = np.ones(2, np.float32)
+    out = np.asarray(hvd.allreduce(x, name="dp.final", op=hvd.Sum))
+    assert np.allclose(out, float(hvd.size())), out
+
+    with open(os.path.join(
+            out_dir, f"epoch_ok_{phase}_{rank}"), "w") as f:
+        f.write(f"locks={c['epoch_locks']} bypass={c['bypass_cycles']}")
+    print(f"EAGER-EPOCH-OK rank={rank} phase={phase} "
+          f"locks={c['epoch_locks']} bypass={c['bypass_cycles']}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -129,3 +129,39 @@ events:
     out = proc.stdout + proc.stderr
     assert "straggler report" in out, out[-4000:]
     assert "slowest: rank 1" in out, out[-4000:]
+
+
+@pytest.mark.integration
+def test_chaos_rank_kill_mid_epoch_falls_back_and_completes(tmp_path):
+    """(e) plan-epoch chaos: rank 1 is killed MID-EPOCH (while every
+    rank serves submissions from the locked plan with zero controller
+    round trips).  The elastic reset tears down the fleet — the epoch
+    dies with the core — and the second incarnation renegotiates from
+    scratch, re-locks the same steady set, and completes, with replayed
+    responses asserted bit-exact the negotiated ones in BOTH
+    incarnations (tests/integration/eager_epoch_worker.py)."""
+    disc = tmp_path / "discover.sh"
+    disc.write_text("#!/bin/sh\necho 'localhost:2'\necho '127.0.0.1:2'\n")
+    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+    spec = _write_spec(tmp_path / "chaos.yaml", f"""
+seed: 19
+state_dir: {tmp_path / 'chaos_state'}
+events:
+  - kill: {{rank: 1, step: 2}}
+""")
+    run_hvdrun("eager_epoch_worker.py",
+               extra_env={"CHAOS_TEST_DIR": str(tmp_path),
+                          "HVD_CPU_CHIPS": "1",
+                          "HOROVOD_BYPASS_STABLE_CYCLES": "3"},
+               launcher_args=["--min-np", "2", "--max-np", "2",
+                              "--host-discovery-script", str(disc),
+                              "--elastic-timeout", "60",
+                              "--chaos", spec])
+    assert (tmp_path / "chaos_state" / "chaos_fired_0_rank1").exists(), \
+        "chaos kill never fired"
+    # second incarnation: both ranks re-locked and completed
+    for r in range(2):
+        marker = tmp_path / f"epoch_ok_post_{r}"
+        assert marker.exists(), sorted(
+            p.name for p in tmp_path.iterdir())
+        assert "locks=" in marker.read_text()

@@ -122,19 +122,26 @@ def test_fastcommit_cross_host_agreement(tmp_path):
 
 @pytest.mark.integration
 def test_eager_bench_bounds():
-    """Negotiated-path regression bounds (r4 VERDICT weak #3): per-op
-    latency and controller cycles/op must stay within a generous
-    envelope of the recorded numbers (docs/benchmarks.md), and grouped
-    bucketing must not lose to per-op dispatch — the optimizer defaults
-    to it."""
+    """Negotiated-path regression bounds (r4 VERDICT weak #3, tightened
+    for the plan-epoch fast path): the steady-state regime must lock
+    its epoch and hold <1.2 controller cycles/op with a sub-millisecond
+    locked negotiation round trip — the docs/benchmarks.md claim as a
+    gate — while the cold-path envelope stays within its loose bounds
+    and grouped bucketing does not lose to per-op dispatch."""
     import importlib.util
     spec = importlib.util.spec_from_file_location(
         "bench_eager", os.path.join(REPO, "scripts", "bench_eager.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     r = mod.run_bench(np_=2, size_kb=64.0, tensors=16, iters=10)
-    # recorded: ~7 ms / ~9 cycles/op on this image; bounds are loose
-    # enough for CI noise but catch order-of-magnitude regressions
+    # steady state: the epoch must actually lock (else the numbers
+    # below would silently measure the slow path) and the bypass must
+    # collapse the per-op controller cost
+    assert r["epoch_locked"], r
+    assert r["bypass_rounds"] > 0, r
+    assert r["steady_cycles_per_op"] < 1.2, r
+    assert r["steady_negotiate_lat_ms"] < 1.0, r
+    # cold path: loose envelope, catches order-of-magnitude regressions
     assert r["sync_small_lat_ms"] < 250, r
     assert r["cycles_per_op"] < 100, r
     assert r["grouped_ops_per_s"] > 0.8 * r["async_ops_per_s"], r

@@ -334,3 +334,246 @@ def test_tcp_transport_two_processes():
         assert p.exitcode == 0
     assert results[0] == ("OK", ("t",))
     assert results[1] == ("OK", ("t",))
+
+
+# ----------------------------------------------------- plan-epoch fast path
+def _epoch_cores(k=3, cycle_ms=0.5, bypass="1"):
+    """Loopback pair with the bypass knobs pinned (the native core reads
+    them from env at construction)."""
+    old = {n: os.environ.get(n)
+           for n in ("HOROVOD_BYPASS", "HOROVOD_BYPASS_STABLE_CYCLES")}
+    os.environ["HOROVOD_BYPASS"] = bypass
+    os.environ["HOROVOD_BYPASS_STABLE_CYCLES"] = str(k)
+    try:
+        hub = LoopbackHub(2)
+        cores = [CoordinationCore.loopback(hub, r, cycle_ms=cycle_ms)
+                 for r in range(2)]
+    finally:
+        for n, v in old.items():
+            if v is None:
+                os.environ.pop(n, None)
+            else:
+                os.environ[n] = v
+    return hub, cores
+
+
+def _epoch_step(cores, names, sig="f32:64:sum", nbytes=256, timeout=5.0):
+    """One steady step: every core submits the set, drains it, and the
+    per-core response batch sequence is returned for exactness checks."""
+    for c in cores:
+        for n in names:
+            c.submit(n, sig, OP_ALLREDUCE, nbytes)
+    seqs = []
+    for c in cores:
+        got, batches = [], []
+        deadline = time.time() + timeout
+        while len(got) < len(names) and time.time() < deadline:
+            r = c.poll()
+            if r:
+                assert r.type == "OK", r
+                batches.append((tuple(r.names), tuple(r.sigs)))
+                got.extend(r.names)
+            time.sleep(0.002)
+        assert sorted(got) == sorted(names), got
+        seqs.append(tuple(batches))
+    return seqs
+
+
+def _drive_to_lock(cores, names, steps=20, **kw):
+    """Steady steps with idle gaps until rank 0 reports an epoch lock."""
+    for _ in range(steps):
+        _epoch_step(cores, names, **kw)
+        time.sleep(0.01)  # idle cycles close the burst
+        if cores[0].metrics()["counters"]["epoch_locks"] >= 1:
+            return True
+    return False
+
+
+def _teardown(hub, cores):
+    for c in cores:
+        c.shutdown()
+    for c in cores:
+        c.close()
+    hub.close()
+
+
+def test_epoch_lock_zero_transport_and_counters():
+    """After K identical steps the epoch locks; locked steps move ZERO
+    coordination bytes and ZERO controller cycles — only the bypass
+    counters advance (the tentpole claim, measured)."""
+    hub, cores = _epoch_cores(k=3)
+    try:
+        names = [f"g{i}" for i in range(5)]
+        assert _drive_to_lock(cores, names), \
+            cores[0].metrics()["counters"]
+        c = cores[0].metrics()["counters"]
+        b0 = c["bytes_gathered"] + c["bytes_broadcast"]
+        cyc0, byp0 = c["cycles"], c["bypass_cycles"]
+        for _ in range(8):
+            _epoch_step(cores, names)
+        for core in cores:
+            c1 = core.metrics()["counters"]
+            assert c1["bytes_gathered"] + c1["bytes_broadcast"] == b0 \
+                if core is cores[0] else True
+            assert c1["epoch_locks"] == 1, c1
+        c1 = cores[0].metrics()["counters"]
+        assert c1["cycles"] == cyc0, (cyc0, c1["cycles"])
+        assert c1["bypass_cycles"] >= byp0 + 8, c1
+        assert c1["epoch_invalidations"] == 0, c1
+    finally:
+        _teardown(hub, cores)
+
+
+def test_epoch_bypass_responses_bit_exact_vs_negotiated():
+    """Replayed responses are BIT-EXACT the negotiated steady step's:
+    same batches, same order, same names and signatures, on every rank."""
+    hub, cores = _epoch_cores(k=4)
+    try:
+        names = [f"layer{i}/grad" for i in range(6)]
+        # negotiated phase: record the steady step's response sequence
+        negotiated = None
+        for _ in range(3):
+            seqs = _epoch_step(cores, names)
+            time.sleep(0.01)
+            assert seqs[0] == seqs[1], "ranks disagreed pre-lock"
+            negotiated = seqs[0]
+        assert _drive_to_lock(cores, names)
+        locked = cores[0].metrics()["counters"]["bypass_cycles"]
+        for _ in range(5):
+            seqs = _epoch_step(cores, names)
+            assert seqs[0] == negotiated, (seqs[0], negotiated)
+            assert seqs[1] == negotiated, (seqs[1], negotiated)
+        assert cores[0].metrics()["counters"]["bypass_cycles"] > locked
+    finally:
+        _teardown(hub, cores)
+
+
+def test_epoch_break_on_new_tensor_falls_back_and_relocks():
+    """A tensor outside the locked set breaks the epoch, renegotiates
+    through the full path, and the workload can re-lock afterwards."""
+    hub, cores = _epoch_cores(k=2)
+    try:
+        names = ["a", "b"]
+        assert _drive_to_lock(cores, names)
+        for c in cores:
+            c.submit("newcomer", "f32:8:sum", OP_ALLREDUCE, 32)
+        for c in cores:
+            r = c.wait(5.0)
+            assert r is not None and r.type == "OK", r
+            assert r.names == ["newcomer"], r
+        c0 = cores[0].metrics()["counters"]
+        assert c0["epoch_invalidations"] >= 1, c0
+        # the grown steady set stabilizes and locks again
+        grown = names + ["newcomer"]
+        for _ in range(30):
+            _epoch_step(cores, grown)
+            time.sleep(0.01)
+            if cores[0].metrics()["counters"]["epoch_locks"] >= 2:
+                break
+        assert cores[0].metrics()["counters"]["epoch_locks"] >= 2
+    finally:
+        _teardown(hub, cores)
+
+
+def test_epoch_break_on_signature_change():
+    """A locked-set name resubmitted with a NEW signature must break the
+    epoch and renegotiate — the new shape wins, exactly like the
+    bit-vector cache invalidation underneath."""
+    hub, cores = _epoch_cores(k=2)
+    try:
+        assert _drive_to_lock(cores, ["t"], sig="f32:4:sum", nbytes=16)
+        for c in cores:
+            c.submit("t", "f32:8:sum", OP_ALLREDUCE, 32)
+        for c in cores:
+            r = c.wait(5.0)
+            assert r is not None and r.type == "OK", r
+            assert r.sigs == ["f32:8:sum"], r
+        assert cores[0].metrics()["counters"]["epoch_invalidations"] >= 1
+    finally:
+        _teardown(hub, cores)
+
+
+def test_epoch_break_on_join():
+    """JOIN while locked breaks the epoch; the join protocol then runs
+    on the full path (joined rank auto-agrees, JOIN_DONE on all-join)."""
+    hub, cores = _epoch_cores(k=2)
+    try:
+        c0, c1 = cores
+        assert _drive_to_lock(cores, ["g"])
+        c1.join()
+        c0.submit("g", "f32:64:sum", OP_ALLREDUCE, 256)
+        r = c0.wait(5.0)
+        assert r is not None and r.type == "OK" and r.names == ["g"], r
+        c0.join()
+        r = c0.wait(5.0)
+        assert r is not None and r.type == "JOIN_DONE", r
+    finally:
+        _teardown(hub, cores)
+
+
+def test_epoch_partial_round_timeout_breaks_and_recovers():
+    """A replay round left partial past the break window (a tensor of
+    the locked set went missing) falls back to full negotiation: the
+    already-submitted member re-materializes via carry and completes."""
+    hub, cores = _epoch_cores(k=2)
+    try:
+        names = ["a", "b"]
+        assert _drive_to_lock(cores, names)
+        # Both ranks submit only 'a': the round can never complete.
+        for c in cores:
+            c.submit("a", "f32:64:sum", OP_ALLREDUCE, 256)
+        r0 = cores[0].wait(10.0)   # arrives after the ~1 s break window
+        r1 = cores[1].wait(10.0)
+        assert r0 is not None and r0.names == ["a"], r0
+        assert r1 is not None and r1.names == ["a"], r1
+        c = cores[0].metrics()["counters"]
+        assert c["epoch_invalidations"] >= 1, c
+    finally:
+        _teardown(hub, cores)
+
+
+def test_bypass_disabled_by_knob():
+    """HOROVOD_BYPASS=0: the bit-vector cache still serves steady steps
+    but no epoch ever locks and every step keeps its transport cycles."""
+    hub, cores = _epoch_cores(k=1, bypass="0")
+    try:
+        names = ["x", "y"]
+        for _ in range(10):
+            _epoch_step(cores, names)
+            time.sleep(0.005)
+        c = cores[0].metrics()["counters"]
+        assert c["epoch_locks"] == 0, c
+        assert c["bypass_cycles"] == 0, c
+        assert c["cache_hits"] > 0, c  # the layer below still works
+    finally:
+        _teardown(hub, cores)
+
+
+def test_epoch_trace_events():
+    """Trace-plane coverage: epoch.lock / epoch.invalidate instants and
+    cycle.bypass B/E spans land in the native ring (drained via
+    hvd_core_trace) so the merged timeline shows the fast path."""
+    hub, cores = _epoch_cores(k=2)
+    try:
+        for c in cores:
+            c.trace_enable()
+        names = ["t0", "t1"]
+        assert _drive_to_lock(cores, names)
+        for _ in range(3):
+            _epoch_step(cores, names)
+        for c in cores:
+            c.submit("breaker", "f32:8:sum", OP_ALLREDUCE, 32)
+        for c in cores:
+            assert c.wait(5.0) is not None
+        d = cores[0].trace_drain()
+        kinds = {(e[1], e[3]) for e in d["events"]}
+        assert ("i", "epoch.lock") in kinds, sorted(kinds)
+        assert ("i", "epoch.invalidate") in kinds, sorted(kinds)
+        assert ("B", "cycle.bypass") in kinds, sorted(kinds)
+        assert ("E", "cycle.bypass") in kinds, sorted(kinds)
+        # bypass spans carry the epoch (B) and the round size (E)
+        ends = [e for e in d["events"]
+                if e[1] == "E" and e[3] == "cycle.bypass"]
+        assert any(e[4] == len(names) for e in ends), ends
+    finally:
+        _teardown(hub, cores)
