@@ -122,20 +122,24 @@ class ChaosInjector:
                            duration_ms=e.duration_ms)
                 self._sleep(e.duration_ms / 1000.0)
 
-    def maybe_fail_kv(self, op: str) -> None:
+    def maybe_fail_kv(self, op: str, scope: str = "") -> None:
         """Rendezvous-KV fault hook (runner/http_client.py): raises
         ``URLError`` for the first ``count`` matching KV operations — a
         simulated blackout window the client's bounded retry must ride
-        through (or surface, if the window outlasts the budget)."""
+        through (or surface, if the window outlasts the budget).  An
+        event carrying a ``scope`` blacks out only that KV scope (e.g.
+        ``serve_plan`` — the serving plane's coordination channel)."""
         for e in self.spec.events:
             if e.kind != "kv_blackout" or not e.matches_rank(self.rank):
                 continue
             if e.op and e.op != op:
                 continue
+            if e.scope and e.scope != scope:
+                continue
             if self._kv_failed < e.count:
                 self._kv_failed += 1
                 self._count("kv_blackout")
-                self._mark("chaos.kv_blackout", op=op)
+                self._mark("chaos.kv_blackout", op=op, scope=scope)
                 import urllib.error
                 raise urllib.error.URLError(
                     f"chaos: injected KV blackout ({self._kv_failed}/"

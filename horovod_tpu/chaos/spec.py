@@ -23,6 +23,7 @@ YAML shape (both event spellings are accepted)::
       - kill: {rank: 1, step: 2, exit_code: 1}
       - stall: {rank: 1, point: negotiate, duration_ms: 30}
       - kv_blackout: {op: put, count: 2}
+      - kv_blackout: {op: get, scope: serve_plan, count: 3}
       - crash_commit: {rank: 0, step: 3, point: pre_marker}
       - {kind: stall, rank: 0, step: 4, duration_ms: 100}
 """
@@ -59,6 +60,8 @@ class ChaosEvent:
     point: str = ""           # stall: injection point (e.g. "negotiate");
                               # crash_commit: pre_marker | pre_manifest
     op: str = ""              # kv_blackout: put | get | "" (any)
+    scope: str = ""           # kv_blackout: restrict to one KV scope
+                              # (e.g. "serve_plan"); "" = every scope
 
     def matches_rank(self, rank: int) -> bool:
         return self.rank < 0 or self.rank == rank

@@ -124,6 +124,34 @@ _knob("HOROVOD_SERVE_CACHE_BLOCKS", 4096, int,
       "stalls (FCFS head-of-line) when a request's worst-case block "
       "need exceeds the free pool.  Must be positive; rejected at "
       "hvd.init().")
+_knob("HOROVOD_SERVE_JOURNAL", True, _parse_bool,
+      "Request journal + redrive (serve/journal.py; docs/serving.md): "
+      "the router journals every accepted request to the rendezvous KV "
+      "scope 'serve_journal'; after a serving-fleet reset the new rank "
+      "0 re-admits unfinished requests and deterministically replays "
+      "them past their already-streamed token prefix, so client ndjson "
+      "streams resume from the last token.  0 disables (degraded mode: "
+      "a reset drops in-flight requests — their streams time out).")
+_knob("HOROVOD_SERVE_DRAIN_TIMEOUT", 30.0, float,
+      "Graceful-drain budget in seconds (POST /admin/drain; "
+      "docs/serving.md): how long the router waits for the engine "
+      "fleet to finish in-flight requests and acknowledge the drain, "
+      "and how long rank 0 keeps serving in-flight work after the "
+      "drain signal before exiting anyway.  Must be positive; rejected "
+      "at hvd.init().")
+_knob("HOROVOD_SERVE_SHED_HIGH", 0, int,
+      "Load-shedding high watermark: pending (accepted, unfinished) "
+      "requests at or above this count are rejected with 429 + "
+      "Retry-After (derived from measured TPOT x queue depth) until "
+      "the low watermark is reached again.  0 = the router's "
+      "max_pending (the pre-shedding hard cap).  Must be >= 0 and >= "
+      "the low watermark; rejected at hvd.init().")
+_knob("HOROVOD_SERVE_SHED_LOW", 0, int,
+      "Load-shedding low watermark (hysteresis): once shedding, "
+      "admission resumes only when pending requests fall to this "
+      "count — avoids 429 flapping right at the high watermark.  0 = "
+      "derived (high - max(1, high/4)).  Must be >= 0; rejected at "
+      "hvd.init().")
 # --- autotune (reference: common.h:70-75) ---
 _knob("HOROVOD_AUTOTUNE", False, _parse_bool,
       "Enable Bayesian autotuning of fusion threshold and cycle time.")
@@ -224,6 +252,13 @@ _knob("HOROVOD_ELASTIC_TIMEOUT", 600, int,
       "Seconds to wait for the required number of slots in elastic mode.")
 _knob("HOROVOD_ELASTIC_RESET_LIMIT", 0, int,
       "Max elastic reset rounds before giving up (0 = unlimited).")
+_knob("HOROVOD_ELASTIC_ROUND", 0, int,
+      "Reset-round number the elastic driver stamps into every "
+      "worker's env (0 on the first round and under the static "
+      "launcher).  The serving plane uses it as the plan-stream epoch: "
+      "serve_plan keys are namespaced by it, so a restarted fleet can "
+      "never replay a stale plan from a previous incarnation "
+      "(docs/serving.md).")
 # --- TPU-native knobs (no reference equivalent) ---
 _knob("HOROVOD_TPU_MESH", "", str,
       "Mesh spec, e.g. 'data=8' or 'data=4,model=2' or 'dcn.data=2,ici.data=8'. "

@@ -90,6 +90,7 @@ class ElasticDriver:
         self.network_interface = network_interface
         self.prefix_output_with_timestamp = prefix_output_with_timestamp
         self._spawned_ranks: set = set()
+        self._round = 0  # reset-round number, exported to workers
 
         self.registry = WorkerStateRegistry()
         self.rendezvous = RendezvousServer(port=metrics_port or 0)
@@ -173,6 +174,10 @@ class ElasticDriver:
         updates["HOROVOD_RENDEZVOUS_ADDR"] = coord_host
         updates["HOROVOD_RENDEZVOUS_PORT"] = str(self.rdv_port)
         updates["HOROVOD_CONTROLLER_PORT"] = str(self.controller_port)
+        # Reset-round stamp: the serving plane fences its plan-stream
+        # epoch on it so a restarted fleet can never replay stale
+        # serve_plan keys (serve/worker.py; docs/serving.md).
+        updates["HOROVOD_ELASTIC_ROUND"] = str(self._round)
         if slot.size > 1:
             updates["HOROVOD_COORDINATOR_ADDR"] = \
                 f"{coord_host}:{self.coordinator_port}"
@@ -207,6 +212,28 @@ class ElasticDriver:
             join_output_pumps(p, timeout=2.0)
         self._procs.clear()
 
+    # --------------------------------------------------------- supervision
+    def _health_monitor(self):
+        """Per-round health supervision (the serve-plane remediation:
+        a wedged engine gets SIGABRT -> elastic restart instead of job
+        death).  Only armed when the heartbeat plane is on (hvdrun
+        --serve implies it); a fresh monitor per round because the
+        scope is cleared at every reset."""
+        enabled = (self.extra_env.get("HOROVOD_HEARTBEAT")
+                   or os.environ.get("HOROVOD_HEARTBEAT", ""))
+        if enabled in ("", "0", "false"):
+            return None
+        from ..utils.health import HealthMonitor, fleet_health
+        timeout = float(self.extra_env.get("HOROVOD_HEARTBEAT_TIMEOUT")
+                        or os.environ.get("HOROVOD_HEARTBEAT_TIMEOUT")
+                        or 10)
+        return HealthMonitor(
+            lambda: fleet_health(
+                self.rendezvous.scope_items("health"),
+                self.rendezvous.scope_receipt_times("health"),
+                stale_after=timeout),
+            timeout=timeout)
+
     # ------------------------------------------------------------------ run
     def run(self) -> int:
         """Reset-round loop (reference: driver.py run/reset +
@@ -225,6 +252,12 @@ class ElasticDriver:
                         not _is_local(s.hostname) for s in slots))
                 self._hosts_changed.clear()
                 self.registry.reset()
+                self._round = resets
+                # Round-scoped heartbeats: a dead incarnation's stale
+                # entries would read as instant heartbeat-loss for the
+                # ranks of the new round.
+                self.rendezvous.clear_scope("health")
+                health_mon = self._health_monitor()
                 log.info("elastic round %d: %d workers on %s", resets,
                          len(slots),
                          ",".join(h.hostname for h in hosts))
@@ -234,6 +267,20 @@ class ElasticDriver:
 
                 round_failed = False
                 while self._procs:
+                    if health_mon is not None:
+                        # Wedged-rank remediation: SIGABRT trips the
+                        # armed flight recorder, the nonzero exit below
+                        # classifies as a failure, and the reset round
+                        # restarts the fleet (docs/serving.md).
+                        for r, cause in health_mon.verdicts(
+                                list(self._procs)).items():
+                            p = self._procs.get(r)
+                            if p is not None and p.poll() is None:
+                                log.warning(
+                                    "elastic: rank %d %s beyond %.0fs — "
+                                    "SIGABRT for forensics, then reset",
+                                    r, cause, health_mon.timeout)
+                                p.send_signal(signal.SIGABRT)
                     done = [(r, p) for r, p in self._procs.items()
                             if p.poll() is not None]
                     for r, p in done:
@@ -301,7 +348,13 @@ def run_elastic(args, command: List[str]) -> int:
                                                           None) or 1)
     min_np = args.min_np or args.num_proc or 1
     max_np = args.max_np or args.num_proc or (1 << 30)
-    from ..runner.launch import args_to_env
+    from ..runner.launch import args_to_env, resolve_serve_port
+    # --serve pins the rendezvous (= router) port exactly like the
+    # static path; the driver's server survives reset rounds, so the
+    # journal, the in-flight client streams and the /generate front
+    # door all ride across fleet restarts (docs/serving.md).
+    pinned_port = (getattr(args, "metrics_port", None)
+                   or resolve_serve_port(args) or None)
     driver = ElasticDriver(
         discovery, min_np, max_np, command, env=args_to_env(args),
         elastic_timeout=args.elastic_timeout or
@@ -315,7 +368,13 @@ def run_elastic(args, command: List[str]) -> int:
         network_interface=getattr(args, "network_interface", None),
         prefix_output_with_timestamp=getattr(
             args, "prefix_output_with_timestamp", False),
-        metrics_port=getattr(args, "metrics_port", None))
+        metrics_port=pinned_port)
+    if getattr(args, "serve", None):
+        import socket
+        print(f"[hvdrun] elastic serving {args.serve}: POST http://"
+              f"{socket.gethostname()}:{driver.rdv_port}/generate  "
+              "(stats: GET /serve/stats, drain: POST /admin/drain, "
+              "metrics: GET /metrics)", file=sys.stderr, flush=True)
     # Chaos plane: the spec rides the driver's rendezvous KV so every
     # incarnation of every worker (reset rounds included) installs the
     # same seeded plan (runner/launch.py publish_chaos_spec).

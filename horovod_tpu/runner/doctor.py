@@ -20,12 +20,18 @@ first (straggler-bound / comm-bound / compute-bound / input-bound /
 stall-bound) with the per-rank step-time decomposition, model drift and
 top native ops behind it.
 
+``--serve`` renders the serving fleet's operational view (the
+``GET /serve/stats`` payload — docs/serving.md): admission counters,
+shed/drain state, journal depth and the engine's self-published stats —
+what an on-call reader checks when the fleet restarted mid-stream.
+
 Usage:
   hvdrun doctor /path/to/postmortem_dir
   hvdrun doctor /path/to/postmortem.json --events 40
   hvdrun doctor run_dir --json          # raw JSON for tooling
   hvdrun doctor --perf http://127.0.0.1:8080/perf
   hvdrun doctor --perf saved_perf.json
+  hvdrun doctor --serve http://127.0.0.1:9000/serve/stats
 """
 
 from __future__ import annotations
@@ -233,6 +239,71 @@ def render_perf(view: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# ----------------------------------------------------------- serve plane
+def load_serve_view(source: str) -> Dict[str, Any]:
+    """Resolve a ``--serve`` argument to the /serve/stats payload: an
+    http URL or bare host:port fetches the live route; anything else is
+    a saved JSON file."""
+    import json as _json
+    import os
+    import urllib.request
+    if source.startswith(("http://", "https://")) or (
+            ":" in source and not os.path.exists(source)
+            and "/" not in source):
+        url = source if source.startswith("http") else f"http://{source}"
+        if not url.rstrip("/").endswith("/serve/stats"):
+            url = url.rstrip("/") + "/serve/stats"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return _json.loads(resp.read())
+    with open(source) as f:
+        return _json.load(f)
+
+
+def render_serve(view: Dict[str, Any]) -> str:
+    """Operational rendering of one /serve/stats payload: admission
+    state first (can the fleet take traffic?), then durability (journal
+    depth = what a reset would replay), then the engine's utilization."""
+    lines: List[str] = []
+    router = view.get("router", {})
+    journal = view.get("journal", {})
+    engine = view.get("engine")
+    state = ("DRAINING" if router.get("draining")
+             else "SHEDDING" if router.get("pending", 0) >=
+             router.get("shed_high", 1 << 30)
+             else "ACCEPTING")
+    lines.append("== hvdrun doctor --serve: fleet front door ==")
+    lines.append(
+        f"ADMISSION: {state} — pending {router.get('pending', '?')} "
+        f"(shed high/low {router.get('shed_high', '?')}/"
+        f"{router.get('shed_low', '?')}, hard cap "
+        f"{router.get('max_pending', '?')})")
+    lines.append(
+        f"  lifetime: submitted {router.get('submitted', '?')}, "
+        f"completed {router.get('completed', '?')}, rejected "
+        f"{router.get('rejected', '?')} (shed {router.get('shed', '?')})")
+    jstate = ("on" if journal.get("enabled")
+              else "OFF (degraded: a fleet reset drops in-flight streams)")
+    lines.append(
+        f"JOURNAL: {jstate} — {journal.get('entries', '?')} entries; a "
+        "reset replays the unfinished ones "
+        "(docs/serving.md#fault-tolerance)")
+    if engine is None:
+        lines.append("ENGINE: no stats published — fleet starting, "
+                     "drained, or dead (check GET /health)")
+        return "\n".join(lines)
+    lines.append(
+        f"ENGINE: tick {engine.get('tick', '?')} — active "
+        f"{engine.get('active', '?')}, waiting "
+        f"{engine.get('waiting', '?')}, completed "
+        f"{engine.get('completed', '?')}, batch fill "
+        f"{engine.get('batch_fill', '?')}, free blocks "
+        f"{engine.get('free_blocks', '?')}")
+    lines.append(
+        f"  tokens: prefill {engine.get('tokens_prefill', '?')}, "
+        f"decode {engine.get('tokens_decode', '?')}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="hvdrun doctor",
@@ -249,9 +320,25 @@ def main(argv=None) -> int:
     ap.add_argument("--perf", action="store_true",
                     help="render the perf-attribution view instead of a "
                          "postmortem (docs/profiling.md)")
+    ap.add_argument("--serve", action="store_true",
+                    help="render the serving fleet's operational view "
+                         "(GET /serve/stats URL, host:port, or a saved "
+                         "JSON; docs/serving.md)")
     ap.add_argument("--json", action="store_true",
                     help="dump the raw JSON instead of the rendering")
     args = ap.parse_args(argv)
+    if args.serve:
+        try:
+            view = load_serve_view(args.path)
+        except Exception as e:
+            print(f"hvdrun doctor: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            json.dump(view, sys.stdout, indent=1)
+            print()
+        else:
+            print(render_serve(view))
+        return 0
     if args.perf:
         try:
             view = load_perf_view(args.path)

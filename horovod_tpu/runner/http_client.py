@@ -20,12 +20,12 @@ import urllib.request
 from typing import Optional
 
 
-def _chaos_kv(op: str) -> None:
+def _chaos_kv(op: str, scope: str = "") -> None:
     # Lazy import: chaos resolves its spec through this module's get_kv.
     from .. import chaos
     inj = chaos.active()
     if inj is not None:
-        inj.maybe_fail_kv(op)
+        inj.maybe_fail_kv(op, scope)
 
 
 def _retry_delays(retries: Optional[int]):
@@ -52,7 +52,7 @@ def put_kv(addr: str, port: int, scope: str, key: str,
     delays = _retry_delays(retries)
     for attempt in range(len(delays) + 1):
         try:
-            _chaos_kv("put")
+            _chaos_kv("put", scope)
             req = urllib.request.Request(url, data=value, method="PUT")
             with urllib.request.urlopen(req, timeout=10):
                 return
@@ -79,7 +79,7 @@ def get_kv(addr: str, port: int, scope: str, key: str,
     deadline = time.time() + timeout
     while True:
         try:
-            _chaos_kv("get")
+            _chaos_kv("get", scope)
             with urllib.request.urlopen(url, timeout=10) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
@@ -100,7 +100,7 @@ def delete_kv(addr: str, port: int, scope: str, key: str,
     delays = _retry_delays(retries)
     for attempt in range(len(delays) + 1):
         try:
-            _chaos_kv("put")  # a delete is a write for blackout purposes
+            _chaos_kv("put", scope)  # a delete is a write for blackouts
             req = urllib.request.Request(url, method="DELETE")
             with urllib.request.urlopen(req, timeout=10):
                 return True

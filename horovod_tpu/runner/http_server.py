@@ -38,11 +38,14 @@ the merged fleet view with the bottleneck verdict root-cause-first.
 The serving plane (docs/serving.md) adds the front door:
 
   * ``POST /generate`` enqueues a generation request onto the
-    ``serve_req`` scope and streams the engine fleet's tokens back as
-    ndjson (``horovod_tpu/serve/router.py`` — backpressure, sequence
+    ``serve_req`` scope (journaled to ``serve_journal`` for redrive)
+    and streams the engine fleet's tokens back as ndjson
+    (``horovod_tpu/serve/router.py`` — watermark shedding, sequence
     numbering, result streaming);
   * ``GET /serve/stats`` merges router counters with the engine's
-    self-published stats (scope ``serve`` key ``stats``).
+    self-published stats (scope ``serve`` key ``stats``);
+  * ``POST /admin/drain`` stops admission and gracefully drains the
+    engine fleet to a clean exit 0 (docs/serving.md#fault-tolerance).
 """
 
 from __future__ import annotations
@@ -92,6 +95,13 @@ class _KVHandler(BaseHTTPRequestHandler):
             # enqueue to the KV, stream the engine's tokens back.
             from ..serve import router as serve_router
             serve_router.handle_generate(self)
+            return
+        if scope == "admin" and key == "drain":
+            # Graceful serving drain (docs/serving.md#fault-tolerance):
+            # stop admission, let the engine fleet finish in-flight
+            # requests, exit 0 — the preemption-safe rolling restart.
+            from ..serve import router as serve_router
+            serve_router.handle_drain(self)
             return
         self.send_response(404)
         self.end_headers()

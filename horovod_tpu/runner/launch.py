@@ -785,7 +785,8 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
     if getattr(args, "serve", None):
         print(f"[hvdrun] serving {args.serve}: POST http://"
               f"{socket.gethostname()}:{rdv_port}/generate  (stats: "
-              f"GET /serve/stats, metrics: GET /metrics)",
+              f"GET /serve/stats, drain: POST /admin/drain, metrics: "
+              "GET /metrics)",
               file=sys.stderr, flush=True)
     publish_chaos_spec(args, rendezvous)
     for slot in slots:
@@ -984,11 +985,10 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                   f"the trailing command ({' '.join(command)})",
                   file=sys.stderr)
             return 2
-        if args.host_discovery_script or args.min_np or args.max_np:
-            print("hvdrun: --serve runs a static fleet; elastic flags "
-                  "(--min-np/--max-np/--host-discovery-script) are not "
-                  "supported with it", file=sys.stderr)
-            return 2
+        # With elastic flags, the serving fleet routes through the
+        # elastic driver: rank death / wedge / preemption trigger reset
+        # rounds, and the journal+redrive machinery resumes in-flight
+        # request streams across them (docs/serving.md#fault-tolerance).
         command = serve_worker_command(args)
     if not command:
         print("hvdrun: no training command given", file=sys.stderr)

@@ -33,6 +33,9 @@ _LAZY = {
     "save_servable": ("engine", "save_servable"),
     "load_servable": ("engine", "load_servable"),
     "FleetFrontend": ("worker", "FleetFrontend"),
+    "JOURNAL_SCOPE": ("journal", "JOURNAL_SCOPE"),
+    "redrive_plan": ("journal", "redrive_plan"),
+    "emitted_prefix": ("journal", "emitted_prefix"),
 }
 
 

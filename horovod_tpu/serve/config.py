@@ -78,7 +78,33 @@ def from_knobs(knobs: Any, **overrides: Any) -> ServeConfig:
     return cfg
 
 
+def _opt(knobs: Any, name: str, default: Any) -> Any:
+    """Knob lookup tolerant of partial mappings (tests validate with
+    plain dicts that predate the fault-tolerance knobs)."""
+    try:
+        return knobs[name]
+    except (KeyError, TypeError):
+        return default
+
+
 def validate_serve_knobs(knobs: Any) -> None:
     """Init-time validation contract (runtime.py): a bad HOROVOD_SERVE_*
     value must fail hvd.init(), not a serving tick hours later."""
     from_knobs(knobs)
+    drain = float(_opt(knobs, "HOROVOD_SERVE_DRAIN_TIMEOUT", 30.0))
+    if drain <= 0:
+        raise ValueError(
+            f"HOROVOD_SERVE_DRAIN_TIMEOUT={drain} invalid; the drain "
+            "budget must be positive seconds (docs/serving.md)")
+    high = int(_opt(knobs, "HOROVOD_SERVE_SHED_HIGH", 0))
+    low = int(_opt(knobs, "HOROVOD_SERVE_SHED_LOW", 0))
+    if high < 0 or low < 0:
+        raise ValueError(
+            f"HOROVOD_SERVE_SHED_HIGH={high} / HOROVOD_SERVE_SHED_LOW="
+            f"{low} invalid; shed watermarks must be >= 0 "
+            "(docs/serving.md)")
+    if high and low and low > high:
+        raise ValueError(
+            f"HOROVOD_SERVE_SHED_LOW={low} exceeds HOROVOD_SERVE_SHED_"
+            f"HIGH={high}; hysteresis needs low <= high "
+            "(docs/serving.md)")

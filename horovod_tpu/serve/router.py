@@ -9,32 +9,53 @@ publishes them (scope ``serve_out``).  ``GET /serve/stats`` merges the
 router's queue counters with the engine's self-published stats (scope
 ``serve`` key ``stats``).
 
-Backpressure: the router is the admission valve in front of the
-engine's own max_batch_tokens budget — beyond ``max_pending``
-unfinished requests it answers 429 immediately instead of growing an
-unbounded queue (tested in tests/test_serve.py).
+Fault tolerance (docs/serving.md#fault-tolerance):
+
+  * every ACCEPTED request is also journaled to scope ``serve_journal``
+    (serve/journal.py) so a fleet reset can redrive unfinished work —
+    the journal write shares the admission's kv_lock critical section,
+    so a journaled request and an enqueued request are the same set;
+  * admission is watermark-based with hysteresis: beyond the high
+    watermark requests are shed with 429 + a ``Retry-After`` header
+    derived from the measured per-request service time (TPOT x tokens,
+    EWMA) times the queue depth; admission resumes at the low watermark;
+  * ``POST /admin/drain`` stops admission (503), signals the engine
+    fleet through the KV (scope ``serve`` key ``drain``), and waits for
+    rank 0's ``drained`` ack — the fleet finishes every accepted
+    request, checkpoints its final stats, and exits 0 (the
+    preemption-safe rolling-restart path).
 
 The handler side runs inside runner/http_server.py's threaded server
 (one thread per in-flight stream — the async queue is the KV scope, the
 threads are just the drains), so the router needs no process of its
-own: ``hvdrun --serve`` gives the fleet a router for free.
+own: ``hvdrun --serve`` gives the fleet a router for free.  Stream
+reads and journal writes touch the IN-PROCESS kv dict (the router lives
+in the rendezvous server's process), so no KV transport error can kill
+a stream router-side; the worker-side KV legs carry the bounded
+exp-backoff retry (serve/worker.py ``_kv_op``).
 """
 
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from typing import Any, Dict, Optional
+
+from .journal import JOURNAL_SCOPE
 
 REQ_SCOPE = "serve_req"
 OUT_SCOPE = "serve_out"
 PLAN_SCOPE = "serve_plan"
 STATS_SCOPE = "serve"
 STATS_KEY = "stats"
+DRAIN_KEY = "drain"
+DRAINED_KEY = "drained"
 
 DEFAULT_MAX_PENDING = 64
 DEFAULT_STREAM_TIMEOUT_S = 120.0
+RETRY_AFTER_CAP_S = 60
 _POLL_S = 0.02
 
 
@@ -43,46 +64,121 @@ def req_key(seq: int) -> str:
 
 
 class RouterState:
-    """Router-side counters: submitted/completed/rejected + the dense
-    sequence numbering the engine fleet consumes in order."""
+    """Router-side admission state: submitted/completed/rejected
+    counters, the dense sequence numbering the engine fleet consumes in
+    order, watermark shedding with hysteresis, the drain latch, and the
+    service-time EWMA behind ``Retry-After``."""
 
     def __init__(self, max_pending: int = DEFAULT_MAX_PENDING,
-                 stream_timeout_s: float = DEFAULT_STREAM_TIMEOUT_S):
+                 stream_timeout_s: float = DEFAULT_STREAM_TIMEOUT_S,
+                 shed_high: Optional[int] = None,
+                 shed_low: Optional[int] = None,
+                 journal: bool = True):
         self.max_pending = int(max_pending)
         self.stream_timeout_s = float(stream_timeout_s)
+        self.shed_high = int(shed_high) if shed_high else self.max_pending
+        if shed_low:
+            self.shed_low = int(shed_low)
+        else:
+            self.shed_low = max(
+                0, self.shed_high - max(1, self.shed_high // 4))
+        self.journal = bool(journal)
         self._lock = threading.Lock()
         self.next_seq = 0
         self.completed = 0
         self.rejected = 0
+        self.shed = 0
+        self.draining = False
+        self.reject_reason: Optional[str] = None  # set by a None claim
+        self._shedding = False
+        self._service_ewma: Optional[float] = None  # s of decode/request
 
     def try_claim(self) -> Optional[int]:
-        """Next sequence number, or None under backpressure."""
+        """Next sequence number, or None under shedding/drain (the
+        reason lands in ``reject_reason`` for the status code)."""
+        from ..utils import metrics as M
         with self._lock:
-            if self.next_seq - self.completed >= self.max_pending:
+            if self.draining:
                 self.rejected += 1
+                self.reject_reason = "draining"
+                return None
+            pending = self.next_seq - self.completed
+            if self._shedding and pending <= self.shed_low:
+                self._shedding = False  # hysteresis: resume admission
+            if self._shedding or pending >= self.shed_high:
+                self._shedding = True
+                self.rejected += 1
+                self.shed += 1
+                self.reject_reason = "shed"
+                M.SERVE_SHEDS.inc()
                 return None
             seq = self.next_seq
             self.next_seq += 1
+            self.reject_reason = None
+            if self.journal:
+                M.SERVE_JOURNAL_DEPTH.set(self.next_seq - self.completed)
             return seq
 
     def finish_stream(self) -> None:
+        from ..utils import metrics as M
         with self._lock:
             self.completed += 1
+            if self.journal:
+                M.SERVE_JOURNAL_DEPTH.set(
+                    max(0, self.next_seq - self.completed))
 
-    def counters(self) -> Dict[str, int]:
+    def observe_done(self, tpot_s: Any, n_tokens: int) -> None:
+        """Feed one finished request's measured decode time into the
+        service-time EWMA (tpot x generated tokens) — the Retry-After
+        basis.  Bad/missing measurements are ignored."""
+        try:
+            svc = float(tpot_s) * max(1, int(n_tokens))
+        except (TypeError, ValueError):
+            return
+        if svc <= 0:
+            return
+        with self._lock:
+            if self._service_ewma is None:
+                self._service_ewma = svc
+            else:
+                self._service_ewma = 0.7 * self._service_ewma + 0.3 * svc
+
+    def retry_after_s(self) -> int:
+        """Client back-off hint for a shed: measured per-request service
+        time x queue depth, in whole seconds clamped to [1, 60].  With
+        no measurement yet, 1 — the cheapest honest answer."""
+        with self._lock:
+            pending = self.next_seq - self.completed
+            svc = self._service_ewma
+        if svc is None:
+            return 1
+        return int(min(RETRY_AFTER_CAP_S, max(1, math.ceil(pending * svc))))
+
+    def counters(self) -> Dict[str, Any]:
         with self._lock:
             return {"submitted": self.next_seq,
                     "completed": self.completed,
                     "rejected": self.rejected,
+                    "shed": self.shed,
                     "pending": self.next_seq - self.completed,
-                    "max_pending": self.max_pending}
+                    "max_pending": self.max_pending,
+                    "shed_high": self.shed_high,
+                    "shed_low": self.shed_low,
+                    "draining": self.draining,
+                    "journal": self.journal}
 
 
 def get_router_state(server) -> RouterState:
-    """Lazily attach one RouterState to the rendezvous HTTP server."""
+    """Lazily attach one RouterState to the rendezvous HTTP server,
+    configured from the knob registry (watermarks, journal switch)."""
     state = getattr(server, "serve_router", None)
     if state is None:
-        state = server.serve_router = RouterState()
+        from ..common.knobs import Knobs
+        knobs = Knobs()
+        state = server.serve_router = RouterState(
+            shed_high=int(knobs["HOROVOD_SERVE_SHED_HIGH"]) or None,
+            shed_low=int(knobs["HOROVOD_SERVE_SHED_LOW"]) or None,
+            journal=bool(knobs["HOROVOD_SERVE_JOURNAL"]))
     return state
 
 
@@ -110,10 +206,10 @@ def parse_generate_body(raw: bytes) -> Dict[str, Any]:
 
 
 def handle_generate(handler) -> None:
-    """POST /generate on the rendezvous server: enqueue to the KV, then
-    stream ndjson lines ({"tokens": [...]} parts, then {"done": ...})
-    as the engine publishes them.  Connection close delimits the body
-    (HTTP/1.0 semantics of the rendezvous server)."""
+    """POST /generate on the rendezvous server: journal + enqueue to the
+    KV, then stream ndjson lines ({"tokens": [...]} parts, then
+    {"done": ...}) as the engine publishes them.  Connection close
+    delimits the body (HTTP/1.0 semantics of the rendezvous server)."""
     server = handler.server
     state = get_router_state(server)
     length = int(handler.headers.get("Content-Length", 0))
@@ -125,31 +221,48 @@ def handle_generate(handler) -> None:
         return
     seq = state.try_claim()
     if seq is None:
-        _json_response(handler, 429, {
-            "error": "serving queue full",
-            **state.counters()})
+        if state.reject_reason == "draining":
+            _json_response(handler, 503, {
+                "error": "serving fleet is draining; retry against the "
+                         "next fleet",
+                **state.counters()})
+        else:
+            _json_response(handler, 429, {
+                "error": "serving queue full (load shed)",
+                **state.counters()},
+                extra_headers={"Retry-After":
+                               str(state.retry_after_s())})
         return
     key = req_key(seq)
     req["id"] = key
     req["submitted_t"] = time.time()
     try:
+        encoded = json.dumps(req).encode()
         with server.kv_lock:
-            server.kv.setdefault(REQ_SCOPE, {})[key] = \
-                json.dumps(req).encode()
-            server.kv_times.setdefault(REQ_SCOPE, {})[key] = time.time()
+            now = time.time()
+            server.kv.setdefault(REQ_SCOPE, {})[key] = encoded
+            server.kv_times.setdefault(REQ_SCOPE, {})[key] = now
+            if state.journal:
+                # Same critical section as the enqueue: the journaled
+                # set and the promised set cannot diverge.
+                server.kv.setdefault(JOURNAL_SCOPE, {})[key] = encoded
+                server.kv_times.setdefault(JOURNAL_SCOPE, {})[key] = now
         handler.send_response(200)
         handler.send_header("Content-Type", "application/x-ndjson")
         handler.send_header("X-Serve-Request-Id", key)
         handler.end_headers()
-        _stream_results(handler, server, key, state.stream_timeout_s)
+        _stream_results(handler, server, key, state)
     finally:
         state.finish_stream()
 
 
-def _stream_results(handler, server, key: str, timeout_s: float) -> None:
+def _stream_results(handler, server, key: str, state: RouterState) -> None:
     """Drain ``serve_out`` parts for one request to the client as they
-    arrive; ends with the ``.done`` record (or a timeout record)."""
-    deadline = time.time() + timeout_s
+    arrive; ends with the ``.done`` record (or a timeout record).  Reads
+    are in-process dict lookups — a fleet reset stalls the stream (no
+    new parts) without breaking it, and the redriven fleet's resumed
+    parts continue it seamlessly."""
+    deadline = time.time() + state.stream_timeout_s
     part = 0
     while True:
         with server.kv_lock:
@@ -164,13 +277,57 @@ def _stream_results(handler, server, key: str, timeout_s: float) -> None:
         if done is not None:
             handler.wfile.write(done + b"\n")
             handler.wfile.flush()
+            try:
+                rec = json.loads(done)
+                state.observe_done(rec.get("tpot_s"),
+                                   len(rec.get("tokens") or ()))
+            except (ValueError, TypeError):
+                pass  # a torn done record still ends the stream
             return
         if time.time() >= deadline:
             handler.wfile.write(json.dumps(
-                {"error": f"timed out after {timeout_s:.0f}s waiting for "
-                          f"{key}"}).encode() + b"\n")
+                {"error": f"timed out after {state.stream_timeout_s:.0f}s "
+                          f"waiting for {key}"}).encode() + b"\n")
             return
         time.sleep(_POLL_S)
+
+
+def handle_drain(handler) -> None:
+    """POST /admin/drain (docs/serving.md#fault-tolerance): stop
+    admission, signal the engine fleet (KV scope ``serve`` key
+    ``drain``), wait up to HOROVOD_SERVE_DRAIN_TIMEOUT for rank 0's
+    ``drained`` ack — the fleet finishes every accepted request first —
+    and report the outcome.  200 = drained clean (the workers exit 0);
+    504 = the fleet did not acknowledge within the budget."""
+    from ..common.knobs import Knobs
+    from ..utils import metrics as M
+    server = handler.server
+    state = get_router_state(server)
+    first = not state.draining
+    state.draining = True
+    if first:
+        M.SERVE_DRAINS.inc()
+    with server.kv_lock:
+        now = time.time()
+        server.kv.setdefault(STATS_SCOPE, {})[DRAIN_KEY] = \
+            json.dumps({"t": now}).encode()
+        server.kv_times.setdefault(STATS_SCOPE, {})[DRAIN_KEY] = now
+    deadline = time.time() + float(Knobs()["HOROVOD_SERVE_DRAIN_TIMEOUT"])
+    ack = None
+    while time.time() < deadline:
+        with server.kv_lock:
+            ack = server.kv.get(STATS_SCOPE, {}).get(DRAINED_KEY)
+        if ack is not None:
+            break
+        time.sleep(_POLL_S)
+    out: Dict[str, Any] = {"drained": ack is not None,
+                           "router": state.counters()}
+    if ack is not None:
+        try:
+            out["engine_final"] = json.loads(ack)
+        except (ValueError, TypeError):
+            pass  # a torn ack still proves the drain completed
+    _json_response(handler, 200 if ack is not None else 504, out)
 
 
 def render_stats(server) -> Dict[str, Any]:
@@ -180,6 +337,8 @@ def render_stats(server) -> Dict[str, Any]:
     out: Dict[str, Any] = {"router": state.counters()}
     with server.kv_lock:
         raw = server.kv.get(STATS_SCOPE, {}).get(STATS_KEY)
+        journal = len(server.kv.get(JOURNAL_SCOPE, {}))
+    out["journal"] = {"enabled": state.journal, "entries": journal}
     if raw is not None:
         try:
             out["engine"] = json.loads(raw)
@@ -188,10 +347,13 @@ def render_stats(server) -> Dict[str, Any]:
     return out
 
 
-def _json_response(handler, code: int, obj: Dict[str, Any]) -> None:
+def _json_response(handler, code: int, obj: Dict[str, Any],
+                   extra_headers: Optional[Dict[str, str]] = None) -> None:
     body = json.dumps(obj).encode()
     handler.send_response(code)
     handler.send_header("Content-Type", "application/json")
     handler.send_header("Content-Length", str(len(body)))
+    for k, v in (extra_headers or {}).items():
+        handler.send_header(k, v)
     handler.end_headers()
     handler.wfile.write(body)

@@ -7,9 +7,9 @@ trains.  Fleet coordination rides the existing rendezvous KV:
   * the router (runner/http_server.py + serve/router.py) enqueues
     requests with dense sequence numbers into scope ``serve_req``;
   * rank 0 drains them, publishes a per-tick PLAN (scope ``serve_plan``
-    key ``tick.N``) carrying the admitted requests verbatim, and every
-    rank — rank 0 included — applies the same plan to its own engine
-    copy.  Engine scheduling and sampling are deterministic
+    key ``e<epoch>.tick.N``) carrying the admitted requests verbatim,
+    and every rank — rank 0 included — applies the same plan to its own
+    engine copy.  Engine scheduling and sampling are deterministic
     (serve/engine.py), so the fleet stays in lockstep without any new
     transport: the plan stream is the only coordination channel, and it
     is the same KV the chaos/metrics/timeline planes already exercise;
@@ -18,11 +18,36 @@ trains.  Fleet coordination rides the existing rendezvous KV:
     a periodic engine-stats snapshot (scope ``serve`` key ``stats``)
     for ``GET /serve/stats``.
 
+Fault tolerance (docs/serving.md#fault-tolerance):
+
+  * **epoch fencing** — plan keys are namespaced by the elastic reset
+    round (HOROVOD_ELASTIC_ROUND -> ``epoch``), and every plan carries
+    its epoch in-band, so a restarted fleet can neither read nor replay
+    a stale ``serve_plan`` key from a previous incarnation;
+  * **redrive** — at bring-up, rank 0 scans the request journal
+    (serve/journal.py, scope ``serve_journal``) left by the previous
+    incarnation, re-admits every unfinished request through the FIRST
+    plan of the new epoch, and — greedy decode being deterministic —
+    suppresses re-publishing the token prefix the client already
+    received, so its ndjson stream resumes from the last token;
+  * **stall, don't die** — every worker-side KV leg rides a bounded
+    exp-backoff retry (``common/util.backoff_delays``), so a transient
+    rendezvous outage (chaos blackout, server restart) stalls the loop
+    instead of killing the fleet;
+  * **graceful drain** — the router's POST /admin/drain plants a drain
+    signal (scope ``serve`` key ``drain``); rank 0 stops admitting new
+    work, finishes everything accepted, publishes the ``drained`` ack
+    and stops the fleet with exit 0 (preemption-safe rolling restart);
+  * **serve-aware chaos** — the loop clocks ``hvd.chaos.step`` on the
+    ENGINE's work-tick counter (a spec kill lands mid-decode
+    deterministically) and exposes the ``serve_tick`` stall point.
+
 SLO observability is inherited, not added: the engine records
 hvd_serve_* metrics (published by MetricsPublisher to /metrics),
-per-request spans into the merged timeline, and
-``hvd.postmortem.record_step`` ticks so /health supervision sees a
-wedged engine exactly like a wedged train loop (docs/serving.md).
+per-request spans into the merged timeline, and the loop ticks
+``hvd.postmortem.record_step`` every iteration so /health supervision
+sees a wedged engine exactly like a wedged train loop — including an
+IDLE fleet, which must look alive, not stalled (docs/serving.md).
 """
 
 from __future__ import annotations
@@ -32,17 +57,24 @@ import json
 import os
 import sys
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
-from .router import (OUT_SCOPE, PLAN_SCOPE, REQ_SCOPE, STATS_KEY,
-                     STATS_SCOPE, req_key)
+from .router import (DRAIN_KEY, DRAINED_KEY, OUT_SCOPE, PLAN_SCOPE,
+                     REQ_SCOPE, STATS_KEY, STATS_SCOPE, req_key)
 
 _IDLE_SLEEP_S = 0.02
 _STATS_INTERVAL_S = 1.0
+# Serve-loop KV retry budget: wider than the http_client's own write
+# budget because a mid-stream outage should stall serving, not kill it
+# (the elastic driver would misread the death as a rank failure).
+_KV_RETRIES = 8
+_KV_BACKOFF_MS = 50.0
 
 
-def plan_key(tick: int) -> str:
-    return f"tick.{tick:09d}"
+def plan_key(tick: int, epoch: int = 0) -> str:
+    """Epoch-namespaced plan key: a reset bumps the epoch, so the new
+    fleet's key space is disjoint from every stale plan (fencing)."""
+    return f"e{epoch:04d}.tick.{tick:09d}"
 
 
 class FleetFrontend:
@@ -51,17 +83,23 @@ class FleetFrontend:
     only — the bench/load-generator path)."""
 
     def __init__(self, engine, addr: str, port: int, rank: int,
-                 nprocs: int, plan_timeout_s: float = 120.0):
+                 nprocs: int, plan_timeout_s: float = 120.0,
+                 epoch: int = 0, journal: bool = True,
+                 drain_timeout_s: float = 30.0):
         self.engine = engine
         self.addr = addr
         self.port = int(port or 0)
         self.rank = int(rank)
         self.nprocs = int(nprocs)
         self.plan_timeout_s = float(plan_timeout_s)
+        self.epoch = int(epoch)
+        self.journal = bool(journal)
+        self.drain_timeout_s = float(drain_timeout_s)
         self.tick = 0
         self._next_seq = 0
         self._parts: Dict[str, int] = {}
         self._results: Dict[str, List[int]] = {}
+        self._suppress: Dict[str, int] = {}  # rid -> tokens NOT to re-publish
         self._last_stats = 0.0
 
     # ------------------------------------------------------------ KV I/O
@@ -69,14 +107,42 @@ class FleetFrontend:
         from ..runner import http_client
         return http_client
 
+    def _kv_op(self, fn: Callable[[], Any], what: str) -> Any:
+        """Bounded exp-backoff retry (common/util.backoff_delays) around
+        one KV leg: a transient rendezvous outage mid-serve must stall
+        the loop, not kill the worker.  Non-transient errors and an
+        exhausted budget still raise — an unreachable fleet is a real
+        failure, and the elastic driver owns it from there."""
+        from ..common.util import backoff_delays
+        from ..runner.http_client import _transient
+        delays = backoff_delays(_KV_RETRIES, _KV_BACKOFF_MS)
+        for attempt in range(len(delays) + 1):
+            try:
+                return fn()
+            except Exception as e:
+                if attempt >= len(delays) or not _transient(e):
+                    raise
+                time.sleep(delays[attempt])
+
+    def _kv_get(self, scope: str, key: str, timeout: float = 0):
+        kv = self._kv()
+        return self._kv_op(
+            lambda: kv.get_kv(self.addr, self.port, scope, key,
+                              timeout=timeout),
+            f"get {scope}/{key}")
+
+    def _kv_put(self, scope: str, key: str, value: bytes) -> None:
+        kv = self._kv()
+        self._kv_op(
+            lambda: kv.put_kv(self.addr, self.port, scope, key, value),
+            f"put {scope}/{key}")
+
     def _drain_requests(self) -> List[Dict[str, Any]]:
         """Rank 0: consume newly-arrived requests in sequence order
         (dense router numbering -> nonblocking probes, no listing)."""
         reqs = []
-        kv = self._kv()
         while True:
-            raw = kv.get_kv(self.addr, self.port, REQ_SCOPE,
-                            req_key(self._next_seq), timeout=0)
+            raw = self._kv_get(REQ_SCOPE, req_key(self._next_seq))
             if raw is None:
                 return reqs
             try:
@@ -87,66 +153,177 @@ class FleetFrontend:
 
     def _publish_plan(self, reqs: List[Dict[str, Any]],
                       stop: bool = False) -> None:
-        self._kv().put_kv(self.addr, self.port, PLAN_SCOPE,
-                          plan_key(self.tick),
-                          json.dumps({"tick": self.tick, "stop": stop,
-                                      "reqs": reqs}).encode())
+        self._kv_put(PLAN_SCOPE, plan_key(self.tick, self.epoch),
+                     json.dumps({"tick": self.tick, "epoch": self.epoch,
+                                 "stop": stop, "reqs": reqs}).encode())
 
     def _fetch_plan(self) -> Dict[str, Any]:
         raw = self._kv().get_kv(self.addr, self.port, PLAN_SCOPE,
-                                plan_key(self.tick),
+                                plan_key(self.tick, self.epoch),
                                 timeout=self.plan_timeout_s)
         if raw is None:
             raise TimeoutError(
-                f"rank {self.rank}: no plan {plan_key(self.tick)} after "
+                f"rank {self.rank}: no plan "
+                f"{plan_key(self.tick, self.epoch)} after "
                 f"{self.plan_timeout_s:.0f}s — rank 0 gone?")
-        return json.loads(raw)
+        plan = json.loads(raw)
+        if int(plan.get("epoch", -1)) != self.epoch:
+            # Belt-and-braces under the key namespace: a plan from
+            # another incarnation must never drive this engine.
+            raise ValueError(
+                f"rank {self.rank}: stale plan epoch "
+                f"{plan.get('epoch')!r} != {self.epoch} — refusing to "
+                "replay a previous incarnation's plan stream")
+        return plan
+
+    # ----------------------------------------------------------- redrive
+    def resume_from_kv(self) -> List[Dict[str, Any]]:
+        """Rank 0 at bring-up: resume the request stream a previous
+        incarnation left behind.  With the journal on, returns the
+        redrive list (unfinished requests annotated with their already-
+        streamed prefix) and fast-forwards the request cursor past every
+        journaled sequence number; with it off (degraded mode), only
+        fast-forwards — orphaned streams time out at the router."""
+        if not self.journal:
+            seq = 0
+            while self._kv_get(REQ_SCOPE, req_key(seq)) is not None:
+                seq += 1
+            self._next_seq = seq
+            return []
+        from .journal import redrive_plan
+        entries, seq = redrive_plan(
+            lambda scope, key: self._kv_get(scope, key))
+        self._next_seq = seq
+        if entries and self.epoch > 0:
+            # Epoch 0 is first bring-up: journal entries there are just
+            # requests accepted before the fleet was ready, not replays.
+            from ..utils import metrics as M
+            M.SERVE_REDRIVES.inc(len(entries))
+            print(f"[hvd.serve] rank 0 epoch {self.epoch}: redriving "
+                  f"{len(entries)} journaled request(s) "
+                  f"({sum(len(e['resume_emitted']) for e in entries)} "
+                  "already-streamed tokens suppressed)", flush=True)
+        return entries
+
+    def _apply_resume(self, r: Dict[str, Any]) -> None:
+        """Seed rank 0's publisher state for one redriven request: the
+        emitted prefix is already on the client's wire, so publishing
+        resumes at the next part with the regenerated suffix only."""
+        emitted = r.get("resume_emitted")
+        rid = r.get("id")
+        if emitted is None or not rid:
+            return
+        self._results[rid] = [int(t) for t in emitted]
+        self._parts[rid] = int(r.get("resume_part", 0))
+        self._suppress[rid] = len(emitted)
 
     # ----------------------------------------------------------- outputs
     def _publish_report(self, report: Dict[str, Any]) -> None:
-        kv = self._kv()
         for rid, toks in report["emitted"].items():
+            skip = self._suppress.get(rid, 0)
+            if skip:
+                # Redriven request: these tokens were streamed by the
+                # previous incarnation (deterministic replay regenerates
+                # them identically) — consume the suppression budget
+                # instead of re-publishing.
+                take = min(skip, len(toks))
+                if take < skip:
+                    self._suppress[rid] = skip - take
+                else:
+                    self._suppress.pop(rid, None)
+                toks = toks[take:]
+            if not toks:
+                continue
             self._results.setdefault(rid, []).extend(toks)
             part = self._parts.get(rid, 0)
-            kv.put_kv(self.addr, self.port, OUT_SCOPE,
-                      f"{rid}.part.{part:06d}",
-                      json.dumps({"tokens": toks}).encode())
+            self._kv_put(OUT_SCOPE, f"{rid}.part.{part:06d}",
+                         json.dumps({"tokens": toks}).encode())
             self._parts[rid] = part + 1
         for req in report["finished"]:
-            kv.put_kv(self.addr, self.port, OUT_SCOPE,
-                      f"{req.req_id}.done",
-                      json.dumps({
-                          "done": True,
-                          "tokens": self._results.pop(req.req_id, []),
-                          "finish_reason": req.finish_reason,
-                          "ttft_s": req.ttft(),
-                          "tpot_s": req.tpot(),
-                      }).encode())
+            self._kv_put(OUT_SCOPE, f"{req.req_id}.done",
+                         json.dumps({
+                             "done": True,
+                             "tokens": self._results.pop(req.req_id, []),
+                             "finish_reason": req.finish_reason,
+                             "ttft_s": req.ttft(),
+                             "tpot_s": req.tpot(),
+                         }).encode())
             self._parts.pop(req.req_id, None)
+            self._suppress.pop(req.req_id, None)
 
     def _publish_stats(self, force: bool = False) -> None:
         now = time.monotonic()
         if not force and now - self._last_stats < _STATS_INTERVAL_S:
             return
         self._last_stats = now
-        self._kv().put_kv(self.addr, self.port, STATS_SCOPE, STATS_KEY,
-                          json.dumps(self.engine.stats()).encode())
+        try:
+            self._kv_put(STATS_SCOPE, STATS_KEY,
+                         json.dumps(self.engine.stats()).encode())
+        except Exception:
+            if force:
+                raise
+            # periodic stats are best-effort; the next tick retries
+
+    # ------------------------------------------------------------- drain
+    def _drain_requested(self) -> bool:
+        return self._kv_get(STATS_SCOPE, DRAIN_KEY) is not None
+
+    def _publish_drained(self) -> None:
+        """The ack POST /admin/drain waits on: final engine stats plus
+        the completed count, written once everything accepted is done."""
+        payload = dict(self.engine.stats(), epoch=self.epoch,
+                       t=time.time())
+        self._kv_put(STATS_SCOPE, DRAINED_KEY,
+                     json.dumps(payload).encode())
 
     # -------------------------------------------------------------- loop
     def run(self, ttl_s: float = 0.0) -> int:
-        """Serve until ``ttl_s`` elapses (0 = until interrupted).  Rank 0
-        paces the fleet; followers block on the plan stream."""
+        """Serve until ``ttl_s`` elapses (0 = until interrupted), or a
+        drain completes.  Rank 0 paces the fleet; followers block on the
+        plan stream."""
+        from .. import chaos as _chaos
+        from .. import postmortem as PM
         fleet = self.nprocs > 1 and bool(self.addr and self.port)
         solo_kv = self.nprocs == 1 and bool(self.addr and self.port)
+        kv_backed = fleet or solo_kv
+        carry: List[Dict[str, Any]] = []
+        if self.rank == 0 and kv_backed:
+            carry = self.resume_from_kv()
         t0 = time.monotonic()
         stop = False
+        drain_t: Optional[float] = None
         try:
             while True:
+                # Loop liveness for /health supervision: an IDLE fleet
+                # must look alive; only a wedged loop/engine freezes it.
+                PM.record_step(self.tick)
+                _chaos.maybe_stall("serve_tick")
                 if self.rank == 0:
-                    reqs = self._drain_requests() if (fleet or solo_kv) \
-                        else []
-                    stop = bool(ttl_s and time.monotonic() - t0 >= ttl_s
+                    if drain_t is None and kv_backed and \
+                            self._drain_requested():
+                        drain_t = time.monotonic()
+                        print(f"[hvd.serve] rank 0: drain requested — "
+                              "finishing in-flight work", flush=True)
+                    reqs = self._drain_requests() if kv_backed else []
+                    if carry:
+                        reqs = carry + reqs
+                        carry = []
+                    done_serving = (
+                        (bool(ttl_s)
+                         and time.monotonic() - t0 >= ttl_s)
+                        or drain_t is not None)
+                    stop = bool(done_serving and not reqs
                                 and not self.engine.has_work())
+                    if drain_t is not None and not stop and \
+                            time.monotonic() - drain_t >= \
+                            self.drain_timeout_s:
+                        # Degraded drain: the budget beats completeness
+                        # so a preemption deadline is never missed.
+                        print("[hvd.serve] rank 0: drain budget "
+                              f"({self.drain_timeout_s:.0f}s) exhausted "
+                              "with work in flight — stopping anyway",
+                              flush=True)
+                        stop = True
                     if fleet:
                         self._publish_plan(reqs, stop=stop)
                 else:
@@ -158,6 +335,8 @@ class FleetFrontend:
                 for r in reqs:
                     if r is None:
                         continue
+                    if self.rank == 0 and kv_backed:
+                        self._apply_resume(r)
                     try:
                         self.engine.submit(r["tokens"],
                                            r["max_new_tokens"],
@@ -166,15 +345,18 @@ class FleetFrontend:
                     except ValueError as e:
                         # invalid per the engine's limits: answer it so
                         # the router stream doesn't hang to timeout
-                        if self.rank == 0 and r.get("id") and \
-                                (fleet or solo_kv):
-                            self._kv().put_kv(
-                                self.addr, self.port, OUT_SCOPE,
-                                f"{r['id']}.done",
+                        if self.rank == 0 and r.get("id") and kv_backed:
+                            self._kv_put(
+                                OUT_SCOPE, f"{r['id']}.done",
                                 json.dumps({"done": True, "tokens": [],
                                             "error": str(e)}).encode())
+                # Chaos step clock = the ENGINE's work-tick counter: it
+                # advances only when the fleet is decoding/prefilling,
+                # so a spec kill at step K lands mid-stream
+                # deterministically (docs/chaos.md).
+                _chaos.step(self.engine.tick)
                 report = self.engine.step()
-                if self.rank == 0 and (fleet or solo_kv):
+                if self.rank == 0 and kv_backed:
                     self._publish_report(report)
                     self._publish_stats()
                 if not self.engine.has_work() and not reqs:
@@ -188,8 +370,10 @@ class FleetFrontend:
                 except Exception:
                     pass
             raise
-        if self.rank == 0 and (fleet or solo_kv):
+        if self.rank == 0 and kv_backed:
             self._publish_stats(force=True)
+            if drain_t is not None:
+                self._publish_drained()
         return 0
 
 
@@ -244,12 +428,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         import dataclasses
         scfg = dataclasses.replace(scfg, max_seq_len=model_cfg.max_seq)
     engine = ServeEngine(model, model_cfg, params, scfg, mesh=hvd.mesh())
+    epoch = int(rt.knobs["HOROVOD_ELASTIC_ROUND"])
     frontend = FleetFrontend(
         engine,
         rt.knobs["HOROVOD_RENDEZVOUS_ADDR"],
         rt.knobs["HOROVOD_RENDEZVOUS_PORT"],
-        hvd.process_rank(), hvd.process_size())
-    print(f"SERVE-READY rank {hvd.process_rank()} "
+        hvd.process_rank(), hvd.process_size(),
+        epoch=epoch,
+        journal=bool(rt.knobs["HOROVOD_SERVE_JOURNAL"]),
+        drain_timeout_s=float(rt.knobs["HOROVOD_SERVE_DRAIN_TIMEOUT"]))
+    print(f"SERVE-READY rank {hvd.process_rank()} epoch {epoch} "
           f"({type(model_cfg).__name__}, slots={scfg.max_slots}, "
           f"blocks={scfg.cache_blocks}x{scfg.block_size})", flush=True)
     if hvd.process_rank() == 0 and frontend.addr and frontend.port:

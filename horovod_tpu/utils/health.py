@@ -189,6 +189,18 @@ class HealthMonitor:
     """Launcher-side supervision verdicts from the fleet's heartbeats
     (hvdrun --postmortem; docs/postmortem.md).
 
+    Two consumers act on the verdicts: the static launcher SIGABRTs the
+    rank and lets the job die with forensics (runner/launch.py), while
+    the elastic driver SIGABRTs and then RESETS the fleet — for a
+    serving fleet a wedged engine means an elastic restart, not job
+    death, and the request journal redrives what was in flight
+    (elastic/driver.py; docs/serving.md#fault-tolerance).  The monitor
+    is round-scoped there: the driver clears the ``health`` KV scope at
+    every reset and builds a fresh monitor, so a dead incarnation's
+    stale heartbeats never read as losses.  Serving workers tick
+    :func:`record_step` every loop iteration (idle included), so an
+    idle fleet looks alive and only a genuinely frozen loop stalls.
+
     Two failure modes, judged per check against ``timeout`` seconds:
 
       * **heartbeat-lost** — a rank that heartbeated before has gone

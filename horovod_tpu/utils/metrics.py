@@ -380,6 +380,25 @@ SERVE_TOKENS = REGISTRY.counter(
     "hvd_serve_tokens_total",
     "Tokens processed by the serving engine, by phase "
     "(prefill = prompt tokens cached, decode = tokens generated).")
+# Fault-tolerant serving (serve/journal.py, docs/serving.md): journaled
+# requests re-admitted after a fleet reset, watermark load sheds, and
+# graceful drains — the robustness half of the serving SLO story.
+SERVE_REDRIVES = REGISTRY.counter(
+    "hvd_serve_redrives_total",
+    "Journaled requests re-admitted and deterministically replayed "
+    "past their emitted token prefix after a serving-fleet reset.")
+SERVE_SHEDS = REGISTRY.counter(
+    "hvd_serve_sheds_total",
+    "Requests rejected by watermark load shedding (429 + Retry-After "
+    "derived from measured TPOT x queue depth).")
+SERVE_DRAINS = REGISTRY.counter(
+    "hvd_serve_drains_total",
+    "Graceful drains initiated via POST /admin/drain (admission stops, "
+    "in-flight requests finish, the fleet exits 0).")
+SERVE_JOURNAL_DEPTH = REGISTRY.gauge(
+    "hvd_serve_journal_depth",
+    "Accepted requests journaled for redrive and not yet finished "
+    "(what a fleet reset would have to replay right now).")
 
 # Perf-attribution plane (horovod_tpu/perf/; docs/profiling.md).  The
 # step-time decomposition ledger records here: measured step times, the

@@ -74,7 +74,7 @@ SUITES = {
         "tests/test_elastic.py", "tests/test_tune.py",
         "tests/test_platform_utils.py",
     ],
-    "serving": ["tests/test_serve.py"],
+    "serving": ["tests/test_serve.py", "tests/test_serve_ft.py"],
     "perf": ["tests/test_perf.py"],
     "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py",
                        "tests/test_profile_analyzer.py"],
@@ -101,6 +101,11 @@ KNOB_DIMS = [
      ["jax-core"]),
     ("tf-join", {"HOROVOD_TF_JOIN": "1"},
      ["tensorflow-keras"]),
+    # serve-redrive off = degraded mode: the router stops journaling,
+    # redrive fast-forwards instead of replaying — the serving suite
+    # must stay green either way (docs/serving.md#fault-tolerance).
+    ("serve-journal-off", {"HOROVOD_SERVE_JOURNAL": "0"},
+     ["serving"]),
 ]
 
 
@@ -173,6 +178,19 @@ def build_steps():
         f"{py} -m pytest tests/integration/test_serve_integration.py "
         f"{full}",
         env={"JAX_PLATFORMS": "cpu"}, timeout=20))
+    steps.append(_step(
+        # elastic-serve chaos smoke: the fault-tolerant serving
+        # acceptance experiment — a 2-proc fleet under the elastic
+        # serve driver has rank 1 chaos-killed MID-DECODE; the fleet
+        # resets, journaled requests redrive past their streamed
+        # prefix, every client stream completes byte-identical to an
+        # unfaulted fleet's, and POST /admin/drain exits both fleets 0
+        # (docs/serving.md#fault-tolerance).  Bounded runtime: tiny
+        # model, 2 requests, loopback only.
+        "chaos: elastic-serve kill-mid-stream smoke",
+        f"{py} -m pytest "
+        f"tests/integration/test_elastic_serve_integration.py {full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=25))
     steps.append(_step(
         # perf-attribution smoke: a 2-process CPU-virtual fleet records
         # steps through the decomposition ledger; the components sum to

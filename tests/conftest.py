@@ -143,6 +143,13 @@ _SLOW_TESTS = {  # file::test (param ids stripped), >= ~8 s measured
         # every pipeline.
         "test_hvdrun_serve_end_to_end",
     },
+    "test_elastic_serve_integration.py": {
+        # ~2 fleets x (bring-up + reset round): the ISSUE-10 chaos
+        # acceptance experiment; the CI elastic-serve smoke leg (-m "")
+        # runs it on every pipeline, and the fast tier keeps the
+        # jax-free redrive/fencing/drain coverage (tests/test_serve_ft).
+        "test_elastic_serve_kill_mid_stream_redrives_and_drains",
+    },
     "test_tune.py": {
         "test_distributed_trainable_forwards_worker_reports",
         "test_distributed_trainable_runs_workers",

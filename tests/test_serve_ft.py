@@ -1,0 +1,507 @@
+"""Fault-tolerant serving (docs/serving.md#fault-tolerance): the request
+journal's round-trip + redrive determinism, reset-epoch plan fencing,
+watermark shedding with hysteresis + Retry-After math, graceful-drain
+semantics, scope-filtered chaos KV blackouts, and the serve loop's
+stall-don't-die KV retry.  Deliberately jax-free: everything here is
+host-side router/frontend machinery driven through the real rendezvous
+HTTP server with a scripted deterministic engine."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import horovod_tpu.serve.worker as worker_mod
+from horovod_tpu.serve.journal import (JOURNAL_SCOPE, emitted_prefix,
+                                       redrive_plan)
+from horovod_tpu.serve.router import (DRAIN_KEY, DRAINED_KEY, OUT_SCOPE,
+                                      PLAN_SCOPE, REQ_SCOPE, STATS_SCOPE,
+                                      RouterState, req_key)
+from horovod_tpu.serve.worker import FleetFrontend, plan_key
+from horovod_tpu.utils import metrics as M
+
+
+# ------------------------------------------------------- scripted engine
+class _DoneReq:
+    def __init__(self, rid):
+        self.req_id = rid
+        self.finish_reason = "completed"
+
+    def ttft(self):
+        return 0.01
+
+    def tpot(self):
+        return 0.002
+
+
+def scripted_tokens(prompt, n):
+    """The deterministic 'generation' both incarnations of the scripted
+    engine produce — the greedy-decode determinism stand-in."""
+    base = sum(int(t) for t in prompt)
+    return [(base + i) % 1000 for i in range(n)]
+
+
+class ScriptedEngine:
+    """Engine stub with the FleetFrontend contract (submit/step/
+    has_work/stats/tick): emits ONE token per active request per step,
+    deterministically derived from the prompt — a fresh instance
+    replays the identical stream, like greedy decode over a fixed
+    checkpoint."""
+
+    def __init__(self):
+        self.tick = 0
+        self.active = {}
+        self.completed = 0
+
+    def submit(self, tokens, max_new_tokens, req_id=None, eos_id=None):
+        self.active[req_id] = scripted_tokens(tokens, max_new_tokens)
+
+    def has_work(self):
+        return bool(self.active)
+
+    def step(self):
+        emitted, finished = {}, []
+        for rid in sorted(self.active):
+            emitted[rid] = [self.active[rid].pop(0)]
+            if not self.active[rid]:
+                del self.active[rid]
+                finished.append(_DoneReq(rid))
+                self.completed += 1
+        if emitted:
+            self.tick += 1
+        return {"tick": self.tick, "processed": len(emitted),
+                "emitted": emitted, "finished": finished}
+
+    def stats(self):
+        return {"tick": self.tick, "completed": self.completed,
+                "active": len(self.active)}
+
+
+@pytest.fixture()
+def rendezvous():
+    from horovod_tpu.runner.http_server import RendezvousServer
+    server = RendezvousServer(host="127.0.0.1")
+    port = server.start()
+    yield server, server._httpd, port
+    server.stop()
+
+
+def _post(port, path, body, timeout=10):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _counter_total(counter):
+    return sum(s["value"] for s in counter.to_family()["samples"])
+
+
+# ---------------------------------------------------- shedding + backoff
+def test_watermark_shed_hysteresis():
+    """Beyond the high watermark admission sheds; it resumes only at the
+    low watermark (hysteresis — no 429 flapping at the boundary)."""
+    sheds0 = _counter_total(M.SERVE_SHEDS)
+    st = RouterState(max_pending=8, shed_high=4, shed_low=2)
+    assert [st.try_claim() for _ in range(4)] == [0, 1, 2, 3]
+    assert st.try_claim() is None and st.reject_reason == "shed"
+    st.finish_stream()  # pending 3 > low: still shedding
+    assert st.try_claim() is None
+    st.finish_stream()  # pending 2 <= low: admission resumes
+    assert st.try_claim() == 4
+    c = st.counters()
+    assert c["shed"] == 2 and c["rejected"] == 2
+    assert c["shed_high"] == 4 and c["shed_low"] == 2
+    assert _counter_total(M.SERVE_SHEDS) == sheds0 + 2
+
+
+def test_shed_watermarks_default_to_max_pending():
+    st = RouterState(max_pending=8)
+    assert st.shed_high == 8 and st.shed_low == 6
+    st0 = RouterState(max_pending=2)
+    assert st0.shed_high == 2 and st0.shed_low == 1
+
+
+def test_retry_after_math():
+    """Retry-After = measured per-request service time (TPOT x tokens,
+    EWMA) x queue depth, whole seconds clamped to [1, 60]."""
+    st = RouterState(max_pending=64)
+    assert st.retry_after_s() == 1  # no measurement yet: cheapest honest
+    st.observe_done(0.5, 4)        # 2 s of decode per request
+    for _ in range(5):
+        st.try_claim()
+    assert st.retry_after_s() == 10  # 5 pending x 2 s
+    st.observe_done(None, 3)       # unmeasured done: ignored
+    assert st.retry_after_s() == 10
+    st.observe_done(10.0, 100)     # pathological spike: EWMA then clamp
+    assert st.retry_after_s() == 60
+
+
+def test_429_carries_retry_after_header(rendezvous):
+    server, httpd, port = rendezvous
+    httpd.serve_router = RouterState(max_pending=0)
+    httpd.serve_router.observe_done(0.5, 4)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(port, "generate", {"tokens": [1]})
+    assert exc.value.code == 429
+    assert int(exc.value.headers["Retry-After"]) >= 1
+    body = json.loads(exc.value.read())
+    assert "queue full" in body["error"] and body["shed"] == 1
+
+
+def test_journal_knob_validation():
+    from horovod_tpu.serve.config import validate_serve_knobs
+    good = {"HOROVOD_SERVE_PORT": 0,
+            "HOROVOD_SERVE_MAX_BATCH_TOKENS": 2048,
+            "HOROVOD_SERVE_MAX_SEQ_LEN": 2048,
+            "HOROVOD_SERVE_CACHE_BLOCKS": 4096}
+    validate_serve_knobs(good)  # partial mapping: FT knobs default
+    with pytest.raises(ValueError, match="DRAIN_TIMEOUT"):
+        validate_serve_knobs(dict(good, HOROVOD_SERVE_DRAIN_TIMEOUT=0))
+    with pytest.raises(ValueError, match="SHED"):
+        validate_serve_knobs(dict(good, HOROVOD_SERVE_SHED_HIGH=2,
+                                  HOROVOD_SERVE_SHED_LOW=5))
+    with pytest.raises(ValueError, match="SHED"):
+        validate_serve_knobs(dict(good, HOROVOD_SERVE_SHED_HIGH=-1))
+    for name in ("HOROVOD_SERVE_JOURNAL", "HOROVOD_SERVE_DRAIN_TIMEOUT",
+                 "HOROVOD_SERVE_SHED_HIGH", "HOROVOD_SERVE_SHED_LOW",
+                 "HOROVOD_ELASTIC_ROUND"):
+        from horovod_tpu.common.knobs import KNOBS
+        assert name in KNOBS, name
+
+
+# ------------------------------------------------------------ journaling
+def test_generate_journals_accepted_requests(rendezvous):
+    """Every accepted /generate lands in the journal scope with the
+    request payload, in the same critical section as the enqueue; the
+    journal-depth gauge tracks pending."""
+    server, httpd, port = rendezvous
+    # Pin the journal ON: this test exercises the journal machinery
+    # itself, independent of the ambient HOROVOD_SERVE_JOURNAL knob
+    # (CI's serve-journal-off dimension runs this suite with it off).
+    httpd.serve_router = RouterState(journal=True)
+    results = {}
+
+    def client():
+        with _post(port, "generate",
+                   {"tokens": [1, 2, 3], "max_new_tokens": 2}) as r:
+            results["lines"] = [json.loads(ln)
+                                for ln in r.read().splitlines()]
+
+    t = threading.Thread(target=client)
+    t.start()
+    try:
+        deadline = time.time() + 10
+        raw = None
+        while time.time() < deadline and raw is None:
+            raw = server.get(REQ_SCOPE, req_key(0))
+            time.sleep(0.01)
+        assert raw is not None
+        journaled = server.get(JOURNAL_SCOPE, req_key(0))
+        assert journaled == raw, "journal diverged from the enqueue"
+        assert json.loads(journaled)["tokens"] == [1, 2, 3]
+        depth = M.SERVE_JOURNAL_DEPTH.to_family()["samples"][0]["value"]
+        assert depth == 1
+        # release the stream
+        server.put(OUT_SCOPE, f"{req_key(0)}.done",
+                   json.dumps({"done": True, "tokens": [7, 8],
+                               "finish_reason": "completed",
+                               "ttft_s": 0.01, "tpot_s": 0.002}).encode())
+    finally:
+        t.join(timeout=10)
+    assert results["lines"][-1]["done"] is True
+
+
+def test_redrive_plan_roundtrip(rendezvous):
+    """The redrive computation: finished entries are skipped, unfinished
+    ones carry their already-streamed prefix and resume part, and the
+    request cursor lands past every journaled sequence number."""
+    server, _, _ = rendezvous
+    reqs = [{"id": req_key(i), "tokens": [i + 1, i + 2],
+             "max_new_tokens": 4} for i in range(3)]
+    for i, r in enumerate(reqs):
+        server.put(JOURNAL_SCOPE, req_key(i), json.dumps(r).encode())
+    # req 0 finished before the "reset"
+    server.put(OUT_SCOPE, f"{req_key(0)}.done",
+               json.dumps({"done": True, "tokens": [1, 2, 3, 4]}).encode())
+    # req 1 streamed two parts
+    server.put(OUT_SCOPE, f"{req_key(1)}.part.000000",
+               json.dumps({"tokens": [10, 11]}).encode())
+    server.put(OUT_SCOPE, f"{req_key(1)}.part.000001",
+               json.dumps({"tokens": [12]}).encode())
+
+    def get(scope, key):
+        return server.get(scope, key)
+
+    assert emitted_prefix(get, req_key(1)) == ([10, 11, 12], 2)
+    entries, seq = redrive_plan(get)
+    assert seq == 3
+    assert [e["id"] for e in entries] == [req_key(1), req_key(2)]
+    assert entries[0]["resume_emitted"] == [10, 11, 12]
+    assert entries[0]["resume_part"] == 2
+    assert entries[1]["resume_emitted"] == [] and \
+        entries[1]["resume_part"] == 0
+
+
+def _serve_ticks(fe, carry, n_ticks):
+    """The essential body of FleetFrontend.run for a rank-0 solo-KV
+    frontend, driven tick by tick so a test can 'crash' it mid-stream."""
+    for _ in range(n_ticks):
+        reqs = (carry or []) + fe._drain_requests()
+        carry = None
+        for r in reqs:
+            if r is None:
+                continue
+            fe._apply_resume(r)
+            fe.engine.submit(r["tokens"], r["max_new_tokens"],
+                             req_id=r.get("id"), eos_id=r.get("eos_id"))
+        fe._publish_report(fe.engine.step())
+
+
+def test_redrive_resumes_client_streams_byte_identical(rendezvous):
+    """THE redrive determinism claim, end to end through the real
+    router: two /generate streams lose their fleet after 3 of 6 tokens;
+    a second incarnation (fresh engine, epoch+1) redrives them from the
+    journal, suppresses the already-streamed prefix, and each client's
+    ndjson stream completes with exactly the unfaulted token sequence —
+    no gap, no duplicate, no reconnect."""
+    server, httpd, port = rendezvous
+    # Journal pinned ON (the machinery under test), knob-independent.
+    httpd.serve_router = RouterState(journal=True)
+    redrives0 = _counter_total(M.SERVE_REDRIVES)
+    prompts = [[3, 5, 8], [2, 4]]
+    results = [None, None]
+
+    def client(i):
+        with _post(port, "generate",
+                   {"tokens": prompts[i], "max_new_tokens": 6},
+                   timeout=30) as r:
+            results[i] = [json.loads(ln) for ln in r.read().splitlines()]
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            server.get(REQ_SCOPE, req_key(1)) is None:
+        time.sleep(0.01)
+    assert server.get(REQ_SCOPE, req_key(1)) is not None
+
+    # incarnation 1: serves 3 of 6 tokens, then "dies" (rank kill)
+    fe1 = FleetFrontend(ScriptedEngine(), "127.0.0.1", port, 0, 1,
+                        epoch=0)
+    carry = fe1.resume_from_kv()
+    assert len(carry) == 2 and fe1._next_seq == 2
+    _serve_ticks(fe1, carry, 3)
+    del fe1
+
+    # incarnation 2: fresh engine, next epoch — redrive from the journal
+    fe2 = FleetFrontend(ScriptedEngine(), "127.0.0.1", port, 0, 1,
+                        epoch=1)
+    carry = fe2.resume_from_kv()
+    assert [len(e["resume_emitted"]) for e in carry] == [3, 3]
+    assert fe2._next_seq == 2
+    _serve_ticks(fe2, carry, 6)
+
+    for t in threads:
+        t.join(timeout=20)
+    for i, lines in enumerate(results):
+        assert lines is not None and lines[-1]["done"] is True, lines
+        oracle = scripted_tokens(prompts[i], 6)
+        streamed = [tok for ln in lines[:-1] for tok in ln["tokens"]]
+        assert streamed == oracle, f"client {i} stream diverged"
+        assert lines[-1]["tokens"] == oracle, f"client {i} done record"
+        # exactly 6 parts each: 3 pre-crash + 3 resumed, none re-published
+        assert len(lines) - 1 == 6
+    assert _counter_total(M.SERVE_REDRIVES) == redrives0 + 2
+
+
+def test_redrive_disabled_fast_forwards_cursor(rendezvous):
+    """Degraded mode (HOROVOD_SERVE_JOURNAL=0): no redrive, but the
+    request cursor still skips every already-accepted request so the
+    new fleet never replays completed work from serve_req."""
+    server, _, port = rendezvous
+    for i in range(3):
+        server.put(REQ_SCOPE, req_key(i), json.dumps(
+            {"id": req_key(i), "tokens": [1], "max_new_tokens": 1}
+        ).encode())
+    fe = FleetFrontend(ScriptedEngine(), "127.0.0.1", port, 0, 1,
+                       journal=False)
+    assert fe.resume_from_kv() == []
+    assert fe._next_seq == 3
+
+
+# ---------------------------------------------------------- plan fencing
+def test_plan_epoch_fencing_rejects_stale_plans(rendezvous):
+    """A restarted fleet must never replay a previous incarnation's
+    plan stream: stale keys are invisible (epoch key namespace) and an
+    epoch-mismatched payload is refused outright."""
+    server, _, port = rendezvous
+    assert plan_key(0, epoch=0) != plan_key(0, epoch=1)
+    # stale epoch-0 plan in the KV
+    server.put(PLAN_SCOPE, plan_key(0, epoch=0),
+               json.dumps({"tick": 0, "epoch": 0, "stop": False,
+                           "reqs": [{"id": "req.000000"}]}).encode())
+    follower = FleetFrontend(ScriptedEngine(), "127.0.0.1", port, 1, 2,
+                             plan_timeout_s=0.4, epoch=1)
+    with pytest.raises(TimeoutError):
+        follower._fetch_plan()  # the stale key is not in epoch 1's space
+    # belt-and-braces: right key, wrong in-band epoch -> refused
+    server.put(PLAN_SCOPE, plan_key(0, epoch=1),
+               json.dumps({"tick": 0, "epoch": 0, "stop": False,
+                           "reqs": []}).encode())
+    with pytest.raises(ValueError, match="stale plan epoch"):
+        follower._fetch_plan()
+    # the real epoch-1 plan fetches clean
+    server.put(PLAN_SCOPE, plan_key(0, epoch=1),
+               json.dumps({"tick": 0, "epoch": 1, "stop": True,
+                           "reqs": []}).encode())
+    plan = follower._fetch_plan()
+    assert plan["stop"] is True and plan["epoch"] == 1
+
+
+# ----------------------------------------------------------------- drain
+def test_drain_endpoint_semantics(rendezvous, monkeypatch):
+    """POST /admin/drain: admission stops (503), the drain signal lands
+    in the KV, the fleet's drained ack completes the response, and the
+    drains counter moves exactly once for repeated drain calls."""
+    monkeypatch.setenv("HOROVOD_SERVE_DRAIN_TIMEOUT", "10")
+    server, httpd, port = rendezvous
+    drains0 = _counter_total(M.SERVE_DRAINS)
+
+    def fleet():
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if server.get(STATS_SCOPE, DRAIN_KEY) is not None:
+                server.put(STATS_SCOPE, DRAINED_KEY, json.dumps(
+                    {"tick": 42, "completed": 7}).encode())
+                return
+            time.sleep(0.01)
+
+    t = threading.Thread(target=fleet)
+    t.start()
+    try:
+        with _post(port, "admin/drain", {}) as r:
+            out = json.loads(r.read())
+    finally:
+        t.join(timeout=10)
+    assert out["drained"] is True
+    assert out["engine_final"]["completed"] == 7
+    assert out["router"]["draining"] is True
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(port, "generate", {"tokens": [1]})
+    assert exc.value.code == 503
+    assert "draining" in json.loads(exc.value.read())["error"]
+    # a second drain call is idempotent on the counter
+    with _post(port, "admin/drain", {}) as r:
+        assert json.loads(r.read())["drained"] is True
+    assert _counter_total(M.SERVE_DRAINS) == drains0 + 1
+
+
+def test_frontend_drains_in_flight_then_acks(rendezvous, monkeypatch):
+    """Rank 0 sees the drain signal, finishes every accepted request
+    (no drops), publishes the drained ack, and run() exits 0."""
+    server, _, port = rendezvous
+    server.put(REQ_SCOPE, req_key(0), json.dumps(
+        {"id": req_key(0), "tokens": [2, 3], "max_new_tokens": 3}
+    ).encode())
+    server.put(JOURNAL_SCOPE, req_key(0), json.dumps(
+        {"id": req_key(0), "tokens": [2, 3], "max_new_tokens": 3}
+    ).encode())
+    server.put(STATS_SCOPE, DRAIN_KEY, json.dumps(
+        {"t": time.time()}).encode())
+    fe = FleetFrontend(ScriptedEngine(), "127.0.0.1", port, 0, 1,
+                       drain_timeout_s=10.0)
+    assert fe.run(ttl_s=0.0) == 0  # no ttl: only the drain stops it
+    ack = server.get(STATS_SCOPE, DRAINED_KEY)
+    assert ack is not None and json.loads(ack)["completed"] == 1
+    done = server.get(OUT_SCOPE, f"{req_key(0)}.done")
+    assert done is not None, "in-flight request dropped by drain"
+    assert json.loads(done)["tokens"] == scripted_tokens([2, 3], 3)
+
+
+# ------------------------------------------------------ serve-side chaos
+def test_kv_blackout_scope_filtering():
+    """A kv_blackout event carrying a scope blacks out only that KV
+    scope; scopeless events keep matching everything (back-compat)."""
+    from horovod_tpu.chaos import ChaosInjector, parse_spec
+    spec = parse_spec({"events": [
+        {"kind": "kv_blackout", "op": "get", "scope": "serve_plan",
+         "count": 2}]})
+    inj = ChaosInjector(spec, rank=0)
+    inj.maybe_fail_kv("get", "metrics")  # other scope: untouched
+    inj.maybe_fail_kv("put", "serve_plan")  # other op: untouched
+    for _ in range(2):
+        with pytest.raises(urllib.error.URLError):
+            inj.maybe_fail_kv("get", "serve_plan")
+    inj.maybe_fail_kv("get", "serve_plan")  # window exhausted
+    # scopeless spec: any scope matches (the pre-existing contract)
+    inj2 = ChaosInjector(parse_spec({"events": [
+        {"kind": "kv_blackout", "op": "get", "count": 1}]}), rank=0)
+    with pytest.raises(urllib.error.URLError):
+        inj2.maybe_fail_kv("get", "anything")
+
+
+def test_serve_loop_kv_retry_rides_blackout(rendezvous, monkeypatch):
+    """The frontend's KV legs stall through a transient blackout
+    (bounded exp-backoff) instead of dying — and still fail loudly once
+    the budget is exhausted."""
+    import horovod_tpu.chaos as chaos
+    server, _, port = rendezvous
+    monkeypatch.setattr(worker_mod, "_KV_RETRIES", 3)
+    monkeypatch.setattr(worker_mod, "_KV_BACKOFF_MS", 5.0)
+    fe = FleetFrontend(ScriptedEngine(), "127.0.0.1", port, 0, 1)
+    spec = chaos.parse_spec({"events": [
+        {"kind": "kv_blackout", "op": "get", "scope": REQ_SCOPE,
+         "count": 2}]})
+    chaos.install(spec, 0)
+    try:
+        assert fe._drain_requests() == []  # rode the 2-op blackout out
+    finally:
+        chaos.uninstall()
+    # exhaustion: a blackout wider than the whole budget still surfaces
+    chaos.install(chaos.parse_spec({"events": [
+        {"kind": "kv_blackout", "op": "get", "scope": REQ_SCOPE,
+         "count": 100}]}), 0)
+    try:
+        with pytest.raises(urllib.error.URLError):
+            fe._drain_requests()
+    finally:
+        chaos.uninstall()
+
+
+# ---------------------------------------------------------------- doctor
+def test_doctor_serve_renders_stats_view(tmp_path, capsys):
+    """`hvdrun doctor --serve` renders the /serve/stats payload
+    admission-state-first, flagging a disabled journal as degraded."""
+    from horovod_tpu.runner.doctor import main as doctor_main
+    view = {"router": {"submitted": 9, "completed": 7, "rejected": 2,
+                       "shed": 1, "pending": 2, "max_pending": 64,
+                       "shed_high": 64, "shed_low": 48,
+                       "draining": False, "journal": True},
+            "journal": {"enabled": True, "entries": 9},
+            "engine": {"tick": 120, "active": 2, "waiting": 0,
+                       "completed": 7, "batch_fill": 0.5,
+                       "free_blocks": 20, "tokens_prefill": 40,
+                       "tokens_decode": 60}}
+    p = tmp_path / "stats.json"
+    p.write_text(json.dumps(view))
+    assert doctor_main([str(p), "--serve"]) == 0
+    out = capsys.readouterr().out
+    assert "ADMISSION: ACCEPTING" in out
+    assert "JOURNAL: on" in out and "9 entries" in out
+    assert "ENGINE: tick 120" in out
+    view["router"]["draining"] = True
+    view["journal"]["enabled"] = False
+    view.pop("engine")
+    p.write_text(json.dumps(view))
+    assert doctor_main([str(p), "--serve"]) == 0
+    out = capsys.readouterr().out
+    assert "ADMISSION: DRAINING" in out
+    assert "OFF (degraded" in out
+    assert "no stats published" in out
