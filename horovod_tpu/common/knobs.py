@@ -124,6 +124,37 @@ _knob("HOROVOD_SERVE_CACHE_BLOCKS", 4096, int,
       "stalls (FCFS head-of-line) when a request's worst-case block "
       "need exceeds the free pool.  Must be positive; rejected at "
       "hvd.init().")
+_knob("HOROVOD_SERVE_PREFILL_CHUNK", 64, int,
+      "Chunked-prefill width: how many prompt tokens one engine tick "
+      "may prefill per slot (the compiled step's row width; "
+      "serve/engine.py).  Long prompts are split across ticks inside "
+      "the max_batch_tokens budget so one 8k prompt cannot spike every "
+      "other stream's TPOT.  Must be in [1, max_batch_tokens]; rejected "
+      "at hvd.init() otherwise (docs/serving.md#raw-speed).")
+_knob("HOROVOD_SERVE_PREFIX_CACHE", True, _parse_bool,
+      "Refcounted radix prefix cache over the paged KV pool "
+      "(serve/engine.py PrefixCache): sequences with a common token "
+      "prefix map the SAME cache blocks (copy-on-write on divergence "
+      "within a partial block), so repeated prefills of shared system "
+      "prompts / few-shot templates become cache hits and admission "
+      "reserves only the NEW blocks.  Output is unchanged (identical "
+      "tokens produce identical KV); 0 disables — every prompt "
+      "recomputes from scratch (docs/serving.md#raw-speed).")
+_knob("HOROVOD_SERVE_SPEC", True, _parse_bool,
+      "Speculative decoding via n-gram/prompt-lookup drafting with "
+      "greedy verification (serve/engine.py): decode ticks feed the "
+      "last token plus up to HOROVOD_SERVE_SPEC_K drafted tokens "
+      "through one multi-token apply_cached verify step and emit only "
+      "the verified prefix — output is bit-identical to plain greedy "
+      "(the contract PR 10's journal redrive and the lockstep plan "
+      "stream depend on).  0 disables: one token per tick per slot "
+      "(docs/serving.md#raw-speed).")
+_knob("HOROVOD_SERVE_SPEC_K", 4, int,
+      "Speculative draft length: max tokens drafted per decode slot per "
+      "tick (each costs one token of the tick budget and one verify-row "
+      "position).  Must be >= 1 and spec_k + 1 <= prefill_chunk (the "
+      "verify row carries the bonus token + K drafts); rejected at "
+      "hvd.init() otherwise (docs/serving.md#raw-speed).")
 _knob("HOROVOD_SERVE_JOURNAL", True, _parse_bool,
       "Request journal + redrive (serve/journal.py; docs/serving.md): "
       "the router journals every accepted request to the rendezvous KV "

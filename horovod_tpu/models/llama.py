@@ -249,6 +249,23 @@ def init_cache(cfg: LlamaConfig, num_blocks: int, block_size: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def copy_blocks(cache: Dict[str, jax.Array], src: jax.Array,
+                dst: jax.Array) -> Dict[str, jax.Array]:
+    """Copy-on-write support for the serving prefix cache
+    (serve/engine.py PrefixCache): clone whole pool blocks
+    ``src[i] -> dst[i]`` across every layer in one gather+scatter,
+    BEFORE the tick's KV writes.  Padding entries route ``dst`` out of
+    range and are dropped; their ``src`` is clamped so the gather stays
+    in bounds.  The diverging sequence then overwrites its suffix
+    positions in the clone, leaving the shared original untouched."""
+    import jax.numpy as jnp
+
+    def cp(pool):
+        safe = jnp.clip(src, 0, pool.shape[1] - 1)
+        return pool.at[:, dst].set(pool[:, safe], mode="drop")
+    return {"k": cp(cache["k"]), "v": cp(cache["v"])}
+
+
 def _attn_cached(p: Dict[str, Any], x: jax.Array, cfg: LlamaConfig,
                  cos: jax.Array, sin: jax.Array,
                  k_pool: jax.Array, v_pool: jax.Array,

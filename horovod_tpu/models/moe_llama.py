@@ -163,6 +163,13 @@ def init_cache(cfg: MoeLlamaConfig, num_blocks: int, block_size: int,
                          dtype=dtype)
 
 
+def copy_blocks(cache: Dict[str, jax.Array], src: jax.Array,
+                dst: jax.Array) -> Dict[str, jax.Array]:
+    """CoW block clone for the serving prefix cache — exactly llama's
+    (the attention half IS llama's, so the pool layout is too)."""
+    return Ll.copy_blocks(cache, src, dst)
+
+
 def apply_cached(params: Dict[str, Any], tokens: jax.Array,
                  cfg: MoeLlamaConfig, cache: Dict[str, jax.Array],
                  block_tables: jax.Array, lengths: jax.Array,
@@ -208,4 +215,5 @@ def param_count(cfg: MoeLlamaConfig) -> int:
 
 
 __all__ = ["MoeLlamaConfig", "CONFIGS", "init", "apply", "loss_fn",
-           "param_count", "init_cache", "apply_cached", "dropfree_moe_fn"]
+           "param_count", "init_cache", "apply_cached", "copy_blocks",
+           "dropfree_moe_fn"]

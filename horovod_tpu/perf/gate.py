@@ -120,22 +120,32 @@ def save_baseline(path: str, doc: Dict[str, Any]) -> None:
 
 def load_artifacts(paths: List[str]) -> List[Dict[str, Any]]:
     """Bench artifacts: each file holds one JSON object (bench.py's one
-    printed line) or JSONL (sweep_results.jsonl rows)."""
+    printed line) or JSONL (sweep_results.jsonl rows).  A row may carry
+    ``sub_rows`` — additional gate-able rows riding the one printed line
+    (the bench supervisor forwards only the last stdout line, so
+    multi-metric modes like ``--serve`` nest their per-leg rows)."""
     rows: List[Dict[str, Any]] = []
+
+    def add(row: Dict[str, Any]) -> None:
+        rows.append(row)
+        for sub in row.get("sub_rows") or ():
+            if isinstance(sub, dict):
+                rows.append(sub)
+
     for path in paths:
         with open(path) as f:
             text = f.read().strip()
         if not text:
             continue
         try:
-            rows.append(json.loads(text))
+            add(json.loads(text))
             continue
         except ValueError:
             pass
         for line in text.splitlines():
             line = line.strip()
             if line.startswith("{"):
-                rows.append(json.loads(line))
+                add(json.loads(line))
     return rows
 
 

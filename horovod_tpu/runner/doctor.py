@@ -299,8 +299,40 @@ def render_serve(view: Dict[str, Any]) -> str:
         f"{engine.get('batch_fill', '?')}, free blocks "
         f"{engine.get('free_blocks', '?')}")
     lines.append(
-        f"  tokens: prefill {engine.get('tokens_prefill', '?')}, "
+        f"  tokens: prefill {engine.get('tokens_prefill', '?')} "
+        f"({engine.get('prefill_chunks', '?')} chunks), "
         f"decode {engine.get('tokens_decode', '?')}")
+    # Raw-speed legs (docs/serving.md#raw-speed) — absent on payloads
+    # from engines that predate them.
+    prefix = engine.get("prefix_cache")
+    if isinstance(prefix, dict):
+        if prefix.get("enabled"):
+            rate = prefix.get("hit_rate")
+            lines.append(
+                f"PREFIX CACHE: on — hit rate "
+                f"{'?' if rate is None else rate} "
+                f"({prefix.get('hits', '?')} hits, "
+                f"{prefix.get('blocks_shared', '?')} blocks shared, "
+                f"{prefix.get('cow_copies', '?')} CoW copies, "
+                f"{prefix.get('cached_blocks', '?')} cached blocks, "
+                f"{prefix.get('evictions', '?')} evictions)")
+        else:
+            lines.append("PREFIX CACHE: OFF (every prompt recomputes; "
+                         "docs/serving.md#raw-speed)")
+    spec = engine.get("spec")
+    if isinstance(spec, dict):
+        if spec.get("enabled"):
+            rate = spec.get("accept_rate")
+            lines.append(
+                f"SPECULATIVE DECODE: on — accept rate "
+                f"{'?' if rate is None else rate} "
+                f"({spec.get('drafted_tokens', '?')} drafted, "
+                f"{spec.get('accepted_tokens', '?')} accepted; low rate "
+                "=> n-gram-unfriendly traffic, see "
+                "docs/troubleshooting.md)")
+        else:
+            lines.append("SPECULATIVE DECODE: OFF (one token per tick "
+                         "per slot; docs/serving.md#raw-speed)")
     return "\n".join(lines)
 
 

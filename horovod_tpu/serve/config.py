@@ -28,6 +28,14 @@ class ServeConfig:
     max_slots: int = 8
     prefill_chunk: int = 64
     eos_id: Optional[int] = None
+    # Raw-speed legs (docs/serving.md#raw-speed).  All three preserve
+    # greedy output exactly (prefix sharing reuses identical KV, chunking
+    # is a scheduling change, speculative tokens are verified before
+    # emission), so they default to the fast path; the knobs exist for
+    # the degraded/off modes and for A/B measurement.
+    prefix_cache: bool = True
+    spec_decode: bool = True
+    spec_k: int = 4
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -53,14 +61,33 @@ class ServeConfig:
         if self.prefill_chunk <= 0 or \
                 self.prefill_chunk > self.max_batch_tokens:
             raise ValueError(
-                f"serve prefill_chunk={self.prefill_chunk} invalid; must "
-                "be in [1, max_batch_tokens="
+                f"HOROVOD_SERVE_PREFILL_CHUNK={self.prefill_chunk} "
+                "invalid; must be in [1, max_batch_tokens="
                 f"{self.max_batch_tokens}] (docs/serving.md)")
+        if self.spec_k < 1:
+            raise ValueError(
+                f"HOROVOD_SERVE_SPEC_K={self.spec_k} invalid; the draft "
+                "length must be >= 1 (docs/serving.md#raw-speed)")
+        if self.spec_decode and self.spec_k + 1 > self.prefill_chunk:
+            raise ValueError(
+                f"HOROVOD_SERVE_SPEC_K={self.spec_k} exceeds the verify "
+                f"row width: need spec_k + 1 <= prefill_chunk="
+                f"{self.prefill_chunk} (the compiled step verifies the "
+                "bonus token + K drafts in one row; docs/serving.md)")
         if model_max_seq is not None and self.max_seq_len > model_max_seq:
             raise ValueError(
                 f"HOROVOD_SERVE_MAX_SEQ_LEN={self.max_seq_len} exceeds "
                 f"the served model's max_seq={model_max_seq}; RoPE "
                 "tables end there (docs/serving.md)")
+
+
+def _opt(knobs: Any, name: str, default: Any) -> Any:
+    """Knob lookup tolerant of partial mappings (tests validate with
+    plain dicts that predate the fault-tolerance/raw-speed knobs)."""
+    try:
+        return knobs[name]
+    except (KeyError, TypeError):
+        return default
 
 
 def from_knobs(knobs: Any, **overrides: Any) -> ServeConfig:
@@ -71,20 +98,15 @@ def from_knobs(knobs: Any, **overrides: Any) -> ServeConfig:
         max_batch_tokens=int(knobs["HOROVOD_SERVE_MAX_BATCH_TOKENS"]),
         max_seq_len=int(knobs["HOROVOD_SERVE_MAX_SEQ_LEN"]),
         cache_blocks=int(knobs["HOROVOD_SERVE_CACHE_BLOCKS"]),
+        prefill_chunk=int(_opt(knobs, "HOROVOD_SERVE_PREFILL_CHUNK", 64)),
+        prefix_cache=bool(_opt(knobs, "HOROVOD_SERVE_PREFIX_CACHE", True)),
+        spec_decode=bool(_opt(knobs, "HOROVOD_SERVE_SPEC", True)),
+        spec_k=int(_opt(knobs, "HOROVOD_SERVE_SPEC_K", 4)),
     )
     kw.update(overrides)
     cfg = ServeConfig(**kw)
     cfg.validate()
     return cfg
-
-
-def _opt(knobs: Any, name: str, default: Any) -> Any:
-    """Knob lookup tolerant of partial mappings (tests validate with
-    plain dicts that predate the fault-tolerance knobs)."""
-    try:
-        return knobs[name]
-    except (KeyError, TypeError):
-        return default
 
 
 def validate_serve_knobs(knobs: Any) -> None:

@@ -37,6 +37,7 @@ with no new transport.
 from __future__ import annotations
 
 import collections
+import hashlib
 import json
 import os
 import time
@@ -49,29 +50,197 @@ from .config import ServeConfig
 
 # ------------------------------------------------------------ block pool
 class BlockAllocator:
-    """Free-list over the paged cache pool.  LIFO reuse: the blocks a
-    finished request frees are the first ones the next request gets —
-    deterministic across ranks and trivially observable in tests
-    (paged-cache block reuse)."""
+    """Refcounted free-list over the paged cache pool.  LIFO reuse: the
+    blocks a finished request frees are the first ones the next request
+    gets — deterministic across ranks and trivially observable in tests
+    (paged-cache block reuse).
+
+    With prefix sharing (PrefixCache) one block can back several
+    sequences plus the cache itself: ``alloc`` hands blocks out at
+    refcount 1, ``incref`` adds an owner, ``free`` releases one owner —
+    a block returns to the free list only when its LAST owner lets go."""
 
     def __init__(self, num_blocks: int):
         self.num_blocks = int(num_blocks)
         self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._refs: Dict[int, int] = {}
 
     @property
     def free_count(self) -> int:
         return len(self._free)
+
+    def ref(self, block: int) -> int:
+        return self._refs.get(block, 0)
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """All-or-nothing: a request that cannot get its worst-case
         block count is not admitted (no mid-flight OOM-evict)."""
         if n > len(self._free):
             return None
-        return [self._free.pop() for _ in range(n)]
+        blocks = [self._free.pop() for _ in range(n)]
+        for b in blocks:
+            self._refs[b] = 1
+        return blocks
+
+    def incref(self, blocks: List[int]) -> None:
+        for b in blocks:
+            self._refs[b] += 1
 
     def free(self, blocks: List[int]) -> None:
         for b in reversed(blocks):
-            self._free.append(b)
+            left = self._refs[b] = self._refs[b] - 1
+            if left == 0:
+                del self._refs[b]
+                self._free.append(b)
+
+
+# ----------------------------------------------------- radix prefix cache
+class _PrefixNode:
+    """One radix-tree node = one pool block's worth of cached prompt KV:
+    ``tokens`` are the token ids whose KV the block holds (a full block,
+    or a partial tail shorter than block_size), children keyed by the
+    NEXT block's token tuple."""
+
+    __slots__ = ("tokens", "block", "children", "parent", "stamp")
+
+    def __init__(self, tokens: Tuple[int, ...], block: int, parent):
+        self.tokens = tokens
+        self.block = block
+        self.children: Dict[Tuple[int, ...], "_PrefixNode"] = {}
+        self.parent = parent
+        self.stamp = 0
+
+
+class PrefixCache:
+    """Radix tree over token-block keys (the automatic-prefix-caching
+    discipline on this repo's paged pool): sequences with a common
+    prefix map the SAME KV blocks, so repeated prefills of shared system
+    prompts / few-shot templates become cache hits.
+
+      * full blocks are shared in place (allocator refcount, zero copy);
+      * divergence INSIDE a cached block — including a partial tail —
+        is shared copy-on-write: the matcher gets a device-side clone of
+        the block (models/llama.py ``copy_blocks``) holding the common
+        positions and overwrites its own suffix;
+      * when the pool runs dry, admission evicts LRU leaves nobody
+        references but the cache (refcount exactly 1).
+
+    Pure host state driven only by the request stream, never by timing —
+    every rank replaying the same plan stream computes the identical
+    tree, which is what keeps the fleet lockstep (docs/serving.md)."""
+
+    def __init__(self, block_size: int, allocator: BlockAllocator):
+        self.block_size = int(block_size)
+        self.allocator = allocator
+        self.root = _PrefixNode((), -1, None)
+        self._clock = 0          # deterministic LRU clock (touch order)
+        self.hits = 0            # admissions with a nonzero prefix hit
+        self.hit_tokens = 0      # prompt tokens served from cache
+        self.blocks_shared = 0   # full blocks mapped instead of computed
+        self.cow_copies = 0
+        self.evictions = 0
+
+    def _touch(self, node: _PrefixNode) -> None:
+        self._clock += 1
+        node.stamp = self._clock
+
+    def match(self, prompt: List[int]
+              ) -> Tuple[List[int], Optional[Tuple[int, int]], int]:
+        """Longest cached prefix of ``prompt``, capped at
+        ``len(prompt) - 1``: at least one prompt token is always
+        recomputed so the admitting tick has logits to sample the first
+        output from (a zero-token prefill chunk would wedge).  Returns
+        ``(full_blocks, cow, hit_tokens)`` — ``full_blocks`` are shared
+        as-is (caller increfs); ``cow`` is ``(src_block, n_valid)`` when
+        the tail diverges inside a cached block, and the caller owns a
+        device-side copy."""
+        bs = self.block_size
+        limit = len(prompt) - 1
+        node, full, pos = self.root, [], 0
+        while limit - pos >= bs:
+            child = node.children.get(tuple(prompt[pos:pos + bs]))
+            if child is None:
+                break
+            self._touch(child)
+            full.append(child.block)
+            node, pos = child, pos + bs
+        # Divergence within a block: best partial overlap among this
+        # node's children (sorted scan = deterministic tie-break),
+        # shared by copy-on-write.
+        want = tuple(prompt[pos:limit])
+        best, best_n = None, 0
+        for key in sorted(node.children):
+            child = node.children[key]
+            n = 0
+            for a, b in zip(want, child.tokens):
+                if a != b:
+                    break
+                n += 1
+            if n > best_n:
+                best, best_n = child, n
+        cow = None
+        if best is not None and best_n >= 1:
+            self._touch(best)
+            cow = (best.block, best_n)
+        return full, cow, pos + best_n
+
+    def insert(self, prompt: List[int], blocks: List[int]) -> None:
+        """Register a finished prefill: ``blocks`` is the slot's table
+        row, whose i-th entry holds the prompt's i-th block of KV.
+        Existing nodes win (dedup: a prefix computed twice concurrently
+        stays owned by its second request and is freed normally); new
+        nodes take one cache ref on their block so eviction — not a
+        request finishing — decides their lifetime."""
+        bs = self.block_size
+        node, pos = self.root, 0
+        for i in range(len(prompt) // bs):
+            key = tuple(prompt[pos:pos + bs])
+            child = node.children.get(key)
+            if child is None:
+                child = _PrefixNode(key, blocks[i], node)
+                node.children[key] = child
+                self.allocator.incref([child.block])
+                self._touch(child)
+            node, pos = child, pos + bs
+        tail = tuple(prompt[pos:])
+        if tail and tail not in node.children:
+            child = _PrefixNode(tail, blocks[len(prompt) // bs], node)
+            node.children[tail] = child
+            self.allocator.incref([child.block])
+            self._touch(child)
+
+    def evict(self, n_blocks: int) -> int:
+        """Free up to ``n_blocks`` by dropping least-recently-touched
+        leaves only the cache references (allocator refcount exactly 1);
+        returns how many were freed.  Interior nodes are never dropped —
+        that would orphan reachable children."""
+        freed = 0
+        while freed < n_blocks:
+            victim = None
+            for node in self._walk(self.root):
+                if node is self.root or node.children:
+                    continue
+                if self.allocator.ref(node.block) != 1:
+                    continue
+                if victim is None or node.stamp < victim.stamp:
+                    victim = node
+            if victim is None:
+                break
+            del victim.parent.children[victim.tokens]
+            self.allocator.free([victim.block])
+            self.evictions += 1
+            freed += 1
+        return freed
+
+    def _walk(self, node: _PrefixNode):
+        yield node
+        for key in sorted(node.children):
+            yield from self._walk(node.children[key])
+
+    @property
+    def size(self) -> int:
+        """Cached blocks currently held by the tree."""
+        return sum(1 for _ in self._walk(self.root)) - 1
 
 
 # --------------------------------------------------------------- request
@@ -97,6 +266,9 @@ class Request:
         self.ctx_len = 0    # tokens written into the cache
         self.slot: Optional[int] = None
         self.blocks: List[int] = []
+        self.draft: List[int] = []      # this tick's speculative tokens
+        self._bigram: Dict[Tuple[int, int], int] = {}
+        self._indexed = 0   # context positions already in the index
         self.submitted_t = time.perf_counter()
         self.admitted_t: Optional[float] = None
         self.first_token_t: Optional[float] = None
@@ -119,6 +291,31 @@ class Request:
         return (self.done_t - self.first_token_t) / \
             (len(self.out_tokens) - 1)
 
+    # ----------------------------------------------------- spec drafting
+    def _ctx_tok(self, i: int) -> int:
+        n = len(self.tokens)
+        return self.tokens[i] if i < n else self.out_tokens[i - n]
+
+    def draft_lookup(self, k: int) -> List[int]:
+        """N-gram / prompt-lookup drafting (the draft-model-free leg of
+        speculative decoding): find the most recent PRIOR occurrence of
+        the context's final bigram and propose up to ``k`` tokens that
+        followed it.  The bigram index grows incrementally (O(1) per
+        generated token) and deliberately excludes the final bigram
+        itself, so a repeating tail still finds its earlier occurrence.
+        A pure function of prompt + emitted tokens — deterministic on
+        every rank (the lockstep contract)."""
+        L = len(self.tokens) + len(self.out_tokens)
+        if k < 1 or L < 3:
+            return []
+        for i in range(max(self._indexed, 1), L - 1):
+            self._bigram[(self._ctx_tok(i - 1), self._ctx_tok(i))] = i + 1
+        self._indexed = max(self._indexed, L - 1)
+        p = self._bigram.get((self._ctx_tok(L - 2), self._ctx_tok(L - 1)))
+        if p is None:
+            return []
+        return [self._ctx_tok(p + j) for j in range(min(k, L - p))]
+
 
 # ------------------------------------------------------------- scheduler
 class Scheduler:
@@ -132,9 +329,15 @@ class Scheduler:
         self.slots: List[Optional[Request]] = [None] * cfg.max_slots
         self.waiting: "collections.deque[Request]" = collections.deque()
         self.allocator = BlockAllocator(cfg.cache_blocks)
+        self.prefix = (PrefixCache(cfg.block_size, self.allocator)
+                       if cfg.prefix_cache else None)
         self.block_tables = -np.ones(
             (cfg.max_slots, cfg.max_blocks_per_seq), np.int32)
         self.completed = 0
+        self.admissions = 0
+        # CoW copies the NEXT dispatch must run before its writes:
+        # (src_block, dst_block) pairs, at most one per admission.
+        self.pending_copies: List[Tuple[int, int]] = []
 
     # ------------------------------------------------------------ intake
     def submit(self, req: Request) -> Request:
@@ -160,15 +363,29 @@ class Scheduler:
     # -------------------------------------------------------------- plan
     def plan(self) -> List[Tuple[int, Request, int]]:
         """One tick's work under the token budget: decode slots first
-        (1 token each, latency-critical), prefill continuations next,
-        FCFS admissions into the remainder.  Deterministic given state."""
+        (1 token + up to spec_k verified drafts each, latency-critical),
+        prefill continuations next, FCFS admissions into the remainder.
+        Deterministic given state."""
         budget = self.cfg.max_batch_tokens
         chunk = self.cfg.prefill_chunk
         work: List[Tuple[int, Request, int]] = []
         for i, req in enumerate(self.slots):
             if req is not None and req.state == "decode" and budget >= 1:
-                work.append((i, req, 1))
-                budget -= 1
+                req.draft = []
+                if self.cfg.spec_decode:
+                    # Draft length caps: the tick budget (each draft
+                    # token costs 1), the verify row width (bonus token
+                    # + K drafts per row), and the remaining generation
+                    # budget (a draft past max_new could be verified at
+                    # RoPE positions the reservation never covered).
+                    cap = min(self.cfg.spec_k, budget - 1,
+                              self.cfg.prefill_chunk - 1,
+                              req.max_new_tokens - len(req.out_tokens) - 1)
+                    if cap >= 1:
+                        req.draft = req.draft_lookup(cap)
+                n = 1 + len(req.draft)
+                work.append((i, req, n))
+                budget -= n
         for i, req in enumerate(self.slots):
             if req is not None and req.state == "prefill" and budget >= 1:
                 n = min(chunk, req.prompt_len - req.pos, budget)
@@ -180,23 +397,79 @@ class Scheduler:
             if not free_slots:
                 break
             req = self.waiting[0]
-            need = -(-(req.prompt_len + req.max_new_tokens)
-                     // self.cfg.block_size)
-            blocks = self.allocator.alloc(need)
-            if blocks is None:
+            row = self._admit_blocks(req)
+            if row is None:
                 break  # FCFS head-of-line: no skip-ahead, deterministic
             self.waiting.popleft()
+            self.admissions += 1
             slot = free_slots[0]
-            req.slot, req.blocks = slot, blocks
+            req.slot, req.blocks = slot, row
             req.state = "prefill"
             req.admitted_t = time.perf_counter()
             self.slots[slot] = req
             self.block_tables[slot, :] = -1
-            self.block_tables[slot, :need] = blocks
-            n = min(chunk, req.prompt_len, budget)
+            self.block_tables[slot, :len(row)] = row
+            # prefix-hit tokens are already resident: prefill resumes at
+            # req.pos (match() keeps >= 1 token to compute, so n >= 1)
+            n = min(chunk, req.prompt_len - req.pos, budget)
             work.append((slot, req, n))
             budget -= n
         return work
+
+    def _admit_blocks(self, req: Request) -> Optional[List[int]]:
+        """One admission's block-table row.  With the prefix cache on,
+        the worst-case reservation counts only NEW blocks — prefix-hit
+        blocks are already resident (the sharing dividend: without this
+        the conservative math would refuse admissible requests).  Shared
+        blocks are increfed BEFORE the alloc/evict so eviction can never
+        recycle what this admission just matched; a failed alloc undoes
+        the increfs and leaves the request queued (all-or-nothing)."""
+        need = -(-(req.prompt_len + req.max_new_tokens)
+                 // self.cfg.block_size)
+        if self.prefix is None:
+            return self.allocator.alloc(need)
+        shared, cow, hit = self.prefix.match(req.tokens)
+        self.allocator.incref(shared)
+        need_new = need - len(shared)
+        blocks = self.allocator.alloc(need_new)
+        if blocks is None:
+            short = need_new - self.allocator.free_count
+            if self.prefix.evict(short) >= short:
+                blocks = self.allocator.alloc(need_new)
+        if blocks is None:
+            self.allocator.free(shared)  # undo: tree refs keep them alive
+            return None
+        if cow is not None:
+            # Divergence inside a cached block: clone it on device into
+            # this request's first new block, then overwrite the suffix.
+            # The source needs no extra ref: the copy runs at the START
+            # of the next dispatch, and any later reuse of the source
+            # block writes in the SAME step after the copy's gather
+            # (functional semantics) or in a later, device-ordered one.
+            src, cow_tokens = cow
+            self.pending_copies.append((src, blocks[0]))
+            hit = len(shared) * self.cfg.block_size + cow_tokens
+            self.prefix.cow_copies += 1
+        if hit:
+            from ..utils import metrics as M
+            self.prefix.hits += 1
+            self.prefix.hit_tokens += hit
+            self.prefix.blocks_shared += len(shared)
+            M.SERVE_PREFIX_HITS.inc()
+            if shared:
+                M.SERVE_PREFIX_BLOCKS_SHARED.inc(len(shared))
+        req.pos = req.ctx_len = hit
+        return shared + blocks
+
+    def take_copies(self) -> List[Tuple[int, int]]:
+        copies, self.pending_copies = self.pending_copies, []
+        return copies
+
+    def register_prefix(self, req: Request) -> None:
+        """Engine callback at prefill completion: the slot's prompt
+        blocks now hold fully-computed KV and become shareable."""
+        if self.prefix is not None and req.slot is not None:
+            self.prefix.insert(req.tokens, req.blocks)
 
     # ------------------------------------------------------------- evict
     def finish(self, req: Request, reason: str) -> None:
@@ -312,6 +585,15 @@ class ServeEngine:
         self._tokens_prefill = 0
         self._tokens_decode = 0
         self._last_fill = 0.0
+        self._prefill_chunks = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        # Rolling digest of every dispatch's scheduling decisions
+        # (admission prefix hits, chunk boundaries, draft tokens, CoW
+        # copies).  Rank 0 publishes it in the plan stream and followers
+        # assert equality — lockstep divergence is caught at the tick it
+        # happens, not when token digests drift (serve/worker.py).
+        self.sched_digest = ""
 
     # ----------------------------------------------------------- compile
     def _build_step(self):
@@ -320,18 +602,25 @@ class ServeEngine:
 
         model, mcfg = self.model, self.model_cfg
 
-        def step_fn(params, cache, block_tables, lengths, n_new, tokens):
+        def step_fn(params, cache, block_tables, lengths, n_new, tokens,
+                    copy_src, copy_dst):
+            # CoW prefix sharing: clone diverged blocks BEFORE this
+            # tick's writes (padding entries route dst out of bounds and
+            # drop).  The gather reads the pre-step pool, so a source
+            # block recycled in this same tick still copies its old
+            # content (functional semantics — see Scheduler._admit_blocks).
+            cache = model.copy_blocks(cache, copy_src, copy_dst)
             out = model.apply_cached(params, tokens, mcfg, cache,
                                      block_tables, lengths, n_new)
             logits, cache = out[0], out[1]  # moe also returns aux
-            last = jnp.maximum(n_new - 1, 0)
-            logits_last = jnp.take_along_axis(
-                logits, last[:, None, None], axis=1)[:, 0]
-            # Greedy sampling ON DEVICE: the token feeds the next tick
-            # without a host round trip in the value chain, and argmax
-            # ties break identically on every rank (SPMD determinism).
+            # Greedy sampling ON DEVICE at EVERY chunk position: row
+            # [s, j] is the greedy continuation after consuming tokens
+            # [s, :j+1] — prefill reads its last valid position,
+            # speculative decode verifies its whole draft row against
+            # it.  Argmax ties break identically on every rank (SPMD
+            # determinism).
             next_tokens = jnp.argmax(
-                logits_last.astype(jnp.float32), axis=-1).astype(jnp.int32)
+                logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
             return cache, next_tokens
 
         return jax.jit(
@@ -392,21 +681,43 @@ class ServeEngine:
             if req.state == "prefill":
                 tokens[slot, :n] = req.tokens[req.pos:req.pos + n]
             else:
-                tokens[slot, 0] = req.out_tokens[-1]
+                # Speculative verify row: the last emitted token plus
+                # the drafts — one multi-token apply_cached call scores
+                # every draft position (n == 1 + len(draft)).
+                tokens[slot, :n] = [req.out_tokens[-1]] + req.draft
             lengths[slot] = req.ctx_len
             n_new[slot] = n
+        copies = self.scheduler.take_copies()
+        copy_src = np.zeros(S, np.int32)
+        copy_dst = np.full(S, cfg.cache_blocks, np.int32)  # no-op: dropped
+        for j, (src, dst) in enumerate(copies):
+            copy_src[j], copy_dst[j] = src, dst
+        self._fold_sched(work, copies)
         # Async dispatch: device_put + jit return immediately; the next
         # step() harvests, so this tick's H2D staging and compute run
         # behind the caller's host work (the double-buffer pattern).
         dev = [_make_global(a, self._repl)
                for a in (np.asarray(self.scheduler.block_tables),
-                         lengths, n_new, tokens)]
+                         lengths, n_new, tokens, copy_src, copy_dst)]
         self.cache, next_tokens = self._step_fn(
             self.params, self.cache, *dev)
         used = int(n_new.sum())
         self._last_fill = used / cfg.max_batch_tokens
         self._inflight.append((self.tick, work, next_tokens, used))
         self.tick += 1
+
+    def _fold_sched(self, work, copies) -> None:
+        """Fold one dispatch's scheduling decisions into the rolling
+        digest: slot/request/phase/width (width encodes chunk boundaries
+        and draft length), the admission-resume positions (prefix hits),
+        the draft tokens themselves, and the CoW copy pairs."""
+        summary = [(slot, req.req_id, req.state, n,
+                    req.pos if req.state == "prefill" else req.ctx_len,
+                    [] if req.state == "prefill" else list(req.draft))
+                   for slot, req, n in work]
+        rec = json.dumps([summary, copies], separators=(",", ":"))
+        self.sched_digest = hashlib.sha1(
+            (self.sched_digest + rec).encode()).hexdigest()[:16]
 
     def _harvest(self) -> Dict[str, Any]:
         if not self._inflight:
@@ -419,39 +730,67 @@ class ServeEngine:
         emitted: Dict[str, List[int]] = {}
         finished: List[Request] = []
         for slot, req, n in work:
-            if req.state == "prefill":
+            decode_row = req.state != "prefill"
+            if not decode_row:
                 req.pos += n
                 req.ctx_len += n
                 self._tokens_prefill += n
+                self._prefill_chunks += 1
                 M.SERVE_TOKENS.inc(n, phase="prefill")
+                M.SERVE_PREFILL_CHUNKS.inc()
                 if req.pos < req.prompt_len:
                     continue  # still prefilling next tick
                 req.state = "decode"
+                self.scheduler.register_prefix(req)
+                new_toks = [int(tokens_host[slot, n - 1])]
             else:
-                req.ctx_len += 1
-                self._tokens_decode += 1
-                M.SERVE_TOKENS.inc(phase="decode")
-            tok = int(tokens_host[slot])
-            req.out_tokens.append(tok)
-            emitted.setdefault(req.req_id, []).append(tok)
-            if req.first_token_t is None:
-                req.first_token_t = now
-                M.SERVE_TTFT.observe(req.ttft())
-                self._span("PREFILL", req, now - req.admitted_t,
-                           end_t=now, extra={"prompt": req.prompt_len})
-            if (req.eos_id is not None and tok == req.eos_id) or \
-                    len(req.out_tokens) >= req.max_new_tokens:
-                reason = ("eos" if req.eos_id is not None
-                          and tok == req.eos_id else "completed")
-                self.scheduler.finish(req, reason)
-                finished.append(req)
-                tpot = req.tpot()
-                if tpot is not None:
-                    M.SERVE_TPOT.observe(tpot)
-                M.SERVE_REQUESTS.inc(outcome=reason)
-                self._span("DECODE", req, req.done_t - req.first_token_t,
-                           end_t=req.done_t,
-                           extra={"generated": len(req.out_tokens)})
+                # Greedy verification: row[j] is the greedy continuation
+                # after consuming input positions <= j, so draft[j] is
+                # accepted iff it EQUALS the previous greedy token —
+                # emitted output is bit-identical to plain greedy, only
+                # the tokens-per-tick rate changes.
+                row = tokens_host[slot]
+                new_toks = [int(row[0])]
+                for j, d in enumerate(req.draft):
+                    if int(d) != new_toks[-1]:
+                        break
+                    new_toks.append(int(row[j + 1]))
+                accepted = len(new_toks) - 1
+                req.ctx_len += 1 + accepted
+                if req.draft:
+                    self._spec_drafted += len(req.draft)
+                    self._spec_accepted += accepted
+                    M.SERVE_SPEC_DRAFTED.inc(len(req.draft))
+                    if accepted:
+                        M.SERVE_SPEC_ACCEPTED.inc(accepted)
+            emitted_n = 0
+            for tok in new_toks:
+                req.out_tokens.append(tok)
+                emitted.setdefault(req.req_id, []).append(tok)
+                emitted_n += 1
+                if req.first_token_t is None:
+                    req.first_token_t = now
+                    M.SERVE_TTFT.observe(req.ttft())
+                    self._span("PREFILL", req, now - req.admitted_t,
+                               end_t=now, extra={"prompt": req.prompt_len})
+                if (req.eos_id is not None and tok == req.eos_id) or \
+                        len(req.out_tokens) >= req.max_new_tokens:
+                    reason = ("eos" if req.eos_id is not None
+                              and tok == req.eos_id else "completed")
+                    self.scheduler.finish(req, reason)
+                    finished.append(req)
+                    tpot = req.tpot()
+                    if tpot is not None:
+                        M.SERVE_TPOT.observe(tpot)
+                    M.SERVE_REQUESTS.inc(outcome=reason)
+                    self._span("DECODE", req,
+                               req.done_t - req.first_token_t,
+                               end_t=req.done_t,
+                               extra={"generated": len(req.out_tokens)})
+                    break  # verified-but-post-EOS drafts are discarded
+            if decode_row:
+                self._tokens_decode += emitted_n
+                M.SERVE_TOKENS.inc(emitted_n, phase="decode")
         from .. import postmortem as PM
         PM.record_step(tick)  # engine liveness on the /health plane
         return {"tick": tick, "processed": used, "emitted": emitted,
@@ -487,7 +826,8 @@ class ServeEngine:
     # -------------------------------------------------------------- view
     def stats(self) -> Dict[str, Any]:
         s = self.scheduler
-        return {
+        prefix = s.prefix
+        out = {
             "tick": self.tick,
             "active": s.active,
             "waiting": s.queue_depth,
@@ -496,7 +836,29 @@ class ServeEngine:
             "batch_fill": round(self._last_fill, 4),
             "tokens_prefill": self._tokens_prefill,
             "tokens_decode": self._tokens_decode,
+            "prefill_chunks": self._prefill_chunks,
+            "prefix_cache": {"enabled": prefix is not None},
+            "spec": {
+                "enabled": bool(self.cfg.spec_decode),
+                "drafted_tokens": self._spec_drafted,
+                "accepted_tokens": self._spec_accepted,
+                "accept_rate": (
+                    round(self._spec_accepted / self._spec_drafted, 4)
+                    if self._spec_drafted else None),
+            },
         }
+        if prefix is not None:
+            out["prefix_cache"].update({
+                "hits": prefix.hits,
+                "hit_tokens": prefix.hit_tokens,
+                "blocks_shared": prefix.blocks_shared,
+                "cached_blocks": prefix.size,
+                "cow_copies": prefix.cow_copies,
+                "evictions": prefix.evictions,
+                "hit_rate": (round(prefix.hits / s.admissions, 4)
+                             if s.admissions else None),
+            })
+        return out
 
 
 # ----------------------------------------------------- servable loading

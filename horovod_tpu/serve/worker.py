@@ -153,9 +153,18 @@ class FleetFrontend:
 
     def _publish_plan(self, reqs: List[Dict[str, Any]],
                       stop: bool = False) -> None:
+        payload = {"tick": self.tick, "epoch": self.epoch,
+                   "stop": stop, "reqs": reqs}
+        # Scheduling decisions live in the plan stream (docs/serving.md
+        # #raw-speed): the engine's rolling digest covers every prefix
+        # hit, chunk boundary, draft and CoW copy rank 0 has dispatched
+        # so far, so followers prove their engines made the SAME
+        # decisions, not just the same tokens.
+        digest = getattr(self.engine, "sched_digest", None)
+        if digest is not None:
+            payload["sched"] = digest
         self._kv_put(PLAN_SCOPE, plan_key(self.tick, self.epoch),
-                     json.dumps({"tick": self.tick, "epoch": self.epoch,
-                                 "stop": stop, "reqs": reqs}).encode())
+                     json.dumps(payload).encode())
 
     def _fetch_plan(self) -> Dict[str, Any]:
         raw = self._kv().get_kv(self.addr, self.port, PLAN_SCOPE,
@@ -174,6 +183,17 @@ class FleetFrontend:
                 f"rank {self.rank}: stale plan epoch "
                 f"{plan.get('epoch')!r} != {self.epoch} — refusing to "
                 "replay a previous incarnation's plan stream")
+        sched = plan.get("sched")
+        mine = getattr(self.engine, "sched_digest", None)
+        if sched is not None and mine is not None \
+                and not plan.get("stop") and sched != mine:
+            # Divergence is caught at the tick it happens — before this
+            # rank dispatches another step off a forked schedule.
+            raise ValueError(
+                f"rank {self.rank}: lockstep divergence at "
+                f"{plan_key(self.tick, self.epoch)} — local scheduling "
+                f"digest {mine} != rank 0's {sched} (prefix/chunk/spec "
+                "decisions disagree; serve/engine.py sched_digest)")
         return plan
 
     # ----------------------------------------------------------- redrive

@@ -399,6 +399,30 @@ SERVE_JOURNAL_DEPTH = REGISTRY.gauge(
     "hvd_serve_journal_depth",
     "Accepted requests journaled for redrive and not yet finished "
     "(what a fleet reset would have to replay right now).")
+# Serving raw speed (serve/engine.py; docs/serving.md#raw-speed): the
+# prefix-cache / chunked-prefill / speculative-decoding telemetry —
+# the rates behind 'is the fast path actually firing on this traffic'.
+SERVE_PREFIX_HITS = REGISTRY.counter(
+    "hvd_serve_prefix_hits_total",
+    "Admissions whose prompt hit the radix prefix cache (>= 1 token "
+    "served from already-resident KV blocks instead of recomputed).")
+SERVE_PREFIX_BLOCKS_SHARED = REGISTRY.counter(
+    "hvd_serve_prefix_blocks_shared_total",
+    "Whole KV blocks mapped refcounted from the prefix cache at "
+    "admission (prefill work avoided, block reservation shrunk).")
+SERVE_PREFILL_CHUNKS = REGISTRY.counter(
+    "hvd_serve_prefill_chunks_total",
+    "Prefill chunks processed (prompts split across ticks at "
+    "HOROVOD_SERVE_PREFILL_CHUNK inside the mixed-step token budget).")
+SERVE_SPEC_DRAFTED = REGISTRY.counter(
+    "hvd_serve_spec_drafted_tokens_total",
+    "Tokens drafted by n-gram/prompt-lookup speculative decoding and "
+    "submitted to the multi-token greedy verify step.")
+SERVE_SPEC_ACCEPTED = REGISTRY.counter(
+    "hvd_serve_spec_accepted_tokens_total",
+    "Drafted tokens the greedy verify step accepted (emitted output "
+    "stays bit-identical to plain greedy; the ratio to drafted is the "
+    "accept rate).")
 
 # Perf-attribution plane (horovod_tpu/perf/; docs/profiling.md).  The
 # step-time decomposition ledger records here: measured step times, the

@@ -74,7 +74,8 @@ SUITES = {
         "tests/test_elastic.py", "tests/test_tune.py",
         "tests/test_platform_utils.py",
     ],
-    "serving": ["tests/test_serve.py", "tests/test_serve_ft.py"],
+    "serving": ["tests/test_serve.py", "tests/test_serve_ft.py",
+                "tests/test_serve_speed.py"],
     "perf": ["tests/test_perf.py"],
     "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py",
                        "tests/test_profile_analyzer.py"],
@@ -105,6 +106,13 @@ KNOB_DIMS = [
     # redrive fast-forwards instead of replaying — the serving suite
     # must stay green either way (docs/serving.md#fault-tolerance).
     ("serve-journal-off", {"HOROVOD_SERVE_JOURNAL": "0"},
+     ["serving"]),
+    # raw-speed legs off = the slow-but-simple paths (every prompt
+    # recomputes / one token per tick): the serving suite must stay
+    # green with each leg disabled (docs/serving.md#raw-speed).
+    ("serve-prefix-off", {"HOROVOD_SERVE_PREFIX_CACHE": "0"},
+     ["serving"]),
+    ("serve-spec-off", {"HOROVOD_SERVE_SPEC": "0"},
      ["serving"]),
 ]
 
@@ -236,11 +244,14 @@ def build_steps():
         "bench: overlap sweep smoke",
         f"{py} bench.py --overlap --cpu", timeout=15))
     steps.append(_step(
-        # serving load-gen smoke: the continuous-batching engine under
-        # closed-loop and Poisson load emits plausible SLO rows (every
-        # request completes, percentiles ordered, batch fill in (0,1]),
-        # CPU-virtual labeled (docs/serving.md) — all CPU-virtual.
-        "bench: serve load-gen smoke",
+        # serving load-gen + raw-speed smoke: closed-loop and Poisson
+        # load emit plausible SLO rows, AND the three speed legs
+        # (radix prefix cache, chunked prefill, speculative decoding)
+        # each run off->on over the same workload with byte-identical
+        # greedy output — a broken identity contract fails the bench
+        # itself, the speedup rows ride the artifact for the perf gate
+        # (docs/serving.md#raw-speed) — all CPU-virtual.
+        "bench: serve load-gen + speed-legs smoke",
         f"{py} bench.py --serve --cpu", timeout=15))
     steps.append(_step(
         # perf regression gate smoke: bench.py --cpu runs three times —

@@ -135,6 +135,13 @@ _SLOW_TESTS = {  # file::test (param ids stripped), >= ~8 s measured
         # serve tests keep fast-tier coverage
         "test_engine_matches_reference_greedy_decode",
     },
+    "test_serve_speed.py": {
+        # 8 engine builds (~2 s jit each): the full prefix x chunked x
+        # spec determinism matrix; the CI serving leg (-m "") runs it,
+        # and the all-legs-on fast-tier test keeps the byte-identity
+        # gate on every pre-commit run
+        "test_determinism_matrix_all_leg_combinations",
+    },
     "test_serve_integration.py": {
         # 55 s — the single most expensive tier-1 test (tier-1 headroom,
         # PR 8): the full hvdrun --serve E2E (orbax restore + 3 streamed

@@ -57,6 +57,13 @@ def _launch_fleet(tmp_path, servable, port, chaos_spec=None, tag="a"):
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     env["HOROVOD_CONTROLLER_PORT"] = str(_free_port())
+    # Raw-speed legs pinned ON (not just defaulted): the byte-identity
+    # assertion below re-proves the PR-10 redrive contract with prefix
+    # sharing + speculative decoding active — a redriven stream must
+    # resume exactly where the dead incarnation stopped even when the
+    # replacement fleet's engines take the fast paths.
+    env["HOROVOD_SERVE_PREFIX_CACHE"] = "1"
+    env["HOROVOD_SERVE_SPEC"] = "1"
     cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
            "--min-np", "2", "--max-np", "2",
            "--host-discovery-script", str(disc),

@@ -168,6 +168,27 @@ def test_bench_serve_cpu_contract():
     assert rec["closed_loop"]["batch_fill"] >= \
         rec["poisson"]["batch_fill"]
     assert rec["serve_config"]["max_batch_tokens"] > 0
+    # raw-speed legs (docs/serving.md#raw-speed): each independently
+    # toggled off->on over the same workload, byte-identical output,
+    # and the leg's mechanism verifiably fired.  Thresholds are
+    # deliberately below the measured wins (prefix ~3-5x, chunk ~2-6x,
+    # spec ~1.3-1.5x) — this is a contract smoke, the perf gate's
+    # median±MAD rows track the actual trajectory.
+    legs = rec["legs"]
+    for leg in ("prefix", "chunked", "spec"):
+        assert legs[leg]["byte_identical"] is True, leg
+    assert legs["prefix"]["ttft_p50_speedup"] > 1.5
+    assert legs["prefix"]["on"]["prefix_hit_rate"] > 0
+    assert legs["prefix"]["on"]["prefill_chunks"] < \
+        legs["prefix"]["off"]["prefill_chunks"]
+    assert legs["chunked"]["gap_bound_ratio"] > 1.0
+    assert legs["spec"]["on"]["spec_accept_rate"] > 0
+    assert legs["spec"]["on"]["accepted"] >= 1
+    # the gate-able sub-rows ride the one artifact line
+    assert {r["metric"].split(" (")[0] for r in rec["sub_rows"]} == {
+        "serve prefix ttft p50 speedup",
+        "serve chunked prefill interference bound",
+        "serve spec decode speedup"}
 
 
 # ------------------------------------------------- supervisor unit tests

@@ -286,8 +286,12 @@ def test_engine_block_reuse_and_eos_eviction(llama_tiny):
     finish_reason='eos'; the freed blocks are reused by a later
     admission (LIFO observable through the allocator)."""
     model, cfg, params = llama_tiny
+    # prefix_cache off: this test asserts the RAW pool mechanics (free
+    # count restored, LIFO reuse); with the cache on, prompt blocks stay
+    # resident by design (tests/test_serve_speed.py covers that).
     scfg = _cfg(max_slots=1, block_size=4, cache_blocks=8,
-                max_seq_len=32, max_batch_tokens=8, prefill_chunk=8)
+                max_seq_len=32, max_batch_tokens=8, prefill_chunk=8,
+                prefix_cache=False)
     engine = ServeEngine(model, cfg, params, scfg,
                          mesh=_one_device_mesh())
     free0 = engine.scheduler.allocator.free_count
@@ -500,8 +504,13 @@ def test_serve_config_validation_matrix():
         _cfg(max_seq_len=-1).validate()
     with pytest.raises(ValueError, match="HOROVOD_SERVE_CACHE_BLOCKS"):
         _cfg(cache_blocks=0).validate()
-    with pytest.raises(ValueError, match="prefill_chunk"):
+    with pytest.raises(ValueError, match="PREFILL_CHUNK"):
         _cfg(prefill_chunk=32, max_batch_tokens=16).validate()
+    with pytest.raises(ValueError, match="SPEC_K"):
+        _cfg(spec_k=0).validate()
+    with pytest.raises(ValueError, match="SPEC_K"):
+        _cfg(spec_k=8, prefill_chunk=8).validate()
+    _cfg(spec_k=8, prefill_chunk=8, spec_decode=False).validate()
     with pytest.raises(ValueError, match="max_seq"):
         _cfg(max_seq_len=64).validate(model_max_seq=32)
     _cfg().validate(model_max_seq=32)  # valid config passes

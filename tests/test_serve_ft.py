@@ -488,7 +488,16 @@ def test_doctor_serve_renders_stats_view(tmp_path, capsys):
             "engine": {"tick": 120, "active": 2, "waiting": 0,
                        "completed": 7, "batch_fill": 0.5,
                        "free_blocks": 20, "tokens_prefill": 40,
-                       "tokens_decode": 60}}
+                       "tokens_decode": 60, "prefill_chunks": 11,
+                       "prefix_cache": {"enabled": True, "hits": 5,
+                                        "hit_tokens": 300,
+                                        "blocks_shared": 18,
+                                        "cached_blocks": 30,
+                                        "cow_copies": 2, "evictions": 1,
+                                        "hit_rate": 0.71},
+                       "spec": {"enabled": True, "drafted_tokens": 40,
+                                "accepted_tokens": 22,
+                                "accept_rate": 0.55}}}
     p = tmp_path / "stats.json"
     p.write_text(json.dumps(view))
     assert doctor_main([str(p), "--serve"]) == 0
@@ -496,6 +505,8 @@ def test_doctor_serve_renders_stats_view(tmp_path, capsys):
     assert "ADMISSION: ACCEPTING" in out
     assert "JOURNAL: on" in out and "9 entries" in out
     assert "ENGINE: tick 120" in out
+    assert "PREFIX CACHE: on" in out and "hit rate 0.71" in out
+    assert "SPECULATIVE DECODE: on" in out and "accept rate 0.55" in out
     view["router"]["draining"] = True
     view["journal"]["enabled"] = False
     view.pop("engine")
