@@ -152,7 +152,7 @@ def fail(reason: str, cause: str = "bench-crash", **extra) -> int:
     distinguish infrastructure failures from real bench bugs (the r4
     flash-mxu rc=1 trio was unattributable without it):
     tunnel-down | tunnel-down-during-run | timeout | invalid-result |
-    bench-crash."""
+    bench-crash | sanitized-lib."""
     print(json.dumps({"metric": "BENCH_INVALID", "value": 0,
                       "unit": "error", "vs_baseline": 0,
                       "cause": cause, "error": reason, **extra}))
@@ -473,6 +473,23 @@ def main() -> int:
     args.score_dtype_explicit = args.score_dtype is not None
     if args.score_dtype is None:
         args.score_dtype = "input"
+
+    # Sanitizer guard (docs/static-analysis.md): a TSan/ASan/UBSan build
+    # of the native core is 5-20x slower — its numbers are correctness
+    # evidence, never performance evidence, so every bench artifact path
+    # refuses it outright rather than emitting a poisoned row the perf
+    # gate would later baseline against.  Checked only when
+    # HOROVOD_NATIVE_LIB overrides the default: the default library is
+    # always a plain build, so the common case pays nothing.
+    if os.environ.get("HOROVOD_NATIVE_LIB", ""):
+        from horovod_tpu.common.basics import native_build_info
+        san = native_build_info().get("sanitizer", "none")
+        if san != "none":
+            return fail(
+                f"HOROVOD_NATIVE_LIB is a {san} sanitizer build; bench "
+                "artifacts from a sanitized library are invalid by "
+                "construction (docs/static-analysis.md)",
+                cause="sanitized-lib")
 
     if not args.inner:
         return supervise([a for a in sys.argv[1:] if a != "--inner"])

@@ -87,6 +87,20 @@ bool TakeStash(ApiHandle* h, Response* out) {
 
 extern "C" {
 
+// Build identity of the loaded library: name-keyed "k=v" pairs,
+// space-separated; new pairs APPEND and parsers key on names (the
+// hvd_core_metrics versioning contract).  `sanitizer` is stamped by the
+// Makefile's SAN mode so a TSan/ASan/UBSan build can never silently
+// masquerade as the production library — the Python loader logs it,
+// hvd.metrics_snapshot() exports it, and bench artifact runs refuse it
+// (docs/static-analysis.md).
+#ifndef HVD_SANITIZER
+#define HVD_SANITIZER "none"
+#endif
+const char* hvd_native_build_info(void) {
+  return "sanitizer=" HVD_SANITIZER;
+}
+
 void* hvd_loopback_hub_create(int size) { return new LoopbackHub(size); }
 void hvd_loopback_hub_destroy(void* hub) {
   delete static_cast<LoopbackHub*>(hub);

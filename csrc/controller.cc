@@ -92,6 +92,8 @@ Controller::Controller(Transport* transport, const ControllerOptions& opts)
   long k = CtlEnvLong("HOROVOD_BYPASS_STABLE_CYCLES",
                       opts_.bypass_stable_cycles);
   if (k >= 1) opts_.bypass_stable_cycles = static_cast<int>(k);
+  fusion_threshold_.store(opts_.fusion_threshold_bytes,
+                          std::memory_order_relaxed);
 }
 
 // ---------------------------------------------------------- plan-epoch bypass
@@ -272,6 +274,10 @@ void Controller::ReplicaErase(int slot) {
     r.name = s.name;
     r.signature = s.sig;
     r.bytes = s.bytes;
+    // carry_ is shared with the bypass path (a submitter's thread can
+    // refill it via BreakEpochLocked), hence the lock; never held here
+    // already — no RunCycle caller reaches ReplicaErase under bypass_mu_.
+    std::lock_guard<std::mutex> lk(bypass_mu_);
     carry_.push_back(std::move(r));
   }
   // Purge this slot's FIFO entry: a stale entry would later evict whatever
@@ -324,7 +330,7 @@ std::vector<Response> Controller::BuildResponses() {
   int num_joined = static_cast<int>(
       std::count(joined_.begin(), joined_.end(), true));
 
-  Fuser fuser(opts_.fusion_threshold_bytes);
+  Fuser fuser(fusion_threshold());
   std::vector<std::string> done_names;
   auto now = std::chrono::steady_clock::now();
   for (const auto& name : arrival_order_) {
@@ -402,8 +408,14 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
 
   // 1. Split local submissions: cache hits flip a bit; signature changes
   //    request invalidation and renegotiate; the rest go the full path.
-  std::vector<Request> uncached = std::move(carry_);
-  carry_.clear();
+  //    The carry_ handoff takes bypass_mu_: an epoch break on a
+  //    submitter's thread may be refilling it concurrently.
+  std::vector<Request> uncached;
+  {
+    std::lock_guard<std::mutex> lk(bypass_mu_);
+    uncached = std::move(carry_);
+    carry_.clear();
+  }
   for (const auto& req : pending) {
     if (req.type == RequestType::JOIN || opts_.cache_capacity <= 0) {
       uncached.push_back(req);
@@ -619,7 +631,7 @@ bool Controller::RunCycle(const std::vector<Request>& pending,
   }
 
   out->clear();
-  Fuser cached(opts_.fusion_threshold_bytes);
+  Fuser cached(fusion_threshold());
   for (uint32_t i = 0; i < bc_slots && i < replica_.size(); i++) {
     if (!agreed[i] || !replica_[i].valid) continue;
     const CacheSlot& s = replica_[i];

@@ -116,6 +116,89 @@ struct ControllerStats {
   LatencyHistogram negotiation_age_us;  // first-seen -> ready, rank 0 only
 };
 
+// Atomic mirror of LatencyHistogram: the cycle loop observes while the
+// Python metrics thread (hvd_core_metrics) and the flight recorder
+// snapshot concurrently.  Relaxed ordering everywhere — these are
+// monotone statistics, not synchronization; a snapshot that splits an
+// Observe across count/sum/bucket is off by one observation, which is
+// exactly the tolerance the plain-struct version silently assumed while
+// being a data race (TSan finding, docs/static-analysis.md).
+struct AtomicLatencyHistogram {
+  std::atomic<uint64_t> buckets[LatencyHistogram::kBuckets] = {};
+  std::atomic<uint64_t> count{0};
+  std::atomic<uint64_t> sum_us{0};
+  void Observe(uint64_t us) {
+    count.fetch_add(1, std::memory_order_relaxed);
+    sum_us.fetch_add(us, std::memory_order_relaxed);
+    int b = 0;
+    while (b < LatencyHistogram::kBuckets - 1 && us > (1ull << b)) b++;
+    buckets[b].fetch_add(1, std::memory_order_relaxed);
+  }
+  LatencyHistogram Snapshot() const {
+    LatencyHistogram h;
+    h.count = count.load(std::memory_order_relaxed);
+    h.sum_us = sum_us.load(std::memory_order_relaxed);
+    for (int i = 0; i < LatencyHistogram::kBuckets; i++)
+      h.buckets[i] = buckets[i].load(std::memory_order_relaxed);
+    return h;
+  }
+};
+
+// Atomic mirror of ControllerStats (same fields, same meanings): the
+// counters are written by the cycle-loop thread AND — on the locked-
+// epoch fast path — by submitter threads under bypass_mu_, while
+// hvd_core_metrics/hvd_core_stats snapshot them from the Python metrics
+// thread and the flight recorder reads them from a fatal-signal
+// handler.  Lock-free atomics serve all four: writers stay wait-free on
+// the hot path and the crash-time reader can never block behind a
+// wedged lock (atomic loads are async-signal-safe).  Snapshot() renders
+// the plain POD every external consumer keeps seeing.
+struct AtomicControllerStats {
+  std::atomic<uint64_t> cycles{0};
+  std::atomic<uint64_t> cache_hits{0};
+  std::atomic<uint64_t> cache_misses{0};
+  std::atomic<uint64_t> stall_warnings{0};
+  std::atomic<uint64_t> responses{0};
+  std::atomic<uint64_t> cached_responses{0};
+  std::atomic<uint64_t> bytes_gathered{0};
+  std::atomic<uint64_t> bytes_broadcast{0};
+  std::atomic<uint64_t> last_cycle_bytes{0};
+  std::atomic<uint64_t> bytes_reduced{0};
+  std::atomic<uint64_t> tensors_negotiated{0};
+  std::atomic<uint64_t> fused_batches{0};
+  std::atomic<uint64_t> fused_batch_bytes{0};
+  std::atomic<uint64_t> bypass_cycles{0};
+  std::atomic<uint64_t> epoch_locks{0};
+  std::atomic<uint64_t> epoch_invalidations{0};
+  AtomicLatencyHistogram cycle_time_us;
+  AtomicLatencyHistogram negotiation_age_us;
+  ControllerStats Snapshot() const {
+    ControllerStats s;
+    s.cycles = cycles.load(std::memory_order_relaxed);
+    s.cache_hits = cache_hits.load(std::memory_order_relaxed);
+    s.cache_misses = cache_misses.load(std::memory_order_relaxed);
+    s.stall_warnings = stall_warnings.load(std::memory_order_relaxed);
+    s.responses = responses.load(std::memory_order_relaxed);
+    s.cached_responses = cached_responses.load(std::memory_order_relaxed);
+    s.bytes_gathered = bytes_gathered.load(std::memory_order_relaxed);
+    s.bytes_broadcast = bytes_broadcast.load(std::memory_order_relaxed);
+    s.last_cycle_bytes = last_cycle_bytes.load(std::memory_order_relaxed);
+    s.bytes_reduced = bytes_reduced.load(std::memory_order_relaxed);
+    s.tensors_negotiated =
+        tensors_negotiated.load(std::memory_order_relaxed);
+    s.fused_batches = fused_batches.load(std::memory_order_relaxed);
+    s.fused_batch_bytes =
+        fused_batch_bytes.load(std::memory_order_relaxed);
+    s.bypass_cycles = bypass_cycles.load(std::memory_order_relaxed);
+    s.epoch_locks = epoch_locks.load(std::memory_order_relaxed);
+    s.epoch_invalidations =
+        epoch_invalidations.load(std::memory_order_relaxed);
+    s.cycle_time_us = cycle_time_us.Snapshot();
+    s.negotiation_age_us = negotiation_age_us.Snapshot();
+    return s;
+  }
+};
+
 class Controller {
  public:
   Controller(Transport* transport, const ControllerOptions& opts);
@@ -148,15 +231,24 @@ class Controller {
     return epoch_locked_.load(std::memory_order_acquire);
   }
 
-  const ControllerStats& stats() const { return stats_; }
+  // Point-in-time copy built from relaxed atomic loads: safe against the
+  // cycle loop, the bypass submit path, and even a fatal-signal handler
+  // (postmortem.cc reads it crash-time).
+  ControllerStats stats() const { return stats_.Snapshot(); }
   int rank() const { return transport_->rank(); }
   int size() const { return transport_->size(); }
 
   // Autotune hook: only rank 0 fuses, so retuning the threshold here is
   // globally consistent (reference: rank-0 tunes then broadcasts,
-  // controller.cc:39-53 SynchronizeParameters).
-  void set_fusion_threshold(int64_t v) { opts_.fusion_threshold_bytes = v; }
-  int64_t fusion_threshold() const { return opts_.fusion_threshold_bytes; }
+  // controller.cc:39-53 SynchronizeParameters).  Atomic: written by the
+  // cycle loop's autotune update, read by hvd_core_metrics from the
+  // Python metrics thread (TSan finding, docs/static-analysis.md).
+  void set_fusion_threshold(int64_t v) {
+    fusion_threshold_.store(v, std::memory_order_relaxed);
+  }
+  int64_t fusion_threshold() const {
+    return fusion_threshold_.load(std::memory_order_relaxed);
+  }
 
   // Tracing-plane hook (trace.h): cycle-phase spans land here when set.
   void set_trace(TraceRing* t) { trace_ = t; }
@@ -188,7 +280,8 @@ class Controller {
 
   Transport* transport_;
   ControllerOptions opts_;
-  ControllerStats stats_;
+  std::atomic<int64_t> fusion_threshold_{0};
+  AtomicControllerStats stats_;
   TraceRing* trace_ = nullptr;
 
   std::unordered_map<std::string, Entry> table_;
@@ -202,7 +295,11 @@ class Controller {
   std::list<std::pair<int, std::string>> fifo_;  // (slot, name) insert order
   std::vector<char> local_hits_;     // this rank's pending cache-hit bits
   std::vector<char> local_inv_;      // invalidations this rank wants
-  std::vector<Request> carry_;       // re-materialized after invalidation
+  // Requests re-materialized for the full path (invalidation, capacity
+  // eviction, epoch break).  Guarded by bypass_mu_: BreakEpochLocked
+  // refills it from a SUBMITTER's thread while the cycle loop consumes
+  // it at the top of RunCycle (TSan finding, docs/static-analysis.md).
+  std::vector<Request> carry_;
   // rank-0: per-slot first-partial-hit time for stall detection (0 = none)
   std::vector<std::chrono::steady_clock::time_point> partial_since_;
   std::vector<char> partial_warned_;

@@ -91,8 +91,8 @@ bool Core::Poll(Response* out) {
 
 bool Core::Wait(Response* out, double timeout_s) {
   std::unique_lock<std::mutex> lk(mu_);
-  bool got = cv_.wait_for(
-      lk, std::chrono::duration<double>(timeout_s),
+  bool got = CvWaitFor(
+      &cv_, &lk, std::chrono::duration<double>(timeout_s),
       [&] { return !responses_.empty() || stopped_.load(); });
   if (!got || responses_.empty()) return false;
   *out = responses_.front();
@@ -113,8 +113,9 @@ ControllerStats Core::stats() const { return controller_->stats(); }
 Core::HealthSnapshot Core::health_snapshot() const {
   HealthSnapshot h;
   h.now_us = trace_.NowUs();
-  // Plain read of the cycle-loop-owned counter: a torn value is a
-  // cycle count off by one, acceptable for a liveness probe.
+  // Relaxed atomic snapshot (AtomicControllerStats): lock-free, so this
+  // stays safe from a fatal-signal handler and can never block behind a
+  // wedged cycle loop.
   h.cycles = controller_->stats().cycles;
   uint64_t lp = last_progress_us_.load(std::memory_order_relaxed);
   h.last_progress_age_us = h.now_us > lp ? h.now_us - lp : 0;
@@ -229,8 +230,8 @@ void Core::Loop() {
             last_progress_us_.store(trace_.NowUs(),
                                     std::memory_order_relaxed);
             std::unique_lock<std::mutex> lk(mu_);
-            submit_cv_.wait_for(
-                lk,
+            CvWaitFor(
+                &submit_cv_, &lk,
                 std::chrono::duration<double, std::milli>(
                     opts_.cycle_time_ms),
                 [&] {
@@ -287,7 +288,7 @@ void Core::Loop() {
       bool woke_early;
       {
         std::unique_lock<std::mutex> lk(mu_);
-        woke_early = submit_cv_.wait_for(lk, cycle - elapsed, [&] {
+        woke_early = CvWaitFor(&submit_cv_, &lk, cycle - elapsed, [&] {
           return !pending_.empty() || stopped_.load() ||
                  shutdown_requested_.load();
         });

@@ -15,6 +15,7 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <memory>
 #include <mutex>
@@ -33,6 +34,31 @@
 #include "transport.h"
 
 namespace hvdtpu {
+
+// Timed condition-variable wait.  Under SAN=tsan builds (HVD_TSAN_BUILD,
+// csrc/Makefile) the wait is routed through wait_until on the SYSTEM
+// clock: libstdc++'s wait_for waits on the steady clock via
+// pthread_cond_clockwait, which gcc-10's libtsan does not intercept —
+// TSan then loses the mutex's lock accounting across the wait and
+// floods the run with phantom "double lock" / "data race" reports
+// (minimal repro + rationale in docs/static-analysis.md).  The system
+// clock maps to the intercepted pthread_cond_timedwait.  Production
+// builds keep the steady-clock wait_for: a system clock step must not
+// stretch a cycle tick.
+template <class Rep, class Period, class Pred>
+bool CvWaitFor(std::condition_variable* cv,
+               std::unique_lock<std::mutex>* lk,
+               std::chrono::duration<Rep, Period> d, Pred pred) {
+#ifdef HVD_TSAN_BUILD
+  return cv->wait_until(
+      *lk,
+      std::chrono::system_clock::now() +
+          std::chrono::duration_cast<std::chrono::microseconds>(d),
+      pred);
+#else
+  return cv->wait_for(*lk, d, pred);
+#endif
+}
 
 struct CoreOptions {
   double cycle_time_ms = 1.0;

@@ -148,8 +148,10 @@ void WriteFlightRecord(Core* core, int fd, const char* reason) {
   PutKV(fd, "transport_healthy", h.transport_healthy ? 1 : 0);
   PutKV(fd, "shutdown", h.shutdown ? 1 : 0);
 
-  // Plain-read copies: the owning threads may be mid-update, and a
-  // counter off by one is an acceptable price at crash time.
+  // Relaxed atomic snapshots (Atomic{Controller,Transport}Stats): loads
+  // only, so they are async-signal-safe and never block behind a lock
+  // the interrupted thread holds.  A counter landing mid-increment is
+  // off by one — an acceptable price at crash time.
   ControllerStats s = core->stats();
   TransportStats ts = core->transport_stats();
   PutStr(fd, "[metrics]\n");

@@ -59,6 +59,11 @@ void LoopbackHub::Kick() {
   cv_.notify_all();
 }
 
+uint64_t LoopbackHub::kick_gen() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return kick_gen_;
+}
+
 bool LoopbackHub::Bcast(int rank, std::string* frame,
                         uint64_t* consumed_rounds) {
   std::unique_lock<std::mutex> lk(mu_);

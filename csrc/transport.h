@@ -21,6 +21,7 @@
 
 #pragma once
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <mutex>
@@ -47,6 +48,34 @@ struct TransportStats {
   // (every frame — the header/seq/payload assembly copy is gone).
   uint64_t frames_coalesced = 0;
   uint64_t coalesced_bytes = 0;
+};
+
+// Atomic mirror of TransportStats: the cycle-loop thread mutates these
+// counters while hvd_core_metrics snapshots them from the Python metrics
+// thread and the flight recorder reads them from a fatal-signal handler
+// — lock-free relaxed atomics serve all three (TSan finding,
+// docs/static-analysis.md).  Snapshot() renders the plain POD the
+// Transport API keeps returning.
+struct AtomicTransportStats {
+  std::atomic<uint64_t> reconnects{0};
+  std::atomic<uint64_t> reconnect_failures{0};
+  std::atomic<uint64_t> frames_resent{0};
+  std::atomic<uint64_t> frames_dropped{0};
+  std::atomic<uint64_t> chaos_faults{0};
+  std::atomic<uint64_t> frames_coalesced{0};
+  std::atomic<uint64_t> coalesced_bytes{0};
+  TransportStats Snapshot() const {
+    TransportStats s;
+    s.reconnects = reconnects.load(std::memory_order_relaxed);
+    s.reconnect_failures =
+        reconnect_failures.load(std::memory_order_relaxed);
+    s.frames_resent = frames_resent.load(std::memory_order_relaxed);
+    s.frames_dropped = frames_dropped.load(std::memory_order_relaxed);
+    s.chaos_faults = chaos_faults.load(std::memory_order_relaxed);
+    s.frames_coalesced = frames_coalesced.load(std::memory_order_relaxed);
+    s.coalesced_bytes = coalesced_bytes.load(std::memory_order_relaxed);
+    return s;
+  }
 };
 
 // Deterministic seeded fault injector for the TCP transport (the csrc
@@ -121,6 +150,13 @@ class LoopbackHub {
   // workers peek for a kick (consumed per caller via kicks_seen).
   bool Peek(int rank, uint64_t* kicks_seen);
   void Kick();
+  // Current kick generation: Bcast consumers sync their kicks_seen to
+  // it so a kick outstanding while a worker is ON the wire is absorbed
+  // as stale — the exact semantics the TCP transport gets for free by
+  // draining empty frames in its Bcast recv loop.  Without this, a
+  // round-N break's kick would spuriously break the NEXT locked epoch
+  // (found by the PR-12 race harness, docs/static-analysis.md).
+  uint64_t kick_gen();
   int size() const { return size_; }
 
  private:
@@ -146,7 +182,13 @@ class LoopbackTransport : public Transport {
     return hub_->Gather(rank_, mine, all);
   }
   bool Bcast(std::string* frame) override {
-    return hub_->Bcast(rank_, frame, &consumed_rounds_);
+    bool ok = hub_->Bcast(rank_, frame, &consumed_rounds_);
+    // A consumed bcast proves this rank is on the lock-step wire, so
+    // every kick issued up to now is stale (kicks only tell LOCKED
+    // workers to rejoin; locking again requires a NEWER bcast's lock
+    // flag).  Mirrors TcpTransport::Bcast draining empty kick frames.
+    kicks_seen_ = hub_->kick_gen();
+    return ok;
   }
   bool Peek() override { return hub_->Peek(rank_, &kicks_seen_); }
   void Kick() override {
@@ -176,7 +218,9 @@ class TcpTransport : public Transport {
   bool Bcast(std::string* frame) override;
   bool Peek() override;
   void Kick() override;
-  TransportStats transport_stats() const override { return stats_; }
+  TransportStats transport_stats() const override {
+    return stats_.Snapshot();
+  }
   void set_trace(TraceRing* t) override { trace_ = t; }
 
  private:
@@ -230,7 +274,7 @@ class TcpTransport : public Transport {
   int coord_port_ = 0;
 
   ChaosInjector chaos_;
-  TransportStats stats_;
+  AtomicTransportStats stats_;
   TraceRing* trace_ = nullptr;
 };
 

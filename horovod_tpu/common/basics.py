@@ -28,6 +28,7 @@ _LIB_PATH = os.path.join(_CSRC, "libhvd_tpu_core.so")
 
 _lib = None
 _lib_lock = threading.Lock()
+_build_info: Optional[dict] = None
 
 # RequestType values (must match csrc/common.h)
 OP_ALLREDUCE = 0
@@ -63,12 +64,43 @@ def _needs_rebuild() -> bool:
     return False
 
 
+def _read_build_info(lib: ctypes.CDLL) -> dict:
+    """Parse hvd_native_build_info's "k=v k=v" pairs (csrc/c_api.cc).
+    Libraries predating the symbol report sanitizer=none — the tag
+    exists precisely to out a sanitized build, and an old library can
+    only be a plain one."""
+    info = {"sanitizer": "none"}
+    try:
+        fn = lib.hvd_native_build_info
+    except AttributeError:
+        return info
+    fn.restype = ctypes.c_char_p
+    fn.argtypes = []
+    raw = fn()
+    for pair in (raw.decode() if raw else "").split():
+        k, _, v = pair.partition("=")
+        if k:
+            info[k] = v
+    return info
+
+
 def load_library() -> ctypes.CDLL:
-    global _lib
+    global _lib, _build_info
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if os.path.isdir(_CSRC):
+        override = os.environ.get("HOROVOD_NATIVE_LIB", "")
+        if override:
+            # Explicit library override (docs/static-analysis.md): how
+            # tests/workers load a sanitizer build (SAN=tsan|asan|ubsan,
+            # csrc/Makefile) without touching the default artifact.  No
+            # rebuild-on-demand: the override names an exact binary.
+            if not os.path.exists(override):
+                raise RuntimeError(
+                    f"HOROVOD_NATIVE_LIB={override} does not exist "
+                    "(build it: make -C csrc [SAN=tsan|asan|ubsan])")
+            path = override
+        elif os.path.isdir(_CSRC):
             # Source checkout: csrc/ is authoritative (rebuilds on edit).
             if _needs_rebuild():
                 _build_library()
@@ -80,6 +112,23 @@ def load_library() -> ctypes.CDLL:
                 "libhvd_tpu_core.so not found: neither a csrc/ source tree "
                 f"nor the installed library at {_INSTALLED_LIB}")
         lib = ctypes.CDLL(path)
+        _build_info = _read_build_info(lib)
+        if _build_info.get("sanitizer", "none") != "none":
+            # Loud on load: a sanitizer build is 5-20x slower and must
+            # never silently leak into a benchmark or production run
+            # (bench.py refuses artifact runs outright).
+            msg = (f"native core loaded from {path} is a "
+                   f"{_build_info['sanitizer']} SANITIZER build — "
+                   "correctness tooling only, never benchmark with it "
+                   "(docs/static-analysis.md)")
+            try:
+                from . import hvdlogging as log
+                log.warning(msg)
+            except ImportError:
+                # File-path loaded (the scripts/ probe-loader pattern):
+                # no package context, stderr is the only channel.
+                import sys
+                print(f"WARNING: {msg}", file=sys.stderr)
         # signatures
         lib.hvd_loopback_hub_create.restype = ctypes.c_void_p
         lib.hvd_loopback_hub_create.argtypes = [ctypes.c_int]
@@ -177,6 +226,24 @@ def load_library() -> ctypes.CDLL:
         lib.hvd_bandit2_best_b.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
+
+
+def native_build_info() -> dict:
+    """Build identity of the native library (loads it if needed):
+    ``{"sanitizer": "none"|"tsan"|"asan"|"ubsan", ...}`` — the tag a
+    sanitized build (csrc/Makefile SAN=...) carries so it can never
+    silently masquerade as the production library
+    (docs/static-analysis.md)."""
+    load_library()
+    return dict(_build_info or {"sanitizer": "none"})
+
+
+def loaded_build_info() -> Optional[dict]:
+    """Like :func:`native_build_info` but never loads the library:
+    None until something else has (metrics_snapshot uses this so a
+    pure-SPMD process is not forced to build csrc)."""
+    info = _build_info
+    return dict(info) if info is not None else None
 
 
 def _dbuf(vals):
@@ -409,7 +476,20 @@ class CoordinationCore:
                                "(transport bring-up failure?)")
         self._h = handle
         self._lib = lib
-        self._buf = ctypes.create_string_buffer(1 << 20)
+        # Response/snapshot buffers are PER THREAD: the metrics
+        # publisher, heartbeat publisher and negotiated submit path all
+        # call into this handle concurrently, and a shared buffer let
+        # one thread's hvd_core_metrics overwrite another's in-flight
+        # wait() response (found by the PR-12 race harness,
+        # tests/test_native_sanitize.py; docs/static-analysis.md).
+        self._tls = threading.local()
+
+    def _buf_for(self, min_size: int = 1 << 20):
+        buf = getattr(self._tls, "buf", None)
+        if buf is None or len(buf) < min_size:
+            buf = ctypes.create_string_buffer(max(min_size, 1 << 20))
+            self._tls.buf = buf
+        return buf
 
     # ------------------------------------------------------------ constructors
     @classmethod
@@ -463,28 +543,29 @@ class CoordinationCore:
         self._lib.hvd_core_join(self._h)
 
     def _grow(self, needed: int) -> None:
-        self._buf = ctypes.create_string_buffer(max(needed + 1,
-                                                    2 * len(self._buf)))
+        self._buf_for(max(needed + 1, 2 * len(self._buf_for())))
 
     def poll(self) -> Optional[CoreResponse]:
-        n = self._lib.hvd_core_poll(self._h, self._buf, len(self._buf))
+        buf = self._buf_for()
+        n = self._lib.hvd_core_poll(self._h, buf, len(buf))
         if n < 0:  # -(needed+1): response retained in the stash; retry
             self._grow(-n)
-            n = self._lib.hvd_core_poll(self._h, self._buf, len(self._buf))
+            buf = self._buf_for()
+            n = self._lib.hvd_core_poll(self._h, buf, len(buf))
         if n <= 0:
             return None
-        return CoreResponse(self._buf.value.decode())
+        return CoreResponse(buf.value.decode())
 
     def wait(self, timeout_s: float = 30.0) -> Optional[CoreResponse]:
-        n = self._lib.hvd_core_wait(self._h, timeout_s, self._buf,
-                                    len(self._buf))
+        buf = self._buf_for()
+        n = self._lib.hvd_core_wait(self._h, timeout_s, buf, len(buf))
         if n < 0:
             self._grow(-n)
-            n = self._lib.hvd_core_wait(self._h, timeout_s, self._buf,
-                                        len(self._buf))
+            buf = self._buf_for()
+            n = self._lib.hvd_core_wait(self._h, timeout_s, buf, len(buf))
         if n <= 0:
             return None
-        return CoreResponse(self._buf.value.decode())
+        return CoreResponse(buf.value.decode())
 
     def enable_autotune(self, warmup_samples: int = 3,
                         steps_per_sample: int = 10,
@@ -520,12 +601,13 @@ class CoordinationCore:
         {"count", "sum" (µs), "buckets": [28 power-of-2-µs bins]}}}``.
         Unknown lines are ignored, so a newer library never breaks an
         older parser — the versioning contract is name-keyed lines."""
-        n = self._lib.hvd_core_metrics(self._h, self._buf, len(self._buf))
-        if n >= len(self._buf):
+        buf = self._buf_for()
+        n = self._lib.hvd_core_metrics(self._h, buf, len(buf))
+        if n >= len(buf):
             self._grow(n)
-            n = self._lib.hvd_core_metrics(self._h, self._buf,
-                                           len(self._buf))
-        text = self._buf.value.decode()
+            buf = self._buf_for()
+            n = self._lib.hvd_core_metrics(self._h, buf, len(buf))
+        text = buf.value.decode()
         lines = text.splitlines()
         if not lines or not lines[0].startswith("hvd_metrics_v"):
             raise RuntimeError(f"unrecognized native metrics header: "
@@ -552,12 +634,13 @@ class CoordinationCore:
         native leg of the perf-attribution plane (docs/profiling.md).
         Extra line fields from a newer library are ignored, the
         hvd_core_metrics versioning contract."""
-        n = self._lib.hvd_core_op_stats(self._h, self._buf, len(self._buf))
-        if n >= len(self._buf):
+        buf = self._buf_for()
+        n = self._lib.hvd_core_op_stats(self._h, buf, len(buf))
+        if n >= len(buf):
             self._grow(n)
-            n = self._lib.hvd_core_op_stats(self._h, self._buf,
-                                            len(self._buf))
-        lines = self._buf.value.decode().splitlines()
+            buf = self._buf_for()
+            n = self._lib.hvd_core_op_stats(self._h, buf, len(buf))
+        lines = buf.value.decode().splitlines()
         if not lines or not lines[0].startswith("hvd_op_stats_v"):
             raise RuntimeError(f"unrecognized native op-stats header: "
                                f"{lines[:1]!r}")
@@ -580,12 +663,13 @@ class CoordinationCore:
         it answers even while the cycle loop is wedged — which is when
         the postmortem plane asks (docs/postmortem.md).  Unknown lines
         from a newer library are ignored (hvd_core_metrics contract)."""
-        n = self._lib.hvd_core_health(self._h, self._buf, len(self._buf))
-        if n >= len(self._buf):
+        buf = self._buf_for()
+        n = self._lib.hvd_core_health(self._h, buf, len(buf))
+        if n >= len(buf):
             self._grow(n)
-            n = self._lib.hvd_core_health(self._h, self._buf,
-                                          len(self._buf))
-        lines = self._buf.value.decode().splitlines()
+            buf = self._buf_for()
+            n = self._lib.hvd_core_health(self._h, buf, len(buf))
+        lines = buf.value.decode().splitlines()
         if not lines or not lines[0].startswith("hvd_health_v"):
             raise RuntimeError(f"unrecognized native health header: "
                                f"{lines[:1]!r}")
@@ -630,12 +714,12 @@ class CoordinationCore:
         versioning contract mirrors hvd_core_metrics."""
         events = []
         header = {"version": 0, "now_us": 0, "dropped": 0}
+        buf = self._buf_for()
         while True:
-            n = self._lib.hvd_core_trace(self._h, self._buf,
-                                         len(self._buf))
+            n = self._lib.hvd_core_trace(self._h, buf, len(buf))
             if n <= 0:
                 break
-            lines = self._buf.value.decode().splitlines()
+            lines = buf.value.decode().splitlines()
             if not lines or not lines[0].startswith("hvd_trace_v"):
                 raise RuntimeError(f"unrecognized native trace header: "
                                    f"{lines[:1]!r}")

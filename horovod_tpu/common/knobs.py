@@ -322,6 +322,13 @@ _knob("HOROVOD_CONTROLLER", "auto", str,
       "(reference: HOROVOD_CONTROLLER in {mpi,gloo}, operations.cc:654).")
 _knob("HOROVOD_CONTROLLER_PORT", 29499, int,
       "TCP port of the rank-0 controller listener.")
+_knob("HOROVOD_NATIVE_LIB", "", str,
+      "Path of the native core library to load instead of the default "
+      "csrc/libhvd_tpu_core.so — how tests and workers run against a "
+      "sanitizer build (make -C csrc SAN=tsan|asan|ubsan; "
+      "docs/static-analysis.md).  The loader logs the build's sanitizer "
+      "tag, hvd.metrics_snapshot() exports it, and bench artifact runs "
+      "refuse sanitized libraries.  Empty = default resolution.")
 _knob("HOROVOD_CONTROLLER_RETRIES", 5, int,
       "Max reconnect attempts after a controller TCP connection drops "
       "(exponential backoff + jitter); 0 fails on the first drop. "
@@ -365,6 +372,18 @@ _knob("HOROVOD_CHAOS_TCP_DELAY_RATE", 0.0, float,
       "Per-frame-op probability of an injected delay.")
 _knob("HOROVOD_CHAOS_TCP_DELAY_MS", 0, int,
       "Injected transport delay length in milliseconds.")
+# --- test/CI infrastructure contracts (registered so scripts/hvdlint.py's
+#     knob-registry invariant covers EVERY HOROVOD_* env var the tree
+#     reads — an unregistered one is invisible to docs and validation) ---
+_knob("HOROVOD_REAL_BACKENDS", False, _parse_bool,
+      "Test-infrastructure gate: run the Spark/Ray contract-fake suites "
+      "against the REAL pyspark/ray packages instead of the fakes "
+      "(scripts/run_real_backends.py; COVERAGE.md).  No runtime effect.")
+_knob("HOROVOD_SPARK_FAULT", "", str,
+      "Test-infrastructure fault hook for the Spark estimator: "
+      "'<rank>,<epoch>,<marker_path>' makes that rank fail once at that "
+      "epoch to exercise task-retry fault tolerance "
+      "(horovod_tpu/spark/estimator.py).  Empty disables.")
 _knob("HOROVOD_TF_JOIN", False, _parse_bool,
       "Route the TensorFlow frontend's dense collectives through the "
       "native controller so join() (uneven inputs) works: a joined rank "

@@ -477,6 +477,14 @@ class Runtime:
         from .utils import metrics as M
         M.RUNTIME_SIZE.set(self.size())
         M.RUNTIME_LOCAL_SIZE.set(self.local_size())
+        # Native build tag (docs/static-analysis.md): loaded_build_info
+        # never forces a library load — a pure-SPMD process that built no
+        # native core reports nothing rather than paying a csrc build.
+        from .common import basics as _basics
+        binfo = _basics.loaded_build_info()
+        if binfo is not None:
+            M.NATIVE_SANITIZER_BUILD.set(
+                1, sanitizer=binfo.get("sanitizer", "none"))
         M.PLAN_CACHE_HITS.set_total(self.plan_cache.hits)
         M.PLAN_CACHE_MISSES.set_total(self.plan_cache.misses)
         if self.stall_inspector is not None:

@@ -127,6 +127,6 @@ def validate_serve_knobs(knobs: Any) -> None:
             "(docs/serving.md)")
     if high and low and low > high:
         raise ValueError(
-            f"HOROVOD_SERVE_SHED_LOW={low} exceeds HOROVOD_SERVE_SHED_"
-            f"HIGH={high}; hysteresis needs low <= high "
-            "(docs/serving.md)")
+            f"HOROVOD_SERVE_SHED_LOW={low} exceeds "
+            f"HOROVOD_SERVE_SHED_HIGH={high}; hysteresis needs "
+            "low <= high (docs/serving.md)")

@@ -167,9 +167,12 @@ class FleetFrontend:
                      json.dumps(payload).encode())
 
     def _fetch_plan(self) -> Dict[str, Any]:
-        raw = self._kv().get_kv(self.addr, self.port, PLAN_SCOPE,
-                                plan_key(self.tick, self.epoch),
-                                timeout=self.plan_timeout_s)
+        # Rides _kv_get like every other serve KV leg (hvdlint
+        # serve-kv-retry): a transient rendezvous blip during the
+        # long-poll must stall this follower, not kill it — the
+        # poll's own timeout still surfaces as the None below.
+        raw = self._kv_get(PLAN_SCOPE, plan_key(self.tick, self.epoch),
+                           timeout=self.plan_timeout_s)
         if raw is None:
             raise TimeoutError(
                 f"rank {self.rank}: no plan "

@@ -467,6 +467,13 @@ RUNTIME_SIZE = REGISTRY.gauge(
     "hvd_runtime_size", "Worker chips in the mesh.")
 RUNTIME_LOCAL_SIZE = REGISTRY.gauge(
     "hvd_runtime_local_size", "Chips driven by this process.")
+NATIVE_SANITIZER_BUILD = REGISTRY.gauge(
+    "hvd_native_sanitizer_build",
+    "1 for the sanitizer tag of the loaded native core library "
+    "(sanitizer=none|tsan|asan|ubsan, csrc/Makefile SAN modes): the "
+    "build-info surface that keeps a 5-20x-slower sanitized library "
+    "from silently leaking into a benchmark or production fleet "
+    "(docs/static-analysis.md).")
 STALL_WARNINGS = REGISTRY.counter(
     "hvd_stall_warnings_total",
     "Python stall-inspector warnings (submitted but not completed).")

@@ -288,6 +288,23 @@ def main() -> int:
                     help="write perf_gate-compatible JSONL rows here "
                          "(gate with scripts/perf_gate.py check)")
     args = ap.parse_args()
+    if args.artifact and os.environ.get("HOROVOD_NATIVE_LIB", ""):
+        # Sanitizer guard (docs/static-analysis.md): a SAN=... build is
+        # 5-20x slower; a gate-consumable artifact from it would poison
+        # PERF_BASELINE.json comparisons.  Only the explicit lib
+        # override can be sanitized, so the common case pays nothing.
+        import importlib.util as _ilu
+        spec = _ilu.spec_from_file_location(
+            "_hvd_basics_san", os.path.join(REPO, "horovod_tpu",
+                                            "common", "basics.py"))
+        mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        san = mod.native_build_info().get("sanitizer", "none")
+        if san != "none":
+            print(f"--artifact refused: HOROVOD_NATIVE_LIB is a {san} "
+                  "sanitizer build (docs/static-analysis.md)",
+                  file=sys.stderr)
+            return 2
     rows = []
     for np_ in args.np:
         r = run_bench(np_, args.size_kb, args.tensors, args.iters)

@@ -58,7 +58,7 @@ SUITES = {
         "tests/test_native_core.py", "tests/test_negotiated.py",
         "tests/test_autotune.py", "tests/test_aux.py",
         "tests/test_metrics.py", "tests/test_chaos.py",
-        "tests/test_postmortem.py",
+        "tests/test_postmortem.py", "tests/test_native_sanitize.py",
     ],
     "torch": ["tests/test_torch.py"],
     "tensorflow-keras": ["tests/test_tensorflow.py", "tests/test_keras.py"],
@@ -68,6 +68,7 @@ SUITES = {
         "tests/test_spark_prepare.py",
         "tests/test_real_backend_fakes.py", "tests/test_runner.py",
         "tests/test_ci_pipeline.py", "tests/test_docs_refs.py",
+        "tests/test_hvdlint.py",
     ],
     "state-elastic-data": [
         "tests/test_data.py", "tests/test_checkpoint.py",
@@ -260,6 +261,40 @@ def build_steps():
         # slowdown must TRIP it (docs/profiling.md#regression-gate).
         "perf: regression-gate smoke (re-run passes, 2x trips)",
         f"{py} scripts/perf_gate.py --smoke", timeout=20))
+    steps.append(_step(
+        # repo-invariant linter (docs/static-analysis.md#hvdlint):
+        # knob-registry, metrics-docs coverage + exposition, serve
+        # lockstep determinism, serve KV-retry discipline, unique test
+        # basenames, postmortem signal-safety — conventions every PR
+        # used to re-verify by hand, now a standing gate.
+        "lint: hvdlint repo invariants",
+        f"{py} scripts/hvdlint.py", timeout=10))
+    steps.append(_step(
+        # clang-tidy over csrc with the committed concurrency/bugprone
+        # config (csrc/.clang-tidy, WarningsAsErrors).  Gated on
+        # availability like run_real_backends: without clang-tidy the
+        # leg exits 0 with an explicit impossibility note.
+        "lint (gated): clang-tidy csrc concurrency/bugprone",
+        f"{py} scripts/run_clang_tidy.py", timeout=15))
+    steps.append(_step(
+        # native race harness under ThreadSanitizer: build the SAN=tsan
+        # library, then run every stress scenario (submit storms, epoch
+        # lock/break/relock churn, trace drain-while-record, chaos
+        # reconnect storms, flight dumps mid-cycle) with zero
+        # unsuppressed reports as the assertion
+        # (docs/static-analysis.md#sanitizers).
+        "sanitize: TSan native race harness",
+        "make -C csrc SAN=tsan && "
+        f"{py} -m pytest tests/test_native_sanitize.py -q -m \"\" "
+        f"-k \"tsan\"", timeout=30))
+    steps.append(_step(
+        # the same harness under ASan (memory errors; leak checking is
+        # a documented non-goal under a Python driver) and UBSan
+        # (-fno-sanitize-recover: any UB aborts the scenario).
+        "sanitize: ASan + UBSan native harness",
+        "make -C csrc SAN=asan && make -C csrc SAN=ubsan && "
+        f"{py} -m pytest tests/test_native_sanitize.py -q -m \"\" "
+        f"-k \"asan or ubsan\"", timeout=30))
     steps.append(_step(
         # promtool-check-metrics-style gate, pure Python (no external
         # dep): renders a populated fleet /metrics snapshot through the

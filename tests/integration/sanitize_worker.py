@@ -1,0 +1,362 @@
+"""Native race-harness stress driver (docs/static-analysis.md).
+
+Hammers the coordination core's hot cross-thread interleavings through
+ctypes so the sanitizer builds (csrc/Makefile SAN=tsan|asan|ubsan) have
+something real to observe: submit storms racing the cycle loop, plan-
+epoch lock/break/relock churn, trace drain-while-record, reconnect
+storms under chaos faults on a 2-process TCP pair, and flight dumps —
+explicit and signal-triggered — mid-cycle.  tests/test_native_sanitize.py
+runs each scenario in a subprocess with the sanitizer runtime preloaded
+and asserts "no sanitizer report" as the pass condition; the same
+scenarios run (briefly) against the plain library in the fast tier so
+the harness itself cannot rot.
+
+Deliberately jax-free and package-import-free: horovod_tpu/__init__ pays
+the jax import, and a sanitizer interposing on XLA would drown the
+native core's signal.  common/basics.py is loaded BY FILE PATH (the
+check_metrics_format probe-loader pattern); HOROVOD_NATIVE_LIB selects
+the library under test.
+
+Usage:  python sanitize_worker.py --scenario submit_storm [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# A scenario thread dying must fail the harness: without this, a storm
+# thread's exception prints a traceback while the process still exits 0
+# and the sanitizer leg reads as green over a scenario that never ran.
+_THREAD_ERRORS = []
+_orig_excepthook = threading.excepthook
+
+
+def _excepthook(args):
+    _THREAD_ERRORS.append(f"{args.thread.name}: "
+                          f"{args.exc_type.__name__}: {args.exc_value}")
+    _orig_excepthook(args)
+
+
+threading.excepthook = _excepthook
+
+
+def load_basics():
+    path = os.path.join(REPO, "horovod_tpu", "common", "basics.py")
+    spec = importlib.util.spec_from_file_location("_hvd_basics_san", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _consume(core, want, timeout_s=30.0):
+    """Wait until `want` named tensors have completed on this core."""
+    seen = 0
+    deadline = time.time() + timeout_s
+    while seen < want:
+        r = core.wait(timeout_s=1.0)
+        if r is not None:
+            assert r.type in ("OK", "SHUTDOWN"), r
+            seen += len(r.names)
+        elif time.time() > deadline:
+            raise RuntimeError(f"consumed {seen}/{want} before timeout")
+    return seen
+
+
+def _metrics_pollers(cores, stop, n_per_core=1):
+    """The Python-metrics-thread interleaving: hvd_core_metrics /
+    op_stats / health / legacy stats snapshots racing the cycle loop —
+    the unlocked-counter reads PR 12 fixed (docs/static-analysis.md)."""
+    threads = []
+
+    def poll(core):
+        while not stop.is_set():
+            core.metrics()
+            core.op_stats()
+            core.health()
+            core.stats()
+    for core in cores:
+        for _ in range(n_per_core):
+            threads.append(threading.Thread(target=poll, args=(core,)))
+    for t in threads:
+        t.start()
+    return threads
+
+
+def _loopback_pair(basics, cycle_ms=1.0, cache=64):
+    hub = basics.LoopbackHub(2)
+    cores = [basics.CoordinationCore.loopback(hub, r, cycle_ms=cycle_ms,
+                                             cache_capacity=cache)
+             for r in range(2)]
+    return hub, cores
+
+
+def _teardown(hub, cores):
+    for c in cores:
+        c.shutdown()
+    for c in cores:
+        c.close()
+    hub.close()
+
+
+# ------------------------------------------------------------- scenarios
+def scenario_submit_storm(basics, iters):
+    """Two loopback ranks storm negotiated submissions from worker
+    threads while per-core metrics pollers hammer every snapshot API."""
+    hub, cores = _loopback_pair(basics)
+    stop = threading.Event()
+    pollers = _metrics_pollers(cores, stop, n_per_core=2)
+
+    def storm(core):
+        names = [f"t{i}" for i in range(8)]
+        for _ in range(iters):
+            for n in names:
+                core.submit(n, "f32:64", nbytes=256)
+            _consume(core, len(names))
+    workers = [threading.Thread(target=storm, args=(c,)) for c in cores]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    stop.set()
+    for t in pollers:
+        t.join()
+    _teardown(hub, cores)
+
+
+def scenario_epoch_churn(basics, iters):
+    """Plan-epoch lock/break/relock churn: steady bursts lock the epoch
+    (inline submit-thread responses racing the watching cycle loop),
+    then a fresh tensor breaks it — the TryBypassSubmit / BreakEpoch /
+    carry_ handoff interleavings — with metrics pollers alongside."""
+    os.environ["HOROVOD_BYPASS"] = "1"
+    os.environ["HOROVOD_BYPASS_STABLE_CYCLES"] = "2"
+    hub, cores = _loopback_pair(basics, cycle_ms=0.5)
+    stop = threading.Event()
+    pollers = _metrics_pollers(cores, stop)
+    names = ["a", "b", "c"]
+
+    def step(extra=None):
+        # Two phases with a cross-rank barrier between them: the steady
+        # set must COMPLETE on both ranks before either submits the
+        # deviation.  A deviation racing a peer's un-submitted steady
+        # set is the documented one-step-skew hazard (the kicked worker
+        # renegotiates tensors its peer already served inline; it heals
+        # on the peer's next step — docs/static-analysis.md), which in a
+        # single barriered step would deadlock the harness.
+        barrier = threading.Barrier(2)
+        done = []
+
+        def one(core):
+            for n in names:
+                core.submit(n, "f32:64", nbytes=128)
+            got = _consume(core, len(names))
+            barrier.wait()
+            if extra:
+                core.submit(extra, "f32:64", nbytes=128)
+                got += _consume(core, 1)
+            done.append(got)
+        ts = [threading.Thread(target=one, args=(c,)) for c in cores]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        want = len(names) + (1 if extra else 0)
+        assert done == [want] * 2, done
+        time.sleep(0.004)  # idle gap: burst boundary for the fingerprint
+
+    for round_ in range(iters):
+        for _ in range(5):       # identical steady steps -> lock
+            step()
+        step(extra=f"dev{round_}")  # deviation -> break, renegotiate
+    locks = cores[0].metrics()["counters"]["epoch_locks"]
+    assert locks >= 1, f"epoch never locked (locks={locks})"
+    stop.set()
+    for t in pollers:
+        t.join()
+    _teardown(hub, cores)
+
+
+def scenario_drain_record(basics, iters):
+    """TraceRing record-while-drain: the cycle loop and transport record
+    spans while two drainer threads consume the ring concurrently."""
+    hub, cores = _loopback_pair(basics)
+    for c in cores:
+        c.trace_enable()
+    stop = threading.Event()
+    drained = [0]
+
+    def drainer(core):
+        while not stop.is_set():
+            drained[0] += len(core.trace_drain()["events"])
+    ts = [threading.Thread(target=drainer, args=(c,))
+          for c in cores for _ in range(2)]
+    for t in ts:
+        t.start()
+
+    def storm(core):
+        for i in range(iters * 4):
+            core.submit(f"d{i % 6}", "f32:64", nbytes=64)
+            if i % 6 == 5:
+                _consume(core, 6)
+        _consume(core, (iters * 4) % 6)
+    ws = [threading.Thread(target=storm, args=(c,)) for c in cores]
+    for t in ws:
+        t.start()
+    for t in ws:
+        t.join()
+    stop.set()
+    for t in ts:
+        t.join()
+    _teardown(hub, cores)
+
+
+def scenario_flight_dump(basics, iters, dump_dir):
+    """Explicit flight dumps mid-cycle: the black-box writer snapshots
+    health/stats/trace while the loop and submitters are hot."""
+    hub, cores = _loopback_pair(basics)
+    cores[0].flight_enable(os.path.join(dump_dir, "armed.flight"))
+    stop = threading.Event()
+    pollers = _metrics_pollers(cores, stop)
+
+    def storm(core):
+        for i in range(iters * 2):
+            core.submit(f"f{i % 4}", "f32:64", nbytes=64)
+            if i % 4 == 3:
+                _consume(core, 4)
+        _consume(core, (iters * 2) % 4)
+    ws = [threading.Thread(target=storm, args=(c,)) for c in cores]
+    for t in ws:
+        t.start()
+    for i in range(iters):
+        path = os.path.join(dump_dir, f"dump{i}.flight")
+        assert cores[0].flight_dump(path, reason="harness")
+        with open(path) as f:
+            text = f.read()
+        assert text.startswith("hvd_flight_v1") and "[end]" in text, path
+        time.sleep(0.002)
+    for t in ws:
+        t.join()
+    stop.set()
+    for t in pollers:
+        t.join()
+    _teardown(hub, cores)
+
+
+def scenario_signal_dump(basics, iters, dump_dir):
+    """Fatal-signal dump mid-cycle: arm the recorder, storm the core,
+    then die by SIGABRT — the handler must write a terminated record
+    ([end] marker) from signal context.  The parent test asserts the
+    SIGABRT exit status and parses the record."""
+    del iters
+    hub, cores = _loopback_pair(basics)
+    record = os.path.join(dump_dir, "signal.flight")
+    cores[0].flight_enable(record)
+    stop = threading.Event()
+    pollers = _metrics_pollers(cores, stop)
+
+    def storm(core):
+        i = 0
+        while not stop.is_set():
+            core.submit(f"s{i % 4}", "f32:64", nbytes=64)
+            if i % 4 == 3:
+                _consume(core, 4)
+            i += 1
+    ws = [threading.Thread(target=storm, args=(c,), daemon=True)
+          for c in cores]
+    for t in ws:
+        t.start()
+    time.sleep(0.2)
+    print("SCENARIO_DYING signal_dump", flush=True)
+    os.abort()  # SIGABRT -> flight recorder -> re-raise -> death
+
+
+def scenario_tcp_churn(basics, iters, rank=None, port=0, dump_dir=None):
+    """2-process TCP reconnect storm: both ranks negotiate a steady set
+    while the seeded chaos injector shuts sockets down mid-frame — the
+    reconnect/resync/replay machinery under a sanitizer, with metrics
+    pollers reading transport counters throughout."""
+    if rank is None:  # parent: spawn the pair, inherit sanitizer env
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_CONTROLLER_RETRIES": "10",
+            "HOROVOD_CHAOS_SEED": "7",
+            "HOROVOD_CHAOS_TCP_CLOSE_RATE": "0.02",
+        })
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--scenario", "tcp_churn", "--iters", str(iters),
+             "--rank", str(r), "--port", str(port)],
+            env=env) for r in (0, 1)]
+        rcs = [p.wait(timeout=600) for p in procs]
+        assert rcs == [0, 0], f"tcp_churn ranks exited {rcs}"
+        return
+    core = basics.CoordinationCore.tcp(rank, 2, port=port, cycle_ms=1.0)
+    stop = threading.Event()
+    pollers = _metrics_pollers([core], stop)
+    names = [f"n{i}" for i in range(8)]
+    for _ in range(iters):
+        for n in names:
+            core.submit(n, "f32:64", nbytes=256)
+        _consume(core, len(names), timeout_s=120.0)
+    stop.set()
+    for t in pollers:
+        t.join()
+    stats = core.metrics()["counters"]
+    core.shutdown()
+    core.close()
+    # The chaos rate is set so at least one fault fires per run on the
+    # pair; per-rank counts vary with the seeded stream.
+    print(f"tcp_churn rank{rank} reconnects={stats['transport_reconnects']}"
+          f" faults={stats['chaos_faults_injected']}", flush=True)
+
+
+SCENARIOS = {
+    "submit_storm": scenario_submit_storm,
+    "epoch_churn": scenario_epoch_churn,
+    "drain_record": scenario_drain_record,
+    "flight_dump": scenario_flight_dump,
+    "signal_dump": scenario_signal_dump,
+    "tcp_churn": scenario_tcp_churn,
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scenario", required=True, choices=sorted(SCENARIOS))
+    ap.add_argument("--iters", type=int,
+                    default=int(os.environ.get("HVDSAN_ITERS", "10")))
+    ap.add_argument("--dump-dir", default="")
+    ap.add_argument("--rank", type=int, default=None)
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    basics = load_basics()
+    fn = SCENARIOS[args.scenario]
+    kwargs = {}
+    if args.scenario in ("flight_dump", "signal_dump"):
+        kwargs["dump_dir"] = args.dump_dir or os.getcwd()
+    if args.scenario == "tcp_churn":
+        kwargs.update(rank=args.rank, port=args.port)
+    fn(basics, args.iters, **kwargs)
+    if _THREAD_ERRORS:
+        print("THREAD ERRORS:\n" + "\n".join(_THREAD_ERRORS),
+              file=sys.stderr, flush=True)
+        return 1
+    print(f"SCENARIO_OK {args.scenario}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,194 @@
+"""Native race harness: the csrc concurrency machinery under sanitizers.
+
+Fast tier: every sanitize_worker scenario runs (briefly) against the
+PLAIN library, so the harness itself cannot rot into a vacuous gate.
+
+Sanitized tier (slow-marked, env-gated on the sanitized library being
+present — CI builds it first with `make -C csrc SAN=...`): each scenario
+runs in a subprocess with the matching sanitizer runtime preloaded and
+the assertion is "zero unsuppressed sanitizer reports" — TSan/ASan
+report files must be absent and the process must exit clean (TSan's
+exitcode=66 turns any report into a failure even if the scenario's own
+assertions pass).  docs/static-analysis.md documents the workflow and
+the real races the first runs surfaced (unlocked stats snapshots, the
+bypass-break carry_ handoff).
+
+HOROVOD_NATIVE_LIB does the library selection (common/basics.py); the
+loader is never rebuilt mid-test.  HVDSAN_ITERS scales scenario length.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CSRC = os.path.join(REPO, "csrc")
+WORKER = os.path.join(REPO, "tests", "integration", "sanitize_worker.py")
+
+SAN_LIBS = {
+    "tsan": os.path.join(CSRC, "libhvd_tpu_core.tsan.so"),
+    "asan": os.path.join(CSRC, "libhvd_tpu_core.asan.so"),
+    "ubsan": os.path.join(CSRC, "libhvd_tpu_core.ubsan.so"),
+}
+SCENARIOS = ["submit_storm", "epoch_churn", "drain_record", "flight_dump",
+             "tcp_churn"]
+
+
+def _runtime_so(name: str):
+    """Resolve libtsan/libasan via the toolchain; None when unavailable."""
+    gcc = shutil.which("gcc")
+    if gcc is None:
+        return None
+    out = subprocess.run([gcc, f"-print-file-name=lib{name}.so"],
+                         capture_output=True, text=True).stdout.strip()
+    return out if out and os.path.isabs(out) and os.path.exists(out) \
+        else None
+
+
+def _run(scenario, tmp_path, san=None, iters=4, expect_rc=0):
+    env = dict(os.environ)
+    env.pop("HOROVOD_BYPASS", None)  # scenarios own their knobs
+    env["HVDSAN_ITERS"] = str(iters)
+    log_prefix = str(tmp_path / "sanreport")
+    if san is not None:
+        env["HOROVOD_NATIVE_LIB"] = SAN_LIBS[san]
+        supp = os.path.join(CSRC, "sanitize", f"{san}.supp")
+        if san == "tsan":
+            env["LD_PRELOAD"] = _runtime_so("tsan")
+            env["TSAN_OPTIONS"] = (f"exitcode=66 log_path={log_prefix} "
+                                   f"suppressions={supp} halt_on_error=0")
+        elif san == "asan":
+            env["LD_PRELOAD"] = _runtime_so("asan")
+            # detect_leaks=0: CPython's interpreter-lifetime allocations
+            # drown LSan; native leak coverage needs a C harness, not a
+            # Python driver (docs/static-analysis.md#suppressions).
+            env["ASAN_OPTIONS"] = (f"detect_leaks=0 log_path={log_prefix} "
+                                   "abort_on_error=0")
+        else:  # ubsan links its runtime into the .so; no preload needed
+            env["UBSAN_OPTIONS"] = (f"log_path={log_prefix} "
+                                    f"suppressions={supp} "
+                                    "print_stacktrace=1")
+    proc = subprocess.run(
+        [sys.executable, WORKER, "--scenario", scenario,
+         "--dump-dir", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=540)
+    report_text = "\n".join(p.read_text()
+                            for p in sorted(tmp_path.glob("sanreport*")))
+    assert proc.returncode == expect_rc, (
+        f"{scenario} rc={proc.returncode} (want {expect_rc})\n"
+        f"stdout:\n{proc.stdout[-3000:]}\nstderr:\n{proc.stderr[-3000:]}\n"
+        f"sanitizer reports:\n{report_text[-6000:]}")
+    assert not report_text.strip(), (
+        f"{scenario}: unsuppressed sanitizer report(s):\n"
+        f"{report_text[-8000:]}")
+    return proc
+
+
+# --------------------------------------------------------------- fast tier
+@pytest.mark.parametrize("scenario", SCENARIOS)
+def test_harness_scenario_runs_on_plain_lib(scenario, tmp_path):
+    """The stress driver itself must pass on the plain build — a broken
+    harness would make every sanitizer leg vacuously green."""
+    proc = _run(scenario, tmp_path, san=None, iters=2)
+    assert f"SCENARIO_OK {scenario}" in proc.stdout
+
+
+def test_signal_dump_writes_record_on_plain_lib(tmp_path):
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, WORKER, "--scenario", "signal_dump",
+         "--dump-dir", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0  # died by SIGABRT, by design
+    record = (tmp_path / "signal.flight").read_text()
+    assert record.startswith("hvd_flight_v1")
+    assert "signal:SIGABRT" in record and "[end]" in record
+
+
+def test_sanitized_lib_reports_build_tag(tmp_path):
+    """HOROVOD_NATIVE_LIB + hvd_native_build_info round trip: the loader
+    must identify a sanitized build (any available one) as such, and the
+    plain build as sanitizer=none."""
+    code = ("import importlib.util as i, os; "
+            "s = i.spec_from_file_location('b', "
+            f"{os.path.join(REPO, 'horovod_tpu', 'common', 'basics.py')!r});"
+            " m = i.module_from_spec(s); s.loader.exec_module(m); "
+            "print('TAG', m.native_build_info()['sanitizer'])")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True,
+                         env=dict(os.environ), timeout=120)
+    assert "TAG none" in out.stdout
+    built = [s for s, p in SAN_LIBS.items() if os.path.exists(p)
+             and s == "ubsan"]  # ubsan needs no runtime preload
+    if built:
+        env = dict(os.environ)
+        env["HOROVOD_NATIVE_LIB"] = SAN_LIBS[built[0]]
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, env=env,
+                             timeout=120)
+        assert f"TAG {built[0]}" in out.stdout
+        assert "SANITIZER build" in out.stderr  # loud loader warning
+
+
+# ---------------------------------------------------------- sanitized tier
+def _gate(san):
+    if not os.path.exists(SAN_LIBS[san]):
+        pytest.skip(f"{SAN_LIBS[san]} not built "
+                    f"(make -C csrc SAN={san})")
+    if san in ("tsan", "asan") and _runtime_so(san) is None:
+        pytest.skip(f"lib{san}.so runtime unavailable")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", SCENARIOS)
+def test_tsan_scenario_clean(scenario, tmp_path):
+    _gate("tsan")
+    proc = _run(scenario, tmp_path, san="tsan",
+                iters=3 if scenario == "tcp_churn" else 6)
+    assert f"SCENARIO_OK {scenario}" in proc.stdout
+
+
+@pytest.mark.slow
+def test_tsan_signal_dump_clean(tmp_path):
+    """Signal-dump-mid-cycle under TSan: the async-signal-safe writer
+    must not race the storm (its reads are lock-free atomics + the
+    bounded-spin ring snapshot)."""
+    _gate("tsan")
+    env = dict(os.environ)
+    env["HOROVOD_NATIVE_LIB"] = SAN_LIBS["tsan"]
+    env["LD_PRELOAD"] = _runtime_so("tsan")
+    log_prefix = str(tmp_path / "sanreport")
+    supp = os.path.join(CSRC, "sanitize", "tsan.supp")
+    env["TSAN_OPTIONS"] = (f"exitcode=66 log_path={log_prefix} "
+                           f"suppressions={supp}")
+    proc = subprocess.run(
+        [sys.executable, WORKER, "--scenario", "signal_dump",
+         "--dump-dir", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert "SCENARIO_DYING" in proc.stdout
+    record = (tmp_path / "signal.flight").read_text()
+    assert "signal:SIGABRT" in record and "[end]" in record
+    reports = "\n".join(p.read_text()
+                        for p in tmp_path.glob("sanreport*"))
+    assert "WARNING: ThreadSanitizer" not in reports, reports[-8000:]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", SCENARIOS)
+def test_asan_scenario_clean(scenario, tmp_path):
+    _gate("asan")
+    proc = _run(scenario, tmp_path, san="asan", iters=6)
+    assert f"SCENARIO_OK {scenario}" in proc.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", SCENARIOS)
+def test_ubsan_scenario_clean(scenario, tmp_path):
+    """UBSan build aborts on any UB (-fno-sanitize-recover), so a clean
+    exit IS the assertion; the log_path stays empty as a belt."""
+    _gate("ubsan")
+    proc = _run(scenario, tmp_path, san="ubsan", iters=6)
+    assert f"SCENARIO_OK {scenario}" in proc.stdout
