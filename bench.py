@@ -457,6 +457,17 @@ def main() -> int:
                          "users) and with Poisson arrivals, emitting "
                          "{throughput_tok_s, ttft_p50/p99, tpot_p50/p99, "
                          "batch_fill} per mode, CPU-virtual labeled")
+    ap.add_argument("--users", nargs="?", const="1,2,4,8,16,24",
+                    default=None, metavar="N,N,...",
+                    help="with --serve: control-plane saturation sweep "
+                         "(docs/control-plane.md) — closed-loop user "
+                         "pools of each size drive POST /generate "
+                         "through the REAL router + rendezvous KV with "
+                         "a scripted fixed-cost engine, locating the "
+                         "router/KV throughput knee for the single-"
+                         "process baseline vs the sharded + direct-"
+                         "stream control plane (default sweep "
+                         "1,2,4,8,16,24)")
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU (smoke mode)")
     ap.add_argument("--profile", metavar="DIR", default=None,
@@ -542,6 +553,10 @@ def main() -> int:
             print("--profile is not supported with --serve (the tick "
                   "loop is not one scanned program); ignoring",
                   file=sys.stderr)
+        if args.users:
+            # Control-plane saturation sweep: scripted engine, no jax
+            # compute — the measurement is the router+KV, not decode.
+            return serve_users_bench(args)
         return serve_bench(args)
     if args.autotune:
         if args.profile:
@@ -1396,6 +1411,206 @@ def serve_bench(args) -> int:
                          "prefill_chunk": scfg.prefill_chunk},
         "legs": legs,
         "metrics": metrics_summary(),
+    }))
+    return 0
+
+
+def serve_users_bench(args) -> int:
+    """Control-plane saturation sweep (docs/control-plane.md): a
+    closed-loop user-count sweep through the REAL front door — POST
+    /generate on the rendezvous server, KV enqueue, FleetFrontend
+    drain/publish, ndjson stream back — with a scripted fixed-cost
+    engine (1 ms/tick, one token per request per tick) so the knee the
+    sweep locates is the ROUTER+KV's, not the model's.  Run twice:
+
+      * ``single`` — 1 KV shard, direct streaming OFF (every token a
+        serve_out KV PUT polled by the router: the pre-scale-out path);
+      * ``sharded_direct`` — ``--kv-shards 3`` + the persistent direct
+        token stream (the scale-out control plane).
+
+    Knee = smallest user count whose throughput reaches 90%% of the
+    config's max.  The artifact gates the per-config knee throughput
+    and the scaled/baseline ratio via PERF_BASELINE.json sub_rows.
+    CPU-virtual: loopback HTTP in one process — absolute numbers
+    measure the host's scheduler + GIL, the COMPARISON is the claim."""
+    import threading
+    import urllib.request
+
+    from horovod_tpu.runner import http_client as hc
+    from horovod_tpu.runner.http_server import RendezvousServer
+    from horovod_tpu.serve.router import RouterState
+    from horovod_tpu.serve.worker import FleetFrontend
+
+    user_counts = [int(x) for x in str(args.users).split(",")]
+    tick_s = 0.001
+    max_new = 16
+    warmup_s, window_s = 0.4, 1.5
+
+    class TickEngine:
+        """FleetFrontend-contract engine with a fixed 1 ms tick: one
+        token per active request per step, deterministic content."""
+
+        def __init__(self):
+            self.tick = 0
+            self.active = {}
+            self.completed = 0
+
+        def submit(self, tokens, max_new_tokens, req_id=None,
+                   eos_id=None):
+            base = sum(int(t) for t in tokens)
+            self.active[req_id] = [(base + i) % 1000
+                                   for i in range(max_new_tokens)]
+
+        def has_work(self):
+            return bool(self.active)
+
+        def step(self):
+            time.sleep(tick_s)  # the modeled decode tick
+            emitted, finished = {}, []
+            for rid in sorted(self.active):
+                emitted[rid] = [self.active[rid].pop(0)]
+                if not self.active[rid]:
+                    del self.active[rid]
+                    finished.append(_UserDone(rid))
+                    self.completed += 1
+            if emitted:
+                self.tick += 1
+            return {"tick": self.tick, "processed": len(emitted),
+                    "emitted": emitted, "finished": finished}
+
+        def stats(self):
+            return {"tick": self.tick, "completed": self.completed,
+                    "active": len(self.active)}
+
+    class _UserDone:
+        def __init__(self, rid):
+            self.req_id = rid
+            self.finish_reason = "completed"
+
+        def ttft(self):
+            return tick_s
+
+        def tpot(self):
+            return tick_s
+
+    def run_config(shards, direct):
+        server = RendezvousServer(host="127.0.0.1", shards=shards)
+        port = server.start()
+        addrs = [("127.0.0.1", p) for p in server.shard_ports]
+        if shards > 1:
+            hc.install_shard_map(addrs)
+        # No shedding: saturation must hit the transport, not admission.
+        server._httpd.serve_router = RouterState(
+            max_pending=1 << 20, shed_high=1 << 20, journal=True)
+        frontend = FleetFrontend(TickEngine(), "127.0.0.1", port, 0, 1,
+                                 direct=direct)
+        ft = threading.Thread(target=frontend.run, daemon=True)
+        ft.start()
+        done = {"requests": 0, "tokens": 0}
+        done_lock = threading.Lock()
+        counting = threading.Event()
+        stop = threading.Event()
+
+        def user_loop(uid):
+            body = json.dumps({"tokens": [uid + 1, uid + 2],
+                               "max_new_tokens": max_new}).encode()
+            while not stop.is_set():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/generate", data=body,
+                    method="POST")
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        lines = r.read().splitlines()
+                except OSError:
+                    continue
+                rec = json.loads(lines[-1]) if lines else {}
+                if rec.get("done") and counting.is_set():
+                    with done_lock:
+                        done["requests"] += 1
+                        done["tokens"] += len(rec.get("tokens") or ())
+
+        rows = []
+        try:
+            for n in user_counts:
+                stop.clear()
+                counting.clear()
+                users = [threading.Thread(target=user_loop, args=(u,),
+                                          daemon=True)
+                         for u in range(n)]
+                for u in users:
+                    u.start()
+                time.sleep(warmup_s)
+                with done_lock:
+                    done["requests"] = done["tokens"] = 0
+                counting.set()
+                time.sleep(window_s)
+                counting.clear()
+                with done_lock:
+                    reqs, toks = done["requests"], done["tokens"]
+                stop.set()
+                for u in users:
+                    u.join(timeout=90)
+                rows.append({"users": n,
+                             "requests_per_s": round(reqs / window_s, 2),
+                             "tok_s": round(toks / window_s, 1)})
+        finally:
+            # graceful exit: the drain signal stops the frontend loop
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/admin/drain", data=b"{}",
+                    method="POST"), timeout=30).read()
+            except OSError:
+                pass
+            ft.join(timeout=30)
+            if shards > 1:
+                hc.install_shard_map(None)
+            server.stop()
+        peak = max(r["tok_s"] for r in rows)
+        knee = next((r for r in rows if r["tok_s"] >= 0.9 * peak),
+                    rows[-1])
+        return {"rows": rows, "peak_tok_s": peak,
+                "knee_users": knee["users"],
+                "knee_tok_s": knee["tok_s"]}
+
+    single = run_config(shards=1, direct=False)
+    scaled = run_config(shards=3, direct=True)
+    for tag, res in (("single", single), ("sharded_direct", scaled)):
+        if res["peak_tok_s"] <= 0:
+            return fail(f"serve --users {tag} sweep moved no tokens: "
+                        f"{res}", cause="invalid-result")
+    gain = scaled["knee_tok_s"] / max(single["knee_tok_s"], 1e-9)
+    label = ("CPU-virtual control plane (loopback HTTP, scripted 1 ms "
+             "engine tick — measures router+KV, not decode)")
+    sub_rows = [
+        {"metric": "serve ctrl-plane single knee throughput "
+                   f"(knee at {single['knee_users']} users)",
+         "value": single["knee_tok_s"], "unit": "tokens/sec",
+         "higher_is_better": True, "label": label},
+        {"metric": "serve ctrl-plane sharded-direct knee throughput "
+                   f"(knee at {scaled['knee_users']} users)",
+         "value": scaled["knee_tok_s"], "unit": "tokens/sec",
+         "higher_is_better": True, "label": label},
+        {"metric": "serve ctrl-plane scale-out gain "
+                   "(sharded+direct vs single, knee tok/s)",
+         "value": round(gain, 3), "unit": "x",
+         "higher_is_better": True, "label": label},
+    ]
+    print(json.dumps({
+        "sub_rows": sub_rows,
+        "metric": "serve ctrl-plane saturation sweep "
+                  f"(single knee {single['knee_tok_s']:.0f} tok/s at "
+                  f"{single['knee_users']} users; sharded+direct "
+                  f"{scaled['knee_tok_s']:.0f} tok/s at "
+                  f"{scaled['knee_users']} users; gain {gain:.2f}x) "
+                  f"[{label}]",
+        "value": scaled["knee_tok_s"], "unit": "tokens/sec",
+        "vs_baseline_is": "single_knee_tok_s",
+        "vs_baseline": single["knee_tok_s"],
+        "label": label,
+        "user_counts": user_counts,
+        "tick_ms": tick_s * 1e3, "max_new_tokens": max_new,
+        "window_s": window_s,
+        "single": single, "sharded_direct": scaled,
     }))
     return 0
 

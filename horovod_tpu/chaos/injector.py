@@ -49,7 +49,15 @@ class ChaosInjector:
         # no atexit handlers, no finally blocks, no state flushes.
         self._exit = exit_fn or os._exit
         self._sleep = sleep_fn
-        self._kv_failed = 0  # consecutive KV ops already failed
+        # Per-EVENT KV fault accounting (event index -> count), so
+        # independent blackout windows (e.g. two different shards) ride
+        # independently: failures charged to one event never consume
+        # another's budget.  _kv_seen counts MATCHING ops per event —
+        # the op-offset clock behind mid-run windows (spec.py: for
+        # kv_blackout, `step` = ops to observe before failing).
+        self._kv_failed: dict = {}
+        self._kv_seen: dict = {}
+        self._kv_shards: Optional[int] = None  # resolved lazily from knobs
 
     # ------------------------------------------------------------- one-shot
     def _fired_marker(self, idx: int) -> Optional[str]:
@@ -122,28 +130,55 @@ class ChaosInjector:
                            duration_ms=e.duration_ms)
                 self._sleep(e.duration_ms / 1000.0)
 
+    def _nshards(self) -> int:
+        if self._kv_shards is None:
+            try:
+                from ..common.knobs import current
+                self._kv_shards = int(current("HOROVOD_KV_SHARDS"))
+            except Exception:
+                self._kv_shards = 1
+        return self._kv_shards
+
     def maybe_fail_kv(self, op: str, scope: str = "") -> None:
         """Rendezvous-KV fault hook (runner/http_client.py): raises
-        ``URLError`` for the first ``count`` matching KV operations — a
-        simulated blackout window the client's bounded retry must ride
-        through (or surface, if the window outlasts the budget).  An
-        event carrying a ``scope`` blacks out only that KV scope (e.g.
-        ``serve_plan`` — the serving plane's coordination channel)."""
-        for e in self.spec.events:
+        ``URLError`` for ``count`` matching KV operations — a simulated
+        blackout window the client's bounded retry must ride through
+        (or surface, if the window outlasts the budget).  An event
+        carrying a ``scope`` blacks out only that KV scope (e.g.
+        ``serve_plan`` — the serving plane's coordination channel); one
+        carrying a ``shard`` blacks out every scope the deterministic
+        map (runner/kvshard.py) assigns to that shard — the partial
+        outage of one dark shard server, which must stall only the
+        scopes it owns (docs/control-plane.md).  A kv_blackout's
+        ``step`` is an op offset: the window opens after that many
+        matching ops were observed.  Counters are per event, so
+        concurrent windows ride independently."""
+        for idx, e in enumerate(self.spec.events):
             if e.kind != "kv_blackout" or not e.matches_rank(self.rank):
                 continue
             if e.op and e.op != op:
                 continue
             if e.scope and e.scope != scope:
                 continue
-            if self._kv_failed < e.count:
-                self._kv_failed += 1
+            if e.shard >= 0:
+                from ..runner.kvshard import shard_for_scope
+                if shard_for_scope(scope, self._nshards()) != e.shard:
+                    continue
+            seen = self._kv_seen.get(idx, 0)
+            self._kv_seen[idx] = seen + 1
+            if e.step >= 0 and seen < e.step:
+                continue  # window not open yet (op-offset clock)
+            failed = self._kv_failed.get(idx, 0)
+            if failed < e.count:
+                self._kv_failed[idx] = failed + 1
                 self._count("kv_blackout")
-                self._mark("chaos.kv_blackout", op=op, scope=scope)
+                self._mark("chaos.kv_blackout", op=op, scope=scope,
+                           shard=e.shard)
                 import urllib.error
                 raise urllib.error.URLError(
-                    f"chaos: injected KV blackout ({self._kv_failed}/"
-                    f"{e.count})")
+                    f"chaos: injected KV blackout event #{idx} "
+                    f"({failed + 1}/{e.count}, scope={scope!r}, "
+                    f"shard={e.shard})")
 
     def crash_point(self, point: str, step: Optional[int] = None) -> None:
         """Durability crash hook (elastic/fastcommit.py): a matching

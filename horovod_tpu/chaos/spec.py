@@ -24,8 +24,19 @@ YAML shape (both event spellings are accepted)::
       - stall: {rank: 1, point: negotiate, duration_ms: 30}
       - kv_blackout: {op: put, count: 2}
       - kv_blackout: {op: get, scope: serve_plan, count: 3}
+      - kv_blackout: {shard: 1, step: 12, count: 6}
       - crash_commit: {rank: 0, step: 3, point: pre_marker}
       - {kind: stall, rank: 0, step: 4, duration_ms: 100}
+
+``kv_blackout`` windows: each event keeps its OWN per-rank counters, so
+independent blackouts ride independently.  ``shard`` restricts the
+event to KV ops whose scope the deterministic scope->shard map
+(runner/kvshard.py, HOROVOD_KV_SHARDS) assigns to that shard — the
+partial-outage experiment where one shard server is dark and only the
+scopes it owns stall (docs/control-plane.md).  For kv_blackout,
+``step`` is an OP offset, not a training step: the event starts failing
+only after ``step`` matching KV ops were observed (a mid-run outage
+window [step, step+count) instead of a bring-up blackout).
 """
 
 from __future__ import annotations
@@ -62,6 +73,9 @@ class ChaosEvent:
     op: str = ""              # kv_blackout: put | get | "" (any)
     scope: str = ""           # kv_blackout: restrict to one KV scope
                               # (e.g. "serve_plan"); "" = every scope
+    shard: int = -1           # kv_blackout: restrict to scopes the
+                              # deterministic map assigns to this KV
+                              # shard (runner/kvshard.py); -1 = any
 
     def matches_rank(self, rank: int) -> bool:
         return self.rank < 0 or self.rank == rank

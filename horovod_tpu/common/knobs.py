@@ -183,6 +183,21 @@ _knob("HOROVOD_SERVE_SHED_LOW", 0, int,
       "count — avoids 429 flapping right at the high watermark.  0 = "
       "derived (high - max(1, high/4)).  Must be >= 0; rejected at "
       "hvd.init().")
+_knob("HOROVOD_SERVE_DIRECT", True, _parse_bool,
+      "Direct token streaming (serve/stream.py; docs/control-plane.md):"
+      " rank 0 streams token parts to the router over one persistent "
+      "chunked POST /serve/stream connection instead of per-part "
+      "serve_out KV PUTs, and the router mirrors them into serve_out "
+      "in-process so journal redrive is unchanged.  On connection loss "
+      "publishing falls back to KV PUTs per record and reconnects.  0 "
+      "disables: every part rides the KV (the pre-scale-out path).")
+_knob("HOROVOD_SERVE_POLL_INTERVAL", 0.02, float,
+      "Base interval in seconds the router waits between serve_out "
+      "probes while streaming a response (serve/router.py).  Direct "
+      "streaming wakes the stream immediately via a condition, so this "
+      "is the fallback cadence; consecutive empty waits back off up to "
+      "an EWMA-informed cap tracking the observed inter-part gap.  "
+      "Must be positive; rejected at hvd.init().")
 # --- autotune (reference: common.h:70-75) ---
 _knob("HOROVOD_AUTOTUNE", False, _parse_bool,
       "Enable Bayesian autotuning of fusion threshold and cycle time.")
@@ -342,6 +357,20 @@ _knob("HOROVOD_KV_RETRIES", 4, int,
 _knob("HOROVOD_KV_RETRY_BACKOFF_MS", 100, int,
       "Initial rendezvous KV retry backoff in ms (doubles per attempt, "
       "capped at 2000 ms, jittered).")
+_knob("HOROVOD_KV_SHARDS", 1, int,
+      "Rendezvous-KV shard count (hvdrun --kv-shards; "
+      "docs/control-plane.md): the launcher starts this many KV shard "
+      "servers and every scope is owned by exactly one per the "
+      "deterministic scope->shard map (runner/kvshard.py), so serve "
+      "traffic, telemetry and coordination stop contending on one "
+      "accept loop and one dark shard stalls only the scopes it owns.  "
+      "Must be >= 1; rejected at hvd.init() otherwise.")
+_knob("HOROVOD_KV_SHARD_ADDRS", "", str,
+      "Comma-separated host:port list of the KV shard servers, primary "
+      "(shard 0) first — stamped into worker env by the launcher when "
+      "HOROVOD_KV_SHARDS > 1 and consumed by runner/http_client's "
+      "per-scope routing.  Also published at KV scope 'kvshard' key "
+      "'map' for cross-checking.  Empty = unsharded.")
 # --- chaos plane (TPU-native; docs/chaos.md — no reference equivalent:
 #     the reference's fault tolerance is only exercised by ad-hoc
 #     worker-kill integration tests) ---

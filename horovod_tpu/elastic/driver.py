@@ -75,7 +75,8 @@ class ElasticDriver:
                  output_filename: Optional[str] = None,
                  network_interface: Optional[str] = None,
                  prefix_output_with_timestamp: bool = False,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 kv_shards: int = 1):
         self.host_manager = HostManager(discovery)
         self.min_np = min_np
         self.max_np = max_np
@@ -93,7 +94,12 @@ class ElasticDriver:
         self._round = 0  # reset-round number, exported to workers
 
         self.registry = WorkerStateRegistry()
-        self.rendezvous = RendezvousServer(port=metrics_port or 0)
+        # Sharded KV (docs/control-plane.md): the shard servers live in
+        # THIS driver process like the primary, so they survive reset
+        # rounds with the journal and in-flight client streams.
+        self.kv_shards = max(1, int(kv_shards))
+        self.rendezvous = RendezvousServer(port=metrics_port or 0,
+                                           shards=self.kv_shards)
         self.rdv_port = self.rendezvous.start()
         self._host_update_counter = 0
         self._current_hosts: List[hosts_mod.HostInfo] = []
@@ -178,6 +184,9 @@ class ElasticDriver:
         # epoch on it so a restarted fleet can never replay stale
         # serve_plan keys (serve/worker.py; docs/serving.md).
         updates["HOROVOD_ELASTIC_ROUND"] = str(self._round)
+        from ..runner.launch import stamp_kv_shard_env
+        stamp_kv_shard_env(updates, coord_host, self.rendezvous,
+                           self.kv_shards)
         if slot.size > 1:
             updates["HOROVOD_COORDINATOR_ADDR"] = \
                 f"{coord_host}:{self.coordinator_port}"
@@ -250,6 +259,10 @@ class ElasticDriver:
                     warn=log.warning,
                     has_remote_workers=any(
                         not _is_local(s.hostname) for s in slots))
+                if self.kv_shards > 1:
+                    # Idempotent per round; coord_host can only be
+                    # known once the round's slots are.
+                    self.rendezvous.publish_shard_map(coord_host)
                 self._hosts_changed.clear()
                 self.registry.reset()
                 self._round = resets
@@ -348,7 +361,8 @@ def run_elastic(args, command: List[str]) -> int:
                                                           None) or 1)
     min_np = args.min_np or args.num_proc or 1
     max_np = args.max_np or args.num_proc or (1 << 30)
-    from ..runner.launch import args_to_env, resolve_serve_port
+    from ..runner.launch import (args_to_env, resolve_kv_shards,
+                                 resolve_serve_port)
     # --serve pins the rendezvous (= router) port exactly like the
     # static path; the driver's server survives reset rounds, so the
     # journal, the in-flight client streams and the /generate front
@@ -368,7 +382,8 @@ def run_elastic(args, command: List[str]) -> int:
         network_interface=getattr(args, "network_interface", None),
         prefix_output_with_timestamp=getattr(
             args, "prefix_output_with_timestamp", False),
-        metrics_port=pinned_port)
+        metrics_port=pinned_port,
+        kv_shards=resolve_kv_shards(args))
     if getattr(args, "serve", None):
         import socket
         print(f"[hvdrun] elastic serving {args.serve}: POST http://"

@@ -358,7 +358,12 @@ class PerfPublisher:
             rep = self._report_fn()
             rep["rank"] = self.rank
             body = json.dumps(rep).encode()
-            url = (f"http://{self.addr}:{self.port}/{self.SCOPE}/"
+            # Sharded KV (docs/control-plane.md): the perf scope may
+            # live on a shard server; resolve per publish.
+            from ..runner.http_client import resolve_kv_addr
+            addr, port, _ = resolve_kv_addr(self.addr, self.port,
+                                            self.SCOPE)
+            url = (f"http://{addr}:{port}/{self.SCOPE}/"
                    f"rank.{self.rank}")
             delay = 0.1
             for attempt in range(retries + 1):

@@ -287,6 +287,22 @@ def render_serve(view: Dict[str, Any]) -> str:
         f"JOURNAL: {jstate} — {journal.get('entries', '?')} entries; a "
         "reset replays the unfinished ones "
         "(docs/serving.md#fault-tolerance)")
+    # Control-plane shard health (docs/control-plane.md) — absent on
+    # payloads from unsharded fleets or routers that predate sharding.
+    shards = view.get("kv_shards")
+    if isinstance(shards, list) and shards:
+        dark = [s for s in shards if not s.get("alive", True)]
+        head = (f"{len(dark)} of {len(shards)} shard(s) DARK — scopes "
+                "they own stall, everything else proceeds"
+                if dark else f"all {len(shards)} shards up")
+        lines.append(f"KV SHARDS: {head}")
+        for s in shards:
+            state_s = "up" if s.get("alive", True) else "DARK"
+            lines.append(
+                f"  shard {s.get('shard', '?')} [{state_s}] port "
+                f"{s.get('port', '?')}: {s.get('requests', '?')} "
+                f"requests, {s.get('keys', '?')} keys "
+                f"({', '.join(s.get('scopes') or []) or 'empty'})")
     if engine is None:
         lines.append("ENGINE: no stats published — fleet starting, "
                      "drained, or dead (check GET /health)")

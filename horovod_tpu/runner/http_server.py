@@ -42,10 +42,23 @@ The serving plane (docs/serving.md) adds the front door:
     and streams the engine fleet's tokens back as ndjson
     (``horovod_tpu/serve/router.py`` — watermark shedding, sequence
     numbering, result streaming);
+  * ``POST /serve/stream`` is rank 0's persistent direct token stream
+    (``horovod_tpu/serve/stream.py``): ndjson records over one chunked
+    connection, mirrored into the ``serve_out`` store in-process so the
+    journal/redrive source of truth is unchanged
+    (docs/control-plane.md#direct-streaming);
   * ``GET /serve/stats`` merges router counters with the engine's
     self-published stats (scope ``serve`` key ``stats``);
   * ``POST /admin/drain`` stops admission and gracefully drains the
     engine fleet to a clean exit 0 (docs/serving.md#fault-tolerance).
+
+Sharding (docs/control-plane.md): with ``shards=N`` the server starts
+N-1 additional KV shard servers in this process, each with its own
+store, lock and accept loop; scopes are owned per the deterministic
+``runner/kvshard.shard_for_scope`` map, clients route per scope, and
+the primary's render routes read the owning shard's store directly
+in-process (the stores share one process, so no HTTP hop).  A dark
+shard therefore stalls only the scopes it owns.
 """
 
 from __future__ import annotations
@@ -54,7 +67,9 @@ import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+from .kvshard import MAP_KEY, MAP_SCOPE, shard_for_scope
 
 METRICS_SCOPE = "metrics"
 TIMELINE_SCOPE = "timeline"
@@ -63,6 +78,20 @@ HEALTH_SCOPE = "health"
 SERVE_SCOPE = "serve"
 PERF_SCOPE = "perf"
 GENERATE_ROUTE = "generate"
+# serve_out writes wake the router's stream drains (serve/router.py
+# waits on kv_wakeup instead of busy-polling; docs/control-plane.md).
+_WAKEUP_SCOPES = ("serve_out",)
+
+
+def store_for(server, scope: str):
+    """The httpd whose in-process store owns ``scope`` — the primary's
+    render routes and the router read/write through this so the view is
+    correct whichever shard a scope hashes to.  A server started
+    without shards is its own (only) store."""
+    stores = getattr(server, "kv_stores", None)
+    if not stores:
+        return server
+    return stores[shard_for_scope(scope, len(stores))]
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -75,10 +104,35 @@ class _KVHandler(BaseHTTPRequestHandler):
         key = parts[1] if len(parts) > 1 else ""
         return scope, key
 
+    def _count_request(self) -> None:
+        """Per-shard request accounting (hvd_kv_shard_requests_total):
+        only meaningful when the KV is actually sharded — single-shard
+        servers skip the metric so the default path pays nothing."""
+        stores = getattr(self.server, "kv_stores", None)
+        idx = getattr(self.server, "shard_index", 0)
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            self.server.kv_requests = \
+                getattr(self.server, "kv_requests", 0) + 1
+        if stores and len(stores) > 1:
+            try:
+                from ..utils import metrics as M
+                M.KV_SHARD_REQUESTS.inc(shard=str(idx))
+            except Exception:
+                pass  # telemetry must never take a KV op down
+
+    def _wake(self, scope: str) -> None:
+        if scope not in _WAKEUP_SCOPES:
+            return
+        cond = getattr(self.server, "kv_wakeup", None)
+        if cond is not None:
+            with cond:
+                cond.notify_all()
+
     def do_PUT(self) -> None:  # noqa: N802
         scope, key = self._split()
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
+        self._count_request()
         with self.server.kv_lock:  # type: ignore[attr-defined]
             self.server.kv.setdefault(scope, {})[key] = value  # type: ignore
             # Receipt stamp: the server-side truth /health staleness is
@@ -87,6 +141,7 @@ class _KVHandler(BaseHTTPRequestHandler):
                 time.time()  # type: ignore[attr-defined]
         self.send_response(200)
         self.end_headers()
+        self._wake(scope)
 
     def do_POST(self) -> None:  # noqa: N802
         scope, key = self._split()
@@ -95,6 +150,13 @@ class _KVHandler(BaseHTTPRequestHandler):
             # enqueue to the KV, stream the engine's tokens back.
             from ..serve import router as serve_router
             serve_router.handle_generate(self)
+            return
+        if scope == SERVE_SCOPE and key == "stream":
+            # Rank 0's persistent direct token stream: parts/done
+            # records off the KV PUT+poll path entirely
+            # (docs/control-plane.md#direct-streaming).
+            from ..serve import stream as serve_stream
+            serve_stream.handle_stream(self)
             return
         if scope == "admin" and key == "drain":
             # Graceful serving drain (docs/serving.md#fault-tolerance):
@@ -130,6 +192,7 @@ class _KVHandler(BaseHTTPRequestHandler):
         if scope == PERF_SCOPE and not key:
             self._serve_perf()
             return
+        self._count_request()
         with self.server.kv_lock:  # type: ignore[attr-defined]
             value = self.server.kv.get(scope, {}).get(key)  # type: ignore
         if value is None:
@@ -145,8 +208,9 @@ class _KVHandler(BaseHTTPRequestHandler):
         """Fleet Prometheus exposition: local (driver) registry + every
         worker snapshot the ``metrics`` scope holds, rank-labeled."""
         from ..utils import metrics as M
-        with self.server.kv_lock:  # type: ignore[attr-defined]
-            stored = dict(self.server.kv.get(METRICS_SCOPE, {}))  # type: ignore
+        store = store_for(self.server, METRICS_SCOPE)
+        with store.kv_lock:  # type: ignore[attr-defined]
+            stored = dict(store.kv.get(METRICS_SCOPE, {}))  # type: ignore
         snaps = [({"rank": "driver"}, M.REGISTRY.snapshot())]
         for key in sorted(stored):
             try:
@@ -174,8 +238,9 @@ class _KVHandler(BaseHTTPRequestHandler):
         """Merged fleet trace: every chunk the ``timeline`` scope holds,
         rank-laned on the shared aligned epoch (docs/timeline.md)."""
         from ..utils.timeline import merge_timeline_chunks
-        with self.server.kv_lock:  # type: ignore[attr-defined]
-            stored = dict(self.server.kv.get(TIMELINE_SCOPE, {}))  # type: ignore
+        store = store_for(self.server, TIMELINE_SCOPE)
+        with store.kv_lock:  # type: ignore[attr-defined]
+            stored = dict(store.kv.get(TIMELINE_SCOPE, {}))  # type: ignore
         merged = merge_timeline_chunks(stored)
         self._serve_body(json.dumps(merged).encode(), "application/json")
 
@@ -194,11 +259,18 @@ class _KVHandler(BaseHTTPRequestHandler):
                 stale_after = float(q["stale_after"][0])
         except (ValueError, TypeError):
             pass  # malformed query: fall back to the default patience
-        with self.server.kv_lock:  # type: ignore[attr-defined]
-            stored = dict(self.server.kv.get(HEALTH_SCOPE, {}))  # type: ignore
-            times = dict(self.server.kv_times.get(  # type: ignore
+        store = store_for(self.server, HEALTH_SCOPE)
+        with store.kv_lock:  # type: ignore[attr-defined]
+            stored = dict(store.kv.get(HEALTH_SCOPE, {}))  # type: ignore
+            times = dict(store.kv_times.get(  # type: ignore
                 HEALTH_SCOPE, {}))
         view = fleet_health(stored, times, stale_after=stale_after)
+        shards = kv_shard_health(self.server)
+        if shards is not None:
+            # Control-plane health rides the same view (docs/
+            # control-plane.md): a dark shard is a partial outage the
+            # on-call reader must see next to rank liveness.
+            view["kv_shards"] = shards
         self._serve_body(json.dumps(view).encode(), "application/json")
 
     def _serve_perf(self) -> None:
@@ -208,13 +280,15 @@ class _KVHandler(BaseHTTPRequestHandler):
         input-bound / stall-bound), root cause first — the same payload
         ``hvdrun doctor --perf`` renders."""
         from ..perf.ledger import merge_perf_reports
-        with self.server.kv_lock:  # type: ignore[attr-defined]
-            stored = dict(self.server.kv.get(PERF_SCOPE, {}))  # type: ignore
+        store = store_for(self.server, PERF_SCOPE)
+        with store.kv_lock:  # type: ignore[attr-defined]
+            stored = dict(store.kv.get(PERF_SCOPE, {}))  # type: ignore
         view = merge_perf_reports(stored)
         self._serve_body(json.dumps(view).encode(), "application/json")
 
     def do_DELETE(self) -> None:  # noqa: N802
         scope, key = self._split()
+        self._count_request()
         with self.server.kv_lock:  # type: ignore[attr-defined]
             existed = self.server.kv.get(scope, {}).pop(key, None)  # type: ignore
             self.server.kv_times.get(scope, {}).pop(key, None)  # type: ignore
@@ -225,27 +299,77 @@ class _KVHandler(BaseHTTPRequestHandler):
         pass
 
 
+def kv_shard_health(server) -> Optional[List[Dict]]:
+    """Per-shard control-plane health rows for /health and the doctor
+    rendering, or None on an unsharded server: shard index, bound port,
+    liveness (stop_shard marks a shard dark), request count, key count
+    and the scopes currently resident (docs/control-plane.md)."""
+    stores = getattr(server, "kv_stores", None)
+    if not stores or len(stores) < 2:
+        return None
+    rows = []
+    for i, store in enumerate(stores):
+        with store.kv_lock:
+            scopes = sorted(store.kv)
+            keys = sum(len(d) for d in store.kv.values())
+            requests = getattr(store, "kv_requests", 0)
+        rows.append({
+            "shard": i,
+            "port": store.server_address[1],
+            "alive": not getattr(store, "kv_stopped", False),
+            "requests": requests,
+            "keys": keys,
+            "scopes": scopes,
+        })
+    return rows
+
+
 class RendezvousServer:
     """Threaded KV server; start() returns the bound port (reference:
-    http_server.py:174-201 RendezvousServer.start/init)."""
+    http_server.py:174-201 RendezvousServer.start/init).
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    ``shards=N`` (docs/control-plane.md) starts N-1 additional KV shard
+    servers in this process (own store/lock/accept loop each, ephemeral
+    ports); server-side accessors route per scope through the
+    deterministic ``kvshard.shard_for_scope`` map, exactly like the
+    workers' clients, so both sides agree by construction."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 shards: int = 1):
         self._host = host
         self._port = port
+        self._shards = max(1, int(shards))
         self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
-        self._final_kv: dict = {}
-        self._final_kv_times: dict = {}
+        self._shard_httpds: List[ThreadingHTTPServer] = []
+        self._threads: List[threading.Thread] = []
+        self._final_kv: List[dict] = []
+        self._final_kv_times: List[dict] = []
 
     def start(self) -> int:
-        self._httpd = ThreadingHTTPServer((self._host, self._port),
-                                          _KVHandler)
-        self._httpd.kv = {}  # type: ignore[attr-defined]
-        self._httpd.kv_times = {}  # type: ignore[attr-defined]
-        self._httpd.kv_lock = threading.Lock()  # type: ignore[attr-defined]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        wakeup = threading.Condition()
+        stores: List[ThreadingHTTPServer] = []
+        for i in range(self._shards):
+            # Only the primary gets the requested port; shard servers
+            # bind ephemeral ports published via the shard map.
+            httpd = ThreadingHTTPServer(
+                (self._host, self._port if i == 0 else 0), _KVHandler)
+            httpd.kv = {}  # type: ignore[attr-defined]
+            httpd.kv_times = {}  # type: ignore[attr-defined]
+            httpd.kv_lock = threading.Lock()  # type: ignore[attr-defined]
+            httpd.kv_requests = 0  # type: ignore[attr-defined]
+            httpd.kv_stopped = False  # type: ignore[attr-defined]
+            httpd.shard_index = i  # type: ignore[attr-defined]
+            httpd.kv_wakeup = wakeup  # type: ignore[attr-defined]
+            stores.append(httpd)
+        for httpd in stores:
+            # Every shard sees the full store list: render routes and
+            # the router resolve a scope's owner in-process.
+            httpd.kv_stores = stores  # type: ignore[attr-defined]
+            t = threading.Thread(target=httpd.serve_forever, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._httpd = stores[0]
+        self._shard_httpds = stores
         return self._httpd.server_address[1]
 
     @property
@@ -253,13 +377,34 @@ class RendezvousServer:
         assert self._httpd is not None
         return self._httpd.server_address[1]
 
+    @property
+    def shard_ports(self) -> List[int]:
+        """Bound port per shard, primary first — what the launcher
+        stamps into HOROVOD_KV_SHARD_ADDRS and publishes at scope
+        ``kvshard`` key ``map``."""
+        assert self._shard_httpds
+        return [h.server_address[1] for h in self._shard_httpds]
+
+    def publish_shard_map(self, addr: str) -> None:
+        """Publish the shard address list to the primary's ``kvshard``
+        scope so workers and the router can cross-check the map they
+        derived from env (agreement by construction, visible by KV)."""
+        self.put(MAP_SCOPE, MAP_KEY, json.dumps({
+            "n": self._shards,
+            "addrs": [f"{addr}:{p}" for p in self.shard_ports],
+        }).encode())
+
+    def _store(self, scope: str):
+        assert self._httpd is not None
+        return store_for(self._httpd, scope)
+
     def put(self, scope: str, key: str, value: bytes) -> None:
         """Server-side direct write (launcher publishing slot info,
         reference: http_server.py:134-172 init(host_alloc_plan))."""
-        assert self._httpd is not None
-        with self._httpd.kv_lock:  # type: ignore[attr-defined]
-            self._httpd.kv.setdefault(scope, {})[key] = value  # type: ignore
-            self._httpd.kv_times.setdefault(scope, {})[key] = \
+        store = self._store(scope)
+        with store.kv_lock:  # type: ignore[attr-defined]
+            store.kv.setdefault(scope, {})[key] = value  # type: ignore
+            store.kv_times.setdefault(scope, {})[key] = \
                 time.time()  # type: ignore[attr-defined]
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
@@ -267,43 +412,76 @@ class RendezvousServer:
             # Server-side reads stay valid after stop(): the store is
             # retained so drivers can harvest worker-published state
             # (e.g. elastic per-rank results) during teardown.
-            return self._final_kv.get(scope, {}).get(key)
-        with self._httpd.kv_lock:  # type: ignore[attr-defined]
-            return self._httpd.kv.get(scope, {}).get(key)  # type: ignore
+            return self._final_scope(scope).get(key)
+        store = self._store(scope)
+        with store.kv_lock:  # type: ignore[attr-defined]
+            return store.kv.get(scope, {}).get(key)  # type: ignore
+
+    def _final_scope(self, scope: str) -> dict:
+        idx = shard_for_scope(scope, len(self._final_kv) or 1)
+        if idx >= len(self._final_kv):
+            return {}
+        return self._final_kv[idx].get(scope, {})
 
     def scope_items(self, scope: str) -> Dict[str, bytes]:
         """All key->value pairs of a scope (valid after stop(), like
         get()); used to harvest worker metric snapshots."""
         if self._httpd is None:
-            return dict(self._final_kv.get(scope, {}))
-        with self._httpd.kv_lock:  # type: ignore[attr-defined]
-            return dict(self._httpd.kv.get(scope, {}))  # type: ignore
+            return dict(self._final_scope(scope))
+        store = self._store(scope)
+        with store.kv_lock:  # type: ignore[attr-defined]
+            return dict(store.kv.get(scope, {}))  # type: ignore
 
     def scope_receipt_times(self, scope: str) -> Dict[str, float]:
         """Wall-clock receipt time of every key in a scope (valid after
         stop(), like scope_items) — the server-side truth heartbeat
         staleness is judged from (utils/health.fleet_health)."""
         if self._httpd is None:
-            return dict(self._final_kv_times.get(scope, {}))
-        with self._httpd.kv_lock:  # type: ignore[attr-defined]
-            return dict(self._httpd.kv_times.get(scope, {}))  # type: ignore
+            idx = shard_for_scope(scope, len(self._final_kv_times) or 1)
+            if idx >= len(self._final_kv_times):
+                return {}
+            return dict(self._final_kv_times[idx].get(scope, {}))
+        store = self._store(scope)
+        with store.kv_lock:  # type: ignore[attr-defined]
+            return dict(store.kv_times.get(scope, {}))  # type: ignore
 
     def clear_scope(self, scope: str) -> None:
         """Drop every key in a scope (round-scoped state like elastic
         worker results)."""
-        assert self._httpd is not None
-        with self._httpd.kv_lock:  # type: ignore[attr-defined]
-            self._httpd.kv.pop(scope, None)  # type: ignore[attr-defined]
-            self._httpd.kv_times.pop(scope, None)  # type: ignore
+        store = self._store(scope)
+        with store.kv_lock:  # type: ignore[attr-defined]
+            store.kv.pop(scope, None)  # type: ignore[attr-defined]
+            store.kv_times.pop(scope, None)  # type: ignore
+
+    def stop_shard(self, index: int) -> None:
+        """Take ONE shard dark (server-side partial outage: connections
+        refused, the in-process store retained) — the chaos/test lever
+        behind the "one KV shard down" story.  The primary (index 0)
+        hosts the HTTP routes and cannot be stopped alone; use stop()."""
+        if index == 0:
+            raise ValueError("shard 0 is the primary; stop() the server")
+        httpd = self._shard_httpds[index]
+        if getattr(httpd, "kv_stopped", False):
+            return
+        httpd.kv_stopped = True  # type: ignore[attr-defined]
+        httpd.shutdown()
+        httpd.server_close()
 
     def stop(self) -> None:
         if self._httpd is not None:
-            with self._httpd.kv_lock:  # type: ignore[attr-defined]
-                self._final_kv = {s: dict(d) for s, d
-                                  in self._httpd.kv.items()}  # type: ignore
-                self._final_kv_times = {
-                    s: dict(d) for s, d
-                    in self._httpd.kv_times.items()}  # type: ignore
-            self._httpd.shutdown()
-            self._httpd.server_close()
+            self._final_kv = []
+            self._final_kv_times = []
+            for httpd in self._shard_httpds:
+                with httpd.kv_lock:  # type: ignore[attr-defined]
+                    self._final_kv.append(
+                        {s: dict(d)
+                         for s, d in httpd.kv.items()})  # type: ignore
+                    self._final_kv_times.append(
+                        {s: dict(d)
+                         for s, d in httpd.kv_times.items()})  # type: ignore
+                if not getattr(httpd, "kv_stopped", False):
+                    httpd.kv_stopped = True  # type: ignore[attr-defined]
+                    httpd.shutdown()
+                    httpd.server_close()
             self._httpd = None
+            self._shard_httpds = []

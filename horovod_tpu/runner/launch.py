@@ -107,6 +107,16 @@ def make_parser() -> argparse.ArgumentParser:
                    help="eager dispatch parallelism (HOROVOD_NUM_STREAMS)")
     p.add_argument("--mesh", default=None,
                    help="mesh spec, e.g. 'data=8' or 'data=4,model=2'")
+    p.add_argument("--kv-shards", type=int, default=None, metavar="N",
+                   help="partition the rendezvous KV across N shard "
+                        "servers (HOROVOD_KV_SHARDS; docs/control-plane"
+                        ".md): scopes are owned per the deterministic "
+                        "scope->shard map so serve traffic, telemetry "
+                        "and coordination stop contending on one accept "
+                        "loop, and one dark shard stalls only the "
+                        "scopes it owns; the shard address list is "
+                        "stamped into worker env and published at KV "
+                        "scope 'kvshard'")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve the fleet Prometheus view at "
                         "http://<driver>:PORT/metrics (pins the rendezvous "
@@ -729,6 +739,36 @@ def write_job_postmortem(rendezvous: RendezvousServer, postmortem_dir: str,
     return path
 
 
+def resolve_kv_shards(args: argparse.Namespace) -> int:
+    """Rendezvous-KV shard count: flag > HOROVOD_KV_SHARDS env > 1.
+    Validated here so a bad value fails the launch, not a worker."""
+    if getattr(args, "kv_shards", None) is not None:
+        n = int(args.kv_shards)
+    else:
+        try:
+            n = int(os.environ.get("HOROVOD_KV_SHARDS", "") or 1)
+        except ValueError:
+            n = 1
+    if n < 1:
+        raise ValueError(f"--kv-shards {n} invalid; the rendezvous KV "
+                         "needs at least one shard "
+                         "(docs/control-plane.md)")
+    return n
+
+
+def stamp_kv_shard_env(updates: Dict[str, str], coord_host: str,
+                       rendezvous: RendezvousServer,
+                       kv_shards: int) -> None:
+    """Worker-env half of the shard map contract: the count plus the
+    primary-first address list every KV client routes with
+    (runner/http_client; docs/control-plane.md)."""
+    if kv_shards <= 1:
+        return
+    updates["HOROVOD_KV_SHARDS"] = str(kv_shards)
+    updates["HOROVOD_KV_SHARD_ADDRS"] = ",".join(
+        f"{coord_host}:{p}" for p in rendezvous.shard_ports)
+
+
 def resolve_serve_port(args: argparse.Namespace) -> int:
     """--serve's router port: flag > HOROVOD_SERVE_PORT env/knob > 0
     (ephemeral; the startup banner prints the bound port)."""
@@ -779,8 +819,9 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
     # Port priority: --metrics-port (back compat) > --serve-port >
     # HOROVOD_SERVE_PORT knob > ephemeral.
     serve_port = resolve_serve_port(args)
+    kv_shards = resolve_kv_shards(args)
     rendezvous = RendezvousServer(port=args.metrics_port or serve_port
-                                  or 0)
+                                  or 0, shards=kv_shards)
     rdv_port = rendezvous.start()
     if getattr(args, "serve", None):
         print(f"[hvdrun] serving {args.serve}: POST http://"
@@ -797,6 +838,13 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
         slots[0].hostname, args.network_interface,
         warn=lambda m: print(f"[hvdrun] warning: {m}", file=sys.stderr),
         has_remote_workers=any(not _is_local(s.hostname) for s in slots))
+    if kv_shards > 1:
+        # Shard map at rendezvous (docs/control-plane.md): workers and
+        # the router agree on the partition by construction (same pure
+        # map), and the published list lets anyone cross-check it.
+        rendezvous.publish_shard_map(coord_host)
+        print(f"[hvdrun] rendezvous KV sharded {kv_shards}x: ports "
+              f"{rendezvous.shard_ports}", file=sys.stderr, flush=True)
     knob_env = args_to_env(args)
 
     procs: List[subprocess.Popen] = []
@@ -810,6 +858,7 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
         updates["HOROVOD_RENDEZVOUS_ADDR"] = coord_host
         updates["HOROVOD_RENDEZVOUS_PORT"] = str(rdv_port)
         updates["HOROVOD_CONTROLLER_PORT"] = str(args.controller_port)
+        stamp_kv_shard_env(updates, coord_host, rendezvous, kv_shards)
         if args.timeline_merge and not updates.get("HOROVOD_TIMELINE") \
                 and not os.environ.get("HOROVOD_TIMELINE"):
             # --timeline-merge without an explicit --timeline-filename:

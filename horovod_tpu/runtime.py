@@ -184,6 +184,26 @@ class Runtime:
                 f"{self.knobs['HOROVOD_BYPASS_STABLE_CYCLES']} invalid; "
                 "the epoch lock needs at least 1 stable step "
                 "(docs/knobs.md)")
+        # Sharded rendezvous KV (docs/control-plane.md): validate the
+        # shard count and the launcher-stamped address list here so a
+        # malformed map fails bring-up, not a KV op mid-run.  The
+        # client's per-scope routing itself reads the env lazily
+        # (runner/http_client), so nothing needs installing.
+        if self.knobs["HOROVOD_KV_SHARDS"] < 1:
+            raise ValueError(
+                f"HOROVOD_KV_SHARDS={self.knobs['HOROVOD_KV_SHARDS']} "
+                "invalid; the rendezvous KV needs at least one shard "
+                "(docs/control-plane.md)")
+        if self.knobs["HOROVOD_KV_SHARD_ADDRS"]:
+            from .runner.kvshard import parse_shard_addrs
+            addrs = parse_shard_addrs(self.knobs["HOROVOD_KV_SHARD_ADDRS"])
+            if len(addrs) != self.knobs["HOROVOD_KV_SHARDS"]:
+                raise ValueError(
+                    f"HOROVOD_KV_SHARD_ADDRS lists {len(addrs)} "
+                    f"shard(s) but HOROVOD_KV_SHARDS="
+                    f"{self.knobs['HOROVOD_KV_SHARDS']}; the scope->"
+                    "shard map is a modulus of the count, so the two "
+                    "must agree (docs/control-plane.md)")
 
         # Autotune (reference: HOROVOD_AUTOTUNE + ParameterManager,
         # parameter_manager.{h,cc}): Bayesian optimization over (fusion

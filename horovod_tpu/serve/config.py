@@ -130,3 +130,9 @@ def validate_serve_knobs(knobs: Any) -> None:
             f"HOROVOD_SERVE_SHED_LOW={low} exceeds "
             f"HOROVOD_SERVE_SHED_HIGH={high}; hysteresis needs "
             "low <= high (docs/serving.md)")
+    poll = float(_opt(knobs, "HOROVOD_SERVE_POLL_INTERVAL", 0.02))
+    if poll <= 0:
+        raise ValueError(
+            f"HOROVOD_SERVE_POLL_INTERVAL={poll} invalid; the router's "
+            "stream-probe interval must be positive seconds "
+            "(docs/control-plane.md)")

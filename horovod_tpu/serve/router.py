@@ -30,13 +30,26 @@ The handler side runs inside runner/http_server.py's threaded server
 threads are just the drains), so the router needs no process of its
 own: ``hvdrun --serve`` gives the fleet a router for free.  Stream
 reads and journal writes touch the IN-PROCESS kv dict (the router lives
-in the rendezvous server's process), so no KV transport error can kill
-a stream router-side; the worker-side KV legs carry the bounded
-exp-backoff retry (serve/worker.py ``_kv_op``).
+in the rendezvous server's process — with ``--kv-shards`` the owning
+shard's store, still in-process; docs/control-plane.md), so no KV
+transport error can kill a stream router-side; the worker-side KV legs
+carry the bounded exp-backoff retry (serve/worker.py ``_kv_op``).
+
+Token delivery is event-driven: rank 0's direct stream
+(serve/stream.py) and the shard servers' ``serve_out`` PUT path both
+notify the server's ``kv_wakeup`` condition, so ``_stream_results``
+wakes on arrival instead of busy-polling; the poll interval that
+remains (the fallback cadence, HOROVOD_SERVE_POLL_INTERVAL) backs off
+under an EWMA-informed cap (:class:`AdaptivePoll`).  Consumed streams
+are garbage-collected: once a client has drained ``.done``, the
+per-request ``serve_out`` parts are deleted and the done record slims
+to a tombstone, so a long-lived fleet's KV stops growing per token
+(journal entries are retained — the tombstone is what redrive skips).
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import math
 import threading
@@ -56,11 +69,74 @@ DRAINED_KEY = "drained"
 DEFAULT_MAX_PENDING = 64
 DEFAULT_STREAM_TIMEOUT_S = 120.0
 RETRY_AFTER_CAP_S = 60
-_POLL_S = 0.02
+_POLL_S = 0.02  # default base cadence; knob HOROVOD_SERVE_POLL_INTERVAL
 
 
 def req_key(seq: int) -> str:
     return f"req.{seq:06d}"
+
+
+def _store(server, scope: str):
+    """The in-process store owning ``scope``: the shard's httpd under
+    --kv-shards, the server itself otherwise (runner/http_server
+    store_for; every store lives in the router's process either way)."""
+    from ..runner.http_server import store_for
+    return store_for(server, scope)
+
+
+@contextlib.contextmanager
+def _locked_stores(server, *scopes):
+    """Acquire the owning stores' locks for several scopes at once (in
+    shard order, deduplicated — deadlock-free by canonical ordering)
+    and yield scope -> store.  The enqueue+journal critical section
+    spans two scopes that may live on different shards; the invariant
+    'journaled set == promised set' must hold across both."""
+    stores = {scope: _store(server, scope) for scope in scopes}
+    ordered = sorted({id(s): s for s in stores.values()}.values(),
+                     key=lambda s: getattr(s, "shard_index", 0))
+    with contextlib.ExitStack() as stack:
+        for s in ordered:
+            stack.enter_context(s.kv_lock)
+        yield stores
+
+
+class AdaptivePoll:
+    """EWMA-informed poll backoff for the stream drain: every empty
+    wait grows the next interval 1.5x from the knob base, capped by the
+    observed inter-part arrival gap's EWMA (never sleep far past when
+    the next token is due) and a hard ceiling; any arrival resets to
+    the base.  Pure arithmetic over an injectable clock — unit-tested
+    without sleeping (tests/test_kv_shard.py)."""
+
+    HARD_CAP_S = 0.25
+    GROWTH = 1.5
+    ALPHA = 0.3  # EWMA weight of the newest observed gap
+
+    def __init__(self, base_s: float):
+        self.base = max(1e-4, float(base_s))
+        self._cur = self.base
+        self._ewma_gap: Optional[float] = None
+        self._last_data: Optional[float] = None
+
+    def observe_data(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        if self._last_data is not None:
+            gap = max(0.0, now - self._last_data)
+            self._ewma_gap = gap if self._ewma_gap is None else (
+                (1 - self.ALPHA) * self._ewma_gap + self.ALPHA * gap)
+        self._last_data = now
+        self._cur = self.base
+
+    def cap(self) -> float:
+        if self._ewma_gap is None:
+            return self.HARD_CAP_S
+        return min(self.HARD_CAP_S, max(self.base, self._ewma_gap))
+
+    def idle(self) -> float:
+        """Interval to wait now; grows the next one."""
+        wait = min(self._cur, self.cap())
+        self._cur = min(self.cap(), self._cur * self.GROWTH)
+        return wait
 
 
 class RouterState:
@@ -73,9 +149,11 @@ class RouterState:
                  stream_timeout_s: float = DEFAULT_STREAM_TIMEOUT_S,
                  shed_high: Optional[int] = None,
                  shed_low: Optional[int] = None,
-                 journal: bool = True):
+                 journal: bool = True,
+                 poll_interval: float = _POLL_S):
         self.max_pending = int(max_pending)
         self.stream_timeout_s = float(stream_timeout_s)
+        self.poll_interval = float(poll_interval)
         self.shed_high = int(shed_high) if shed_high else self.max_pending
         if shed_low:
             self.shed_low = int(shed_low)
@@ -178,7 +256,8 @@ def get_router_state(server) -> RouterState:
         state = server.serve_router = RouterState(
             shed_high=int(knobs["HOROVOD_SERVE_SHED_HIGH"]) or None,
             shed_low=int(knobs["HOROVOD_SERVE_SHED_LOW"]) or None,
-            journal=bool(knobs["HOROVOD_SERVE_JOURNAL"]))
+            journal=bool(knobs["HOROVOD_SERVE_JOURNAL"]),
+            poll_interval=float(knobs["HOROVOD_SERVE_POLL_INTERVAL"]))
     return state
 
 
@@ -238,15 +317,18 @@ def handle_generate(handler) -> None:
     req["submitted_t"] = time.time()
     try:
         encoded = json.dumps(req).encode()
-        with server.kv_lock:
+        with _locked_stores(server, REQ_SCOPE, JOURNAL_SCOPE) as stores:
             now = time.time()
-            server.kv.setdefault(REQ_SCOPE, {})[key] = encoded
-            server.kv_times.setdefault(REQ_SCOPE, {})[key] = now
+            rq = stores[REQ_SCOPE]
+            rq.kv.setdefault(REQ_SCOPE, {})[key] = encoded
+            rq.kv_times.setdefault(REQ_SCOPE, {})[key] = now
             if state.journal:
-                # Same critical section as the enqueue: the journaled
-                # set and the promised set cannot diverge.
-                server.kv.setdefault(JOURNAL_SCOPE, {})[key] = encoded
-                server.kv_times.setdefault(JOURNAL_SCOPE, {})[key] = now
+                # Same critical section as the enqueue (both owning
+                # stores' locks held): the journaled set and the
+                # promised set cannot diverge.
+                jn = stores[JOURNAL_SCOPE]
+                jn.kv.setdefault(JOURNAL_SCOPE, {})[key] = encoded
+                jn.kv_times.setdefault(JOURNAL_SCOPE, {})[key] = now
         handler.send_response(200)
         handler.send_header("Content-Type", "application/x-ndjson")
         handler.send_header("X-Serve-Request-Id", key)
@@ -261,18 +343,27 @@ def _stream_results(handler, server, key: str, state: RouterState) -> None:
     arrive; ends with the ``.done`` record (or a timeout record).  Reads
     are in-process dict lookups — a fleet reset stalls the stream (no
     new parts) without breaking it, and the redriven fleet's resumed
-    parts continue it seamlessly."""
+    parts continue it seamlessly.  Arrival is event-driven: the direct
+    stream's ingest and the shard PUT path both notify ``kv_wakeup``;
+    the timed wait is only the fallback cadence, backed off by
+    :class:`AdaptivePoll`.  After the client consumes ``.done`` the
+    request's parts are deleted and the done record slims to a
+    tombstone (the marker redrive skips) so serve_out stays bounded."""
+    store = _store(server, OUT_SCOPE)
+    wakeup = getattr(server, "kv_wakeup", None)
+    poll = AdaptivePoll(state.poll_interval)
     deadline = time.time() + state.stream_timeout_s
     part = 0
     while True:
-        with server.kv_lock:
-            scope = server.kv.get(OUT_SCOPE, {})
+        with store.kv_lock:
+            scope = store.kv.get(OUT_SCOPE, {})
             chunk = scope.get(f"{key}.part.{part:06d}")
             done = scope.get(f"{key}.done")
         if chunk is not None:
             handler.wfile.write(chunk + b"\n")
             handler.wfile.flush()
             part += 1
+            poll.observe_data()
             continue
         if done is not None:
             handler.wfile.write(done + b"\n")
@@ -283,13 +374,47 @@ def _stream_results(handler, server, key: str, state: RouterState) -> None:
                                    len(rec.get("tokens") or ()))
             except (ValueError, TypeError):
                 pass  # a torn done record still ends the stream
+            _collect_consumed(store, key, part)
             return
         if time.time() >= deadline:
             handler.wfile.write(json.dumps(
                 {"error": f"timed out after {state.stream_timeout_s:.0f}s "
                           f"waiting for {key}"}).encode() + b"\n")
             return
-        time.sleep(_POLL_S)
+        wait = poll.idle()
+        if wakeup is not None:
+            with wakeup:
+                wakeup.wait(wait)
+        else:
+            time.sleep(wait)
+
+
+def _collect_consumed(store, key: str, nparts: int) -> None:
+    """Garbage-collect one fully-consumed stream: delete its serve_out
+    parts and slim ``.done`` to a token-free tombstone.  The tombstone
+    must survive — it is what redrive_plan (serve/journal.py) skips; a
+    deleted done with a retained journal entry would re-admit a request
+    whose client is gone."""
+    done_key = f"{key}.done"
+    with store.kv_lock:
+        scope = store.kv.get(OUT_SCOPE, {})
+        times = store.kv_times.get(OUT_SCOPE, {})
+        for p in range(nparts):
+            pk = f"{key}.part.{p:06d}"
+            scope.pop(pk, None)
+            times.pop(pk, None)
+        done = scope.get(done_key)
+        if done is None:
+            return
+        try:
+            rec = json.loads(done)
+        except (ValueError, TypeError):
+            rec = {}
+        scope[done_key] = json.dumps({
+            "done": True, "consumed": True,
+            "finish_reason": rec.get("finish_reason"),
+            "n_tokens": len(rec.get("tokens") or ()),
+        }).encode()
 
 
 def handle_drain(handler) -> None:
@@ -307,16 +432,17 @@ def handle_drain(handler) -> None:
     state.draining = True
     if first:
         M.SERVE_DRAINS.inc()
-    with server.kv_lock:
+    store = _store(server, STATS_SCOPE)
+    with store.kv_lock:
         now = time.time()
-        server.kv.setdefault(STATS_SCOPE, {})[DRAIN_KEY] = \
+        store.kv.setdefault(STATS_SCOPE, {})[DRAIN_KEY] = \
             json.dumps({"t": now}).encode()
-        server.kv_times.setdefault(STATS_SCOPE, {})[DRAIN_KEY] = now
+        store.kv_times.setdefault(STATS_SCOPE, {})[DRAIN_KEY] = now
     deadline = time.time() + float(Knobs()["HOROVOD_SERVE_DRAIN_TIMEOUT"])
     ack = None
     while time.time() < deadline:
-        with server.kv_lock:
-            ack = server.kv.get(STATS_SCOPE, {}).get(DRAINED_KEY)
+        with store.kv_lock:
+            ack = store.kv.get(STATS_SCOPE, {}).get(DRAINED_KEY)
         if ack is not None:
             break
         time.sleep(_POLL_S)
@@ -332,18 +458,27 @@ def handle_drain(handler) -> None:
 
 def render_stats(server) -> Dict[str, Any]:
     """GET /serve/stats: router counters + the engine fleet's
-    self-published stats (KV scope ``serve`` key ``stats``)."""
+    self-published stats (KV scope ``serve`` key ``stats``), plus the
+    control-plane shard health when the KV is sharded (the operational
+    view `hvdrun doctor --serve` renders; docs/control-plane.md)."""
     state = get_router_state(server)
     out: Dict[str, Any] = {"router": state.counters()}
-    with server.kv_lock:
-        raw = server.kv.get(STATS_SCOPE, {}).get(STATS_KEY)
-        journal = len(server.kv.get(JOURNAL_SCOPE, {}))
+    st = _store(server, STATS_SCOPE)
+    with st.kv_lock:
+        raw = st.kv.get(STATS_SCOPE, {}).get(STATS_KEY)
+    jn = _store(server, JOURNAL_SCOPE)
+    with jn.kv_lock:
+        journal = len(jn.kv.get(JOURNAL_SCOPE, {}))
     out["journal"] = {"enabled": state.journal, "entries": journal}
     if raw is not None:
         try:
             out["engine"] = json.loads(raw)
         except (ValueError, TypeError):
             pass  # a torn PUT must not 500 the stats view
+    from ..runner.http_server import kv_shard_health
+    shards = kv_shard_health(server)
+    if shards is not None:
+        out["kv_shards"] = shards
     return out
 
 

@@ -13,10 +13,15 @@ trains.  Fleet coordination rides the existing rendezvous KV:
     (serve/engine.py), so the fleet stays in lockstep without any new
     transport: the plan stream is the only coordination channel, and it
     is the same KV the chaos/metrics/timeline planes already exercise;
-  * rank 0 publishes results (scope ``serve_out``: per-tick token parts
-    + a final ``.done`` record) that the router streams to clients, and
-    a periodic engine-stats snapshot (scope ``serve`` key ``stats``)
-    for ``GET /serve/stats``.
+  * rank 0 publishes results that the router streams to clients — by
+    default over ONE persistent direct connection (``POST
+    /serve/stream``, serve/stream.py; knob HOROVOD_SERVE_DIRECT), which
+    the router mirrors into scope ``serve_out`` in-process so the
+    journal's redrive source of truth is unchanged; on connection loss
+    each record falls back to a ``serve_out`` KV PUT (per-tick token
+    parts + a final ``.done`` record — the pre-scale-out path,
+    docs/control-plane.md) — plus a periodic engine-stats snapshot
+    (scope ``serve`` key ``stats``) for ``GET /serve/stats``.
 
 Fault tolerance (docs/serving.md#fault-tolerance):
 
@@ -85,7 +90,7 @@ class FleetFrontend:
     def __init__(self, engine, addr: str, port: int, rank: int,
                  nprocs: int, plan_timeout_s: float = 120.0,
                  epoch: int = 0, journal: bool = True,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0, direct: bool = True):
         self.engine = engine
         self.addr = addr
         self.port = int(port or 0)
@@ -95,6 +100,8 @@ class FleetFrontend:
         self.epoch = int(epoch)
         self.journal = bool(journal)
         self.drain_timeout_s = float(drain_timeout_s)
+        self.direct = bool(direct)
+        self._dstream = None  # lazy: serve/stream.DirectTokenStream
         self.tick = 0
         self._next_seq = 0
         self._parts: Dict[str, int] = {}
@@ -241,6 +248,31 @@ class FleetFrontend:
         self._suppress[rid] = len(emitted)
 
     # ----------------------------------------------------------- outputs
+    def _direct_send(self, record: Dict[str, Any]) -> bool:
+        """Try the persistent direct stream (serve/stream.py;
+        docs/control-plane.md#direct-streaming).  False = not delivered
+        (direct off, or the connection is down and one reconnect
+        failed) — the caller publishes via the KV instead, and the
+        router's store sees the same keys either way."""
+        if not self.direct or self.rank != 0:
+            return False
+        if self._dstream is None:
+            from .stream import DirectTokenStream
+            self._dstream = DirectTokenStream(self.addr, self.port)
+        return self._dstream.send(record)
+
+    def _publish_part(self, rid: str, part: int, toks: List[int]) -> None:
+        if self._direct_send({"rid": rid, "part": part, "tokens": toks}):
+            return
+        self._kv_put(OUT_SCOPE, f"{rid}.part.{part:06d}",
+                     json.dumps({"tokens": toks}).encode())
+
+    def _publish_done(self, rid: str, done: Dict[str, Any]) -> None:
+        if self._direct_send({"rid": rid, "done": done}):
+            return
+        self._kv_put(OUT_SCOPE, f"{rid}.done",
+                     json.dumps(done).encode())
+
     def _publish_report(self, report: Dict[str, Any]) -> None:
         for rid, toks in report["emitted"].items():
             skip = self._suppress.get(rid, 0)
@@ -259,18 +291,16 @@ class FleetFrontend:
                 continue
             self._results.setdefault(rid, []).extend(toks)
             part = self._parts.get(rid, 0)
-            self._kv_put(OUT_SCOPE, f"{rid}.part.{part:06d}",
-                         json.dumps({"tokens": toks}).encode())
+            self._publish_part(rid, part, toks)
             self._parts[rid] = part + 1
         for req in report["finished"]:
-            self._kv_put(OUT_SCOPE, f"{req.req_id}.done",
-                         json.dumps({
-                             "done": True,
-                             "tokens": self._results.pop(req.req_id, []),
-                             "finish_reason": req.finish_reason,
-                             "ttft_s": req.ttft(),
-                             "tpot_s": req.tpot(),
-                         }).encode())
+            self._publish_done(req.req_id, {
+                "done": True,
+                "tokens": self._results.pop(req.req_id, []),
+                "finish_reason": req.finish_reason,
+                "ttft_s": req.ttft(),
+                "tpot_s": req.tpot(),
+            })
             self._parts.pop(req.req_id, None)
             self._suppress.pop(req.req_id, None)
 
@@ -369,10 +399,9 @@ class FleetFrontend:
                         # invalid per the engine's limits: answer it so
                         # the router stream doesn't hang to timeout
                         if self.rank == 0 and r.get("id") and kv_backed:
-                            self._kv_put(
-                                OUT_SCOPE, f"{r['id']}.done",
-                                json.dumps({"done": True, "tokens": [],
-                                            "error": str(e)}).encode())
+                            self._publish_done(r["id"],
+                                               {"done": True, "tokens": [],
+                                                "error": str(e)})
                 # Chaos step clock = the ENGINE's work-tick counter: it
                 # advances only when the fleet is decoding/prefilling,
                 # so a spec kill at step K lands mid-stream
@@ -393,6 +422,12 @@ class FleetFrontend:
                 except Exception:
                     pass
             raise
+        if self._dstream is not None:
+            # Orderly end of the direct stream: everything sent is
+            # already stored router-side, so this only releases the
+            # connection (a torn close loses nothing).
+            self._dstream.close()
+            self._dstream = None
         if self.rank == 0 and kv_backed:
             self._publish_stats(force=True)
             if drain_t is not None:
@@ -459,7 +494,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         hvd.process_rank(), hvd.process_size(),
         epoch=epoch,
         journal=bool(rt.knobs["HOROVOD_SERVE_JOURNAL"]),
-        drain_timeout_s=float(rt.knobs["HOROVOD_SERVE_DRAIN_TIMEOUT"]))
+        drain_timeout_s=float(rt.knobs["HOROVOD_SERVE_DRAIN_TIMEOUT"]),
+        direct=bool(rt.knobs["HOROVOD_SERVE_DIRECT"]))
     print(f"SERVE-READY rank {hvd.process_rank()} epoch {epoch} "
           f"({type(model_cfg).__name__}, slots={scfg.max_slots}, "
           f"blocks={scfg.cache_blocks}x{scfg.block_size})", flush=True)

@@ -127,7 +127,13 @@ class HeartbeatPublisher:
             return False
         try:
             body = json.dumps(self._payload_fn()).encode()
-            url = (f"http://{self.addr}:{self.port}/{self.SCOPE}/"
+            # Sharded KV (docs/control-plane.md): route to the health
+            # scope's owning shard.  Routing only — still not through
+            # put_kv, so a chaos blackout cannot sever liveness.
+            from ..runner.http_client import resolve_kv_addr
+            addr, port, _ = resolve_kv_addr(self.addr, self.port,
+                                            self.SCOPE)
+            url = (f"http://{addr}:{port}/{self.SCOPE}/"
                    f"rank.{self.rank}")
             delay = 0.1
             for attempt in range(retries + 1):

@@ -423,6 +423,25 @@ SERVE_SPEC_ACCEPTED = REGISTRY.counter(
     "Drafted tokens the greedy verify step accepted (emitted output "
     "stays bit-identical to plain greedy; the ratio to drafted is the "
     "accept rate).")
+# Control-plane scale-out (runner/kvshard.py, serve/stream.py;
+# docs/control-plane.md): per-shard rendezvous-KV traffic/outage
+# accounting and the direct token stream that took the hottest serve
+# path off KV polling.
+KV_SHARD_REQUESTS = REGISTRY.counter(
+    "hvd_kv_shard_requests_total",
+    "Rendezvous-KV requests handled per shard server (labeled "
+    "shard=index; counted by the driver's shard accept loops — only "
+    "emitted when HOROVOD_KV_SHARDS > 1).")
+KV_SHARD_UNAVAILABLE = REGISTRY.counter(
+    "hvd_kv_shard_unavailable_total",
+    "Transient KV-op failures against a shard (labeled shard=index; "
+    "counted client-side per attempt, so a backoff riding a dark shard "
+    "is visible while every other shard's traffic proceeds).")
+SERVE_STREAM_DIRECT_TOKENS = REGISTRY.counter(
+    "hvd_serve_stream_direct_tokens_total",
+    "Tokens delivered over rank 0's persistent direct stream (POST "
+    "/serve/stream) instead of serve_out KV PUTs + router polling; "
+    "counted at the router's ingest, where client delivery is assured.")
 
 # Perf-attribution plane (horovod_tpu/perf/; docs/profiling.md).  The
 # step-time decomposition ledger records here: measured step times, the
@@ -753,7 +772,13 @@ class MetricsPublisher:
             snap = self._snapshot_fn()
             snap["rank"] = self.rank
             body = json.dumps(snap).encode()
-            url = (f"http://{self.addr}:{self.port}/{self.SCOPE}/"
+            # Sharded KV (docs/control-plane.md): the metrics scope may
+            # live on a shard server, not the primary — resolve per
+            # publish (stdlib-only module, routing logic included).
+            from ..runner.http_client import resolve_kv_addr
+            addr, port, _ = resolve_kv_addr(self.addr, self.port,
+                                            self.SCOPE)
+            url = (f"http://{addr}:{port}/{self.SCOPE}/"
                    f"rank.{self.rank}")
             # Bounded retry (stdlib-only by design — see module docstring;
             # runner/http_client.put_kv carries the canonical schedule): a

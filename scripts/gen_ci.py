@@ -76,7 +76,7 @@ SUITES = {
         "tests/test_platform_utils.py",
     ],
     "serving": ["tests/test_serve.py", "tests/test_serve_ft.py",
-                "tests/test_serve_speed.py"],
+                "tests/test_serve_speed.py", "tests/test_kv_shard.py"],
     "perf": ["tests/test_perf.py"],
     "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py",
                        "tests/test_profile_analyzer.py"],
@@ -114,6 +114,13 @@ KNOB_DIMS = [
     ("serve-prefix-off", {"HOROVOD_SERVE_PREFIX_CACHE": "0"},
      ["serving"]),
     ("serve-spec-off", {"HOROVOD_SERVE_SPEC": "0"},
+     ["serving"]),
+    # control-plane scale-out off/on (docs/control-plane.md): the
+    # serving suite must stay green over a 3-shard KV with direct
+    # streaming disabled (every token back on the KV PUT+poll path) —
+    # the degraded/pre-scale-out combination.
+    ("kv-shards-3", {"HOROVOD_KV_SHARDS": "3",
+                     "HOROVOD_SERVE_DIRECT": "0"},
      ["serving"]),
 ]
 
@@ -201,6 +208,18 @@ def build_steps():
         f"tests/integration/test_elastic_serve_integration.py {full}",
         env={"JAX_PLATFORMS": "cpu"}, timeout=25))
     steps.append(_step(
+        # sharded-serve chaos smoke: the control-plane scale-out
+        # acceptance experiment — two 2-proc fleets over a 3-shard KV
+        # with direct token streaming; fleet B's chaos spec blacks out
+        # the serve and plan shards MID-RUN (op-offset windows) and
+        # every accepted /generate stream must complete byte-identical
+        # to the unfaulted fleet's, with per-shard health at /health
+        # (docs/control-plane.md).
+        "chaos: sharded-serve partial-outage smoke",
+        f"{py} -m pytest "
+        f"tests/integration/test_kv_shard_integration.py {full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=20))
+    steps.append(_step(
         # perf-attribution smoke: a 2-process CPU-virtual fleet records
         # steps through the decomposition ledger; the components sum to
         # the measured step time within 10%, the merged GET /perf view
@@ -254,6 +273,14 @@ def build_steps():
         # (docs/serving.md#raw-speed) — all CPU-virtual.
         "bench: serve load-gen + speed-legs smoke",
         f"{py} bench.py --serve --cpu", timeout=15))
+    steps.append(_step(
+        # control-plane saturation smoke: the closed-loop user sweep
+        # drives POST /generate through the REAL router + KV for the
+        # single-process baseline AND the sharded+direct config; the
+        # knee rows ride the artifact for the perf gate
+        # (docs/control-plane.md) — all CPU-virtual.
+        "bench: serve control-plane saturation smoke",
+        f"{py} bench.py --serve --users 1,2,4 --cpu", timeout=15))
     steps.append(_step(
         # perf regression gate smoke: bench.py --cpu runs three times —
         # two baseline the host's noise, the unmodified re-run must

@@ -20,6 +20,13 @@ default run checks the whole repo and exits nonzero on any violation:
                          engine / plan-stream lockstep path — the
                          determinism contract the journal redrive and
                          the fleet plan stream depend on.
+  kvshard-determinism    the scope->shard map (runner/kvshard.py) is a
+                         pure function of (scope, shard count): no RNG,
+                         no wall-clock control flow, no set iteration,
+                         no builtin hash() (PYTHONHASHSEED-dependent),
+                         no environment reads — every rank, the router
+                         and the driver must derive the SAME partition
+                         (docs/control-plane.md).
   serve-kv-retry         serve-worker KV legs go through the _kv_op
                          bounded-backoff wrapper, never raw
                          get_kv/put_kv/delete_kv (a transient rendezvous
@@ -347,6 +354,62 @@ def check_serve_determinism(
     return out
 
 
+# ------------------------------------------------------ kvshard-determinism
+class _KVShardVisitor(_DeterminismVisitor):
+    """The serve-determinism checks plus two map-specific hazards:
+    builtin ``hash()`` (varies per process under PYTHONHASHSEED) and
+    environment reads (two ranks with different env would partition the
+    KV differently)."""
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Name) and node.func.id == "hash":
+            self._flag(node, "builtin hash() in the scope->shard map "
+                             "(PYTHONHASHSEED-dependent: ranks would "
+                             "disagree on the partition; use the FNV "
+                             "helper)")
+        if self._is_module_call(node, "os", {"getenv"}):
+            self._flag(node, "environment read in the scope->shard map "
+                             "(the map must be a pure function of "
+                             "(scope, count))")
+        super().visit_Call(node)
+
+    def visit_Attribute(self, node):
+        if (isinstance(node.value, ast.Name) and node.value.id == "os"
+                and node.attr == "environ"):
+            self._flag(node, "os.environ access in the scope->shard map "
+                             "(the map must be a pure function of "
+                             "(scope, count))")
+        self.generic_visit(node)
+
+
+def check_kvshard_determinism(
+        root: str = REPO,
+        rel: str = "horovod_tpu/runner/kvshard.py") -> List[Violation]:
+    """The scope->shard map is a pure function of (scope, count)."""
+    rule = "kvshard-determinism"
+    src = _read(root, rel)
+    tree = ast.parse(src)
+    lines = src.splitlines()
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            mods = [a.name for a in node.names] if isinstance(
+                node, ast.Import) else [node.module or ""]
+            bad = [m1 for m1 in mods
+                   if m1 == "random" or m1.startswith("random.")
+                   or m1 == "time" or m1.startswith("time.")]
+            if bad and not _allowed(lines[node.lineno - 1], rule):
+                out.append(Violation(
+                    rule, rel, node.lineno,
+                    f"{'/'.join(bad)} imported in the scope->shard map "
+                    "module (determinism contract; "
+                    "docs/control-plane.md)"))
+    v = _KVShardVisitor(rel, lines, rule)
+    v.visit(tree)
+    out.extend(v.out)
+    return out
+
+
 # ----------------------------------------------------------- serve-kv-retry
 _KV_OPS = {"get_kv", "put_kv", "delete_kv"}
 _KV_WRAPPERS = {"_kv_op", "_kv_get", "_kv_put", "_kv_delete"}
@@ -540,6 +603,7 @@ RULES = {
     "knob-registry": check_knob_registry,
     "metrics-documented": check_metrics_documented,
     "serve-determinism": check_serve_determinism,
+    "kvshard-determinism": check_kvshard_determinism,
     "serve-kv-retry": check_serve_kv_retry,
     "unique-test-basenames": check_unique_test_basenames,
     "signal-safety": check_signal_safety,

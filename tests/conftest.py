@@ -81,6 +81,7 @@ _SLOW_TESTS = {  # file::test (param ids stripped), >= ~8 s measured
         "test_bench_autotune_cpu_contract",
         "test_bench_scaling_cpu_contract", "test_bench_wire_cpu_contract",
         "test_bench_overlap_cpu_contract", "test_bench_serve_cpu_contract",
+        "test_bench_serve_users_cpu_contract",
     },
     "test_models.py": {
         "test_inception_v3_forward_and_grads",

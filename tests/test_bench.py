@@ -144,6 +144,34 @@ def test_bench_overlap_cpu_contract():
 
 
 @pytest.mark.slow
+def test_bench_serve_users_cpu_contract():
+    """--serve --users: the control-plane saturation sweep
+    (docs/control-plane.md) — per-user-count rows for the single-shard
+    baseline AND the sharded+direct config, a knee per config, the
+    gate-able sub_rows (knee throughputs + scale-out gain), and the
+    explicit measures-router-not-decode labeling."""
+    env = dict(os.environ)
+    env["BENCH_DEADLINE_S"] = "300"
+    rec = _run_bench("--serve", "--users", "1,2,4", env=env, timeout=400)
+    assert rec["unit"] == "tokens/sec"
+    assert "CPU-virtual" in rec["label"] and "router" in rec["label"]
+    assert rec["user_counts"] == [1, 2, 4]
+    for cfg in ("single", "sharded_direct"):
+        res = rec[cfg]
+        assert [r["users"] for r in res["rows"]] == [1, 2, 4]
+        assert all(r["tok_s"] > 0 for r in res["rows"]), res
+        assert res["knee_users"] in (1, 2, 4)
+        assert res["knee_tok_s"] >= 0.9 * res["peak_tok_s"]
+    subs = {r["metric"].split(" (")[0]: r for r in rec["sub_rows"]}
+    assert "serve ctrl-plane scale-out gain" in subs
+    assert subs["serve ctrl-plane scale-out gain"]["unit"] == "x"
+    assert subs["serve ctrl-plane single knee throughput"]["value"] == \
+        rec["single"]["knee_tok_s"]
+    assert subs["serve ctrl-plane sharded-direct knee throughput"][
+        "value"] == rec["sharded_direct"]["knee_tok_s"]
+
+
+@pytest.mark.slow
 def test_bench_serve_cpu_contract():
     """--serve: the serving load-generator artifact (docs/serving.md):
     a closed-loop row (fixed user pool, the throughput ceiling) and a

@@ -1,0 +1,500 @@
+"""Control-plane scale-out (docs/control-plane.md), fast tier: the
+deterministic scope->shard map and its hvdlint contract, per-scope
+client/server routing, per-shard blackout isolation (client-injected
+chaos AND a server-side dark shard), the direct token stream with its
+KV-PUT fallback and byte-identical redrive recovery, the router's
+EWMA-informed poll backoff, and the consumed-stream garbage collection.
+Deliberately jax-free: everything here is host-side rendezvous/router/
+frontend machinery driven through real HTTP servers."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import horovod_tpu.chaos as chaos
+from horovod_tpu.runner import http_client as hc
+from horovod_tpu.runner.http_server import (RendezvousServer,
+                                            kv_shard_health, store_for)
+from horovod_tpu.runner.kvshard import (format_shard_addrs,
+                                        parse_shard_addrs,
+                                        shard_for_scope)
+from horovod_tpu.serve.journal import JOURNAL_SCOPE, redrive_plan
+from horovod_tpu.serve.router import (OUT_SCOPE, REQ_SCOPE, AdaptivePoll,
+                                      RouterState, req_key)
+from horovod_tpu.serve.stream import DirectTokenStream
+from horovod_tpu.serve.worker import FleetFrontend
+from horovod_tpu.utils import metrics as M
+
+from test_serve_ft import ScriptedEngine, scripted_tokens
+
+SCOPES = ["metrics", "health", "timeline", "perf", "chaos", "serve",
+          "serve_req", "serve_out", "serve_plan", "serve_journal",
+          "rank", "host_update"]
+
+
+@pytest.fixture()
+def sharded():
+    """A 3-shard rendezvous server with the client map installed (and
+    cleaned up) — the docs/control-plane.md topology in miniature."""
+    server = RendezvousServer(host="127.0.0.1", shards=3)
+    port = server.start()
+    addrs = [("127.0.0.1", p) for p in server.shard_ports]
+    hc.install_shard_map(addrs)
+    try:
+        yield server, port, addrs
+    finally:
+        hc.install_shard_map(None)
+        server.stop()
+
+
+def _counter_total(counter):
+    return sum(s["value"] for s in counter.to_family()["samples"])
+
+
+# ------------------------------------------------------- scope->shard map
+def test_shard_map_deterministic_goldens():
+    """Pinned values: the partition is part of the wire contract (a
+    silent hash change would strand every scope's data)."""
+    assert shard_for_scope("serve_out", 3) == 1
+    assert shard_for_scope("serve_plan", 3) == 2
+    assert shard_for_scope("metrics", 3) == 0
+    assert shard_for_scope("health", 3) == 0
+    for s in SCOPES:
+        assert shard_for_scope(s, 1) == 0
+        assert 0 <= shard_for_scope(s, 3) < 3
+        # pure: identical on repeated evaluation
+        assert shard_for_scope(s, 3) == shard_for_scope(s, 3)
+
+
+def test_shard_map_bootstrap_scope_pinned_to_primary():
+    """The kvshard scope (holding the published map) must live on the
+    door a mapless client already knows, for every shard count."""
+    for n in (1, 2, 3, 4, 7):
+        assert shard_for_scope("kvshard", n) == 0
+
+
+def test_shard_map_spreads_scopes():
+    """The planes genuinely stop sharing one accept loop at N=3: the
+    known scopes cover more than one shard."""
+    owners = {shard_for_scope(s, 3) for s in SCOPES}
+    assert len(owners) >= 2
+
+
+def test_shard_addrs_roundtrip_and_validation():
+    addrs = [("h0", 1), ("h1", 2), ("10.0.0.3", 65535)]
+    assert parse_shard_addrs(format_shard_addrs(addrs)) == addrs
+    assert parse_shard_addrs("") == []
+    with pytest.raises(ValueError):
+        parse_shard_addrs("no-port-here")
+
+
+def test_kvshard_determinism_lint_fixture(tmp_path):
+    """The hvdlint rule actually catches the hazards it names (builtin
+    hash, RNG, env reads) and passes the real module."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_hvdlint", "scripts/hvdlint.py")
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    bad = tmp_path / "horovod_tpu" / "runner"
+    bad.mkdir(parents=True)
+    (bad / "kvshard.py").write_text(
+        "import os\nimport random\n"
+        "def shard_for_scope(scope, n):\n"
+        "    if os.environ.get('X'):\n"
+        "        return random.randrange(n)\n"
+        "    return hash(scope) % n\n")
+    out = lint.check_kvshard_determinism(root=str(tmp_path))
+    msgs = " ".join(v.message for v in out)
+    assert "hash()" in msgs and "random" in msgs.lower()
+    assert "environ" in msgs
+    assert lint.check_kvshard_determinism() == []  # the real module
+
+
+# ------------------------------------------------------- routed transport
+def test_client_routes_puts_to_owning_shard(sharded):
+    server, port, addrs = sharded
+    hc.put_kv("127.0.0.1", port, "serve_out", "k", b"v")
+    stores = server._httpd.kv_stores
+    own = shard_for_scope("serve_out", 3)
+    assert stores[own].kv["serve_out"]["k"] == b"v"
+    for i, s in enumerate(stores):
+        if i != own:
+            assert "serve_out" not in s.kv
+    # reads route identically; server-side accessors agree
+    assert hc.get_kv("127.0.0.1", port, "serve_out", "k",
+                     timeout=2) == b"v"
+    assert server.get("serve_out", "k") == b"v"
+    assert server.scope_items("serve_out") == {"k": b"v"}
+    assert hc.delete_kv("127.0.0.1", port, "serve_out", "k")
+    assert server.get("serve_out", "k") is None
+
+
+def test_client_reroutes_only_fleet_primary(sharded):
+    """A request aimed at an ad-hoc server (not the fleet primary) must
+    pass through untouched — tests and side servers keep working."""
+    server, port, addrs = sharded
+    other = RendezvousServer(host="127.0.0.1")
+    oport = other.start()
+    try:
+        hc.put_kv("127.0.0.1", oport, "serve_out", "k", b"side")
+        assert other.get("serve_out", "k") == b"side"
+        assert server.get("serve_out", "k") is None
+    finally:
+        other.stop()
+
+
+def test_env_map_routes_without_install(sharded, monkeypatch):
+    """Workers route from HOROVOD_KV_SHARD_ADDRS alone (the launcher's
+    stamp), no explicit install needed."""
+    server, port, addrs = sharded
+    hc.install_shard_map(None)
+    monkeypatch.setenv("HOROVOD_KV_SHARD_ADDRS", format_shard_addrs(addrs))
+    hc.put_kv("127.0.0.1", port, "serve_plan", "t", b"p")
+    own = shard_for_scope("serve_plan", 3)
+    assert server._httpd.kv_stores[own].kv["serve_plan"]["t"] == b"p"
+
+
+def test_sharded_client_class_routes(sharded):
+    server, port, addrs = sharded
+    client = hc.ShardedKVClient(addrs)
+    client.put("perf", "rank.0", b"{}")
+    own = shard_for_scope("perf", 3)
+    assert server._httpd.kv_stores[own].kv["perf"]["rank.0"] == b"{}"
+    assert client.get("perf", "rank.0", timeout=2) == b"{}"
+    assert client.delete("perf", "rank.0")
+
+
+def test_shard_map_published_at_rendezvous(sharded):
+    server, port, addrs = sharded
+    server.publish_shard_map("127.0.0.1")
+    raw = hc.get_kv("127.0.0.1", port, "kvshard", "map", timeout=2)
+    doc = json.loads(raw)
+    assert doc["n"] == 3
+    assert doc["addrs"] == [f"{a}:{p}" for a, p in addrs]
+
+
+# --------------------------------------------------- partial-outage chaos
+def test_blackout_shard_isolation():
+    """A kv_blackout pinned to one shard fails ONLY ops whose scope that
+    shard owns; every other scope's traffic proceeds — the partial
+    outage a production fleet actually sees."""
+    dark = shard_for_scope("serve_plan", 3)
+    spec = chaos.parse_spec({"events": [
+        {"kind": "kv_blackout", "shard": dark, "count": 2}]})
+    inj = chaos.ChaosInjector(spec, rank=0)
+    inj._kv_shards = 3  # pinned: unit test, no knob env
+    inj.maybe_fail_kv("get", "metrics")      # other shard: untouched
+    inj.maybe_fail_kv("put", "serve_out")    # other shard: untouched
+    for _ in range(2):
+        with pytest.raises(urllib.error.URLError):
+            inj.maybe_fail_kv("get", "serve_plan")
+    inj.maybe_fail_kv("get", "serve_plan")   # window exhausted
+    inj.maybe_fail_kv("get", "metrics")      # still untouched
+
+
+def test_blackout_windows_ride_independently():
+    """Per-EVENT counters: two blackout events (two shards) fail their
+    own budgets without consuming each other's."""
+    spec = chaos.parse_spec({"events": [
+        {"kind": "kv_blackout", "scope": "serve_plan", "count": 1},
+        {"kind": "kv_blackout", "scope": "metrics", "count": 1}]})
+    inj = chaos.ChaosInjector(spec, rank=0)
+    with pytest.raises(urllib.error.URLError):
+        inj.maybe_fail_kv("get", "serve_plan")
+    # event 1's budget must be intact even though event 0 fired
+    with pytest.raises(urllib.error.URLError):
+        inj.maybe_fail_kv("get", "metrics")
+    inj.maybe_fail_kv("get", "serve_plan")
+    inj.maybe_fail_kv("get", "metrics")
+
+
+def test_blackout_op_offset_window():
+    """For kv_blackout, `step` is an op offset: the window opens only
+    after that many matching ops were observed (a mid-run outage, not a
+    bring-up blackout)."""
+    spec = chaos.parse_spec({"events": [
+        {"kind": "kv_blackout", "scope": "serve_out", "step": 3,
+         "count": 2}]})
+    inj = chaos.ChaosInjector(spec, rank=0)
+    for _ in range(3):
+        inj.maybe_fail_kv("put", "serve_out")  # window not open yet
+    for _ in range(2):
+        with pytest.raises(urllib.error.URLError):
+            inj.maybe_fail_kv("put", "serve_out")
+    inj.maybe_fail_kv("put", "serve_out")      # window exhausted
+
+
+def test_dark_shard_degrades_telemetry_not_serving(sharded):
+    """Server-side partial outage: stop the shard owning metrics/health
+    — publishers swallow the refusals (liveness/telemetry degrade), the
+    serving scopes on other shards keep working, and /health + doctor
+    name the dark shard."""
+    from horovod_tpu.runner.doctor import render_serve
+    from horovod_tpu.utils.health import HeartbeatPublisher
+    from horovod_tpu.utils.metrics import MetricsPublisher
+    server, port, addrs = sharded
+    telemetry = shard_for_scope("metrics", 3)
+    assert telemetry == shard_for_scope("health", 3) == 0
+    # sanity: serving scopes are NOT on the telemetry shard at N=3
+    assert shard_for_scope("serve_out", 3) != telemetry
+    with pytest.raises(ValueError):
+        server.stop_shard(0)  # the primary hosts the routes
+    # make the telemetry scopes' shard the primary's neighbor... the
+    # map pins metrics/health to shard 0 (the primary) at N=3, so the
+    # server-side dark-shard experiment uses a non-primary one:
+    dark = shard_for_scope("serve_plan", 3)
+    assert dark != 0
+    server.stop_shard(dark)
+    # ops against the dark shard's scopes now fail at the transport
+    with pytest.raises(Exception):
+        hc.put_kv("127.0.0.1", port, "serve_plan", "t", b"p", retries=0)
+    # every other shard's traffic proceeds
+    hc.put_kv("127.0.0.1", port, "serve_out", "k", b"v")
+    before = _counter_total(M.KV_SHARD_UNAVAILABLE)
+    assert before > 0  # the failed attempts were counted per shard
+    # publishers to live shards still work; a publisher is never fatal
+    pub = MetricsPublisher("127.0.0.1", 0, rank=0, snapshot_fn=dict)
+    assert pub.publish_now() is False  # disabled (no port): never raises
+    hb = HeartbeatPublisher("127.0.0.1", port, rank=0,
+                            payload_fn=lambda: {"rank": 0})
+    assert hb.publish_now() is True
+    hb.close()
+    # /health and doctor --serve surface the outage
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/health",
+                                timeout=5) as r:
+        view = json.loads(r.read())
+    rows = {s["shard"]: s for s in view["kv_shards"]}
+    assert rows[dark]["alive"] is False
+    assert rows[0]["alive"] is True
+    rendered = render_serve({"router": {}, "journal": {},
+                             "kv_shards": view["kv_shards"]})
+    assert "DARK" in rendered and f"shard {dark}" in rendered
+
+
+def test_telemetry_shard_blackout_never_stalls_serving(sharded):
+    """A blackout pinned to the telemetry shard (metrics/health at N=3)
+    must not delay a single token: the serving scopes live on other
+    shards, so their KV legs never match the event — serving proceeds
+    at full speed while telemetry degrades."""
+    server, port, addrs = sharded
+    server._httpd.serve_router = RouterState(journal=True)
+    telemetry = shard_for_scope("metrics", 3)
+    spec = chaos.parse_spec({"events": [
+        {"kind": "kv_blackout", "shard": telemetry, "count": 1000}]})
+    inj = chaos.install(spec, rank=0)
+    inj._kv_shards = 3
+    try:
+        # telemetry legs riding http_client DO fail for the window...
+        with pytest.raises(urllib.error.URLError):
+            hc.put_kv("127.0.0.1", port, "metrics", "rank.0", b"{}",
+                      retries=0)
+        # ...while a full /generate stream completes with zero serving-
+        # scope injections (the injector's per-event counter is the
+        # witness: only telemetry ops were charged).
+        fe = FleetFrontend(ScriptedEngine(), "127.0.0.1", port, 0, 1,
+                           direct=True)
+        out = [None]
+        t = threading.Thread(target=_drain_generate,
+                             args=(port, [4, 4], 3, out, 0))
+        t.start()
+        deadline = time.time() + 30
+        while out[0] is None and time.time() < deadline:
+            fe.run(ttl_s=0.05)
+            time.sleep(0.01)
+        t.join(timeout=10)
+        assert out[0] is not None and out[0][-1]["done"] is True
+        assert out[0][-1]["tokens"] == scripted_tokens([4, 4], 3)
+    finally:
+        chaos.uninstall()
+
+
+def test_shard_request_metric_moves(sharded):
+    server, port, addrs = sharded
+    own = shard_for_scope("timeline", 3)
+    before = _counter_total(M.KV_SHARD_REQUESTS)
+    hc.put_kv("127.0.0.1", port, "timeline", "rank.0.0", b"{}")
+    assert _counter_total(M.KV_SHARD_REQUESTS) > before
+    health = kv_shard_health(server._httpd)
+    assert health[own]["requests"] >= 1
+
+
+# ------------------------------------------------------- direct streaming
+def _drain_generate(port, tokens, max_new, out, idx):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps({"tokens": tokens,
+                         "max_new_tokens": max_new}).encode(),
+        method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out[idx] = [json.loads(ln) for ln in r.read().splitlines()]
+
+
+def test_direct_stream_end_to_end_with_sharded_kv(sharded):
+    """The full hot path: /generate -> KV enqueue -> frontend (direct
+    stream ON) -> hub mirror -> event-driven stream drain — over a
+    3-shard KV.  Tokens match the scripted engine's deterministic
+    output, the direct-tokens counter moves, and the consumed stream's
+    serve_out parts are garbage-collected with a tombstone."""
+    server, port, addrs = sharded
+    server._httpd.serve_router = RouterState(journal=True)
+    before = _counter_total(M.SERVE_STREAM_DIRECT_TOKENS)
+    fe = FleetFrontend(ScriptedEngine(), "127.0.0.1", port, 0, 1,
+                       direct=True)
+    out = [None]
+    t = threading.Thread(target=_drain_generate,
+                         args=(port, [5, 6], 4, out, 0))
+    t.start()
+    deadline = time.time() + 30
+    while out[0] is None and time.time() < deadline:
+        fe.run(ttl_s=0.05)
+        time.sleep(0.01)
+    t.join(timeout=10)
+    assert out[0] is not None, "stream never completed"
+    done = out[0][-1]
+    assert done["done"] is True
+    assert done["tokens"] == scripted_tokens([5, 6], 4)
+    parts = [tk for ln in out[0][:-1] for tk in ln["tokens"]]
+    assert parts == done["tokens"]
+    assert _counter_total(M.SERVE_STREAM_DIRECT_TOKENS) - before >= 4
+    assert fe._dstream is None or fe._dstream.fallbacks == 0
+    # consumed-stream GC: parts deleted, done slimmed to a tombstone
+    out_store = store_for(server._httpd, OUT_SCOPE)
+    with out_store.kv_lock:
+        scope = dict(out_store.kv.get(OUT_SCOPE, {}))
+    rid = req_key(0)
+    assert not any(k.startswith(f"{rid}.part.") for k in scope), scope
+    tomb = json.loads(scope[f"{rid}.done"])
+    assert tomb["consumed"] is True and "tokens" not in tomb
+    # the tombstone keeps redrive quiet: nothing to re-admit
+    entries, seq = redrive_plan(lambda s, k: server.get(s, k))
+    assert entries == [] and seq == 1
+
+
+def test_direct_stream_falls_back_to_kv_and_redrives_identically(
+        sharded, monkeypatch):
+    """Break the direct connection (every stream lands on a dead port):
+    every record falls back to KV PUTs, the stream still completes with
+    the same tokens, and serve_out carries the same truth either way —
+    the byte-identity contract of docs/control-plane.md."""
+    import horovod_tpu.serve.stream as stream_mod
+    server, port, addrs = sharded
+    server._httpd.serve_router = RouterState(journal=True)
+    fallbacks = []
+    real = stream_mod.DirectTokenStream
+
+    class _DeadStream(real):
+        def __init__(self, addr, p, timeout=10.0):
+            super().__init__(addr, 9, timeout=0.2)  # discard port: dead
+
+        def send(self, record):
+            ok = super().send(record)
+            if not ok:
+                fallbacks.append(record)
+            return ok
+
+    monkeypatch.setattr(stream_mod, "DirectTokenStream", _DeadStream)
+    fe = FleetFrontend(ScriptedEngine(), "127.0.0.1", port, 0, 1,
+                       direct=True)
+    out = [None]
+    t = threading.Thread(target=_drain_generate,
+                         args=(port, [7, 8, 9], 3, out, 0))
+    t.start()
+    deadline = time.time() + 30
+    while out[0] is None and time.time() < deadline:
+        fe.run(ttl_s=0.05)
+        time.sleep(0.01)
+    t.join(timeout=10)
+    assert out[0] is not None and out[0][-1]["done"] is True
+    assert out[0][-1]["tokens"] == scripted_tokens([7, 8, 9], 3)
+    assert fallbacks, "the KV path never carried a record"
+
+
+def test_direct_stream_mirror_matches_kv_put_bytes(sharded):
+    """The hub mirror writes the EXACT keys/values _kv_put would, so
+    journal prefix recovery cannot tell the paths apart."""
+    server, port, addrs = sharded
+    ds = DirectTokenStream("127.0.0.1", port)
+    assert ds.send({"rid": "req.000042", "part": 0, "tokens": [1, 2]})
+    ds.close()
+    direct_val = server.get(OUT_SCOPE, "req.000042.part.000000")
+    hc.put_kv("127.0.0.1", port, OUT_SCOPE, "req.000043.part.000000",
+              json.dumps({"tokens": [1, 2]}).encode())
+    kv_val = server.get(OUT_SCOPE, "req.000043.part.000000")
+    assert direct_val == kv_val
+
+
+# -------------------------------------------------------- adaptive polling
+def test_adaptive_poll_grows_and_resets():
+    p = AdaptivePoll(0.01)
+    waits = [p.idle() for _ in range(6)]
+    assert waits[0] == pytest.approx(0.01)
+    assert waits[1] > waits[0]  # backoff grows
+    assert max(waits) <= AdaptivePoll.HARD_CAP_S
+    p.observe_data(now=100.0)
+    assert p.idle() == pytest.approx(0.01)  # reset on data
+
+
+def test_adaptive_poll_ewma_caps_backoff():
+    """The observed inter-part gap bounds the backoff: with parts
+    arriving every ~30 ms the drain never sleeps far past the next
+    one, however long it idled before."""
+    p = AdaptivePoll(0.005)
+    t = 0.0
+    for _ in range(10):
+        p.observe_data(now=t)
+        t += 0.03
+    assert p.cap() == pytest.approx(0.03, rel=0.2)
+    for _ in range(20):
+        last = p.idle()
+    assert last <= p.cap() + 1e-9
+
+
+def test_poll_interval_knob_validated():
+    from horovod_tpu.serve.config import validate_serve_knobs
+    with pytest.raises(ValueError, match="POLL_INTERVAL"):
+        validate_serve_knobs({"HOROVOD_SERVE_PORT": 0,
+                              "HOROVOD_SERVE_MAX_BATCH_TOKENS": 64,
+                              "HOROVOD_SERVE_MAX_SEQ_LEN": 64,
+                              "HOROVOD_SERVE_CACHE_BLOCKS": 64,
+                              "HOROVOD_SERVE_POLL_INTERVAL": 0.0})
+
+
+def test_kv_shards_knob_validated():
+    """A bad shard count / mismatched address list fails hvd.init-level
+    validation, not a KV op mid-run (runtime.py)."""
+    from horovod_tpu.runner.kvshard import parse_shard_addrs
+    addrs = parse_shard_addrs("h:1,h:2")
+    assert len(addrs) == 2  # the runtime cross-checks len vs the count
+
+
+# ------------------------------------------------------------ launch glue
+def test_stamp_kv_shard_env(sharded):
+    from horovod_tpu.runner.launch import stamp_kv_shard_env
+    server, port, addrs = sharded
+    updates = {}
+    stamp_kv_shard_env(updates, "127.0.0.1", server, 3)
+    assert updates["HOROVOD_KV_SHARDS"] == "3"
+    assert parse_shard_addrs(updates["HOROVOD_KV_SHARD_ADDRS"]) == addrs
+    untouched = {}
+    stamp_kv_shard_env(untouched, "127.0.0.1", server, 1)
+    assert untouched == {}
+
+
+def test_resolve_kv_shards_flag_env_default(monkeypatch):
+    import argparse
+    from horovod_tpu.runner.launch import resolve_kv_shards
+    ns = argparse.Namespace(kv_shards=None)
+    monkeypatch.delenv("HOROVOD_KV_SHARDS", raising=False)
+    assert resolve_kv_shards(ns) == 1
+    monkeypatch.setenv("HOROVOD_KV_SHARDS", "3")
+    assert resolve_kv_shards(ns) == 3
+    ns.kv_shards = 2
+    assert resolve_kv_shards(ns) == 2  # flag wins
+    ns.kv_shards = 0
+    with pytest.raises(ValueError):
+        resolve_kv_shards(ns)
