@@ -450,6 +450,14 @@ def main() -> int:
                          "per-depth {step_time, exposed_comm_bytes "
                          "(analytical), overlapped_fraction} with the "
                          "pipelined ≡ sequential params guard asserted")
+    ap.add_argument("--zero", action="store_true",
+                    help="ZeRO weight-update sharding sweep "
+                         "(parallel/zero.py; docs/zero.md): run the "
+                         "chain at levels 0-3 on the quadratic toy and "
+                         "llama-tiny, emitting per-level {analytical "
+                         "peak params+grads+opt-state bytes, step_time, "
+                         "exposed_comm_bytes, ledger model drift} with "
+                         "level 1/2/3 bit-near equivalence asserted")
     ap.add_argument("--serve", action="store_true",
                     help="serving load-generator sweep (serve/engine.py; "
                          "docs/serving.md): drive the continuous-"
@@ -517,13 +525,13 @@ def main() -> int:
 
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
-    if (args.wire or args.overlap) and args.cpu and \
+    if (args.wire or args.overlap or args.zero) and args.cpu and \
             "xla_force_host_platform_device_count" \
             not in os.environ.get("XLA_FLAGS", ""):
-        # The wire/overlap sweeps are about collectives: virtualize an
-        # 8-device CPU mesh (the test harness's topology) so the rings
-        # actually ring.  Scoped here: the other cpu smokes keep their
-        # 1-device runs.
+        # The wire/overlap/zero sweeps are about collectives: virtualize
+        # an 8-device CPU mesh (the test harness's topology) so the
+        # rings actually ring.  Scoped here: the other cpu smokes keep
+        # their 1-device runs.
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                    " --xla_force_host_platform_device_"
                                    "count=8").strip()
@@ -548,6 +556,12 @@ def main() -> int:
                   "per depth would overwrite itself); ignoring",
                   file=sys.stderr)
         return overlap_bench(args)
+    if args.zero:
+        if args.profile:
+            print("--profile is not supported with --zero (one trace per "
+                  "level would overwrite itself); ignoring",
+                  file=sys.stderr)
+        return zero_bench(args)
     if args.serve:
         if args.profile:
             print("--profile is not supported with --serve (the tick "
@@ -1266,6 +1280,251 @@ def overlap_bench(args) -> int:
         "depths": results,
         "zero1": zero1,
         "equivalence_asserted": True,
+        "metrics": metrics_summary(),
+    }))
+    return 0
+
+
+def zero_bench(args) -> int:
+    """ZeRO weight-update sharding sweep (parallel/zero.py;
+    docs/zero.md): the chain runs at levels 1/2/3 (plus the level-0
+    plain-DP baseline) on the quadratic toy with
+    backward_passes_per_step=2, and at levels 1/2/3 on llama-tiny.  Per
+    level the artifact records the ANALYTICAL per-rank peak
+    {params, grads, opt-state, total} bytes
+    (perf/costmodel.zero_memory_bytes), the modeled exposed_comm_bytes,
+    the measured step_time and the ledger's model-drift ratio (the
+    prediction confronted with the wall clock).  Level 1/2/3 bit-near
+    parameter equivalence is asserted before anything is printed; on
+    the CPU-virtual harness wall-clock parity is expected (no
+    latency-hiding scheduler, loopback fabric) and the row is labeled
+    accordingly — the memory columns are the headline, the step-time
+    ratios the regression gate."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.parallel import zero as Z
+    from horovod_tpu.parallel.data_parallel import (make_train_step,
+                                                    replicate, shard_batch)
+    from horovod_tpu.perf import costmodel as cm
+    from horovod_tpu.utils import metrics as M
+
+    _init_with_retry(hvd, expect_tpu=not args.cpu)
+    mesh = hvd.mesh()
+    n = hvd.size()
+    k = 2
+    timed_steps = 5 if args.cpu else 20
+    dim = 64 if args.cpu else 1024
+    thresh = dim * 4  # several buckets on the toy
+    opt_slots = 2     # adamw: mu + nu
+
+    rng = np.random.RandomState(0)
+    params = {"w1": jnp.asarray(rng.randn(dim, dim) / np.sqrt(dim),
+                                jnp.float32),
+              "b1": jnp.asarray(np.zeros(dim), jnp.float32),
+              "w2": jnp.asarray(rng.randn(dim, 1) / np.sqrt(dim),
+                                jnp.float32)}
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+
+    def loss_fn(p, batch):
+        x, y = batch
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    xs = rng.randn(k, 8 * n, dim).astype(np.float32)
+    ys = rng.randn(k, 8 * n, 1).astype(np.float32)
+    kbatch = (shard_batch(jnp.asarray(xs), mesh, axis=1),
+              shard_batch(jnp.asarray(ys), mesh, axis=1))
+    # level 0 consumes the same samples as ONE merged batch (gradient of
+    # the merged mean == mean of per-microbatch gradients: same update)
+    mbatch = (shard_batch(jnp.asarray(xs.reshape(-1, dim)), mesh),
+              shard_batch(jnp.asarray(ys.reshape(-1, 1)), mesh))
+
+    def run_toy_level(level):
+        import horovod_tpu.perf as perf
+        opt = optax.adamw(1e-2, weight_decay=0.01)
+        if level == 0:
+            step = make_train_step(loss_fn, opt, mesh, donate=False)
+            p = replicate(params, mesh)
+            s = replicate(opt.init(params), mesh)
+            batch = mbatch
+        else:
+            step = Z.make_zero_train_step(
+                loss_fn, opt, mesh, zero_level=level,
+                backward_passes_per_step=k,
+                fusion_threshold_bytes=thresh, params_template=params,
+                donate=False)
+            s = Z.init_zero_state(opt, replicate(params, mesh), mesh,
+                                  zero_level=level,
+                                  fusion_threshold_bytes=thresh)
+            p = (Z.shard_zero3_params(replicate(params, mesh), mesh,
+                                      fusion_threshold_bytes=thresh)
+                 if level == 3 else replicate(params, mesh))
+            batch = kbatch
+        comm = cm.zero_comm_bytes(n_params, n, level, k=k)
+        perf.reset()
+        perf.configure(comm_bytes_per_step=comm["total_bytes"],
+                       zero_model={"n_params": n_params, "world": n,
+                                   "level": level, "k": k,
+                                   "opt_slots": opt_slots})
+        p, s, loss = step(p, s, batch)          # compile + warm
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(timed_steps):
+            with perf.timed_step():
+                p, s, loss = step(p, s, batch)
+                jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / timed_steps
+        rep = hvd.perf_report()
+        if level == 3:
+            p = Z.gather_zero3_params(p, params, mesh,
+                                      fusion_threshold_bytes=thresh)
+        return dt, p, float(loss), comm, rep
+
+    toy = {}
+    finals = {}
+    try:
+        for level in (0, 1, 2, 3):
+            dt, p, loss, comm, rep = run_toy_level(level)
+            finals[level] = p
+            mem = cm.zero_memory_bytes(level, n_params, n,
+                                       opt_slots=opt_slots)
+            row = {
+                "step_time_s": round(dt, 6),
+                "exposed_comm_bytes": int(comm["total_bytes"]),
+                "peak_bytes": mem,
+                "loss": round(loss, 6),
+                "model_drift_ratio": rep.get("model_drift_ratio"),
+            }
+            if level >= 1:
+                row["traced_exposed_comm_bytes"] = int(
+                    M.OVERLAP_EXPOSED_BYTES.value(plane=f"zero{level}"))
+            toy[str(level)] = row
+        # the equivalence guarantee: levels 1/2/3 bit-near, level 0
+        # within psum-linearity tolerance of the merged batch
+        for level in (2, 3):
+            for key in params:
+                err = float(np.abs(np.asarray(finals[level][key]) -
+                                   np.asarray(finals[1][key])).max())
+                if err > 1e-5:
+                    raise AssertionError(
+                        f"level {level} diverges from level 1 by {err}")
+        for key in params:
+            err = float(np.abs(np.asarray(finals[1][key]) -
+                               np.asarray(finals[0][key])).max())
+            if err > 1e-4:
+                raise AssertionError(
+                    f"level 1 diverges from the plain-DP baseline by "
+                    f"{err}")
+    except AssertionError as e:
+        return fail(str(e), cause="invalid-result")
+
+    # ---- llama-tiny leg: the model-shaped workload (levels 1-3, k=1)
+    from horovod_tpu.models import llama as llama_mod
+    cfg = llama_mod.CONFIGS["tiny"]
+    lbatch_rows, lseq, lsteps = 2 * n, 32, (2 if args.cpu else 10)
+    lthresh = 32 * 1024
+    lparams = llama_mod.init(jax.random.PRNGKey(0), cfg)
+    ln_params = sum(int(np.prod(l.shape))
+                    for l in jax.tree_util.tree_leaves(lparams))
+    ids = np.random.RandomState(1).randint(
+        0, cfg.vocab, (lbatch_rows, lseq + 1), dtype=np.int32)
+    lids = shard_batch(jnp.asarray(ids), mesh)
+
+    def run_llama_level(level):
+        opt = optax.adamw(3e-4, weight_decay=0.01)
+        step = Z.make_zero_train_step(
+            lambda p, b: llama_mod.loss_fn(p, b, cfg),
+            opt, mesh, zero_level=level, fusion_threshold_bytes=lthresh,
+            params_template=lparams, donate=False)
+        s = Z.init_zero_state(opt, replicate(lparams, mesh), mesh,
+                              zero_level=level,
+                              fusion_threshold_bytes=lthresh)
+        p = (Z.shard_zero3_params(replicate(lparams, mesh), mesh,
+                                  fusion_threshold_bytes=lthresh)
+             if level == 3 else replicate(lparams, mesh))
+        p, s, loss = step(p, s, lids)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(lsteps):
+            p, s, loss = step(p, s, lids)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / lsteps
+        if level == 3:
+            p = Z.gather_zero3_params(p, lparams, mesh,
+                                      fusion_threshold_bytes=lthresh)
+        return dt, p, float(loss)
+
+    llama_rows = {}
+    lfinals = {}
+    try:
+        for level in (1, 2, 3):
+            dt, p, loss = run_llama_level(level)
+            lfinals[level] = p
+            mem = cm.zero_memory_bytes(level, ln_params, n,
+                                       opt_slots=opt_slots)
+            llama_rows[str(level)] = {
+                "step_time_s": round(dt, 6),
+                "tokens_per_s": round(lbatch_rows * lseq / dt, 1),
+                "exposed_comm_bytes": int(cm.zero_comm_bytes(
+                    ln_params, n, level)["total_bytes"]),
+                "peak_bytes": mem,
+                "loss": round(loss, 6),
+            }
+        for level in (2, 3):
+            for a, b in zip(jax.tree_util.tree_leaves(lfinals[level]),
+                            jax.tree_util.tree_leaves(lfinals[1])):
+                err = float(np.abs(np.asarray(a) - np.asarray(b)).max())
+                if err > 1e-4:
+                    raise AssertionError(
+                        f"llama level {level} diverges from level 1 by "
+                        f"{err}")
+    except AssertionError as e:
+        return fail(str(e), cause="invalid-result")
+
+    # ---- gate rows: the analytical memory reductions (deterministic)
+    # and the same-run step-time ratios (correlated noise cancels)
+    def _sg(level, N):
+        m = cm.zero_memory_bytes(level, N, n, opt_slots=opt_slots)
+        return m["grads_bytes"] + m["opt_state_bytes"]
+
+    red2 = _sg(0, n_params) / _sg(2, n_params)
+    red3p = (cm.zero_memory_bytes(0, n_params, n)["params_bytes"]
+             / cm.zero_memory_bytes(3, n_params, n)["params_bytes"])
+    t1 = toy["1"]["step_time_s"]
+    chip = detect_chip()
+    label = (f"CPU-virtual ({n} XLA host devices, loopback; no chip, no "
+             "latency-hiding scheduler — memory columns are the "
+             "analytical model, wall-clock parity expected)"
+             if chip == "cpu" else chip)
+    sub_rows = [
+        {"metric": "zero level2 state+grad memory reduction",
+         "value": round(red2, 3), "unit": "x", "label": label},
+        {"metric": "zero level3 param memory reduction",
+         "value": round(red3p, 3), "unit": "x", "label": label},
+        {"metric": "zero level2 step overhead vs level1",
+         "value": round(toy["2"]["step_time_s"] / t1, 4),
+         "unit": "ratio", "label": label},
+        {"metric": "zero level3 step overhead vs level1",
+         "value": round(toy["3"]["step_time_s"] / t1, 4),
+         "unit": "ratio", "label": label},
+    ]
+    print(json.dumps({
+        "metric": f"zero sweep: level 2 cuts per-rank state+grad memory "
+                  f"{red2:.1f}x, level 3 cuts params {red3p:.1f}x "
+                  f"(n={n}, levels 1/2/3 bit-near asserted) [{label}]",
+        "value": round(red2, 3),
+        "unit": "x",
+        "label": label,
+        "world": n,
+        "k": k,
+        "toy": toy,
+        "llama": llama_rows,
+        "equivalence_asserted": True,
+        "sub_rows": sub_rows,
         "metrics": metrics_summary(),
     }))
     return 0

@@ -97,6 +97,26 @@ _knob("HOROVOD_PREFETCH_DEPTH", 2, int,
       "Device-prefetch depth of data.loader.prefetch(): how many batches "
       "are jax.device_put ahead of the step consuming them (2 = double "
       "buffered).  Must be >= 1; rejected at hvd.init() otherwise.")
+# --- ZeRO weight-update sharding (parallel/zero.py; docs/zero.md — the
+#     reference has no analog: its data-parallel path replicates
+#     everything) ---
+_knob("HOROVOD_ZERO_LEVEL", 1, int,
+      "Default ZeRO weight-update sharding level of the zero chain "
+      "(parallel/zero.py; kwarg zero_level wins): 1 shards optimizer "
+      "state 1/n along the fusion-bucket plan, 2 additionally keeps "
+      "gradient shards resident after the reduce_scatter (accumulation "
+      "on the 1/n shard), 3 additionally keeps parameters sharded "
+      "between steps with just-in-time per-bucket all_gathers.  0 = "
+      "off (plain data parallelism).  Must be in {0, 1, 2, 3}; "
+      "rejected at hvd.init() otherwise (docs/zero.md).")
+_knob("HOROVOD_ZERO_AG_PREFETCH", 2, int,
+      "ZeRO-3 parameter all-gather prefetch depth: how many bucket "
+      "gathers the level-3 step issues ahead of the bucket being "
+      "unpacked/consumed at step start (plan order, first-needed "
+      "first), so a latency-hiding scheduler overlays gathers with "
+      "consumption.  Must be in [1, 8]; rejected at hvd.init() "
+      "otherwise.  Refined to the tuned overlap-depth bandit arm when "
+      "HOROVOD_AUTOTUNE is on (docs/zero.md).")
 # --- serving plane (TPU-native; docs/serving.md — the reference has no
 #     inference path: its docs/inference.rst only covers exporting
 #     checkpoints OUT of the training framework) ---

@@ -156,6 +156,21 @@ def pack_bucket(leaves: Sequence[jax.Array], bucket: Bucket) -> jax.Array:
     return jnp.concatenate(parts)
 
 
+def pack_bucket_padded(leaves: Sequence[jax.Array], bucket: Bucket,
+                       multiple: int) -> jax.Array:
+    """:func:`pack_bucket` padded to a multiple of ``multiple`` — the
+    shard-geometry form the ZeRO weight-update chain reduces/scatters
+    (parallel/zero.py): a bucket split 1/n per chip needs a length
+    divisible by the axis size, and the pad is static so XLA sees
+    fixed-shape collectives."""
+    flat = pack_bucket(leaves, bucket)
+    total = flat.shape[0]
+    padded = -(-total // max(multiple, 1)) * max(multiple, 1)
+    if padded == total:
+        return flat
+    return jnp.pad(flat, (0, padded - total))
+
+
 def unpack_bucket(buffer: jax.Array, bucket: Bucket,
                   out: List[Optional[jax.Array]]) -> None:
     """Split a fused buffer back into its leaves, writing into ``out``."""

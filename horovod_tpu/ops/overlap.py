@@ -20,14 +20,19 @@ This module owns that restructuring at three levels:
     per-microbatch syncs run in the same order on the same values, so the
     result is bit-near the unpipelined issue order (tests/test_overlap.py
     asserts it per wire format, EF on and off).
-  * **Bucket-interleaved ZeRO-1** (:func:`priority_order`, consumed by
-    parallel/zero.py): the monolithic flat-vector RS -> shard-update ->
-    AG chain becomes a per-fusion-bucket pipeline, bucket *b*'s sharded
-    update overlapping bucket *b+1*'s in-flight reduce_scatter, with
-    issue order reversed (last buckets first — the Horovod convention of
-    negotiating tensors in reverse registration order, and
-    ByteScheduler's priority ordering, arXiv — PAPERS.md) so the
-    next step's first-needed parameters finish gathering earliest.
+  * **Bucket-interleaved ZeRO chain** (:func:`priority_order`, consumed
+    by parallel/zero.py for ``zero_level`` in {1, 2, 3} — docs/zero.md):
+    the monolithic flat-vector RS -> shard-update -> AG chain becomes a
+    per-fusion-bucket pipeline, bucket *b*'s sharded update overlapping
+    bucket *b+1*'s in-flight reduce_scatter, with issue order reversed
+    (last buckets first — the Horovod convention of negotiating tensors
+    in reverse registration order, and ByteScheduler's priority
+    ordering, arXiv — PAPERS.md) so the next step's first-needed
+    parameters finish gathering earliest.  ZeRO-3's just-in-time param
+    all_gathers apply the same discipline in the opposite direction:
+    plan order, ``HOROVOD_ZERO_AG_PREFETCH`` gathers in flight ahead of
+    the bucket being consumed, with the tuned overlap-depth bandit arm
+    covering that depth too (Runtime.zero_ag_prefetch).
   * **Observability + autotuning**: the ``hvd_overlap_*`` gauges record
     the analytical exposed-vs-overlapped byte split per trace
     (:func:`record_overlap`), and the pipeline depth joins the autotune

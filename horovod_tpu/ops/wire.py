@@ -185,18 +185,30 @@ def reduce_bucket(buf: jax.Array, fmt: str, axis_name: AxisName,
     return out
 
 
+def wire_roundtrip(buf: jax.Array, fmt: str) -> jax.Array:
+    """``C(buf)`` — the decoded value of putting ``buf`` on the wire in
+    ``fmt``, under the one-shot codec model (encode once, decode once).
+    This is what the ZeRO chain's reduce_scatter leg feeds the collective
+    (parallel/zero.py): each rank's contribution is encoded exactly once
+    before the scatter, so the compensable error is ``buf - C(buf)`` —
+    the same residual :func:`local_error` reports."""
+    if fmt in ("bf16", "fp16"):
+        comp = Compression.by_name(fmt)
+        c, ctx = comp.compress(buf)
+        return comp.decompress(c, ctx)
+    if fmt in ("int8_ring", "dcn_int8"):
+        from .quantized import int8_roundtrip
+        return int8_roundtrip(buf)
+    return buf
+
+
 def local_error(buf: jax.Array, fmt: str) -> jax.Array:
     """The rank-local compensable encode error ``x - C(x)`` of putting
     ``buf`` on the wire in ``fmt`` — the EF-SGD residual.  One-shot codec
     model: for the multi-hop rings this is the error of this rank's own
     contribution (the only part a rank *can* compensate)."""
-    if fmt in ("bf16", "fp16"):
-        comp = Compression.by_name(fmt)
-        c, ctx = comp.compress(buf)
-        return buf - comp.decompress(c, ctx)
-    if fmt in ("int8_ring", "dcn_int8"):
-        from .quantized import int8_roundtrip
-        return buf - int8_roundtrip(buf)
+    if is_lossy(fmt):
+        return buf - wire_roundtrip(buf, fmt)
     return jnp.zeros_like(buf)
 
 
@@ -264,11 +276,17 @@ def modeled_wire_bytes(nelems: int, itemsize: int, fmt: str,
 
 
 def plan_formats(plan, policy: Policy, axis_name: AxisName,
-                 op: ReduceOp) -> List[str]:
+                 op: ReduceOp,
+                 axis_sizes: Optional[Dict[str, int]] = None) -> List[str]:
     """Decide (and record) the wire format of every bucket in a fusion
     plan.  Runs at trace time, once per compiled program — the metric
-    families therefore count decisions per trace (see utils/metrics.py)."""
-    sizes = _axis_sizes(axis_name)
+    families therefore count decisions per trace (see utils/metrics.py).
+
+    ``axis_sizes`` overrides the bound-axis probe: callers that decide
+    formats OUTSIDE shard_map (the ZeRO chain's state init, which must
+    agree structurally with the traced step — parallel/zero.py) pass the
+    mesh sizes explicitly so both sides resolve identical formats."""
+    sizes = _axis_sizes(axis_name) if axis_sizes is None else axis_sizes
     total_ranks = 1
     for v in sizes.values():
         total_ranks *= v

@@ -10,7 +10,11 @@ state stay replicated.
 This is the explicit, Horovod-style mode — collectives are visible and
 controllable (fusion threshold, compression, Adasum, hierarchical two-level
 reduction).  The implicit GSPMD mode (sharding-annotation driven) lives in
-parallel/fsdp.py.
+parallel/fsdp.py.  When per-rank memory — not compute — caps model scale,
+the ZeRO chain (parallel/zero.py, docs/zero.md) is this module's
+memory-bound sibling: the same shard_map discipline with optimizer state
+(level 1), gradients (level 2) and parameters (level 3) sharded 1/n along
+the fusion-bucket plan, numerics unchanged.
 """
 
 from __future__ import annotations

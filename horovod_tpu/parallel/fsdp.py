@@ -1,5 +1,5 @@
-"""FSDP / GSPMD sharding: parameter sharding with compiler-inserted
-all_gather + reduce_scatter.
+"""FSDP / GSPMD sharding: the COMPILER-scheduled ZeRO-3 realization —
+parameter sharding with compiler-inserted all_gather + reduce_scatter.
 
 BASELINE config 3 is "Llama-3 8B FSDP-style shard with
 hvd.allgather/reduce_scatter" — in the reference a user would build that by
@@ -9,6 +9,19 @@ idiomatic design is sharding annotations: parameters carry a
 partitioner materializes exactly the allgather-on-use / reduce-scatter-
 on-gradient pattern (the ZeRO-3 schedule) on ICI.  See the scaling-book
 recipe: pick a mesh, annotate, let XLA insert collectives.
+
+Relationship to :mod:`.zero` (ONE ZeRO-3 story, two schedulers —
+docs/zero.md): ``parallel/zero.py`` is the
+EXPLICITLY-scheduled chain — shard_map collectives the chain places
+itself along the fusion-bucket plan, with ``zero_level`` in {1, 2, 3},
+per-bucket wire formats + EF residuals on the reduce_scatter leg, the
+reverse-priority/prefetch issue orders, trace markers and the
+cost-model-predicted/ledger-proven byte model.  This module hands the
+SAME memory shape (``perf/costmodel.zero_memory_bytes`` level 3 prices
+both) to GSPMD and lets the compiler own collective placement/fusion —
+highest throughput for big annotated models, least knob control.  Pick
+by control: explicit knobs/observability -> zero.py; compiler freedom +
+tensor-parallel composition (the rules below) -> here.
 
 Also provides Megatron-style tensor-parallel rules for the bundled models
 (column/row parallel attention + FFN).

@@ -22,7 +22,7 @@ compiled programs) import lazily inside their bodies.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 # ---------------------------------------------------------------- hardware
 # bf16 peak TFLOP/s per chip by TPU generation (public spec sheets).
@@ -180,6 +180,124 @@ def predicted_step_time(flops: float, comm_bytes: float, *,
         "chip": chip,
         "link": link,
     }
+
+
+# ------------------------------------------------------- ZeRO what-if model
+# Wire itemsize per RS-leg format (bytes/element on the wire) — the
+# stdlib restatement of ops/wire.py's table, so the zero chain's
+# trace-time gauges and this prediction cannot fork.
+WIRE_ITEMSIZE: Dict[str, float] = {
+    "none": 4.0, "bf16": 2.0, "fp16": 2.0,
+    "int8_ring": 1.0, "dcn_int8": 1.0,
+}
+ZERO_LEVELS = (0, 1, 2, 3)
+
+
+def _ring_half_leg(n: int, nelems: float, itemsize: float) -> float:
+    """One reduce_scatter OR all_gather leg of the standard ring, per
+    chip: (n-1) chunks of ceil(nelems/n) elements (half of
+    :func:`ring_wire_bytes`'s full allreduce)."""
+    if n <= 1:
+        return 0.0
+    return (n - 1) * math.ceil(nelems / n) * itemsize
+
+
+def zero_comm_bytes(nelems: float, world: int, level: int, *,
+                    k: int = 1, wire_format: str = "none",
+                    itemsize: float = 4.0) -> Dict[str, float]:
+    """Per-chip modeled wire bytes of ONE optimizer step of the ZeRO
+    chain (parallel/zero.py; docs/zero.md) — the RS and AG legs priced
+    separately, per level:
+
+      level 0  plain DP: accumulate k microbatches locally, ONE
+               allreduce (both ring phases at the wire itemsize, the
+               ops/wire.py allreduce model);
+      level 1  k per-microbatch syncs; at k > 1 each shard is gathered
+               back to keep the full gradient accumulator (the
+               redundancy level 2 deletes), plus the update all_gather;
+      level 2  k reduce_scatters onto the resident shard + one update
+               all_gather;
+      level 3  k reduce_scatters + one PARAM all_gather at step start —
+               the same bytes as level 2 (RS+AG == AR at k=1: the
+               ZeRO/arXiv:2004.13336 equal-wire-bytes claim).
+
+    The RS leg carries ``wire_format``'s itemsize; AG legs are exact
+    (``itemsize``) — gathered payloads are master state with no EF
+    channel (docs/zero.md#wire-composition).
+    """
+    if level not in ZERO_LEVELS:
+        raise ValueError(f"zero level {level} invalid; must be one of "
+                         f"{ZERO_LEVELS}")
+    n = int(world)
+    enc = WIRE_ITEMSIZE.get(wire_format, itemsize)
+    rs = _ring_half_leg(n, nelems, enc)
+    ag = _ring_half_leg(n, nelems, itemsize)
+    if level == 0:
+        rs_total, ag_total = rs, _ring_half_leg(n, nelems, enc)
+    elif level == 1:
+        rs_total = k * rs
+        ag_total = (k + 1) * ag if k > 1 else ag
+    else:
+        rs_total, ag_total = k * rs, ag
+    return {"rs_bytes": rs_total, "ag_bytes": ag_total,
+            "total_bytes": rs_total + ag_total}
+
+
+def zero_memory_bytes(level: int, n_params: float, world: int, *,
+                      opt_slots: int = 2, ef: bool = False,
+                      itemsize: float = 4.0) -> Dict[str, int]:
+    """Analytical PER-RANK resident bytes of the training state under a
+    ZeRO level (docs/zero.md#memory-math): params, the gradient
+    accumulator, optimizer state (``opt_slots`` params-shaped buffers —
+    2 for adam's moments) and the EF residual (full-size per rank when a
+    lossy wire format is error-compensated; inherent to EF-on-RS).
+    Level 0 = plain data parallelism, the reduction baseline."""
+    if level not in ZERO_LEVELS:
+        raise ValueError(f"zero level {level} invalid; must be one of "
+                         f"{ZERO_LEVELS}")
+    n = max(int(world), 1)
+    p = float(n_params) * itemsize
+    out = {
+        "params_bytes": p / n if level >= 3 else p,
+        "grads_bytes": p / n if level >= 2 else p,
+        "opt_state_bytes": (p * opt_slots / n if level >= 1
+                           else p * opt_slots),
+        "ef_residual_bytes": p if ef else 0.0,
+    }
+    out = {key: int(v) for key, v in out.items()}
+    out["total_bytes"] = sum(out.values())
+    return out
+
+
+def zero_level_table(n_params: float, world: int, *,
+                     opt_slots: int = 2, k: int = 1,
+                     wire_format: str = "none", ef: bool = False,
+                     chip: str = "cpu", link: str = "loopback",
+                     flops_per_step: Optional[float] = None
+                     ) -> List[Dict[str, Any]]:
+    """The "what would ZeRO-N cost me at my topology" table
+    (docs/zero.md): one row per level with the analytical per-rank
+    memory, the per-step wire bytes split RS/AG, the exposed-comm
+    seconds on ``link``, and — when ``flops_per_step`` is known — the
+    roofline predicted step.  Rendered by ``hvd.perf_report()`` /
+    ``GET /perf`` / ``hvdrun doctor --perf``; the ledger measures the
+    active level's drift against it."""
+    rows = []
+    for level in ZERO_LEVELS:
+        comm = zero_comm_bytes(n_params, world, level, k=k,
+                               wire_format=wire_format)
+        row: Dict[str, Any] = {
+            "level": level,
+            "memory": zero_memory_bytes(level, n_params, world,
+                                        opt_slots=opt_slots, ef=ef),
+            "comm": {key: int(v) for key, v in comm.items()},
+            "exposed_comm_s": comm["total_bytes"] / link_bandwidth(link),
+        }
+        if flops_per_step:
+            row["predicted"] = predicted_step_time(
+                flops_per_step, comm["total_bytes"], chip=chip, link=link)
+        rows.append(row)
+    return rows
 
 
 # ----------------------------------------------- plan-cache comm accounting

@@ -64,18 +64,34 @@ class PerfLedger:
             self._overlap_fraction = 0.0
             self._chip = "cpu"
             self._link = "loopback"
+            self._zero: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------ configure
     def configure(self, *, flops_per_step: Optional[float] = None,
                   comm_bytes_per_step: Optional[float] = None,
                   overlap_fraction: Optional[float] = None,
                   chip: Optional[str] = None,
-                  link: Optional[str] = None) -> None:
+                  link: Optional[str] = None,
+                  zero_model: Optional[Dict[str, Any]] = None) -> None:
         """Set the cost-model inputs the decomposition prices steps with.
         Unset components stay as they were; an unconfigured model
-        attributes everything beyond measured input wait to ``stall``."""
+        attributes everything beyond measured input wait to ``stall``.
+
+        ``zero_model`` describes the weight-update sharding workload —
+        ``{"n_params", "world"}`` required, plus optional ``level`` (the
+        active one), ``opt_slots``, ``k``, ``wire_format``, ``ef`` — and
+        makes :meth:`report` carry the per-ZeRO-level what-if table
+        (costmodel.zero_level_table; docs/zero.md)."""
         from .costmodel import LINK_CLASSES
+        if zero_model is not None:
+            for req in ("n_params", "world"):
+                if req not in zero_model:
+                    raise ValueError(
+                        f"zero_model needs {req!r} (docs/zero.md); got "
+                        f"{sorted(zero_model)}")
         with self._lock:
+            if zero_model is not None:
+                self._zero = dict(zero_model)
             if flops_per_step is not None:
                 self._flops = float(flops_per_step)
             if comm_bytes_per_step is not None:
@@ -199,7 +215,7 @@ class PerfLedger:
         predicted step from the configured model, deltas, and the local
         bottleneck verdict.  JSON-able; this exact payload is what the
         publisher PUTs to KV scope ``perf``."""
-        from .costmodel import predicted_step_time
+        from .costmodel import predicted_step_time, zero_level_table
         with self._lock:
             steps = self._steps
             sums = dict(self._sum)
@@ -209,6 +225,7 @@ class PerfLedger:
                                    self._link)
             drift = (self._drift_sum / self._drift_n
                      if self._drift_n else None)
+            zero = dict(self._zero) if self._zero else None
         mean = {k: (v / steps if steps else 0.0) for k, v in sums.items()}
         decomposition = {
             "compute_s": mean["compute"],
@@ -242,6 +259,23 @@ class PerfLedger:
             report["predicted_vs_measured"] = {
                 "step_delta_s": predicted["step_s"] - mean["step"],
                 "step_ratio": predicted["step_s"] / mean["step"],
+            }
+        if zero is not None:
+            # The "what would ZeRO-N cost me at my topology" table
+            # (docs/zero.md): per-level memory + wire bytes + predicted
+            # exposed comm on this rank's link class, beside the
+            # MEASURED decomposition above so the active level's
+            # prediction is confronted with the wall clock.
+            report["zero"] = {
+                "active_level": zero.get("level"),
+                "model": zero,
+                "levels": zero_level_table(
+                    zero["n_params"], zero["world"],
+                    opt_slots=int(zero.get("opt_slots", 2)),
+                    k=int(zero.get("k", 1)),
+                    wire_format=str(zero.get("wire_format", "none")),
+                    ef=bool(zero.get("ef", False)),
+                    chip=chip, link=link, flops_per_step=flops),
             }
         ops = native_op_stats()
         if ops:

@@ -144,6 +144,16 @@ def _fmt_ms(v: Any) -> str:
     return f"{v * 1e3:.2f}ms"
 
 
+def _fmt_bytes(v: Any) -> str:
+    if not isinstance(v, (int, float)):
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024.0 or unit == "GiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024.0
+    return f"{v:.1f}GiB"
+
+
 def load_perf_view(source: str) -> Dict[str, Any]:
     """Resolve a ``--perf`` argument to the merged /perf payload: an
     http URL or bare host:port fetches the live route; a directory reads
@@ -225,6 +235,31 @@ def render_perf(view: Dict[str, Any]) -> str:
         lines.append("")
         lines.append("Cost-model drift (modeled/measured; 1.0 = exact): "
                      + ", ".join(f"rank {r} {v:.2f}x" for r, v in drifts))
+    # ZeRO what-if table (docs/zero.md): one rank's view suffices — the
+    # table is an analytical function of (workload, topology), identical
+    # on every rank; render the first rank that carries it.
+    for r in sorted(ranks):
+        zero = ranks[r].get("zero")
+        if not zero:
+            continue
+        active = zero.get("active_level")
+        lines.append("")
+        lines.append(f"-- ZeRO memory-vs-comm what-if (active level: "
+                     f"{active if active is not None else '?'}; "
+                     "per-rank analytical, docs/zero.md) --")
+        lines.append("  level  params      grads       opt-state   "
+                     "wire-bytes/step  exposed-comm")
+        for row in zero.get("levels", []):
+            mem = row.get("memory", {})
+            mark = "*" if row.get("level") == active else " "
+            lines.append(
+                f"  {mark}{row.get('level')}     "
+                f"{_fmt_bytes(mem.get('params_bytes')):<11} "
+                f"{_fmt_bytes(mem.get('grads_bytes')):<11} "
+                f"{_fmt_bytes(mem.get('opt_state_bytes')):<11} "
+                f"{_fmt_bytes(row.get('comm', {}).get('total_bytes')):<16} "
+                f"{_fmt_ms(row.get('exposed_comm_s'))}")
+        break
     for r in sorted(ranks):
         ops = ranks[r].get("native_ops")
         if not ops:

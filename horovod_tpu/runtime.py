@@ -155,6 +155,11 @@ class Runtime:
         # the core numeric knobs.
         from .ops.overlap import validate_overlap_knobs
         validate_overlap_knobs(self.knobs)
+        # ZeRO weight-update sharding (parallel/zero.py; docs/zero.md):
+        # level and AG-prefetch depth fail AT INIT, not as a trace
+        # error inside the first compiled zero step.
+        from .parallel.zero import validate_zero_knobs
+        validate_zero_knobs(self.knobs)
         # Serving plane (serve/; docs/serving.md): same init-validation
         # contract for the HOROVOD_SERVE_* knob surface (port range,
         # positive budgets) — config-only import, no model/jax cost.
@@ -482,6 +487,33 @@ class Runtime:
             raise ValueError(
                 f"HOROVOD_OVERLAP_DEPTH={depth} invalid; must be in "
                 f"[1, {MAX_OVERLAP_DEPTH}] (docs/overlap.md)")
+        if self.autotuner is not None:
+            arm = self.autotuner.overlap_depth
+            if arm is not None:
+                return arm
+        return depth
+
+    def zero_level(self) -> int:
+        """Live default ZeRO weight-update sharding level (env-live via
+        ``current``; the zero chain's kwarg wins — parallel/zero.py,
+        docs/zero.md)."""
+        from .common.knobs import current
+        from .parallel.zero import resolve_zero_level
+        return resolve_zero_level(int(current("HOROVOD_ZERO_LEVEL")))
+
+    def zero_ag_prefetch(self) -> int:
+        """Live ZeRO-3 param all-gather prefetch depth: the knob,
+        refined to the bandit's tuned overlap-depth arm when tuning is
+        on — the SAME arm dimension the microbatch pipeline tunes, so
+        one broadcast covers both planes and all ranks compile
+        identical SPMD programs (docs/zero.md)."""
+        from .common.knobs import current
+        from .ops.overlap import MAX_OVERLAP_DEPTH
+        depth = int(current("HOROVOD_ZERO_AG_PREFETCH"))
+        if not 1 <= depth <= MAX_OVERLAP_DEPTH:
+            raise ValueError(
+                f"HOROVOD_ZERO_AG_PREFETCH={depth} invalid; must be in "
+                f"[1, {MAX_OVERLAP_DEPTH}] (docs/zero.md)")
         if self.autotuner is not None:
             arm = self.autotuner.overlap_depth
             if arm is not None:

@@ -342,7 +342,8 @@ WIRE_RESIDUAL_NORM = REGISTRY.gauge(
 # byte model, like the wire families above: 'exposed' bytes are sync
 # traffic issued with no concurrent compute to hide behind (the flush
 # tail of the microbatch pipeline; the pipeline ends of the interleaved
-# ZeRO-1 chain), by plane (microbatch/zero1).  docs/overlap.md.
+# ZeRO chain), by plane (microbatch/zero1/zero2/zero3).
+# docs/overlap.md, docs/zero.md.
 OVERLAP_EXPOSED_BYTES = REGISTRY.gauge(
     "hvd_overlap_exposed_bytes",
     "Modeled sync bytes left on the critical path (not overlapped with "
@@ -351,6 +352,25 @@ OVERLAP_FRACTION = REGISTRY.gauge(
     "hvd_overlap_overlapped_fraction",
     "Fraction of modeled sync bytes issued concurrently with compute "
     "per compiled step, by plane (1 - exposed/total; ops/overlap.py).")
+# ZeRO weight-update sharding (parallel/zero.py; docs/zero.md).  Set at
+# TRACE time like the overlap families: the level/prefetch of the last
+# compiled zero chain and the ANALYTICAL per-rank residency of each
+# state kind under it (the docs/zero.md memory model, priced by
+# perf/costmodel.zero_memory_bytes).
+ZERO_LEVEL = REGISTRY.gauge(
+    "hvd_zero_level",
+    "ZeRO weight-update sharding level of the last traced zero chain "
+    "(1 = optimizer state sharded 1/n, 2 = + resident gradient shards, "
+    "3 = + parameter shards; parallel/zero.py).")
+ZERO_SHARDED_BYTES = REGISTRY.gauge(
+    "hvd_zero_sharded_bytes",
+    "Modeled per-rank resident bytes under the active ZeRO level, by "
+    "kind (params/grads/opt_state/ef_residual) — the analytical memory "
+    "model of docs/zero.md, set at trace time.")
+ZERO_AG_PREFETCH = REGISTRY.gauge(
+    "hvd_zero_ag_prefetch_depth",
+    "ZeRO-3 parameter all-gather prefetch depth of the last traced "
+    "zero chain (0 below level 3; HOROVOD_ZERO_AG_PREFETCH).")
 
 # Serving plane (serve/engine.py; docs/serving.md).  SLO telemetry for
 # the continuous-batching engine: latency distributions per REQUEST

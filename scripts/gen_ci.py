@@ -101,6 +101,12 @@ KNOB_DIMS = [
      ["jax-core"]),
     ("overlap", {"HOROVOD_OVERLAP": "1", "HOROVOD_OVERLAP_DEPTH": "2"},
      ["jax-core"]),
+    # ZeRO default level flipped to 3 (docs/zero.md): tests that pin
+    # zero_level explicitly are unaffected; everything resolving the
+    # knob (the chain's defaults, the resolution tests) must stay green
+    # with params sharded and a deeper AG prefetch window.
+    ("zero-3", {"HOROVOD_ZERO_LEVEL": "3", "HOROVOD_ZERO_AG_PREFETCH": "4"},
+     ["jax-core"]),
     ("tf-join", {"HOROVOD_TF_JOIN": "1"},
      ["tensorflow-keras"]),
     # serve-redrive off = degraded mode: the router stops journaling,
@@ -263,6 +269,24 @@ def build_steps():
         # (docs/overlap.md) — all CPU-virtual.
         "bench: overlap sweep smoke",
         f"{py} bench.py --overlap --cpu", timeout=15))
+    steps.append(_step(
+        # ZeRO-level equivalence smoke: the bucket-interleaved chain at
+        # levels 1/2/3 (int8 wire + EF + microbatching) under the real
+        # launcher — every leg rides real cross-process collectives and
+        # params land bit-near across levels, bit-identical across
+        # chips (docs/zero.md) — all CPU-virtual.
+        "zero: 2-process zero2/zero3 equivalence smoke",
+        f"{py} -m pytest tests/integration/test_zero_integration.py "
+        f"{full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=15))
+    steps.append(_step(
+        # ZeRO sweep smoke: levels 0-3 on the quadratic toy +
+        # llama-tiny with level 1/2/3 equivalence asserted in-bench,
+        # the analytical memory columns and the ledger drift riding
+        # the artifact for the perf gate (docs/zero.md) — all
+        # CPU-virtual.
+        "bench: zero sweep smoke",
+        f"{py} bench.py --zero --cpu", timeout=15))
     steps.append(_step(
         # serving load-gen + raw-speed smoke: closed-loop and Poisson
         # load emit plausible SLO rows, AND the three speed legs

@@ -82,6 +82,14 @@ _SLOW_TESTS = {  # file::test (param ids stripped), >= ~8 s measured
         "test_bench_scaling_cpu_contract", "test_bench_wire_cpu_contract",
         "test_bench_overlap_cpu_contract", "test_bench_serve_cpu_contract",
         "test_bench_serve_users_cpu_contract",
+        "test_bench_zero_cpu_contract",
+    },
+    "test_zero.py": {
+        # the full level x wire x EF x k acceptance matrix (~18 combos x
+        # 3 jitted chains); the fast tier keeps a 3-combo slice
+        # (test_zero_levels_equivalent_core) and the CI jax-core leg
+        # (-m "") runs the whole matrix
+        "test_zero_levels_equivalent_matrix",
     },
     "test_models.py": {
         "test_inception_v3_forward_and_grads",

@@ -1,0 +1,15 @@
+"""ZeRO-level integration tier: the bucket-interleaved chain under the
+real launcher — 2 processes x 4 virtual chips, real cross-process XLA
+collectives — levels 1/2/3 with the int8 wire format + error feedback
+landing bit-near identical params across levels and bit-identical
+params across every chip (docs/zero.md)."""
+
+import pytest
+
+from test_multiprocess import run_hvdrun
+
+
+@pytest.mark.integration
+def test_zero_levels_agree_two_processes():
+    proc = run_hvdrun("zero_worker.py")
+    assert proc.stdout.count("ZERO-OK") >= 2, proc.stdout

@@ -1,0 +1,127 @@
+"""Per-rank worker for the ZeRO-level integration test.
+
+Launched by hvdrun with -np 2 on localhost (4 virtual CPU chips each,
+the 8-chip cross-process mesh): the bucket-interleaved ZeRO chain at
+levels 1, 2 and 3 — int8_ring wire format, error feedback on,
+backward_passes_per_step=2, so every leg (per-microbatch quantized
+reduce_scatter, shard accumulation, EF residuals, level-3 just-in-time
+param all_gathers) rides REAL cross-process XLA collectives here, not
+the single-process loopback of the unit tier — must land bit-near
+identical parameters across levels and bit-identical parameters across
+every chip of every process (docs/zero.md).
+"""
+
+import sys
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+THRESH = 64
+K = 2
+STEPS = 3
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.process_size() == 2, hvd.process_size()
+    n = hvd.size()
+    assert n == 8, n
+
+    import jax  # noqa: E402
+    import jax.numpy as jnp  # noqa: E402
+    import optax  # noqa: E402
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.parallel import zero as Z
+
+    mesh = hvd.mesh()
+
+    def replicate(tree, _mesh=None):
+        """Multi-process-safe replicate: materialize the (identical)
+        host constants INSIDE one jitted program instead of device_put
+        from host — host->replicated transfers run multihost
+        assert_equal collectives that interleave badly with the step's
+        gloo ops under this launcher."""
+        repl = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P()),
+            jax.eval_shape(lambda: tree))
+        return jax.jit(lambda: tree, out_shardings=repl)()
+    me = hvd.process_rank()
+    pos = [i for i, d in enumerate(mesh.devices.flatten())
+           if d.process_index == me]
+
+    rng = np.random.RandomState(0)
+    params = {"w1": jnp.asarray(rng.randn(7, 5), jnp.float32),
+              "b1": jnp.asarray(rng.randn(5), jnp.float32),
+              "w2": jnp.asarray(rng.randn(5, 1), jnp.float32)}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    per = 8  # rows per chip
+
+    def gput(arr):
+        """Full [K, 8n, f] host batch -> global array sharded on axis 1
+        (every process generates the identical batch; each contributes
+        its local chips' rows)."""
+        idx = np.concatenate([np.arange(p * per, (p + 1) * per)
+                              for p in pos])
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P(None, "hvd")), arr[:, idx])
+
+    opt = optax.adamw(1e-2, weight_decay=0.01)
+    finals = {}
+    for level in (1, 2, 3):
+        step = Z.make_zero_train_step(
+            loss_fn, opt, mesh, zero_level=level,
+            wire_policy="int8_ring", error_feedback=True,
+            backward_passes_per_step=K, fusion_threshold_bytes=THRESH,
+            params_template=params, donate=False)
+        s = Z.init_zero_state(opt, replicate(params, mesh), mesh,
+                              zero_level=level, wire_policy="int8_ring",
+                              error_feedback=True,
+                              fusion_threshold_bytes=THRESH)
+        p = (Z.shard_zero3_params(replicate(params, mesh), mesh,
+                                  fusion_threshold_bytes=THRESH)
+             if level == 3 else replicate(params, mesh))
+        data = np.random.RandomState(1)
+        for _ in range(STEPS):
+            xs = data.randn(K, per * n, 7).astype(np.float32)
+            ys = data.randn(K, per * n, 1).astype(np.float32)
+            p, s, loss = step(p, s, (gput(xs), gput(ys)))
+        assert np.isfinite(float(loss)), level
+        if level == 3:
+            p = Z.gather_zero3_params(p, params, mesh,
+                                      fusion_threshold_bytes=THRESH)
+        # replicated output: every local chip holds the identical params
+        host = {}
+        for key, arr in p.items():
+            shards = [np.asarray(sh.data) for sh in arr.addressable_shards]
+            for sh in shards[1:]:
+                np.testing.assert_array_equal(sh, shards[0])
+            host[key] = shards[0]
+        finals[level] = host
+
+    for level in (2, 3):
+        for key in params:
+            np.testing.assert_allclose(
+                finals[level][key], finals[1][key], rtol=1e-5, atol=1e-6,
+                err_msg=f"level {level} vs 1: {key}")
+
+    # the zero gauges moved on this process, at the last traced level
+    from horovod_tpu.utils import metrics as M
+    assert M.ZERO_LEVEL.value() == 3
+    assert M.ZERO_SHARDED_BYTES.value(kind="params") > 0
+    assert M.OVERLAP_EXPOSED_BYTES.value(plane="zero3") > 0
+
+    print(f"ZERO-OK process {me}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

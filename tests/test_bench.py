@@ -144,6 +144,59 @@ def test_bench_overlap_cpu_contract():
 
 
 @pytest.mark.slow
+def test_bench_zero_cpu_contract():
+    """--zero: the ZeRO sweep artifact (docs/zero.md): per-level
+    {analytical peak bytes, step_time, exposed_comm_bytes, ledger
+    drift}, the acceptance reductions (>= 2x state+grad at level 2,
+    >= n/2 x params at level 3), levels 1/2/3 equivalence asserted
+    in-bench, the gate-able sub_rows, and the CPU-virtual labeling."""
+    env = dict(os.environ)
+    env["BENCH_DEADLINE_S"] = "300"
+    rec = _run_bench("--zero", env=env, timeout=400)
+    assert rec["unit"] == "x"
+    assert "CPU-virtual" in rec["label"]
+    assert rec["equivalence_asserted"] is True
+    n = rec["world"]
+    assert n >= 2
+    toy = rec["toy"]
+    assert set(toy) == {"0", "1", "2", "3"}
+    for row in toy.values():
+        assert row["step_time_s"] > 0
+        assert row["exposed_comm_bytes"] >= 0
+        assert row["peak_bytes"]["total_bytes"] > 0
+    # the acceptance reductions, from the artifact's own analytical rows
+    def _sg(lv):
+        m = toy[lv]["peak_bytes"]
+        return m["grads_bytes"] + m["opt_state_bytes"]
+    assert _sg("0") >= 2 * _sg("2")
+    assert toy["0"]["peak_bytes"]["params_bytes"] >= \
+        (n / 2) * toy["3"]["peak_bytes"]["params_bytes"]
+    # memory monotonically non-increasing with level; level-2 wire bytes
+    # strictly below level-1's at k>1 (the ZeRO-2 claim)
+    totals = [toy[lv]["peak_bytes"]["total_bytes"]
+              for lv in ("0", "1", "2", "3")]
+    assert totals == sorted(totals, reverse=True)
+    assert rec["k"] > 1
+    assert toy["2"]["exposed_comm_bytes"] < toy["1"]["exposed_comm_bytes"]
+    # the ledger ran against the costmodel prediction: drift recorded
+    # and inside the (documented, CPU-virtual-loose) bound
+    for lv in ("1", "2", "3"):
+        drift = toy[lv]["model_drift_ratio"]
+        assert drift is not None and 0.0 < drift < 50.0, (lv, drift)
+    llama = rec["llama"]
+    assert set(llama) == {"1", "2", "3"}
+    for row in llama.values():
+        assert row["tokens_per_s"] > 0
+        assert row["peak_bytes"]["total_bytes"] > 0
+    subs = {r["metric"]: r for r in rec["sub_rows"]}
+    assert subs["zero level2 state+grad memory reduction"]["value"] >= 2
+    assert subs["zero level3 param memory reduction"]["value"] >= n / 2
+    for key in ("zero level2 step overhead vs level1",
+                "zero level3 step overhead vs level1"):
+        assert subs[key]["unit"] == "ratio" and subs[key]["value"] > 0
+
+
+@pytest.mark.slow
 def test_bench_serve_users_cpu_contract():
     """--serve --users: the control-plane saturation sweep
     (docs/control-plane.md) — per-user-count rows for the single-shard

@@ -134,6 +134,115 @@ def test_plan_comm_bytes_matches_wire_model():
     assert "dcn" in two["per_fabric"]
 
 
+# ------------------------------------------------------- ZeRO what-if model
+def test_zero_memory_bytes_goldens():
+    """The docs/zero.md memory math, exact: N=1000 fp32 params, n=4,
+    adam (2 slots)."""
+    lv = {l: cm.zero_memory_bytes(l, 1000, 4) for l in (0, 1, 2, 3)}
+    assert lv[0] == {"params_bytes": 4000, "grads_bytes": 4000,
+                     "opt_state_bytes": 8000, "ef_residual_bytes": 0,
+                     "total_bytes": 16000}
+    assert lv[1]["total_bytes"] == 4000 + 4000 + 2000
+    assert lv[2]["total_bytes"] == 4000 + 1000 + 2000
+    assert lv[3]["total_bytes"] == 1000 + 1000 + 2000
+    # the acceptance ratios: state+grads >= 2x down at level 2 vs the
+    # unsharded baseline on any n >= 2; params n-fold down at level 3
+    for n in (2, 4, 8):
+        l0 = cm.zero_memory_bytes(0, 1000, n)
+        l2 = cm.zero_memory_bytes(2, 1000, n)
+        l3 = cm.zero_memory_bytes(3, 1000, n)
+        sg0 = l0["grads_bytes"] + l0["opt_state_bytes"]
+        sg2 = l2["grads_bytes"] + l2["opt_state_bytes"]
+        assert sg0 >= 2 * sg2, (n, sg0, sg2)
+        assert l0["params_bytes"] >= (n / 2) * l3["params_bytes"]
+    # EF adds a full-size residual per rank (inherent to EF-on-RS)
+    assert cm.zero_memory_bytes(2, 1000, 4, ef=True)[
+        "ef_residual_bytes"] == 4000
+    with pytest.raises(ValueError, match="zero level"):
+        cm.zero_memory_bytes(5, 1000, 4)
+
+
+def test_zero_comm_bytes_wire_claims():
+    """RS+AG == AR at k=1 (the arXiv:2004.13336 equal-bytes claim),
+    level 2 strictly below level 1 at k>1, level 3 == level 2, and the
+    RS leg priced at the wire format's itemsize with exact AG legs."""
+    n, N = 8, 1 << 20
+    at_k1 = [cm.zero_comm_bytes(N, n, l)["total_bytes"]
+             for l in (0, 1, 2, 3)]
+    assert len(set(at_k1)) == 1  # all equal
+    k = 4
+    l1 = cm.zero_comm_bytes(N, n, 1, k=k)
+    l2 = cm.zero_comm_bytes(N, n, 2, k=k)
+    l3 = cm.zero_comm_bytes(N, n, 3, k=k)
+    assert l2["total_bytes"] < l1["total_bytes"]
+    assert l3 == l2
+    # per-microbatch RS at int8 is 1/4 the fp32 leg; AG stays exact
+    q = cm.zero_comm_bytes(N, n, 2, k=k, wire_format="int8_ring")
+    assert q["rs_bytes"] * 4 == l2["rs_bytes"]
+    assert q["ag_bytes"] == l2["ag_bytes"]
+    # single member axis moves nothing
+    assert cm.zero_comm_bytes(N, 1, 3)["total_bytes"] == 0.0
+
+
+def test_zero_level_table_rows():
+    rows = cm.zero_level_table(1000, 4, k=2, wire_format="bf16",
+                               flops_per_step=1e9, chip="cpu",
+                               link="ici")
+    assert [r["level"] for r in rows] == [0, 1, 2, 3]
+    for r in rows:
+        assert r["memory"]["total_bytes"] > 0
+        assert r["comm"]["total_bytes"] > 0
+        assert r["exposed_comm_s"] == pytest.approx(
+            r["comm"]["total_bytes"] / cm.link_bandwidth("ici"))
+        assert r["predicted"]["step_s"] > 0
+    # memory monotonically non-increasing with level
+    mems = [r["memory"]["total_bytes"] for r in rows]
+    assert mems == sorted(mems, reverse=True)
+
+
+def test_ledger_zero_section_and_drift_bound():
+    """configure(zero_model=...) makes the report carry the per-level
+    what-if table, and on a workload whose step time matches the model
+    the ledger's drift ratio sits inside the tested bound — the
+    "ledger confirms the prediction" closure (docs/zero.md)."""
+    led = PerfLedger()
+    comm = cm.zero_comm_bytes(1 << 16, 8, 2, k=2)["total_bytes"]
+    led.configure(flops_per_step=1e7, comm_bytes_per_step=comm,
+                  chip="cpu", link="loopback",
+                  zero_model={"n_params": 1 << 16, "world": 8,
+                              "level": 2, "k": 2, "opt_slots": 2})
+    assert led.report()["zero"]["levels"]  # table rides steps=0 reports
+    pred_t = (1e7 / cm.peak_flops("cpu")
+              + comm / cm.link_bandwidth("loopback"))
+    for dt in (pred_t * 1.02, pred_t * 0.98, pred_t):
+        led.record_step(dt)
+    rep = led.report()
+    assert rep["zero"]["active_level"] == 2
+    levels = rep["zero"]["levels"]
+    assert [r["level"] for r in levels] == [0, 1, 2, 3]
+    # the active level's table row IS the configured comm model
+    assert levels[2]["comm"]["total_bytes"] == int(comm)
+    # drift bound: modeled/measured within 5% when the workload matches
+    assert 0.95 <= rep["model_drift_ratio"] <= 1.05
+    with pytest.raises(ValueError, match="n_params"):
+        led.configure(zero_model={"world": 8})
+
+
+def test_doctor_renders_zero_table():
+    from horovod_tpu.runner.doctor import render_perf
+    led = PerfLedger()
+    led.configure(flops_per_step=1e7, comm_bytes_per_step=1e5,
+                  zero_model={"n_params": 1000, "world": 4, "level": 3})
+    led.record_step(0.01)
+    rep = led.report()
+    rep["rank"] = 0
+    view = merge_perf_reports({"rank.0": json.dumps(rep).encode()})
+    text = render_perf(view)
+    assert "ZeRO memory-vs-comm what-if" in text
+    assert "active level: 3" in text
+    assert text.count("\n  ") >= 4  # the four level rows render
+
+
 # ----------------------------------------------------------------- ledger
 def test_decomposition_sums_to_step_time_exactly():
     led = PerfLedger()

@@ -1,6 +1,15 @@
-"""ZeRO-1 weight-update sharding (parallel/zero.py): numerics must match
-the replicated-update data-parallel step exactly while the optimizer
-state lives at 1/n per chip (arXiv:2004.13336, PAPERS.md)."""
+"""ZeRO weight-update sharding (parallel/zero.py), levels 1-3.
+
+Level 1's numerics must match the replicated-update data-parallel step
+exactly while optimizer state lives at 1/n per chip (arXiv:2004.13336,
+PAPERS.md); levels 2 and 3 must be bit-near level 1 in params AND
+per-element optax state across wire format x error feedback x
+backward_passes_per_step (the uniform per-microbatch sync schedule,
+docs/zero.md), with gradient shards resp. parameter shards resident at
+1/n.  Plus: the level-3 shard/gather round trip (the elastic resharding
+story), the EF-residual-rides-the-bucket layout, the state-layout
+mismatch guard, knob validation at init, and the hvd_zero_* trace-time
+observability pinned against perf/costmodel's predictions."""
 
 import jax
 import jax.numpy as jnp
@@ -10,8 +19,15 @@ import pytest
 
 from horovod_tpu.parallel.data_parallel import (make_train_step, replicate,
                                                 shard_batch)
+from horovod_tpu.parallel import zero as Z
 from horovod_tpu.parallel.zero import (init_sharded_opt_state,
-                                       make_zero1_train_step)
+                                       init_zero_state,
+                                       make_zero1_train_step,
+                                       make_zero_train_step,
+                                       gather_zero3_params,
+                                       shard_zero3_params)
+
+THRESH = 64  # tiny fusion threshold -> several buckets on the toy
 
 
 def _model():
@@ -34,6 +50,62 @@ def _batches(k, n):
     return xs, ys
 
 
+def _run_chain(hvd, level, wire, ef, k, steps=2, ag_prefetch=None,
+               opt=None):
+    """Run `steps` optimizer steps of the bucketed chain at `level`;
+    returns (final replicated params as numpy, final state)."""
+    mesh = hvd.mesh()
+    n = hvd.size()
+    params, loss_fn = _model()
+    opt = opt or optax.adamw(1e-2, weight_decay=0.01)
+    step = make_zero_train_step(
+        loss_fn, opt, mesh, zero_level=level, wire_policy=wire,
+        error_feedback=ef, backward_passes_per_step=k,
+        fusion_threshold_bytes=THRESH, params_template=params,
+        ag_prefetch=ag_prefetch, donate=False)
+    s = init_zero_state(opt, replicate(params, mesh), mesh,
+                        zero_level=level, wire_policy=wire,
+                        error_feedback=ef, fusion_threshold_bytes=THRESH)
+    p = (shard_zero3_params(replicate(params, mesh), mesh,
+                            fusion_threshold_bytes=THRESH)
+         if level == 3 else replicate(params, mesh))
+    rng = np.random.RandomState(1)
+    for _ in range(steps):
+        xs = rng.randn(k, 8 * n, 7).astype(np.float32)
+        ys = rng.randn(k, 8 * n, 1).astype(np.float32)
+        batch = (shard_batch(jnp.asarray(xs if k > 1 else xs[0]), mesh,
+                             axis=1 if k > 1 else 0),
+                 shard_batch(jnp.asarray(ys if k > 1 else ys[0]), mesh,
+                             axis=1 if k > 1 else 0))
+        p, s, loss = step(p, s, batch)
+        assert np.isfinite(float(loss))
+    if level == 3:
+        p = gather_zero3_params(p, params, mesh,
+                                fusion_threshold_bytes=THRESH)
+    return (jax.tree_util.tree_map(np.asarray, p),
+            jax.tree_util.tree_map(np.asarray, s))
+
+
+def _assert_levels_agree(ref, got, tag):
+    """Params bit-near AND per-element state values bit-near: the state
+    layouts are identical arrays across levels (same per-bucket shard
+    geometry), so the comparison is direct.  Tolerances absorb only
+    compiler reassociation noise between differently-shaped programs
+    (1-2 ulp observed on the EF residual)."""
+    ref_p, ref_s = ref
+    got_p, got_s = got
+    for key in ref_p:
+        np.testing.assert_allclose(got_p[key], ref_p[key], rtol=1e-5,
+                                   atol=1e-6, err_msg=f"{tag} params {key}")
+    ref_leaves = jax.tree_util.tree_leaves(ref_s)
+    got_leaves = jax.tree_util.tree_leaves(got_s)
+    assert len(ref_leaves) == len(got_leaves)
+    for a, b in zip(ref_leaves, got_leaves):
+        np.testing.assert_allclose(b, a, rtol=1e-4, atol=2e-6,
+                                   err_msg=f"{tag} state")
+
+
+# ----------------------------------------------------------- level-1 legacy
 def test_zero1_matches_replicated_update(hvd):
     mesh = hvd.mesh()
     n = hvd.size()
@@ -100,3 +172,334 @@ def test_zero1_loss_decreases(hvd):
         p, s, loss = step(p, s, batch)
         losses.append(float(loss))
     assert losses[-1] < 0.3 * losses[0], losses
+
+
+# ----------------------------------------------------- level equivalence
+def test_zero_levels_equivalent_core(hvd):
+    """The fast-tier slice of the acceptance matrix: levels 2 and 3
+    agree with level 1 in params and per-element optax state — one
+    lossless, one cast + EF, one quantized config (the full wire x EF x
+    k product runs in test_zero_levels_equivalent_matrix)."""
+    for wire, ef, k in (("none", False, 2), ("bf16", True, 2),
+                        ("int8_ring", True, 1)):
+        ref = _run_chain(hvd, 1, wire, ef, k)
+        for level in (2, 3):
+            _assert_levels_agree(ref, _run_chain(hvd, level, wire, ef, k),
+                                 f"wire={wire} ef={ef} k={k} lvl{level}")
+
+
+def test_zero_levels_equivalent_matrix(hvd):
+    """The full acceptance matrix (slow tier): level 1/2/3 params AND
+    per-element optax state agree across wire format {none, bf16,
+    int8_ring} x EF {off, on} x backward_passes_per_step {1, 2, 4}."""
+    for wire in ("none", "bf16", "int8_ring"):
+        for ef in (False, True):
+            for k in (1, 2, 4):
+                ref = _run_chain(hvd, 1, wire, ef, k)
+                for level in (2, 3):
+                    _assert_levels_agree(
+                        ref, _run_chain(hvd, level, wire, ef, k),
+                        f"wire={wire} ef={ef} k={k} lvl{level}")
+
+
+def test_zero_interleaved_level1_matches_monolithic_anchor(hvd):
+    """The bucketed chain's anchor: level 1 interleaved (k=1, lossless)
+    lands the same params as the legacy monolithic flat-vector chain."""
+    mesh = hvd.mesh()
+    n = hvd.size()
+    params, loss_fn = _model()
+    opt = optax.adam(1e-2)
+    mono = make_zero1_train_step(loss_fn, opt, mesh, donate=False)
+    m_p = replicate(params, mesh)
+    m_s = init_sharded_opt_state(opt, m_p, mesh)
+    xs, ys = _batches(3, n)
+    for t in range(3):
+        batch = (shard_batch(jnp.asarray(xs[t]), mesh),
+                 shard_batch(jnp.asarray(ys[t]), mesh))
+        m_p, m_s, _ = mono(m_p, m_s, batch)
+    step = make_zero_train_step(loss_fn, opt, mesh, zero_level=1,
+                                wire_policy="none",
+                                fusion_threshold_bytes=THRESH,
+                                donate=False)
+    i_p = replicate(params, mesh)
+    i_s = init_zero_state(opt, i_p, mesh, zero_level=1,
+                          wire_policy="none",
+                          fusion_threshold_bytes=THRESH)
+    for t in range(3):
+        batch = (shard_batch(jnp.asarray(xs[t]), mesh),
+                 shard_batch(jnp.asarray(ys[t]), mesh))
+        i_p, i_s, _ = step(i_p, i_s, batch)
+    for key in params:
+        np.testing.assert_allclose(np.asarray(i_p[key]),
+                                   np.asarray(m_p[key]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_zero_ag_prefetch_is_scheduling_only(hvd):
+    """HOROVOD_ZERO_AG_PREFETCH moves the level-3 param gathers'
+    program position, never the values: depths 1 and 4 land identical
+    params."""
+    p1, _ = _run_chain(hvd, 3, "none", False, 2, ag_prefetch=1)
+    p4, _ = _run_chain(hvd, 3, "none", False, 2, ag_prefetch=4)
+    for key in p1:
+        np.testing.assert_allclose(p4[key], p1[key], rtol=1e-6,
+                                   atol=1e-7)
+
+
+# ----------------------------------------------------- level-3 param story
+def test_zero3_shard_gather_roundtrip_and_shapes(hvd):
+    mesh = hvd.mesh()
+    n = hvd.size()
+    params, _ = _model()
+    from horovod_tpu.parallel.zero import _bucket_plan
+    plan = _bucket_plan(params, THRESH)
+    shards = shard_zero3_params(replicate(params, mesh), mesh,
+                                fusion_threshold_bytes=THRESH)
+    assert len(shards) == plan.num_buckets
+    for bi, b in enumerate(plan.buckets):
+        padded = -(-sum(b.sizes) // n) * n
+        assert shards[bi].shape == (n, padded // n)
+        # each chip holds exactly its 1/n row
+        for sh in shards[bi].addressable_shards:
+            assert sh.data.shape == (1, padded // n)
+    back = gather_zero3_params(shards, params, mesh,
+                               fusion_threshold_bytes=THRESH)
+    for key in params:
+        np.testing.assert_array_equal(np.asarray(back[key]),
+                                      np.asarray(params[key]))
+
+
+def test_zero3_geometry_rederives_for_new_world_size(hvd):
+    """The elastic/chaos reset contract (docs/zero.md): shard geometry
+    is a pure function of (plan, world size) — gather at the old mesh,
+    re-shard at a DIFFERENT world size, values survive bit-exact."""
+    from jax.sharding import Mesh
+    mesh = hvd.mesh()
+    params, _ = _model()
+    small = Mesh(np.array(jax.devices()[:2]), ("hvd",))
+    big_shards = shard_zero3_params(replicate(params, mesh), mesh,
+                                    fusion_threshold_bytes=THRESH)
+    full = gather_zero3_params(big_shards, params, mesh,
+                               fusion_threshold_bytes=THRESH)
+    small_shards = shard_zero3_params(replicate(params, small), small,
+                                      fusion_threshold_bytes=THRESH)
+    # different world size -> different shard geometry, same values
+    assert big_shards[0].shape[0] == hvd.size()
+    assert small_shards[0].shape[0] == 2
+    back = gather_zero3_params(small_shards, params, small,
+                               fusion_threshold_bytes=THRESH)
+    for key in params:
+        np.testing.assert_array_equal(np.asarray(back[key]),
+                                      np.asarray(full[key]))
+
+
+def test_zero_ef_residual_sharded_with_buckets(hvd):
+    """EF residuals ride the per-bucket sharded state: one rank-local
+    [n, bucket] row block per bucket (docs/zero.md#wire-composition),
+    nonzero after lossy syncs."""
+    from horovod_tpu.parallel.zero import _ZeroEFBlock, _bucket_plan
+    mesh = hvd.mesh()
+    n = hvd.size()
+    params, _ = _model()
+    plan = _bucket_plan(params, THRESH)
+    opt = optax.sgd(0.05)
+    state = init_zero_state(opt, replicate(params, mesh), mesh,
+                            zero_level=2, wire_policy="int8_ring",
+                            error_feedback=True,
+                            fusion_threshold_bytes=THRESH)
+    assert len(state) == plan.num_buckets
+    for bi, b in enumerate(plan.buckets):
+        assert isinstance(state[bi], _ZeroEFBlock)
+        padded = -(-sum(b.sizes) // n) * n
+        assert state[bi].residual.shape == (n, padded)
+        for sh in state[bi].residual.addressable_shards:
+            assert sh.data.shape == (1, padded)
+    _, final = _run_chain(hvd, 2, "int8_ring", True, 2,
+                          opt=optax.sgd(0.05))
+    norms = [float(np.abs(final[bi].residual).sum())
+             for bi in range(plan.num_buckets)]
+    assert any(v > 0 for v in norms), norms
+    # EF off (or lossless wire): plain per-bucket optax blocks
+    plain = init_zero_state(opt, replicate(params, mesh), mesh,
+                            zero_level=2, wire_policy="none",
+                            error_feedback=True,
+                            fusion_threshold_bytes=THRESH)
+    assert not isinstance(plain[0], _ZeroEFBlock)
+
+
+# ------------------------------------------------- layout/validation guards
+def test_zero_mismatched_state_layout_raises(hvd):
+    """The satellite fix: state inited interleaved=True consumed by a
+    monolithic step builder must RAISE, not mis-slice — and the
+    converse."""
+    mesh = hvd.mesh()
+    n = hvd.size()
+    params, loss_fn = _model()
+    opt = optax.adam(1e-2)
+    xs, ys = _batches(1, n)
+    batch = (shard_batch(jnp.asarray(xs[0]), mesh),
+             shard_batch(jnp.asarray(ys[0]), mesh))
+    p = replicate(params, mesh)
+
+    mono_step = make_zero1_train_step(loss_fn, opt, mesh, donate=False)
+    inter_state = init_sharded_opt_state(opt, p, mesh, interleaved=True,
+                                         fusion_threshold_bytes=THRESH)
+    with pytest.raises(ValueError, match="interleaved"):
+        mono_step(p, inter_state, batch)
+
+    inter_step = make_zero_train_step(loss_fn, opt, mesh, zero_level=1,
+                                      fusion_threshold_bytes=THRESH,
+                                      donate=False)
+    mono_state = init_sharded_opt_state(opt, p, mesh)
+    with pytest.raises(ValueError, match="layout mismatch"):
+        inter_step(p, mono_state, batch)
+
+
+def test_zero_builder_argument_validation(hvd):
+    mesh = hvd.mesh()
+    params, loss_fn = _model()
+    opt = optax.sgd(0.1)
+    with pytest.raises(ValueError, match="zero_level=0"):
+        make_zero_train_step(loss_fn, opt, mesh, zero_level=0)
+    with pytest.raises(ValueError, match="plain data parallelism"):
+        init_zero_state(opt, params, mesh, zero_level=0)
+    with pytest.raises(ValueError, match="bucket-interleaved"):
+        make_zero_train_step(loss_fn, opt, mesh, zero_level=2,
+                             interleaved=False)
+    with pytest.raises(ValueError, match="bucket-interleaved|interleaved"):
+        init_sharded_opt_state(opt, params, mesh, zero_level=3,
+                               interleaved=False)
+    with pytest.raises(ValueError, match="params_template"):
+        make_zero_train_step(loss_fn, opt, mesh, zero_level=3)
+    with pytest.raises(ValueError, match="monolithic"):
+        make_zero_train_step(loss_fn, opt, mesh, zero_level=1,
+                             interleaved=False,
+                             backward_passes_per_step=2)
+    with pytest.raises(ValueError, match="wire"):
+        make_zero_train_step(loss_fn, opt, mesh, zero_level=1,
+                             interleaved=False, wire_policy="int8_ring")
+    with pytest.raises(ValueError, match="zero level"):
+        make_zero_train_step(loss_fn, opt, mesh, zero_level=7)
+
+
+@pytest.mark.parametrize("knob,bad", [
+    ("HOROVOD_ZERO_LEVEL", "5"),
+    ("HOROVOD_ZERO_LEVEL", "-1"),
+    ("HOROVOD_ZERO_AG_PREFETCH", "0"),
+    ("HOROVOD_ZERO_AG_PREFETCH", "99"),
+])
+def test_zero_knobs_fail_loudly_at_init(hvd, monkeypatch, knob, bad):
+    """The knob satellite: HOROVOD_ZERO_LEVEL / HOROVOD_ZERO_AG_PREFETCH
+    are validated at hvd.init with the knob named."""
+    import horovod_tpu as h
+    monkeypatch.setenv(knob, bad)
+    h.shutdown()
+    try:
+        with pytest.raises(ValueError, match=knob):
+            h.init()
+    finally:
+        monkeypatch.delenv(knob)
+        h.init()
+
+
+def test_zero_resolution_order(hvd, monkeypatch):
+    """kwarg > knob for the level; kwarg > tuned bandit arm > knob for
+    the AG prefetch depth (the overlap-depth arm covers it)."""
+    import os
+
+    import horovod_tpu.runtime as hrt
+    from horovod_tpu.parallel.zero import (resolve_ag_prefetch,
+                                           resolve_zero_level)
+
+    # knob-driven default (CI's zero-3 dimension flips the env)
+    base = int(os.environ.get("HOROVOD_ZERO_LEVEL", "") or 1)
+    assert resolve_zero_level() == base
+    assert resolve_zero_level(3) == 3         # kwarg wins
+    monkeypatch.setenv("HOROVOD_ZERO_LEVEL", "2")
+    assert resolve_zero_level() == 2          # env-live
+    assert resolve_zero_level(1) == 1
+
+    rt = hrt.get()
+    pre = int(os.environ.get("HOROVOD_ZERO_AG_PREFETCH", "") or 2)
+    assert rt.zero_ag_prefetch() == pre       # knob-driven
+    monkeypatch.setenv("HOROVOD_ZERO_AG_PREFETCH", "4")
+    assert resolve_ag_prefetch() == 4
+    assert resolve_ag_prefetch(1) == 1        # kwarg wins
+    monkeypatch.delenv("HOROVOD_ZERO_AG_PREFETCH")
+
+    class _Tuner:
+        overlap_depth = 3
+    monkeypatch.setattr(rt, "autotuner", _Tuner())
+    assert rt.zero_ag_prefetch() == 3         # bandit arm refines
+    assert resolve_ag_prefetch() == 3
+
+
+# ------------------------------------------------------- observability pins
+def test_zero_metrics_and_costmodel_pin(hvd):
+    """After a level-3 trace: the hvd_zero_* gauges carry level /
+    prefetch / per-kind sharded bytes, the overlap gauges carry the
+    plane=zero3 split, and the trace-time byte model EQUALS
+    perf/costmodel.zero_comm_bytes' prediction (the model-closure
+    contract of docs/zero.md)."""
+    import horovod_tpu as h
+    from horovod_tpu.ops.overlap import priority_order
+    from horovod_tpu.parallel.zero import _bucket_plan
+    from horovod_tpu.perf import costmodel as cm
+    from horovod_tpu.utils import metrics as M
+
+    n = hvd.size()
+    k = 2
+    _run_chain(hvd, 3, "none", False, k, steps=1)
+    assert M.ZERO_LEVEL.value() == 3
+    assert M.ZERO_AG_PREFETCH.value() == Z.resolve_ag_prefetch()
+    params, _ = _model()
+    plan = _bucket_plan(params, THRESH)
+    order = priority_order(plan)
+    padded = [-(-sum(b.sizes) // n) * n for b in plan.buckets]
+    per_bucket = [cm.zero_comm_bytes(L, n, 3, k=k)["total_bytes"]
+                  for L in padded]
+    expected_exposed = 0.5 * (per_bucket[order[0]] + per_bucket[order[-1]])
+    got_exposed = M.OVERLAP_EXPOSED_BYTES.value(plane="zero3")
+    assert got_exposed == pytest.approx(expected_exposed)
+    frac = M.OVERLAP_FRACTION.value(plane="zero3")
+    assert frac == pytest.approx(1.0 - expected_exposed / sum(per_bucket))
+
+    elems = sum(padded)
+    assert M.ZERO_SHARDED_BYTES.value(kind="grads") == elems * 4 // n
+    assert M.ZERO_SHARDED_BYTES.value(kind="ef_residual") == 0
+    pbytes = sum(int(np.prod(l.shape)) * 4
+                 for l in jax.tree_util.tree_leaves(params))
+    assert M.ZERO_SHARDED_BYTES.value(kind="params") == pbytes // n
+    assert M.ZERO_SHARDED_BYTES.value(kind="opt_state") > 0
+
+    fams = h.metrics_snapshot()["families"]
+    for fam in ("hvd_zero_level", "hvd_zero_sharded_bytes",
+                "hvd_zero_ag_prefetch_depth"):
+        assert fam in fams, fam
+
+    # level 2 k>1 moves strictly fewer bytes than level 1 (the
+    # ZeRO-2 wire claim); equal at k=1
+    l1 = cm.zero_comm_bytes(1000, n, 1, k=4)["total_bytes"]
+    l2 = cm.zero_comm_bytes(1000, n, 2, k=4)["total_bytes"]
+    assert l2 < l1
+    assert (cm.zero_comm_bytes(1000, n, 1)["total_bytes"]
+            == cm.zero_comm_bytes(1000, n, 2)["total_bytes"]
+            == cm.zero_comm_bytes(1000, n, 0)["total_bytes"])
+
+
+def test_zero_trace_markers_in_timeline(hvd, tmp_path):
+    """The merged-timeline satellite: a level-3 trace leaves
+    zero.bucket.{ag,rs,free} instants (docs/timeline.md)."""
+    import horovod_tpu as h
+    from horovod_tpu.utils.timeline import load_trace_events
+
+    path = str(tmp_path / "zero_trace.json")
+    h.start_timeline(path)
+    try:
+        _run_chain(hvd, 3, "none", False, 1, steps=1)
+    finally:
+        h.stop_timeline()
+    names = {e.get("name") for e in load_trace_events(path)}
+    for marker in ("zero.bucket.ag", "zero.bucket.rs",
+                   "zero.bucket.free"):
+        assert marker in names, (marker, sorted(names))
