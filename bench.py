@@ -195,6 +195,18 @@ def metrics_summary() -> dict:
             h, m = total(hit), total(miss)
             return round(h / (h + m), 4) if h + m else None
 
+        # Watch plane (docs/watch.md): every alert that FIRED during the
+        # run rides the artifact as (rule, severity, count), so a sweep
+        # row records its in-flight incidents beside its MFU — a number
+        # produced while `sentinel-nonfinite` fired reads differently.
+        fired_alerts = []
+        for s in fams.get("hvd_alerts_total", {}).get("samples", []):
+            labels = s.get("labels", {})
+            if s.get("value") and labels.get("rule"):
+                fired_alerts.append({
+                    "rule": labels["rule"],
+                    "severity": labels.get("severity", "warning"),
+                    "count": int(s["value"])})
         summary = {
             "schema": "hvd-metrics-summary-v1",
             "plan_cache_hit_rate": rate("hvd_fusion_plan_cache_hits_total",
@@ -206,6 +218,9 @@ def metrics_summary() -> dict:
             "collective_ops": int(total("hvd_collective_ops_total")),
             "collective_bytes": int(total("hvd_collective_bytes_total")),
             "stall_warnings": int(total("hvd_stall_warnings_total")),
+            "fired_alerts": sorted(fired_alerts,
+                                   key=lambda a: (a["rule"],
+                                                  a["severity"])),
         }
         # When the run traced (HOROVOD_TIMELINE / --timeline-merge), the
         # artifact points at the evidence (docs/timeline.md).

@@ -2,6 +2,7 @@
 // reference's extern "C" init/rank/enqueue API, operations.cc:710-915,
 // consumed by horovod/common/basics.py).
 
+#include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -282,6 +283,48 @@ int hvd_core_metrics(void* h, char* buf, int buflen) {
   };
   hist("cycle_time_us", s.cycle_time_us);
   hist("negotiation_age_us", s.negotiation_age_us);
+  int n = static_cast<int>(t.size());
+  if (buf && buflen > 0) {
+    int copy = n < buflen - 1 ? n : buflen - 1;
+    memcpy(buf, t.data(), copy);
+    buf[copy] = '\0';
+  }
+  return n;
+}
+
+// ------------------------------------------------------------ window rates
+// Watch plane (docs/watch.md): trailing-window rates differentiated
+// natively against the cycle loop's epoch-stamped snapshot ring
+// (csrc/window.h) — a versioned text block in the hvd_core_metrics mold:
+//   hvd_metrics_window_v1
+//   span_us <n>             (history covered; 0 = no samples yet)
+//   cycle_rate <v>          (controller cycles per second)
+//   bytes_reduced_rate <v>  (reduced payload bytes per second)
+//   reconnect_rate <v>      (transport reconnects per minute)
+//   bypass_fraction <v>     (bypass replay rounds / all rounds, 0..1)
+// New lines APPEND; parsers key on names — the versioning contract.
+// Truncation semantics match hvd_core_metrics (full length returned,
+// at most buflen-1 bytes written, always NUL-terminated).
+int hvd_core_metrics_window(void* h, double window_s, char* buf,
+                            int buflen) {
+  Core::WindowRates r =
+      static_cast<ApiHandle*>(h)->core->metrics_window(window_s);
+  std::string t = "hvd_metrics_window_v1\n";
+  t += "span_us ";
+  t += std::to_string(r.span_us);
+  t += '\n';
+  char num[64];
+  auto kv = [&t, &num](const char* k, double v) {
+    snprintf(num, sizeof(num), "%.9g", v);
+    t += k;
+    t += ' ';
+    t += num;
+    t += '\n';
+  };
+  kv("cycle_rate", r.cycle_rate);
+  kv("bytes_reduced_rate", r.bytes_rate);
+  kv("reconnect_rate", r.reconnect_rate);
+  kv("bypass_fraction", r.bypass_fraction);
   int n = static_cast<int>(t.size());
   if (buf && buflen > 0) {
     int copy = n < buflen - 1 ? n : buflen - 1;

@@ -110,6 +110,49 @@ void Core::Shutdown() {
 
 ControllerStats Core::stats() const { return controller_->stats(); }
 
+void Core::StampWindow() {
+  uint64_t now = trace_.NowUs();
+  if (!window_.DuePush(now)) return;
+  WindowSample s;
+  s.ts_us = now;
+  ControllerStats cs = controller_->stats();
+  TransportStats ts = transport_->transport_stats();
+  s.cycles = cs.cycles;
+  s.bypass_cycles = cs.bypass_cycles;
+  s.responses = cs.responses;
+  s.bytes_reduced = cs.bytes_reduced;
+  s.transport_reconnects = ts.reconnects;
+  window_.Push(s);
+}
+
+Core::WindowRates Core::metrics_window(double window_s) const {
+  WindowRates out;
+  uint64_t now = trace_.NowUs();
+  uint64_t window_us = window_s > 0
+      ? static_cast<uint64_t>(window_s * 1e6) : 60000000ull;
+  WindowSample ref;
+  if (!window_.Reference(now, window_us, &ref) || now <= ref.ts_us)
+    return out;  // no history yet: every rate honestly zero
+  ControllerStats cs = controller_->stats();
+  TransportStats ts = transport_->transport_stats();
+  out.span_us = now - ref.ts_us;
+  double span_s = out.span_us / 1e6;
+  auto delta = [](uint64_t a, uint64_t b) {
+    return a > b ? static_cast<double>(a - b) : 0.0;
+  };
+  double d_cycles = delta(cs.cycles, ref.cycles);
+  double d_bypass = delta(cs.bypass_cycles, ref.bypass_cycles);
+  out.cycle_rate = d_cycles / span_s;
+  out.bytes_rate = delta(cs.bytes_reduced, ref.bytes_reduced) / span_s;
+  out.reconnect_rate =
+      delta(ts.reconnects, ref.transport_reconnects) / span_s * 60.0;
+  // Steady-state fraction: replay rounds served from the locked plan
+  // over all rounds (bypass + full cycles) of the window.
+  if (d_cycles + d_bypass > 0)
+    out.bypass_fraction = d_bypass / (d_cycles + d_bypass);
+  return out;
+}
+
 Core::HealthSnapshot Core::health_snapshot() const {
   HealthSnapshot h;
   h.now_us = trace_.NowUs();
@@ -189,6 +232,11 @@ void Core::Loop() {
   using clock = std::chrono::steady_clock;
   while (!stopped_.load()) {
     auto start = clock::now();
+    // Watch plane: stamp the window ring every due period, idle and
+    // locked-epoch iterations included (the `continue` below skips the
+    // cycle tail, so the stamp lives at the top) — a quiet core's rates
+    // decay to zero instead of freezing at the last busy value.
+    StampWindow();
     std::vector<Request> batch;
     {
       std::lock_guard<std::mutex> lk(mu_);

@@ -32,6 +32,7 @@
 #include "optim.h"
 #include "trace.h"
 #include "transport.h"
+#include "window.h"
 
 namespace hvdtpu {
 
@@ -126,6 +127,19 @@ class Core {
   void EnableTrace() { trace_.Enable(); }
   TraceRing* trace() { return &trace_; }
 
+  // Watch plane (window.h): per-second rates over the trailing window,
+  // differentiated natively against the cycle loop's epoch-stamped
+  // snapshot ring.  Exported through the versioned
+  // hvd_core_metrics_window C API (csrc/c_api.cc; docs/watch.md).
+  struct WindowRates {
+    uint64_t span_us = 0;      // history actually covered (<= asked)
+    double cycle_rate = 0.0;   // controller cycles per second
+    double bytes_rate = 0.0;   // reduced payload bytes per second
+    double reconnect_rate = 0.0;   // transport reconnects per MINUTE
+    double bypass_fraction = 0.0;  // bypass rounds / all rounds, [0, 1]
+  };
+  WindowRates metrics_window(double window_s) const;
+
   // Turn on rank-0 autotuning of (fusion threshold, cycle time) scored by
   // negotiated bytes/sec (reference: ParameterManager + HOROVOD_AUTOTUNE,
   // parameter_manager.{h,cc}).  Rank 0 fuses and paces the lock-step
@@ -142,10 +156,15 @@ class Core {
   void PublishResponsesLocked(std::vector<Response>* out,
                               bool* got_shutdown, int64_t* cycle_bytes);
 
+  // Stamp one window sample when due (cycle loop, every iteration —
+  // DuePush gates the cost to one spinlock round trip per tick).
+  void StampWindow();
+
   std::unique_ptr<Transport> transport_;
   std::unique_ptr<Controller> controller_;
   CoreOptions opts_;
   TraceRing trace_;
+  mutable MetricsWindowRing window_;
 
   mutable std::mutex mu_;
   std::condition_variable cv_;

@@ -220,6 +220,11 @@ from . import serve  # noqa: E402
 # step-time decomposition ledger; training loops record steps via
 # hvd.perf.timed_step() and read hvd.perf_report()
 from . import perf  # noqa: E402
+# watch plane (docs/watch.md) — fleet time-series history, declarative
+# alert rules (hvdrun --alerts), and training-quality sentinels:
+# hvd.sentinel.wrap(step_fn) watches grad-norm/nonfinite/loss-EMA
+from . import watch  # noqa: E402
+from .watch import sentinel  # noqa: E402
 
 
 __all__ = [
@@ -245,5 +250,5 @@ __all__ = [
     "CheckpointManager", "save_checkpoint", "restore_checkpoint",
     "flash_attention", "run",
     "__version__", "probe_backend", "metrics_snapshot", "chaos",
-    "postmortem", "serve", "perf", "perf_report",
+    "postmortem", "serve", "perf", "perf_report", "watch", "sentinel",
 ]

@@ -157,6 +157,13 @@ def load_library() -> ctypes.CDLL:
         lib.hvd_core_shutdown.argtypes = [ctypes.c_void_p]
         lib.hvd_core_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong)]
+        try:
+            lib.hvd_core_metrics_window.argtypes = [
+                ctypes.c_void_p, ctypes.c_double, ctypes.c_char_p,
+                ctypes.c_int]
+        except AttributeError:
+            pass  # pre-watch-plane library (HOROVOD_NATIVE_LIB override):
+            # metrics_window() raises, windowed rates degrade to absent
         lib.hvd_core_metrics.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                          ctypes.c_int]
         lib.hvd_core_op_stats.argtypes = [ctypes.c_void_p,
@@ -624,6 +631,42 @@ class CoordinationCore:
                     "buckets": [int(p) for p in parts[4:]]}
             elif len(parts) == 2:
                 out["counters"][parts[0]] = int(parts[1])
+        return out
+
+    def metrics_window(self, window_s: float = 60.0) -> dict:
+        """Windowed native rates (csrc/c_api.cc
+        ``hvd_core_metrics_window``; docs/watch.md): ``{"version",
+        "span_us", "cycle_rate", "bytes_reduced_rate",
+        "reconnect_rate" (per minute), "bypass_fraction"}``,
+        differentiated inside the core against its epoch-stamped
+        snapshot ring — so the rates carry no scraper-cadence noise.
+        ``span_us`` 0 means no history yet (every rate honestly 0).
+        Unknown lines from a newer library are ignored — the
+        hvd_core_metrics versioning contract."""
+        buf = self._buf_for()
+        n = self._lib.hvd_core_metrics_window(self._h, float(window_s),
+                                              buf, len(buf))
+        if n >= len(buf):
+            self._grow(n)
+            buf = self._buf_for()
+            n = self._lib.hvd_core_metrics_window(self._h,
+                                                  float(window_s), buf,
+                                                  len(buf))
+        lines = buf.value.decode().splitlines()
+        if not lines or not lines[0].startswith("hvd_metrics_window_v"):
+            raise RuntimeError(f"unrecognized native window header: "
+                               f"{lines[:1]!r}")
+        out = {"version": int(lines[0].split("hvd_metrics_window_v",
+                                             1)[1])}
+        for line in lines[1:]:
+            parts = line.split()
+            if len(parts) == 2:
+                try:
+                    out[parts[0]] = (int(parts[1])
+                                     if parts[0] == "span_us"
+                                     else float(parts[1]))
+                except ValueError:
+                    continue
         return out
 
     def op_stats(self) -> dict:

@@ -271,6 +271,45 @@ _knob("HOROVOD_PERF_LINK", "auto", str,
       "'ici', 'dcn', 'loopback', or 'auto' (by mesh topology: a dcn.* "
       "axis -> dcn, a real TPU mesh -> ici, CPU-virtual -> loopback).  "
       "Unknown names fail at hvd.init().")
+# --- watch plane (TPU-native; docs/watch.md — the reference's analog is
+#     reading the timeline by hand AFTER a run went bad) ---
+_knob("HOROVOD_SERIES_RETENTION", 600.0, float,
+      "Fleet time-series history horizon in seconds (watch plane, "
+      "horovod_tpu/watch/series.py): the rendezvous server keeps one "
+      "bounded downsampling ring per (rank, metric family), fed by the "
+      "metric snapshots workers already publish, served at GET /series "
+      "and evaluated by the alert rules engine.  Ring memory is "
+      "retention/resolution points per series, enforced.  Must be "
+      "positive; rejected at hvd.init() otherwise.")
+_knob("HOROVOD_SERIES_RESOLUTION", 5.0, float,
+      "Fleet time-series bucket width in seconds (watch plane): samples "
+      "landing inside one resolution bucket replace the bucket's point "
+      "(last wins), and metrics-scope ingest is rate-limited per rank "
+      "to this cadence.  Must be positive and no larger than "
+      "HOROVOD_SERIES_RETENTION; rejected at hvd.init() otherwise.")
+_knob("HOROVOD_ALERTS", "", str,
+      "Path of a YAML alert-rules file (watch plane, "
+      "horovod_tpu/watch/rules.py): rules merge over the committed "
+      "default ruleset by name, are published to the rendezvous KV "
+      "scope 'alerts' and evaluated by the driver's engine — firing "
+      "alerts surface at GET /alerts, as merged-timeline instants and "
+      "as the hvd_alerts_* families.  Equivalent to hvdrun --alerts.  "
+      "When set, the file must exist and parse; rejected at hvd.init() "
+      "otherwise.  Empty = defaults only.")
+_knob("HOROVOD_SENTINEL", True, _parse_bool,
+      "Training-quality sentinel kill switch (watch plane, "
+      "horovod_tpu/watch/sentinel.py): with it on, hvd.sentinel.wrap "
+      "computes trace-time global grad-norm, nonfinite count (psum of "
+      "isfinite — SPMD-identical on every rank) and loss EMA/divergence "
+      "scalars that ride the existing metrics publisher; a nonfinite "
+      "step triggers an explicit native flight dump (reason 'nan') and "
+      "the committed sentinel-nonfinite critical rule.  0 = "
+      "hvd.sentinel.wrap returns the step untouched.")
+_knob("HOROVOD_SENTINEL_INTERVAL", 1, int,
+      "Sentinel gauge/EMA update cadence in recorded steps (1 = every "
+      "step).  Nonfinite detection always runs every recorded step — a "
+      "NaN must never slip between samples.  Must be >= 1; rejected at "
+      "hvd.init() otherwise.")
 # --- postmortem plane (TPU-native; docs/postmortem.md — no reference
 #     equivalent: the reference leaves a dead run as a bare exit status) ---
 _knob("HOROVOD_HEARTBEAT", False, _parse_bool,

@@ -25,6 +25,13 @@ top native ops behind it.
 shed/drain state, journal depth and the engine's self-published stats —
 what an on-call reader checks when the fleet restarted mid-stream.
 
+``--watch`` is the watch plane's live follow mode (docs/watch.md): it
+re-renders ``GET /alerts`` + ``GET /series`` every ``--interval``
+seconds — firing alerts first (severity-ordered, with rule context like
+the nonfinite step number), then unicode sparklines of the hot series
+(the families firing rules watch plus the standing fleet vitals).
+``--once`` renders a single frame, which is what CI smokes pin.
+
 Usage:
   hvdrun doctor /path/to/postmortem_dir
   hvdrun doctor /path/to/postmortem.json --events 40
@@ -32,6 +39,8 @@ Usage:
   hvdrun doctor --perf http://127.0.0.1:8080/perf
   hvdrun doctor --perf saved_perf.json
   hvdrun doctor --serve http://127.0.0.1:9000/serve/stats
+  hvdrun doctor --watch http://127.0.0.1:9090 --interval 2
+  hvdrun doctor --watch saved_alerts.json --once
 """
 
 from __future__ import annotations
@@ -274,6 +283,127 @@ def render_perf(view: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# ----------------------------------------------------------- watch plane
+# Sparkline glyphs, lowest to highest — the one-line shape of a series.
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _spark(points: List[Any], width: int = 24) -> str:
+    """Unicode sparkline of a [[t, v], ...] series (newest-right,
+    resampled to ``width`` columns by taking the last value per
+    column)."""
+    vals = [float(v) for _, v in points
+            if isinstance(v, (int, float))]
+    vals = [v for v in vals if v == v and abs(v) != float("inf")]
+    if not vals:
+        return ""
+    vals = vals[-width:]
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[0] * len(vals)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / (hi - lo) * (len(_SPARK) - 1)))]
+        for v in vals)
+
+
+def _fmt_v(v: Any) -> str:
+    if not isinstance(v, (int, float)):
+        return "?"
+    if v != v:
+        return "nan"
+    if v == int(v) and abs(v) < 1e9:
+        return str(int(v))
+    return f"{v:.4g}"
+
+
+def load_watch_view(source: str) -> Dict[str, Any]:
+    """Resolve a ``--watch`` argument: an http URL or bare host:port
+    fetches the live ``GET /alerts`` + ``GET /series`` routes; anything
+    else is a saved JSON file holding ``{"alerts": ..., "series": ...}``
+    (or a bare /alerts payload)."""
+    import json as _json
+    import os
+    import urllib.request
+    if source.startswith(("http://", "https://")) or (
+            ":" in source and not os.path.exists(source)
+            and "/" not in source):
+        base = source if source.startswith("http") else f"http://{source}"
+        base = base.rstrip("/")
+        for suffix in ("/alerts", "/series"):
+            if base.endswith(suffix):
+                base = base[:-len(suffix)]
+        with urllib.request.urlopen(base + "/alerts", timeout=10) as r:
+            alerts = _json.loads(r.read())
+        with urllib.request.urlopen(base + "/series", timeout=10) as r:
+            series = _json.loads(r.read())
+        return {"alerts": alerts, "series": series}
+    with open(source) as f:
+        view = _json.load(f)
+    if "alerts" not in view:
+        view = {"alerts": view, "series": view.get("series_view")}
+    return view
+
+
+def render_watch(view: Dict[str, Any], spark_window: float = 120.0
+                 ) -> str:
+    """Alerts-first rendering of one watch view (docs/watch.md): the
+    firing list severity-ordered, the ruleset summary, then sparklines
+    of the hot families — the ones firing rules watch, plus the
+    standing fleet vitals."""
+    alerts = view.get("alerts") or {}
+    series = view.get("series") or {}
+    firing = alerts.get("firing") or []
+    rules = alerts.get("rules") or []
+    lines: List[str] = []
+    lines.append("== hvdrun doctor --watch: fleet alerts + series ==")
+    if firing:
+        lines.append(f"FIRING ({len(firing)}):")
+        for f in firing:
+            since = f.get("since")
+            ctx = f.get("context") or {}
+            ctx_s = "".join(f" [{k}={_fmt_v(v)}]"
+                            for k, v in sorted(ctx.items()))
+            lines.append(
+                f"  [{f.get('severity', '?'):>8}] {f.get('rule', '?')} "
+                f"rank {f.get('rank', '?')} — {f.get('family', '?')} "
+                f"{f.get('kind', '?')} value={_fmt_v(f.get('value'))} "
+                f"since {_fmt_clock(since)}{ctx_s}")
+    else:
+        lines.append("FIRING (0): fleet quiet")
+    fired = alerts.get("fired_total") or []
+    lifetime = sum(f.get("count", 0) for f in fired)
+    user = alerts.get("user_rules") or []
+    lines.append(
+        f"rules: {len(rules)} active ({len(rules) - len(user)} default"
+        f" + {len(user)} user), {len(firing)} firing, "
+        f"{lifetime} fired lifetime")
+    srows = series.get("series") or []
+    if srows:
+        hot = {f.get("family") for f in firing}
+        hot.update(("hvd_controller_cycle_rate", "hvd_serve_queue_depth",
+                    "hvd_sentinel_loss", "hvd_straggler_skew"))
+        shown = [s for s in srows if s.get("family") in hot
+                 and s.get("points")]
+        if shown:
+            lines.append("")
+            lines.append(f"-- hot series (last {spark_window:.0f}s, "
+                         "newest right) --")
+            now = series.get("now", 0.0)
+            for s in sorted(shown, key=lambda s: (s["family"],
+                                                  s.get("rank", 0))):
+                pts = [p for p in s["points"]
+                       if isinstance(p[0], (int, float))
+                       and p[0] >= now - spark_window]
+                if not pts:
+                    continue
+                last = pts[-1][1]
+                lines.append(
+                    f"  {s['family']:<34} rank {s.get('rank', '?')}: "
+                    f"{_spark(pts):<24} {_fmt_v(last)}")
+    return "\n".join(lines)
+
+
 # ----------------------------------------------------------- serve plane
 def load_serve_view(source: str) -> Dict[str, Any]:
     """Resolve a ``--serve`` argument to the /serve/stats payload: an
@@ -322,6 +452,18 @@ def render_serve(view: Dict[str, Any]) -> str:
         f"JOURNAL: {jstate} — {journal.get('entries', '?')} entries; a "
         "reset replays the unfinished ones "
         "(docs/serving.md#fault-tolerance)")
+    # Watch plane (docs/watch.md) — absent on payloads from routers
+    # that predate it.
+    al = view.get("alerts")
+    if isinstance(al, dict):
+        if al.get("firing"):
+            lines.append(
+                f"ALERTS: {al.get('firing')} firing "
+                f"({al.get('critical', 0)} critical): "
+                f"{', '.join(al.get('rules') or [])} — details: "
+                "GET /alerts / hvdrun doctor --watch")
+        else:
+            lines.append("ALERTS: none firing")
     # Control-plane shard health (docs/control-plane.md) — absent on
     # payloads from unsharded fleets or routers that predate sharding.
     shards = view.get("kv_shards")
@@ -407,9 +549,49 @@ def main(argv=None) -> int:
                     help="render the serving fleet's operational view "
                          "(GET /serve/stats URL, host:port, or a saved "
                          "JSON; docs/serving.md)")
+    ap.add_argument("--watch", action="store_true",
+                    help="live watch-plane follow mode (docs/watch.md): "
+                         "re-render GET /alerts + /series every "
+                         "--interval seconds, alerts first, then "
+                         "sparklines of the hot families; a saved JSON "
+                         "renders once")
+    ap.add_argument("--once", action="store_true",
+                    help="with --watch: render a single frame and exit "
+                         "(what CI smokes and scripts use)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="with --watch: seconds between frames")
     ap.add_argument("--json", action="store_true",
                     help="dump the raw JSON instead of the rendering")
     args = ap.parse_args(argv)
+    if args.watch:
+        try:
+            view = load_watch_view(args.path)
+        except Exception as e:
+            print(f"hvdrun doctor: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            json.dump(view, sys.stdout, indent=1)
+            print()
+            return 0
+        print(render_watch(view))
+        import os as _os
+        live = args.path.startswith(("http://", "https://")) or (
+            ":" in args.path and not _os.path.exists(args.path))
+        if args.once or not live:
+            return 0  # saved-file views have nothing to follow
+        try:
+            while True:
+                time.sleep(max(0.2, args.interval))
+                try:
+                    view = load_watch_view(args.path)
+                except Exception as e:
+                    print(f"hvdrun doctor: refetch failed: {e}",
+                          file=sys.stderr)
+                    continue
+                print()
+                print(render_watch(view))
+        except KeyboardInterrupt:
+            return 0
     if args.serve:
         try:
             view = load_serve_view(args.path)

@@ -63,7 +63,9 @@ shard therefore stalls only the scopes it owns.
 
 from __future__ import annotations
 
+import itertools
 import json
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -77,6 +79,8 @@ CLOCK_SCOPE = "clock"
 HEALTH_SCOPE = "health"
 SERVE_SCOPE = "serve"
 PERF_SCOPE = "perf"
+SERIES_ROUTE = "series"
+ALERTS_ROUTE = "alerts"
 GENERATE_ROUTE = "generate"
 # serve_out writes wake the router's stream drains (serve/router.py
 # waits on kv_wakeup instead of busy-polling; docs/control-plane.md).
@@ -92,6 +96,15 @@ def store_for(server, scope: str):
     if not stores:
         return server
     return stores[shard_for_scope(scope, len(stores))]
+
+
+def watch_state_for(server):
+    """The watch plane's server-side state (series store + alert
+    engine; docs/watch.md), installed on the ``metrics``-owning shard
+    store at server start — so history piggybacks on the metric PUTs
+    that shard already receives and survives elastic resets with the
+    driver.  None on servers that predate/skip installation."""
+    return getattr(store_for(server, METRICS_SCOPE), "watch_state", None)
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -142,6 +155,21 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.end_headers()
         self._wake(scope)
+        # Watch plane (docs/watch.md): metrics snapshots feed the fleet
+        # series store (rate-limited to the series resolution) and each
+        # ingest runs an alert-evaluation pass; heartbeats feed the
+        # absence-kind liveness series.  Best-effort by contract —
+        # telemetry must never fail the KV op that carried it.
+        if scope in (METRICS_SCOPE, HEALTH_SCOPE):
+            try:
+                ws = watch_state_for(self.server)
+                if ws is not None:
+                    if scope == METRICS_SCOPE:
+                        ws.ingest_metrics(key, value)
+                    else:
+                        ws.note_heartbeat(key)
+            except Exception:
+                pass
 
     def do_POST(self) -> None:  # noqa: N802
         scope, key = self._split()
@@ -191,6 +219,12 @@ class _KVHandler(BaseHTTPRequestHandler):
             return
         if scope == PERF_SCOPE and not key:
             self._serve_perf()
+            return
+        if scope == SERIES_ROUTE and not key:
+            self._serve_series()
+            return
+        if scope == ALERTS_ROUTE and not key:
+            self._serve_alerts()
             return
         self._count_request()
         with self.server.kv_lock:  # type: ignore[attr-defined]
@@ -271,6 +305,51 @@ class _KVHandler(BaseHTTPRequestHandler):
             # control-plane.md): a dark shard is a partial outage the
             # on-call reader must see next to rank liveness.
             view["kv_shards"] = shards
+        self._serve_body(json.dumps(view).encode(), "application/json")
+
+    def _serve_series(self) -> None:
+        """Fleet time-series view (watch plane, docs/watch.md): the
+        bounded per-(rank, family) history the rendezvous server folds
+        out of the metric snapshots workers already publish.
+        ``GET /series?family=F&rank=N&window=S`` filters; bare
+        ``GET /series`` returns everything retained."""
+        from urllib.parse import parse_qs
+        ws = watch_state_for(self.server)
+        if ws is None:
+            self._serve_body(json.dumps({"error": "watch plane not "
+                                         "installed"}).encode(),
+                             "application/json")
+            return
+        family = rank = window = None
+        try:
+            q = parse_qs(getattr(self, "_query", ""))
+            if q.get("family"):
+                family = q["family"][0]
+            if q.get("rank"):
+                rank = int(q["rank"][0])
+            if q.get("window"):
+                window = float(q["window"][0])
+        except (ValueError, TypeError):
+            pass  # malformed query: fall back to the unfiltered view
+        view = ws.store.query(family=family, rank=rank, window_s=window)
+        self._serve_body(json.dumps(view).encode(), "application/json")
+
+    def _serve_alerts(self) -> None:
+        """Live alert view (watch plane, docs/watch.md#rules): one
+        evaluation pass over the rules engine — firing alerts first
+        (severity-ordered), then the active ruleset and the bounded
+        transition history; the payload ``hvdrun doctor --watch``
+        renders."""
+        ws = watch_state_for(self.server)
+        if ws is None:
+            self._serve_body(json.dumps({"error": "watch plane not "
+                                         "installed"}).encode(),
+                             "application/json")
+            return
+        view = ws.engine.view()
+        view["series"] = {"families": len(ws.store.families()),
+                          "points": ws.store.point_count(),
+                          "dropped_series": ws.store.dropped_series}
         self._serve_body(json.dumps(view).encode(), "application/json")
 
     def _serve_perf(self) -> None:
@@ -370,7 +449,62 @@ class RendezvousServer:
             self._threads.append(t)
         self._httpd = stores[0]
         self._shard_httpds = stores
+        self._install_watch_state(stores)
         return self._httpd.server_address[1]
+
+    def _install_watch_state(self, stores) -> None:
+        """Watch plane (docs/watch.md): the series store + alert engine
+        live on the ``metrics``-owning shard store — history piggybacks
+        on the metric PUTs that store already receives and, since every
+        shard lives in the driver process, survives elastic resets.
+        Firing alerts additionally land as instants in the ``timeline``
+        KV scope so the merged Perfetto trace shows incidents on the
+        suspect rank's lane."""
+        from ..watch import make_watch_state
+        seq = itertools.count()
+
+        def alert_instant(rule: str, rank: int, severity: str,
+                          now: float) -> None:
+            # A synthetic timeline chunk on the suspect rank's lane:
+            # worker chunks stamp absolute aligned µs (wall + offset
+            # measured against THIS server), so the server's own wall
+            # clock is on the same epoch by construction.
+            chunk = {"rank": int(rank), "seq": -1, "events": [
+                {"name": f"alert.{rule}", "ph": "i", "s": "p",
+                 "ts": now * 1e6, "lane": "alerts",
+                 "args": {"rule": rule, "severity": severity}}]}
+            tl = store_for(stores[0], TIMELINE_SCOPE)
+            key = f"alert.{rank}.{next(seq):06d}"
+            with tl.kv_lock:  # type: ignore[attr-defined]
+                tl.kv.setdefault(TIMELINE_SCOPE, {})[key] = \
+                    json.dumps(chunk).encode()  # type: ignore
+                tl.kv_times.setdefault(TIMELINE_SCOPE, {})[key] = \
+                    time.time()  # type: ignore[attr-defined]
+
+        ws = make_watch_state(
+            instant_fn=alert_instant,
+            log_fn=lambda m: print(m, file=sys.stderr, flush=True))
+        store_for(stores[0], METRICS_SCOPE).watch_state = ws
+
+    @property
+    def watch_state(self):
+        """The installed watch plane (None before start())."""
+        if self._httpd is None:
+            return None
+        return watch_state_for(self._httpd)
+
+    def install_alert_rules(self, rules) -> None:
+        """Merge user alert rules (hvdrun --alerts / HOROVOD_ALERTS)
+        over the committed defaults by name and publish the merged set
+        to KV scope ``alerts`` key ``rules`` for cross-checking — the
+        chaos-spec distribution contract (docs/watch.md#rules)."""
+        ws = self.watch_state
+        if ws is None:
+            return
+        ws.engine.set_rules(rules)
+        from ..watch import KV_KEY, KV_SCOPE, rules_to_json
+        self.put(KV_SCOPE, KV_KEY,
+                 rules_to_json(ws.engine.rules).encode())
 
     @property
     def port(self) -> int:

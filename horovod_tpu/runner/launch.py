@@ -213,6 +213,17 @@ def make_parser() -> argparse.ArgumentParser:
                    help="seconds the serving fleet stays up before a "
                         "clean exit (0/omitted = until interrupted); "
                         "bounded CI smokes use this")
+    p.add_argument("--alerts", default=None, metavar="RULES_YAML",
+                   help="declarative alert rules for the watch plane "
+                        "(horovod_tpu/watch; docs/watch.md): validated "
+                        "at launch, merged over the committed default "
+                        "ruleset by name, published to the rendezvous "
+                        "KV scope 'alerts' and evaluated continuously "
+                        "by the driver against the fleet time-series "
+                        "store — firing alerts surface at GET /alerts, "
+                        "as merged-timeline instants and as the "
+                        "hvd_alerts_* metric families (follow live: "
+                        "hvdrun doctor --watch URL)")
     p.add_argument("--chaos", default=None, metavar="SPEC_YAML",
                    help="deterministic fault-injection spec "
                         "(horovod_tpu/chaos; docs/chaos.md): validated at "
@@ -386,6 +397,27 @@ def publish_chaos_spec(args: argparse.Namespace,
     from ..chaos import KV_KEY, KV_SCOPE
     rendezvous.put(KV_SCOPE, KV_KEY,
                    load_chaos_spec(args).to_json().encode())
+
+
+def install_alert_rules(args: argparse.Namespace,
+                        rendezvous: RendezvousServer) -> None:
+    """Watch plane (docs/watch.md#rules): resolve the user ruleset
+    (--alerts flag > HOROVOD_ALERTS env > none), merge it over the
+    committed defaults inside the server's alert engine, and publish
+    the merged set to KV scope ``alerts`` — the chaos-spec distribution
+    contract.  A malformed rules file fails the LAUNCH (the parse
+    raises), never a detector mid-run.  Called by both the static and
+    the elastic driver, whose rendezvous server survives reset rounds
+    with the engine's state."""
+    path = getattr(args, "alerts", None) \
+        or os.environ.get("HOROVOD_ALERTS") or None
+    rules = None
+    if path:
+        if getattr(args, "_alert_rules", None) is None:
+            from ..watch import load_rules
+            args._alert_rules = load_rules(path)
+        rules = args._alert_rules
+    rendezvous.install_alert_rules(rules)
 
 
 def _pump_prefixed(stream, sink, rank: int, close_sink: bool) -> None:
@@ -830,6 +862,7 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
               "GET /metrics)",
               file=sys.stderr, flush=True)
     publish_chaos_spec(args, rendezvous)
+    install_alert_rules(args, rendezvous)
     for slot in slots:
         rendezvous.put("rank", str(slot.rank),
                        repr(slot.to_env()).encode())

@@ -170,6 +170,11 @@ class Runtime:
         # positive publish period).
         from .perf import validate_perf_knobs
         validate_perf_knobs(self.knobs)
+        # Watch plane (watch/; docs/watch.md): series bounds, sentinel
+        # cadence, and — when HOROVOD_ALERTS names a rules file — a full
+        # parse, so a typo'd ruleset fails bring-up, not a detector.
+        from .watch import validate_watch_knobs
+        validate_watch_knobs(self.knobs)
         if self.knobs["HOROVOD_FUSION_THRESHOLD"] <= 0:
             raise ValueError(
                 f"HOROVOD_FUSION_THRESHOLD="
@@ -546,6 +551,12 @@ class Runtime:
                 M.import_core_metrics(self.core.metrics())
             except Exception:
                 pass  # a closing core must not break the snapshot
+            # Watch plane: the natively-windowed hvd_*_rate gauges ride
+            # the same snapshot (csrc/window.h; docs/watch.md).
+            try:
+                M.import_window_rates(self.core.metrics_window())
+            except Exception:
+                pass  # pre-watch library or closing core: rates absent
             # Perf plane: the native per-op-name aggregates ride the
             # same snapshot (hvd_perf_native_op_* families).
             try:

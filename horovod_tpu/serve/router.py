@@ -475,10 +475,21 @@ def render_stats(server) -> Dict[str, Any]:
             out["engine"] = json.loads(raw)
         except (ValueError, TypeError):
             pass  # a torn PUT must not 500 the stats view
-    from ..runner.http_server import kv_shard_health
+    from ..runner.http_server import kv_shard_health, watch_state_for
     shards = kv_shard_health(server)
     if shards is not None:
         out["kv_shards"] = shards
+    ws = watch_state_for(server)
+    if ws is not None:
+        # Watch plane (docs/watch.md): the on-call reader checking the
+        # front door should see firing alerts next to admission state.
+        firing = ws.engine.evaluate()
+        out["alerts"] = {
+            "firing": len(firing),
+            "critical": sum(1 for f in firing
+                            if f.get("severity") == "critical"),
+            "rules": sorted({f["rule"] for f in firing}),
+        }
     return out
 
 

@@ -303,6 +303,27 @@ CONTROLLER_CYCLE_TIME = REGISTRY.histogram(
 CONTROLLER_NEGOTIATION_AGE = REGISTRY.histogram(
     "hvd_controller_negotiation_age_seconds",
     "Rank-0 per-tensor age from first submission to global readiness.")
+# Watch plane, native leg (csrc/window.h; docs/watch.md): trailing-window
+# rates differentiated inside the core against its epoch-stamped
+# snapshot ring — no scraper clock in the math.  Imported from
+# hvd_core_metrics_window by metrics_snapshot().
+CONTROLLER_CYCLE_RATE = REGISTRY.gauge(
+    "hvd_controller_cycle_rate",
+    "Controller cycles per second over the trailing window, computed "
+    "natively from the core's snapshot ring (hvd_core_metrics_window).")
+CONTROLLER_BYTES_REDUCED_RATE = REGISTRY.gauge(
+    "hvd_controller_bytes_reduced_rate",
+    "Reduced payload bytes per second over the trailing window "
+    "(native windowed rate, csrc/window.h).")
+TRANSPORT_RECONNECTS_RATE = REGISTRY.gauge(
+    "hvd_transport_reconnects_rate",
+    "Controller TCP reconnects per MINUTE over the trailing window "
+    "(native windowed rate — the flapping-transport detector's input).")
+CONTROLLER_BYPASS_FRACTION = REGISTRY.gauge(
+    "hvd_controller_bypass_fraction",
+    "Fraction of the trailing window's negotiation rounds served from "
+    "the locked plan epoch (bypass / (bypass + full cycles)) — the live "
+    "steady-state health of the PR-9 fast path.")
 
 # Layer 2: collectives + fusion planning (Python data-plane).
 COLLECTIVE_OPS = REGISTRY.counter(
@@ -496,6 +517,52 @@ PERF_NATIVE_OP_BYTES = REGISTRY.counter(
     "Cumulative payload bytes of negotiated collectives by collapsed "
     "op name (csrc hvd_core_op_stats).")
 
+# Watch plane, detection leg (horovod_tpu/watch/; docs/watch.md): the
+# declarative rules engine's firing accounting.  Maintained by the
+# DRIVER's AlertEngine (the rendezvous server evaluates rules against
+# the fleet series store), so these families carry data on the /metrics
+# driver row, not on workers.
+ALERTS_TOTAL = REGISTRY.counter(
+    "hvd_alerts_total",
+    "Alert firing transitions by rule and severity (the rules engine's "
+    "lifetime incident count; docs/watch.md#rules).")
+ALERTS_FIRING = REGISTRY.gauge(
+    "hvd_alerts_firing",
+    "Currently-firing alert instances by rule (0 = quiet) — the live "
+    "pager view of GET /alerts.")
+# Watch plane, sentinel leg (watch/sentinel.py; docs/watch.md#sentinels):
+# training-quality scalars computed at trace time inside the step
+# (grad-norm / nonfinite via psum, SPMD-identical on all ranks) and
+# recorded host-side — the model-health families the committed
+# sentinel-* default rules watch.
+SENTINEL_STEPS = REGISTRY.counter(
+    "hvd_sentinel_steps_total",
+    "Train steps the sentinel recorded (hvd.sentinel.wrap / record).")
+SENTINEL_LOSS = REGISTRY.gauge(
+    "hvd_sentinel_loss", "Last recorded training loss (pmean across "
+    "ranks when the step passed an axis_name).")
+SENTINEL_LOSS_EMA = REGISTRY.gauge(
+    "hvd_sentinel_loss_ema",
+    "Exponential moving average of the recorded loss (~50-step "
+    "horizon) — the divergence baseline.")
+SENTINEL_LOSS_DIVERGENCE = REGISTRY.gauge(
+    "hvd_sentinel_loss_divergence",
+    "Last loss over its EMA (1.0 = on trend); the committed "
+    "sentinel-loss-divergence rule thresholds this.")
+SENTINEL_GRAD_NORM = REGISTRY.gauge(
+    "hvd_sentinel_grad_norm",
+    "Global gradient L2 norm of the last recorded step (psum'd square "
+    "sums over the finite gradient mass, trace-time).")
+SENTINEL_NONFINITE = REGISTRY.counter(
+    "hvd_sentinel_nonfinite_total",
+    "Training steps with any nonfinite gradient element or loss (each "
+    "also triggers an explicit flight dump, reason 'nan' — "
+    "docs/watch.md#sentinels).")
+SENTINEL_LAST_NONFINITE_STEP = REGISTRY.gauge(
+    "hvd_sentinel_last_nonfinite_step",
+    "Step number of the most recent nonfinite verdict (-1 = none); the "
+    "sentinel-nonfinite alert carries it as context.")
+
 # Layer 3: runtime (stall inspector + topology).
 STRAGGLER_SUSPECT = REGISTRY.gauge(
     "hvd_straggler_suspect",
@@ -593,6 +660,18 @@ def import_core_metrics(native: Dict[str, Any]) -> None:
         h = native.get("histograms", {}).get(hname)
         if h:
             metric.set_native(h["buckets"], h["sum"] * 1e-6, h["count"])
+
+
+def import_window_rates(window: Dict[str, Any]) -> None:
+    """Map one native windowed-rates dict (CoordinationCore.
+    metrics_window()) onto the hvd_*_rate gauges.  The rates were
+    differentiated inside the core against its own steady clock
+    (csrc/window.h), so this is a straight copy."""
+    CONTROLLER_CYCLE_RATE.set(window.get("cycle_rate", 0.0))
+    CONTROLLER_BYTES_REDUCED_RATE.set(
+        window.get("bytes_reduced_rate", 0.0))
+    TRANSPORT_RECONNECTS_RATE.set(window.get("reconnect_rate", 0.0))
+    CONTROLLER_BYPASS_FRACTION.set(window.get("bypass_fraction", 0.0))
 
 
 # --------------------------------------------------------------- exposition
@@ -897,19 +976,18 @@ def detect_straggler(snapshots: Dict[int, Dict[str, Any]],
     estimates come from power-of-2 buckets — adjacent buckets differ by
     exactly 2x, so a 2x threshold would fire on quantization noise.
     None when no rank stands out or fewer than two ranks have data —
-    detection needs a peer baseline."""
-    rows = [(r, p99) for r, _, p99, _ in _age_rows(snapshots)
-            if p99 is not None]
-    if len(rows) < 2:
-        return None
-    suspect_rank, suspect_p99 = max(rows, key=lambda rp: rp[1])
-    peers = sorted(p for r, p in rows if r != suspect_rank)
-    peer_median = peers[len(peers) // 2]
-    if suspect_p99 < floor_seconds or \
-            suspect_p99 < skew_ratio * max(peer_median, 1e-9):
-        return None
-    return {"rank": suspect_rank, "p99": suspect_p99,
-            "peer_median_p99": peer_median}
+    detection needs a peer baseline.
+
+    The comparison itself lives in the watch plane
+    (``horovod_tpu.watch.rules.straggler_verdict``): the committed
+    ``straggler-suspect`` default rule thresholds the SAME skew over the
+    fleet series store, so the live monitor, the end-of-run report path
+    and the alert rule are ONE detection path (docs/watch.md)."""
+    rows = {r: p99 for r, _, p99, _ in _age_rows(snapshots)
+            if p99 is not None}
+    from horovod_tpu.watch.rules import straggler_verdict
+    return straggler_verdict(rows, skew_ratio=skew_ratio,
+                             floor_seconds=floor_seconds)
 
 
 class StragglerMonitor:

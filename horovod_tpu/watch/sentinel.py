@@ -1,0 +1,245 @@
+"""Training-quality sentinels: watch the MODEL, not just the machinery.
+
+Every plane so far watches infrastructure (cycles, bytes, heartbeats);
+a run can be infrastructurally perfect while the model silently
+diverges.  This module closes that gap (docs/watch.md#sentinels):
+
+  * :func:`sentinel_stats` — trace-time scalars computed INSIDE the
+    compiled step: global gradient norm, nonfinite element count (a
+    psum of ``isfinite`` complements, so the verdict is SPMD-identical
+    on every rank — no rank can disagree about whether the step was
+    finite), and the (p)mean loss;
+  * :func:`record` — the host-side sink: updates the
+    ``hvd_sentinel_*`` gauge/counter families that ride the existing
+    MetricsPublisher (zero new plumbing), maintains the loss EMA and
+    its divergence ratio, and on a nonfinite step fires the full
+    forensics chain — an explicit native flight dump
+    (``hvd_core_flight_dump`` reason ``nan``, closing the loop into the
+    PR-6 postmortem plane), a timeline instant, and the counter the
+    committed ``sentinel-nonfinite`` critical rule watches
+    (watch/rules.py);
+  * :func:`wrap` — the drop-in: wraps a train step whose output carries
+    ``(loss, grads, ...)``; stats are computed in-graph and delivered
+    host-side through ``jax.debug.callback`` (async, jit/pjit-safe), so
+    the wrapped step's signature and outputs are UNCHANGED.
+
+Knobs: ``HOROVOD_SENTINEL`` (kill switch — off, :func:`wrap` returns
+the step untouched) and ``HOROVOD_SENTINEL_INTERVAL`` (EMA/gauge update
+cadence in recorded steps; nonfinite is checked EVERY step regardless —
+a NaN must never slip between samples).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+# Loss EMA smoothing: ~50-step horizon, the scale at which "diverging"
+# is distinguishable from batch noise on the toy and real losses alike.
+EMA_ALPHA = 0.02
+
+
+class _SentinelState:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.step = 0                 # auto-advanced when record() gets None
+        self.ema: Optional[float] = None
+        self.recorded = 0
+        self.last_nonfinite_step = -1
+        self.dump_paths: list = []    # test-visible: flight dumps written
+
+
+_state = _SentinelState()
+
+
+def reset() -> None:
+    """Test hook: forget EMA/step state (module-global)."""
+    global _state
+    _state = _SentinelState()
+
+
+def _knob(name: str):
+    from ..common.knobs import current
+    return current(name)
+
+
+def enabled() -> bool:
+    return bool(_knob("HOROVOD_SENTINEL"))
+
+
+# ------------------------------------------------------------- trace time
+def sentinel_stats(loss, grads=None, axis_name: Optional[str] = None
+                   ) -> Dict[str, Any]:
+    """Trace-time sentinel scalars: ``{"loss", "grad_norm",
+    "nonfinite"}``, each a replicated f32 scalar.  With ``axis_name``
+    the gradient square-sum and nonfinite count are ``psum``'d and the
+    loss ``pmean``'d, so every rank computes the IDENTICAL verdict (the
+    SPMD caveat documented in docs/watch.md: call it inside the same
+    collective context as the gradient sync, or the psum deadlocks)."""
+    import jax
+    import jax.numpy as jnp
+    loss = jnp.asarray(loss, jnp.float32)
+    leaves = jax.tree_util.tree_leaves(grads) if grads is not None else []
+    sq = jnp.zeros((), jnp.float32)
+    bad = jnp.zeros((), jnp.float32)
+    for g in leaves:
+        g32 = jnp.asarray(g, jnp.float32)
+        fin = jnp.isfinite(g32)
+        # Nonfinite elements poison a plain square-sum; count them
+        # separately and keep the norm over the finite mass so BOTH
+        # signals stay informative on a partially-bad gradient.
+        sq = sq + jnp.sum(jnp.where(fin, g32, 0.0) ** 2)
+        bad = bad + jnp.sum(1.0 - fin.astype(jnp.float32))
+    bad = bad + (1.0 - jnp.isfinite(loss).astype(jnp.float32))
+    if axis_name is not None:
+        from jax import lax
+        sq = lax.psum(sq, axis_name)
+        bad = lax.psum(bad, axis_name)
+        loss = lax.pmean(loss, axis_name)
+    return {"loss": loss, "grad_norm": jnp.sqrt(sq), "nonfinite": bad}
+
+
+# -------------------------------------------------------------- host side
+def record(stats: Dict[str, Any], step: Optional[int] = None,
+           core: Any = None) -> Dict[str, float]:
+    """Sink one step's concrete sentinel scalars: update the
+    hvd_sentinel_* families, the loss EMA/divergence, and — on a
+    nonfinite step — fire the flight dump + alert chain.  Returns the
+    recorded row (tests and callers can assert on it)."""
+    loss = float(stats.get("loss", float("nan")))
+    grad_norm = float(stats.get("grad_norm", float("nan")))
+    nonfinite = float(stats.get("nonfinite", 0.0))
+    from ..utils import metrics as M
+    with _state.lock:
+        if step is None:
+            step = _state.step
+        _state.step = int(step) + 1
+        _state.recorded += 1
+        interval = max(1, int(_knob("HOROVOD_SENTINEL_INTERVAL")))
+        update_gauges = (_state.recorded % interval) == 0 or \
+            _state.recorded == 1
+        ema = _state.ema
+        if update_gauges and math.isfinite(loss):
+            ema = loss if ema is None else \
+                (1.0 - EMA_ALPHA) * ema + EMA_ALPHA * loss
+            _state.ema = ema
+    bad = nonfinite > 0 or not math.isfinite(loss) \
+        or not math.isfinite(grad_norm)
+    row = {"step": int(step), "loss": loss, "grad_norm": grad_norm,
+           "nonfinite": nonfinite,
+           "ema": ema if ema is not None else loss,
+           "divergence": (loss / ema) if (ema and math.isfinite(loss)
+                                          and ema > 0) else 1.0}
+    if update_gauges:
+        M.SENTINEL_STEPS.inc()
+        M.SENTINEL_LOSS.set(loss)
+        M.SENTINEL_GRAD_NORM.set(grad_norm)
+        if ema is not None:
+            M.SENTINEL_LOSS_EMA.set(ema)
+            M.SENTINEL_LOSS_DIVERGENCE.set(row["divergence"])
+    if bad:
+        _on_nonfinite(int(step), nonfinite, core=core)
+    return row
+
+
+def _on_nonfinite(step: int, count: float, core: Any = None) -> None:
+    """The nonfinite chain: counter + step gauge (what the committed
+    `sentinel-nonfinite` critical rule and its context ride), a native
+    flight dump (reason ``nan`` — the postmortem plane's black box taken
+    NOW, while the bad step's spans are still in the ring), a timeline
+    instant, and a loud log line naming the step."""
+    from ..utils import metrics as M
+    with _state.lock:
+        already = _state.last_nonfinite_step == step
+        _state.last_nonfinite_step = step
+    if already:
+        return  # one verdict per step, however many records land on it
+    M.SENTINEL_NONFINITE.inc()
+    M.SENTINEL_LAST_NONFINITE_STEP.set(step)
+    dump = _flight_dump(step, core=core)
+    try:
+        from ..utils.timeline import trace_instant
+        trace_instant("alerts", "sentinel.nonfinite",
+                      args={"step": step, "count": count})
+    except Exception:
+        pass
+    try:
+        from ..common import hvdlogging as log
+        log.warning(
+            "sentinel: NONFINITE training step %d (%s nonfinite values)%s "
+            "— docs/watch.md#sentinels", step, int(count),
+            f"; flight dump: {dump}" if dump else "")
+    except Exception:
+        pass
+
+
+def _flight_dump(step: int, core: Any = None) -> Optional[str]:
+    """Explicit native flight dump for a nonfinite step.  Uses the
+    caller's core, else the initialized runtime's (never forces a core
+    into existence — a pure-SPMD run has no controller to dump).  The
+    path derives from HOROVOD_FLIGHT_RECORD (the postmortem plane's
+    per-rank path) with a ``.nan`` suffix so a later crash record never
+    overwrites the divergence evidence."""
+    path = str(_knob("HOROVOD_FLIGHT_RECORD") or "")
+    if core is None:
+        try:
+            from .. import runtime as _rt
+            if _rt.is_initialized():
+                core = _rt.get().core
+        except Exception:
+            core = None
+    if core is None or not getattr(core, "_h", True):
+        return None
+    if not path:
+        return None
+    path = f"{path}.nan"
+    try:
+        if core.flight_dump(path, reason=f"nan step={step}"):
+            with _state.lock:
+                _state.dump_paths.append(path)
+            return path
+    except Exception:
+        pass  # forensics must never take the training loop down
+    return None
+
+
+# ----------------------------------------------------------------- wrap
+def wrap(step_fn: Callable, axis_name: Optional[str] = None,
+         extract: Optional[Callable[[Any], Tuple[Any, Any]]] = None
+         ) -> Callable:
+    """Sentinel-wrap a train step: same signature, same outputs, plus
+    the in-graph sentinel scalars delivered host-side via
+    ``jax.debug.callback``.  ``extract(out) -> (loss, grads)`` defaults
+    to ``(out[0], out[1])`` for tuple outputs and ``(out, None)`` for a
+    bare loss.  With HOROVOD_SENTINEL=0 the step is returned untouched
+    (the kill switch costs nothing)."""
+    if not enabled():
+        return step_fn
+
+    def _default_extract(out):
+        if isinstance(out, (tuple, list)) and len(out) >= 2:
+            return out[0], out[1]
+        return out, None
+
+    pick = extract or _default_extract
+
+    def wrapped(*args, **kwargs):
+        import jax
+        out = step_fn(*args, **kwargs)
+        loss, grads = pick(out)
+        stats = sentinel_stats(loss, grads, axis_name=axis_name)
+
+        def _sink(loss_v, gn_v, nf_v):
+            try:
+                record({"loss": loss_v, "grad_norm": gn_v,
+                        "nonfinite": nf_v})
+            except Exception:
+                pass  # telemetry must never take the step down
+
+        jax.debug.callback(_sink, stats["loss"], stats["grad_norm"],
+                           stats["nonfinite"])
+        return out
+
+    return wrapped

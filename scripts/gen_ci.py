@@ -59,6 +59,7 @@ SUITES = {
         "tests/test_autotune.py", "tests/test_aux.py",
         "tests/test_metrics.py", "tests/test_chaos.py",
         "tests/test_postmortem.py", "tests/test_native_sanitize.py",
+        "tests/test_watch.py",
     ],
     "torch": ["tests/test_torch.py"],
     "tensorflow-keras": ["tests/test_tensorflow.py", "tests/test_keras.py"],
@@ -224,6 +225,18 @@ def build_steps():
         "chaos: sharded-serve partial-outage smoke",
         f"{py} -m pytest "
         f"tests/integration/test_kv_shard_integration.py {full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=20))
+    steps.append(_step(
+        # watch-plane alerts smoke: hvdrun --alerts (user rules merged
+        # over the committed defaults) on 2-proc runs — a
+        # chaos-scheduled 40 ms stall must fire the straggler-suspect
+        # rule at GET /alerts naming rank 1 AND land as a timeline
+        # instant on rank 1's lane, and a NaN-injected gradient must
+        # fire the sentinel-nonfinite CRITICAL alert plus a parseable
+        # reason-nan flight dump (docs/watch.md).
+        "watch: 2-process alerts + sentinel smoke (hvdrun --alerts)",
+        f"{py} -m pytest tests/integration/test_watch_integration.py "
+        f"{full}",
         env={"JAX_PLATFORMS": "cpu"}, timeout=20))
     steps.append(_step(
         # perf-attribution smoke: a 2-process CPU-virtual fleet records

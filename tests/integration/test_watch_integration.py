@@ -1,0 +1,89 @@
+"""Watch-plane acceptance experiments (docs/watch.md), 2 real processes
+under the real launcher:
+
+  (a) a chaos-scheduled 40 ms stall on rank 1 fires the committed
+      `straggler-suspect` rule at GET /alerts — right rule, RIGHT RANK —
+      and lands as an alert instant on rank 1's lane in the merged
+      timeline, all while the run is still running (the in-flight
+      detection PR 1-8 never had);
+  (b) a NaN-injected gradient on rank 1 fires the `sentinel-nonfinite`
+      CRITICAL alert (with the step number as context) and writes a
+      parseable explicit flight dump (reason `nan`) — the
+      training-quality loop closed into the PR-6 postmortem plane.
+
+Both runs pass ``--alerts`` with a user rules file, so the
+chaos-spec-style distribution path (parse at launch, publish to KV
+scope ``alerts``, merge over defaults) is exercised end to end.
+"""
+
+import pytest
+
+from test_multiprocess import run_hvdrun
+
+_USER_RULES = """
+rules:
+  - name: watch-test-user-rule
+    family: hvd_controller_cycles_total
+    kind: threshold
+    op: ">="
+    value: 1e18
+    severity: info
+"""
+
+
+def _rules_file(tmp_path) -> str:
+    p = tmp_path / "rules.yaml"
+    p.write_text(_USER_RULES)
+    return str(p)
+
+
+@pytest.mark.integration
+def test_watch_straggler_alert_fires_in_flight(tmp_path):
+    """(a) the stall -> skew-series -> threshold-rule -> /alerts +
+    timeline-instant chain, asserted from inside the running fleet."""
+    spec = tmp_path / "chaos.yaml"
+    spec.write_text("""
+seed: 23
+events:
+  - stall: {rank: 1, point: complete, duration_ms: 40}
+""")
+    proc = run_hvdrun(
+        "watch_worker.py",
+        extra_env={"HVD_CPU_CHIPS": "1",
+                   "HOROVOD_METRICS": "1",
+                   "HOROVOD_METRICS_INTERVAL": "0.3",
+                   "HOROVOD_SERIES_RESOLUTION": "0.2",
+                   "HOROVOD_SERIES_RETENTION": "120"},
+        launcher_args=["--chaos", str(spec),
+                       "--alerts", _rules_file(tmp_path)])
+    assert proc.stdout.count("WATCH-STRAGGLER-OK") >= 2, \
+        proc.stdout + proc.stderr
+    # the driver-side engine announced the transition on stderr
+    assert "ALERT warning straggler-suspect" in proc.stderr, \
+        proc.stderr[-4000:]
+
+
+@pytest.mark.integration
+def test_watch_sentinel_nan_fires_critical_and_dumps_flight(tmp_path):
+    """(b) NaN gradient -> sentinel counter -> critical /alerts verdict
+    naming rank 1 + step, plus the reason-nan flight dump, parseable."""
+    pm = tmp_path / "pm"
+    proc = run_hvdrun(
+        "watch_nan_worker.py",
+        extra_env={"HVD_CPU_CHIPS": "1",
+                   "HOROVOD_METRICS": "1",
+                   "HOROVOD_METRICS_INTERVAL": "0.3",
+                   "HOROVOD_SERIES_RESOLUTION": "0.2"},
+        launcher_args=["--postmortem", str(pm),
+                       "--alerts", _rules_file(tmp_path)])
+    # --postmortem redirects worker streams to DIR/logs/rank.N/
+    out = proc.stdout + proc.stderr
+    for rank in (0, 1):
+        for stream in ("stdout", "stderr"):
+            p = pm / "logs" / f"rank.{rank}" / stream
+            if p.exists():
+                out += p.read_text()
+    assert out.count("WATCH-NAN-OK") >= 2, out[-6000:]
+    assert (pm / "flight.rank.1.nan").exists()
+    assert "ALERT critical sentinel-nonfinite" in proc.stderr, \
+        proc.stderr[-4000:]

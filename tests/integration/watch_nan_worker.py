@@ -1,0 +1,109 @@
+"""Per-rank worker for the watch-plane sentinel NaN test.
+
+A sentinel-wrapped toy train step runs on both ranks; rank 1's step-3
+input is poisoned with NaN, so its gradients and loss go nonfinite.
+The sentinel must (a) write an explicit native flight dump (reason
+``nan``, path ``$HOROVOD_FLIGHT_RECORD.nan`` — the launcher's
+--postmortem armed the per-rank path) that parses as a flight record,
+and (b) move ``hvd_sentinel_nonfinite_total``, which the committed
+``sentinel-nonfinite`` CRITICAL rule turns into a firing alert at
+``GET /alerts`` naming rank 1 with the step number as context — the
+loop from a bad gradient to the postmortem plane, closed end to end.
+"""
+
+import json
+import math
+import os
+import sys
+import time
+import urllib.request
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+NAN_STEP = 3
+
+
+def _get_json(path: str):
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    port = os.environ["HOROVOD_RENDEZVOUS_PORT"]
+    with urllib.request.urlopen(f"http://{addr}:{port}{path}",
+                                timeout=10) as r:
+        return json.loads(r.read())
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.process_size() == 2
+    rank = hvd.process_rank()
+    # Bring up the native controller: the sentinel's explicit flight
+    # dump snapshots ITS black box (hvd_core_flight_dump reason nan).
+    from horovod_tpu import runtime as rt
+    assert rt.get().ensure_core() is not None
+
+    @jax.jit
+    def step(x):
+        loss = jnp.sum(x ** 2)
+        grads = jax.grad(lambda v: jnp.sum(v ** 2))(x)
+        return loss, grads
+
+    wrapped = hvd.sentinel.wrap(step)
+
+    ones = np.ones((4,), np.float32)
+    for i in range(8):
+        x = jnp.asarray(ones * (float("nan")
+                                if (rank == 1 and i == NAN_STEP)
+                                else 1.0))
+        loss, grads = wrapped(x)
+        synced = np.asarray(hvd.allreduce(np.asarray(grads),
+                                          name=f"g{i}", op=hvd.Sum))
+        if rank == 1 and i == NAN_STEP:
+            assert not math.isfinite(float(synced[0]))
+    jax.effects_barrier()  # sentinel records ride jax.debug.callback
+
+    if rank == 1:
+        # (a) the explicit flight dump, reason nan, parseable.
+        flight = os.environ["HOROVOD_FLIGHT_RECORD"] + ".nan"
+        deadline = time.time() + 10
+        while not os.path.exists(flight) and time.time() < deadline:
+            time.sleep(0.1)
+        assert os.path.exists(flight), f"no flight dump at {flight}"
+        from horovod_tpu.postmortem import parse_flight_record
+        fr = parse_flight_record(flight)
+        assert "nan" in fr["reason"], fr["reason"]
+        assert f"step={NAN_STEP}" in fr["reason"], fr["reason"]
+        assert fr["complete"], "torn flight dump"
+        snap = hvd.metrics_snapshot()["families"]
+        total = sum(s["value"] for s in
+                    snap["hvd_sentinel_nonfinite_total"]["samples"])
+        assert total == 1, snap["hvd_sentinel_nonfinite_total"]
+
+    # (b) both ranks see the critical alert naming rank 1 + the step.
+    verdict = None
+    poll_deadline = time.time() + 30
+    while time.time() < poll_deadline:
+        view = _get_json("/alerts")
+        hits = [f for f in view["firing"]
+                if f["rule"] == "sentinel-nonfinite"]
+        if hits:
+            verdict = hits[0]
+            break
+        time.sleep(0.3)
+    assert verdict is not None, "sentinel-nonfinite never fired"
+    assert verdict["rank"] == 1, verdict
+    assert verdict["severity"] == "critical", verdict
+    ctx = verdict.get("context") or {}
+    assert ctx.get("hvd_sentinel_last_nonfinite_step") == NAN_STEP, \
+        verdict
+
+    print(f"WATCH-NAN-OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+"""Per-rank worker for the watch-plane straggler-alert test.
+
+The chaos spec stalls rank 1 for 40 ms at the ``complete`` point (the
+slow-host straggler mode), inflating rank 1's own negotiation ages; the
+metric snapshots both ranks publish feed the driver's fleet series
+store, the derived ``hvd_straggler_skew`` series crosses the committed
+``straggler-suspect`` rule's 4x threshold, and the alert must surface —
+while the run is STILL RUNNING — at ``GET /alerts`` (right rule, right
+rank) and as an ``alert.straggler-suspect`` instant on rank 1's lane in
+the merged ``GET /timeline``.  Both ranks poll and assert, so the test
+also proves the alert view is readable from any worker.
+
+Also asserts the launcher-published user rule (tests pass ``--alerts``)
+rode the KV ``alerts`` scope merged over the defaults — the
+chaos-spec-style distribution contract.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def _get_json(path: str):
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    port = os.environ["HOROVOD_RENDEZVOUS_PORT"]
+    with urllib.request.urlopen(f"http://{addr}:{port}{path}",
+                                timeout=10) as r:
+        return json.loads(r.read())
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.process_size() == 2
+    rank = hvd.process_rank()
+    assert hvd.chaos.active() is not None, \
+        "chaos injector not installed from the rendezvous spec"
+    # Bring up the native controller: the windowed-rate assertion below
+    # reads ITS snapshot ring (the SPMD data plane needs no core).
+    from horovod_tpu import runtime as rt
+    assert rt.get().ensure_core() is not None
+
+    x = np.full((4,), float(rank + 1), np.float32)
+    np.asarray(hvd.allreduce(x, op=hvd.Sum))  # warmup aligns the ranks
+    start = time.monotonic()
+    for i in range(25):
+        # Paced ticks keep the stall inside the straggler's own window
+        # (see chaos_straggler_worker.py for the attribution rationale).
+        deadline = start + i * 0.1
+        now = time.monotonic()
+        if deadline > now:
+            time.sleep(deadline - now)
+        out = np.asarray(hvd.allreduce(x, name=f"w{i}", op=hvd.Sum))
+        assert np.allclose(out, 3.0 * hvd.size() / 2), out
+
+    # The distributed ruleset: user rule (from --alerts) merged over the
+    # committed defaults, published at KV scope alerts/rules.
+    published = _get_json("/alerts/rules")
+    names = {r["name"] for r in published["rules"]}
+    assert "watch-test-user-rule" in names, names
+    assert "straggler-suspect" in names, names
+
+    # The alert must fire IN FLIGHT: poll GET /alerts while our metrics
+    # publisher keeps feeding the series store.
+    verdict = None
+    poll_deadline = time.time() + 30
+    while time.time() < poll_deadline:
+        view = _get_json("/alerts")
+        hits = [f for f in view["firing"]
+                if f["rule"] == "straggler-suspect"]
+        if hits:
+            verdict = hits[0]
+            break
+        time.sleep(0.3)
+    assert verdict is not None, "straggler-suspect never fired"
+    assert verdict["rank"] == 1, verdict
+    assert verdict["severity"] == "warning", verdict
+    assert verdict["value"] >= 4.0, verdict
+
+    # The firing transition is an instant on RANK 1's lane in the merged
+    # Perfetto view (the driver injected a synthetic timeline chunk).
+    merged = _get_json("/timeline")
+    alert_evs = [e for e in merged["traceEvents"]
+                 if e.get("name") == "alert.straggler-suspect"]
+    assert alert_evs, "no alert instant in the merged timeline"
+    assert all(e["pid"] == 1 for e in alert_evs), alert_evs
+
+    # The windowed native rates ride the public snapshot (csrc ring).
+    fams = hvd.metrics_snapshot()["families"]
+    cycle_rate = fams["hvd_controller_cycle_rate"]["samples"][0]["value"]
+    assert cycle_rate > 0, fams["hvd_controller_cycle_rate"]
+
+    print(f"WATCH-STRAGGLER-OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

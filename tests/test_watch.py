@@ -1,0 +1,700 @@
+"""Watch plane (docs/watch.md): rules engine per-kind matrix (incl.
+``for:`` durations and the MAD zero-band), series-ring downsampling +
+retention bounds under a publish storm, the native windowed-rates C API
+round trip, sentinel nonfinite/divergence on a toy step, the /series +
+/alerts routes, and the doctor --watch golden."""
+
+import json
+import math
+import os
+import time
+import urllib.request
+
+import pytest
+
+import horovod_tpu.utils.metrics as M
+from horovod_tpu.watch import (AlertEngine, DEFAULT_RULES, SeriesStore,
+                               WatchState, load_rules, loads_rules,
+                               merge_rules, parse_rules,
+                               rules_to_json, straggler_skew,
+                               straggler_verdict, validate_watch_knobs)
+from horovod_tpu.watch import sentinel
+from horovod_tpu.watch.series import (HEARTBEAT_FAMILY,
+                                      NEGOTIATION_AGE_P99,
+                                      STRAGGLER_SKEW, SeriesRing)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------- rule parsing
+def test_parse_rules_aliases_and_for_key():
+    rules = parse_rules({"rules": [
+        {"name": "a", "family": "f", "kind": "roc", "for": 3,
+         "window": 10},
+        {"name": "b", "family": "f", "kind": "mad-anomaly"},
+    ]})
+    assert rules[0].kind == "rate_of_change"
+    assert rules[0].for_s == 3.0
+    assert rules[1].kind == "mad"
+
+
+def test_parse_rules_rejects_typos():
+    with pytest.raises(ValueError, match="kind"):
+        parse_rules([{"name": "a", "family": "f", "kind": "treshold"}])
+    with pytest.raises(ValueError, match="unknown fields"):
+        parse_rules([{"name": "a", "family": "f", "kind": "threshold",
+                      "treshold": 4}])
+    with pytest.raises(ValueError, match="op"):
+        parse_rules([{"name": "a", "family": "f", "kind": "threshold",
+                      "op": "=="}])
+    with pytest.raises(ValueError, match="severity"):
+        parse_rules([{"name": "a", "family": "f", "kind": "threshold",
+                      "severity": "panic"}])
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_rules([{"name": "a", "family": "f", "kind": "threshold"},
+                     {"name": "a", "family": "g", "kind": "absence"}])
+    with pytest.raises(ValueError, match="missing"):
+        parse_rules([{"family": "f", "kind": "threshold"}])
+    with pytest.raises(ValueError, match="top-level"):
+        parse_rules({"rule": []})
+    with pytest.raises(ValueError, match="for >= 0"):
+        parse_rules([{"name": "a", "family": "f", "kind": "threshold",
+                      "for": -1}])
+
+
+def test_loads_rules_yaml_and_json_roundtrip():
+    text = """
+rules:
+  - name: queue-deep
+    family: hvd_serve_queue_depth
+    kind: threshold
+    value: 100
+    for: 10
+    severity: critical
+"""
+    rules = loads_rules(text)
+    assert rules[0].severity == "critical" and rules[0].for_s == 10.0
+    again = loads_rules(rules_to_json(rules))
+    assert again == rules
+
+
+def test_default_ruleset_covers_the_standing_failure_modes():
+    names = {r.name for r in DEFAULT_RULES}
+    assert {"straggler-suspect", "perf-model-drift", "serve-shed-rate",
+            "kv-shard-unavailable", "heartbeat-stale",
+            "sentinel-nonfinite", "sentinel-loss-nonfinite",
+            "sentinel-loss-divergence"} <= names
+    crit = {r.name for r in DEFAULT_RULES if r.severity == "critical"}
+    assert "sentinel-nonfinite" in crit and "heartbeat-stale" in crit
+
+
+def test_merge_rules_user_wins_by_name():
+    user = parse_rules([{"name": "straggler-suspect",
+                         "family": "hvd_straggler_skew",
+                         "kind": "threshold", "value": 8.0}])
+    merged = merge_rules(user)
+    byname = {r.name: r for r in merged}
+    assert byname["straggler-suspect"].value == 8.0
+    assert len(merged) == len(DEFAULT_RULES)  # replaced, not appended
+
+
+# ------------------------------------------------------------ series ring
+def test_series_ring_downsamples_last_wins():
+    ring = SeriesRing(retention_s=100, resolution_s=1.0)
+    ring.add(0.0, 1.0)
+    ring.add(0.5, 2.0)   # same bucket: replaces
+    ring.add(1.5, 3.0)   # new bucket
+    assert ring.points == [[0.0, 2.0], [1.5, 3.0]]
+
+
+def test_series_ring_bounded_under_publish_storm():
+    """Acceptance: the ring never exceeds its configured point budget
+    however long the storm runs (retention/resolution + 1)."""
+    ring = SeriesRing(retention_s=10, resolution_s=1.0)
+    budget = ring.cap
+    assert budget == 11
+    t = 0.0
+    for i in range(10000):
+        t += 0.1
+        ring.add(t, float(i))
+        assert len(ring.points) <= budget
+    # and retention is enforced, not just the cap
+    assert ring.points[0][0] >= t - 10 - 1.0
+
+
+def test_series_store_caps_cardinality():
+    store = SeriesStore(retention_s=10, resolution_s=1, max_series=3)
+    for i in range(10):
+        store.add(0, f"fam{i}", 1.0, 1.0)
+    assert len(store.families()) == 3
+    assert store.dropped_series == 7
+
+
+def test_series_store_query_filters():
+    store = SeriesStore(retention_s=100, resolution_s=1)
+    store.add(0, "a", 10.0, 1.0)
+    store.add(1, "a", 10.0, 2.0)
+    store.add(0, "b", 10.0, 3.0)
+    v = store.query(family="a", now=11.0)
+    assert {(s["rank"], s["family"]) for s in v["series"]} == \
+        {(0, "a"), (1, "a")}
+    v = store.query(rank=0, now=11.0)
+    assert {(s["rank"], s["family"]) for s in v["series"]} == \
+        {(0, "a"), (0, "b")}
+    v = store.query(family="a", window_s=0.5, now=100.0)
+    assert v["series"] == []  # points aged out of the window
+
+
+# ---------------------------------------------------------- engine kinds
+def _engine(rules, **kw):
+    store = SeriesStore(retention_s=600, resolution_s=0.001)
+    return store, AlertEngine(store, rules=parse_rules(rules), **kw)
+
+
+def _firing(engine, now):
+    return [(f["rule"], f["rank"]) for f in engine.evaluate(now)]
+
+
+def test_threshold_kind_with_for_duration():
+    store, eng = _engine([{"name": "hot", "family": "f",
+                           "kind": "threshold", "value": 5, "for": 10}])
+    store.add(0, "f", 100.0, 9.0)
+    assert _firing(eng, 100.0) == []          # pending, `for:` unserved
+    assert _firing(eng, 105.0) == []
+    assert _firing(eng, 110.5) == [("hot", 0)]  # held 10s: firing
+    store.add(0, "f", 111.0, 1.0)
+    assert _firing(eng, 111.0) == []          # resolved
+    store.add(0, "f", 112.0, 9.0)
+    assert _firing(eng, 112.0) == []          # pending restarts from 0
+
+
+def test_rate_of_change_kind():
+    store, eng = _engine([{"name": "shed", "family": "c", "kind": "roc",
+                           "value": 0.5, "window": 30}])
+    store.add(1, "c", 100.0, 0.0)
+    assert _firing(eng, 100.0) == []          # one point: no rate yet
+    store.add(1, "c", 110.0, 20.0)            # 2/s
+    assert _firing(eng, 110.0) == [("shed", 1)]
+    store.add(1, "c", 150.0, 20.0)            # flat again (old pt aged out)
+    store.add(1, "c", 160.0, 20.0)
+    assert _firing(eng, 160.0) == []
+
+
+def test_mad_kind_anomaly_and_zero_band():
+    noisy = [{"name": "m", "family": "f", "kind": "mad", "value": 4,
+              "window": 100}]
+    store, eng = _engine(noisy)
+    for i, v in enumerate([10.0, 12.0, 9.0, 11.0, 10.0]):
+        store.add(0, "f", 100.0 + i, v)
+    assert _firing(eng, 104.0) == []
+    store.add(0, "f", 106.0, 50.0)            # way past 4x MAD
+    assert _firing(eng, 106.0) == [("m", 0)]
+    # MAD zero-band: a perfectly flat history never fires by default...
+    store2, eng2 = _engine(noisy)
+    for i in range(5):
+        store2.add(0, "f", 100.0 + i, 10.0)
+    store2.add(0, "f", 106.0, 11.0)
+    assert _firing(eng2, 106.0) == []
+    # ...and fires past an explicit absolute band
+    store3, eng3 = _engine([{"name": "m", "family": "f", "kind": "mad",
+                             "value": 4, "window": 100,
+                             "zero_band": 0.5}])
+    for i in range(5):
+        store3.add(0, "f", 100.0 + i, 10.0)
+    store3.add(0, "f", 106.0, 11.0)
+    assert _firing(eng3, 106.0) == [("m", 0)]
+
+
+def test_absence_kind_silence_vs_bringup():
+    store, eng = _engine([{"name": "hb", "family": "pulse",
+                           "kind": "absence", "window": 15}])
+    assert _firing(eng, 1000.0) == []         # never seen: bring-up
+    store.add(2, "pulse", 1000.0, 1.0)
+    assert _firing(eng, 1010.0) == []
+    assert _firing(eng, 1016.0) == [("hb", 2)]
+    store.add(2, "pulse", 1017.0, 1.0)
+    assert _firing(eng, 1017.5) == []
+
+
+def test_default_heartbeat_stale_rule_on_receipts():
+    """The committed heartbeat-stale rule over note_heartbeat receipts:
+    silence past the window fires critical for the silent rank only."""
+    store = SeriesStore(retention_s=600, resolution_s=0.001)
+    eng = AlertEngine(store)  # defaults only
+    store.note_heartbeat(0, t=1000.0)
+    store.note_heartbeat(1, t=1000.0)
+    store.note_heartbeat(0, t=1020.0)         # rank 1 went silent
+    firing = {(f["rule"], f["rank"], f["severity"])
+              for f in eng.evaluate(1020.0)}
+    assert ("heartbeat-stale", 1, "critical") in firing
+    assert all(r != 0 for rule, r, _ in firing
+               if rule == "heartbeat-stale")
+
+
+def test_nonfinite_kind():
+    store, eng = _engine([{"name": "nan", "family": "loss",
+                           "kind": "nonfinite"}])
+    store.add(0, "loss", 10.0, 1.5)
+    assert _firing(eng, 10.0) == []
+    store.add(0, "loss", 11.0, float("nan"))
+    assert _firing(eng, 11.0) == [("nan", 0)]
+    store.add(0, "loss", 12.0, float("inf"))
+    assert _firing(eng, 12.0) == [("nan", 0)]
+
+
+def test_rank_pinned_rule_ignores_other_ranks():
+    store, eng = _engine([{"name": "r1", "family": "f",
+                           "kind": "threshold", "value": 5, "rank": 1}])
+    store.add(0, "f", 10.0, 9.0)
+    assert _firing(eng, 10.0) == []
+    store.add(1, "f", 10.0, 9.0)
+    assert _firing(eng, 10.5) == [("r1", 1)]
+
+
+def test_transitions_counted_once_and_gauge_tracks():
+    instants = []
+    store, eng = _engine(
+        [{"name": "hot", "family": "f", "kind": "threshold", "value": 5,
+          "severity": "critical"}],
+        instant_fn=lambda **kw: instants.append(kw))
+    store.add(0, "f", 10.0, 9.0)
+    for t in (10.0, 11.0, 12.0):
+        eng.evaluate(t)                       # firing held: ONE transition
+    assert eng.fired_total() == [{"rule": "hot", "severity": "critical",
+                                  "count": 1}]
+    assert len(instants) == 1 and instants[0]["rank"] == 0
+    assert M.ALERTS_FIRING.value(rule="hot") == 1
+    store.add(0, "f", 13.0, 1.0)
+    eng.evaluate(13.0)
+    assert M.ALERTS_FIRING.value(rule="hot") == 0
+    store.add(0, "f", 14.0, 9.0)
+    eng.evaluate(14.0)                        # re-fire: second transition
+    assert eng.fired_total()[0]["count"] == 2
+    assert M.ALERTS_TOTAL.value(rule="hot", severity="critical") == 2
+
+
+def test_context_family_rides_the_firing():
+    store, eng = _engine([{"name": "nf", "family": "c", "kind": "roc",
+                           "value": 0, "window": 60,
+                           "context_family": "step"}])
+    store.add(1, "c", 100.0, 0.0)
+    store.add(1, "c", 110.0, 1.0)
+    store.add(1, "step", 110.0, 7.0)
+    firing = eng.evaluate(110.0)
+    assert firing[0]["context"] == {"step": 7.0}
+
+
+# -------------------------------------------------- straggler: one path
+def test_straggler_skew_and_verdict():
+    skews = straggler_skew({0: 0.001, 1: 0.064, 2: 0.0011})
+    assert skews[1]["ratio"] > 4.0
+    assert straggler_verdict({0: 0.001, 1: 0.064})["rank"] == 1
+    assert straggler_verdict({0: 0.001, 1: 0.0011}) is None
+    assert straggler_verdict({0: 0.064}) is None  # no peer baseline
+    # absolute floor: µs-scale jitter never names anyone
+    assert straggler_verdict({0: 1e-6, 1: 1e-4}) is None
+
+
+def _age_snapshot(p99_bucket: int, n: int = 20) -> dict:
+    counts = [0] * M.NATIVE_BUCKETS
+    counts[p99_bucket] = n
+    return {"families": {"hvd_negotiation_age_seconds": {
+        "kind": "histogram", "help": "h",
+        "bounds": list(M.BUCKET_BOUNDS),
+        "samples": [{"labels": {}, "counts": counts,
+                     "sum": n * M.BUCKET_BOUNDS[p99_bucket],
+                     "count": n}]}}}
+
+
+def test_default_straggler_rule_fires_from_ingested_snapshots():
+    """The committed `straggler-suspect` rule over the derived skew
+    series IS the PR-5 check: same _age_rows source, same 4x-median
+    comparison (watch/rules.straggler_skew) — one detection path."""
+    store = SeriesStore(retention_s=600, resolution_s=0.001)
+    eng = AlertEngine(store)  # defaults only
+    store.ingest_snapshot(0, _age_snapshot(11), t=100.0)   # ~2 ms
+    store.ingest_snapshot(1, _age_snapshot(16), t=100.1)   # ~65 ms
+    firing = {(f["rule"], f["rank"]) for f in eng.evaluate(101.0)}
+    assert ("straggler-suspect", 1) in firing
+    assert ("straggler-suspect", 0) not in firing
+    assert store.latest(1, STRAGGLER_SKEW)[1] > 4.0
+    assert store.latest(0, NEGOTIATION_AGE_P99) is not None
+
+
+def test_detect_straggler_delegates_to_the_same_skew():
+    snaps = {0: _age_snapshot(11), 1: _age_snapshot(16)}
+    v = M.detect_straggler(snaps)
+    assert v is not None and v["rank"] == 1
+    assert v["ratio"] >= 4.0
+    assert v["p99"] > v["peer_median_p99"]
+
+
+# --------------------------------------------------- native window C API
+def test_native_metrics_window_roundtrip():
+    from horovod_tpu.common.basics import CoordinationCore, LoopbackHub
+    hub = LoopbackHub(2)
+    cores = [CoordinationCore.loopback(hub, r, cycle_ms=1.0)
+             for r in (0, 1)]
+    try:
+        for i in range(5):
+            for c in cores:
+                c.submit(f"w{i}", "f32/4", nbytes=16)
+            for c in cores:
+                assert c.wait(10.0) is not None
+        time.sleep(0.35)  # past the ring's stamp period: span accrues
+        w = cores[0].metrics_window(60.0)
+        assert w["version"] == 1
+        assert w["span_us"] > 0
+        assert w["cycle_rate"] > 0
+        assert w["bytes_reduced_rate"] >= 0
+        assert 0.0 <= w["bypass_fraction"] <= 1.0
+        assert w["reconnect_rate"] == 0.0  # loopback never reconnects
+        # a tiny window still differentiates against the nearest sample
+        assert cores[1].metrics_window(0.001)["span_us"] > 0
+    finally:
+        for c in cores:
+            c.shutdown()
+        for c in cores:
+            c.close()
+        hub.close()
+
+
+def test_import_window_rates_sets_the_gauges():
+    M.import_window_rates({"span_us": 1000000, "cycle_rate": 123.0,
+                           "bytes_reduced_rate": 456.0,
+                           "reconnect_rate": 6.0,
+                           "bypass_fraction": 0.75})
+    assert M.CONTROLLER_CYCLE_RATE.value() == 123.0
+    assert M.CONTROLLER_BYTES_REDUCED_RATE.value() == 456.0
+    assert M.TRANSPORT_RECONNECTS_RATE.value() == 6.0
+    assert M.CONTROLLER_BYPASS_FRACTION.value() == 0.75
+
+
+# -------------------------------------------------------------- sentinel
+class _FakeCore:
+    def __init__(self):
+        self.dumps = []
+
+    def flight_dump(self, path, reason=""):
+        self.dumps.append((path, reason))
+        with open(path, "w") as f:
+            f.write(f"hvd_flight_v1\nreason explicit:{reason}\nrank 0\n"
+                    "[end]\n")
+        return True
+
+
+@pytest.fixture
+def fresh_sentinel():
+    sentinel.reset()
+    yield
+    sentinel.reset()
+
+
+def test_sentinel_stats_trace_time(fresh_sentinel):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def stats_of(x):
+        loss = jnp.sum(x ** 2)
+        grads = jax.grad(lambda x: jnp.sum(x ** 2))(x)
+        return sentinel.sentinel_stats(loss, grads)
+
+    s = stats_of(jnp.ones((4,)))
+    assert float(s["nonfinite"]) == 0.0
+    assert math.isclose(float(s["grad_norm"]), 4.0)  # |2*ones(4)| = 4
+    s = stats_of(jnp.array([1.0, float("nan"), 1.0, 1.0]))
+    # the nan element's gradient (2x) plus the nan loss are both counted
+    assert float(s["nonfinite"]) == 2.0
+    assert not math.isfinite(float(s["loss"]))
+
+
+def test_sentinel_stats_psum_identical_across_ranks(fresh_sentinel):
+    """The SPMD claim: with an axis_name the verdict is psum'd, so every
+    rank computes the identical scalars."""
+    import jax
+    import jax.numpy as jnp
+    n = 2
+
+    def step(x):
+        loss = jnp.sum(x ** 2)
+        grads = jax.grad(lambda x: jnp.sum(x ** 2))(x)
+        return sentinel.sentinel_stats(loss, grads, axis_name="i")
+
+    xs = jnp.stack([jnp.ones((4,)),
+                    jnp.array([1.0, float("inf"), 1.0, 1.0])])
+    out = jax.pmap(step, axis_name="i")(xs)
+    for key in ("loss", "grad_norm", "nonfinite"):
+        vals = [float(v) for v in out[key]]
+        assert vals[0] == vals[1], (key, vals)  # SPMD-identical
+    assert float(out["nonfinite"][0]) > 0  # one rank's inf is seen by all
+
+
+def test_sentinel_record_nonfinite_dumps_and_alerts(
+        fresh_sentinel, tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_FLIGHT_RECORD",
+                       str(tmp_path / "flight.rank.0"))
+    core = _FakeCore()
+    before = M.SENTINEL_NONFINITE.value()
+    row = sentinel.record({"loss": float("nan"), "grad_norm": 1.0,
+                           "nonfinite": 3.0}, step=7, core=core)
+    assert row["step"] == 7
+    assert M.SENTINEL_NONFINITE.value() == before + 1
+    assert M.SENTINEL_LAST_NONFINITE_STEP.value() == 7
+    # one verdict per step, however many records land on it
+    sentinel.record({"loss": float("nan"), "grad_norm": 1.0,
+                     "nonfinite": 3.0}, step=7, core=core)
+    assert M.SENTINEL_NONFINITE.value() == before + 1
+    assert len(core.dumps) == 1
+    path, reason = core.dumps[0]
+    assert path.endswith(".nan") and "nan" in reason and "7" in reason
+    from horovod_tpu.postmortem import parse_flight_record
+    assert "nan" in parse_flight_record(path)["reason"]
+
+
+def test_sentinel_ema_and_divergence(fresh_sentinel):
+    for i in range(20):
+        row = sentinel.record({"loss": 1.0, "grad_norm": 1.0,
+                               "nonfinite": 0.0})
+    assert math.isclose(row["ema"], 1.0)
+    row = sentinel.record({"loss": 5.0, "grad_norm": 1.0,
+                           "nonfinite": 0.0})
+    assert row["divergence"] > 1.0
+    assert M.SENTINEL_LOSS_DIVERGENCE.value() > 1.0
+    assert M.SENTINEL_LOSS.value() == 5.0
+
+
+def test_sentinel_interval_gates_gauges_not_nonfinite(
+        fresh_sentinel, monkeypatch):
+    monkeypatch.setenv("HOROVOD_SENTINEL_INTERVAL", "5")
+    sentinel.record({"loss": 2.0, "grad_norm": 1.0, "nonfinite": 0.0})
+    loss_after_first = M.SENTINEL_LOSS.value()
+    sentinel.record({"loss": 9.0, "grad_norm": 1.0, "nonfinite": 0.0})
+    assert M.SENTINEL_LOSS.value() == loss_after_first  # gated
+    before = M.SENTINEL_NONFINITE.value()
+    sentinel.record({"loss": float("nan"), "grad_norm": 1.0,
+                     "nonfinite": 1.0})
+    assert M.SENTINEL_NONFINITE.value() == before + 1  # never gated
+
+
+def test_sentinel_wrap_is_dropin_and_kill_switch(
+        fresh_sentinel, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    def step(x):
+        loss = jnp.sum(x ** 2)
+        grads = jax.grad(lambda x: jnp.sum(x ** 2))(x)
+        return loss, grads
+
+    monkeypatch.setenv("HOROVOD_SENTINEL", "0")
+    assert sentinel.wrap(step) is step  # kill switch: untouched
+    monkeypatch.setenv("HOROVOD_SENTINEL", "1")
+    wrapped = sentinel.wrap(jax.jit(step))
+    before = M.SENTINEL_NONFINITE.value()
+    for i in range(4):
+        x = jnp.full((4,), float("nan") if i == 2 else 1.0)
+        loss, grads = wrapped(x)  # outputs unchanged
+    jax.effects_barrier()
+    assert M.SENTINEL_NONFINITE.value() == before + 1
+    assert M.SENTINEL_LAST_NONFINITE_STEP.value() == 2
+
+
+# --------------------------------------------------------- knob validation
+def test_validate_watch_knobs_matrix(tmp_path):
+    validate_watch_knobs({"HOROVOD_SERIES_RETENTION": 600.0,
+                          "HOROVOD_SERIES_RESOLUTION": 5.0,
+                          "HOROVOD_SENTINEL_INTERVAL": 1,
+                          "HOROVOD_ALERTS": ""})
+    with pytest.raises(ValueError, match="RETENTION"):
+        validate_watch_knobs({"HOROVOD_SERIES_RETENTION": 0.0})
+    with pytest.raises(ValueError, match="RESOLUTION"):
+        validate_watch_knobs({"HOROVOD_SERIES_RESOLUTION": -1.0})
+    with pytest.raises(ValueError, match="RESOLUTION"):
+        validate_watch_knobs({"HOROVOD_SERIES_RETENTION": 10.0,
+                              "HOROVOD_SERIES_RESOLUTION": 60.0})
+    with pytest.raises(ValueError, match="SENTINEL_INTERVAL"):
+        validate_watch_knobs({"HOROVOD_SENTINEL_INTERVAL": 0})
+    with pytest.raises(ValueError, match="unreadable"):
+        validate_watch_knobs({"HOROVOD_ALERTS": str(tmp_path / "no.yaml")})
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("rules:\n  - name: a\n    family: f\n    kind: nope\n")
+    with pytest.raises(ValueError, match="invalid"):
+        validate_watch_knobs({"HOROVOD_ALERTS": str(bad)})
+    good = tmp_path / "good.yaml"
+    good.write_text("rules:\n  - name: a\n    family: f\n"
+                    "    kind: threshold\n    value: 1\n")
+    validate_watch_knobs({"HOROVOD_ALERTS": str(good)})
+    assert load_rules(str(good))[0].name == "a"
+
+
+# ------------------------------------------------------ /series + /alerts
+def _get_json(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _put(port, scope, key, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/{scope}/{key}", data=body,
+        method="PUT")
+    urllib.request.urlopen(req, timeout=10).close()
+
+
+def test_series_and_alerts_routes_end_to_end(monkeypatch):
+    """A real RendezvousServer: metric PUTs feed the series store, a
+    user rule merged over the defaults fires at GET /alerts, the merged
+    ruleset is published at KV scope alerts/rules, and the firing
+    transition lands as a timeline instant on the suspect rank's lane
+    in the merged GET /timeline."""
+    monkeypatch.setenv("HOROVOD_SERIES_RESOLUTION", "0.01")
+    from horovod_tpu.runner.http_server import RendezvousServer
+    srv = RendezvousServer(host="127.0.0.1")
+    port = srv.start()
+    try:
+        srv.install_alert_rules(parse_rules([
+            {"name": "queue-deep", "family": "hvd_serve_queue_depth",
+             "kind": "threshold", "value": 10,
+             "severity": "critical"}]))
+        snap = {"rank": 1, "families": {"hvd_serve_queue_depth": {
+            "kind": "gauge", "help": "h",
+            "samples": [{"labels": {}, "value": 42}]}}}
+        _put(port, "metrics", "rank.1", json.dumps(snap).encode())
+        time.sleep(0.05)
+        _put(port, "metrics", "rank.1", json.dumps(snap).encode())
+        deadline = time.time() + 5
+        while True:  # ingest runs after the PUT response: poll
+            alerts = _get_json(port, "/alerts")
+            if alerts["firing"] or time.time() > deadline:
+                break
+            time.sleep(0.02)
+        firing = {(f["rule"], f["rank"], f["severity"])
+                  for f in alerts["firing"]}
+        assert ("queue-deep", 1, "critical") in firing
+        assert "queue-deep" in alerts["user_rules"]
+        assert len(alerts["rules"]) == len(DEFAULT_RULES) + 1
+        # the series route serves the retained history, filtered
+        series = _get_json(port, "/series?family=hvd_serve_queue_depth")
+        assert series["series"][0]["rank"] == 1
+        assert {p[1] for p in series["series"][0]["points"]} == {42.0}
+        assert _get_json(port, "/series?rank=7")["series"] == []
+        # merged ruleset published for cross-checking (chaos contract)
+        kv_rules = _get_json(port, "/alerts/rules")
+        assert {r["name"] for r in kv_rules["rules"]} >= \
+            {"queue-deep", "straggler-suspect"}
+        # the firing transition is an instant on rank 1's timeline lane
+        merged = _get_json(port, "/timeline")
+        alert_evs = [e for e in merged["traceEvents"]
+                     if e.get("name") == "alert.queue-deep"]
+        assert alert_evs and alert_evs[0]["pid"] == 1
+        assert alert_evs[0]["args"]["severity"] == "critical"
+        # heartbeats feed the absence series (ingest runs after the
+        # HTTP response is already on the wire: poll briefly)
+        _put(port, "health", "rank.1", json.dumps({"rank": 1}).encode())
+        deadline = time.time() + 5
+        while srv.watch_state.store.latest(1, HEARTBEAT_FAMILY) is None:
+            assert time.time() < deadline, "heartbeat never ingested"
+            time.sleep(0.01)
+    finally:
+        srv.stop()
+
+
+# -------------------------------------------------------- doctor --watch
+_GOLDEN_VIEW = {
+    "alerts": {
+        "now": 1000.0,
+        "firing": [
+            {"rule": "sentinel-nonfinite", "severity": "critical",
+             "kind": "rate_of_change",
+             "family": "hvd_sentinel_nonfinite_total", "rank": 1,
+             "since": 990.0, "value": 0.2,
+             "context": {"hvd_sentinel_last_nonfinite_step": 7.0}},
+            {"rule": "straggler-suspect", "severity": "warning",
+             "kind": "threshold", "family": "hvd_straggler_skew",
+             "rank": 1, "since": 995.0, "value": 5.25},
+        ],
+        "rules": [{"name": f"r{i}"} for i in range(9)],
+        "user_rules": ["r8"],
+        "fired_total": [{"rule": "sentinel-nonfinite",
+                         "severity": "critical", "count": 1},
+                        {"rule": "straggler-suspect",
+                         "severity": "warning", "count": 3}],
+    },
+    "series": {
+        "now": 1000.0,
+        "series": [
+            {"rank": 1, "family": "hvd_straggler_skew",
+             "points": [[996.0, 1.0], [998.0, 3.0], [1000.0, 5.25]]},
+            {"rank": 0, "family": "hvd_controller_cycle_rate",
+             "points": [[998.0, 100.0], [1000.0, 100.0]]},
+            {"rank": 0, "family": "hvd_unrelated",
+             "points": [[1000.0, 1.0]]},
+        ],
+    },
+}
+
+
+def test_doctor_watch_golden():
+    from horovod_tpu.runner.doctor import render_watch
+    out = render_watch(_GOLDEN_VIEW)
+    lines = out.splitlines()
+    assert lines[0] == \
+        "== hvdrun doctor --watch: fleet alerts + series =="
+    assert lines[1] == "FIRING (2):"
+    # critical first, context riding the line
+    assert "sentinel-nonfinite" in lines[2] and "critical" in lines[2]
+    assert "[hvd_sentinel_last_nonfinite_step=7]" in lines[2]
+    assert "straggler-suspect" in lines[3] and "warning" in lines[3]
+    assert "rules: 9 active (8 default + 1 user), 2 firing, " \
+        "4 fired lifetime" in out
+    # hot series render with sparklines; unrelated families do not
+    assert "hvd_straggler_skew" in out
+    assert "hvd_controller_cycle_rate" in out
+    assert "hvd_unrelated" not in out
+    spark_line = next(ln for ln in lines
+                      if ln.strip().startswith("hvd_straggler_skew"))
+    assert "▁" in spark_line and "█" in spark_line
+    assert spark_line.rstrip().endswith("5.25")
+
+
+def test_doctor_watch_cli_once(tmp_path, capsys):
+    from horovod_tpu.runner.doctor import main as doctor_main
+    path = tmp_path / "watch.json"
+    path.write_text(json.dumps(_GOLDEN_VIEW))
+    assert doctor_main(["--watch", str(path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "FIRING (2):" in out
+    assert doctor_main(["--watch", str(tmp_path / "nope.json"),
+                        "--once"]) == 2
+
+
+def test_doctor_serve_renders_alerts_row():
+    from horovod_tpu.runner.doctor import render_serve
+    view = {"router": {"pending": 0}, "journal": {"enabled": True},
+            "alerts": {"firing": 2, "critical": 1,
+                       "rules": ["sentinel-nonfinite"]}}
+    out = render_serve(view)
+    assert "ALERTS: 2 firing (1 critical): sentinel-nonfinite" in out
+    view["alerts"] = {"firing": 0, "critical": 0, "rules": []}
+    assert "ALERTS: none firing" in render_serve(view)
+
+
+# ------------------------------------------------------ bench fired_alerts
+def test_bench_metrics_summary_fired_alerts_contract(hvd):
+    """Satellite contract: every bench artifact's metrics summary
+    carries the fired_alerts section (rule, severity, count)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    M.ALERTS_TOTAL.set_total(3, rule="straggler-suspect",
+                             severity="warning")
+    s = bench.metrics_summary()
+    assert "error" not in s, s
+    assert {"rule": "straggler-suspect", "severity": "warning",
+            "count": 3} in s["fired_alerts"]
+    for row in s["fired_alerts"]:
+        assert set(row) == {"rule", "severity", "count"}
+    json.dumps(s)
