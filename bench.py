@@ -1307,9 +1307,12 @@ def zero_bench(args) -> int:
     backward_passes_per_step=2, and at levels 1/2/3 on llama-tiny.  Per
     level the artifact records the ANALYTICAL per-rank peak
     {params, grads, opt-state, total} bytes
-    (perf/costmodel.zero_memory_bytes), the modeled exposed_comm_bytes,
-    the measured step_time and the ledger's model-drift ratio (the
-    prediction confronted with the wall clock).  Level 1/2/3 bit-near
+    (perf/costmodel.zero_memory_bytes) beside the MEASURED peak from
+    the memory plane (``measured_peak_bytes`` + ``mem_drift_ratio``,
+    perf/memstats.py — on the CPU-virtual harness the live-buffer
+    aggregate, labeled by ``measured_source``), the modeled
+    exposed_comm_bytes, the measured step_time and the ledger's
+    model-drift ratio (the prediction confronted with the wall clock).  Level 1/2/3 bit-near
     parameter equivalence is asserted before anything is printed; on
     the CPU-virtual harness wall-clock parity is expected (no
     latency-hiding scheduler, loopback fabric) and the row is labeled
@@ -1324,6 +1327,7 @@ def zero_bench(args) -> int:
     from horovod_tpu.parallel.data_parallel import (make_train_step,
                                                     replicate, shard_batch)
     from horovod_tpu.perf import costmodel as cm
+    from horovod_tpu.perf import memstats
     from horovod_tpu.utils import metrics as M
 
     _init_with_retry(hvd, expect_tpu=not args.cpu)
@@ -1381,6 +1385,7 @@ def zero_bench(args) -> int:
             batch = kbatch
         comm = cm.zero_comm_bytes(n_params, n, level, k=k)
         perf.reset()
+        memstats.reset()  # per-level measured peak, not the sweep's max
         perf.configure(comm_bytes_per_step=comm["total_bytes"],
                        zero_model={"n_params": n_params, "world": n,
                                    "level": level, "k": k,
@@ -1394,16 +1399,20 @@ def zero_bench(args) -> int:
                 jax.block_until_ready(loss)
         dt = (time.perf_counter() - t0) / timed_steps
         rep = hvd.perf_report()
+        # The measured side of the analytical peak_bytes column
+        # (perf/memstats.py; docs/memory.md): live-buffer residency
+        # after the level's steps, reconciled against zero_memory_bytes.
+        mrow = memstats.sample(force=True) or {}
         if level == 3:
             p = Z.gather_zero3_params(p, params, mesh,
                                       fusion_threshold_bytes=thresh)
-        return dt, p, float(loss), comm, rep
+        return dt, p, float(loss), comm, rep, mrow
 
     toy = {}
     finals = {}
     try:
         for level in (0, 1, 2, 3):
-            dt, p, loss, comm, rep = run_toy_level(level)
+            dt, p, loss, comm, rep, mrow = run_toy_level(level)
             finals[level] = p
             mem = cm.zero_memory_bytes(level, n_params, n,
                                        opt_slots=opt_slots)
@@ -1411,6 +1420,9 @@ def zero_bench(args) -> int:
                 "step_time_s": round(dt, 6),
                 "exposed_comm_bytes": int(comm["total_bytes"]),
                 "peak_bytes": mem,
+                "measured_peak_bytes": mrow.get("peak_bytes_in_use"),
+                "measured_source": mrow.get("source"),
+                "mem_drift_ratio": mrow.get("model_drift_ratio"),
                 "loss": round(loss, 6),
                 "model_drift_ratio": rep.get("model_drift_ratio"),
             }
@@ -1450,6 +1462,7 @@ def zero_bench(args) -> int:
     lids = shard_batch(jnp.asarray(ids), mesh)
 
     def run_llama_level(level):
+        import horovod_tpu.perf as perf
         opt = optax.adamw(3e-4, weight_decay=0.01)
         step = Z.make_zero_train_step(
             lambda p, b: llama_mod.loss_fn(p, b, cfg),
@@ -1461,6 +1474,11 @@ def zero_bench(args) -> int:
         p = (Z.shard_zero3_params(replicate(lparams, mesh), mesh,
                                   fusion_threshold_bytes=lthresh)
              if level == 3 else replicate(lparams, mesh))
+        perf.reset()
+        memstats.reset()
+        perf.configure(zero_model={"n_params": ln_params, "world": n,
+                                   "level": level,
+                                   "opt_slots": opt_slots})
         p, s, loss = step(p, s, lids)
         jax.block_until_ready(loss)
         t0 = time.perf_counter()
@@ -1468,16 +1486,17 @@ def zero_bench(args) -> int:
             p, s, loss = step(p, s, lids)
         jax.block_until_ready(loss)
         dt = (time.perf_counter() - t0) / lsteps
+        mrow = memstats.sample(force=True) or {}
         if level == 3:
             p = Z.gather_zero3_params(p, lparams, mesh,
                                       fusion_threshold_bytes=lthresh)
-        return dt, p, float(loss)
+        return dt, p, float(loss), mrow
 
     llama_rows = {}
     lfinals = {}
     try:
         for level in (1, 2, 3):
-            dt, p, loss = run_llama_level(level)
+            dt, p, loss, mrow = run_llama_level(level)
             lfinals[level] = p
             mem = cm.zero_memory_bytes(level, ln_params, n,
                                        opt_slots=opt_slots)
@@ -1487,6 +1506,9 @@ def zero_bench(args) -> int:
                 "exposed_comm_bytes": int(cm.zero_comm_bytes(
                     ln_params, n, level)["total_bytes"]),
                 "peak_bytes": mem,
+                "measured_peak_bytes": mrow.get("peak_bytes_in_use"),
+                "measured_source": mrow.get("source"),
+                "mem_drift_ratio": mrow.get("model_drift_ratio"),
                 "loss": round(loss, 6),
             }
         for level in (2, 3):

@@ -405,6 +405,42 @@ int hvd_core_health(void* h, char* buf, int buflen) {
   return n;
 }
 
+// ------------------------------------------------------------------- memory
+// Native-core memory footprint (memory plane, docs/memory.md): a
+// versioned text block in the hvd_core_health mold —
+//   hvd_mem_v1
+//   <key> <value>               (one line per field)
+// RSS and the response-cache bytes are stamped by the cycle loop
+// (Core::StampWindow) so this read is lock-free; new keys APPEND and
+// parsers key on names — the versioning contract.  Returns the full
+// length required; truncation semantics match hvd_core_metrics
+// (always NUL-terminated, caller retries bigger).
+int hvd_core_mem(void* h, char* buf, int buflen) {
+  Core* core = static_cast<ApiHandle*>(h)->core;
+  Core::MemSnapshot ms = core->mem_snapshot();
+  std::string t = "hvd_mem_v1\n";
+  auto kv = [&t](const char* k, long long v) {
+    t += k;
+    t += ' ';
+    t += std::to_string(v);
+    t += '\n';
+  };
+  kv("rss_bytes", static_cast<long long>(ms.rss_bytes));
+  kv("peak_rss_bytes", static_cast<long long>(ms.peak_rss_bytes));
+  kv("trace_ring_bytes", static_cast<long long>(ms.trace_ring_bytes));
+  kv("window_ring_bytes", static_cast<long long>(ms.window_ring_bytes));
+  kv("response_cache_bytes",
+     static_cast<long long>(ms.response_cache_bytes));
+  kv("stamps", static_cast<long long>(ms.stamps));
+  int n = static_cast<int>(t.size());
+  if (buf && buflen > 0) {
+    int copy = n < buflen - 1 ? n : buflen - 1;
+    memcpy(buf, t.data(), copy);
+    buf[copy] = '\0';
+  }
+  return n;
+}
+
 // Arm the crash-time flight recorder: fatal signals / std::terminate
 // dump this core's flight record to `path` (postmortem.cc).  Implies
 // trace-ring recording so the record's span tail is populated.

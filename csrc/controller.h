@@ -253,6 +253,19 @@ class Controller {
   // Tracing-plane hook (trace.h): cycle-phase spans land here when set.
   void set_trace(TraceRing* t) { trace_ = t; }
 
+  // Memory plane (hvd_core_mem): approximate heap bytes held by the
+  // replicated response cache — slot structs plus the name/sig strings
+  // they own.  replica_ is cycle-thread-owned (mutated only inside
+  // RunCycle's broadcast apply), so this MUST only be called from the
+  // cycle loop (Core::StampWindow), which publishes the value through
+  // an atomic for lock-free readers.
+  int64_t ApproxCacheBytes() const {
+    int64_t b = static_cast<int64_t>(replica_.capacity() * sizeof(CacheSlot));
+    for (const CacheSlot& s : replica_)
+      b += static_cast<int64_t>(s.name.size() + s.sig.size());
+    return b;
+  }
+
  private:
   // --- rank-0 state ---
   struct Entry {

@@ -1,10 +1,40 @@
 #include "core.h"
 
+#include <sys/resource.h>
+#include <unistd.h>
+
 #include <chrono>
+#include <cstdio>
 
 namespace hvdtpu {
 
 namespace {
+
+// Current resident set in bytes from /proc/self/statm (field 2 is
+// resident pages).  Returns 0 where procfs is unavailable — the memory
+// plane reports what it can measure, never guesses.
+uint64_t ReadRssBytes() {
+  FILE* f = std::fopen("/proc/self/statm", "r");
+  if (!f) return 0;
+  unsigned long long size = 0, resident = 0;
+  int got = std::fscanf(f, "%llu %llu", &size, &resident);
+  std::fclose(f);
+  if (got != 2) return 0;
+  long page = sysconf(_SC_PAGESIZE);
+  return resident * static_cast<uint64_t>(page > 0 ? page : 4096);
+}
+
+// Lifetime peak RSS in bytes.  ru_maxrss is KB on Linux, bytes on
+// Darwin (the only two platforms this builds on).
+uint64_t ReadPeakRssBytes() {
+  struct rusage ru;
+  if (getrusage(RUSAGE_SELF, &ru) != 0) return 0;
+#ifdef __APPLE__
+  return static_cast<uint64_t>(ru.ru_maxrss);
+#else
+  return static_cast<uint64_t>(ru.ru_maxrss) * 1024ull;
+#endif
+}
 
 // Collapse auto-generated per-call names to their prefix — the same rule
 // as the timeline's collapse_name (utils/timeline.py): unbounded
@@ -123,6 +153,34 @@ void Core::StampWindow() {
   s.bytes_reduced = cs.bytes_reduced;
   s.transport_reconnects = ts.reconnects;
   window_.Push(s);
+  // Memory plane: refresh the mem atomics on the same DuePush cadence.
+  // This runs on the cycle thread, the one place ApproxCacheBytes may
+  // be called (replica_ is cycle-thread-owned); readers see the values
+  // lock-free through mem_snapshot().
+  mem_rss_bytes_.store(ReadRssBytes(), std::memory_order_relaxed);
+  mem_peak_rss_bytes_.store(ReadPeakRssBytes(), std::memory_order_relaxed);
+  mem_cache_bytes_.store(
+      static_cast<uint64_t>(controller_->ApproxCacheBytes()),
+      std::memory_order_relaxed);
+  mem_stamps_.fetch_add(1, std::memory_order_relaxed);
+}
+
+Core::MemSnapshot Core::mem_snapshot() const {
+  MemSnapshot m;
+  m.rss_bytes = mem_rss_bytes_.load(std::memory_order_relaxed);
+  m.peak_rss_bytes = mem_peak_rss_bytes_.load(std::memory_order_relaxed);
+  m.trace_ring_bytes = trace_.CapacityBytes();
+  m.window_ring_bytes = sizeof(MetricsWindowRing);
+  m.response_cache_bytes = mem_cache_bytes_.load(std::memory_order_relaxed);
+  m.stamps = mem_stamps_.load(std::memory_order_relaxed);
+  // Before the first cycle-loop stamp the atomics are empty; answer
+  // with a direct (still signal-safe) read so an early caller never
+  // sees a zero RSS on a live process.
+  if (m.stamps == 0) {
+    m.rss_bytes = ReadRssBytes();
+    m.peak_rss_bytes = ReadPeakRssBytes();
+  }
+  return m;
 }
 
 Core::WindowRates Core::metrics_window(double window_s) const {

@@ -105,6 +105,21 @@ class Core {
   };
   HealthSnapshot health_snapshot() const;
 
+  // Memory plane (hvd_core_mem, docs/memory.md): the native core's own
+  // footprint.  RSS and the response-cache bytes are stamped by the
+  // cycle loop (StampWindow, kMinPeriodUs cadence) into atomics, the
+  // ring sizes are construction-time constants — so the snapshot is
+  // lock-free and safe beside a wedged cycle loop, like HealthSnapshot.
+  struct MemSnapshot {
+    uint64_t rss_bytes = 0;            // process resident set (statm)
+    uint64_t peak_rss_bytes = 0;       // getrusage ru_maxrss
+    uint64_t trace_ring_bytes = 0;     // TraceRing capacity * event size
+    uint64_t window_ring_bytes = 0;    // MetricsWindowRing footprint
+    uint64_t response_cache_bytes = 0; // replicated cache heap (approx)
+    uint64_t stamps = 0;               // cycle-loop refreshes so far
+  };
+  MemSnapshot mem_snapshot() const;
+
   // Perf-attribution plane (docs/profiling.md): per-op-name
   // enqueue->done aggregates, keyed by the collapsed tensor name so the
   // controller path's cycle cost attributes to the ops that caused it.
@@ -191,6 +206,12 @@ class Core {
   std::atomic<uint64_t> last_progress_us_{0};
   std::atomic<int64_t> inflight_count_{0};
   std::atomic<int64_t> responses_pending_{0};
+  // Memory-plane atomics (mem_snapshot): refreshed by the cycle loop in
+  // StampWindow, read lock-free from hvd_core_mem on any thread.
+  std::atomic<uint64_t> mem_rss_bytes_{0};
+  std::atomic<uint64_t> mem_peak_rss_bytes_{0};
+  std::atomic<uint64_t> mem_cache_bytes_{0};
+  std::atomic<uint64_t> mem_stamps_{0};
   std::thread thread_;
 };
 

@@ -53,6 +53,11 @@ class TraceRing {
   void Enable() { enabled_.store(true, std::memory_order_release); }
   bool enabled() const { return enabled_.load(std::memory_order_acquire); }
 
+  // Ring footprint for the memory plane (hvd_core_mem): the buffer is
+  // sized once at construction and never resized, so this is safe to
+  // read lock-free from any thread.
+  size_t CapacityBytes() const { return buf_.size() * sizeof(Event); }
+
   uint64_t NowUs() const {
     return static_cast<uint64_t>(
         std::chrono::duration_cast<std::chrono::microseconds>(

@@ -174,6 +174,13 @@ def load_library() -> ctypes.CDLL:
         # postmortem plane (csrc/postmortem.{h,cc}; docs/postmortem.md)
         lib.hvd_core_health.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                         ctypes.c_int]
+        # memory plane (hvd_core_mem; docs/memory.md)
+        try:
+            lib.hvd_core_mem.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_int]
+        except AttributeError:
+            pass  # pre-memory-plane library (HOROVOD_NATIVE_LIB
+            # override): mem() raises, the native leg degrades to absent
         lib.hvd_core_flight_enable.argtypes = [ctypes.c_void_p,
                                                ctypes.c_char_p]
         lib.hvd_core_flight_dump.argtypes = [ctypes.c_void_p,
@@ -717,6 +724,36 @@ class CoordinationCore:
             raise RuntimeError(f"unrecognized native health header: "
                                f"{lines[:1]!r}")
         out = {"version": int(lines[0].split("hvd_health_v", 1)[1])}
+        for line in lines[1:]:
+            parts = line.split()
+            if len(parts) == 2:
+                try:
+                    out[parts[0]] = int(parts[1])
+                except ValueError:
+                    continue
+        return out
+
+    def mem(self) -> dict:
+        """Native-core memory footprint (csrc/c_api.cc ``hvd_core_mem``):
+        name-keyed integer fields — ``rss_bytes``, ``peak_rss_bytes``,
+        ``trace_ring_bytes``, ``window_ring_bytes``,
+        ``response_cache_bytes``, ``stamps`` (cycle-loop refreshes).
+        Stamped by the cycle loop beside hvd_core_metrics and read
+        lock-free.  Raises AttributeError on a pre-memory-plane library
+        (HOROVOD_NATIVE_LIB override) — callers treat that as the leg
+        being absent.  Unknown lines from a newer library are ignored
+        (hvd_core_metrics contract)."""
+        buf = self._buf_for()
+        n = self._lib.hvd_core_mem(self._h, buf, len(buf))
+        if n >= len(buf):
+            self._grow(n)
+            buf = self._buf_for()
+            n = self._lib.hvd_core_mem(self._h, buf, len(buf))
+        lines = buf.value.decode().splitlines()
+        if not lines or not lines[0].startswith("hvd_mem_v"):
+            raise RuntimeError(f"unrecognized native mem header: "
+                               f"{lines[:1]!r}")
+        out = {"version": int(lines[0].split("hvd_mem_v", 1)[1])}
         for line in lines[1:]:
             parts = line.split()
             if len(parts) == 2:

@@ -271,6 +271,28 @@ _knob("HOROVOD_PERF_LINK", "auto", str,
       "'ici', 'dcn', 'loopback', or 'auto' (by mesh topology: a dcn.* "
       "axis -> dcn, a real TPU mesh -> ici, CPU-virtual -> loopback).  "
       "Unknown names fail at hvd.init().")
+# --- memory plane (TPU-native; docs/memory.md — the reference has no
+#     memory story: an OOM there dies as an unclassified SIGKILL) ---
+_knob("HOROVOD_MEM", True, _parse_bool,
+      "Memory-plane kill switch (horovod_tpu/perf/memstats.py): with it "
+      "on, each metrics snapshot samples device.memory_stats() (CPU "
+      "fallback: jax.live_buffers() + /proc RSS), attributes bytes to "
+      "planes, updates the hvd_mem_* families, reconciles against the "
+      "zero_memory_bytes prediction, and arms the OOM-proximity "
+      "sentinel.  0 = no sampling, no mem section in perf reports.")
+_knob("HOROVOD_MEM_INTERVAL", 0.0, float,
+      "Minimum seconds between memory samples (memstats.MemSampler): 0 "
+      "samples on every metrics snapshot (the HOROVOD_METRICS_INTERVAL "
+      "cadence); a positive value rate-limits the live_buffers walk on "
+      "hosts where it is expensive.  Must be >= 0; rejected at "
+      "hvd.init() otherwise.")
+_knob("HOROVOD_MEM_HIGH_WATERMARK", 0.9, float,
+      "OOM-proximity threshold as a fraction of the device memory cap "
+      "(docs/memory.md#oom): crossing it fires the mem sentinel once "
+      "per transition — alert + timeline instant + flight dump reason "
+      "'mem' — and stamps the watermark the postmortem oom classifier "
+      "reads from the final heartbeat.  Must be in (0, 1]; rejected at "
+      "hvd.init() otherwise.")
 # --- watch plane (TPU-native; docs/watch.md — the reference's analog is
 #     reading the timeline by hand AFTER a run went bad) ---
 _knob("HOROVOD_SERIES_RETENTION", 600.0, float,

@@ -27,6 +27,8 @@ from __future__ import annotations
 from .ledger import (GLOBAL, PerfLedger, PerfPublisher, add_input_wait,
                      configure, merge_perf_reports, native_op_stats,
                      record_step, report, reset, timed_step)
+from . import memstats  # noqa: F401  (hvd.perf.memstats rides this)
+from .memstats import MemSampler, validate_mem_knobs
 
 # perf_report is the hvd-level spelling (hvd.perf_report()); report the
 # module-level one (hvd.perf.report()).
@@ -75,8 +77,9 @@ def resolve_link(knobs, mesh=None) -> str:
 
 
 __all__ = [
-    "GLOBAL", "PerfLedger", "PerfPublisher", "add_input_wait",
-    "configure", "configure_from_overlap_gauges", "merge_perf_reports",
-    "native_op_stats", "perf_report", "record_step", "report", "reset",
-    "resolve_link", "timed_step", "validate_perf_knobs",
+    "GLOBAL", "MemSampler", "PerfLedger", "PerfPublisher",
+    "add_input_wait", "configure", "configure_from_overlap_gauges",
+    "memstats", "merge_perf_reports", "native_op_stats", "perf_report",
+    "record_step", "report", "reset", "resolve_link", "timed_step",
+    "validate_mem_knobs", "validate_perf_knobs",
 ]

@@ -110,6 +110,13 @@ class PerfLedger:
                         f"{', '.join(LINK_CLASSES)}")
                 self._link = str(link)
 
+    def zero_model(self) -> Optional[Dict[str, Any]]:
+        """The configured weight-update sharding workload (or None) —
+        the geometry the memory plane's attribution and reconciliation
+        price (perf/memstats.py)."""
+        with self._lock:
+            return dict(self._zero) if self._zero else None
+
     def configure_from_overlap_gauges(self) -> bool:
         """Adopt the overlap plane's trace-time byte model (the
         ``hvd_overlap_*`` gauges, ops/overlap.py) as this ledger's comm
@@ -280,6 +287,17 @@ class PerfLedger:
         ops = native_op_stats()
         if ops:
             report["native_ops"] = ops
+        # Memory plane (perf/memstats.py; docs/memory.md): the measured
+        # residency beside the zero_memory_bytes prediction — absent
+        # until the sampler has run (HOROVOD_MEM off, or no snapshot
+        # yet), so old readers see the exact pre-memory payload.
+        try:
+            from . import memstats
+            mem = memstats.report_section()
+            if mem is not None:
+                report["memory"] = mem
+        except Exception:
+            pass  # the memory leg must never break the perf report
         return report
 
 
@@ -473,5 +491,29 @@ def merge_perf_reports(stored: Dict[str, bytes],
                     {k: (v / total if total else 0.0)
                      for k, v in agg.items()})
                 fleet["decomposition"] = {k: v / n for k, v in agg.items()}
+    # Fleet memory rollup (docs/memory.md): worst watermark + smallest
+    # headroom across ranks — the rank closest to the cap paces when the
+    # fleet OOMs, the same way the slowest rank paces the step.
+    mem_rows = [(r, rep["memory"]) for r, rep in ranks.items()
+                if isinstance(rep.get("memory"), dict)]
+    if mem_rows:
+        worst_rank, worst = max(
+            mem_rows,
+            key=lambda rm: rm[1].get("measured", {}).get("watermark", 0.0)
+            or 0.0)
+        fleet["memory"] = {
+            "ranks": len(mem_rows),
+            "bytes_in_use_total": sum(
+                m.get("measured", {}).get("bytes_in_use", 0) or 0
+                for _, m in mem_rows),
+            "worst_watermark": {
+                "rank": worst_rank,
+                "watermark": worst.get("measured", {}).get("watermark"),
+                "headroom_bytes": worst.get("measured",
+                                            {}).get("headroom_bytes"),
+            },
+            "drift_ratio_by_rank": {
+                r: m.get("model_drift_ratio") for r, m in mem_rows},
+        }
     return {"version": REPORT_VERSION, "time": time.time(),
             "fleet": fleet, "ranks": ranks}

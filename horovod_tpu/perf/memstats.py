@@ -1,0 +1,477 @@
+"""Memory plane: the measured fleet memory ledger (docs/memory.md).
+
+Every memory number in the repo before this module was *predicted*
+(costmodel.zero_memory_bytes, bench --zero's analytical peak_bytes).
+This module closes the predict-vs-measure loop the way PR 14 did for
+comm bytes — ledger-proven:
+
+  * :class:`MemSampler` — the per-rank measured ledger.  Sources, in
+    preference order (docs/memory.md#sources):
+      1. ``device.memory_stats()`` — ``bytes_in_use`` /
+         ``peak_bytes_in_use`` / ``bytes_limit`` where the backend
+         provides them (TPU/GPU);
+      2. CPU-virtual fallback — the aggregate live-array size
+         (``jax.live_arrays()``; device leg) + ``/proc/self/status``
+         VmRSS (host leg), labeled ``source: live_buffers`` so no
+         reader mistakes it for a real device cap.
+    Bytes are attributed to planes from geometry the repo already
+    knows: params/grads/opt-state/EF-residual from the ZeRO level +
+    bucket plan (the ledger's configured zero model), the serve KV pool
+    from :class:`~horovod_tpu.serve.engine.BlockAllocator` occupancy
+    (``blocks x block_bytes``, used/free/shared split), the
+    fusion/overlap working set from threshold x depth, and the native
+    core's own footprint from the versioned ``hvd_core_mem`` C API
+    (TraceRing, MetricsWindowRing, response cache, peak RSS — stamped
+    by the cycle loop beside ``hvd_core_metrics``).
+  * **reconciliation** — ``hvd_mem_model_drift_ratio`` = measured
+    bytes-in-use over the ``zero_memory_bytes`` predicted total; the
+    section :func:`report_section` builds rides ``hvd.perf_report()``
+    and ``GET /perf`` and is rendered by ``hvdrun doctor --perf``.  The
+    ``headroom_bytes`` it carries is the cap-headroom input ROADMAP
+    item 2's layout solver consumes.
+  * **OOM-proximity sentinel** — crossing
+    ``HOROVOD_MEM_HIGH_WATERMARK`` fires ONCE per transition: the
+    ``hvd_mem_pressure_events_total`` counter (the committed
+    ``mem-pressure-high`` rule's context), a timeline instant, and an
+    explicit native flight dump reason ``mem`` (path suffix ``.mem``) —
+    the black box taken *before* the kernel's SIGKILL.
+
+Knobs: ``HOROVOD_MEM`` (kill switch), ``HOROVOD_MEM_INTERVAL``
+(sample rate limit), ``HOROVOD_MEM_HIGH_WATERMARK`` (the sentinel
+threshold, also stamped into heartbeats for the postmortem ``oom``
+classifier).  All init-validated (:func:`validate_mem_knobs`).
+
+Stdlib-only at module level (jax and the metrics registry import
+lazily), the utils/metrics.py discipline: sampling runs inside the
+metrics publisher's snapshot path and must never take the job down.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+# Plane keys of the geometry attribution, in render order.
+PLANES = ("params", "grads", "opt_state", "ef_residual", "kv_pool",
+          "fusion_overlap", "native_core")
+
+
+def _knob(name: str):
+    from ..common.knobs import current
+    return current(name)
+
+
+def enabled() -> bool:
+    return bool(_knob("HOROVOD_MEM"))
+
+
+def validate_mem_knobs(knobs) -> None:
+    """Init-time validation of the HOROVOD_MEM_* knob surface
+    (common/knobs.py contract: a bad value fails hvd.init, never the
+    sampler mid-run).  Consumed by runtime.Runtime."""
+    interval = float(knobs["HOROVOD_MEM_INTERVAL"])
+    if interval < 0:
+        raise ValueError(
+            f"HOROVOD_MEM_INTERVAL={interval} invalid; the memory "
+            "sampler rate limit must be >= 0 seconds (docs/memory.md)")
+    wm = float(knobs["HOROVOD_MEM_HIGH_WATERMARK"])
+    if not 0.0 < wm <= 1.0:
+        raise ValueError(
+            f"HOROVOD_MEM_HIGH_WATERMARK={wm} invalid; the OOM-"
+            "proximity threshold is a fraction of the device cap in "
+            "(0, 1] (docs/memory.md#oom)")
+
+
+# ------------------------------------------------------------ measurement
+def read_host_rss_bytes() -> int:
+    """Host resident set from /proc/self/status VmRSS (kB lines); 0
+    where procfs is unavailable — report what you measure, never guess.
+    """
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return 0
+
+
+def measure_device(device: Any = None) -> Dict[str, Any]:
+    """One device-side measurement: ``{"source", "bytes_in_use",
+    "peak_bytes_in_use", "cap_bytes"}``.  ``memory_stats()`` returning
+    None (the CPU backend) or raising falls back to the aggregate
+    ``jax.live_arrays()`` size without raising — the backend-matrix
+    contract docs/memory.md#sources documents."""
+    stats = None
+    try:
+        import jax
+        if device is None:
+            device = jax.local_devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        return {
+            "source": "device",
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(
+                stats.get("peak_bytes_in_use",
+                          stats.get("bytes_in_use", 0))),
+            "cap_bytes": int(stats.get("bytes_limit", 0)),
+        }
+    live = 0
+    try:
+        import jax
+        for buf in jax.live_arrays():
+            try:
+                live += int(buf.nbytes)
+            except Exception:
+                continue
+    except Exception:
+        live = 0
+    return {"source": "live_buffers", "bytes_in_use": int(live),
+            "peak_bytes_in_use": None, "cap_bytes": 0}
+
+
+def native_mem(core: Any = None) -> Optional[Dict[str, int]]:
+    """The csrc leg: ``hvd_core_mem`` parsed (common/basics.py
+    ``mem()``), or None when no core is up or the loaded library
+    predates the memory plane — graceful absence, never an error."""
+    if core is None:
+        try:
+            from .. import runtime as _rt
+            if _rt.is_initialized():
+                core = _rt.get().core
+        except Exception:
+            core = None
+    if core is None or not getattr(core, "_h", None):
+        return None
+    try:
+        return core.mem()
+    except Exception:
+        return None  # pre-memory-plane .so or a closing core
+
+
+# ------------------------------------------------------- kv-pool provider
+_kv_pool_fn: Optional[Callable[[], Dict[str, Any]]] = None
+
+
+def set_kv_pool_provider(fn: Optional[Callable[[], Dict[str, Any]]]
+                         ) -> None:
+    """Register the serve engine's BlockAllocator occupancy source
+    (serve/engine.py registers ``allocator.occupancy`` at scheduler
+    construction; None unregisters on shutdown)."""
+    global _kv_pool_fn
+    _kv_pool_fn = fn
+
+
+def kv_pool_stats() -> Optional[Dict[str, Any]]:
+    fn = _kv_pool_fn
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception:
+        return None  # a closing engine must not break the sampler
+
+
+# ---------------------------------------------------------------- sampler
+class MemSampler:
+    """Per-rank measured memory ledger + OOM-proximity sentinel.
+
+    ``sample()`` is called from Runtime.metrics_snapshot (the
+    MetricsPublisher cadence), rate-limited by HOROVOD_MEM_INTERVAL;
+    the latest sample is what the heartbeat stamps and
+    :func:`report_section` reconciles."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.last: Optional[Dict[str, Any]] = None
+        self.peak_seen = 0        # running max under the CPU fallback
+        self.pressure_above = False   # fire-once transition latch
+        self.pressure_events = 0
+        self.dump_paths: list = []    # test-visible: flight dumps written
+        self._last_t: Optional[float] = None
+
+    # ------------------------------------------------------------ geometry
+    def _predicted(self) -> Optional[Dict[str, int]]:
+        """zero_memory_bytes for the ledger's configured zero model —
+        the predicted side of the reconciliation (None unconfigured)."""
+        from .ledger import GLOBAL
+        zero = GLOBAL.zero_model()
+        if not zero:
+            return None
+        from .costmodel import zero_memory_bytes
+        try:
+            return zero_memory_bytes(
+                int(zero.get("level", 1) or 0), zero["n_params"],
+                zero["world"], opt_slots=int(zero.get("opt_slots", 2)),
+                ef=bool(zero.get("ef", False)))
+        except (ValueError, KeyError):
+            return None
+
+    def _planes(self, core: Any) -> Dict[str, int]:
+        """Geometry-attributed bytes by plane (docs/memory.md
+        #attribution): the training-state planes from the zero model,
+        the serve KV pool from BlockAllocator occupancy, the fusion/
+        overlap working set from threshold x depth, the native core
+        from hvd_core_mem."""
+        planes: Dict[str, int] = {}
+        pred = self._predicted()
+        if pred:
+            for key in ("params", "grads", "opt_state", "ef_residual"):
+                planes[key] = int(pred[f"{key}_bytes"])
+        kv = kv_pool_stats()
+        if kv:
+            planes["kv_pool"] = int(kv.get("pool_bytes", 0))
+        try:
+            threshold = int(_knob("HOROVOD_FUSION_THRESHOLD"))
+            depth = max(1, int(_knob("HOROVOD_OVERLAP_DEPTH")))
+            planes["fusion_overlap"] = threshold * depth
+        except Exception:
+            pass
+        nm = native_mem(core)
+        if nm:
+            planes["native_core"] = int(
+                nm.get("trace_ring_bytes", 0)
+                + nm.get("window_ring_bytes", 0)
+                + nm.get("response_cache_bytes", 0))
+        return planes
+
+    # -------------------------------------------------------------- sample
+    def sample(self, core: Any = None, device: Any = None,
+               now: Optional[float] = None,
+               cap_bytes: Optional[int] = None,
+               force: bool = False) -> Optional[Dict[str, Any]]:
+        """Take (or rate-limit-skip) one measurement: update the
+        hvd_mem_* families, the transition latch, and ``self.last``.
+        ``cap_bytes`` overrides the backend cap (tests; the CPU
+        fallback reports none).  Returns the sample row, or None when
+        disabled/rate-limited."""
+        if not enabled():
+            return None
+        now = time.time() if now is None else float(now)
+        interval = float(_knob("HOROVOD_MEM_INTERVAL"))
+        with self.lock:
+            if (not force and interval > 0 and self._last_t is not None
+                    and now - self._last_t < interval):
+                return None
+            self._last_t = now
+        measured = measure_device(device)
+        host_rss = read_host_rss_bytes()
+        if cap_bytes is not None:
+            measured["cap_bytes"] = int(cap_bytes)
+        with self.lock:
+            self.peak_seen = max(self.peak_seen, measured["bytes_in_use"])
+            if measured["peak_bytes_in_use"] is None:
+                measured["peak_bytes_in_use"] = self.peak_seen
+        cap = int(measured["cap_bytes"] or 0)
+        watermark = (measured["bytes_in_use"] / cap) if cap > 0 else 0.0
+        planes = self._planes(core)
+        pred = self._predicted()
+        drift = None
+        if pred and pred.get("total_bytes", 0) > 0 \
+                and measured["bytes_in_use"] > 0:
+            drift = measured["bytes_in_use"] / pred["total_bytes"]
+        nm = native_mem(core)
+        kv = kv_pool_stats()
+        row: Dict[str, Any] = {
+            "time": now,
+            "source": measured["source"],
+            "bytes_in_use": measured["bytes_in_use"],
+            "peak_bytes_in_use": measured["peak_bytes_in_use"],
+            "cap_bytes": cap,
+            "host_rss_bytes": host_rss,
+            "watermark": watermark,
+            "headroom_bytes": (cap - measured["bytes_in_use"]) if cap > 0
+            else None,
+            "planes": planes,
+            "predicted": pred,
+            "model_drift_ratio": drift,
+            "native": nm,
+            "kv_pool": kv,
+        }
+        self._update_gauges(row)
+        self._check_pressure(row, core=core)
+        with self.lock:
+            self.last = row
+        return row
+
+    def _update_gauges(self, row: Dict[str, Any]) -> None:
+        try:
+            from ..utils import metrics as M
+        except ImportError:
+            return
+        M.MEM_BYTES_IN_USE.set(row["bytes_in_use"])
+        M.MEM_PEAK_BYTES.set(row["peak_bytes_in_use"] or 0)
+        M.MEM_CAP_BYTES.set(row["cap_bytes"])
+        M.MEM_HOST_RSS.set(row["host_rss_bytes"])
+        M.MEM_WATERMARK.set(row["watermark"])
+        if row["model_drift_ratio"] is not None and \
+                math.isfinite(row["model_drift_ratio"]):
+            M.MEM_MODEL_DRIFT.set(row["model_drift_ratio"])
+        for plane, b in row["planes"].items():
+            M.MEM_PLANE_BYTES.set(b, plane=plane)
+        nm = row.get("native")
+        if nm:
+            for key, kind in (("rss_bytes", "rss"),
+                              ("peak_rss_bytes", "peak_rss"),
+                              ("trace_ring_bytes", "trace_ring"),
+                              ("window_ring_bytes", "window_ring"),
+                              ("response_cache_bytes", "response_cache")):
+                if key in nm:
+                    M.MEM_NATIVE_BYTES.set(nm[key], kind=kind)
+        kv = row.get("kv_pool")
+        if kv:
+            used = int(kv.get("used_blocks", 0))
+            free = int(kv.get("free_blocks", 0))
+            M.MEM_KV_BLOCKS_USED.set(used)
+            M.MEM_KV_BLOCKS_FREE.set(free)
+            M.MEM_KV_BLOCKS_SHARED.set(kv.get("shared_blocks", 0))
+            if used + free > 0:
+                M.MEM_KV_UTIL.set(used / (used + free))
+
+    # ------------------------------------------------------------ sentinel
+    def _check_pressure(self, row: Dict[str, Any], core: Any = None
+                        ) -> None:
+        """The OOM-proximity sentinel: fire ONCE per below->above
+        transition of the watermark (a rank hovering at the threshold
+        must not page every sample); dropping below re-arms."""
+        if row["cap_bytes"] <= 0:
+            return  # no cap known: proximity is undefined, stay quiet
+        high = float(_knob("HOROVOD_MEM_HIGH_WATERMARK"))
+        above = row["watermark"] >= high
+        with self.lock:
+            fire = above and not self.pressure_above
+            self.pressure_above = above
+            if fire:
+                self.pressure_events += 1
+        if not fire:
+            return
+        try:
+            from ..utils import metrics as M
+            M.MEM_PRESSURE_EVENTS.inc()
+        except ImportError:
+            pass
+        dump = self._flight_dump(row, core=core)
+        try:
+            from ..utils.timeline import trace_instant
+            trace_instant("alerts", "mem.pressure",
+                          args={"watermark": round(row["watermark"], 4),
+                                "bytes_in_use": row["bytes_in_use"],
+                                "cap_bytes": row["cap_bytes"]})
+        except Exception:
+            pass
+        try:
+            from ..common import hvdlogging as log
+            log.warning(
+                "memstats: device memory watermark %.1f%% crossed the "
+                "high watermark %.1f%% (%d / %d bytes)%s — "
+                "docs/memory.md#oom", row["watermark"] * 100, high * 100,
+                row["bytes_in_use"], row["cap_bytes"],
+                f"; flight dump: {dump}" if dump else "")
+        except Exception:
+            pass
+
+    def _flight_dump(self, row: Dict[str, Any], core: Any = None
+                     ) -> Optional[str]:
+        """Explicit native flight dump, reason ``mem`` — the black box
+        taken before the kernel kills the process.  Path derives from
+        HOROVOD_FLIGHT_RECORD with a ``.mem`` suffix so a later crash
+        record never overwrites the pressure evidence (the sentinel
+        ``.nan`` pattern, watch/sentinel.py)."""
+        path = str(_knob("HOROVOD_FLIGHT_RECORD") or "")
+        if core is None:
+            try:
+                from .. import runtime as _rt
+                if _rt.is_initialized():
+                    core = _rt.get().core
+            except Exception:
+                core = None
+        if core is None or not getattr(core, "_h", True):
+            return None
+        if not path:
+            return None
+        path = f"{path}.mem"
+        try:
+            if core.flight_dump(
+                    path, reason=f"mem watermark="
+                    f"{row['watermark']:.4f}"):
+                with self.lock:
+                    self.dump_paths.append(path)
+                return path
+        except Exception:
+            pass  # forensics must never take the training loop down
+        return None
+
+    # -------------------------------------------------------------- report
+    def report_section(self) -> Optional[Dict[str, Any]]:
+        """The ``memory`` section of ``hvd.perf_report()`` (and thus
+        ``GET /perf``): the last sample's measured residency beside the
+        per-plane prediction, the drift ratio, and the cap headroom
+        ROADMAP item 2's layout solver consumes.  None before the first
+        sample (or with HOROVOD_MEM off)."""
+        with self.lock:
+            row = dict(self.last) if self.last else None
+            events = self.pressure_events
+        if row is None:
+            return None
+        pred = row.get("predicted") or {}
+        table = {}
+        for key in ("params", "grads", "opt_state", "ef_residual"):
+            if f"{key}_bytes" in pred or key in row["planes"]:
+                table[key] = {
+                    "predicted_bytes": int(pred.get(f"{key}_bytes", 0)),
+                    "attributed_bytes": int(row["planes"].get(key, 0)),
+                }
+        for key in ("kv_pool", "fusion_overlap", "native_core"):
+            if key in row["planes"]:
+                table[key] = {"predicted_bytes": None,
+                              "attributed_bytes": row["planes"][key]}
+        return {
+            "source": row["source"],
+            "measured": {
+                "bytes_in_use": row["bytes_in_use"],
+                "peak_bytes_in_use": row["peak_bytes_in_use"],
+                "cap_bytes": row["cap_bytes"],
+                "host_rss_bytes": row["host_rss_bytes"],
+                "watermark": row["watermark"],
+                "headroom_bytes": row["headroom_bytes"],
+            },
+            "predicted_total_bytes": int(pred["total_bytes"])
+            if pred else None,
+            "model_drift_ratio": row["model_drift_ratio"],
+            "planes": table,
+            "native": row.get("native"),
+            "kv_pool": row.get("kv_pool"),
+            "pressure_events": events,
+            "time": row["time"],
+        }
+
+
+# ---------------------------------------------------------- module global
+GLOBAL = MemSampler()
+
+
+def reset() -> None:
+    """Test hook: forget samples, peaks and the pressure latch
+    (module-global state), and unregister the KV-pool provider."""
+    global GLOBAL
+    GLOBAL = MemSampler()
+    set_kv_pool_provider(None)
+
+
+def sample(**kw) -> Optional[Dict[str, Any]]:
+    return GLOBAL.sample(**kw)
+
+
+def report_section() -> Optional[Dict[str, Any]]:
+    return GLOBAL.report_section()
+
+
+def last_sample() -> Optional[Dict[str, Any]]:
+    with GLOBAL.lock:
+        return dict(GLOBAL.last) if GLOBAL.last else None

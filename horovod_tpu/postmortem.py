@@ -40,7 +40,30 @@ FLIGHT_HEADER = "hvd_flight_v"
 
 # Closed suspect taxonomy (docs/postmortem.md#taxonomy).
 SUSPECTS = ("kill", "stall", "kv_blackout", "transport", "torn_commit",
-            "unknown")
+            "oom", "unknown")
+
+# SIGKILL arrives as rc -9 from the launcher's waitpid or as the shell
+# convention 128+9 when a wrapper re-reported it.
+_SIGKILL_RCS = (-9, 137)
+
+
+def _mem_watermark(heartbeat: Optional[Dict[str, Any]]) -> Optional[float]:
+    """The device-memory watermark the final heartbeat carried (the
+    memory plane stamps it, utils/health.py), or None."""
+    mem = (heartbeat or {}).get("mem") or {}
+    wm = mem.get("watermark")
+    try:
+        return float(wm) if wm is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def _pressure_threshold() -> float:
+    try:
+        from .common.knobs import current
+        return float(current("HOROVOD_MEM_HIGH_WATERMARK"))
+    except Exception:
+        return 0.9  # registry default (common/knobs.py)
 
 # The stall inspector's documented hard-exit status (utils/stall.py).
 STALL_SHUTDOWN_EXIT = 42
@@ -113,7 +136,8 @@ def parse_flight_record(path_or_text: str) -> Dict[str, Any]:
 
 # ------------------------------------------------------------ exit taxonomy
 def classify_exit(rc: Optional[int], by_launcher: bool = False,
-                  supervision_cause: Optional[str] = None) -> str:
+                  supervision_cause: Optional[str] = None,
+                  heartbeat: Optional[Dict[str, Any]] = None) -> str:
     """One worker exit -> taxonomy label.
 
     ``supervision_cause`` ("stall" / "heartbeat-lost") wins: when the
@@ -121,7 +145,13 @@ def classify_exit(rc: Optional[int], by_launcher: bool = False,
     of is the cure, not the disease.  ``by_launcher`` marks fail-fast
     terminations of SURVIVORS after another rank failed — collateral,
     never the first failure.  rc 42 is the stall inspector's documented
-    hard-exit status (utils/stall.py)."""
+    hard-exit status (utils/stall.py).
+
+    ``heartbeat`` is the rank's FINAL heartbeat: a SIGKILL/rc-137 exit
+    whose heartbeat carried a device-memory watermark at or above the
+    pressure threshold classifies as suspected ``oom`` — the kernel's
+    OOM killer sends exactly that signal, and the memory plane put the
+    evidence on the wire before dying (docs/memory.md#oom)."""
     if supervision_cause:
         return supervision_cause
     if by_launcher:
@@ -130,6 +160,10 @@ def classify_exit(rc: Optional[int], by_launcher: bool = False,
         return "unknown"
     if rc == 0:
         return "clean"
+    if rc in _SIGKILL_RCS:
+        wm = _mem_watermark(heartbeat)
+        if wm is not None and wm >= _pressure_threshold():
+            return "oom"
     if rc < 0:
         try:
             return f"signal:{_signal.Signals(-rc).name}"
@@ -190,6 +224,13 @@ def classify_suspect(info: Dict[str, Any]) -> Tuple[str, List[str]]:
             or chaos.get("kv_blackout"):
         return "kv_blackout", ["log/metrics show rendezvous-KV operations "
                                "failing before the exit"]
+    if cls == "oom":
+        wm = _mem_watermark(info.get("heartbeat"))
+        return "oom", [
+            "SIGKILL with the final heartbeat's device-memory watermark "
+            f"at {wm:.0%} of the cap — the kernel OOM-killer signature "
+            "(docs/memory.md#oom)" if wm is not None else
+            "SIGKILL with memory pressure in the final heartbeat"]
     if cls in ("stall", "heartbeat-lost"):
         return "stall", [f"supervision verdict: {cls} beyond the "
                          "heartbeat timeout"]
@@ -251,11 +292,11 @@ def build_postmortem(job: Dict[str, Any],
     events: List[Dict[str, Any]] = []
     for r in sorted(exits):
         e = exits[r]
-        classification = classify_exit(e.get("rc"),
-                                       bool(e.get("by_launcher")),
-                                       e.get("cause"))
         hb_info = health_ranks.get(str(r)) or {}
         hb = hb_info.get("heartbeat")
+        classification = classify_exit(e.get("rc"),
+                                       bool(e.get("by_launcher")),
+                                       e.get("cause"), heartbeat=hb)
         fr = (flight_records or {}).get(r)
         snap = (metric_snapshots or {}).get(r)
         info: Dict[str, Any] = {
@@ -293,8 +334,22 @@ def build_postmortem(job: Dict[str, Any],
             "classification": ranks[str(first_rank)]["exit"]
             ["classification"],
         }
-        classification, evidence = classify_suspect(ranks[str(first_rank)])
-        suspect = {"rank": first_rank, "classification": classification,
+        # OOM suspects by pressure, not by time: the kernel kills the
+        # biggest consumer, and exit times race — the rank whose final
+        # heartbeat sat highest above the watermark is the one that
+        # blew the cap (docs/memory.md#oom).
+        oom_ranks = [int(r) for r, info in ranks.items()
+                     if info["exit"]["classification"] == "oom"]
+        if oom_ranks:
+            suspect_rank = max(
+                oom_ranks,
+                key=lambda r: _mem_watermark(
+                    ranks[str(r)].get("heartbeat")) or 0.0)
+        else:
+            suspect_rank = first_rank
+        classification, evidence = classify_suspect(
+            ranks[str(suspect_rank)])
+        suspect = {"rank": suspect_rank, "classification": classification,
                    "evidence": evidence}
     return {
         "schema": SCHEMA,

@@ -17,8 +17,9 @@ needs —
 the argument is a ``GET /perf`` URL (or ``host:port``), a saved /perf
 JSON, or a directory holding ``perf.json`` — rendered bottleneck-verdict
 first (straggler-bound / comm-bound / compute-bound / input-bound /
-stall-bound) with the per-rank step-time decomposition, model drift and
-top native ops behind it.
+stall-bound) with the per-rank step-time decomposition, model drift, the
+memory plane's measured-vs-predicted residency table (docs/memory.md)
+and top native ops behind it.
 
 ``--serve`` renders the serving fleet's operational view (the
 ``GET /serve/stats`` payload — docs/serving.md): admission counters,
@@ -195,6 +196,62 @@ def load_perf_view(source: str) -> Dict[str, Any]:
     return view
 
 
+def _render_perf_memory(fleet: Dict[str, Any], ranks: Dict[str, Any]
+                        ) -> List[str]:
+    """The MEMORY block of ``render_perf``: measured residency vs the
+    zero_memory_bytes prediction per rank, the fleet's worst watermark,
+    and one rank's per-plane attribution table (docs/memory.md).  Empty
+    when no rank carries a ``memory`` section (HOROVOD_MEM=0 or a
+    payload that predates the plane)."""
+    mem_ranks = [(r, ranks[r]["memory"]) for r in sorted(ranks)
+                 if isinstance(ranks[r].get("memory"), dict)]
+    if not mem_ranks:
+        return []
+    lines: List[str] = [""]
+    lines.append("-- MEMORY: measured residency vs zero_memory_bytes "
+                 "prediction (docs/memory.md) --")
+    fmem = fleet.get("memory") or {}
+    worst = fmem.get("worst_watermark") or {}
+    if worst.get("watermark") is not None:
+        lines.append(
+            f"  fleet: {_fmt_bytes(fmem.get('bytes_in_use_total'))} "
+            f"in use; worst watermark rank {worst.get('rank')} at "
+            f"{worst.get('watermark'):.1%} "
+            f"(headroom {_fmt_bytes(worst.get('headroom_bytes'))})")
+    for r, m in mem_ranks:
+        meas = m.get("measured", {})
+        drift = m.get("model_drift_ratio")
+        cap = meas.get("cap_bytes")
+        wm = meas.get("watermark")
+        lines.append(
+            f"  rank {r} [{m.get('source', '?')}]: "
+            f"{_fmt_bytes(meas.get('bytes_in_use'))} in use "
+            f"(peak {_fmt_bytes(meas.get('peak_bytes_in_use'))}, "
+            f"host RSS {_fmt_bytes(meas.get('host_rss_bytes'))})"
+            + (f", cap {_fmt_bytes(cap)} @ {wm:.1%}"
+               if cap and wm is not None else ", no cap (CPU-virtual)")
+            + (f", drift {drift:.2f}x" if drift is not None else "")
+            + (f", {m['pressure_events']} pressure event(s)"
+               if m.get("pressure_events") else ""))
+    # The per-plane table is per-rank attribution; one rank's view is
+    # rendered — the worst-watermark rank when known, else the first
+    # carrier (training-state planes are symmetric under ZeRO's equal
+    # shards; kv_pool/native differ only in the tails).
+    pick = str(worst.get("rank")) if str(worst.get("rank")) in \
+        dict(mem_ranks) else mem_ranks[0][0]
+    table = dict(mem_ranks)[pick].get("planes") or {}
+    if table:
+        lines.append(f"  per-plane (rank {pick}): "
+                     "plane        predicted    attributed")
+        for plane, row in table.items():
+            pred = row.get("predicted_bytes")
+            lines.append(
+                f"    {plane:<12} "
+                f"{_fmt_bytes(pred) if pred is not None else '-':<12} "
+                f"{_fmt_bytes(row.get('attributed_bytes'))}")
+    return lines
+
+
 def render_perf(view: Dict[str, Any]) -> str:
     """Bottleneck-verdict-first text rendering of one merged /perf view
     (the same numbers GET /perf serves — docs/profiling.md)."""
@@ -208,6 +265,9 @@ def render_perf(view: Dict[str, Any]) -> str:
         lines.append("BOTTLENECK: no perf reports recorded — enable "
                      "HOROVOD_PERF and record steps with "
                      "hvd.perf.timed_step() (docs/profiling.md)")
+        # A serving fleet or an early run can carry memory samples with
+        # no recorded steps — the residency table still renders.
+        lines.extend(_render_perf_memory(fleet, ranks))
         return "\n".join(lines)
     if verdict == "straggler-bound":
         s = fleet.get("straggler", {})
@@ -244,6 +304,9 @@ def render_perf(view: Dict[str, Any]) -> str:
         lines.append("")
         lines.append("Cost-model drift (modeled/measured; 1.0 = exact): "
                      + ", ".join(f"rank {r} {v:.2f}x" for r, v in drifts))
+    # Memory plane (docs/memory.md) — absent on payloads from ranks that
+    # predate it or run with HOROVOD_MEM=0.
+    lines.extend(_render_perf_memory(fleet, ranks))
     # ZeRO what-if table (docs/zero.md): one rank's view suffices — the
     # table is an analytical function of (workload, topology), identical
     # on every rank; render the first rank that carries it.
@@ -495,6 +558,19 @@ def render_serve(view: Dict[str, Any]) -> str:
         f"  tokens: prefill {engine.get('tokens_prefill', '?')} "
         f"({engine.get('prefill_chunks', '?')} chunks), "
         f"decode {engine.get('tokens_decode', '?')}")
+    # KV-pool occupancy (docs/memory.md#kv-pool) — absent on payloads
+    # from engines that predate the memory plane.
+    pool = engine.get("kv_pool")
+    if isinstance(pool, dict):
+        lines.append(
+            f"KV POOL: {pool.get('used_blocks', '?')}/"
+            f"{pool.get('num_blocks', '?')} blocks used "
+            f"({pool.get('free_blocks', '?')} free, "
+            f"{pool.get('shared_blocks', '?')} shared) = "
+            f"{_fmt_bytes(pool.get('used_bytes'))} of "
+            f"{_fmt_bytes(pool.get('pool_bytes'))}; fragmentation "
+            f"{pool.get('fragmentation', '?')}, eviction pressure "
+            f"{pool.get('eviction_pressure', '?')}")
     # Raw-speed legs (docs/serving.md#raw-speed) — absent on payloads
     # from engines that predate them.
     prefix = engine.get("prefix_cache")

@@ -175,6 +175,10 @@ class Runtime:
         # parse, so a typo'd ruleset fails bring-up, not a detector.
         from .watch import validate_watch_knobs
         validate_watch_knobs(self.knobs)
+        # Memory plane (perf/memstats.py; docs/memory.md): sample rate
+        # limit and the OOM-proximity watermark fraction.
+        from .perf import validate_mem_knobs
+        validate_mem_knobs(self.knobs)
         if self.knobs["HOROVOD_FUSION_THRESHOLD"] <= 0:
             raise ValueError(
                 f"HOROVOD_FUSION_THRESHOLD="
@@ -564,6 +568,15 @@ class Runtime:
                 import_op_stats(self.core)
             except Exception:
                 pass
+        # Memory plane (perf/memstats.py; docs/memory.md): sample the
+        # measured ledger on the snapshot cadence — the hvd_mem_*
+        # families ride THIS snapshot into the publisher, the series
+        # store and the committed mem-* rules.
+        try:
+            from .perf import memstats
+            memstats.sample(core=self.core)
+        except Exception:
+            pass  # sampling must never break a snapshot
         return M.REGISTRY.snapshot()
 
     def _heartbeat_payload(self) -> Dict[str, Any]:

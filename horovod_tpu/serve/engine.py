@@ -93,6 +93,18 @@ class BlockAllocator:
                 del self._refs[b]
                 self._free.append(b)
 
+    def occupancy(self) -> Dict[str, int]:
+        """Pool occupancy for the memory plane (perf/memstats.py;
+        docs/memory.md#kv-pool): used/free split plus the blocks more
+        than one owner maps (prefix-cache / CoW sharing) — the bytes the
+        used count would double-book if summed per sequence."""
+        return {
+            "num_blocks": self.num_blocks,
+            "used_blocks": self.num_blocks - len(self._free),
+            "free_blocks": len(self._free),
+            "shared_blocks": sum(1 for c in self._refs.values() if c > 1),
+        }
+
 
 # ----------------------------------------------------- radix prefix cache
 class _PrefixNode:
@@ -594,6 +606,17 @@ class ServeEngine:
         # assert equality — lockstep divergence is caught at the tick it
         # happens, not when token digests drift (serve/worker.py).
         self.sched_digest = ""
+        # The pool's true byte footprint: the preallocated cache pytree
+        # itself (this rank's shards of it are the resident bytes the
+        # memory plane attributes to the kv_pool plane).
+        self._pool_bytes = sum(
+            int(np.prod(x.shape)) * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves(cache_struct))
+        try:
+            from ..perf.memstats import set_kv_pool_provider
+            set_kv_pool_provider(self.kv_pool)
+        except Exception:
+            pass  # the memory plane must never block engine bring-up
 
     # ----------------------------------------------------------- compile
     def _build_step(self):
@@ -824,6 +847,57 @@ class ServeEngine:
             pass  # tracing must never take serving down
 
     # -------------------------------------------------------------- view
+    def kv_pool(self) -> Dict[str, Any]:
+        """KV-cache pool occupancy for ``GET /serve/stats`` and the
+        memory plane (memstats.set_kv_pool_provider registers this at
+        construction; docs/memory.md#kv-pool):
+
+          * the allocator's used/free/shared block split;
+          * ``pool_bytes`` — the preallocated cache pytree's true size
+            (blocks x block_bytes; resident whether or not blocks are
+            used — a paged pool's cost is its reservation);
+          * ``fragmentation`` — the worst-case-reservation waste: 1 -
+            tokens actually written over tokens reserved across active
+            requests (prefix-cache-held blocks excluded — they hold
+            real KV);
+          * ``eviction_pressure`` — prefix-cache evictions per
+            admission: > 0 means admissions only succeed by evicting
+            cached prefixes (the pool is effectively full).
+        """
+        s = self.scheduler
+        occ = s.allocator.occupancy()
+        nb = max(occ["num_blocks"], 1)
+        block_bytes = self._pool_bytes // nb
+        reserved_tokens = written_tokens = 0
+        for req in s.slots:
+            if req is not None:
+                reserved_tokens += len(req.blocks) * self.cfg.block_size
+                written_tokens += req.ctx_len
+        frag = (1.0 - written_tokens / reserved_tokens
+                if reserved_tokens else 0.0)
+        evictions = s.prefix.evictions if s.prefix is not None else 0
+        occ.update({
+            "block_size": self.cfg.block_size,
+            "block_bytes": block_bytes,
+            "pool_bytes": self._pool_bytes,
+            "used_bytes": occ["used_blocks"] * block_bytes,
+            "fragmentation": round(frag, 4),
+            "evictions": evictions,
+            "eviction_pressure": (round(evictions / s.admissions, 4)
+                                  if s.admissions else 0.0),
+        })
+        return occ
+
+    def close(self) -> None:
+        """Unregister the memory plane's KV-pool provider — a torn-down
+        engine must not keep reporting a stale pool."""
+        try:
+            from ..perf import memstats
+            if memstats._kv_pool_fn == self.kv_pool:
+                memstats.set_kv_pool_provider(None)
+        except Exception:
+            pass
+
     def stats(self) -> Dict[str, Any]:
         s = self.scheduler
         prefix = s.prefix
@@ -833,6 +907,7 @@ class ServeEngine:
             "waiting": s.queue_depth,
             "completed": s.completed,
             "free_blocks": s.allocator.free_count,
+            "kv_pool": self.kv_pool(),
             "batch_fill": round(self._last_fill, 4),
             "tokens_prefill": self._tokens_prefill,
             "tokens_decode": self._tokens_decode,

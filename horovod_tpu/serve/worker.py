@@ -505,6 +505,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return frontend.run(ttl_s=args.ttl)
     except KeyboardInterrupt:
         return 130
+    finally:
+        engine.close()  # unregister the memory plane's KV-pool provider
 
 
 if __name__ == "__main__":

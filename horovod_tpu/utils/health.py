@@ -94,6 +94,20 @@ def heartbeat_payload(rank: int, clock: Optional[Any] = None,
             hb["core"] = core.health()
         except Exception:
             pass  # a closing core must not break the heartbeat
+    # Memory plane (perf/memstats.py): the last sampled watermark rides
+    # the heartbeat so a SIGKILLed rank's FINAL heartbeat carries the
+    # pressure evidence the postmortem `oom` classifier reads
+    # (docs/memory.md#oom, docs/postmortem.md#taxonomy).
+    try:
+        from ..perf.memstats import last_sample
+        row = last_sample()
+        if row is not None:
+            hb["mem"] = {"watermark": row.get("watermark"),
+                         "bytes_in_use": row.get("bytes_in_use"),
+                         "cap_bytes": row.get("cap_bytes"),
+                         "source": row.get("source")}
+    except Exception:
+        pass  # the memory leg must never break the heartbeat
     return hb
 
 

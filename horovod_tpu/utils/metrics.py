@@ -517,6 +517,81 @@ PERF_NATIVE_OP_BYTES = REGISTRY.counter(
     "Cumulative payload bytes of negotiated collectives by collapsed "
     "op name (csrc hvd_core_op_stats).")
 
+# Memory plane (horovod_tpu/perf/memstats.py; docs/memory.md): the
+# measured fleet memory ledger — device/host residency sampled per rank,
+# attributed to planes from known geometry, reconciled against the
+# zero_memory_bytes prediction, and watched by the committed mem-* alert
+# rules plus the OOM-proximity sentinel.
+MEM_BYTES_IN_USE = REGISTRY.gauge(
+    "hvd_mem_bytes_in_use",
+    "Measured device bytes in use on this rank: device.memory_stats() "
+    "bytes_in_use where the backend provides it, else the aggregate "
+    "jax.live_arrays() size (CPU-virtual fallback; the sample's "
+    "'source' field says which — docs/memory.md#sources).")
+MEM_PEAK_BYTES = REGISTRY.gauge(
+    "hvd_mem_peak_bytes",
+    "Measured peak device bytes (memory_stats peak_bytes_in_use; under "
+    "the CPU fallback the running max of sampled bytes_in_use).")
+MEM_CAP_BYTES = REGISTRY.gauge(
+    "hvd_mem_cap_bytes",
+    "Device memory capacity in bytes (memory_stats bytes_limit); 0 when "
+    "the backend reports no cap (CPU fallback) — the watermark and "
+    "headroom need a nonzero cap.")
+MEM_HOST_RSS = REGISTRY.gauge(
+    "hvd_mem_host_rss_bytes",
+    "Host resident set of this rank's process (/proc/self/status VmRSS) "
+    "— the host leg of the ledger, reported beside (never inside) the "
+    "device drift ratio.")
+MEM_WATERMARK = REGISTRY.gauge(
+    "hvd_mem_watermark",
+    "bytes_in_use / cap as a fraction (0 when no cap is known); the "
+    "committed mem-pressure-high rule and the OOM-proximity sentinel "
+    "threshold this against HOROVOD_MEM_HIGH_WATERMARK.")
+MEM_PLANE_BYTES = REGISTRY.gauge(
+    "hvd_mem_plane_bytes",
+    "Geometry-attributed residency by plane (params / grads / opt_state "
+    "/ ef_residual from the ZeRO level + bucket plan, kv_pool from the "
+    "BlockAllocator, fusion_overlap from threshold x depth, native_core "
+    "from hvd_core_mem) — the per-plane side of the measured-vs-"
+    "predicted table (docs/memory.md#attribution).")
+MEM_MODEL_DRIFT = REGISTRY.gauge(
+    "hvd_mem_model_drift_ratio",
+    "Measured bytes_in_use over the zero_memory_bytes predicted total "
+    "(1.0 = the memory model prices exactly what the device reports; "
+    "the PR-14 drift discipline, for bytes-resident instead of "
+    "bytes-moved).  The committed mem-model-drift rule watches it.")
+MEM_PRESSURE_EVENTS = REGISTRY.counter(
+    "hvd_mem_pressure_events_total",
+    "OOM-proximity sentinel firings: watermark transitions above "
+    "HOROVOD_MEM_HIGH_WATERMARK, each firing once — alert + timeline "
+    "instant + flight dump reason 'mem' (docs/memory.md#oom).")
+MEM_KV_BLOCKS_USED = REGISTRY.gauge(
+    "hvd_mem_kv_blocks_used",
+    "Serve KV-cache pool blocks currently allocated (BlockAllocator "
+    "occupancy; docs/serving.md) — the observability prerequisite for "
+    "host spill.")
+MEM_KV_BLOCKS_FREE = REGISTRY.gauge(
+    "hvd_mem_kv_blocks_free",
+    "Serve KV-cache pool blocks on the free list (the kv-pool-dry "
+    "rule's signal rides hvd_mem_kv_util, derived from this).")
+MEM_KV_BLOCKS_SHARED = REGISTRY.gauge(
+    "hvd_mem_kv_blocks_shared",
+    "Serve KV-cache pool blocks with refcount > 1 (prefix-cache / "
+    "beam sharing): bytes the used count double-books across "
+    "sequences.")
+MEM_KV_UTIL = REGISTRY.gauge(
+    "hvd_mem_kv_util",
+    "Serve KV-cache pool utilization: used / (used + free), in [0, 1]. "
+    "Exactly 1.0 only when an ACTIVE pool has no free blocks — the "
+    "committed kv-pool-dry rule watches this rather than the free count "
+    "because an unset gauge snapshots as 0, which would read as 'dry' "
+    "on every non-serving rank.")
+MEM_NATIVE_BYTES = REGISTRY.gauge(
+    "hvd_mem_native_bytes",
+    "Native core footprint by kind (hvd_core_mem, stamped by the cycle "
+    "loop: rss / peak_rss / trace_ring / window_ring / response_cache "
+    "— csrc's own memory beside the device planes).")
+
 # Watch plane, detection leg (horovod_tpu/watch/; docs/watch.md): the
 # declarative rules engine's firing accounting.  Maintained by the
 # DRIVER's AlertEngine (the rendezvous server evaluates rules against

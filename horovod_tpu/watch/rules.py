@@ -253,6 +253,31 @@ DEFAULT_RULES: List[AlertRule] = parse_rules({"rules": [
     {"name": "sentinel-loss-divergence",
      "family": "hvd_sentinel_loss_divergence", "kind": "threshold",
      "op": ">=", "value": 3.0, "for": 20, "severity": "warning"},
+    # Memory plane (perf/memstats.py; docs/memory.md): device residency
+    # sustained above the high watermark — the page that precedes the
+    # kernel's SIGKILL.  `for:` keeps a transient allocation spike from
+    # paging; the memstats sentinel separately fires once per crossing
+    # (flight dump reason 'mem'), so the black box exists even when the
+    # rule's duration gate never opens.
+    {"name": "mem-pressure-high", "family": "hvd_mem_watermark",
+     "kind": "threshold", "op": ">=", "value": 0.9, "for": 10,
+     "severity": "critical", "context_family": "hvd_mem_bytes_in_use"},
+    # Serve KV-cache pool exhausted: admission stalls and eviction
+    # pressure follow — capacity, not code, but an incident
+    # (docs/serving.md, docs/memory.md#kv-pool).  Watches utilization,
+    # not the free count: an unset gauge snapshots as 0, so free <= 0
+    # would read as 'dry' on every non-serving rank, while util only
+    # reaches 1.0 when an ACTIVE pool has no free blocks.
+    {"name": "kv-pool-dry", "family": "hvd_mem_kv_util",
+     "kind": "threshold", "op": ">=", "value": 1.0, "for": 10,
+     "severity": "warning", "context_family": "hvd_mem_kv_blocks_used"},
+    # Memory model self-assessment: measured residency 2x away from the
+    # zero_memory_bytes prediction for 15 s means the attribution (and
+    # the layout solver consuming its headroom number) is off the rails
+    # — the PR-14 drift discipline, for bytes-resident.
+    {"name": "mem-model-drift", "family": "hvd_mem_model_drift_ratio",
+     "kind": "threshold", "op": ">=", "value": 2.0, "for": 15,
+     "severity": "warning"},
 ]})
 
 

@@ -78,7 +78,7 @@ SUITES = {
     ],
     "serving": ["tests/test_serve.py", "tests/test_serve_ft.py",
                 "tests/test_serve_speed.py", "tests/test_kv_shard.py"],
-    "perf": ["tests/test_perf.py"],
+    "perf": ["tests/test_perf.py", "tests/test_memstats.py"],
     "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py",
                        "tests/test_profile_analyzer.py"],
 }
@@ -129,6 +129,13 @@ KNOB_DIMS = [
     ("kv-shards-3", {"HOROVOD_KV_SHARDS": "3",
                      "HOROVOD_SERVE_DIRECT": "0"},
      ["serving"]),
+    # memory plane off (docs/memory.md): the perf suite must stay green
+    # with sampling disabled — reports lose their memory section, the
+    # hvd_mem_* gauges stay unset, and nothing downstream may assume
+    # the section exists (tests that exercise sampling itself re-enable
+    # the knob explicitly).
+    ("mem-off", {"HOROVOD_MEM": "0"},
+     ["perf"]),
 ]
 
 
@@ -246,6 +253,18 @@ def build_steps():
         # that exact payload (docs/profiling.md).
         "perf: 2-process attribution /perf + doctor smoke",
         f"{py} -m pytest tests/integration/test_perf_integration.py "
+        f"{full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=20))
+    steps.append(_step(
+        # memory-plane smoke: a 2-process CPU-virtual fleet's measured
+        # hvd_mem_* families land in GET /series for both ranks, the
+        # GET /perf reconciliation carries bounded drift + the fleet
+        # worst-watermark rollup, a synthetic near-cap fires the
+        # committed mem-pressure-high rule at GET /alerts in flight,
+        # and the sentinel's reason-mem flight dump parses
+        # (docs/memory.md).
+        "mem: 2-process memory ledger + pressure-alert smoke",
+        f"{py} -m pytest tests/integration/test_mem_integration.py "
         f"{full}",
         env={"JAX_PLATFORMS": "cpu"}, timeout=20))
     steps.append(_step(

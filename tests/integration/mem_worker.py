@@ -1,0 +1,152 @@
+"""Per-rank worker for the memory-plane integration test.
+
+Each rank holds real device-side residency (a live jax array — the
+CPU-virtual source aggregates it), configures the ledger's zero model,
+and takes ONE forced sample with a synthetic near-cap
+(``cap_bytes = bytes_in_use / 0.95``) so the watermark lands at ~0.95:
+
+  * the sentinel fires immediately (once): the reason-``mem`` flight
+    dump exists before the fleet assertions even start;
+  * ``HOROVOD_MEM_INTERVAL`` is huge, so the metrics publisher's own
+    rate-limited ``sample()`` calls never overwrite the near-cap
+    gauges — every snapshot republishes them, the driver's series
+    store accumulates a sustained ``hvd_mem_watermark >= 0.9``, and
+    the committed ``mem-pressure-high`` rule's ``for: 10`` gate opens
+    while the run is still running;
+  * the perf publisher ships the report's ``memory`` section, so
+    rank 0 can assert the measured-vs-predicted reconciliation (drift
+    bounded) for BOTH ranks at ``GET /perf`` and the fleet rollup's
+    worst-watermark verdict.
+"""
+
+import json
+import math
+import os
+import sys
+import time
+import urllib.request
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def _get_json(path: str):
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    port = os.environ["HOROVOD_RENDEZVOUS_PORT"]
+    with urllib.request.urlopen(f"http://{addr}:{port}{path}",
+                                timeout=10) as r:
+        return json.loads(r.read())
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.process_size() == 2, hvd.process_size()
+    rank = hvd.process_rank()
+    rt = hvd.runtime.get()
+    core = rt.ensure_core()
+    assert core is not None
+    assert rt.perf_publisher is not None, \
+        "HOROVOD_PERF=1 did not wire the perf publisher"
+
+    import jax.numpy as jnp
+    from horovod_tpu.perf import memstats
+
+    # Live residency the CPU-virtual source measures.
+    resident = jnp.ones((4096,), dtype=jnp.float32)
+
+    hvd.perf.reset()
+    memstats.reset()
+    hvd.perf.configure(zero_model={"n_params": 100_000, "world": 2,
+                                   "level": 2, "opt_slots": 2})
+
+    # A few real steps so the perf report is a full report, not a stub.
+    x = np.ones((256,), np.float32)
+    for i in range(3):
+        with hvd.perf.timed_step():
+            out = np.asarray(hvd.allreduce(x, name=f"s{i}", op=hvd.Sum))
+        assert np.allclose(out, float(hvd.size())), out[:4]
+
+    # The synthetic near-cap sample: watermark ~0.95 >= the 0.9
+    # threshold, so the OOM-proximity sentinel fires NOW (flight dump
+    # reason `mem`) and every later metrics snapshot republishes the
+    # near-cap gauges (the publisher's own samples are rate-limited
+    # away by HOROVOD_MEM_INTERVAL).
+    b = memstats.measure_device()["bytes_in_use"]
+    assert b >= resident.nbytes, b
+    row = memstats.sample(core=core, cap_bytes=int(b / 0.95), force=True)
+    assert row is not None and row["watermark"] >= 0.9, row
+    assert memstats.GLOBAL.pressure_events == 1
+    assert memstats.GLOBAL.dump_paths, "sentinel wrote no flight dump"
+    assert memstats.GLOBAL.dump_paths[0].endswith(".mem")
+    drift = row["model_drift_ratio"]
+    assert drift is not None and math.isfinite(drift) and 0 < drift < 1e6
+
+    # Ship the memory section, then fence so BOTH PUTs precede rank 0's
+    # fleet reads.
+    assert rt.perf_publisher.publish_now()
+    hvd.allreduce(np.ones(1, np.float32), name="pub.barrier", op=hvd.Sum)
+
+    if rank == 0:
+        # (1) Reconciliation at GET /perf: both ranks carry the memory
+        # section, drift bounded, and the fleet rollup names the worst
+        # watermark — the cap-headroom surface the layout solver reads.
+        view = _get_json("/perf")
+        assert set(view["ranks"]) == {"0", "1"}, sorted(view["ranks"])
+        for r in ("0", "1"):
+            mem = view["ranks"][r]["memory"]
+            d = mem["model_drift_ratio"]
+            assert d is not None and 0 < d < 1e6, (r, d)
+            assert mem["measured"]["watermark"] >= 0.9, (r, mem)
+            assert mem["pressure_events"] >= 1, (r, mem)
+        fleet_mem = view["fleet"]["memory"]
+        assert fleet_mem["ranks"] == 2, fleet_mem
+        assert fleet_mem["worst_watermark"]["watermark"] >= 0.9
+        assert set(fleet_mem["drift_ratio_by_rank"]) == {"0", "1"}
+
+        # (2) The measured series: both ranks' hvd_mem_* families in
+        # GET /series, latest watermark at the near-cap value.
+        deadline = time.time() + 30
+        seen = {}
+        while time.time() < deadline:
+            sv = _get_json("/series?family=hvd_mem_watermark")
+            seen = {s["rank"]: s["points"][-1][1] for s in sv["series"]}
+            if set(seen) >= {0, 1} and all(v >= 0.9
+                                           for v in seen.values()):
+                break
+            time.sleep(0.3)
+        assert set(seen) >= {0, 1} and all(v >= 0.9
+                                           for v in seen.values()), seen
+        sv = _get_json("/series?family=hvd_mem_bytes_in_use")
+        assert {s["rank"] for s in sv["series"]} >= {0, 1}, sv["series"]
+
+        # (3) The committed mem-pressure-high rule fires IN FLIGHT once
+        # its for:10 gate opens on the sustained series.
+        verdict = None
+        deadline = time.time() + 40
+        while time.time() < deadline:
+            av = _get_json("/alerts")
+            hits = [f for f in av["firing"]
+                    if f["rule"] == "mem-pressure-high"]
+            if hits:
+                verdict = hits[0]
+                break
+            time.sleep(0.3)
+        assert verdict is not None, "mem-pressure-high never fired"
+        assert verdict["severity"] == "critical", verdict
+        assert verdict["value"] >= 0.9, verdict
+        assert "hvd_mem_bytes_in_use" in verdict.get("context", {}), \
+            verdict
+
+    # Keep rank 1 alive (publishing snapshots) until rank 0's polling
+    # assertions are done.
+    hvd.allreduce(np.ones(1, np.float32), name="exit.barrier", op=hvd.Sum)
+    del resident
+    print(f"MEM-OK {rank}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

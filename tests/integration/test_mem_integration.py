@@ -1,0 +1,57 @@
+"""Memory-plane acceptance experiment (docs/memory.md), 2 real
+processes under the real launcher: both ranks' measured ``hvd_mem_*``
+families land in the driver's ``GET /series``, the ``GET /perf``
+reconciliation carries a bounded measured-vs-predicted drift for every
+rank plus the fleet worst-watermark rollup, a synthetic near-cap
+residency fires the committed ``mem-pressure-high`` rule at ``GET
+/alerts`` while the run is still running, and the OOM-proximity
+sentinel's reason-``mem`` flight dump parses — the black box that
+exists even when the kernel's SIGKILL would arrive next."""
+
+import pytest
+
+from test_multiprocess import run_hvdrun
+
+from horovod_tpu import postmortem as PM
+
+
+@pytest.mark.integration
+def test_mem_plane_two_processes(tmp_path):
+    pm = tmp_path / "pm"
+    proc = run_hvdrun(
+        "mem_worker.py",
+        extra_env={"HVD_CPU_CHIPS": "1",
+                   "HOROVOD_PERF": "1",
+                   "HOROVOD_PERF_INTERVAL": "0.5",
+                   "HOROVOD_METRICS": "1",
+                   "HOROVOD_METRICS_INTERVAL": "0.3",
+                   "HOROVOD_SERIES_RESOLUTION": "0.2",
+                   "HOROVOD_SERIES_RETENTION": "120",
+                   # The publisher's own cadence samples are rate-limited
+                   # away so the worker's synthetic near-cap sample stays
+                   # the gauge value every snapshot republishes.
+                   "HOROVOD_MEM_INTERVAL": "3600"},
+        launcher_args=["--postmortem", str(pm)])
+    # --postmortem redirects worker streams to DIR/logs/rank.N/
+    out = proc.stdout + proc.stderr
+    for rank in (0, 1):
+        for stream in ("stdout", "stderr"):
+            p = pm / "logs" / f"rank.{rank}" / stream
+            if p.exists():
+                out += p.read_text()
+    assert out.count("MEM-OK") >= 2, out[-6000:]
+
+    # The driver-side engine announced the pressure transition.
+    assert "ALERT critical mem-pressure-high" in proc.stderr, \
+        proc.stderr[-4000:]
+
+    # The sentinel's black box: a parseable explicit flight dump with
+    # the watermark in the reason, on BOTH ranks (each crossed its own
+    # synthetic cap), under the postmortem dir's per-rank path.
+    for rank in (0, 1):
+        path = pm / f"flight.rank.{rank}.mem"
+        assert path.exists(), sorted(p.name for p in pm.iterdir())
+        fr = PM.parse_flight_record(str(path))
+        assert fr["complete"] is True
+        assert fr["reason"].startswith("explicit:mem watermark="), \
+            fr["reason"]

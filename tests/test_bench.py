@@ -146,10 +146,12 @@ def test_bench_overlap_cpu_contract():
 @pytest.mark.slow
 def test_bench_zero_cpu_contract():
     """--zero: the ZeRO sweep artifact (docs/zero.md): per-level
-    {analytical peak bytes, step_time, exposed_comm_bytes, ledger
-    drift}, the acceptance reductions (>= 2x state+grad at level 2,
-    >= n/2 x params at level 3), levels 1/2/3 equivalence asserted
-    in-bench, the gate-able sub_rows, and the CPU-virtual labeling."""
+    {analytical peak bytes, MEASURED peak bytes + mem drift
+    (perf/memstats.py; docs/memory.md), step_time, exposed_comm_bytes,
+    ledger drift}, the acceptance reductions (>= 2x state+grad at
+    level 2, >= n/2 x params at level 3), levels 1/2/3 equivalence
+    asserted in-bench, the gate-able sub_rows, and the CPU-virtual
+    labeling."""
     env = dict(os.environ)
     env["BENCH_DEADLINE_S"] = "300"
     rec = _run_bench("--zero", env=env, timeout=400)
@@ -183,11 +185,25 @@ def test_bench_zero_cpu_contract():
     for lv in ("1", "2", "3"):
         drift = toy[lv]["model_drift_ratio"]
         assert drift is not None and 0.0 < drift < 50.0, (lv, drift)
+    # the memory plane's measured side rode along: a peak measurement
+    # per row (CPU-virtual live-buffer aggregate, labeled as such) with
+    # a finite reconciliation against the analytical prediction
+    for lv in ("0", "1", "2", "3"):
+        row = toy[lv]
+        assert row["measured_peak_bytes"] is not None \
+            and row["measured_peak_bytes"] >= 0, (lv, row)
+        assert row["measured_source"] in ("device", "live_buffers")
+        mdrift = row["mem_drift_ratio"]
+        assert mdrift is not None and 0.0 < mdrift < 1e4, (lv, mdrift)
     llama = rec["llama"]
     assert set(llama) == {"1", "2", "3"}
     for row in llama.values():
         assert row["tokens_per_s"] > 0
         assert row["peak_bytes"]["total_bytes"] > 0
+        assert row["measured_peak_bytes"] is not None \
+            and row["measured_peak_bytes"] >= 0
+        mdrift = row["mem_drift_ratio"]
+        assert mdrift is not None and 0.0 < mdrift < 1e4
     subs = {r["metric"]: r for r in rec["sub_rows"]}
     assert subs["zero level2 state+grad memory reduction"]["value"] >= 2
     assert subs["zero level3 param memory reduction"]["value"] >= n / 2

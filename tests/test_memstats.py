@@ -1,0 +1,452 @@
+"""Memory plane, fast tier (docs/memory.md):
+
+  * measurement — the CPU-virtual fallback (``memory_stats()`` is None
+    on the CPU backend) aggregates ``jax.live_arrays()`` without
+    raising; knob validation; kill switch and rate limit;
+  * reconciliation — drift against ``zero_memory_bytes`` stays finite
+    and bounded across all four ZeRO levels; the report section carries
+    the measured-vs-predicted plane table and the headroom number;
+  * sentinel — the high-watermark latch fires ONCE per below->above
+    transition, writes a parseable ``.mem`` flight dump (fake core and
+    the real native core), and stays quiet with no cap;
+  * fleet surface — heartbeats carry the watermark, the committed
+    mem-pressure-high / kv-pool-dry / mem-model-drift rules fire on the
+    exact transitions they document and stay quiet on padded zeros;
+  * forensics — the postmortem ``oom`` classification (SIGKILL + final
+    heartbeat above threshold) and the highest-watermark suspect rule;
+  * serve — BlockAllocator occupancy counts for the KV-pool plane.
+
+The 2-process mem-series/alert/flight-dump experiment lives in
+tests/integration/test_mem_integration.py.
+"""
+
+import math
+import os
+
+import pytest
+
+from horovod_tpu import postmortem as PM
+from horovod_tpu.common.basics import (CoordinationCore, LoopbackHub,
+                                       OP_ALLREDUCE)
+from horovod_tpu.perf import memstats
+from horovod_tpu.utils import health as H
+from horovod_tpu.utils import metrics as M
+from horovod_tpu.watch.rules import DEFAULT_RULES, AlertEngine
+from horovod_tpu.watch.series import SeriesStore
+
+import horovod_tpu.perf as perf
+
+
+@pytest.fixture(autouse=True)
+def _mem_on(monkeypatch):
+    # CI's mem-off knob dimension runs this suite with HOROVOD_MEM=0;
+    # these tests exercise the sampler itself, so they re-enable it
+    # (a test-level setenv, e.g. the kill-switch test, still wins).
+    monkeypatch.setenv("HOROVOD_MEM", "1")
+
+
+@pytest.fixture
+def fresh_mem():
+    memstats.reset()
+    perf.reset()
+    yield
+    memstats.reset()
+    perf.reset()
+
+
+@pytest.fixture
+def loopback_core():
+    hub = LoopbackHub(1)
+    core = CoordinationCore.loopback(hub, rank=0)
+    yield core
+    core.shutdown()
+    core.close()
+    hub.close()
+
+
+def _negotiate_one(core):
+    core.submit("t0", "f32:4", OP_ALLREDUCE, 16)
+    assert core.wait(5.0) is not None
+
+
+class _FakeCore:
+    """Duck-typed core: records flight dumps and writes a minimal
+    parseable record (the test_watch sentinel convention)."""
+
+    def __init__(self):
+        self.dumps = []
+
+    def flight_dump(self, path, reason=""):
+        self.dumps.append((path, reason))
+        with open(path, "w") as f:
+            f.write(f"hvd_flight_v1\nreason explicit:{reason}\nrank 0\n"
+                    "[end]\n")
+        return True
+
+
+# ------------------------------------------------------------------ knobs
+def test_validate_mem_knobs_accepts_defaults():
+    memstats.validate_mem_knobs({"HOROVOD_MEM_INTERVAL": 0.0,
+                                 "HOROVOD_MEM_HIGH_WATERMARK": 0.9})
+    memstats.validate_mem_knobs({"HOROVOD_MEM_INTERVAL": 30,
+                                 "HOROVOD_MEM_HIGH_WATERMARK": 1.0})
+
+
+@pytest.mark.parametrize("knobs", [
+    {"HOROVOD_MEM_INTERVAL": -1, "HOROVOD_MEM_HIGH_WATERMARK": 0.9},
+    {"HOROVOD_MEM_INTERVAL": 0.0, "HOROVOD_MEM_HIGH_WATERMARK": 0.0},
+    {"HOROVOD_MEM_INTERVAL": 0.0, "HOROVOD_MEM_HIGH_WATERMARK": 1.5},
+])
+def test_validate_mem_knobs_rejects_bad(knobs):
+    with pytest.raises(ValueError):
+        memstats.validate_mem_knobs(knobs)
+
+
+def test_kill_switch_disables_sampling(monkeypatch, fresh_mem):
+    monkeypatch.setenv("HOROVOD_MEM", "0")
+    assert not memstats.enabled()
+    assert memstats.sample(force=True) is None
+    assert memstats.last_sample() is None
+
+
+def test_interval_rate_limits_but_force_wins(monkeypatch, fresh_mem):
+    monkeypatch.setenv("HOROVOD_MEM_INTERVAL", "100")
+    s = memstats.MemSampler()
+    assert s.sample(now=1000.0) is not None
+    assert s.sample(now=1050.0) is None          # inside the window
+    assert s.sample(now=1050.0, force=True) is not None
+    assert s.sample(now=1200.0) is not None      # window elapsed
+
+
+# ------------------------------------------------------------ measurement
+def test_measure_device_cpu_fallback_no_raise():
+    """memory_stats() returning None (the CPU backend) falls back to
+    the aggregate live-array size with the honest source label."""
+    import jax.numpy as jnp
+    arr = jnp.ones((1024,), dtype=jnp.float32)
+    m = memstats.measure_device()
+    assert m["source"] in ("device", "live_buffers")
+    if m["source"] == "live_buffers":
+        assert m["bytes_in_use"] >= arr.nbytes
+        assert m["cap_bytes"] == 0  # no invented cap under the fallback
+    assert m["bytes_in_use"] >= 0
+    del arr
+
+
+def test_host_rss_readable():
+    # Linux CI has procfs; the helper contract is "never raise".
+    assert memstats.read_host_rss_bytes() >= 0
+
+
+def test_sample_row_shape(fresh_mem):
+    import jax.numpy as jnp
+    arr = jnp.ones((256,), dtype=jnp.float32)
+    row = memstats.sample(force=True, cap_bytes=1 << 30)
+    assert row is not None
+    for key in ("time", "source", "bytes_in_use", "peak_bytes_in_use",
+                "cap_bytes", "host_rss_bytes", "watermark",
+                "headroom_bytes", "planes", "model_drift_ratio"):
+        assert key in row
+    assert row["cap_bytes"] == 1 << 30
+    assert 0.0 <= row["watermark"] < 1.0
+    assert row["headroom_bytes"] == row["cap_bytes"] - row["bytes_in_use"]
+    assert row["peak_bytes_in_use"] >= row["bytes_in_use"] >= arr.nbytes
+    # fusion/overlap working set attributes from the default knobs.
+    assert row["planes"].get("fusion_overlap", 0) > 0
+    assert memstats.last_sample()["time"] == row["time"]
+    del arr
+
+
+# ---------------------------------------------------------- reconciliation
+@pytest.mark.parametrize("level", [0, 1, 2, 3])
+def test_drift_bounded_across_zero_levels(fresh_mem, level):
+    """Measured-vs-predicted drift stays finite and bounded for every
+    ZeRO level on the CPU-virtual source (the bench --zero contract)."""
+    import jax.numpy as jnp
+    arr = jnp.ones((512,), dtype=jnp.float32)
+    perf.configure(zero_model={"n_params": 100_000, "world": 2,
+                               "level": level, "opt_slots": 2})
+    row = memstats.sample(force=True)
+    assert row["predicted"] is not None
+    assert row["predicted"]["total_bytes"] > 0
+    drift = row["model_drift_ratio"]
+    assert drift is not None and math.isfinite(drift)
+    assert 0.0 < drift < 1e6
+    del arr
+
+
+def test_report_section_shape(fresh_mem):
+    import jax.numpy as jnp
+    assert memstats.report_section() is None  # no sample yet
+    arr = jnp.ones((256,), dtype=jnp.float32)
+    perf.configure(zero_model={"n_params": 50_000, "world": 4,
+                               "level": 2, "opt_slots": 2})
+    memstats.sample(force=True, cap_bytes=1 << 30)
+    del arr
+    sec = memstats.report_section()
+    assert sec is not None
+    assert sec["source"] in ("device", "live_buffers")
+    meas = sec["measured"]
+    for key in ("bytes_in_use", "peak_bytes_in_use", "cap_bytes",
+                "host_rss_bytes", "watermark", "headroom_bytes"):
+        assert key in meas
+    assert sec["predicted_total_bytes"] > 0
+    assert sec["model_drift_ratio"] is not None
+    assert sec["pressure_events"] == 0
+    # The plane table pairs each training-state plane's prediction with
+    # the attributed bytes; infra planes carry attribution only.
+    for plane in ("params", "grads", "opt_state", "ef_residual"):
+        assert sec["planes"][plane]["predicted_bytes"] >= 0
+    assert sec["planes"]["fusion_overlap"]["predicted_bytes"] is None
+
+
+def test_perf_report_carries_memory_section(fresh_mem):
+    memstats.sample(force=True, cap_bytes=1 << 30)
+    rep = perf.report()
+    assert isinstance(rep.get("memory"), dict)
+    assert rep["memory"]["measured"]["cap_bytes"] == 1 << 30
+
+
+# ---------------------------------------------------------------- sentinel
+def test_pressure_latch_fires_once_per_transition(monkeypatch, fresh_mem,
+                                                  tmp_path):
+    import jax.numpy as jnp
+    arr = jnp.ones((256,), dtype=jnp.float32)
+    monkeypatch.setenv("HOROVOD_FLIGHT_RECORD", str(tmp_path / "flight"))
+    core = _FakeCore()
+    s = memstats.MemSampler()
+    b = memstats.measure_device()["bytes_in_use"]
+    assert b > 0
+    above = b          # watermark 1.0 >= 0.9
+    below = b * 100    # watermark ~0.01
+
+    s.sample(core=core, cap_bytes=above, force=True)
+    assert s.pressure_events == 1            # below -> above: fires
+    s.sample(core=core, cap_bytes=above, force=True)
+    assert s.pressure_events == 1            # hovering: no re-fire
+    s.sample(core=core, cap_bytes=below, force=True)
+    assert s.pressure_events == 1            # dropped below: re-armed
+    s.sample(core=core, cap_bytes=above, force=True)
+    assert s.pressure_events == 2            # second transition: fires
+
+    assert len(core.dumps) == 2
+    path, reason = core.dumps[0]
+    assert path.endswith(".mem")
+    assert reason.startswith("mem watermark=")
+    assert s.dump_paths == [p for p, _ in core.dumps]
+    fr = PM.parse_flight_record(path)
+    assert fr["reason"].startswith("explicit:mem watermark=")
+    del arr
+
+
+def test_pressure_quiet_without_cap(fresh_mem):
+    """No cap known (the CPU fallback) -> proximity undefined -> the
+    sentinel must stay quiet rather than page on watermark 0.0."""
+    s = memstats.MemSampler()
+    row = s.sample(force=True)
+    assert row["watermark"] == 0.0
+    assert s.pressure_events == 0 and not s.pressure_above
+
+
+def test_pressure_dump_via_real_core(monkeypatch, fresh_mem, tmp_path,
+                                     loopback_core):
+    import jax.numpy as jnp
+    arr = jnp.ones((256,), dtype=jnp.float32)
+    monkeypatch.setenv("HOROVOD_FLIGHT_RECORD", str(tmp_path / "flight"))
+    _negotiate_one(loopback_core)
+    s = memstats.MemSampler()
+    b = memstats.measure_device()["bytes_in_use"]
+    s.sample(core=loopback_core, cap_bytes=max(1, b), force=True)
+    assert len(s.dump_paths) == 1
+    path = s.dump_paths[0]
+    assert path.endswith(".mem") and os.path.exists(path)
+    fr = PM.parse_flight_record(path)
+    assert fr["complete"] is True
+    assert fr["reason"].startswith("explicit:mem watermark=")
+    del arr
+
+
+# ------------------------------------------------------------- native core
+def test_native_mem_snapshot(fresh_mem, loopback_core):
+    nm = memstats.native_mem(loopback_core)
+    assert nm is not None and nm["version"] >= 1
+    assert nm["rss_bytes"] > 0
+    assert nm["trace_ring_bytes"] > 0
+    _negotiate_one(loopback_core)
+    row = memstats.sample(core=loopback_core, force=True)
+    assert row["planes"]["native_core"] > 0
+    assert row["native"]["rss_bytes"] > 0
+
+
+def test_native_mem_absent_is_none(fresh_mem):
+    assert memstats.native_mem(object()) is None  # no handle attribute
+
+
+# ------------------------------------------------------------- heartbeats
+def test_heartbeat_carries_mem(fresh_mem):
+    memstats.sample(force=True, cap_bytes=1 << 30)
+    hb = H.heartbeat_payload(0)
+    assert hb["mem"]["cap_bytes"] == 1 << 30
+    assert 0.0 <= hb["mem"]["watermark"] < 1.0
+    assert hb["mem"]["source"] in ("device", "live_buffers")
+
+
+# ---------------------------------------------------------------- kv pool
+def test_kv_pool_provider_and_util_gauge(fresh_mem):
+    memstats.set_kv_pool_provider(
+        lambda: {"used_blocks": 8, "free_blocks": 0, "shared_blocks": 2,
+                 "pool_bytes": 4096})
+    row = memstats.sample(force=True)
+    assert row["kv_pool"]["used_blocks"] == 8
+    assert row["planes"]["kv_pool"] == 4096
+    assert M.MEM_KV_UTIL.value() == 1.0
+    # A half-full pool reads below the dry threshold.
+    memstats.set_kv_pool_provider(
+        lambda: {"used_blocks": 4, "free_blocks": 4, "shared_blocks": 0,
+                 "pool_bytes": 4096})
+    memstats.sample(force=True)
+    assert M.MEM_KV_UTIL.value() == 0.5
+
+
+def test_kv_pool_provider_failure_is_absence(fresh_mem):
+    def boom():
+        raise RuntimeError("closing engine")
+    memstats.set_kv_pool_provider(boom)
+    assert memstats.kv_pool_stats() is None
+    row = memstats.sample(force=True)
+    assert "kv_pool" not in row["planes"]
+    memstats.reset()          # reset unregisters the provider
+    assert memstats._kv_pool_fn is None
+
+
+def test_block_allocator_occupancy():
+    from horovod_tpu.serve.engine import BlockAllocator
+    a = BlockAllocator(8)
+    assert a.occupancy() == {"num_blocks": 8, "used_blocks": 0,
+                             "free_blocks": 8, "shared_blocks": 0}
+    blocks = a.alloc(3)
+    assert a.occupancy()["used_blocks"] == 3
+    assert a.occupancy()["free_blocks"] == 5
+    a.incref([blocks[0]])     # prefix sharing: two owners
+    assert a.occupancy()["shared_blocks"] == 1
+    a.free([blocks[0]])       # one owner lets go: still resident
+    occ = a.occupancy()
+    assert occ["used_blocks"] == 3 and occ["shared_blocks"] == 0
+    a.free(blocks)
+    assert a.occupancy() == {"num_blocks": 8, "used_blocks": 0,
+                             "free_blocks": 8, "shared_blocks": 0}
+
+
+# ---------------------------------------------------------- default rules
+def _default_engine():
+    store = SeriesStore(retention_s=600, resolution_s=0.001)
+    return store, AlertEngine(store, rules=None)  # committed defaults
+
+
+def _fired_count(eng, rule):
+    return sum(row["count"] for row in eng.fired_total()
+               if row["rule"] == rule)
+
+
+def test_mem_pressure_rule_fires_once_per_transition():
+    store, eng = _default_engine()
+    for t in (100.0, 105.0, 111.0):
+        store.add(0, "hvd_mem_watermark", t, 0.95)
+        store.add(0, "hvd_mem_bytes_in_use", t, 9.5e9)
+    eng.evaluate(100.0)
+    firing = eng.evaluate(111.0)              # held past for: 10
+    mine = [f for f in firing if f["rule"] == "mem-pressure-high"]
+    assert mine and mine[0]["severity"] == "critical"
+    assert mine[0]["context"] == {"hvd_mem_bytes_in_use": 9.5e9}
+    eng.evaluate(112.0)                       # still above: no re-fire
+    assert _fired_count(eng, "mem-pressure-high") == 1
+    store.add(0, "hvd_mem_watermark", 113.0, 0.2)
+    assert not eng.evaluate(113.0)            # resolved
+    store.add(0, "hvd_mem_watermark", 120.0, 0.95)
+    eng.evaluate(120.0)
+    eng.evaluate(131.0)                       # second transition
+    assert _fired_count(eng, "mem-pressure-high") == 2
+
+
+def test_mem_rules_quiet_on_padded_zeros():
+    """Registry padding snapshots every unset gauge as 0.0 on every
+    rank — the committed mem rules must read 0.0 as healthy."""
+    store, eng = _default_engine()
+    for t in (100.0, 110.0, 120.0, 140.0):
+        for fam in ("hvd_mem_watermark", "hvd_mem_kv_util",
+                    "hvd_mem_model_drift_ratio"):
+            store.add(0, fam, t, 0.0)
+    eng.evaluate(100.0)
+    assert eng.evaluate(140.0) == []
+
+
+def test_kv_pool_dry_rule_fires_on_full_util():
+    store, eng = _default_engine()
+    for t in (100.0, 105.0, 111.0):
+        store.add(1, "hvd_mem_kv_util", t, 1.0)
+        store.add(1, "hvd_mem_kv_blocks_used", t, 64.0)
+    eng.evaluate(100.0)
+    firing = eng.evaluate(111.0)
+    mine = [f for f in firing if f["rule"] == "kv-pool-dry"]
+    assert mine and mine[0]["rank"] == 1
+    assert mine[0]["context"] == {"hvd_mem_kv_blocks_used": 64.0}
+
+
+def test_mem_model_drift_rule():
+    store, eng = _default_engine()
+    for t in (100.0, 110.0, 116.0):
+        store.add(0, "hvd_mem_model_drift_ratio", t, 2.5)
+    eng.evaluate(100.0)
+    assert [f["rule"] for f in eng.evaluate(116.0)] == ["mem-model-drift"]
+    store.add(0, "hvd_mem_model_drift_ratio", 117.0, 1.5)
+    assert eng.evaluate(117.0) == []          # within 2x: healthy
+
+
+# --------------------------------------------------------------- forensics
+@pytest.mark.parametrize("rc", [-9, 137])
+def test_classify_exit_oom(rc):
+    hb = {"mem": {"watermark": 0.95, "cap_bytes": 100}}
+    assert PM.classify_exit(rc, heartbeat=hb) == "oom"
+
+
+def test_classify_exit_oom_needs_pressure_and_sigkill():
+    high = {"mem": {"watermark": 0.99}}
+    assert PM.classify_exit(-9, heartbeat=None) == "signal:SIGKILL"
+    assert PM.classify_exit(
+        -9, heartbeat={"mem": {"watermark": 0.5}}) == "signal:SIGKILL"
+    assert PM.classify_exit(-11, heartbeat=high) == "signal:SIGSEGV"
+    # Supervision verdicts and fail-fast collateral still win.
+    assert PM.classify_exit(-9, supervision_cause="stall",
+                            heartbeat=high) == "stall"
+    assert PM.classify_exit(-9, by_launcher=True,
+                            heartbeat=high) == "terminated"
+
+
+def test_classify_suspect_oom_evidence():
+    cls, evidence = PM.classify_suspect(
+        {"exit": {"classification": "oom"},
+         "heartbeat": {"mem": {"watermark": 0.95}}})
+    assert cls == "oom"
+    assert "OOM-killer" in evidence[0] and "95%" in evidence[0]
+
+
+def test_build_postmortem_oom_suspect_is_highest_watermark():
+    """Exit times race under the kernel's OOM killer; the suspect is
+    the rank whose final heartbeat sat highest, not whoever's waitpid
+    landed first."""
+    exits = {0: {"rc": -9, "time": 10.0}, 1: {"rc": -9, "time": 11.0}}
+    health = {"ranks": {
+        "0": {"heartbeat": {"rank": 0, "time": 9.0, "step": 5,
+                            "mem": {"watermark": 0.92,
+                                    "bytes_in_use": 92, "cap_bytes": 100}},
+              "age_s": 1.0},
+        "1": {"heartbeat": {"rank": 1, "time": 9.5, "step": 5,
+                            "mem": {"watermark": 0.97,
+                                    "bytes_in_use": 97, "cap_bytes": 100}},
+              "age_s": 0.5},
+    }}
+    pm = PM.build_postmortem({"job": "j"}, exits, health_view=health)
+    assert pm["first_failure"]["rank"] == 0          # earliest exit
+    assert pm["suspect"]["rank"] == 1                # highest watermark
+    assert pm["suspect"]["classification"] == "oom"
+    assert pm["ranks"]["0"]["exit"]["classification"] == "oom"
