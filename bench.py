@@ -305,7 +305,11 @@ def supervise(argv) -> int:
     deadline = float(os.environ.get("BENCH_DEADLINE_S", "1200"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "55"))
 
-    if "--cpu" not in argv:
+    # --scenario replays on a virtual clock (CPU by construction, even
+    # when the spec says engine: real — that path forces JAX CPU); the
+    # TPU probe would only block a mode that never touches the chip.
+    scenario_mode = any(a.split("=", 1)[0] == "--scenario" for a in argv)
+    if "--cpu" not in argv and not scenario_mode:
         reason = probe_tpu(probe_timeout)
         if reason:
             return fail(reason, cause="tunnel-down",
@@ -370,7 +374,8 @@ def supervise(argv) -> int:
     # the stderr tail so the next hardware window can attribute the
     # crash without re-reproducing it.
     cause = "timeout" if status == "timeout" else "bench-crash"
-    if "--cpu" not in argv and probe_tpu(probe_timeout):
+    if "--cpu" not in argv and not scenario_mode and \
+            probe_tpu(probe_timeout):
         cause = "tunnel-down-during-run"
     return fail(f"bench child produced no JSON ({status})", cause=cause,
                 exit_cause=exit_cause, stderr_tail=stderr_tail,
@@ -491,6 +496,17 @@ def main() -> int:
                          "process baseline vs the sharded + direct-"
                          "stream control plane (default sweep "
                          "1,2,4,8,16,24)")
+    ap.add_argument("--scenario", metavar="SPEC_YAML", default=None,
+                    help="deterministic scenario replay "
+                         "(horovod_tpu/scenario; docs/scenarios.md): "
+                         "run the spec's trace + fault storm against "
+                         "the real router/watch planes on a virtual "
+                         "clock, twice — byte-identical SLO rows are "
+                         "the validity gate — then once against a live "
+                         "rendezvous server whose GET /alerts is "
+                         "checked against the spec's expect_alerts; "
+                         "per-scenario rows ride the artifact as "
+                         "sub_rows for perf/gate.py")
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU (smoke mode)")
     ap.add_argument("--profile", metavar="DIR", default=None,
@@ -540,6 +556,11 @@ def main() -> int:
 
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
+    if args.scenario:
+        # Virtual-clock replay: no jax import unless the spec says
+        # engine: real, and even then the replay is CPU by construction.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        return scenario_bench(args)
     if (args.wire or args.overlap or args.zero) and args.cpu and \
             "xla_force_host_platform_device_count" \
             not in os.environ.get("XLA_FLAGS", ""):
@@ -1567,6 +1588,122 @@ def zero_bench(args) -> int:
     return 0
 
 
+def scenario_bench(args) -> int:
+    """Deterministic scenario replay (horovod_tpu/scenario;
+    docs/scenarios.md): execute the spec's workload trace + fault storm
+    against the real router/watch planes on a virtual clock.  Validity
+    gates before an artifact prints: (1) two independent harness runs
+    must produce byte-identical canonical SLO rows AND event digests
+    (the determinism contract the corpus is committed under); (2) a
+    third run feeds a LIVE rendezvous server's watch plane and the
+    spec's ``expect_alerts`` must all appear in ``GET /alerts``
+    ``fired_total`` — alert expectations are checked over the same HTTP
+    surface operators read, not an in-process shortcut.  Per-scenario
+    rows ride the one artifact line as ``sub_rows`` (perf/gate.py
+    expands them into standalone baseline keys).  Virtual-clock
+    latencies measure queueing/scheduling/recovery under the declared
+    load, not chip decode — labeled accordingly."""
+    from horovod_tpu.scenario import (ScenarioHarness, canonical_rows,
+                                      load_scenario, rows_jsonl)
+    try:
+        spec = load_scenario(args.scenario)
+    except (OSError, ValueError) as e:
+        return fail(f"scenario spec {args.scenario!r}: {e}",
+                    cause="invalid-result")
+    # Knob overrides (common/knobs.py; validated at hvd.init — here the
+    # same parse, tolerant of the empty-string default).
+    vranks = int(os.environ.get("HOROVOD_SCENARIO_RANKS", "0") or 0) \
+        or None
+    tick_ms = float(os.environ.get("HOROVOD_SCENARIO_TICK_MS", "0")
+                    or 0.0)
+    if tick_ms > 0:
+        import dataclasses as _dc
+        spec = _dc.replace(spec, tick_ms=tick_ms)
+
+    t0 = time.perf_counter()
+    first = ScenarioHarness(spec, virtual_ranks=vranks).run()
+    second = ScenarioHarness(spec, virtual_ranks=vranks).run()
+    rows = canonical_rows(first)
+    if first["digest"] != second["digest"]:
+        return fail(
+            f"scenario {spec.name}: event digest differs across two "
+            f"runs of one seed ({first['digest'][:12]} vs "
+            f"{second['digest'][:12]}) — the trace generator is "
+            "nondeterministic", cause="invalid-result")
+    if rows_jsonl(rows) != rows_jsonl(canonical_rows(second)):
+        return fail(
+            f"scenario {spec.name}: SLO rows differ across two runs of "
+            "one seed — the replay harness is nondeterministic",
+            cause="invalid-result")
+
+    # Live-server leg: the watch plane under a real RendezvousServer,
+    # alerts read back over HTTP like an operator would.
+    from horovod_tpu.runner.http_server import RendezvousServer
+    server = RendezvousServer(port=0)
+    port = server.start()
+    try:
+        if spec.alert_rules:
+            from horovod_tpu.watch import parse_rules
+            server.install_alert_rules(parse_rules(spec.alert_rules))
+        live = ScenarioHarness(spec, watch=server.watch_state,
+                               virtual_ranks=vranks).run()
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/alerts", timeout=30) as resp:
+            alerts_view = json.loads(resp.read().decode())
+    finally:
+        server.stop()
+    wall = time.perf_counter() - t0
+    if rows_jsonl(canonical_rows(live)) != rows_jsonl(rows):
+        return fail(
+            f"scenario {spec.name}: SLO rows differ between the "
+            "private and live watch sinks — the watch feed leaked "
+            "into the replay", cause="invalid-result")
+    fired = sorted({f["rule"]
+                    for f in alerts_view.get("fired_total", [])
+                    if f.get("count", 0) > 0})
+    missing = [r for r in spec.expect_alerts if r not in fired]
+    if missing:
+        return fail(
+            f"scenario {spec.name}: expect_alerts never fired: "
+            f"{missing} (GET /alerts fired_total: {fired})",
+            cause="invalid-result")
+
+    slo = first["slo"]
+    req = first["requests"]
+    label = ("CPU-virtual clock (tick arithmetic — queueing/"
+             "scheduling/recovery under the declared load, not chip "
+             "decode)")
+    print(json.dumps({
+        "sub_rows": rows,
+        "metric": f"scenario {spec.name} replay "
+                  f"({req['completed']}/{req['arrived']} reqs, "
+                  f"{first['virtual_ranks']} vranks, "
+                  f"{first['restarts']} restart(s), ttft p99 "
+                  f"{slo['ttft_p99_s'] * 1e3:.1f} ms) [{label}]",
+        "value": slo["throughput_tok_s"],
+        "unit": "tokens/sec",
+        "vs_baseline_is": "completed_over_arrived",
+        "vs_baseline": round(req["completed"] / max(1, req["arrived"]),
+                             4),
+        "label": label,
+        "wall_s": round(wall, 3),
+        "scenario": os.path.basename(args.scenario),
+        "digest": first["digest"],
+        "slo": slo,
+        "requests": req,
+        "per_rank": first["per_rank"],
+        "phases": first["phases"],
+        "storms": first["storms"],
+        "restarts": first["restarts"],
+        "alerts": {"fired": fired,
+                   "expected": list(spec.expect_alerts),
+                   "missing": missing, "ok": not missing},
+        "metrics": metrics_summary(),
+    }))
+    return 0
+
+
 def serve_bench(args) -> int:
     """Serving load-generator sweep (serve/engine.py; docs/serving.md):
     the continuous-batching engine under two canonical load shapes —
@@ -1662,10 +1799,14 @@ def serve_bench(args) -> int:
     closed = drain(None)
     # Open-loop Poisson at ~60% of the measured closed-loop request
     # rate: under the saturation knee, so the row shows latency, not
-    # queue blow-up.
-    lam = max(0.1, 0.6 * closed["requests_per_s"])
-    arrivals = np.cumsum(rng.exponential(1.0 / lam, size=total))
-    poisson = drain(arrivals.tolist())
+    # queue blow-up.  The schedule comes from the scenario trace
+    # machinery's named built-in (scenario/trace.py BUILTIN_TRACES) so
+    # --serve and --scenario draw arrivals from ONE seeded generator.
+    from horovod_tpu.scenario import builtin_arrivals
+    arrivals = builtin_arrivals("serve-bench-poisson",
+                                closed_loop_rps=closed["requests_per_s"],
+                                n=total)
+    poisson = drain(arrivals)
 
     for mode, row in (("closed_loop", closed), ("poisson", poisson)):
         if row["requests"] != total or row["ttft_p50_s"] <= 0 or \

@@ -30,7 +30,8 @@ from typing import Optional
 from ..common import hvdlogging as log
 from .injector import ChaosInjector, rank_stream_seed  # noqa: F401
 from .spec import (  # noqa: F401
-    ChaosEvent, ChaosSpec, load_spec, loads_spec, parse_spec)
+    ChaosEvent, ChaosSpec, load_spec, loads_spec, merge_specs,
+    parse_spec)
 
 KV_SCOPE = "chaos"
 KV_KEY = "spec"

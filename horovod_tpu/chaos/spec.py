@@ -112,10 +112,36 @@ class ChaosSpec:
         }, sort_keys=True)
 
 
+# Per-field value types: a bad value must name the EVENT INDEX and the
+# FIELD (a multi-event spec that raised a bare TypeError out of
+# ChaosEvent(**raw) left the experimenter bisecting by hand).  bool is
+# excluded from the int fields — YAML's `rank: true` is a typo, not -1.
+_EVENT_FIELD_TYPES: Dict[str, Any] = {
+    "kind": str,
+    "rank": int, "step": int, "count": int, "exit_code": int,
+    "shard": int,
+    "duration_ms": (int, float),
+    "point": str, "op": str, "scope": str,
+}
+
+
+def _check_event_field(i: int, kind: str, name: str, value: Any) -> None:
+    want = _EVENT_FIELD_TYPES[name]
+    ok = isinstance(value, want) and not (
+        isinstance(value, bool) and want is not str)
+    if not ok:
+        want_name = want.__name__ if isinstance(want, type) else \
+            "/".join(t.__name__ for t in want)
+        raise ValueError(
+            f"chaos spec: event #{i} ({kind}) field {name!r}: expected "
+            f"{want_name}, got {value!r} ({type(value).__name__})")
+
+
 def parse_spec(doc: Dict[str, Any]) -> ChaosSpec:
     """Build + validate a spec from a parsed YAML/JSON document.  Raises
-    ``ValueError`` on unknown kinds/fields so a typo'd experiment fails at
-    launch, not silently at the injection site."""
+    ``ValueError`` on unknown kinds/fields — and on wrong-typed field
+    values, naming the event index AND field — so a typo'd experiment
+    fails at launch, not silently at the injection site."""
     if not isinstance(doc, dict):
         raise ValueError(f"chaos spec must be a mapping, got {type(doc)}")
     unknown = set(doc) - {"seed", "state_dir", "events", "transport"}
@@ -135,6 +161,10 @@ def parse_spec(doc: Dict[str, Any]) -> ChaosSpec:
         if "kind" not in raw and len(raw) == 1:
             # shorthand: - kill: {rank: 1, step: 2}
             kind, body = next(iter(raw.items()))
+            if body is not None and not isinstance(body, dict):
+                raise ValueError(
+                    f"chaos spec: event #{i} ({kind}) body must be a "
+                    f"mapping, got {body!r} ({type(body).__name__})")
             raw = dict(body or {}, kind=kind)
         if raw.get("kind") not in EVENT_KINDS:
             raise ValueError(
@@ -144,10 +174,49 @@ def parse_spec(doc: Dict[str, Any]) -> ChaosSpec:
         if bad:
             raise ValueError(
                 f"chaos spec: event #{i} unknown fields {sorted(bad)}")
+        for name in sorted(raw):
+            _check_event_field(i, raw["kind"], name, raw[name])
         events.append(ChaosEvent(**raw))
     return ChaosSpec(seed=int(doc.get("seed") or 0),
                      state_dir=str(doc.get("state_dir") or ""),
                      events=events, transport=transport)
+
+
+def merge_specs(base: ChaosSpec, extra: ChaosSpec,
+                origins: tuple = ("--chaos", "scenario storm")
+                ) -> ChaosSpec:
+    """Compose two chaos plans into the ONE spec the launcher publishes
+    (docs/chaos.md#composition): ``hvdrun --chaos`` + a scenario's
+    embedded storm (scenario/storm.py) both reach the fleet, so their
+    merge semantics are defined HERE and validated at launch, never
+    improvised by a worker.
+
+    Events concatenate base-first (injectors keep per-event state, so
+    ordering only affects log/readback order).  Scalars must AGREE:
+    a seed/state_dir/transport-key set on both sides with different
+    values is a contradiction the launch must refuse — silently picking
+    one would replay a different experiment than either file describes.
+    Unset (falsy) values defer to the other side."""
+    b_name, e_name = origins
+    for field in ("seed", "state_dir"):
+        b, e = getattr(base, field), getattr(extra, field)
+        if b and e and b != e:
+            raise ValueError(
+                f"chaos spec merge: {field} conflicts between {b_name} "
+                f"({b!r}) and {e_name} ({e!r}); set it on one side only")
+    transport = dict(base.transport)
+    for key, value in extra.transport.items():
+        if key in transport and transport[key] != value:
+            raise ValueError(
+                f"chaos spec merge: transport fault {key!r} conflicts "
+                f"between {b_name} ({transport[key]!r}) and {e_name} "
+                f"({value!r}); set it on one side only")
+        transport[key] = value
+    return ChaosSpec(
+        seed=base.seed or extra.seed,
+        state_dir=base.state_dir or extra.state_dir,
+        events=list(base.events) + list(extra.events),
+        transport=transport)
 
 
 def load_spec(path: str) -> ChaosSpec:

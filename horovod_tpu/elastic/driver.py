@@ -393,8 +393,10 @@ def run_elastic(args, command: List[str]) -> int:
     # Chaos plane: the spec rides the driver's rendezvous KV so every
     # incarnation of every worker (reset rounds included) installs the
     # same seeded plan (runner/launch.py publish_chaos_spec).
-    from ..runner.launch import install_alert_rules, publish_chaos_spec
+    from ..runner.launch import (
+        install_alert_rules, publish_chaos_spec, publish_scenario_spec)
     publish_chaos_spec(args, driver.rendezvous)
+    publish_scenario_spec(args, driver.rendezvous)
     # Watch plane: the alert engine + series store live in THIS driver's
     # rendezvous server, so fleet history and rule state span reset
     # rounds — a run that goes bad across a reset is still one series

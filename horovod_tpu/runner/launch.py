@@ -231,6 +231,14 @@ def make_parser() -> argparse.ArgumentParser:
                         "rank injects from one seeded plan; transport "
                         "faults export as HOROVOD_CHAOS_* env for the "
                         "native core")
+    p.add_argument("--scenario", default=None, metavar="SPEC_YAML",
+                   help="declarative workload scenario "
+                        "(horovod_tpu/scenario; docs/scenarios.md): "
+                        "validated at launch, published to the "
+                        "rendezvous KV scope 'scenario'; its embedded "
+                        "fault storm merges with --chaos (conflicts "
+                        "fail the launch) and its embedded alert rules "
+                        "install under any --alerts overrides")
     # --- elastic (reference: launch.py:621-670) ---
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
@@ -366,10 +374,10 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_ELASTIC_TIMEOUT"] = str(args.elastic_timeout)
     if args.reset_limit is not None:
         env["HOROVOD_ELASTIC_RESET_LIMIT"] = str(args.reset_limit)
-    if getattr(args, "chaos", None):
-        spec = load_chaos_spec(args)
+    merged_chaos = merged_chaos_spec(args)
+    if merged_chaos is not None:
         env["HOROVOD_CHAOS"] = "1"
-        env.update(spec.transport_env())
+        env.update(merged_chaos.transport_env())
     if getattr(args, "serve", None):
         # SLO observability for free (docs/serving.md): serving workers
         # publish hvd_serve_* metrics and heartbeats like any trainer.
@@ -388,15 +396,65 @@ def load_chaos_spec(args: argparse.Namespace):
     return args._chaos_spec
 
 
+def load_scenario_spec(args: argparse.Namespace):
+    """Parse + validate the --scenario spec once per launch (cached on
+    the args namespace — the load_chaos_spec contract: a typo'd scenario
+    must fail the launch, not a worker mid-replay).  None without
+    --scenario."""
+    if not getattr(args, "scenario", None):
+        return None
+    if getattr(args, "_scenario_spec", None) is None:
+        from ..scenario import load_scenario
+        args._scenario_spec = load_scenario(args.scenario)
+    return args._scenario_spec
+
+
+def merged_chaos_spec(args: argparse.Namespace):
+    """The ONE chaos plan this launch distributes: the --chaos spec
+    merged with the --scenario storm (chaos/spec.py ``merge_specs`` —
+    scenario logical-clock events land as step-scheduled ChaosEvents
+    via scenario/storm.py ``to_chaos_spec``).  Conflicting scalars fail
+    the LAUNCH here; returns None when neither side brings a plan."""
+    if getattr(args, "_merged_chaos", None) is None:
+        base = load_chaos_spec(args) if getattr(args, "chaos", None) \
+            else None
+        scen = load_scenario_spec(args)
+        storm_spec = None
+        if scen is not None and scen.storm:
+            from ..scenario import to_chaos_spec
+            storm_spec = to_chaos_spec(scen.storm, scen.tick_s,
+                                       seed=scen.seed)
+        if base is not None and storm_spec is not None:
+            from ..chaos import merge_specs
+            args._merged_chaos = merge_specs(base, storm_spec)
+        else:
+            args._merged_chaos = base or storm_spec
+    return args._merged_chaos
+
+
 def publish_chaos_spec(args: argparse.Namespace,
                        rendezvous: RendezvousServer) -> None:
-    """Put the chaos spec on the rendezvous KV (scope ``chaos``) so every
-    rank — local or ssh-remote — installs its injector from one plan."""
-    if not getattr(args, "chaos", None):
+    """Put the (merged) chaos spec on the rendezvous KV (scope
+    ``chaos``) so every rank — local or ssh-remote — installs its
+    injector from one plan."""
+    spec = merged_chaos_spec(args)
+    if spec is None:
         return
     from ..chaos import KV_KEY, KV_SCOPE
-    rendezvous.put(KV_SCOPE, KV_KEY,
-                   load_chaos_spec(args).to_json().encode())
+    rendezvous.put(KV_SCOPE, KV_KEY, spec.to_json().encode())
+
+
+def publish_scenario_spec(args: argparse.Namespace,
+                          rendezvous: RendezvousServer) -> None:
+    """Put the scenario spec on the rendezvous KV (scope ``scenario``)
+    — the chaos-spec distribution contract: every rank (and any replay
+    harness pointed at the fleet) reads ONE plan, as JSON, with no YAML
+    parser required (docs/scenarios.md)."""
+    spec = load_scenario_spec(args)
+    if spec is None:
+        return
+    from ..scenario import KV_KEY, KV_SCOPE
+    rendezvous.put(KV_SCOPE, KV_KEY, spec.to_json().encode())
 
 
 def install_alert_rules(args: argparse.Namespace,
@@ -417,6 +475,13 @@ def install_alert_rules(args: argparse.Namespace,
             from ..watch import load_rules
             args._alert_rules = load_rules(path)
         rules = args._alert_rules
+    scen = load_scenario_spec(args)
+    if scen is not None and scen.alert_rules:
+        from ..watch import parse_rules
+        operator_names = {r.name for r in (rules or [])}
+        scen_rules = [r for r in parse_rules(scen.alert_rules)
+                      if r.name not in operator_names]
+        rules = scen_rules + (rules or []) if scen_rules else rules
     rendezvous.install_alert_rules(rules)
 
 
@@ -862,6 +927,7 @@ def launch_static(args: argparse.Namespace, command: List[str]) -> int:
               "GET /metrics)",
               file=sys.stderr, flush=True)
     publish_chaos_spec(args, rendezvous)
+    publish_scenario_spec(args, rendezvous)
     install_alert_rules(args, rendezvous)
     for slot in slots:
         rendezvous.put("rank", str(slot.rank),

@@ -179,6 +179,11 @@ class Runtime:
         # limit and the OOM-proximity watermark fraction.
         from .perf import validate_mem_knobs
         validate_mem_knobs(self.knobs)
+        # Scenario engine (scenario/; docs/scenarios.md): rank/tick
+        # overrides, and — when HOROVOD_SCENARIO names a spec — a full
+        # parse, so a typo'd scenario fails bring-up, not a replay.
+        from .scenario import validate_scenario_knobs
+        validate_scenario_knobs(self.knobs)
         if self.knobs["HOROVOD_FUSION_THRESHOLD"] <= 0:
             raise ValueError(
                 f"HOROVOD_FUSION_THRESHOLD="

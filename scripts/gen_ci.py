@@ -77,7 +77,8 @@ SUITES = {
         "tests/test_platform_utils.py",
     ],
     "serving": ["tests/test_serve.py", "tests/test_serve_ft.py",
-                "tests/test_serve_speed.py", "tests/test_kv_shard.py"],
+                "tests/test_serve_speed.py", "tests/test_kv_shard.py",
+                "tests/test_scenario.py"],
     "perf": ["tests/test_perf.py", "tests/test_memstats.py"],
     "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py",
                        "tests/test_profile_analyzer.py"],
@@ -268,6 +269,18 @@ def build_steps():
         f"{full}",
         env={"JAX_PLATFORMS": "cpu"}, timeout=20))
     steps.append(_step(
+        # scenario distribution smoke: hvdrun --chaos + --scenario on a
+        # 2-proc run — the spec rides the rendezvous KV as JSON and
+        # both ranks regenerate the SAME trace digest, the embedded
+        # storm arrives as part of the MERGED chaos spec, the embedded
+        # alert rule lands in the published ruleset, and a
+        # contradictory --chaos seed refuses to launch
+        # (docs/scenarios.md).
+        "scenario: 2-process spec/storm/rules distribution smoke",
+        f"{py} -m pytest tests/integration/test_scenario_integration.py "
+        f"{full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=15))
+    steps.append(_step(
         "dryrun: 8-chip multichip shardings",
         f'{py} -c "import __graft_entry__ as g; g.dryrun_multichip(8)"',
         env={"JAX_PLATFORMS": "cpu",
@@ -337,6 +350,17 @@ def build_steps():
         # (docs/control-plane.md) — all CPU-virtual.
         "bench: serve control-plane saturation smoke",
         f"{py} bench.py --serve --users 1,2,4 --cpu", timeout=15))
+    steps.append(_step(
+        # scenario replay smoke: one committed corpus spec replayed
+        # against the REAL router/engine/watch planes on the virtual
+        # clock — two same-seed runs must produce byte-identical SLO
+        # rows (the bench fails itself otherwise), the expected alerts
+        # are verified against a live GET /alerts, and the rows ride
+        # the artifact for the perf gate (docs/scenarios.md) — all
+        # CPU-virtual.
+        "bench: scenario trace-replay smoke (burst-serve)",
+        f"{py} bench.py --scenario scenarios/burst-serve.yaml --cpu",
+        timeout=15))
     steps.append(_step(
         # perf regression gate smoke: bench.py --cpu runs three times —
         # two baseline the host's noise, the unmodified re-run must

@@ -27,6 +27,14 @@ default run checks the whole repo and exits nonzero on any violation:
                          no environment reads — every rank, the router
                          and the driver must derive the SAME partition
                          (docs/control-plane.md).
+  scenario-determinism   the scenario generators/replay
+                         (horovod_tpu/scenario) are pure functions of
+                         (spec, seed): the kvshard discipline applied
+                         module-wide — no RNG, no builtin hash(), no
+                         env reads, no wall-clock control flow, no set
+                         iteration, no random/time/uuid imports — so
+                         one spec yields byte-identical event streams
+                         and SLO rows everywhere (docs/scenarios.md).
   serve-kv-retry         serve-worker KV legs go through the _kv_op
                          bounded-backoff wrapper, never raw
                          get_kv/put_kv/delete_kv (a transient rendezvous
@@ -415,6 +423,53 @@ _KV_OPS = {"get_kv", "put_kv", "delete_kv"}
 _KV_WRAPPERS = {"_kv_op", "_kv_get", "_kv_put", "_kv_delete"}
 
 
+# --------------------------------------------------- scenario-determinism
+# The scenario generators/replay (horovod_tpu/scenario): the whole
+# module surface is determinism-critical — same spec, same seed must
+# yield byte-identical event streams and SLO rows across processes,
+# interpreter sessions and PYTHONHASHSEED values (docs/scenarios.md).
+# The kvshard discipline applies module-wide: no RNG, no builtin
+# hash(), no env reads, no wall-clock control flow, no set iteration,
+# and neither `random` nor `time` may even be imported.
+_SCENARIO_FILES = (
+    "horovod_tpu/scenario/trace.py",
+    "horovod_tpu/scenario/spec.py",
+    "horovod_tpu/scenario/storm.py",
+    "horovod_tpu/scenario/harness.py",
+)
+
+
+def check_scenario_determinism(
+        root: str = REPO,
+        files: Sequence[str] = _SCENARIO_FILES) -> List[Violation]:
+    """Scenario generators/replay are pure functions of (spec, seed):
+    no RNG, no hash(), no env/wall-clock, no set iteration."""
+    rule = "scenario-determinism"
+    out: List[Violation] = []
+    for rel in files:
+        src = _read(root, rel)
+        tree = ast.parse(src)
+        lines = src.splitlines()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mods = [a.name for a in node.names] if isinstance(
+                    node, ast.Import) else [node.module or ""]
+                bad = [m1 for m1 in mods
+                       if m1 == "random" or m1.startswith("random.")
+                       or m1 == "time" or m1.startswith("time.")
+                       or m1 == "uuid" or m1.startswith("uuid.")]
+                if bad and not _allowed(lines[node.lineno - 1], rule):
+                    out.append(Violation(
+                        rule, rel, node.lineno,
+                        f"{'/'.join(bad)} imported in a scenario module "
+                        "(every draw must come from scenario/trace.py "
+                        "Stream; docs/scenarios.md)"))
+        v = _KVShardVisitor(rel, lines, rule)
+        v.visit(tree)
+        out.extend(v.out)
+    return out
+
+
 def check_serve_kv_retry(
         root: str = REPO,
         files: Sequence[str] = ("horovod_tpu/serve/worker.py",
@@ -604,6 +659,7 @@ RULES = {
     "metrics-documented": check_metrics_documented,
     "serve-determinism": check_serve_determinism,
     "kvshard-determinism": check_kvshard_determinism,
+    "scenario-determinism": check_scenario_determinism,
     "serve-kv-retry": check_serve_kv_retry,
     "unique-test-basenames": check_unique_test_basenames,
     "signal-safety": check_signal_safety,

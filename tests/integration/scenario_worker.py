@@ -1,0 +1,86 @@
+"""Per-rank worker for the scenario distribution smoke test.
+
+The launcher was started with ``--scenario`` (a spec with an embedded
+storm and an embedded alert rule).  Each rank proves the three
+distribution legs of docs/scenarios.md from INSIDE the fleet:
+
+  1. the spec itself rides the rendezvous KV at scope ``scenario`` as
+     JSON (no YAML parser needed on the worker), and regenerating the
+     trace from it yields the SAME digest on every rank — the
+     byte-identity contract checked across real processes with
+     different PYTHONHASHSEED values (the launcher does not pin it);
+  2. the storm arrived as part of the MERGED chaos spec (scenario
+     storm events become step-scheduled ChaosEvents, composed with any
+     ``--chaos`` base by chaos/spec.py ``merge_specs``), so the chaos
+     injector is installed and carries the storm's stall;
+  3. the spec's embedded alert rule was merged into the published
+     ruleset at KV scope ``alerts`` — operator rules still win by
+     name, scenario rules fill the gaps.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def _get_json(path: str):
+    addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+    port = os.environ["HOROVOD_RENDEZVOUS_PORT"]
+    with urllib.request.urlopen(f"http://{addr}:{port}{path}",
+                                timeout=10) as r:
+        return json.loads(r.read())
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.process_size() == 2
+    rank = hvd.process_rank()
+
+    # (1) one plan, as JSON, from the KV — then regenerate and compare.
+    from horovod_tpu.runner.http_client import get_kv
+    from horovod_tpu.scenario import (KV_KEY, KV_SCOPE, events_digest,
+                                      generate_events, loads_scenario)
+    raw = get_kv(os.environ["HOROVOD_RENDEZVOUS_ADDR"],
+                 int(os.environ["HOROVOD_RENDEZVOUS_PORT"]),
+                 KV_SCOPE, KV_KEY, timeout=10)
+    assert raw, "scenario spec not published on the rendezvous KV"
+    spec = loads_scenario(raw.decode())
+    assert spec.name == "integration-smoke", spec.name
+    digest = events_digest(
+        generate_events(spec.seed, spec.phases, spec.vocab))
+    digests = hvd.allgather_object(digest)
+    assert len(set(digests)) == 1, \
+        f"trace digests diverged across ranks: {digests}"
+
+    # (2) the storm rode the merged chaos spec to every rank.
+    injector = hvd.chaos.active()
+    assert injector is not None, \
+        "chaos injector not installed from the scenario storm"
+    kinds = [e.kind for e in injector.spec.events]
+    assert "stall" in kinds, kinds
+
+    # (3) the embedded rule is in the published, merged ruleset.
+    names = {r["name"] for r in _get_json("/alerts/rules")["rules"]}
+    assert "scenario-smoke-rule" in names, names
+    assert "straggler-suspect" in names, names  # defaults still there
+
+    # A real collective round, so the fleet did actual work under the
+    # injector (the stall is scheduled far past our step count — this
+    # smoke proves distribution, not the storm's timeline).
+    x = np.full((4,), float(rank + 1), np.float32)
+    out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+    assert np.allclose(out, 3.0 * hvd.size() / 2), out
+
+    print(f"SCENARIO-KV-OK {rank} {digest}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

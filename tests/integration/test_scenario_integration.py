@@ -1,0 +1,84 @@
+"""Scenario-engine distribution smoke (docs/scenarios.md), 2 real
+processes under the real launcher:
+
+``hvdrun --scenario`` publishes the spec to the rendezvous KV (scope
+``scenario``, JSON wire format), converts the embedded storm into
+step-scheduled ChaosEvents merged with the ``--chaos`` base
+(chaos/spec.py ``merge_specs``), and merges the embedded alert rule
+into the published ruleset at KV scope ``alerts``.  Both ranks fetch
+the plan, regenerate the trace, and must land on the SAME digest —
+the byte-identity contract proven across fresh interpreter processes,
+not threads.  The storm's kill is scheduled far past the smoke's step
+count: this test proves the distribution legs, bench.py --scenario
+proves the replay itself.
+"""
+
+import re
+
+import pytest
+
+from test_multiprocess import run_hvdrun
+
+_SPEC = """
+name: integration-smoke
+seed: 11
+virtual_ranks: 32
+tick_ms: 10
+phases:
+  - name: steady
+    kind: serve
+    duration_s: 1.0
+    arrivals: {process: poisson, rate: 15}
+    shapes: {prompt_mean: 8, prompt_max: 24, output_mean: 4}
+storm:
+  - stall: {at_s: 0.5, duration_s: 0.05}
+alert_rules:
+  - name: scenario-smoke-rule
+    family: hvd_scenario_queue_depth
+    kind: threshold
+    op: ">="
+    value: 1e18
+    severity: info
+"""
+
+_BASE_CHAOS = """
+seed: 11
+events:
+  - stall: {rank: 0, step: 100000, point: complete, duration_ms: 1}
+"""
+
+
+@pytest.mark.integration
+def test_scenario_spec_storm_and_rules_reach_every_rank(tmp_path):
+    spec = tmp_path / "scenario.yaml"
+    spec.write_text(_SPEC)
+    base = tmp_path / "chaos.yaml"
+    base.write_text(_BASE_CHAOS)
+    proc = run_hvdrun(
+        "scenario_worker.py",
+        extra_env={"HVD_CPU_CHIPS": "1"},
+        # --chaos AND --scenario together: the merge leg is the point.
+        launcher_args=["--chaos", str(base), "--scenario", str(spec)])
+    # markers can interleave on one line: match, don't split lines
+    marks = re.findall(r"SCENARIO-KV-OK (\d) ([0-9a-f]{64})", proc.stdout)
+    assert len(marks) == 2, proc.stdout + proc.stderr
+    assert {r for r, _ in marks} == {"0", "1"}, marks
+    # the per-rank digests printed by the markers agree byte-for-byte
+    assert len({d for _, d in marks}) == 1, marks
+
+
+@pytest.mark.integration
+def test_scenario_storm_chaos_conflict_fails_launch(tmp_path):
+    """A --chaos base whose seed contradicts the scenario's must refuse
+    to launch (merge_specs conflict), not replay a third experiment."""
+    spec = tmp_path / "scenario.yaml"
+    spec.write_text(_SPEC)
+    base = tmp_path / "chaos.yaml"
+    base.write_text("seed: 99\nevents:\n  - stall: {rank: 0}\n")
+    proc = run_hvdrun(
+        "scenario_worker.py",
+        extra_env={"HVD_CPU_CHIPS": "1"},
+        launcher_args=["--chaos", str(base), "--scenario", str(spec)],
+        check=False)
+    assert proc.returncode != 0
+    assert "seed conflicts" in (proc.stderr + proc.stdout)

@@ -73,10 +73,53 @@ def test_spec_json_roundtrip():
     ({"transport": {"nuke_rate": 1.0}}, "transport"),
     ({"events": [{"kind": "kill", "blast_radius": 2}]}, "unknown fields"),
     ({"chaos": True}, "top-level"),
+    # field-level type errors name the EVENT INDEX and the FIELD
+    ({"events": [{"kind": "kill", "rank": "one"}]},
+     r"event #0 \(kill\) field 'rank': expected int, got 'one' \(str\)"),
+    ({"events": [{"kind": "kill"},
+                 {"stall": {"duration_ms": "long"}}]},
+     r"event #1 \(stall\) field 'duration_ms': expected int/float"),
+    ({"events": [{"kv_blackout": {"op": 3}}]},
+     r"event #0 \(kv_blackout\) field 'op': expected str, got 3"),
+    # YAML's `rank: true` is a typo, not an int
+    ({"events": [{"kind": "kill", "rank": True}]},
+     r"event #0 \(kill\) field 'rank': expected int, got True \(bool\)"),
+    ({"events": [{"kill": "rank 1"}]},
+     r"event #0 \(kill\) body must be a mapping, got 'rank 1'"),
 ])
 def test_spec_validation_fails_loudly(doc, msg):
     with pytest.raises(ValueError, match=msg):
         chaos.parse_spec(doc)
+
+
+def test_merge_specs_concatenates_and_defers():
+    """--chaos + scenario storm compose: events concatenate base-first,
+    unset scalars defer to whichever side set them."""
+    base = chaos.parse_spec({"seed": 9, "events": [
+        {"kind": "stall", "rank": 0, "step": 1}]})
+    extra = chaos.parse_spec({
+        "state_dir": "/tmp/st", "transport": {"dup_rate": 0.25},
+        "events": [{"kill": {"rank": 1, "step": 5}}]})
+    merged = chaos.merge_specs(base, extra)
+    assert [e.kind for e in merged.events] == ["stall", "kill"]
+    assert merged.seed == 9 and merged.state_dir == "/tmp/st"
+    assert merged.transport == {"dup_rate": 0.25}
+    # agreement is not a conflict
+    same = chaos.merge_specs(base, chaos.parse_spec({"seed": 9}))
+    assert same.seed == 9
+
+
+@pytest.mark.parametrize("base,extra,msg", [
+    ({"seed": 9}, {"seed": 10},
+     r"seed conflicts between --chaos \(9\) and scenario storm \(10\)"),
+    ({"state_dir": "/a"}, {"state_dir": "/b"},
+     r"state_dir conflicts"),
+    ({"transport": {"dup_rate": 0.1}}, {"transport": {"dup_rate": 0.2}},
+     r"transport fault 'dup_rate' conflicts"),
+])
+def test_merge_specs_refuses_contradictions(base, extra, msg):
+    with pytest.raises(ValueError, match=msg):
+        chaos.merge_specs(chaos.parse_spec(base), chaos.parse_spec(extra))
 
 
 def test_ensure_installed_from_spec_file(tmp_path, monkeypatch):

@@ -244,6 +244,61 @@ def test_signal_safety_real_file_is_handler_safe(lint):
     assert lint.check_signal_safety() == []
 
 
+# --------------------------------------------------- scenario-determinism
+def test_scenario_determinism_clean(lint, tmp_path):
+    rel = _write(tmp_path, "pkg/trace.py", """\
+        import hashlib
+        def draw(seed):
+            for k in sorted({"a", "b"}):
+                seed = (seed * 31 + len(k)) & 0xFFFFFFFF
+            return seed
+        """)
+    assert lint.check_scenario_determinism(str(tmp_path),
+                                           files=(rel,)) == []
+
+
+def test_scenario_determinism_flags_imports_hash_env(lint, tmp_path):
+    rel = _write(tmp_path, "pkg/trace.py", """\
+        import random, os
+        import uuid
+        def draw(reqs, deadline):
+            import time
+            if time.monotonic() > deadline:
+                reqs = reqs[:1]
+            random.shuffle(reqs)
+            token = uuid.uuid4()
+            bucket = hash(token) % 8
+            shards = os.getenv("SHARDS")
+            for r in set(reqs):
+                yield r, bucket, shards
+        """)
+    out = lint.check_scenario_determinism(str(tmp_path), files=(rel,))
+    msgs = " | ".join(v.message for v in out)
+    assert "random imported in a scenario module" in msgs
+    assert "uuid imported in a scenario module" in msgs
+    assert "time imported in a scenario module" in msgs
+    assert "RNG call" in msgs
+    assert "wall-clock value drives control flow" in msgs
+    assert "builtin hash()" in msgs
+    assert "environment read" in msgs
+    assert "iteration over an unordered set" in msgs
+
+
+def test_scenario_determinism_pragma_allows(lint, tmp_path):
+    rel = _write(tmp_path, "pkg/trace.py", """\
+        import time  # hvdlint: allow[scenario-determinism] wall metering
+        def wall():
+            return time.perf_counter()
+        """)
+    assert lint.check_scenario_determinism(str(tmp_path),
+                                           files=(rel,)) == []
+
+
+def test_scenario_determinism_real_modules_clean(lint):
+    """The real scenario package passes with the DEFAULT file list."""
+    assert lint.check_scenario_determinism() == []
+
+
 # ------------------------------------------------------------------- driver
 def test_real_repo_is_clean(lint):
     """The whole repo under the full rule set: the acceptance invariant

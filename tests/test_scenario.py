@@ -1,0 +1,357 @@
+"""Fast tier for the scenario engine (docs/scenarios.md; the 2-proc
+launcher proofs live in tests/integration/test_scenario_integration.py):
+
+  * trace determinism — the byte-identity contract: same spec + seed
+    => identical event JSONL across virtual-rank counts, across fresh
+    interpreter processes with different PYTHONHASHSEED values, and
+    across repeated in-process runs; golden stream values pin the
+    splitmix64/FNV construction itself;
+  * spec/storm validation — chaos-spec discipline: every error names
+    the phase or event INDEX and the FIELD;
+  * storm windows — overlapping kills merge into one outage (the
+    preemption race), blackout side resolution (scope/op/shard),
+    at_s -> tick conversion into a distributable ChaosSpec;
+  * replay harness — kill/restart with journal-redrive prefix
+    suppression, admission-blackout buffering, watermark shedding,
+    storm recovery accounting, embedded alert rules firing (and
+    reported missing when they don't), byte-identical SLO rows;
+  * knob surface — validate_scenario_knobs accept/reject.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.scenario import (ScenarioHarness, builtin_arrivals,
+                                  canonical_rows, events_digest,
+                                  events_jsonl, generate_events,
+                                  loads_scenario, parse_scenario,
+                                  parse_storm, rank_for, rows_jsonl,
+                                  to_chaos_spec, validate_scenario_knobs,
+                                  windows)
+from horovod_tpu.scenario.trace import Stream
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SPEC = {
+    "name": "unit",
+    "seed": 7,
+    "virtual_ranks": 32,
+    "tick_ms": 10,
+    "phases": [
+        {"name": "p0", "kind": "serve", "duration_s": 1.0,
+         "arrivals": {"process": "poisson", "rate": 20},
+         "shapes": {"prompt_mean": 8, "prompt_max": 24,
+                    "output_mean": 5, "prefix_groups": 3}},
+    ],
+}
+
+
+def _spec(**over):
+    doc = dict(_SPEC)
+    doc.update(over)
+    return parse_scenario(doc)
+
+
+# ------------------------------------------------------------ determinism
+def test_stream_golden_values():
+    """Pin the splitmix64 + FNV-1a construction: a refactor that changes
+    these changes every committed digest and baseline row."""
+    assert Stream(42).next_u64() == 13679457532755275413
+    assert Stream(42, "x").uniform() == pytest.approx(
+        0.4183931962706945, abs=0.0)
+
+
+def test_event_stream_byte_identical_across_rank_counts():
+    """virtual_ranks never enters generation: 32 vs 256 yield the same
+    bytes, and rank attribution is a separate pure replay function."""
+    s32 = _spec(virtual_ranks=32)
+    s256 = _spec(virtual_ranks=256)
+    e32 = generate_events(s32.seed, s32.phases, s32.vocab)
+    e256 = generate_events(s256.seed, s256.phases, s256.vocab)
+    assert events_jsonl(e32) == events_jsonl(e256)
+    assert "rank" not in events_jsonl(e32)
+    r32 = ScenarioHarness(s32).run()
+    r256 = ScenarioHarness(s256).run()
+    assert r32["digest"] == r256["digest"]
+    # the scatter itself is deterministic and spreads sources
+    assert [rank_for(i, 256) for i in range(8)] == \
+        [rank_for(i, 256) for i in range(8)]
+    assert r256["per_rank"]["max_requests"] <= r256["requests"]["arrived"]
+
+
+def test_event_stream_identical_across_fresh_processes():
+    """Two fresh interpreters with DIFFERENT PYTHONHASHSEED values print
+    the same digest: generation is independent of the per-process hash
+    randomization and of dict/set iteration order."""
+    prog = ("import json,sys;"
+            "from horovod_tpu.scenario import generate_events,"
+            "events_digest;"
+            f"doc=json.loads({json.dumps(json.dumps(_SPEC))});"
+            "print(events_digest(generate_events("
+            "doc['seed'],doc['phases'],256)))")
+    digests = []
+    for hash_seed in ("1", "4242"):
+        env = dict(os.environ, PYTHONHASHSEED=hash_seed,
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        out = subprocess.run([sys.executable, "-c", prog], env=env,
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        digests.append(out.stdout.strip())
+    assert digests[0] == digests[1]
+    # and the in-process generator agrees with both
+    spec = _spec()
+    assert digests[0] == events_digest(
+        generate_events(spec.seed, spec.phases, spec.vocab))
+
+
+def test_slo_rows_byte_identical_across_runs():
+    spec = _spec(storm=[{"kill": {"at_s": 0.5, "down_s": 0.2}}])
+    r1 = ScenarioHarness(spec).run()
+    r2 = ScenarioHarness(spec).run()
+    assert rows_jsonl(canonical_rows(r1)) == rows_jsonl(canonical_rows(r2))
+
+
+def test_builtin_arrivals_named_trace():
+    a = builtin_arrivals("serve-bench-poisson", closed_loop_rps=10.0,
+                         n=16)
+    b = builtin_arrivals("serve-bench-poisson", closed_loop_rps=10.0,
+                         n=16)
+    assert a == b and len(a) == 16
+    assert all(x < y for x, y in zip(a, b[1:]))
+    # the historical shape: mean gap ~ 1 / (0.6 * closed rate)
+    assert 0.05 < a[-1] / 16 < 0.6
+
+
+# ------------------------------------------------------------- validation
+@pytest.mark.parametrize("mutate,msg", [
+    (lambda d: d.pop("name"), "'name'"),
+    (lambda d: d.update(phases=[]), "non-empty"),
+    (lambda d: d.update(blast=1), "unknown fields"),
+    (lambda d: d["phases"][0].pop("duration_s"), r"phase #0.*duration_s"),
+    (lambda d: d["phases"][0].update(kind="dance"), r"phase #0 kind"),
+    (lambda d: d["phases"][0]["arrivals"].update(process="storky"),
+     r"phase #0.*process"),
+    (lambda d: d["phases"][0]["arrivals"].update(rate="fast"),
+     r"phase #0 arrivals field 'rate'"),
+    (lambda d: d["phases"][0].pop("arrivals"), r"phase #0.*arrivals"),
+    (lambda d: d.update(engine="gpu"), "engine"),
+    (lambda d: d.update(shed_high=4, shed_low=9), "shed_low"),
+    (lambda d: d.update(storm=[{"kill": {"at_s": 99.0}}]),
+     r"storm event #0.*horizon"),
+    (lambda d: d.update(expect_alerts=["no-such-rule"]),
+     "unknown rule 'no-such-rule'"),
+])
+def test_spec_validation_names_index_and_field(mutate, msg):
+    doc = json.loads(json.dumps(_SPEC))
+    mutate(doc)
+    with pytest.raises(ValueError, match=msg):
+        parse_scenario(doc)
+
+
+@pytest.mark.parametrize("items,msg", [
+    ([{"kind": "explode", "at_s": 1.0}], r"event #0 kind"),
+    ([{"kill": {"rank": 0}}], r"event #0 \(kill\) missing 'at_s'"),
+    ([{"kill": {"at_s": "soon"}}],
+     r"event #0 \(kill\) field 'at_s': expected int/float, got 'soon'"),
+    ([{"kind": "kill", "at_s": 1.0},
+      {"stall": {"at_s": 2.0, "blast": 3}}],
+     r"event #1 \(stall\) unknown fields \['blast'\]"),
+    ([{"kv_blackout": {"at_s": 1.0, "duration_s": -0.5}}],
+     r"event #0 \(kv_blackout\) field 'duration_s': must be >= 0"),
+    ([{"kill": 7}], r"event #0 \(kill\) body must be a mapping"),
+])
+def test_storm_validation_names_index_and_field(items, msg):
+    with pytest.raises(ValueError, match=msg):
+        parse_storm(items)
+
+
+def test_expect_alerts_accepts_committed_default_rules():
+    spec = _spec(expect_alerts=["sentinel-nonfinite"])
+    assert spec.expect_alerts == ["sentinel-nonfinite"]
+
+
+def test_loads_scenario_json_and_yaml():
+    as_json = loads_scenario(json.dumps(_SPEC))
+    import yaml
+    as_yaml = loads_scenario(yaml.safe_dump(_SPEC))
+    assert as_json.to_json() == as_yaml.to_json()
+
+
+# ------------------------------------------------------------------ storm
+def test_overlapping_kills_merge_into_one_outage():
+    storm = parse_storm([
+        {"kill": {"at_s": 1.0, "down_s": 0.4}},
+        {"kill": {"at_s": 1.2, "down_s": 0.4, "rank": 1}},
+        {"stall": {"at_s": 3.0, "duration_s": 0.2}},
+    ])
+    wins = windows(storm, tick_s=0.01)
+    outages = [w for w in wins if w.kind == "outage"]
+    assert len(outages) == 1
+    assert outages[0].start_tick == 100 and outages[0].end_tick == 160
+    assert [w.kind for w in wins if w.kind == "stall"] == ["stall"]
+
+
+def test_blackout_side_resolution():
+    tick_s = 0.01
+    req = windows(parse_storm(
+        [{"kv_blackout": {"at_s": 1.0, "duration_s": 0.1,
+                          "scope": "serve_req"}}]), tick_s)[0]
+    assert req.admission and not req.delivery
+    out = windows(parse_storm(
+        [{"kv_blackout": {"at_s": 1.0, "duration_s": 0.1,
+                          "op": "get"}}]), tick_s)[0]
+    assert out.delivery and not out.admission
+    both = windows(parse_storm(
+        [{"kv_blackout": {"at_s": 1.0, "duration_s": 0.1}}]), tick_s)[0]
+    assert both.admission and both.delivery
+    # shard form resolves through the SAME deterministic map the fleet
+    # uses (runner/kvshard.py)
+    from horovod_tpu.runner.kvshard import shard_for_scope
+    shard = shard_for_scope("serve_req", 3)
+    via_shard = windows(parse_storm(
+        [{"kv_blackout": {"at_s": 1.0, "duration_s": 0.1,
+                          "shard": shard}}]), tick_s, kv_shards=3)[0]
+    assert via_shard.admission
+
+
+def test_to_chaos_spec_tick_conversion():
+    storm = parse_storm([
+        {"kill": {"at_s": 0.5, "rank": 1}},
+        {"stall": {"at_s": 1.0, "duration_s": 0.25}},
+        {"kv_blackout": {"at_s": 2.0, "duration_s": 0.05,
+                         "op": "put"}},
+    ])
+    spec = to_chaos_spec(storm, tick_s=0.01, seed=9)
+    assert spec.seed == 9
+    kill, stall, blk = spec.events
+    assert (kill.kind, kill.step, kill.rank) == ("kill", 50, 1)
+    assert (stall.kind, stall.step, stall.duration_ms) == \
+        ("stall", 100, 250.0)
+    assert (blk.kind, blk.step, blk.count, blk.op) == \
+        ("kv_blackout", 200, 5, "put")
+
+
+# ---------------------------------------------------------------- harness
+def test_kill_restart_redrives_and_recovers():
+    spec = _spec(storm=[{"kill": {"at_s": 0.4, "down_s": 0.2}}])
+    r = ScenarioHarness(spec).run()
+    assert r["restarts"] == 1
+    assert r["requests"]["backlog"] == 0
+    assert r["requests"]["completed"] == r["requests"]["arrived"]
+    # every completed request delivered exactly its max_new tokens —
+    # the redrive suppressed already-delivered prefixes instead of
+    # double-delivering them
+    ev = generate_events(spec.seed, spec.phases, spec.vocab)
+    want = sum(e["max_new"] for e in ev if e["kind"] == "arrive")
+    assert r["requests"]["delivered_tokens"] == want
+    (storm,) = r["storms"]
+    assert storm["window"] == "outage" and storm["recovered"]
+    assert storm["recovery_s"] >= storm["down_s"] > 0
+    rows = canonical_rows(r)
+    assert any("storm recovery max" in row["metric"] for row in rows)
+
+
+def test_admission_blackout_buffers_then_flushes():
+    spec = _spec(storm=[{"kv_blackout": {
+        "at_s": 0.2, "duration_s": 0.3, "scope": "serve_req"}}])
+    r = ScenarioHarness(spec).run()
+    assert r["requests"]["completed"] == r["requests"]["arrived"]
+    assert r["requests"]["shed"] == 0
+    # buffered admissions push TTFT tails past the blackout length
+    assert r["slo"]["ttft_p99_s"] >= 0.25
+
+
+def test_watermark_shedding_latches():
+    heavy = {"name": "heavy", "kind": "serve", "duration_s": 1.0,
+             "arrivals": {"process": "poisson", "rate": 200},
+             "shapes": {"prompt_mean": 16, "prompt_max": 48,
+                        "output_mean": 10}}
+    spec = _spec(phases=[heavy], shed_high=10, shed_low=5,
+                 engine_config={"max_slots": 2, "max_batch_tokens": 8,
+                                "prefill_chunk": 4})
+    r = ScenarioHarness(spec).run()
+    assert r["requests"]["shed"] > 0
+    assert r["requests"]["completed"] + r["requests"]["shed"] == \
+        r["requests"]["arrived"]
+
+
+def test_embedded_alert_fires_and_missing_is_reported():
+    rule = {"name": "scenario-engine-down",
+            "family": "hvd_scenario_engine_up",
+            "kind": "threshold", "op": "<=", "value": 0,
+            "severity": "critical"}
+    spec = _spec(storm=[{"kill": {"at_s": 0.4, "down_s": 0.3}}],
+                 alert_rules=[rule],
+                 expect_alerts=["scenario-engine-down"])
+    r = ScenarioHarness(spec).run()
+    assert r["alerts"]["ok"], r["alerts"]
+    assert "scenario-engine-down" in r["alerts"]["fired"]
+    # without the outage the same expectation is reported missing
+    calm = _spec(alert_rules=[rule],
+                 expect_alerts=["scenario-engine-down"])
+    r2 = ScenarioHarness(calm).run()
+    assert not r2["alerts"]["ok"]
+    assert r2["alerts"]["missing"] == ["scenario-engine-down"]
+
+
+def test_train_and_mixed_phases_time_slice():
+    spec = _spec(phases=[
+        {"name": "warm", "kind": "train", "duration_s": 0.5,
+         "train_rate": 20},
+        {"name": "mix", "kind": "mixed", "duration_s": 1.0,
+         "train_rate": 10,
+         "arrivals": {"process": "constant", "rate": 10}},
+    ])
+    r = ScenarioHarness(spec).run()
+    assert r["requests"]["train_steps"] == 20
+    assert r["requests"]["completed"] == r["requests"]["arrived"] == 10
+    assert set(r["phases"]) == {"warm", "mix"}
+
+
+def test_virtual_ranks_override_changes_scatter_not_stream():
+    spec = _spec()
+    base = ScenarioHarness(spec).run()
+    over = ScenarioHarness(spec, virtual_ranks=8).run()
+    assert over["virtual_ranks"] == 8
+    assert over["digest"] == base["digest"]
+    assert over["slo"] == base["slo"]
+
+
+# ------------------------------------------------------------------ knobs
+def test_validate_scenario_knobs(tmp_path):
+    validate_scenario_knobs({"HOROVOD_SCENARIO": "",
+                             "HOROVOD_SCENARIO_RANKS": 0,
+                             "HOROVOD_SCENARIO_TICK_MS": 0.0})
+    validate_scenario_knobs({})  # partial mappings tolerated
+    with pytest.raises(ValueError, match="HOROVOD_SCENARIO_RANKS"):
+        validate_scenario_knobs({"HOROVOD_SCENARIO_RANKS": -1})
+    with pytest.raises(ValueError, match="HOROVOD_SCENARIO_TICK_MS"):
+        validate_scenario_knobs({"HOROVOD_SCENARIO_TICK_MS": -2.0})
+    with pytest.raises(ValueError, match="unreadable"):
+        validate_scenario_knobs(
+            {"HOROVOD_SCENARIO": str(tmp_path / "nope.yaml")})
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("name: x\n")  # no phases
+    with pytest.raises(ValueError, match="invalid"):
+        validate_scenario_knobs({"HOROVOD_SCENARIO": str(bad)})
+    good = tmp_path / "good.yaml"
+    good.write_text(json.dumps(_SPEC))
+    validate_scenario_knobs({"HOROVOD_SCENARIO": str(good)})
+
+
+# ----------------------------------------------------------------- corpus
+def test_committed_corpus_parses_and_expects_alerts():
+    """Every committed scenario must parse and carry a nonempty alert
+    expectation — the corpus is the CI contract, not an example dir."""
+    from horovod_tpu.scenario import load_scenario
+    corpus = sorted(os.listdir(os.path.join(REPO, "scenarios")))
+    assert len(corpus) >= 3
+    for fname in corpus:
+        spec = load_scenario(os.path.join(REPO, "scenarios", fname))
+        assert spec.phases and spec.expect_alerts, fname
+        assert spec.virtual_ranks >= 32, fname
