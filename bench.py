@@ -496,6 +496,16 @@ def main() -> int:
                          "process baseline vs the sharded + direct-"
                          "stream control plane (default sweep "
                          "1,2,4,8,16,24)")
+    ap.add_argument("--replicas", nargs="?", const="1,2,4",
+                    default=None, metavar="N,N,...",
+                    help="with --serve --users: replica scale-out sweep "
+                         "(docs/serving.md#replicated-tier) — repeat the "
+                         "user-count sweep against N independent replica "
+                         "fleets registered behind one router with "
+                         "prefix-affinity routing, locating the knee per "
+                         "replica count plus the affinity hit rate vs "
+                         "the least-loaded-only baseline (default sweep "
+                         "1,2,4)")
     ap.add_argument("--scenario", metavar="SPEC_YAML", default=None,
                     help="deterministic scenario replay "
                          "(horovod_tpu/scenario; docs/scenarios.md): "
@@ -606,7 +616,14 @@ def main() -> int:
         if args.users:
             # Control-plane saturation sweep: scripted engine, no jax
             # compute — the measurement is the router+KV, not decode.
+            if args.replicas:
+                return serve_replicas_bench(args)
             return serve_users_bench(args)
+        if args.replicas:
+            print("--replicas needs --users (the replica sweep rides "
+                  "the control-plane saturation harness)",
+                  file=sys.stderr)
+            return 2
         return serve_bench(args)
     if args.autotune:
         if args.profile:
@@ -2048,6 +2065,301 @@ def serve_users_bench(args) -> int:
         "tick_ms": tick_s * 1e3, "max_new_tokens": max_new,
         "window_s": window_s,
         "single": single, "sharded_direct": scaled,
+    }))
+    return 0
+
+
+def serve_replicas_bench(args) -> int:
+    """Replica scale-out sweep (docs/serving.md#replicated-tier): the
+    ``--users`` saturation harness repeated against N independent
+    replica fleets — each a FleetFrontend + slot-capped scripted tick
+    engine — registered behind ONE router process with prefix-affinity
+    routing.
+    The workload is grouped shared-prefix traffic (each closed-loop
+    user belongs to one of a few hot prefix groups), so the sweep
+    measures the two replicated-tier claims at once:
+
+      * the saturation knee scales with the replica count (the single
+        lockstep fleet was the ceiling the tier removes);
+      * affinity routing pins each prefix group to one replica — hit
+        rate measured from the ``X-Serve-Affinity-Blocks`` response
+        header — where the least-loaded-only baseline (affinity knob
+        off) scatters it (hit rate 0 by construction).
+
+    CPU-virtual: every replica is a thread in this process, so the
+    scale-out gain measures overlap of control-plane waits (loopback
+    HTTP, KV locks, the 1 ms engine sleep) under the GIL — the
+    COMPARISON across replica counts is the claim, not the absolute
+    tok/s.  Artifact gates per-replica-count knee throughput, the
+    1->2 scale-out gain, and the affinity hit rate via
+    PERF_BASELINE.json sub_rows."""
+    import threading
+    import urllib.request
+
+    import horovod_tpu.serve.worker as worker_mod
+    from horovod_tpu.runner import http_client as hc
+    from horovod_tpu.runner.http_server import RendezvousServer
+    from horovod_tpu.serve.replica import (ReplicaRouter, fold_digest,
+                                           prompt_fingerprints)
+    from horovod_tpu.serve.router import RouterState
+    from horovod_tpu.serve.worker import FleetFrontend
+
+    replica_counts = sorted({int(x)
+                             for x in str(args.replicas).split(",")})
+    user_counts = [int(x) for x in str(args.users).split(",")]
+    tick_s = 0.025
+    slots = 2           # modeled decode slots per replica fleet
+    chunk = 8           # tokens emitted per scheduled request per tick
+    block = 4           # fingerprint block size (registered with router)
+    n_groups = 8        # hot shared-prefix groups (lcm-friendly for 1/2/4)
+    prefix_blocks = 3   # full blocks of shared prefix per group
+    max_new = 32
+    warmup_s, window_s = 0.5, 1.5
+
+    # Deterministic per-group shared prefixes: 3 full blocks each, so
+    # the router sees 3 matchable fingerprints per prompt.
+    prefixes = [[(17 * g + 3 * i + 1) % 251 for i in range(
+        block * prefix_blocks)] for g in range(n_groups)]
+
+    class TickEngine:
+        """Scripted slot-capped engine: each 25 ms tick (a GIL-released
+        sleep — the modeled decode fleet) serves the first ``slots``
+        queued requests FCFS, emitting a ``chunk``-token part each, so
+        ONE replica's ceiling is slots*chunk/tick = 640 tok/s by
+        construction — far below the router process's own CPU cap and the sweep observes the tier scale until the
+        shared router process saturates.  The replica affinity contract
+        rides on top: submitted prompts' rolling block fingerprints
+        accumulate as the advertised 'radix tree', and stats carry the
+        queue depth the least-loaded fallback reads."""
+
+        def __init__(self):
+            self.tick = 0
+            self.active = {}
+            self.order = []  # FCFS arrival order
+            self.completed = 0
+            self._fps = set()
+
+        def submit(self, tokens, max_new_tokens, req_id=None,
+                   eos_id=None):
+            base = sum(int(t) for t in tokens)
+            self.active[req_id] = [(base + i) % 1000
+                                   for i in range(max_new_tokens)]
+            self.order.append(req_id)
+            self._fps.update(prompt_fingerprints(tokens, block))
+
+        def prefix_fps(self):
+            fps = sorted(self._fps)[:64]
+            return fps, fold_digest(fps)
+
+        def has_work(self):
+            return bool(self.active)
+
+        def step(self):
+            time.sleep(tick_s)  # the modeled decode tick
+            emitted, finished = {}, []
+            for rid in self.order[:slots]:
+                emitted[rid] = self.active[rid][:chunk]
+                del self.active[rid][:chunk]
+                if not self.active[rid]:
+                    del self.active[rid]
+                    finished.append(_ReplicaDone(rid))
+                    self.completed += 1
+            self.order = [r for r in self.order if r in self.active]
+            if emitted:
+                self.tick += 1
+            return {"tick": self.tick, "processed": len(emitted),
+                    "emitted": emitted, "finished": finished}
+
+        def stats(self):
+            return {"tick": self.tick, "completed": self.completed,
+                    "active": len(self.active),
+                    "waiting": len(self.active)}
+
+    class _ReplicaDone:
+        def __init__(self, rid):
+            self.req_id = rid
+            self.finish_reason = "completed"
+
+        def ttft(self):
+            return tick_s
+
+        def tpot(self):
+            return tick_s
+
+    def run_config(n_replicas, affinity):
+        """One (replica count, affinity) config: fresh server, N
+        registered replica fleets, the full user-count sweep.  Returns
+        the per-user-count rows, the knee, and the measured affinity
+        hit rate over every counted request."""
+        server = RendezvousServer(host="127.0.0.1", shards=3)
+        port = server.start()
+        hc.install_shard_map([("127.0.0.1", p)
+                              for p in server.shard_ports])
+        # No shedding (saturation must hit the transport, not
+        # admission), and an explicit affinity switch per config.
+        server._httpd.serve_routers = {
+            k: RouterState(max_pending=1 << 20, shed_high=1 << 20,
+                           journal=True) for k in range(n_replicas)}
+        server._httpd.serve_router = server._httpd.serve_routers[0]
+        server._httpd.serve_replicas = ReplicaRouter(
+            block_size=block, affinity=affinity, dead_after_s=30.0)
+        frontends = [FleetFrontend(TickEngine(), "127.0.0.1", port, 0, 1,
+                                   direct=True, replica_id=k)
+                     for k in range(n_replicas)]
+        for fe in frontends:
+            fe.register_replica({"replicas": n_replicas,
+                                 "block_size": block})
+            fe._publish_stats(force=True)
+        threads = [threading.Thread(target=fe.run, daemon=True)
+                   for fe in frontends]
+        for t in threads:
+            t.start()
+
+        done = {"requests": 0, "tokens": 0, "hits": 0, "routed": 0}
+        done_lock = threading.Lock()
+        counting = threading.Event()
+        stop = threading.Event()
+
+        def user_loop(uid):
+            toks = prefixes[uid % n_groups] + [uid + 1, uid + 2]
+            body = json.dumps({"tokens": toks,
+                               "max_new_tokens": max_new}).encode()
+            while not stop.is_set():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/generate", data=body,
+                    method="POST")
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        hit = int(r.headers.get(
+                            "X-Serve-Affinity-Blocks", 0) or 0)
+                        lines = r.read().splitlines()
+                except (OSError, ValueError):
+                    continue
+                rec = json.loads(lines[-1]) if lines else {}
+                if rec.get("done") and counting.is_set():
+                    with done_lock:
+                        done["requests"] += 1
+                        done["tokens"] += len(rec.get("tokens") or ())
+                        done["routed"] += 1
+                        done["hits"] += 1 if hit > 0 else 0
+
+        rows = []
+        try:
+            for n in user_counts:
+                stop.clear()
+                counting.clear()
+                users = [threading.Thread(target=user_loop, args=(u,),
+                                          daemon=True)
+                         for u in range(n)]
+                for u in users:
+                    u.start()
+                time.sleep(warmup_s)
+                with done_lock:
+                    done["requests"] = done["tokens"] = 0
+                counting.set()
+                time.sleep(window_s)
+                counting.clear()
+                with done_lock:
+                    reqs, toks = done["requests"], done["tokens"]
+                stop.set()
+                for u in users:
+                    u.join(timeout=90)
+                rows.append({"users": n,
+                             "requests_per_s": round(reqs / window_s, 2),
+                             "tok_s": round(toks / window_s, 1)})
+        finally:
+            # graceful exit: ONE drain fans out to every replica fleet
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/admin/drain", data=b"{}",
+                    method="POST"), timeout=30).read()
+            except OSError:
+                pass
+            for t in threads:
+                t.join(timeout=30)
+            hc.install_shard_map(None)
+            server.stop()
+        peak = max(r["tok_s"] for r in rows)
+        knee = next((r for r in rows if r["tok_s"] >= 0.9 * peak),
+                    rows[-1])
+        with done_lock:
+            routed, hits = done["routed"], done["hits"]
+        return {"replicas": n_replicas, "affinity": affinity,
+                "rows": rows, "peak_tok_s": peak,
+                "knee_users": knee["users"], "knee_tok_s": knee["tok_s"],
+                "affinity_hit_rate": round(hits / max(routed, 1), 4),
+                "routed": routed}
+
+    # Fleet stats must beat the router's load/affinity staleness at
+    # bench time scales: 1 Hz heartbeats against 1.5 s windows would
+    # measure the heartbeat, not the tier.
+    old_interval = worker_mod._STATS_INTERVAL_S
+    worker_mod._STATS_INTERVAL_S = 0.05
+    try:
+        results = {n: run_config(n, affinity=True)
+                   for n in replica_counts}
+        # The hit-rate control: the biggest tier again with the
+        # affinity knob off — pure least-loaded placement scatters the
+        # prefix groups (hit rate 0 by construction; the row documents
+        # the comparison, the gate rides the affinity-on rate).
+        control = run_config(max(replica_counts), affinity=False)
+    finally:
+        worker_mod._STATS_INTERVAL_S = old_interval
+
+    for n, res in results.items():
+        if res["peak_tok_s"] <= 0:
+            return fail(f"serve --replicas {n} sweep moved no tokens: "
+                        f"{res}", cause="invalid-result")
+    label = ("CPU-virtual replica tier (loopback HTTP, slot-capped "
+             "scripted engine ticks, N replica threads in one process "
+             "— measures router+KV overlap, not decode)")
+    sub_rows = []
+    for n in replica_counts:
+        res = results[n]
+        sub_rows.append(
+            {"metric": f"serve replica tier knee throughput r{n} "
+                       f"(knee at {res['knee_users']} users)",
+             "value": res["knee_tok_s"], "unit": "tokens/sec",
+             "higher_is_better": True, "label": label})
+    gain2 = None
+    if 1 in results and 2 in results:
+        gain2 = results[2]["knee_tok_s"] / max(
+            results[1]["knee_tok_s"], 1e-9)
+        sub_rows.append(
+            {"metric": "serve replica scale-out gain 1to2 "
+                       "(knee tok/s, 2 vs 1 replicas)",
+             "value": round(gain2, 3), "unit": "x",
+             "higher_is_better": True, "label": label})
+    top = max(replica_counts)
+    if 1 in results and top > 2:
+        sub_rows.append(
+            {"metric": f"serve replica scale-out gain 1to{top} "
+                       f"(knee tok/s, {top} vs 1 replicas)",
+             "value": round(results[top]["knee_tok_s"] / max(
+                 results[1]["knee_tok_s"], 1e-9), 3),
+             "unit": "x", "higher_is_better": True, "label": label})
+    sub_rows.append(
+        {"metric": f"serve replica affinity hit rate r{top} "
+                   f"({n_groups} prefix groups; least-loaded control "
+                   f"{control['affinity_hit_rate']:.2f})",
+         "value": results[top]["affinity_hit_rate"], "unit": "ratio",
+         "higher_is_better": True, "label": label})
+    gain_txt = f"{gain2:.2f}x" if gain2 is not None else "n/a"
+    print(json.dumps({
+        "sub_rows": sub_rows,
+        "metric": "serve replica scale-out sweep "
+                  f"(knees {[results[n]['knee_tok_s'] for n in replica_counts]} "
+                  f"tok/s at replicas {replica_counts}; 1->2 gain "
+                  f"{gain_txt}; affinity hit rate "
+                  f"{results[top]['affinity_hit_rate']:.2f} vs control "
+                  f"{control['affinity_hit_rate']:.2f}) [{label}]",
+        "value": results[top]["knee_tok_s"], "unit": "tokens/sec",
+        "label": label,
+        "replica_counts": replica_counts, "user_counts": user_counts,
+        "tick_ms": tick_s * 1e3, "max_new_tokens": max_new,
+        "window_s": window_s, "prefix_groups": n_groups,
+        "results": {str(n): results[n] for n in replica_counts},
+        "least_loaded_control": control,
     }))
     return 0
 

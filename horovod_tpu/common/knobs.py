@@ -218,6 +218,60 @@ _knob("HOROVOD_SERVE_POLL_INTERVAL", 0.02, float,
       "is the fallback cadence; consecutive empty waits back off up to "
       "an EWMA-informed cap tracking the observed inter-part gap.  "
       "Must be positive; rejected at hvd.init().")
+_knob("HOROVOD_SERVE_REPLICAS", 1, int,
+      "Replicated serving tier size (docs/serving.md#replicated-tier): "
+      "N independent lockstep serving fleets registered behind one "
+      "router/rendezvous process under the 'replicas' KV scope.  The "
+      "router keeps a per-replica digest of each radix prefix tree and "
+      "routes POST /generate to the replica holding the longest "
+      "prompt-prefix match, falling back to least-loaded.  1 = the "
+      "single-fleet deployment (byte-for-byte the pre-replica KV "
+      "layout).  Must be >= 1; rejected at hvd.init().")
+_knob("HOROVOD_SERVE_REPLICA_ID", 0, int,
+      "This fleet's identity within the replica tier (hvdrun --serve "
+      "--replica-id K --replicas N): replica 0 keeps the unscoped KV "
+      "scope names; replica K > 0 suffixes its serve_req/serve_out/"
+      "serve_plan/serve/serve_journal scopes with '.rKK', so N fleets "
+      "share one rendezvous KV without key collisions and journal "
+      "redrive stays per-replica.  Must be in "
+      "[0, HOROVOD_SERVE_REPLICAS); rejected at hvd.init() "
+      "(docs/serving.md#replicated-tier).")
+_knob("HOROVOD_SERVE_REPLICA_DEAD_S", 3.0, float,
+      "Dark-replica threshold in seconds: a replica whose stats "
+      "publish (the 1 s heartbeat carrying its prefix-tree "
+      "fingerprints) is older than this is routed around, and streams "
+      "it was serving are re-dispatched to a surviving replica with "
+      "their already-streamed prefix suppressed (journal redrive "
+      "semantics, router-side).  Must be positive; rejected at "
+      "hvd.init() (docs/serving.md#replicated-tier).")
+_knob("HOROVOD_SERVE_AFFINITY", True, _parse_bool,
+      "Prefix-affinity routing (docs/serving.md#replicated-tier): "
+      "route each request to the replica whose published radix-tree "
+      "fingerprints cover the longest prefix of the prompt's block "
+      "fingerprints; ties and misses fall back to least-loaded "
+      "(queue-depth series, then lowest replica id).  0 disables: "
+      "pure least-loaded routing (the A/B baseline bench.py --serve "
+      "--replicas measures the hit rate against).")
+_knob("HOROVOD_SERVE_PREFILL_RANKS", 0, int,
+      "Prefill/decode disaggregation within a replica "
+      "(docs/serving.md#replicated-tier): the first K ranks run "
+      "chunked prefill only and stream finished KV blocks to the "
+      "decode ranks' paged pools over the persistent direct-stream "
+      "path (serve/stream.py kvblock records), so a long prompt never "
+      "sits inside a decode fleet's mixed-step max_batch_tokens "
+      "budget.  0 = colocated (every rank runs the mixed engine).  "
+      "Must be >= 0; rejected at hvd.init().")
+_knob("HOROVOD_SERVE_SPILL_BLOCKS", 0, int,
+      "Host-RAM KV spill capacity in blocks "
+      "(docs/serving.md#replicated-tier): cold radix-tree blocks "
+      "(allocator refcount 1, LRU by the prefix cache's deterministic "
+      "touch clock) migrate out of the device pool into a host-side "
+      "pool of at most this many blocks and reload on the next prefix "
+      "hit, multiplying effective cache capacity per replica.  "
+      "Spill/reload counters join the memory ledger (hvd_serve_spill_* "
+      "families) and doctor --serve.  0 = off (cold blocks are simply "
+      "evicted).  Requires the prefix cache on; must be >= 0; rejected "
+      "at hvd.init().")
 # --- autotune (reference: common.h:70-75) ---
 _knob("HOROVOD_AUTOTUNE", False, _parse_bool,
       "Enable Bayesian autotuning of fusion threshold and cycle time.")

@@ -53,9 +53,12 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
-# Plane keys of the geometry attribution, in render order.
+# Plane keys of the geometry attribution, in render order.  kv_spill
+# is HOST bytes (the serve tier's spilled cold KV blocks; it shows up
+# in host RSS, not the device cap) — kept in the same ledger so the
+# spill tier's cost is accounted where operators already look.
 PLANES = ("params", "grads", "opt_state", "ef_residual", "kv_pool",
-          "fusion_overlap", "native_core")
+          "kv_spill", "fusion_overlap", "native_core")
 
 
 def _knob(name: str):
@@ -227,6 +230,9 @@ class MemSampler:
         kv = kv_pool_stats()
         if kv:
             planes["kv_pool"] = int(kv.get("pool_bytes", 0))
+            sp = kv.get("spill")
+            if isinstance(sp, dict):
+                planes["kv_spill"] = int(sp.get("held_bytes_est", 0))
         try:
             threshold = int(_knob("HOROVOD_FUSION_THRESHOLD"))
             depth = max(1, int(_knob("HOROVOD_OVERLAP_DEPTH")))

@@ -543,6 +543,46 @@ def render_serve(view: Dict[str, Any]) -> str:
                 f"{s.get('port', '?')}: {s.get('requests', '?')} "
                 f"requests, {s.get('keys', '?')} keys "
                 f"({', '.join(s.get('scopes') or []) or 'empty'})")
+    # Replicated tier (docs/serving.md#replicated-tier) — absent on
+    # single-fleet deployments and routers that predate it.
+    reps = view.get("replicas")
+    if isinstance(reps, dict) and reps.get("per_replica"):
+        per = reps["per_replica"]
+        live = reps.get("live") or []
+        rate = reps.get("affinity_hit_rate")
+        lines.append(
+            f"REPLICAS: {len(per)} registered, {len(live)} live — "
+            f"affinity {'on' if reps.get('affinity') else 'OFF'}, "
+            f"hit rate {'?' if rate is None else rate} "
+            f"({reps.get('affinity_hits', '?')} hits / "
+            f"{reps.get('affinity_misses', '?')} misses), "
+            f"{reps.get('redispatches', '?')} re-dispatched streams")
+        engines = view.get("engines") or {}
+        # Dark replicas first: the one getting no traffic is the one
+        # the operator is hunting (docs/troubleshooting.md).
+        order = sorted(per, key=lambda r: (not per[r].get("dark"),
+                                           int(r)))
+        for rid in order:
+            rec = per[rid]
+            est = engines.get(rid) if isinstance(engines, dict) else None
+            pool = (est or {}).get("kv_pool") or {}
+            spill = (est or {}).get("spill") or pool.get("spill") or {}
+            state_r = ("DARK" if rec.get("dark")
+                       else "shedding" if rec.get("shed") else "up")
+            kv_s = (f"{pool.get('used_blocks', '?')}/"
+                    f"{pool.get('num_blocks', '?')} blk"
+                    if pool else "?")
+            spill_s = (f", spill {spill.get('held_blocks', '?')} held "
+                       f"({spill.get('spilled_total', '?')} out / "
+                       f"{spill.get('reloaded_total', '?')} back)"
+                       if spill else "")
+            lines.append(
+                f"  replica {rid} [{state_r}]: routed "
+                f"{rec.get('routed', '?')} "
+                f"({rec.get('affinity_hits', '?')} affinity), queue "
+                f"{rec.get('queue_depth', '?')}, kv {kv_s}{spill_s}, "
+                f"tree {rec.get('fps', '?')} fps "
+                f"digest {rec.get('digest', '?')}")
     if engine is None:
         lines.append("ENGINE: no stats published — fleet starting, "
                      "drained, or dead (check GET /health)")
@@ -571,6 +611,17 @@ def render_serve(view: Dict[str, Any]) -> str:
             f"{_fmt_bytes(pool.get('pool_bytes'))}; fragmentation "
             f"{pool.get('fragmentation', '?')}, eviction pressure "
             f"{pool.get('eviction_pressure', '?')}")
+        # Host-RAM spill tier (docs/serving.md#replicated-tier) —
+        # absent when HOROVOD_SERVE_SPILL_BLOCKS is 0.
+        sp = pool.get("spill")
+        if isinstance(sp, dict):
+            lines.append(
+                f"  spill (host RAM): {sp.get('held_blocks', '?')}/"
+                f"{sp.get('capacity_blocks', '?')} blocks held = "
+                f"{_fmt_bytes(sp.get('held_bytes_est'))}; "
+                f"{sp.get('spilled_total', '?')} spilled, "
+                f"{sp.get('reloaded_total', '?')} reloaded, "
+                f"{sp.get('dropped_total', '?')} dropped")
     # Raw-speed legs (docs/serving.md#raw-speed) — absent on payloads
     # from engines that predate them.
     prefix = engine.get("prefix_cache")

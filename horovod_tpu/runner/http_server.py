@@ -83,8 +83,72 @@ SERIES_ROUTE = "series"
 ALERTS_ROUTE = "alerts"
 GENERATE_ROUTE = "generate"
 # serve_out writes wake the router's stream drains (serve/router.py
-# waits on kv_wakeup instead of busy-polling; docs/control-plane.md).
-_WAKEUP_SCOPES = ("serve_out",)
+# waits on kv_wakeup instead of busy-polling; docs/control-plane.md);
+# serve_kv writes wake the decode sub-fleet's handoff long-polls.
+# Matching is on the base name so per-replica scoped variants
+# (serve_out.r01, ...; serve/replica.py) wake the same condition.
+_WAKEUP_SCOPES = ("serve_out", "serve_kv")
+
+
+def add_stream_waiter(server, scope: str, req_key: str):
+    """Register a per-request wakeup condition for one stream drain
+    (serve/router.py) and return it — or None on a server without the
+    waiter registry (bare test servers), where the caller falls back to
+    the broadcast ``kv_wakeup``.  Keyed waiters are the replicated
+    tier's scalability fix: the broadcast condition wakes EVERY waiting
+    stream on EVERY ingested record, an O(streams x tokens/s) stampede
+    that was most of the measured tick budget once N replica fleets
+    shared one router process (docs/serving.md#replicated-tier)."""
+    waiters = getattr(server, "kv_waiters", None)
+    lock = getattr(server, "kv_waiters_lock", None)
+    if waiters is None or lock is None:
+        return None
+    with lock:
+        ent = waiters.get((scope, req_key))
+        if ent is None:
+            ent = waiters[(scope, req_key)] = [threading.Condition(), 0]
+        ent[1] += 1  # refcount: a re-dispatched stream may share a key
+        return ent[0]
+
+
+def drop_stream_waiter(server, scope: str, req_key: str) -> None:
+    waiters = getattr(server, "kv_waiters", None)
+    lock = getattr(server, "kv_waiters_lock", None)
+    if waiters is None or lock is None:
+        return
+    with lock:
+        ent = waiters.get((scope, req_key))
+        if ent is not None:
+            ent[1] -= 1
+            if ent[1] <= 0:
+                del waiters[(scope, req_key)]
+
+
+def wake_stream(server, scope: str, key: str) -> None:
+    """Wake the stream drain waiting on this record: the per-request
+    condition when one is registered (serve_out keys are
+    ``req.NNNNNN.part.*`` / ``req.NNNNNN.done``), then the broadcast
+    condition for legacy/unkeyed waiters — with keyed streams
+    registered, the broadcast usually has no waiters and the notify is
+    a few microseconds."""
+    if scope.split(".r", 1)[0] not in _WAKEUP_SCOPES:
+        return
+    waiters = getattr(server, "kv_waiters", None)
+    lock = getattr(server, "kv_waiters_lock", None)
+    if waiters is not None and lock is not None:
+        req = key.split(".part.", 1)[0]
+        if req.endswith(".done"):
+            req = req[:-len(".done")]
+        with lock:
+            ent = waiters.get((scope, req))
+        if ent is not None:
+            cond = ent[0]
+            with cond:
+                cond.notify_all()
+    cond = getattr(server, "kv_wakeup", None)
+    if cond is not None:
+        with cond:
+            cond.notify_all()
 
 
 def store_for(server, scope: str):
@@ -133,13 +197,8 @@ class _KVHandler(BaseHTTPRequestHandler):
             except Exception:
                 pass  # telemetry must never take a KV op down
 
-    def _wake(self, scope: str) -> None:
-        if scope not in _WAKEUP_SCOPES:
-            return
-        cond = getattr(self.server, "kv_wakeup", None)
-        if cond is not None:
-            with cond:
-                cond.notify_all()
+    def _wake(self, scope: str, key: str) -> None:
+        wake_stream(self.server, scope, key)
 
     def do_PUT(self) -> None:  # noqa: N802
         scope, key = self._split()
@@ -154,7 +213,7 @@ class _KVHandler(BaseHTTPRequestHandler):
                 time.time()  # type: ignore[attr-defined]
         self.send_response(200)
         self.end_headers()
-        self._wake(scope)
+        self._wake(scope, key)
         # Watch plane (docs/watch.md): metrics snapshots feed the fleet
         # series store (rate-limited to the series resolution) and each
         # ingest runs an alert-evaluation pass; heartbeats feed the
@@ -426,6 +485,11 @@ class RendezvousServer:
 
     def start(self) -> int:
         wakeup = threading.Condition()
+        # Keyed stream waiters (add_stream_waiter): shared across all
+        # shard httpds, like the broadcast condition, so a stream's
+        # records wake it no matter which shard its scope hashes to.
+        waiters: Dict[Tuple[str, str], list] = {}
+        waiters_lock = threading.Lock()
         stores: List[ThreadingHTTPServer] = []
         for i in range(self._shards):
             # Only the primary gets the requested port; shard servers
@@ -439,6 +503,8 @@ class RendezvousServer:
             httpd.kv_stopped = False  # type: ignore[attr-defined]
             httpd.shard_index = i  # type: ignore[attr-defined]
             httpd.kv_wakeup = wakeup  # type: ignore[attr-defined]
+            httpd.kv_waiters = waiters  # type: ignore[attr-defined]
+            httpd.kv_waiters_lock = waiters_lock  # type: ignore[attr-defined]
             stores.append(httpd)
         for httpd in stores:
             # Every shard sees the full store list: render routes and

@@ -213,6 +213,16 @@ def make_parser() -> argparse.ArgumentParser:
                    help="seconds the serving fleet stays up before a "
                         "clean exit (0/omitted = until interrupted); "
                         "bounded CI smokes use this")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="with --serve: total serving replica fleets "
+                        "behind the shared router "
+                        "(HOROVOD_SERVE_REPLICAS; docs/serving.md"
+                        "#replicated-tier)")
+    p.add_argument("--replica-id", type=int, default=None,
+                   help="with --serve: this launch's replica index "
+                        "(0..replicas-1, HOROVOD_SERVE_REPLICA_ID); "
+                        "replica 0 hosts the router, the rest join it "
+                        "over the shared rendezvous")
     p.add_argument("--alerts", default=None, metavar="RULES_YAML",
                    help="declarative alert rules for the watch plane "
                         "(horovod_tpu/watch; docs/watch.md): validated "
@@ -383,6 +393,12 @@ def args_to_env(args: argparse.Namespace) -> Dict[str, str]:
         # publish hvd_serve_* metrics and heartbeats like any trainer.
         env.setdefault("HOROVOD_METRICS", "1")
         env.setdefault("HOROVOD_HEARTBEAT", "1")
+        # Replicated tier (docs/serving.md#replicated-tier): this
+        # launch's fleet is replica K of N behind a shared router.
+        if getattr(args, "replicas", None) is not None:
+            env["HOROVOD_SERVE_REPLICAS"] = str(args.replicas)
+        if getattr(args, "replica_id", None) is not None:
+            env["HOROVOD_SERVE_REPLICA_ID"] = str(args.replica_id)
     return env
 
 
@@ -1132,6 +1148,15 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             print("hvdrun: --serve supplies the worker command; drop "
                   f"the trailing command ({' '.join(command)})",
                   file=sys.stderr)
+            return 2
+        if args.replica_id is not None and args.replicas is None:
+            print("hvdrun: --replica-id needs --replicas "
+                  "(docs/serving.md#replicated-tier)", file=sys.stderr)
+            return 2
+        if args.replicas is not None and \
+                not 0 <= (args.replica_id or 0) < args.replicas:
+            print(f"hvdrun: --replica-id {args.replica_id} out of range "
+                  f"for --replicas {args.replicas}", file=sys.stderr)
             return 2
         # With elastic flags, the serving fleet routes through the
         # elastic driver: rank death / wedge / preemption trigger reset

@@ -50,6 +50,7 @@ ENGINE_UP_FAMILY = "hvd_scenario_engine_up"
 SHED_FAMILY = "hvd_scenario_shed_total"
 TTFT_P99_FAMILY = "hvd_scenario_ttft_p99_ms"
 DELIVERED_FAMILY = "hvd_scenario_delivered_total"
+REPLICAS_UP_FAMILY = "hvd_scenario_replicas_up"
 
 # Watch-feed cadence in logical seconds: fine enough that a sub-second
 # storm is visible to threshold rules, coarse enough to stay cheap.
@@ -234,7 +235,25 @@ class ScenarioHarness:
         router = RouterState(shed_high=spec.shed_high or None,
                              shed_low=spec.shed_low or None,
                              journal=False)
-        engine = self._factory()
+        # Replicated serving tier (docs/serving.md#replicated-tier):
+        # N engines behind ONE admission state, placed by the REAL
+        # prefix-affinity router on the virtual clock.  replicas == 1
+        # runs the exact single-fleet path.
+        replicas = int(getattr(spec, "replicas", 1) or 1)
+        engines: List[Any] = [self._factory() for _ in range(replicas)]
+        rr = None
+        adv: List[set] = [set() for _ in range(replicas)]
+        redispatched = 0
+        if replicas > 1:
+            from ..serve.replica import ReplicaRouter, prompt_fingerprints
+            # Liveness here is the harness's outage windows (passed as
+            # `exclude`), not heartbeat staleness — dead_after_s is
+            # effectively off so live() stays the full registry.
+            rr = ReplicaRouter(
+                block_size=spec.engine_config.get("block_size", 4),
+                affinity=True, dead_after_s=1e12)
+            for r in range(replicas):
+                rr.register(r, {"replicas": replicas}, now=0.0)
         arrivals = [e for e in events if e["kind"] == "arrive"]
         trains = [e for e in events if e["kind"] == "train"]
         recs: Dict[str, Dict[str, Any]] = {}
@@ -271,6 +290,17 @@ class ScenarioHarness:
                 unfinished.pop(rid, None)
                 router.finish_stream()
 
+        def _qdepth(e) -> int:
+            if e is None:
+                return 0
+            fn = getattr(e, "queue_depth", None)
+            if callable(fn):
+                return int(fn())
+            return int(e.stats().get("waiting", 0))
+
+        def _down() -> List[int]:
+            return [r for r in range(replicas) if engines[r] is None]
+
         def try_admit(ev: Dict[str, Any]) -> None:
             nonlocal shed
             rid = ev["req"]
@@ -282,35 +312,93 @@ class ScenarioHarness:
             rec["submit_tick"] = tick
             admitted.append(rid)
             unfinished[rid] = True
-            if engine is not None:
-                engine.submit(list(ev["prompt"]), ev["max_new"],
+            if replicas == 1:
+                if engines[0] is not None:
+                    engines[0].submit(list(ev["prompt"]), ev["max_new"],
+                                      req_id=rid)
+                return
+            placed = rr.route(list(ev["prompt"]), tick * tick_s,
+                              exclude=_down())
+            if placed is None:
+                # whole tier down: parked on replica 0; the restart
+                # redrive resubmits it
+                rec["replica"] = 0
+                return
+            r, depth = placed
+            rec["replica"] = r
+            rec["affinity_blocks"] = depth
+            engines[r].submit(list(ev["prompt"]), ev["max_new"],
                               req_id=rid)
+            # Replica r's radix tree now holds this prompt: advertise
+            # its block fingerprints, like the real stats piggyback
+            # (serve/worker.py _publish_stats).
+            adv[r].update(prompt_fingerprints(list(ev["prompt"]),
+                                              rr.block_size))
+            rr.update(r, {"prefix_fps": sorted(adv[r]),
+                          "queue_depth": _qdepth(engines[r])},
+                      now=tick * tick_s)
+
+        def outage_now(r: int, tick_: int) -> bool:
+            for w in wins:
+                if w.kind == "outage" \
+                        and w.start_tick <= tick_ < w.end_tick \
+                        and w.event.replica in (-1, r):
+                    return True
+            return False
 
         while tick < max_ticks:
             now = tick * tick_s
-            in_outage = storm_mod.active(wins, tick, "outage")
+            down_now = [outage_now(r, tick) for r in range(replicas)]
+            in_outage = any(down_now)
             stalled = storm_mod.active(wins, tick, "stall")
             adm_black = storm_mod.active(wins, tick, "blackout",
                                          "admission")
             dlv_black = storm_mod.active(wins, tick, "blackout",
                                          "delivery")
-            if in_outage and engine is not None:
-                # the kill: fleet down, in-flight engine state lost
-                engine.close()
-                engine = None
-            if not in_outage and engine is None:
-                # elastic restart + journal redrive: resubmit every
-                # admitted-unfinished request in admission order; the
-                # already-delivered stream prefix is suppressed so the
-                # client stream stays byte-identical.
-                engine = self._factory()
-                restarts += 1
-                for rid in admitted:
-                    rec = recs[rid]
-                    if not rec["finished"] and not rec["shed"]:
+            for r in range(replicas):
+                if down_now[r] and engines[r] is not None:
+                    # the kill: replica down, in-flight engine state lost
+                    engines[r].close()
+                    engines[r] = None
+                    if replicas > 1:
+                        # Router-side re-dispatch (serve/router.py
+                        # _redispatch): the dead replica's unfinished
+                        # streams move to a survivor, already-delivered
+                        # prefixes suppressed.
+                        for rid in admitted:
+                            rec = recs[rid]
+                            if rec.get("replica") != r \
+                                    or rec["finished"] or rec["shed"]:
+                                continue
+                            placed = rr.route(list(rec["prompt"]), now,
+                                              exclude=_down())
+                            if placed is None:
+                                continue  # no survivor: restart redrives
+                            new_r = placed[0]
+                            rr.note_redispatch()
+                            redispatched += 1
+                            rec["replica"] = new_r
+                            replay_skip[rid] = rec["delivered"]
+                            engines[new_r].submit(list(rec["prompt"]),
+                                                  rec["max_new"],
+                                                  req_id=rid)
+                if not down_now[r] and engines[r] is None:
+                    # elastic restart + journal redrive: resubmit every
+                    # admitted-unfinished request this replica still
+                    # owns, in admission order; the already-delivered
+                    # stream prefix is suppressed so the client stream
+                    # stays byte-identical.
+                    engines[r] = self._factory()
+                    restarts += 1
+                    for rid in admitted:
+                        rec = recs[rid]
+                        if rec["finished"] or rec["shed"]:
+                            continue
+                        if replicas > 1 and rec.get("replica") != r:
+                            continue
                         replay_skip[rid] = rec["delivered"]
-                        engine.submit(list(rec["prompt"]),
-                                      rec["max_new"], req_id=rid)
+                        engines[r].submit(list(rec["prompt"]),
+                                          rec["max_new"], req_id=rid)
             while ai < len(arrivals) and arrivals[ai]["t"] <= now:
                 ev = arrivals[ai]
                 rid = ev["req"]
@@ -337,27 +425,31 @@ class ScenarioHarness:
                     deliver(rid, tok)
                 transit = []
             train_due = ti < len(trains) and trains[ti]["t"] <= now
-            if engine is not None and not stalled:
+            up_count = sum(1 for e in engines if e is not None)
+            if up_count and not stalled:
                 if train_due:
                     # mixed fleets time-slice: this tick is the train
                     # step's, serving waits
                     ti += 1
                     trains_done += 1
-                elif engine.has_work():
-                    rep = engine.step()
-                    for rid in sorted(rep["emitted"]):
-                        for tok in rep["emitted"][rid]:
-                            if replay_skip.get(rid, 0) > 0:
-                                replay_skip[rid] -= 1
-                                continue
-                            if dlv_black:
-                                transit.append((rid, tok))
-                            else:
-                                deliver(rid, tok)
+                else:
+                    for eng in engines:
+                        if eng is None or not eng.has_work():
+                            continue
+                        rep = eng.step()
+                        for rid in sorted(rep["emitted"]):
+                            for tok in rep["emitted"][rid]:
+                                if replay_skip.get(rid, 0) > 0:
+                                    replay_skip[rid] -= 1
+                                    continue
+                                if dlv_black:
+                                    transit.append((rid, tok))
+                                else:
+                                    deliver(rid, tok)
             if tick % watch_every == 0:
                 self._feed(now, len(unfinished) + len(buffered),
-                           engine is not None, shed, ttft_ms_done,
-                           delivered_total)
+                           up_count > 0, shed, ttft_ms_done,
+                           delivered_total, up_count)
             tick += 1
             if tick >= horizon_ticks and ai >= len(arrivals) \
                     and ti >= len(trains) and not buffered \
@@ -365,31 +457,39 @@ class ScenarioHarness:
                     and not in_outage:
                 break
         final_now = tick * tick_s
+        up_count = sum(1 for e in engines if e is not None)
         self._feed(final_now, len(unfinished) + len(buffered),
-                   engine is not None, shed, ttft_ms_done,
-                   delivered_total)
-        if engine is not None:
-            engine.close()
+                   up_count > 0, shed, ttft_ms_done,
+                   delivered_total, up_count)
+        for eng in engines:
+            if eng is not None:
+                eng.close()
         return self._report(events, digest, wins, recs, admitted,
                             delivery_ticks, shed, trains_done, restarts,
                             tick, len(unfinished) + len(buffered),
-                            per_rank, final_now)
+                            per_rank, final_now, rr=rr,
+                            redispatched=redispatched)
 
     # --------------------------------------------------------- watch feed
     def _feed(self, now: float, depth: int, up: bool, shed: int,
-              ttft_ms_done: List[float], delivered: int) -> None:
+              ttft_ms_done: List[float], delivered: int,
+              up_count: Optional[int] = None) -> None:
         store, engine = self.watch.store, self.watch.engine
         store.add(0, QUEUE_DEPTH_FAMILY, now, float(depth))
         store.add(0, ENGINE_UP_FAMILY, now, 1.0 if up else 0.0)
         store.add(0, SHED_FAMILY, now, float(shed))
         store.add(0, TTFT_P99_FAMILY, now, percentile(ttft_ms_done, 99))
         store.add(0, DELIVERED_FAMILY, now, float(delivered))
+        store.add(0, REPLICAS_UP_FAMILY, now,
+                  float(up_count if up_count is not None
+                        else (1 if up else 0)))
         engine.evaluate(now)
 
     # ------------------------------------------------------------- report
     def _report(self, events, digest, wins, recs, admitted,
                 delivery_ticks, shed, trains_done, restarts, ticks,
-                backlog, per_rank, final_now) -> Dict[str, Any]:
+                backlog, per_rank, final_now, rr=None,
+                redispatched=0) -> Dict[str, Any]:
         spec = self.spec
         tick_s = spec.tick_s
         done = [r for r in recs.values() if r["finished"]]
@@ -426,6 +526,10 @@ class ScenarioHarness:
                         if f["count"] > 0})
         missing = [r for r in spec.expect_alerts if r not in fired]
         delivered = sum(r["delivered"] for r in recs.values())
+        replica_tier = None
+        if rr is not None:
+            replica_tier = rr.counters()
+            replica_tier["redispatched_streams"] = redispatched
         return {
             "name": spec.name, "seed": spec.seed,
             "virtual_ranks": self.nranks, "tick_ms": spec.tick_ms,
@@ -458,6 +562,7 @@ class ScenarioHarness:
                        "expected": list(spec.expect_alerts),
                        "missing": missing,
                        "ok": not missing},
+            **({"replica_tier": replica_tier} if replica_tier else {}),
         }
 
 
@@ -492,6 +597,14 @@ def canonical_rows(report: Dict[str, Any]) -> List[Dict[str, Any]]:
                        f"({len(storms)} outage(s); virtual clock)",
              "value": round(worst, 4), "unit": "seconds",
              "higher_is_better": False})
+    tier = report.get("replica_tier")
+    if tier:
+        rows.append(
+            {"metric": f"scenario {name} replica affinity hit rate "
+                       f"({tier['replicas']} replicas, "
+                       f"{tier['redispatched_streams']} re-dispatched)",
+             "value": tier.get("affinity_hit_rate") or 0.0,
+             "unit": "ratio", "higher_is_better": True})
     return rows
 
 

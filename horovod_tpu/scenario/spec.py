@@ -55,7 +55,7 @@ ENGINES = ("virtual", "real")
 
 _TOP_KEYS = {"name", "seed", "virtual_ranks", "tick_ms", "engine",
              "vocab", "kv_shards", "engine_config", "shed_high",
-             "shed_low", "phases", "storm", "alert_rules",
+             "shed_low", "replicas", "phases", "storm", "alert_rules",
              "expect_alerts"}
 _PHASE_KEYS = {"name", "kind", "duration_s", "arrivals", "shapes",
                "train_rate"}
@@ -80,6 +80,8 @@ class ScenarioSpec:
     engine_config: Dict[str, int] = dataclasses.field(default_factory=dict)
     shed_high: int = 0
     shed_low: int = 0
+    replicas: int = 1   # serving replica fleets behind one router
+                        # (docs/serving.md#replicated-tier)
     phases: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
     storm: List[StormEvent] = dataclasses.field(default_factory=list)
     alert_rules: List[Dict[str, Any]] = dataclasses.field(
@@ -103,6 +105,7 @@ class ScenarioSpec:
             "kv_shards": self.kv_shards,
             "engine_config": self.engine_config,
             "shed_high": self.shed_high, "shed_low": self.shed_low,
+            "replicas": self.replicas,
             "phases": self.phases,
             "storm": [dataclasses.asdict(e) for e in self.storm],
             "alert_rules": self.alert_rules,
@@ -223,6 +226,8 @@ def parse_scenario(doc: Any) -> ScenarioSpec:
                            top.get("shed_high", 0), lo=0)),
         shed_low=int(_num("top level", "shed_low",
                           top.get("shed_low", 0), lo=0)),
+        replicas=int(_num("top level", "replicas",
+                          top.get("replicas", 1), lo=1)),
         phases=phases,
         storm=parse_storm(top.get("storm")),
         alert_rules=list(top.get("alert_rules") or []),
@@ -236,6 +241,11 @@ def parse_scenario(doc: Any) -> ScenarioSpec:
             raise ValueError(
                 f"scenario spec: storm event #{j} field 'at_s': "
                 f"{ev.at_s} is past the {horizon}s trace horizon")
+        if ev.replica >= spec.replicas:
+            raise ValueError(
+                f"scenario spec: storm event #{j} field 'replica': "
+                f"{ev.replica} out of range for replicas="
+                f"{spec.replicas}")
     # alert_rules parse through the watch plane's own validator so a
     # typo'd rule fails HERE with its rule-#i message, and expect_alerts
     # must reference a rule that can actually exist (embedded or a

@@ -36,7 +36,7 @@ _STORM_FIELD_TYPES: Dict[str, Any] = {
     "kind": str,
     "at_s": (int, float), "down_s": (int, float),
     "duration_s": (int, float),
-    "rank": int, "exit_code": int, "shard": int,
+    "rank": int, "exit_code": int, "shard": int, "replica": int,
     "point": str, "op": str, "scope": str,
 }
 
@@ -53,6 +53,8 @@ class StormEvent:
     op: str = ""              # kv_blackout: put | get | "" (any)
     scope: str = ""           # kv_blackout: one KV scope; "" = all
     shard: int = -1           # kv_blackout: scopes mapping to this shard
+    replica: int = -1         # kill: one serving replica; -1 = the tier
+                              # (docs/serving.md#replicated-tier)
 
 
 def parse_storm(items: Any) -> List[StormEvent]:
@@ -188,10 +190,13 @@ def windows(storm: List[StormEvent], tick_s: float,
             adm, dlv = _blackout_sides(ev, kv_shards)
             others.append(Window("blackout", start, end, ev.at_s, ev,
                                  admission=adm, delivery=dlv))
-    outages.sort(key=lambda w: w.start_tick)
+    outages.sort(key=lambda w: (w.event.replica, w.start_tick))
     merged: List[Window] = []
     for w in outages:
-        if merged and w.start_tick <= merged[-1].end_tick:
+        if merged and merged[-1].event.replica == w.event.replica \
+                and w.start_tick <= merged[-1].end_tick:
+            # Same-target overlap extends ONE outage; kills aimed at
+            # DIFFERENT replicas stay independent windows.
             merged[-1].end_tick = max(merged[-1].end_tick, w.end_tick)
         else:
             merged.append(w)

@@ -36,6 +36,16 @@ class ServeConfig:
     prefix_cache: bool = True
     spec_decode: bool = True
     spec_k: int = 4
+    # Replicated tier (docs/serving.md#replicated-tier): this fleet's
+    # identity among N independent replica fleets behind one router,
+    # the prefill/decode role split within a replica, and the host-RAM
+    # spill capacity behind the device pool.  replica_id 0 keeps the
+    # unscoped KV names, so a single fleet is byte-for-byte the
+    # pre-replica deployment.
+    replica_id: int = 0
+    replicas: int = 1
+    prefill_ranks: int = 0
+    spill_blocks: int = 0
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -74,6 +84,32 @@ class ServeConfig:
                 f"row width: need spec_k + 1 <= prefill_chunk="
                 f"{self.prefill_chunk} (the compiled step verifies the "
                 "bonus token + K drafts in one row; docs/serving.md)")
+        if self.replicas < 1:
+            raise ValueError(
+                f"HOROVOD_SERVE_REPLICAS={self.replicas} invalid; the "
+                "replica tier needs >= 1 fleet "
+                "(docs/serving.md#replicated-tier)")
+        if not (0 <= self.replica_id < self.replicas):
+            raise ValueError(
+                f"HOROVOD_SERVE_REPLICA_ID={self.replica_id} invalid; "
+                f"must be in [0, HOROVOD_SERVE_REPLICAS={self.replicas})"
+                " (docs/serving.md#replicated-tier)")
+        if self.prefill_ranks < 0:
+            raise ValueError(
+                f"HOROVOD_SERVE_PREFILL_RANKS={self.prefill_ranks} "
+                "invalid; must be >= 0 (0 = colocated prefill+decode; "
+                "docs/serving.md#replicated-tier)")
+        if self.spill_blocks < 0:
+            raise ValueError(
+                f"HOROVOD_SERVE_SPILL_BLOCKS={self.spill_blocks} "
+                "invalid; must be >= 0 (0 = spill off; "
+                "docs/serving.md#replicated-tier)")
+        if self.spill_blocks and not self.prefix_cache:
+            raise ValueError(
+                f"HOROVOD_SERVE_SPILL_BLOCKS={self.spill_blocks} needs "
+                "the radix prefix cache on (HOROVOD_SERVE_PREFIX_CACHE); "
+                "only tree-held cold blocks spill "
+                "(docs/serving.md#replicated-tier)")
         if model_max_seq is not None and self.max_seq_len > model_max_seq:
             raise ValueError(
                 f"HOROVOD_SERVE_MAX_SEQ_LEN={self.max_seq_len} exceeds "
@@ -102,6 +138,10 @@ def from_knobs(knobs: Any, **overrides: Any) -> ServeConfig:
         prefix_cache=bool(_opt(knobs, "HOROVOD_SERVE_PREFIX_CACHE", True)),
         spec_decode=bool(_opt(knobs, "HOROVOD_SERVE_SPEC", True)),
         spec_k=int(_opt(knobs, "HOROVOD_SERVE_SPEC_K", 4)),
+        replica_id=int(_opt(knobs, "HOROVOD_SERVE_REPLICA_ID", 0)),
+        replicas=int(_opt(knobs, "HOROVOD_SERVE_REPLICAS", 1)),
+        prefill_ranks=int(_opt(knobs, "HOROVOD_SERVE_PREFILL_RANKS", 0)),
+        spill_blocks=int(_opt(knobs, "HOROVOD_SERVE_SPILL_BLOCKS", 0)),
     )
     kw.update(overrides)
     cfg = ServeConfig(**kw)
@@ -136,3 +176,9 @@ def validate_serve_knobs(knobs: Any) -> None:
             f"HOROVOD_SERVE_POLL_INTERVAL={poll} invalid; the router's "
             "stream-probe interval must be positive seconds "
             "(docs/control-plane.md)")
+    dead = float(_opt(knobs, "HOROVOD_SERVE_REPLICA_DEAD_S", 3.0))
+    if dead <= 0:
+        raise ValueError(
+            f"HOROVOD_SERVE_REPLICA_DEAD_S={dead} invalid; the router's "
+            "dark-replica threshold must be positive seconds "
+            "(docs/serving.md#replicated-tier)")

@@ -111,16 +111,108 @@ class _PrefixNode:
     """One radix-tree node = one pool block's worth of cached prompt KV:
     ``tokens`` are the token ids whose KV the block holds (a full block,
     or a partial tail shorter than block_size), children keyed by the
-    NEXT block's token tuple."""
+    NEXT block's token tuple.  ``block`` is None while the node's KV
+    lives in the host spill tier (HostSpillPool) — the node stays in
+    the tree so the prefix stays matchable and reloads on hit."""
 
     __slots__ = ("tokens", "block", "children", "parent", "stamp")
 
-    def __init__(self, tokens: Tuple[int, ...], block: int, parent):
+    def __init__(self, tokens: Tuple[int, ...], block: Optional[int],
+                 parent):
         self.tokens = tokens
         self.block = block
         self.children: Dict[Tuple[int, ...], "_PrefixNode"] = {}
         self.parent = parent
         self.stamp = 0
+
+
+# ------------------------------------------------------- host spill tier
+class HostSpillPool:
+    """Host-RAM tier behind the device paged pool
+    (docs/serving.md#replicated-tier): cold radix-tree blocks —
+    allocator refcount exactly 1, i.e. held by nobody but the tree —
+    migrate here instead of being dropped at eviction, and reload into
+    a fresh device block on the next prefix hit.  Capacity-bounded in
+    blocks; when full, the least-recently-touched held block (the
+    prefix cache's own deterministic ``stamp`` clock) is dropped for
+    good.  Pure host state driven by the request stream (no clock, no
+    RNG — the hvdlint serve-determinism scope covers this class), so a
+    lockstep fleet spills and reloads identically on every rank.
+
+    ``read_block(block) -> payload`` and ``write_block(block, payload)``
+    are engine-provided device accessors (numpy copies of one pool
+    block across layers); the pool itself never touches jax."""
+
+    def __init__(self, capacity_blocks: int, read_block, write_block):
+        self.capacity = int(capacity_blocks)
+        self._read = read_block
+        self._write = write_block
+        self._held: Dict[int, Any] = {}     # id(node) -> payload
+        self._nodes: Dict[int, Any] = {}    # id(node) -> node (for LRU)
+        self.spilled_total = 0
+        self.reloaded_total = 0
+        self.dropped_total = 0
+        self.bytes_held = 0
+
+    @property
+    def blocks_held(self) -> int:
+        return len(self._held)
+
+    def _payload_bytes(self, payload) -> int:
+        return sum(int(a.nbytes) for a in payload.values())
+
+    def _drop_coldest(self) -> None:
+        victim_key, victim = None, None
+        for key in sorted(self._nodes):
+            node = self._nodes[key]
+            if victim is None or node.stamp < victim.stamp:
+                victim_key, victim = key, node
+        if victim_key is None:
+            return
+        payload = self._held.pop(victim_key)
+        del self._nodes[victim_key]
+        self.bytes_held -= self._payload_bytes(payload)
+        self.dropped_total += 1
+        # the node's KV is gone for good: unlink it from the tree so
+        # match() never offers a prefix nobody can reload
+        if victim.parent is not None and not victim.children:
+            victim.parent.children.pop(victim.tokens, None)
+
+    def spill(self, node: _PrefixNode) -> bool:
+        """Migrate one tree-held block to host RAM.  Returns False when
+        capacity is 0 (spill off) — the caller evicts normally."""
+        if self.capacity <= 0:
+            return False
+        while len(self._held) >= self.capacity:
+            self._drop_coldest()
+        payload = self._read(node.block)
+        self._held[id(node)] = payload
+        self._nodes[id(node)] = node
+        self.bytes_held += self._payload_bytes(payload)
+        self.spilled_total += 1
+        return True
+
+    def reload(self, node: _PrefixNode, block: int) -> None:
+        """Write a held node's KV back into device ``block`` (the
+        caller allocated it; the tree takes the ref)."""
+        payload = self._held.pop(id(node))
+        del self._nodes[id(node)]
+        self.bytes_held -= self._payload_bytes(payload)
+        self._write(block, payload)
+        self.reloaded_total += 1
+
+    def holds(self, node: _PrefixNode) -> bool:
+        return id(node) in self._held
+
+    def counters(self) -> Dict[str, Any]:
+        return {
+            "capacity_blocks": self.capacity,
+            "held_blocks": len(self._held),
+            "held_bytes": self.bytes_held,
+            "spilled_total": self.spilled_total,
+            "reloaded_total": self.reloaded_total,
+            "dropped_total": self.dropped_total,
+        }
 
 
 class PrefixCache:
@@ -141,9 +233,11 @@ class PrefixCache:
     every rank replaying the same plan stream computes the identical
     tree, which is what keeps the fleet lockstep (docs/serving.md)."""
 
-    def __init__(self, block_size: int, allocator: BlockAllocator):
+    def __init__(self, block_size: int, allocator: BlockAllocator,
+                 spill: Optional[HostSpillPool] = None):
         self.block_size = int(block_size)
         self.allocator = allocator
+        self.spill = spill
         self.root = _PrefixNode((), -1, None)
         self._clock = 0          # deterministic LRU clock (touch order)
         self.hits = 0            # admissions with a nonzero prefix hit
@@ -155,6 +249,26 @@ class PrefixCache:
     def _touch(self, node: _PrefixNode) -> None:
         self._clock += 1
         node.stamp = self._clock
+
+    def _reload(self, node: _PrefixNode) -> bool:
+        """Bring a spilled node's KV back into a fresh device block (the
+        tree takes the ref, exactly like insert()).  The alloc may
+        itself evict — eviction never selects spilled nodes, so this
+        cannot recurse into the node being reloaded."""
+        if self.spill is None or not self.spill.holds(node):
+            return False
+        blocks = self.allocator.alloc(1)
+        if blocks is None:
+            if self.evict(1) < 1:
+                return False
+            blocks = self.allocator.alloc(1)
+            if blocks is None:
+                return False
+        self.spill.reload(node, blocks[0])
+        node.block = blocks[0]
+        from ..utils import metrics as M
+        M.SERVE_SPILL_RELOADS.inc()
+        return True
 
     def match(self, prompt: List[int]
               ) -> Tuple[List[int], Optional[Tuple[int, int]], int]:
@@ -173,6 +287,8 @@ class PrefixCache:
             child = node.children.get(tuple(prompt[pos:pos + bs]))
             if child is None:
                 break
+            if child.block is None and not self._reload(child):
+                break  # spilled and unreloadable: the match ends here
             self._touch(child)
             full.append(child.block)
             node, pos = child, pos + bs
@@ -192,6 +308,8 @@ class PrefixCache:
                 best, best_n = child, n
         cow = None
         if best is not None and best_n >= 1:
+            if best.block is None and not self._reload(best):
+                return full, None, pos
             self._touch(best)
             cow = (best.block, best_n)
         return full, cow, pos + best_n
@@ -225,21 +343,33 @@ class PrefixCache:
         """Free up to ``n_blocks`` by dropping least-recently-touched
         leaves only the cache references (allocator refcount exactly 1);
         returns how many were freed.  Interior nodes are never dropped —
-        that would orphan reachable children."""
+        that would orphan reachable children.  With a spill tier
+        attached, a victim's KV migrates to host RAM first (the node
+        stays in the tree, block None, reloadable on the next hit);
+        spilled nodes themselves are never victims — they hold no
+        device block."""
         freed = 0
         while freed < n_blocks:
             victim = None
             for node in self._walk(self.root):
                 if node is self.root or node.children:
                     continue
+                if node.block is None:
+                    continue  # already spilled: nothing on device
                 if self.allocator.ref(node.block) != 1:
                     continue
                 if victim is None or node.stamp < victim.stamp:
                     victim = node
             if victim is None:
                 break
-            del victim.parent.children[victim.tokens]
-            self.allocator.free([victim.block])
+            if self.spill is not None and self.spill.spill(victim):
+                self.allocator.free([victim.block])
+                victim.block = None
+                from ..utils import metrics as M
+                M.SERVE_SPILLS.inc()
+            else:
+                del victim.parent.children[victim.tokens]
+                self.allocator.free([victim.block])
             self.evictions += 1
             freed += 1
         return freed
@@ -334,10 +464,25 @@ class Scheduler:
     """Deterministic slot-table scheduler (pure host state, no jax) —
     unit-testable without a model.  ``plan()`` returns this tick's
     (slot, request, n_tokens) work list and performs admissions;
-    ``finish()`` evicts."""
+    ``finish()`` evicts.
 
-    def __init__(self, cfg: ServeConfig):
+    ``role`` is the prefill/decode disaggregation split
+    (docs/serving.md#replicated-tier): a ``mixed`` scheduler (the
+    default, byte-for-byte the pre-split engine) runs both phases; a
+    ``prefill`` scheduler admits from the waiting queue but its engine
+    hands finished prefills off instead of decoding them; a ``decode``
+    scheduler admits ONLY imported handoffs (``queue_import``) — its
+    waiting queue is never drained, so a stray submit cannot double-run
+    a prompt both sides of the split."""
+
+    ROLES = ("mixed", "prefill", "decode")
+
+    def __init__(self, cfg: ServeConfig, role: str = "mixed"):
+        if role not in self.ROLES:
+            raise ValueError(f"scheduler role {role!r} invalid; expected "
+                             f"one of {self.ROLES}")
         self.cfg = cfg
+        self.role = role
         self.slots: List[Optional[Request]] = [None] * cfg.max_slots
         self.waiting: "collections.deque[Request]" = collections.deque()
         self.allocator = BlockAllocator(cfg.cache_blocks)
@@ -347,9 +492,17 @@ class Scheduler:
             (cfg.max_slots, cfg.max_blocks_per_seq), np.int32)
         self.completed = 0
         self.admissions = 0
+        self.imports = 0
         # CoW copies the NEXT dispatch must run before its writes:
         # (src_block, dst_block) pairs, at most one per admission.
         self.pending_copies: List[Tuple[int, int]] = []
+        # Disaggregation intake: handoffs waiting for a slot, the
+        # device-block writes the next dispatch must apply before its
+        # step reads the cache, and the emissions (the prefill rank's
+        # first token) the next report must carry.
+        self.import_queue: "collections.deque" = collections.deque()
+        self.pending_writes: List[Tuple[int, Any]] = []
+        self.import_emits: List[Tuple[Request, List[int]]] = []
 
     # ------------------------------------------------------------ intake
     def submit(self, req: Request) -> Request:
@@ -370,7 +523,8 @@ class Scheduler:
         return sum(1 for s in self.slots if s is not None)
 
     def has_work(self) -> bool:
-        return self.active > 0 or bool(self.waiting)
+        return self.active > 0 or bool(self.waiting) or \
+            bool(self.import_queue)
 
     # -------------------------------------------------------------- plan
     def plan(self) -> List[Tuple[int, Request, int]]:
@@ -381,6 +535,7 @@ class Scheduler:
         budget = self.cfg.max_batch_tokens
         chunk = self.cfg.prefill_chunk
         work: List[Tuple[int, Request, int]] = []
+        self._drain_imports()
         for i, req in enumerate(self.slots):
             if req is not None and req.state == "decode" and budget >= 1:
                 req.draft = []
@@ -404,7 +559,7 @@ class Scheduler:
                 if n >= 1:
                     work.append((i, req, n))
                     budget -= n
-        while self.waiting and budget >= 1:
+        while self.waiting and budget >= 1 and self.role != "decode":
             free_slots = [i for i, s in enumerate(self.slots) if s is None]
             if not free_slots:
                 break
@@ -476,6 +631,70 @@ class Scheduler:
     def take_copies(self) -> List[Tuple[int, int]]:
         copies, self.pending_copies = self.pending_copies, []
         return copies
+
+    # ----------------------------------------------- disaggregated intake
+    def queue_import(self, req: Request, payloads: List[Any],
+                     first_token: int) -> None:
+        """Decode-side intake of one prefill-rank handoff: the request,
+        its prompt blocks' KV payloads (engine-decoded numpy dicts, one
+        per full-or-partial prompt block), and the first output token
+        the prefill rank already sampled.  Queued FCFS; ``plan()``
+        installs it the tick a slot and blocks free up."""
+        self.import_queue.append((req, payloads, int(first_token)))
+
+    def _drain_imports(self) -> None:
+        """Install queued handoffs straight into decode state: allocate
+        the full worst-case row (prompt + max_new blocks), stage the KV
+        payload writes for the next dispatch, emit the prefill rank's
+        first token.  FCFS head-of-line like admission — an uninstallable
+        handoff blocks the ones behind it (deterministic)."""
+        while self.import_queue:
+            free_slots = [i for i, s in enumerate(self.slots) if s is None]
+            if not free_slots:
+                break
+            req, payloads, first = self.import_queue[0]
+            need = -(-(req.prompt_len + req.max_new_tokens)
+                     // self.cfg.block_size)
+            blocks = self.allocator.alloc(need)
+            if blocks is None and self.prefix is not None:
+                short = need - self.allocator.free_count
+                if self.prefix.evict(short) >= short:
+                    blocks = self.allocator.alloc(need)
+            if blocks is None:
+                break
+            self.import_queue.popleft()
+            slot = free_slots[0]
+            req.slot, req.blocks = slot, blocks
+            req.state = "decode"
+            req.pos = req.prompt_len
+            req.ctx_len = req.prompt_len
+            req.out_tokens = [first]
+            req.admitted_t = time.perf_counter()
+            req.first_token_t = req.admitted_t
+            self.slots[slot] = req
+            self.block_tables[slot, :] = -1
+            self.block_tables[slot, :need] = blocks
+            self.admissions += 1
+            self.imports += 1
+            self.import_emits.append((req, [first]))
+            if (req.eos_id is not None and first == req.eos_id) or \
+                    req.max_new_tokens <= 1:
+                reason = ("eos" if req.eos_id is not None
+                          and first == req.eos_id else "completed")
+                self.finish(req, reason)
+                continue  # done on arrival: no KV writes needed
+            for b, payload in zip(blocks, payloads):
+                self.pending_writes.append((b, payload))
+            if self.prefix is not None:
+                self.prefix.insert(req.tokens, blocks)
+
+    def take_pending_writes(self) -> List[Tuple[int, Any]]:
+        writes, self.pending_writes = self.pending_writes, []
+        return writes
+
+    def take_import_emits(self) -> List[Tuple[Request, List[int]]]:
+        emits, self.import_emits = self.import_emits, []
+        return emits
 
     def register_prefix(self, req: Request) -> None:
         """Engine callback at prefill completion: the slot's prompt
@@ -550,6 +769,37 @@ def replicate_global(tree, mesh):
         lambda x: _make_global(np.asarray(x), sharding), tree)
 
 
+# --------------------------------------------------- block payload codec
+def encode_block_payload(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """One pool block's KV (the ``_read_block`` numpy dict, e.g.
+    {"k": [L, bs, kv_heads, hd], "v": ...}) as a JSON-safe record —
+    dtype/shape plus hex bytes — for the prefill->decode handoff ride
+    over the direct-stream path (serve/stream.py).  Hex doubles the
+    bytes but keeps the record line-framed JSON like every other stream
+    record; the payload is one block, not a sequence."""
+    out: Dict[str, Any] = {}
+    for k, a in payload.items():
+        a = np.ascontiguousarray(a)
+        out[k] = {"dtype": str(a.dtype), "shape": list(a.shape),
+                  "hex": a.tobytes().hex()}
+    return out
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # low-bit dtypes jax serves in (bf16 etc.)
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def decode_block_payload(enc: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: np.frombuffer(bytes.fromhex(v["hex"]),
+                             dtype=_np_dtype(v["dtype"]))
+            .reshape(v["shape"]).copy()
+            for k, v in enc.items()}
+
+
 # ---------------------------------------------------------------- engine
 class ServeEngine:
     """The continuous-batching engine: host scheduler + one jit'd mixed
@@ -561,7 +811,7 @@ class ServeEngine:
     """
 
     def __init__(self, model, model_cfg, params, cfg: ServeConfig,
-                 mesh=None):
+                 mesh=None, role: str = "mixed"):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -573,7 +823,7 @@ class ServeEngine:
             from .. import runtime as _rt
             mesh = _rt.get().mesh
         self.mesh = mesh
-        self.scheduler = Scheduler(cfg)
+        self.scheduler = Scheduler(cfg, role=role)
         self._repl = NamedSharding(mesh, P())
         self._cache_shd = cache_shardings(mesh, cfg.cache_blocks,
                                           model_cfg.n_kv_heads)
@@ -588,6 +838,16 @@ class ServeEngine:
         self.cache = jax.tree_util.tree_map(
             lambda x: _global_zeros(x.shape, x.dtype, self._cache_shd),
             cache_struct)
+        # Host-RAM spill tier behind the device pool
+        # (docs/serving.md#replicated-tier): evicted-but-warm radix
+        # blocks migrate to host instead of dying, reload on hit.
+        self._spill: Optional[HostSpillPool] = None
+        if cfg.spill_blocks > 0 and self.scheduler.prefix is not None:
+            self._spill = HostSpillPool(cfg.spill_blocks,
+                                        self._read_block,
+                                        self._write_block)
+            self.scheduler.prefix.spill = self._spill
+        self._handoffs = 0
         self._step_fn = self._build_step()
         # One-deep tick pipeline (the loader.prefetch deque pattern):
         # holds (plan, device next-token array) until the next step()
@@ -666,6 +926,79 @@ class ServeEngine:
     def has_work(self) -> bool:
         return self.scheduler.has_work() or bool(self._inflight)
 
+    # ---------------------------------------------------- block transfer
+    def _read_block(self, block: int) -> Dict[str, Any]:
+        """One pool block across all layers as host numpy (the spill
+        tier's read side and the prefill handoff's export side).  D2H
+        copy of [L, bs, kv_heads, hd] per cache leaf — one block, not
+        the pool."""
+        import jax
+        flat = {}
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(self.cache)
+        for path, leaf in leaves:
+            key = "/".join(str(getattr(p, "key", p)) for p in path)
+            flat[key] = np.asarray(leaf[:, block])
+        return flat
+
+    def _write_block(self, block: int, payload: Dict[str, Any]) -> None:
+        """Write one block's host payload back into the device pool
+        (spill reload / handoff import).  Functional ``.at[].set`` per
+        leaf — runs between steps, so the next dispatch reads it."""
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(self.cache)
+        new = []
+        for path, leaf in leaves:
+            key = "/".join(str(getattr(p, "key", p)) for p in path)
+            arr = np.asarray(payload[key]).astype(leaf.dtype)
+            new.append(leaf.at[:, block].set(arr))
+        self.cache = jax.tree_util.tree_unflatten(treedef, new)
+
+    # ------------------------------------------------------ disaggregation
+    def export_handoff(self, req: Request, first_token: int
+                       ) -> Dict[str, Any]:
+        """Serialize one finished prefill for a decode engine: the
+        request identity/budget, the first sampled token, and the
+        prompt blocks' KV as encoded payloads.  Pure read — the caller
+        decides when to finish the request."""
+        bs = self.cfg.block_size
+        n_blocks = -(-req.prompt_len // bs)
+        return {
+            "req_id": req.req_id,
+            "tokens": list(req.tokens),
+            "max_new_tokens": req.max_new_tokens,
+            "eos_id": req.eos_id,
+            "first_token": int(first_token),
+            "blocks": [encode_block_payload(self._read_block(b))
+                       for b in req.blocks[:n_blocks]],
+        }
+
+    def import_prefill(self, handoff: Dict[str, Any]) -> Request:
+        """Decode-side intake of a prefill rank's handoff record: queue
+        it for installation (Scheduler._drain_imports) — the request
+        enters the slot table directly in decode state with its prompt
+        KV written from the payload, skipping prefill entirely."""
+        req = Request(handoff["tokens"], int(handoff["max_new_tokens"]),
+                      req_id=handoff.get("req_id"),
+                      eos_id=(handoff.get("eos_id")
+                              if handoff.get("eos_id") is not None
+                              else self.cfg.eos_id))
+        payloads = [decode_block_payload(p) for p in handoff["blocks"]]
+        self.scheduler.queue_import(req, payloads,
+                                    int(handoff["first_token"]))
+        from ..utils import metrics as M
+        M.SERVE_IMPORTS.inc()
+        return req
+
+    def prefix_fps(self) -> Tuple[List[str], str]:
+        """This engine's radix-tree advertisement for the replica
+        router: (fingerprints, digest) — what rank 0 piggybacks on the
+        stats publish (serve/replica.py)."""
+        from .replica import prefix_fingerprints, fold_digest
+        if self.scheduler.prefix is None:
+            return [], fold_digest([])
+        fps = prefix_fingerprints(self.scheduler.prefix)
+        return fps, fold_digest(fps)
+
     # -------------------------------------------------------------- tick
     def step(self) -> Dict[str, Any]:
         """Run one engine tick.  Returns the COMPLETED tick's report
@@ -674,6 +1007,13 @@ class ServeEngine:
         when nothing completed."""
         report = self._harvest()
         self._dispatch()
+        # Handoff installs surface their first token (sampled by the
+        # prefill rank) in this report — the emission order a mixed
+        # engine would have produced at prefill completion.
+        for req, toks in self.scheduler.take_import_emits():
+            report["emitted"].setdefault(req.req_id, []).extend(toks)
+            if req.state == "done":
+                report["finished"].append(req)
         self._update_gauges()
         return report
 
@@ -686,6 +1026,11 @@ class ServeEngine:
 
     def _dispatch(self) -> None:
         work = self.scheduler.plan()
+        # Handoff imports staged by the plan: land the prompt KV in the
+        # pool BEFORE this tick's step reads it (functional .at writes,
+        # device-ordered ahead of the step call).
+        for b, payload in self.scheduler.take_pending_writes():
+            self._write_block(b, payload)
         for slot, req, n in work:
             if req.admitted_t is not None and not req.pos and \
                     req.state == "prefill" and req.ctx_len == 0:
@@ -745,13 +1090,14 @@ class ServeEngine:
     def _harvest(self) -> Dict[str, Any]:
         if not self._inflight:
             return {"tick": None, "processed": 0, "emitted": {},
-                    "finished": []}
+                    "finished": [], "handoff": []}
         from ..utils import metrics as M
         tick, work, next_tokens, used = self._inflight.popleft()
         tokens_host = np.asarray(next_tokens)  # D2H fence for this tick
         now = time.perf_counter()
         emitted: Dict[str, List[int]] = {}
         finished: List[Request] = []
+        handoffs: List[Dict[str, Any]] = []
         for slot, req, n in work:
             decode_row = req.state != "prefill"
             if not decode_row:
@@ -763,6 +1109,21 @@ class ServeEngine:
                 M.SERVE_PREFILL_CHUNKS.inc()
                 if req.pos < req.prompt_len:
                     continue  # still prefilling next tick
+                if self.scheduler.role == "prefill":
+                    # Disaggregation: this rank's job ends at prefill
+                    # completion — export the prompt KV + first token
+                    # for a decode engine, keep the prefix warm in OUR
+                    # tree (the next shared prompt still hits), free
+                    # the slot.  The first token is NOT emitted here;
+                    # the decode side emits it (exactly-once).
+                    first = int(tokens_host[slot, n - 1])
+                    self.scheduler.register_prefix(req)
+                    handoffs.append(self.export_handoff(req, first))
+                    self.scheduler.finish(req, "prefill_done")
+                    finished.append(req)
+                    self._handoffs += 1
+                    M.SERVE_HANDOFFS.inc()
+                    continue
                 req.state = "decode"
                 self.scheduler.register_prefix(req)
                 new_toks = [int(tokens_host[slot, n - 1])]
@@ -817,7 +1178,7 @@ class ServeEngine:
         from .. import postmortem as PM
         PM.record_step(tick)  # engine liveness on the /health plane
         return {"tick": tick, "processed": used, "emitted": emitted,
-                "finished": finished}
+                "finished": finished, "handoff": handoffs}
 
     def _update_gauges(self) -> None:
         from ..utils import metrics as M
@@ -886,6 +1247,11 @@ class ServeEngine:
             "eviction_pressure": (round(evictions / s.admissions, 4)
                                   if s.admissions else 0.0),
         })
+        if self._spill is not None:
+            spill = self._spill.counters()
+            spill["held_bytes_est"] = \
+                self._spill.blocks_held * block_bytes
+            occ["spill"] = spill
         return occ
 
     def close(self) -> None:
@@ -903,9 +1269,12 @@ class ServeEngine:
         prefix = s.prefix
         out = {
             "tick": self.tick,
+            "role": s.role,
             "active": s.active,
             "waiting": s.queue_depth,
             "completed": s.completed,
+            "imports": s.imports,
+            "handoffs": self._handoffs,
             "free_blocks": s.allocator.free_count,
             "kv_pool": self.kv_pool(),
             "batch_fill": round(self._last_fill, 4),
@@ -933,6 +1302,8 @@ class ServeEngine:
                 "hit_rate": (round(prefix.hits / s.admissions, 4)
                              if s.admissions else None),
             })
+        if self._spill is not None:
+            out["spill"] = self._spill.counters()
         return out
 
 

@@ -54,9 +54,10 @@ import json
 import math
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from .journal import JOURNAL_SCOPE
+from .replica import REPLICA_SCOPE, ReplicaRouter, scoped
 
 REQ_SCOPE = "serve_req"
 OUT_SCOPE = "serve_out"
@@ -70,6 +71,7 @@ DEFAULT_MAX_PENDING = 64
 DEFAULT_STREAM_TIMEOUT_S = 120.0
 RETRY_AFTER_CAP_S = 60
 _POLL_S = 0.02  # default base cadence; knob HOROVOD_SERVE_POLL_INTERVAL
+_DARK_CHECK_S = 0.25  # per-stream dark-replica probe cadence
 
 
 def req_key(seq: int) -> str:
@@ -246,19 +248,83 @@ class RouterState:
                     "journal": self.journal}
 
 
-def get_router_state(server) -> RouterState:
-    """Lazily attach one RouterState to the rendezvous HTTP server,
-    configured from the knob registry (watermarks, journal switch)."""
-    state = getattr(server, "serve_router", None)
+def get_router_state(server, replica_id: int = 0) -> RouterState:
+    """Lazily attach one RouterState per replica fleet to the
+    rendezvous HTTP server, configured from the knob registry
+    (watermarks, journal switch).  Replica 0's state is also aliased at
+    ``server.serve_router`` — the pre-replica attachment point every
+    existing test/tool reads (docs/serving.md#replicated-tier)."""
+    rid = int(replica_id)
+    states = getattr(server, "serve_routers", None)
+    if states is None:
+        states = server.serve_routers = {}
+    if rid == 0 and getattr(server, "serve_router", None) is not None:
+        states.setdefault(0, server.serve_router)
+    state = states.get(rid)
     if state is None:
         from ..common.knobs import Knobs
         knobs = Knobs()
-        state = server.serve_router = RouterState(
+        state = states[rid] = RouterState(
             shed_high=int(knobs["HOROVOD_SERVE_SHED_HIGH"]) or None,
             shed_low=int(knobs["HOROVOD_SERVE_SHED_LOW"]) or None,
             journal=bool(knobs["HOROVOD_SERVE_JOURNAL"]),
             poll_interval=float(knobs["HOROVOD_SERVE_POLL_INTERVAL"]))
+        if rid == 0:
+            server.serve_router = state
     return state
+
+
+def get_replica_router(server) -> ReplicaRouter:
+    """Lazily attach the replica registry/affinity router
+    (serve/replica.py) to the rendezvous HTTP server.  Empty until a
+    replica fleet registers — a single unregistered fleet keeps the
+    pre-replica fast path byte-for-byte."""
+    rr = getattr(server, "serve_replicas", None)
+    if rr is None:
+        from ..common.knobs import Knobs
+        knobs = Knobs()
+        rr = server.serve_replicas = ReplicaRouter(
+            affinity=bool(knobs["HOROVOD_SERVE_AFFINITY"]),
+            dead_after_s=float(knobs["HOROVOD_SERVE_REPLICA_DEAD_S"]))
+    return rr
+
+
+def refresh_replicas(server, rr: ReplicaRouter) -> int:
+    """Fold the replica registry scope and every registered replica's
+    latest stats publish (fingerprints, queue depth, shed) into the
+    ReplicaRouter; returns how many replicas are registered.  All reads
+    are in-process store lookups; heartbeat freshness is judged from
+    the server's own KV receipt stamps — a replica with a broken clock
+    still ages honestly."""
+    store = _store(server, REPLICA_SCOPE)
+    with store.kv_lock:
+        regs = dict(store.kv.get(REPLICA_SCOPE, {}))
+    for key in sorted(regs):
+        try:
+            info = json.loads(regs[key])
+            rid = int(info["replica_id"])
+        except (ValueError, TypeError, KeyError):
+            continue  # a torn registration must not 500 the front door
+        st_scope = scoped(STATS_SCOPE, rid)
+        st = _store(server, st_scope)
+        with st.kv_lock:
+            sraw = st.kv.get(st_scope, {}).get(STATS_KEY)
+            stamp = st.kv_times.get(st_scope, {}).get(STATS_KEY)
+        rr.register(rid, info, now=float(stamp or 0.0))
+        if sraw is not None and stamp is not None:
+            try:
+                rr.update(rid, json.loads(sraw), now=float(stamp))
+            except (ValueError, TypeError):
+                pass  # a torn stats PUT keeps the previous advertisement
+        # Least-loaded needs a signal fresher than the <= 1 Hz stats
+        # heartbeat: overlay this process's OWN in-flight count for the
+        # replica (requests routed here and not yet completed), so a
+        # burst arriving between two heartbeats spreads instead of
+        # piling onto the lowest replica id.
+        state = (getattr(server, "serve_routers", None) or {}).get(rid)
+        if state is not None:
+            rr.note_load(rid, state.next_seq - state.completed)
+    return len(rr.replicas)
 
 
 def parse_generate_body(raw: bytes) -> Dict[str, Any]:
@@ -284,13 +350,35 @@ def parse_generate_body(raw: bytes) -> Dict[str, Any]:
     return out
 
 
+def _enqueue_request(server, state: RouterState, rid: int,
+                     req: Dict[str, Any], key: str) -> None:
+    """Journal + enqueue one request under replica ``rid``'s scopes in
+    ONE critical section (both owning stores' locks held): the
+    journaled set and the promised set cannot diverge."""
+    rq_scope = scoped(REQ_SCOPE, rid)
+    jn_scope = scoped(JOURNAL_SCOPE, rid)
+    encoded = json.dumps(req).encode()
+    with _locked_stores(server, rq_scope, jn_scope) as stores:
+        now = time.time()
+        rq = stores[rq_scope]
+        rq.kv.setdefault(rq_scope, {})[key] = encoded
+        rq.kv_times.setdefault(rq_scope, {})[key] = now
+        if state.journal:
+            jn = stores[jn_scope]
+            jn.kv.setdefault(jn_scope, {})[key] = encoded
+            jn.kv_times.setdefault(jn_scope, {})[key] = now
+
+
 def handle_generate(handler) -> None:
-    """POST /generate on the rendezvous server: journal + enqueue to the
-    KV, then stream ndjson lines ({"tokens": [...]} parts, then
-    {"done": ...}) as the engine publishes them.  Connection close
-    delimits the body (HTTP/1.0 semantics of the rendezvous server)."""
+    """POST /generate on the rendezvous server: place the request on a
+    replica fleet (prefix affinity when replicas are registered —
+    serve/replica.py; the single unregistered fleet otherwise), journal
+    + enqueue to that replica's KV scopes, then stream ndjson lines
+    ({"tokens": [...]} parts, then {"done": ...}) as the engine
+    publishes them.  Connection close delimits the body (HTTP/1.0
+    semantics of the rendezvous server)."""
+    from ..utils import metrics as M
     server = handler.server
-    state = get_router_state(server)
     length = int(handler.headers.get("Content-Length", 0))
     raw = handler.rfile.read(length)
     try:
@@ -298,6 +386,26 @@ def handle_generate(handler) -> None:
     except ValueError as e:
         _json_response(handler, 400, {"error": str(e)})
         return
+    rr = get_replica_router(server)
+    replicated = refresh_replicas(server, rr) > 0
+    rid_replica, hit_blocks = 0, 0
+    if replicated:
+        placed = rr.route(req["tokens"], time.time())
+        if placed is None:
+            _json_response(handler, 503, {
+                "error": "no live serving replica (all heartbeats "
+                         "stale); retry",
+                "replicas": rr.counters(time.time())})
+            return
+        rid_replica, hit_blocks = placed
+        try:
+            M.ROUTER_ROUTED.inc(replica=str(rid_replica))
+            (M.ROUTER_AFFINITY_HITS if hit_blocks
+             else M.ROUTER_AFFINITY_MISSES).inc()
+            M.ROUTER_REPLICAS_UP.set(len(rr.live(time.time())))
+        except Exception:
+            pass  # telemetry must never take the front door down
+    state = get_router_state(server, rid_replica)
     seq = state.try_claim()
     if seq is None:
         if state.reject_reason == "draining":
@@ -316,29 +424,64 @@ def handle_generate(handler) -> None:
     req["id"] = key
     req["submitted_t"] = time.time()
     try:
-        encoded = json.dumps(req).encode()
-        with _locked_stores(server, REQ_SCOPE, JOURNAL_SCOPE) as stores:
-            now = time.time()
-            rq = stores[REQ_SCOPE]
-            rq.kv.setdefault(REQ_SCOPE, {})[key] = encoded
-            rq.kv_times.setdefault(REQ_SCOPE, {})[key] = now
-            if state.journal:
-                # Same critical section as the enqueue (both owning
-                # stores' locks held): the journaled set and the
-                # promised set cannot diverge.
-                jn = stores[JOURNAL_SCOPE]
-                jn.kv.setdefault(JOURNAL_SCOPE, {})[key] = encoded
-                jn.kv_times.setdefault(JOURNAL_SCOPE, {})[key] = now
+        _enqueue_request(server, state, rid_replica, req, key)
         handler.send_response(200)
         handler.send_header("Content-Type", "application/x-ndjson")
         handler.send_header("X-Serve-Request-Id", key)
+        if replicated:
+            handler.send_header("X-Serve-Replica", str(rid_replica))
+            handler.send_header("X-Serve-Affinity-Blocks",
+                                str(hit_blocks))
         handler.end_headers()
-        _stream_results(handler, server, key, state)
+        _stream_results(handler, server, key, state,
+                        replica_id=rid_replica,
+                        rr=rr if replicated else None, req=req)
     finally:
         state.finish_stream()
 
 
-def _stream_results(handler, server, key: str, state: RouterState) -> None:
+def _redispatch(server, rr: ReplicaRouter, req: Dict[str, Any],
+                dead_rid: int, streamed: List[int], part: int):
+    """Move one accepted stream off a dark replica: re-journal +
+    re-enqueue the request on the best surviving replica with
+    ``resume_emitted``/``resume_part`` set to what the client already
+    received — the survivor's rank 0 applies the standard redrive
+    suppression (serve/worker.py ``_apply_resume``), so the client's
+    ndjson stream resumes byte-identically from the last token it saw.
+    Returns ``(new_rid, new_key, new_state)`` or None (no survivor, or
+    the survivor is shedding — the caller keeps waiting until the
+    original replica returns or the stream times out)."""
+    from ..utils import metrics as M
+    now = time.time()
+    placed = rr.route(req["tokens"], now, exclude=[dead_rid])
+    if placed is None:
+        return None
+    new_rid, _ = placed
+    new_state = get_router_state(server, new_rid)
+    seq = new_state.try_claim()
+    if seq is None:
+        return None
+    new_key = req_key(seq)
+    rec = dict(req)
+    rec["id"] = new_key
+    rec["submitted_t"] = now
+    rec["resume_emitted"] = [int(t) for t in streamed]
+    rec["resume_part"] = int(part)
+    rec["redispatched_from"] = dead_rid
+    _enqueue_request(server, new_state, new_rid, rec, new_key)
+    rr.note_redispatch()
+    try:
+        M.ROUTER_REDISPATCHES.inc()
+        M.ROUTER_ROUTED.inc(replica=str(new_rid))
+    except Exception:
+        pass
+    return new_rid, new_key, new_state
+
+
+def _stream_results(handler, server, key: str, state: RouterState,
+                    replica_id: int = 0,
+                    rr: Optional[ReplicaRouter] = None,
+                    req: Optional[Dict[str, Any]] = None) -> None:
     """Drain ``serve_out`` parts for one request to the client as they
     arrive; ends with the ``.done`` record (or a timeout record).  Reads
     are in-process dict lookups — a fleet reset stalls the stream (no
@@ -348,48 +491,106 @@ def _stream_results(handler, server, key: str, state: RouterState) -> None:
     the timed wait is only the fallback cadence, backed off by
     :class:`AdaptivePoll`.  After the client consumes ``.done`` the
     request's parts are deleted and the done record slims to a
-    tombstone (the marker redrive skips) so serve_out stays bounded."""
-    store = _store(server, OUT_SCOPE)
-    wakeup = getattr(server, "kv_wakeup", None)
+    tombstone (the marker redrive skips) so serve_out stays bounded.
+
+    With a replica tier (``rr`` set), a stream whose replica goes DARK
+    mid-request is re-dispatched to a surviving replica
+    (:func:`_redispatch`): the wait loop switches to the survivor's
+    ``serve_out`` scope at the same part index and the client never
+    sees the failover."""
+    from ..runner.http_server import add_stream_waiter, drop_stream_waiter
+    out_scope = scoped(OUT_SCOPE, replica_id)
+    store = _store(server, out_scope)
+    # Keyed waiter (docs/serving.md#replicated-tier): this stream wakes
+    # only on ITS records, not on every record any stream ingests — the
+    # broadcast condition is the fallback for bare test servers.  The
+    # lost-wakeup window (record lands between the registry probe and
+    # the wait) is bounded by AdaptivePoll's hard cap, same as before.
+    keyed = add_stream_waiter(server, out_scope, key)
+    wakeup = keyed if keyed is not None \
+        else getattr(server, "kv_wakeup", None)
     poll = AdaptivePoll(state.poll_interval)
     deadline = time.time() + state.stream_timeout_s
+    next_dark_check = 0.0
     part = 0
-    while True:
-        with store.kv_lock:
-            scope = store.kv.get(OUT_SCOPE, {})
-            chunk = scope.get(f"{key}.part.{part:06d}")
-            done = scope.get(f"{key}.done")
-        if chunk is not None:
-            handler.wfile.write(chunk + b"\n")
-            handler.wfile.flush()
-            part += 1
-            poll.observe_data()
-            continue
-        if done is not None:
-            handler.wfile.write(done + b"\n")
-            handler.wfile.flush()
-            try:
-                rec = json.loads(done)
-                state.observe_done(rec.get("tpot_s"),
-                                   len(rec.get("tokens") or ()))
-            except (ValueError, TypeError):
-                pass  # a torn done record still ends the stream
-            _collect_consumed(store, key, part)
-            return
-        if time.time() >= deadline:
-            handler.wfile.write(json.dumps(
-                {"error": f"timed out after {state.stream_timeout_s:.0f}s "
-                          f"waiting for {key}"}).encode() + b"\n")
-            return
-        wait = poll.idle()
-        if wakeup is not None:
-            with wakeup:
-                wakeup.wait(wait)
-        else:
-            time.sleep(wait)
+    streamed: List[int] = []  # tokens on the client's wire (redispatch)
+    extra_states: List[RouterState] = []
+    try:
+        while True:
+            with store.kv_lock:
+                scope = store.kv.get(out_scope, {})
+                chunk = scope.get(f"{key}.part.{part:06d}")
+                done = scope.get(f"{key}.done")
+            if chunk is not None:
+                handler.wfile.write(chunk + b"\n")
+                handler.wfile.flush()
+                part += 1
+                poll.observe_data()
+                if rr is not None:
+                    try:
+                        streamed.extend(
+                            int(t) for t in
+                            json.loads(chunk).get("tokens", []))
+                    except (ValueError, TypeError):
+                        pass  # a torn part still reached the client
+                continue
+            if done is not None:
+                handler.wfile.write(done + b"\n")
+                handler.wfile.flush()
+                try:
+                    rec = json.loads(done)
+                    state.observe_done(rec.get("tpot_s"),
+                                       len(rec.get("tokens") or ()))
+                except (ValueError, TypeError):
+                    pass  # a torn done record still ends the stream
+                _collect_consumed(store, key, part, out_scope)
+                return
+            if time.time() >= deadline:
+                handler.wfile.write(json.dumps(
+                    {"error": "timed out after "
+                              f"{state.stream_timeout_s:.0f}s "
+                              f"waiting for {key}"}).encode() + b"\n")
+                return
+            if rr is not None and req is not None and \
+                    time.time() >= next_dark_check:
+                # Bound the dark-replica probe's cadence per stream:
+                # kv_wakeup is a per-record broadcast, so checking on
+                # every idle wake would fold the whole registry
+                # O(streams x tokens/s) times — the heartbeat the probe
+                # reads only moves at ~1 Hz anyway, and dead_after_s
+                # dwarfs a quarter-second detection lag.
+                next_dark_check = time.time() + _DARK_CHECK_S
+                refresh_replicas(server, rr)
+                if rr.is_dark(replica_id, time.time()):
+                    moved = _redispatch(server, rr, req, replica_id,
+                                        streamed, part)
+                    if moved is not None:
+                        if keyed is not None:
+                            drop_stream_waiter(server, out_scope, key)
+                        replica_id, key, new_state = moved
+                        extra_states.append(new_state)
+                        out_scope = scoped(OUT_SCOPE, replica_id)
+                        store = _store(server, out_scope)
+                        keyed = add_stream_waiter(server, out_scope, key)
+                        wakeup = keyed if keyed is not None \
+                            else getattr(server, "kv_wakeup", None)
+                        poll.observe_data()  # survivor restarts cadence
+                        continue
+            wait = poll.idle()
+            if wakeup is not None:
+                with wakeup:
+                    wakeup.wait(wait)
+            else:
+                time.sleep(wait)
+    finally:
+        if keyed is not None:
+            drop_stream_waiter(server, out_scope, key)
+        for st in extra_states:
+            st.finish_stream()
 
 
-def _collect_consumed(store, key: str, nparts: int) -> None:
+def _collect_consumed(store, key: str, nparts: int,
+                      out_scope: str = OUT_SCOPE) -> None:
     """Garbage-collect one fully-consumed stream: delete its serve_out
     parts and slim ``.done`` to a token-free tombstone.  The tombstone
     must survive — it is what redrive_plan (serve/journal.py) skips; a
@@ -397,8 +598,8 @@ def _collect_consumed(store, key: str, nparts: int) -> None:
     whose client is gone."""
     done_key = f"{key}.done"
     with store.kv_lock:
-        scope = store.kv.get(OUT_SCOPE, {})
-        times = store.kv_times.get(OUT_SCOPE, {})
+        scope = store.kv.get(out_scope, {})
+        times = store.kv_times.get(out_scope, {})
         for p in range(nparts):
             pk = f"{key}.part.{p:06d}"
             scope.pop(pk, None)
@@ -427,33 +628,51 @@ def handle_drain(handler) -> None:
     from ..common.knobs import Knobs
     from ..utils import metrics as M
     server = handler.server
-    state = get_router_state(server)
-    first = not state.draining
-    state.draining = True
+    rr = get_replica_router(server)
+    rids = (sorted(rr.replicas)
+            if refresh_replicas(server, rr) else [0])
+    first = False
+    for rid in rids:
+        state = get_router_state(server, rid)
+        first = first or not state.draining
+        state.draining = True
     if first:
         M.SERVE_DRAINS.inc()
-    store = _store(server, STATS_SCOPE)
-    with store.kv_lock:
-        now = time.time()
-        store.kv.setdefault(STATS_SCOPE, {})[DRAIN_KEY] = \
-            json.dumps({"t": now}).encode()
-        store.kv_times.setdefault(STATS_SCOPE, {})[DRAIN_KEY] = now
+    stores = {}
+    for rid in rids:
+        st_scope = scoped(STATS_SCOPE, rid)
+        store = stores[rid] = (st_scope, _store(server, st_scope))
+        with store[1].kv_lock:
+            now = time.time()
+            store[1].kv.setdefault(st_scope, {})[DRAIN_KEY] = \
+                json.dumps({"t": now}).encode()
+            store[1].kv_times.setdefault(st_scope, {})[DRAIN_KEY] = now
     deadline = time.time() + float(Knobs()["HOROVOD_SERVE_DRAIN_TIMEOUT"])
-    ack = None
-    while time.time() < deadline:
-        with store.kv_lock:
-            ack = store.kv.get(STATS_SCOPE, {}).get(DRAINED_KEY)
-        if ack is not None:
-            break
-        time.sleep(_POLL_S)
-    out: Dict[str, Any] = {"drained": ack is not None,
-                           "router": state.counters()}
-    if ack is not None:
+    acks: Dict[int, Any] = {}
+    while time.time() < deadline and len(acks) < len(rids):
+        for rid in rids:
+            if rid in acks:
+                continue
+            st_scope, store = stores[rid]
+            with store.kv_lock:
+                ack = store.kv.get(st_scope, {}).get(DRAINED_KEY)
+            if ack is not None:
+                acks[rid] = ack
+        if len(acks) < len(rids):
+            time.sleep(_POLL_S)
+    drained = len(acks) == len(rids)
+    out: Dict[str, Any] = {
+        "drained": drained,
+        "router": get_router_state(server, rids[0]).counters()}
+    if len(rids) > 1:
+        out["replicas_drained"] = sorted(acks)
+        out["replicas"] = rids
+    if acks:
         try:
-            out["engine_final"] = json.loads(ack)
+            out["engine_final"] = json.loads(acks[min(acks)])
         except (ValueError, TypeError):
             pass  # a torn ack still proves the drain completed
-    _json_response(handler, 200 if ack is not None else 504, out)
+    _json_response(handler, 200 if drained else 504, out)
 
 
 def render_stats(server) -> Dict[str, Any]:
@@ -475,6 +694,22 @@ def render_stats(server) -> Dict[str, Any]:
             out["engine"] = json.loads(raw)
         except (ValueError, TypeError):
             pass  # a torn PUT must not 500 the stats view
+    rr = get_replica_router(server)
+    if refresh_replicas(server, rr):
+        # Replicated tier (docs/serving.md#replicated-tier): placement
+        # counters + per-replica registry/load/digest rows, each
+        # replica's admission state, and its full self-published engine
+        # stats (kv_pool + spill occupancy included) — the payload
+        # `hvdrun doctor --serve` renders as the per-replica table.
+        now = time.time()
+        view = rr.counters(now)
+        view["admission"] = {
+            str(rid): get_router_state(server, rid).counters()
+            for rid in sorted(rr.replicas)}
+        view["engines"] = {
+            str(rid): rr.replicas[rid].get("stats", {})
+            for rid in sorted(rr.replicas)}
+        out["replicas"] = view
     from ..runner.http_server import kv_shard_health, watch_state_for
     shards = kv_shard_health(server)
     if shards is not None:

@@ -100,10 +100,36 @@ def ingest_record(server, rec: Dict[str, Any]) -> bool:
     byte-compatible with the KV PUT path, so redrive prefix recovery
     and late-attaching client streams see one truth — and wake the
     stream drains.  Returns False for records without a usable shape."""
-    from ..runner.http_server import store_for
+    from ..runner.http_server import store_for, wake_stream
     from .router import OUT_SCOPE
+    if rec.get("kind") == "kvblock":
+        # Prefill->decode KV handoff riding the same persistent stream
+        # (docs/serving.md#replicated-tier): mirrored into its serve_kv
+        # scope exactly as the KV PUT fallback would have written it.
+        kv_scope = rec.get("scope")
+        key = rec.get("key")
+        payload = rec.get("payload")
+        if not isinstance(kv_scope, str) or \
+                not kv_scope.startswith("serve_kv") or \
+                not isinstance(key, str) or payload is None:
+            return False
+        store = store_for(server, kv_scope)
+        now = time.time()
+        with store.kv_lock:
+            store.kv.setdefault(kv_scope, {})[key] = \
+                json.dumps(payload).encode()
+            store.kv_times.setdefault(kv_scope, {})[key] = now
+        wake_stream(server, kv_scope, key)
+        return True
     rid = rec.get("rid")
     if not rid or not isinstance(rid, str):
+        return False
+    # Replica scoping (serve/replica.py): replica K's worker labels its
+    # records with the scoped output scope; unlabeled records are the
+    # single-fleet/replica-0 path, byte-identical to before.
+    out_scope = rec.get("scope", OUT_SCOPE)
+    if not isinstance(out_scope, str) or \
+            out_scope.split(".r", 1)[0] != OUT_SCOPE:
         return False
     if "tokens" in rec and rec.get("part") is not None:
         key = f"{rid}.part.{int(rec['part']):06d}"
@@ -115,21 +141,18 @@ def ingest_record(server, rec: Dict[str, Any]) -> bool:
         ntokens = 0
     else:
         return False
-    store = store_for(server, OUT_SCOPE)
+    store = store_for(server, out_scope)
     now = time.time()
     with store.kv_lock:
-        store.kv.setdefault(OUT_SCOPE, {})[key] = value
-        store.kv_times.setdefault(OUT_SCOPE, {})[key] = now
+        store.kv.setdefault(out_scope, {})[key] = value
+        store.kv_times.setdefault(out_scope, {})[key] = now
     if ntokens:
         try:
             from ..utils import metrics as M
             M.SERVE_STREAM_DIRECT_TOKENS.inc(ntokens)
         except Exception:
             pass  # telemetry must never break token delivery
-    cond = getattr(server, "kv_wakeup", None)
-    if cond is not None:
-        with cond:
-            cond.notify_all()
+    wake_stream(server, out_scope, key)
     return True
 
 

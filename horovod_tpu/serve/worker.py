@@ -64,11 +64,25 @@ import sys
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from .replica import REPLICA_SCOPE, replica_key, scoped
 from .router import (DRAIN_KEY, DRAINED_KEY, OUT_SCOPE, PLAN_SCOPE,
                      REQ_SCOPE, STATS_KEY, STATS_SCOPE, req_key)
 
+# Prefill->decode handoff scope (docs/serving.md#replicated-tier): the
+# prefill sub-fleet's rank 0 publishes each finished prefill's prompt
+# KV + first token here (densely numbered, like serve_req) and the
+# decode sub-fleet drains it in order.
+KV_SCOPE = "serve_kv"
+
 _IDLE_SLEEP_S = 0.02
 _STATS_INTERVAL_S = 1.0
+# Drain-latch probe cadence: the latch is a driver/human-scale signal,
+# but probing it was a KV roundtrip on EVERY engine tick — at serving
+# tick rates that roundtrip (two thread handoffs through the rendezvous
+# server) was a measurable slice of the tick budget.  A quarter-second
+# poll bounds drain pickup latency far below the drain timeout while
+# taking the probe off the hot loop.
+_DRAIN_POLL_S = 0.25
 # Serve-loop KV retry budget: wider than the http_client's own write
 # budget because a mid-stream outage should stall serving, not kill it
 # (the elastic driver would misread the death as a rank failure).
@@ -90,7 +104,8 @@ class FleetFrontend:
     def __init__(self, engine, addr: str, port: int, rank: int,
                  nprocs: int, plan_timeout_s: float = 120.0,
                  epoch: int = 0, journal: bool = True,
-                 drain_timeout_s: float = 30.0, direct: bool = True):
+                 drain_timeout_s: float = 30.0, direct: bool = True,
+                 replica_id: int = 0, role: str = "mixed"):
         self.engine = engine
         self.addr = addr
         self.port = int(port or 0)
@@ -101,12 +116,37 @@ class FleetFrontend:
         self.journal = bool(journal)
         self.drain_timeout_s = float(drain_timeout_s)
         self.direct = bool(direct)
+        self.replica_id = int(replica_id)
+        self.role = str(role)
+        # Per-replica KV scoping (serve/replica.py): replica 0 keeps
+        # the unscoped names, replica K suffixes .rKK — N fleets share
+        # one rendezvous without collisions.
+        self.req_scope = scoped(REQ_SCOPE, self.replica_id)
+        self.out_scope = scoped(OUT_SCOPE, self.replica_id)
+        self.plan_scope = scoped(PLAN_SCOPE, self.replica_id)
+        self.stats_scope = scoped(STATS_SCOPE, self.replica_id)
+        self.kv_scope = scoped(KV_SCOPE, self.replica_id)
+        self._stats_key = STATS_KEY
+        self._drained_key = DRAINED_KEY
+        if self.role == "prefill":
+            # The prefill sub-fleet runs its own plan stream and stats
+            # key beside the decode sub-fleet's — the decode side owns
+            # the client-facing ones (it emits the tokens).
+            self.plan_scope += ".pf"
+            self._stats_key += ".prefill"
+            self._drained_key += ".prefill"
         self._dstream = None  # lazy: serve/stream.DirectTokenStream
         self.tick = 0
         self._next_seq = 0
+        self._next_handoff = 0  # decode role: serve_kv drain cursor
+        self._handoff_seq = 0   # prefill role: serve_kv publish cursor
         self._parts: Dict[str, int] = {}
         self._results: Dict[str, List[int]] = {}
         self._suppress: Dict[str, int] = {}  # rid -> tokens NOT to re-publish
+        # Prefill role only: redriven requests' already-streamed prefixes,
+        # forwarded through the handoff so the DECODE publisher (the one
+        # that owns the client stream) suppresses them, not us.
+        self._resume_info: Dict[str, Dict[str, Any]] = {}
         self._last_stats = 0.0
 
     # ------------------------------------------------------------ KV I/O
@@ -149,7 +189,7 @@ class FleetFrontend:
         (dense router numbering -> nonblocking probes, no listing)."""
         reqs = []
         while True:
-            raw = self._kv_get(REQ_SCOPE, req_key(self._next_seq))
+            raw = self._kv_get(self.req_scope, req_key(self._next_seq))
             if raw is None:
                 return reqs
             try:
@@ -170,7 +210,7 @@ class FleetFrontend:
         digest = getattr(self.engine, "sched_digest", None)
         if digest is not None:
             payload["sched"] = digest
-        self._kv_put(PLAN_SCOPE, plan_key(self.tick, self.epoch),
+        self._kv_put(self.plan_scope, plan_key(self.tick, self.epoch),
                      json.dumps(payload).encode())
 
     def _fetch_plan(self) -> Dict[str, Any]:
@@ -178,7 +218,8 @@ class FleetFrontend:
         # serve-kv-retry): a transient rendezvous blip during the
         # long-poll must stall this follower, not kill it — the
         # poll's own timeout still surfaces as the None below.
-        raw = self._kv_get(PLAN_SCOPE, plan_key(self.tick, self.epoch),
+        raw = self._kv_get(self.plan_scope,
+                           plan_key(self.tick, self.epoch),
                            timeout=self.plan_timeout_s)
         if raw is None:
             raise TimeoutError(
@@ -216,13 +257,16 @@ class FleetFrontend:
         fast-forwards — orphaned streams time out at the router."""
         if not self.journal:
             seq = 0
-            while self._kv_get(REQ_SCOPE, req_key(seq)) is not None:
+            while self._kv_get(self.req_scope, req_key(seq)) is not None:
                 seq += 1
             self._next_seq = seq
             return []
         from .journal import redrive_plan
+        # journal.py stays replica-agnostic: the getter rewrites its
+        # scope names into this replica's (serve/replica.py scoped()).
         entries, seq = redrive_plan(
-            lambda scope, key: self._kv_get(scope, key))
+            lambda scope, key: self._kv_get(
+                scoped(scope, self.replica_id), key))
         self._next_seq = seq
         if entries and self.epoch > 0:
             # Epoch 0 is first bring-up: journal entries there are just
@@ -262,15 +306,21 @@ class FleetFrontend:
         return self._dstream.send(record)
 
     def _publish_part(self, rid: str, part: int, toks: List[int]) -> None:
-        if self._direct_send({"rid": rid, "part": part, "tokens": toks}):
+        rec = {"rid": rid, "part": part, "tokens": toks}
+        if self.replica_id:
+            rec["scope"] = self.out_scope
+        if self._direct_send(rec):
             return
-        self._kv_put(OUT_SCOPE, f"{rid}.part.{part:06d}",
+        self._kv_put(self.out_scope, f"{rid}.part.{part:06d}",
                      json.dumps({"tokens": toks}).encode())
 
     def _publish_done(self, rid: str, done: Dict[str, Any]) -> None:
-        if self._direct_send({"rid": rid, "done": done}):
+        rec = {"rid": rid, "done": done}
+        if self.replica_id:
+            rec["scope"] = self.out_scope
+        if self._direct_send(rec):
             return
-        self._kv_put(OUT_SCOPE, f"{rid}.done",
+        self._kv_put(self.out_scope, f"{rid}.done",
                      json.dumps(done).encode())
 
     def _publish_report(self, report: Dict[str, Any]) -> None:
@@ -294,6 +344,11 @@ class FleetFrontend:
             self._publish_part(rid, part, toks)
             self._parts[rid] = part + 1
         for req in report["finished"]:
+            if req.finish_reason == "prefill_done":
+                # Prefill-role completion: the request's life continues
+                # on the decode sub-fleet (via the serve_kv handoff) —
+                # the decode side owns the client-facing .done.
+                continue
             self._publish_done(req.req_id, {
                 "done": True,
                 "tokens": self._results.pop(req.req_id, []),
@@ -310,8 +365,19 @@ class FleetFrontend:
             return
         self._last_stats = now
         try:
-            self._kv_put(STATS_SCOPE, STATS_KEY,
-                         json.dumps(self.engine.stats()).encode())
+            payload = dict(self.engine.stats(),
+                           replica_id=self.replica_id)
+            payload["queue_depth"] = int(payload.get("waiting", 0))
+            fps = getattr(self.engine, "prefix_fps", None)
+            if fps is not None:
+                # Affinity piggyback (serve/replica.py): the router
+                # learns this replica's radix-tree fingerprints from the
+                # same heartbeat it already reads for liveness.
+                fp_list, digest = fps()
+                payload["prefix_fps"] = fp_list
+                payload["replica_digest"] = digest
+            self._kv_put(self.stats_scope, self._stats_key,
+                         json.dumps(payload).encode())
         except Exception:
             if force:
                 raise
@@ -319,15 +385,60 @@ class FleetFrontend:
 
     # ------------------------------------------------------------- drain
     def _drain_requested(self) -> bool:
-        return self._kv_get(STATS_SCOPE, DRAIN_KEY) is not None
+        return self._kv_get(self.stats_scope, DRAIN_KEY) is not None
 
     def _publish_drained(self) -> None:
         """The ack POST /admin/drain waits on: final engine stats plus
         the completed count, written once everything accepted is done."""
         payload = dict(self.engine.stats(), epoch=self.epoch,
                        t=time.time())
-        self._kv_put(STATS_SCOPE, DRAINED_KEY,
+        self._kv_put(self.stats_scope, self._drained_key,
                      json.dumps(payload).encode())
+
+    # ----------------------------------------------------- replica/handoff
+    def register_replica(self, info: Optional[Dict[str, Any]] = None) \
+            -> None:
+        """Rank 0 of a replicated fleet announces itself under the
+        ``replicas`` scope so the router can discover and route to it
+        (serve/replica.py).  Liveness afterwards is the stats heartbeat,
+        not this one-shot registration."""
+        payload = {"replica_id": self.replica_id, "epoch": self.epoch,
+                   "nprocs": self.nprocs, "role": self.role}
+        if info:
+            payload.update(info)
+        self._kv_put(REPLICA_SCOPE, replica_key(self.replica_id),
+                     json.dumps(payload).encode())
+
+    def _publish_handoffs(self, report: Dict[str, Any]) -> None:
+        """Prefill-role rank 0: ship each finished prefill's prompt KV
+        + first token to the decode sub-fleet via serve_kv (densely
+        numbered, so the decode side drains with nonblocking probes)."""
+        for h in report.get("handoff", []):
+            info = self._resume_info.pop(h.get("req_id"), None)
+            if info:
+                h = dict(h, **info)
+            key = f"handoff.{self._handoff_seq:06d}"
+            rec = {"kind": "kvblock", "scope": self.kv_scope,
+                   "key": key, "payload": h}
+            if not self._direct_send(rec):
+                self._kv_put(self.kv_scope, key,
+                             json.dumps(h).encode())
+            self._handoff_seq += 1
+
+    def _drain_handoffs(self) -> List[Dict[str, Any]]:
+        """Decode-role rank 0: consume prefill handoffs in sequence
+        order; each becomes a plan entry every decode rank imports."""
+        out = []
+        while True:
+            raw = self._kv_get(self.kv_scope,
+                               f"handoff.{self._next_handoff:06d}")
+            if raw is None:
+                return out
+            try:
+                out.append({"handoff": json.loads(raw)})
+            except (ValueError, TypeError):
+                out.append(None)  # torn PUT: hold the dense numbering
+            self._next_handoff += 1
 
     # -------------------------------------------------------------- loop
     def run(self, ttl_s: float = 0.0) -> int:
@@ -340,11 +451,15 @@ class FleetFrontend:
         solo_kv = self.nprocs == 1 and bool(self.addr and self.port)
         kv_backed = fleet or solo_kv
         carry: List[Dict[str, Any]] = []
-        if self.rank == 0 and kv_backed:
+        if self.rank == 0 and kv_backed and self.role != "decode":
+            # Decode role never touches serve_req — redrive replays
+            # through the prefill sub-fleet, which re-hands-off with the
+            # resume prefix attached (byte-identical stream resumption).
             carry = self.resume_from_kv()
         t0 = time.monotonic()
         stop = False
         drain_t: Optional[float] = None
+        drain_check_t = 0.0
         try:
             while True:
                 # Loop liveness for /health supervision: an IDLE fleet
@@ -353,11 +468,21 @@ class FleetFrontend:
                 _chaos.maybe_stall("serve_tick")
                 if self.rank == 0:
                     if drain_t is None and kv_backed and \
-                            self._drain_requested():
-                        drain_t = time.monotonic()
-                        print(f"[hvd.serve] rank 0: drain requested — "
-                              "finishing in-flight work", flush=True)
-                    reqs = self._drain_requests() if kv_backed else []
+                            time.monotonic() >= drain_check_t:
+                        drain_check_t = time.monotonic() + _DRAIN_POLL_S
+                        if self._drain_requested():
+                            drain_t = time.monotonic()
+                            print("[hvd.serve] rank 0: drain requested "
+                                  "— finishing in-flight work",
+                                  flush=True)
+                    if not kv_backed:
+                        reqs = []
+                    elif self.role == "decode":
+                        # The decode sub-fleet's work arrives as prefill
+                        # handoffs, not raw client requests.
+                        reqs = self._drain_handoffs()
+                    else:
+                        reqs = self._drain_requests()
                     if carry:
                         reqs = carry + reqs
                         carry = []
@@ -388,8 +513,25 @@ class FleetFrontend:
                 for r in reqs:
                     if r is None:
                         continue
+                    if "handoff" in r:
+                        # Prefill->decode import: the prompt KV is in
+                        # the payload; skips the admission queue.
+                        h = r["handoff"]
+                        if self.rank == 0 and kv_backed and \
+                                h.get("resume_emitted") is not None:
+                            self._apply_resume(
+                                {"id": h.get("req_id"),
+                                 "resume_emitted": h["resume_emitted"],
+                                 "resume_part": h.get("resume_part", 0)})
+                        self.engine.import_prefill(h)
+                        continue
                     if self.rank == 0 and kv_backed:
                         self._apply_resume(r)
+                        if self.role == "prefill" and \
+                                r.get("resume_emitted") is not None:
+                            self._resume_info[r["id"]] = {
+                                "resume_emitted": r["resume_emitted"],
+                                "resume_part": r.get("resume_part", 0)}
                     try:
                         self.engine.submit(r["tokens"],
                                            r["max_new_tokens"],
@@ -410,6 +552,8 @@ class FleetFrontend:
                 report = self.engine.step()
                 if self.rank == 0 and kv_backed:
                     self._publish_report(report)
+                    if self.role == "prefill":
+                        self._publish_handoffs(report)
                     self._publish_stats()
                 if not self.engine.has_work() and not reqs:
                     if self.rank == 0:
@@ -485,21 +629,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     if scfg.max_seq_len > model_cfg.max_seq:
         import dataclasses
         scfg = dataclasses.replace(scfg, max_seq_len=model_cfg.max_seq)
-    engine = ServeEngine(model, model_cfg, params, scfg, mesh=hvd.mesh())
+    # Prefill/decode disaggregation (docs/serving.md#replicated-tier):
+    # HOROVOD_SERVE_PREFILL_RANKS splits the fleet into two sub-fleets,
+    # each with its own rank 0 and plan stream; the decode side owns the
+    # client-facing output and stats scopes.
+    pf = int(scfg.prefill_ranks)
+    rank, size = hvd.process_rank(), hvd.process_size()
+    if 0 < pf < size:
+        if rank < pf:
+            role, sub_rank, sub_n = "prefill", rank, pf
+        else:
+            role, sub_rank, sub_n = "decode", rank - pf, size - pf
+    else:
+        role, sub_rank, sub_n = "mixed", rank, size
+    engine = ServeEngine(model, model_cfg, params, scfg,
+                         mesh=hvd.mesh(), role=role)
     epoch = int(rt.knobs["HOROVOD_ELASTIC_ROUND"])
     frontend = FleetFrontend(
         engine,
         rt.knobs["HOROVOD_RENDEZVOUS_ADDR"],
         rt.knobs["HOROVOD_RENDEZVOUS_PORT"],
-        hvd.process_rank(), hvd.process_size(),
+        sub_rank, sub_n,
         epoch=epoch,
         journal=bool(rt.knobs["HOROVOD_SERVE_JOURNAL"]),
         drain_timeout_s=float(rt.knobs["HOROVOD_SERVE_DRAIN_TIMEOUT"]),
-        direct=bool(rt.knobs["HOROVOD_SERVE_DIRECT"]))
-    print(f"SERVE-READY rank {hvd.process_rank()} epoch {epoch} "
+        direct=bool(rt.knobs["HOROVOD_SERVE_DIRECT"]),
+        replica_id=scfg.replica_id, role=role)
+    print(f"SERVE-READY rank {rank} epoch {epoch} "
           f"({type(model_cfg).__name__}, slots={scfg.max_slots}, "
-          f"blocks={scfg.cache_blocks}x{scfg.block_size})", flush=True)
-    if hvd.process_rank() == 0 and frontend.addr and frontend.port:
+          f"blocks={scfg.cache_blocks}x{scfg.block_size}, role={role}, "
+          f"replica={scfg.replica_id}/{scfg.replicas})", flush=True)
+    if sub_rank == 0 and frontend.addr and frontend.port:
+        if scfg.replicas > 1 and role != "prefill":
+            frontend.register_replica({"replicas": scfg.replicas,
+                                       "block_size": scfg.block_size})
         frontend._publish_stats(force=True)  # readiness for the router
     try:
         return frontend.run(ttl_s=args.ttl)

@@ -483,6 +483,53 @@ SERVE_STREAM_DIRECT_TOKENS = REGISTRY.counter(
     "Tokens delivered over rank 0's persistent direct stream (POST "
     "/serve/stream) instead of serve_out KV PUTs + router polling; "
     "counted at the router's ingest, where client delivery is assured.")
+# Replicated serving tier (serve/replica.py, serve/engine.py;
+# docs/serving.md#replicated-tier): router-side placement accounting
+# across replica fleets, the prefill->decode disaggregation handoff
+# flow, and the host-RAM KV spill tier behind the device pool.
+ROUTER_ROUTED = REGISTRY.counter(
+    "hvd_router_routed_total",
+    "Requests placed on a replica fleet by the front-door router "
+    "(labeled replica=id) — affinity hits and least-loaded fallbacks "
+    "both count; the per-replica split shows traffic balance.")
+ROUTER_AFFINITY_HITS = REGISTRY.counter(
+    "hvd_router_affinity_hits_total",
+    "Requests routed to the replica advertising the longest cached "
+    "prefix of their prompt (>= 1 full block matched the replica's "
+    "published radix-tree fingerprints).")
+ROUTER_AFFINITY_MISSES = REGISTRY.counter(
+    "hvd_router_affinity_misses_total",
+    "Requests placed least-loaded because no live replica advertised "
+    "any prefix of their prompt (or affinity routing is off).")
+ROUTER_REDISPATCHES = REGISTRY.counter(
+    "hvd_router_redispatches_total",
+    "Accepted streams re-dispatched to a surviving replica after their "
+    "original fleet went dark mid-request (per-replica journal redrive "
+    "driven router-side; emitted prefix suppressed, byte-identical).")
+ROUTER_REPLICAS_UP = REGISTRY.gauge(
+    "hvd_router_replicas_up",
+    "Replica fleets currently live at the router (registered under the "
+    "replicas KV scope with a fresh stats heartbeat; dark replicas — "
+    "heartbeat older than HOROVOD_SERVE_REPLICA_DEAD_S — excluded).")
+SERVE_HANDOFFS = REGISTRY.counter(
+    "hvd_serve_handoffs_total",
+    "Finished prefills exported by a prefill-role engine for a decode "
+    "engine (prompt KV blocks + first sampled token; the request "
+    "finishes with reason prefill_done on the prefill side).")
+SERVE_IMPORTS = REGISTRY.counter(
+    "hvd_serve_imports_total",
+    "Prefill handoffs accepted by a decode-role engine (request "
+    "installed directly in decode state with imported prompt KV).")
+SERVE_SPILLS = REGISTRY.counter(
+    "hvd_serve_spill_blocks_total",
+    "Cold radix-cache KV blocks migrated from the device pool to the "
+    "host-RAM spill tier at eviction instead of being dropped "
+    "(HOROVOD_SERVE_SPILL_BLOCKS bounds the tier).")
+SERVE_SPILL_RELOADS = REGISTRY.counter(
+    "hvd_serve_spill_reload_blocks_total",
+    "Spilled KV blocks reloaded into fresh device blocks on a prefix "
+    "hit (the spill tier's payoff: a host copy instead of a prefill "
+    "recompute).")
 
 # Perf-attribution plane (horovod_tpu/perf/; docs/profiling.md).  The
 # step-time decomposition ledger records here: measured step times, the

@@ -77,8 +77,8 @@ SUITES = {
         "tests/test_platform_utils.py",
     ],
     "serving": ["tests/test_serve.py", "tests/test_serve_ft.py",
-                "tests/test_serve_speed.py", "tests/test_kv_shard.py",
-                "tests/test_scenario.py"],
+                "tests/test_serve_speed.py", "tests/test_serve_replica.py",
+                "tests/test_kv_shard.py", "tests/test_scenario.py"],
     "perf": ["tests/test_perf.py", "tests/test_memstats.py"],
     "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py",
                        "tests/test_profile_analyzer.py"],
@@ -129,6 +129,17 @@ KNOB_DIMS = [
     # the degraded/pre-scale-out combination.
     ("kv-shards-3", {"HOROVOD_KV_SHARDS": "3",
                      "HOROVOD_SERVE_DIRECT": "0"},
+     ["serving"]),
+    # replicated tier on by default (docs/serving.md#replicated-tier):
+    # a 2-replica config with this process as replica 0 must keep the
+    # serving suite green — replica 0 keeps the unscoped KV names, so
+    # everything pre-replica stays byte-compatible under the knob.
+    ("serve-replicas-2", {"HOROVOD_SERVE_REPLICAS": "2"},
+     ["serving"]),
+    # host-RAM spill tier armed: cold radix blocks migrate to host RAM
+    # at eviction and reload on hit — outputs must stay reference-greedy
+    # byte-identical through the migration.
+    ("serve-spill", {"HOROVOD_SERVE_SPILL_BLOCKS": "64"},
      ["serving"]),
     # memory plane off (docs/memory.md): the perf suite must stay green
     # with sampling disabled — reports lose their memory section, the
@@ -233,6 +244,18 @@ def build_steps():
         "chaos: sharded-serve partial-outage smoke",
         f"{py} -m pytest "
         f"tests/integration/test_kv_shard_integration.py {full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=20))
+    steps.append(_step(
+        # replica-tier acceptance: the replicated front door's claims
+        # as experiments — prefix-affinity placement and per-replica
+        # scoping units, the host-RAM spill migration and the
+        # prefill/decode disaggregation handoff each byte-identical to
+        # reference greedy, and a 2-replica kill-one-replica run
+        # through the REAL router whose re-dispatched stream completes
+        # byte-identical to the unfaulted single-fleet reference
+        # (docs/serving.md#replicated-tier).
+        "serve: 2-replica affinity + kill-one-replica redispatch",
+        f"{py} -m pytest tests/test_serve_replica.py {full}",
         env={"JAX_PLATFORMS": "cpu"}, timeout=20))
     steps.append(_step(
         # watch-plane alerts smoke: hvdrun --alerts (user rules merged
@@ -350,6 +373,16 @@ def build_steps():
         # (docs/control-plane.md) — all CPU-virtual.
         "bench: serve control-plane saturation smoke",
         f"{py} bench.py --serve --users 1,2,4 --cpu", timeout=15))
+    steps.append(_step(
+        # replica scale-out smoke: the --replicas sweep drives POST
+        # /generate through the REAL prefix-affinity router over 1- and
+        # 2-replica tiers; the per-count knees, the 1->2 scale-out gain
+        # and the affinity hit rate (vs a least-loaded control) ride
+        # the artifact for the perf gate
+        # (docs/serving.md#replicated-tier) — all CPU-virtual.
+        "bench: serve replica scale-out smoke",
+        f"{py} bench.py --serve --users 2,4,8,16 --replicas 1,2 --cpu",
+        timeout=15))
     steps.append(_step(
         # scenario replay smoke: one committed corpus spec replayed
         # against the REAL router/engine/watch planes on the virtual

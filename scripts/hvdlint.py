@@ -250,10 +250,18 @@ def check_metrics_documented(
 # neither is iteration over unordered sets.
 _DETERMINISM_SCOPES = {
     "horovod_tpu/serve/engine.py": ["Scheduler", "PrefixCache",
-                                    "BlockAllocator", "draft_lookup",
+                                    "BlockAllocator", "HostSpillPool",
+                                    "draft_lookup",
                                     "_dispatch", "_fold_sched"],
     "horovod_tpu/serve/worker.py": ["plan_key", "_publish_plan",
                                     "_fetch_plan", "_apply_resume"],
+    # The whole replicated tier is lockstep-grade: routing decisions
+    # must replay identically (callers pass `now` explicitly).
+    "horovod_tpu/serve/replica.py": ["ReplicaRouter",
+                                     "prompt_fingerprints",
+                                     "prefix_fingerprints",
+                                     "fold_digest", "scoped",
+                                     "_fold_block", "_bisect_contains"],
 }
 _TIME_FNS = {"time", "monotonic", "perf_counter", "process_time",
              "thread_time", "clock_gettime"}

@@ -1,0 +1,406 @@
+"""Replicated serving tier (serve/replica.py;
+docs/serving.md#replicated-tier): per-replica KV scoping, the
+fingerprint affinity protocol, router placement (longest-prefix /
+least-loaded / dark exclusion / note_load overlay), the host-RAM spill
+tier, prefill/decode disaggregation byte-identity, the keyed
+stream-wakeup registry, and THE acceptance claim — a kill-one-replica
+run whose accepted streams complete byte-identical to the unfaulted
+single-fleet reference, end to end through the real router."""
+
+import json
+import threading
+import time
+import types
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+import horovod_tpu.serve.worker as worker_mod
+from horovod_tpu.serve.config import ServeConfig
+from horovod_tpu.serve.engine import (BlockAllocator, HostSpillPool,
+                                      PrefixCache, ServeEngine)
+from horovod_tpu.serve.replica import (REPLICA_SCOPE, ReplicaRouter,
+                                       fold_digest, prefix_fingerprints,
+                                       prompt_fingerprints, replica_key,
+                                       scoped)
+from horovod_tpu.serve.router import (OUT_SCOPE, REQ_SCOPE, STATS_SCOPE,
+                                      RouterState)
+from horovod_tpu.serve.worker import FleetFrontend
+from test_serve import _reference_greedy
+from test_serve_ft import ScriptedEngine, scripted_tokens
+
+
+def _cfg(**kw):
+    base = dict(max_slots=2, block_size=4, cache_blocks=16,
+                max_seq_len=32, max_batch_tokens=16, prefill_chunk=8)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _one_device_mesh():
+    return jax.sharding.Mesh(np.array(jax.devices()[:1]), ("hvd",))
+
+
+# --------------------------------------------------------- KV scoping
+def test_scoped_names_keep_replica_zero_unscoped():
+    """Replica 0 IS the pre-replica deployment: unscoped names, so a
+    single fleet stays byte-for-byte compatible; K > 0 suffixes."""
+    assert scoped("serve_out", 0) == "serve_out"
+    assert scoped("serve_req", 3) == "serve_req.r03"
+    assert scoped("serve", 12) == "serve.r12"
+    assert replica_key(0) == "replica.00"
+    assert replica_key(7) == "replica.07"
+
+
+# ------------------------------------------------- affinity fingerprints
+def test_prompt_fingerprints_roll_over_full_blocks():
+    """fps[i] identifies the first i+1 blocks as a unit: a shared
+    prefix shares the leading fingerprints, divergence at block j
+    changes fps[j:] only, and partial tails contribute nothing."""
+    a = list(range(12))
+    fa = prompt_fingerprints(a, 4)
+    assert len(fa) == 3
+    # partial tail: one extra token adds no fingerprint
+    assert prompt_fingerprints(a + [99], 4) == fa
+    # shared two-block prefix, divergent third block
+    b = a[:8] + [7, 7, 7, 7]
+    fb = prompt_fingerprints(b, 4)
+    assert fb[:2] == fa[:2] and fb[2] != fa[2]
+    # rolling: a reordered first block changes EVERY fingerprint
+    fc = prompt_fingerprints(list(reversed(a[:4])) + a[4:], 4)
+    assert all(x != y for x, y in zip(fa, fc))
+
+
+def test_cache_advertisement_matches_prompt_fingerprints():
+    """The two fingerprint computations are the same protocol: a
+    prompt inserted into a replica's radix tree advertises exactly the
+    prompt's own rolling fingerprints (full blocks only)."""
+    alloc = BlockAllocator(8)
+    cache = PrefixCache(4, alloc)
+    prompt = list(range(10))  # 2 full blocks + partial tail
+    cache.insert(prompt, alloc.alloc(3))
+    adv = prefix_fingerprints(cache)
+    assert set(prompt_fingerprints(prompt, 4)) <= set(adv)
+    assert len(adv) == 2  # the partial tail never advertises
+    assert fold_digest(adv) != fold_digest([])
+
+
+# ----------------------------------------------------- router placement
+def _router(n, now=0.0, **kw):
+    rr = ReplicaRouter(block_size=4, **kw)
+    for rid in range(n):
+        rr.register(rid, {"replicas": n}, now=now)
+    return rr
+
+
+def test_route_prefers_longest_prefix_match():
+    rr = _router(3)
+    prompt = list(range(12))
+    fps = prompt_fingerprints(prompt, 4)
+    rr.update(0, {"prefix_fps": fps[:1], "waiting": 0}, now=0.0)
+    rr.update(2, {"prefix_fps": fps, "waiting": 9}, now=0.0)
+    # depth 3 on replica 2 beats depth 1 on replica 0 despite the load
+    assert rr.route(prompt, now=0.0) == (2, 3)
+    assert rr.affinity_hits == 1
+    # an unknown prompt falls back least-loaded (empty-queue replica 0)
+    rid, depth = rr.route([91, 92, 93, 94, 95], now=0.0)
+    assert (rid, depth) == (0, 0)
+    assert rr.affinity_misses == 1
+
+
+def test_route_least_loaded_honors_note_load_overlay():
+    """The stats heartbeat is <= 1 Hz; note_load overlays the router's
+    own in-flight count so a burst between heartbeats spreads instead
+    of piling on the lowest replica id — and the next stats update
+    resets the depth to the replica's own view."""
+    rr = _router(2)
+    assert rr.route([1, 2], now=0.0)[0] == 0  # all idle: lowest rid
+    rr.note_load(0, 3)
+    assert rr.route([1, 2], now=0.0)[0] == 1
+    rr.update(0, {"waiting": 0}, now=0.0)  # heartbeat resets the view
+    assert rr.route([1, 2], now=0.0)[0] == 0
+    # a shedding replica loses to any accepting one regardless of depth
+    rr.update(0, {"waiting": 0, "shed": True}, now=0.0)
+    rr.update(1, {"waiting": 50}, now=0.0)
+    assert rr.route([1, 2], now=0.0)[0] == 1
+
+
+def test_dark_replicas_get_no_traffic_and_exclude_wins():
+    rr = _router(2, dead_after_s=1.0)
+    rr.update(0, {"waiting": 0}, now=10.0)
+    rr.update(1, {"waiting": 0}, now=8.5)  # stale by 1.5s at now=10
+    assert rr.is_dark(1, 10.0) and not rr.is_dark(0, 10.0)
+    assert rr.live(10.0) == [0]
+    assert rr.route([1, 2], now=10.0)[0] == 0
+    # the redispatch path excludes the fleet it is fleeing
+    assert rr.route([1, 2], now=10.0, exclude=[0]) is None
+    rr.update(1, {"waiting": 0}, now=10.0)
+    assert rr.route([1, 2], now=10.0, exclude=[0])[0] == 1
+    rr.note_redispatch()
+    c = rr.counters(now=10.0)
+    assert c["redispatches"] == 1
+    assert c["per_replica"]["0"]["dark"] is False
+
+
+# ------------------------------------------------------ host-RAM spill
+def test_spill_pool_migrates_evicts_and_reloads():
+    """Cold radix blocks migrate to host RAM at eviction (node stays in
+    the tree, block None), reload into a fresh device block on the next
+    hit, and the capacity bound drops the coldest held block for good
+    (unlinking it so match() never offers an unreloadable prefix)."""
+    host = {}
+    reads, writes = [], []
+
+    def read_block(b):
+        reads.append(b)
+        return {"kv": np.full((2, 2), b, np.float32)}
+
+    def write_block(b, payload):
+        writes.append(b)
+        host[b] = payload
+
+    alloc = BlockAllocator(4)
+    pool = HostSpillPool(1, read_block, write_block)
+    cache = PrefixCache(4, alloc, spill=pool)
+    pa, pb = [1, 2, 3, 4], [5, 6, 7, 8]
+    for p in (pa, pb):
+        blocks = alloc.alloc(1)
+        cache.insert(p, blocks)
+        alloc.free(blocks)  # the request finished; the tree holds on
+    # evict both full-block leaves: first spills, second (capacity 1)
+    # forces the coldest OUT of the pool entirely
+    assert cache.evict(4) >= 2
+    assert pool.spilled_total == 2 and pool.dropped_total == 1
+    assert pool.blocks_held == 1 and pool.bytes_held > 0
+    # pa's block was the coldest: dropped for good, its node unlinked
+    full, cow, hit = cache.match(pa + [0])
+    assert full == [] and hit <= len(pa) - 4
+    # pb's block is still held: the match reloads it into a fresh block
+    full, _, _ = cache.match(pb + [0])
+    assert len(full) == 1 and pool.reloaded_total == 1
+    assert writes and pool.blocks_held == 0
+    c = pool.counters()
+    assert c["spilled_total"] == 2 and c["reloaded_total"] == 1
+    assert c["dropped_total"] == 1 and c["held_blocks"] == 0
+
+
+@pytest.fixture(scope="module")
+def llama_tiny():
+    from horovod_tpu.models import llama
+    cfg = llama.CONFIGS["tiny"]
+    return llama, cfg, llama.init(jax.random.PRNGKey(0), cfg)
+
+
+def test_engine_spill_reload_is_byte_identical(llama_tiny):
+    """Under pool pressure a shared prefix spills to host RAM and
+    reloads on the next hit — and the engine's output stays exactly
+    reference greedy through the migration."""
+    model, cfg, params = llama_tiny
+    rng = np.random.RandomState(7)
+    pa = rng.randint(0, cfg.vocab, 12).tolist()
+    pb = rng.randint(0, cfg.vocab, 12).tolist()
+    scfg = _cfg(max_slots=1, cache_blocks=6, spill_blocks=8,
+                spec_decode=False)
+    engine = ServeEngine(model, cfg, params, scfg,
+                         mesh=_one_device_mesh())
+    outs = {}
+    for i, p in enumerate((pa, pb, pa)):
+        req = engine.submit(p, 4, req_id=f"r{i}")
+        engine.flush()
+        assert req.state == "done"
+        outs[i] = req.out_tokens
+    spill = engine.kv_pool()["spill"]
+    assert spill["spilled_total"] >= 1, spill
+    assert spill["reloaded_total"] >= 1, spill
+    for i, p in ((0, pa), (1, pb), (2, pa)):
+        assert outs[i] == _reference_greedy(model, cfg, params, p, 4), i
+
+
+# ----------------------------------------- prefill/decode disaggregation
+def test_disaggregated_prefill_decode_is_byte_identical(llama_tiny):
+    """The disaggregation contract: a prefill-role engine exports each
+    finished prefill (prompt KV blocks + first token) over a
+    JSON-serializable handoff, a decode-role engine imports it straight
+    into its slot table, and the joined output is exactly the mixed
+    single-engine greedy stream — first token exactly once."""
+    model, cfg, params = llama_tiny
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, cfg.vocab, n).tolist() for n in (9, 13)]
+    scfg = _cfg(spec_decode=False)
+    mesh = _one_device_mesh()
+    pre = ServeEngine(model, cfg, params, scfg, mesh=mesh,
+                      role="prefill")
+    dec = ServeEngine(model, cfg, params, scfg, mesh=mesh,
+                      role="decode")
+    for i, p in enumerate(prompts):
+        pre.submit(p, 6, req_id=f"r{i}")
+    handoffs = []
+    while pre.has_work():
+        handoffs.extend(pre.step().get("handoff", []))
+    assert len(handoffs) == len(prompts)
+    assert pre.stats()["handoffs"] == len(prompts)
+    # the wire: handoffs must survive a JSON round-trip (serve_kv path)
+    reqs = [dec.import_prefill(json.loads(json.dumps(h)))
+            for h in handoffs]
+    emitted = {r.req_id: [] for r in reqs}
+    while dec.has_work():
+        for rid, toks in dec.step()["emitted"].items():
+            emitted[rid].extend(toks)
+    for i, p in enumerate(prompts):
+        oracle = _reference_greedy(model, cfg, params, p, 6)
+        assert reqs[i].out_tokens == oracle, i
+        assert emitted[f"r{i}"] == oracle, i  # exactly-once, in order
+
+
+def test_decode_role_rejects_prefill_admission():
+    """A decode-role scheduler never plans prefill work from raw
+    submissions — requests reach it only through the import path."""
+    from horovod_tpu.serve.engine import Request, Scheduler
+    sched = Scheduler(_cfg(), role="decode")
+    sched.submit(Request([1, 2, 3], 4, req_id="r0"))
+    assert sched.plan() == []
+    with pytest.raises(ValueError):
+        Scheduler(_cfg(), role="mainframe")
+
+
+# ------------------------------------------------- keyed stream wakeups
+def test_keyed_stream_waiters_wake_only_their_stream():
+    """The replicated tier's broadcast fix (runner/http_server.py): a
+    stream registers a per-request condition and its records wake IT,
+    not every waiting stream; refcounts keep a shared key alive until
+    the last waiter drops; unkeyed servers fall back to the broadcast
+    condition."""
+    from horovod_tpu.runner.http_server import (add_stream_waiter,
+                                                drop_stream_waiter,
+                                                wake_stream)
+    server = types.SimpleNamespace(
+        kv_waiters={}, kv_waiters_lock=threading.Lock(),
+        kv_wakeup=threading.Condition())
+    cond = add_stream_waiter(server, "serve_out", "req.000001")
+    assert cond is not None
+    # refcount: a re-dispatched stream sharing the key reuses the entry
+    assert add_stream_waiter(server, "serve_out", "req.000001") is cond
+    drop_stream_waiter(server, "serve_out", "req.000001")
+    assert ("serve_out", "req.000001") in server.kv_waiters
+
+    woken = []
+
+    def waiter():
+        with cond:
+            woken.append(cond.wait(5.0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    # another stream's record must not wake this one...
+    wake_stream(server, "serve_out", "req.000002.part.000000")
+    # ...nor a non-stream scope; then ".done" key extraction wakes it
+    wake_stream(server, "metrics", "req.000001.part.000000")
+    wake_stream(server, "serve_out", "req.000001.done")
+    t.join(timeout=5.0)
+    assert woken == [True]
+    drop_stream_waiter(server, "serve_out", "req.000001")
+    assert server.kv_waiters == {}
+    # bare server (no registry): register returns None, wake still
+    # notifies the broadcast condition without raising
+    bare = types.SimpleNamespace(kv_wakeup=threading.Condition())
+    assert add_stream_waiter(bare, "serve_out", "req.000001") is None
+    wake_stream(bare, "serve_out.r01", "req.000001.part.000000")
+
+
+# --------------------------------- kill-one-replica acceptance (HTTP)
+@pytest.fixture()
+def rendezvous():
+    from horovod_tpu.runner.http_server import RendezvousServer
+    server = RendezvousServer(host="127.0.0.1")
+    port = server.start()
+    yield server, server._httpd, port
+    server.stop()
+
+
+def test_kill_one_replica_streams_byte_identical(rendezvous):
+    """THE acceptance claim, end to end through the real router: two
+    /generate streams land on a 2-replica tier (note_load spreads
+    them), replica 0 dies after 3 of 6 tokens, the router re-dispatches
+    its stream to replica 1 with the delivered prefix suppressed, and
+    BOTH clients' ndjson streams complete with exactly the unfaulted
+    single-fleet token sequence — no gap, no duplicate."""
+    server, httpd, port = rendezvous
+    httpd.serve_routers = {0: RouterState(journal=True),
+                           1: RouterState(journal=True)}
+    httpd.serve_router = httpd.serve_routers[0]
+    rr = ReplicaRouter(block_size=4, dead_after_s=0.4)
+    httpd.serve_replicas = rr
+    fes = [FleetFrontend(ScriptedEngine(), "127.0.0.1", port, 0, 1,
+                         direct=True, replica_id=k)
+           for k in range(2)]
+    for fe in fes:
+        fe.register_replica({"replicas": 2})
+        fe._publish_stats(force=True)
+        fe.resume_from_kv()
+
+    prompts = [[3, 5, 8], [2, 4]]
+    results = [None, None]
+    headers = [None, None]
+
+    def client(i):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"tokens": prompts[i],
+                             "max_new_tokens": 6}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            headers[i] = r.headers.get("X-Serve-Replica")
+            results[i] = [json.loads(ln) for ln in r.read().splitlines()]
+
+    threads = []
+    for i in range(2):
+        t = threading.Thread(target=client, args=(i,))
+        t.start()
+        threads.append(t)
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                httpd.serve_routers[i].next_seq == 0:
+            time.sleep(0.01)
+        # note_load spread: request i landed on replica i
+        assert httpd.serve_routers[i].next_seq == 1
+
+    def tick(fe):
+        reqs = fe._drain_requests()
+        for r in reqs:
+            if r is None:
+                continue
+            fe._apply_resume(r)
+            fe.engine.submit(r["tokens"], r["max_new_tokens"],
+                             req_id=r.get("id"), eos_id=r.get("eos_id"))
+        fe._publish_report(fe.engine.step())
+        fe._publish_stats(force=True)
+
+    for _ in range(3):        # both replicas serve 3 of 6 tokens...
+        tick(fes[0])
+        tick(fes[1])
+    del fes[0]                # ...then replica 0 dies (no stats, no ticks)
+    deadline = time.time() + 10
+    while time.time() < deadline and rr.redispatches == 0:
+        tick(fes[0])          # the survivor keeps heartbeating
+        time.sleep(0.05)
+    assert rr.redispatches == 1, "router never re-dispatched"
+    deadline = time.time() + 10
+    while time.time() < deadline and any(r is None for r in results):
+        tick(fes[0])
+        time.sleep(0.02)
+
+    for t in threads:
+        t.join(timeout=10)
+    assert sorted(headers) == ["0", "1"]
+    for i, lines in enumerate(results):
+        assert lines is not None and lines[-1]["done"] is True, lines
+        oracle = scripted_tokens(prompts[i], 6)
+        streamed = [tok for ln in lines[:-1] for tok in ln["tokens"]]
+        assert streamed == oracle, f"client {i} stream diverged"
+        assert lines[-1]["tokens"] == oracle, f"client {i} done record"
+    # client 0's stream: 3 parts pre-kill + 3 from the survivor
+    assert len(results[0]) - 1 == 6
+    assert rr.counters()["redispatches"] == 1
