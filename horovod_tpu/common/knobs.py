@@ -117,6 +117,32 @@ _knob("HOROVOD_ZERO_AG_PREFETCH", 2, int,
       "consumption.  Must be in [1, 8]; rejected at hvd.init() "
       "otherwise.  Refined to the tuned overlap-depth bandit arm when "
       "HOROVOD_AUTOTUNE is on (docs/zero.md).")
+# --- 3D layout plane (parallel/layout.py; docs/parallelism.md — the
+#     reference can only express data parallelism; the solver factors
+#     the topology into dp x tp x pp from the cost model) ---
+_knob("HOROVOD_LAYOUT", "", str,
+      "Mesh layout policy (parallel/layout.py): '' leaves the legacy "
+      "1-D mesh (HOROVOD_TPU_MESH or flat 'hvd'); 'auto' runs the "
+      "perf/costmodel.solve_layout ranking at init and builds the "
+      "winning dp=D,tp=T,pp=P mesh; 'dp-only' pins (world, 1, 1); an "
+      "explicit 'dp,tp,pp' triple pins that factorization.  Conflicts "
+      "with a non-empty HOROVOD_TPU_MESH; dp*tp*pp must equal the "
+      "world size.  Rejected at hvd.init() otherwise "
+      "(docs/parallelism.md).")
+_knob("HOROVOD_TP", 0, int,
+      "Tensor-parallel degree constraint for HOROVOD_LAYOUT=auto (the "
+      "solver only considers candidates with this tp), cross-checked "
+      "against an explicit 'dp,tp,pp' triple.  0 = unconstrained.  "
+      "Must be >= 0, divide the world size, and (for the llama "
+      "family) divide n_heads and n_kv_heads; rejected at hvd.init() "
+      "or step-build time otherwise (docs/parallelism.md).")
+_knob("HOROVOD_PP", 0, int,
+      "Pipeline-parallel degree constraint for HOROVOD_LAYOUT=auto "
+      "(the solver only considers candidates with this pp), "
+      "cross-checked against an explicit 'dp,tp,pp' triple.  0 = "
+      "unconstrained.  Must be >= 0, divide the world size, and (for "
+      "the llama family) divide n_layers; rejected at hvd.init() or "
+      "step-build time otherwise (docs/parallelism.md).")
 # --- serving plane (TPU-native; docs/serving.md — the reference has no
 #     inference path: its docs/inference.rst only covers exporting
 #     checkpoints OUT of the training framework) ---

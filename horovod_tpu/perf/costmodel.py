@@ -300,6 +300,251 @@ def zero_level_table(n_params: float, world: int, *,
     return rows
 
 
+# ------------------------------------------------------- 3D layout solver
+# The whole-parallelism-space extrapolation of the ZeRO what-if table
+# above (ROADMAP item 2; docs/parallelism.md): enumerate (dp, tp, pp,
+# zero_level, wire, overlap_depth) factorizations of the topology, price
+# each with the SAME roofline primitives the ledger validates
+# (ring_wire_bytes / zero_comm_bytes / zero_memory_bytes), filter by a
+# per-chip memory cap, rank by predicted step time.  Stdlib-only like
+# everything else here so bench.py can load it standalone.
+LAYOUT_AXES = ("dp", "tp", "pp")
+
+# Live activation bytes per token per resident layer, in units of
+# dim * itemsize: residual stream + normed input + attn output + ffn
+# intermediate held for the backward pass.  A deliberate small-constant
+# model (docs/parallelism.md#memory-cap), not a measurement — the bench
+# reports the measured peak beside it so the gap stays observable.
+ACTIVATION_MULT = 4.0
+
+
+def tp_comm_bytes(tp: int, tokens: float, dim: int, n_layers: int, *,
+                  itemsize: float = 4.0) -> float:
+    """Per-chip wire bytes of Megatron-style tensor parallelism for one
+    step: each transformer layer all_reduces the [tokens, dim] residual
+    activation twice in the forward (attention wo and FFN down row-
+    parallel psums) and twice in the backward (the conjugate f-operator
+    psums at the column-parallel block inputs) -> 4 ring allreduces per
+    layer over the tp group (parallel/layout.py places exactly these)."""
+    if tp <= 1:
+        return 0.0
+    return 4.0 * n_layers * ring_wire_bytes(tokens * dim, itemsize, tp)
+
+
+def pp_comm_bytes(pp: int, n_micro: int, mb_tokens: float, dim: int, *,
+                  itemsize: float = 4.0) -> float:
+    """Per-chip wire bytes of the GPipe schedule for one step: one
+    ppermute shift of a [mb_tokens, dim] activation per tick, with
+    ``n_micro + pp - 1`` ticks, forward and backward (ppermute's
+    transpose is the reverse shift, same payload)."""
+    if pp <= 1:
+        return 0.0
+    return 2.0 * (n_micro + pp - 1) * mb_tokens * dim * itemsize
+
+
+def _effective_microbatches(local_batch: int, requested: int) -> int:
+    """Largest divisor of ``local_batch`` that is <= ``requested`` — the
+    GPipe microbatch count a (dp, pp) candidate can actually run."""
+    m = max(1, min(int(requested), int(local_batch)))
+    while m > 1 and local_batch % m:
+        m -= 1
+    return m
+
+
+def layout_memory_bytes(model: Dict[str, Any], dp: int, tp: int, pp: int,
+                        *, zero_level: int = 1, ef: bool = False,
+                        opt_slots: int = 2) -> Dict[str, int]:
+    """Per-chip resident bytes under a (dp, tp, pp) layout: the ZeRO
+    state triangle priced on this chip's ``n_params / (tp*pp)`` slice
+    with the RS/AG group = the dp subgroup, plus the activation term
+    (batch/dp rows x the layers resident on this pipeline stage; the
+    residual stream is replicated across tp so tp does not divide it)."""
+    itemsize = float(model.get("itemsize", 4.0))
+    n_local = float(model["n_params"]) / (tp * pp)
+    out = dict(zero_memory_bytes(zero_level, n_local, dp,
+                                 opt_slots=opt_slots, ef=ef,
+                                 itemsize=itemsize))
+    total = out.pop("total_bytes")
+    batch = float(model.get("batch", dp))
+    seq = float(model.get("seq", 1))
+    n_layers = float(model.get("n_layers", 1))
+    act = (batch / dp) * seq * (n_layers / pp) \
+        * float(model.get("dim", 0)) * ACTIVATION_MULT * itemsize
+    out["activation_bytes"] = int(act)
+    out["total_bytes"] = int(total + act)
+    return out
+
+
+def layout_step_time(model: Dict[str, Any], dp: int, tp: int, pp: int, *,
+                     zero_level: int = 1, k: int = 1,
+                     wire_format: str = "none", overlap_depth: int = 0,
+                     n_micro: int = 4, chip: str = "cpu",
+                     link: str = "loopback", ef: bool = False,
+                     opt_slots: int = 2) -> Dict[str, Any]:
+    """Predicted step decomposition of one (dp, tp, pp) candidate:
+
+      compute        model FLOPs spread over all dp*tp*pp chips;
+      tp_comm        4 activation allreduces per layer over the tp ring;
+      pp_comm        the GPipe ppermute stream;
+      bubble         (S-1)/(M+S-1) inflates compute + tp comm (those run
+                     inside the pipelined region; docs/parallelism.md);
+      zero_comm      RS/AG legs of the chain priced on the n_params/(tp*pp)
+                     slice over the DP SUBGROUP only — level-3 param
+                     all_gathers hide behind forward compute with a
+                     prefetch window, so depth d exposes ag/d.
+
+    All terms land on one ``link`` class (per-link-class roofline);
+    memory comes from :func:`layout_memory_bytes`."""
+    itemsize = float(model.get("itemsize", 4.0))
+    bw = link_bandwidth(link)
+    seq = float(model.get("seq", 1))
+    batch = float(model.get("batch", dp))
+    n_layers = int(model.get("n_layers", 1))
+    dim = int(model.get("dim", 0))
+    local_rows = batch / dp
+    m = _effective_microbatches(int(local_rows), n_micro) if pp > 1 else 1
+    compute_s = (float(model.get("flops_per_step", 0.0))
+                 / (peak_flops(chip) * dp * tp * pp))
+    # Every microbatch passes through this chip's resident n_layers/pp
+    # layers, so the tp rings see all local tokens per step.
+    tp_s = tp_comm_bytes(tp, local_rows * seq, dim,
+                         n_layers // pp if pp > 1 else n_layers,
+                         itemsize=itemsize) / bw
+    pp_s = pp_comm_bytes(pp, m, (local_rows / m) * seq, dim,
+                         itemsize=itemsize) / bw
+    bubble = (pp - 1) / (m + pp - 1) if pp > 1 else 0.0
+    comm = zero_comm_bytes(float(model["n_params"]) / (tp * pp), dp,
+                           zero_level, k=k, wire_format=wire_format,
+                           itemsize=itemsize)
+    rs_s = comm["rs_bytes"] / bw
+    ag_s = comm["ag_bytes"] / bw
+    if zero_level >= 3 and overlap_depth > 0:
+        ag_s /= overlap_depth
+    zero_s = rs_s + ag_s
+    step_s = (compute_s + tp_s) / (1.0 - bubble) + pp_s + zero_s
+    return {
+        "layout": {"dp": dp, "tp": tp, "pp": pp},
+        "zero_level": int(zero_level),
+        "wire_format": wire_format,
+        "overlap_depth": int(overlap_depth),
+        "n_micro": int(m),
+        "bubble_fraction": bubble,
+        "compute_s": compute_s,
+        "tp_comm_s": tp_s,
+        "pp_comm_s": pp_s,
+        "zero_comm_s": zero_s,
+        "step_s": step_s,
+        "memory": layout_memory_bytes(model, dp, tp, pp,
+                                      zero_level=zero_level, ef=ef,
+                                      opt_slots=opt_slots),
+        "chip": chip,
+        "link": link,
+    }
+
+
+def _factorizations(world: int):
+    for dp in range(1, world + 1):
+        if world % dp:
+            continue
+        rest = world // dp
+        for tp in range(1, rest + 1):
+            if rest % tp:
+                continue
+            yield dp, tp, rest // tp
+
+
+def enumerate_layouts(model: Dict[str, Any], world: int, *,
+                      levels=(1, 2, 3), wires=("none",),
+                      overlap_depths=(0,), k: int = 1, n_micro: int = 4,
+                      chip: str = "cpu", link: str = "loopback",
+                      ef: bool = False) -> List[Dict[str, Any]]:
+    """All VALID (dp, tp, pp, zero_level, wire, overlap_depth) candidates
+    at ``world`` chips: dp*tp*pp == world, tp divides n_heads AND
+    n_kv_heads (contiguous GQA head slices stay aligned), pp divides
+    n_layers, dp divides the global batch.  ``overlap_depths`` only fans
+    out at level 3 (prefetch is a level-3 knob; docs/zero.md)."""
+    n_heads = int(model.get("n_heads", 1))
+    n_kv = int(model.get("n_kv_heads", n_heads))
+    n_layers = int(model.get("n_layers", 1))
+    batch = int(model.get("batch", world))
+    rows = []
+    for dp, tp, pp in _factorizations(int(world)):
+        if n_heads % tp or n_kv % tp or n_layers % pp or batch % dp:
+            continue
+        for level in levels:
+            for wire in wires:
+                depths = overlap_depths if level >= 3 else (0,)
+                for depth in depths:
+                    rows.append(layout_step_time(
+                        model, dp, tp, pp, zero_level=level, k=k,
+                        wire_format=wire, overlap_depth=depth,
+                        n_micro=n_micro, chip=chip, link=link, ef=ef))
+    return rows
+
+
+def solve_layout(model: Dict[str, Any], world: int, *,
+                 mem_cap_bytes: Optional[float] = None,
+                 levels=(1, 2, 3), wires=("none",), overlap_depths=(0,),
+                 k: int = 1, n_micro: int = 4, chip: str = "cpu",
+                 link: str = "loopback", ef: bool = False
+                 ) -> Dict[str, Any]:
+    """The auto-layout decision (HOROVOD_LAYOUT=auto; ROADMAP item 2):
+    rank :func:`enumerate_layouts` candidates memory-fits-first then by
+    predicted step time (ties -> fewer pipeline stages, then less tensor
+    parallelism — pure dp wins when the model says it's free).  The
+    default ``mem_cap_bytes`` callers pass is the memory plane's measured
+    ``headroom_bytes`` (PR 16).  Returns the full ranked table plus the
+    chosen row; ``chosen["fits"]`` is False only when NOTHING fits — the
+    least-infeasible candidate is still surfaced so doctor can say why."""
+    rows = enumerate_layouts(model, world, levels=levels, wires=wires,
+                             overlap_depths=overlap_depths, k=k,
+                             n_micro=n_micro, chip=chip, link=link, ef=ef)
+    if not rows:
+        raise ValueError(
+            f"no valid (dp, tp, pp) factorization of world={world} for "
+            f"this model (check n_heads/n_kv_heads/n_layers/batch "
+            "divisibility; docs/parallelism.md#constraints)")
+    for row in rows:
+        row["fits"] = (mem_cap_bytes is None
+                       or row["memory"]["total_bytes"] <= mem_cap_bytes)
+    rows.sort(key=lambda r: (not r["fits"], r["step_s"],
+                             r["layout"]["pp"], r["layout"]["tp"]))
+    for rank, row in enumerate(rows, start=1):
+        row["rank"] = rank
+    return {
+        "world": int(world),
+        "mem_cap_bytes": (int(mem_cap_bytes)
+                          if mem_cap_bytes is not None else None),
+        "n_candidates": len(rows),
+        "chosen": rows[0],
+        "candidates": rows,
+    }
+
+
+def llama_layout_model(*, vocab: int, dim: int, n_layers: int,
+                       n_heads: int, n_kv_heads: int, ffn_dim: int,
+                       batch: int, seq: int,
+                       itemsize: float = 4.0) -> Dict[str, Any]:
+    """The model descriptor :func:`solve_layout` consumes, built from
+    llama config shapes with the module's own exact param count and the
+    6·N FLOPs convention — so the solver, the bench MFU and the ledger
+    all price the same model."""
+    n_params = llama_param_count(vocab, dim, n_layers, n_heads,
+                                 n_kv_heads, ffn_dim)
+    return {
+        "family": "llama",
+        "n_params": n_params,
+        "dim": dim,
+        "n_layers": n_layers,
+        "n_heads": n_heads,
+        "n_kv_heads": n_kv_heads,
+        "batch": batch,
+        "seq": seq,
+        "itemsize": itemsize,
+        "flops_per_step": train_flops_per_token(n_params) * batch * seq,
+    }
+
+
 # ----------------------------------------------- plan-cache comm accounting
 def plan_comm_bytes(plan, policy: str, axis_sizes: Dict[str, int],
                     op=None) -> Dict[str, Any]:

@@ -65,6 +65,7 @@ class PerfLedger:
             self._chip = "cpu"
             self._link = "loopback"
             self._zero: Optional[Dict[str, Any]] = None
+            self._layout: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------ configure
     def configure(self, *, flops_per_step: Optional[float] = None,
@@ -72,7 +73,8 @@ class PerfLedger:
                   overlap_fraction: Optional[float] = None,
                   chip: Optional[str] = None,
                   link: Optional[str] = None,
-                  zero_model: Optional[Dict[str, Any]] = None) -> None:
+                  zero_model: Optional[Dict[str, Any]] = None,
+                  layout_model: Optional[Dict[str, Any]] = None) -> None:
         """Set the cost-model inputs the decomposition prices steps with.
         Unset components stay as they were; an unconfigured model
         attributes everything beyond measured input wait to ``stall``.
@@ -81,17 +83,31 @@ class PerfLedger:
         ``{"n_params", "world"}`` required, plus optional ``level`` (the
         active one), ``opt_slots``, ``k``, ``wire_format``, ``ef`` — and
         makes :meth:`report` carry the per-ZeRO-level what-if table
-        (costmodel.zero_level_table; docs/zero.md)."""
+        (costmodel.zero_level_table; docs/zero.md).
+
+        ``layout_model`` describes the 3D-layout workload the same way —
+        ``{"n_params", "world"}`` required, plus the llama descriptor
+        fields (``dim``/``n_layers``/``n_heads``/``n_kv_heads``/
+        ``batch``/``seq``/``flops_per_step``; permissive defaults when
+        absent) and solver options (``levels``/``wires``/
+        ``overlap_depths``/``k``/``n_micro``/``ef``/``mem_cap_bytes``/
+        ``active``) — and makes :meth:`report` carry the ranked layout
+        candidate table (costmodel.solve_layout;
+        docs/parallelism.md)."""
         from .costmodel import LINK_CLASSES
-        if zero_model is not None:
-            for req in ("n_params", "world"):
-                if req not in zero_model:
-                    raise ValueError(
-                        f"zero_model needs {req!r} (docs/zero.md); got "
-                        f"{sorted(zero_model)}")
+        for what, m in (("zero_model", zero_model),
+                        ("layout_model", layout_model)):
+            if m is not None:
+                for req in ("n_params", "world"):
+                    if req not in m:
+                        raise ValueError(
+                            f"{what} needs {req!r} (docs/zero.md, "
+                            f"docs/parallelism.md); got {sorted(m)}")
         with self._lock:
             if zero_model is not None:
                 self._zero = dict(zero_model)
+            if layout_model is not None:
+                self._layout = dict(layout_model)
             if flops_per_step is not None:
                 self._flops = float(flops_per_step)
             if comm_bytes_per_step is not None:
@@ -116,6 +132,12 @@ class PerfLedger:
         price (perf/memstats.py)."""
         with self._lock:
             return dict(self._zero) if self._zero else None
+
+    def layout_model(self) -> Optional[Dict[str, Any]]:
+        """The configured 3D-layout workload (or None) — what the report
+        solves the candidate table from (docs/parallelism.md)."""
+        with self._lock:
+            return dict(self._layout) if self._layout else None
 
     def configure_from_overlap_gauges(self) -> bool:
         """Adopt the overlap plane's trace-time byte model (the
@@ -233,6 +255,7 @@ class PerfLedger:
             drift = (self._drift_sum / self._drift_n
                      if self._drift_n else None)
             zero = dict(self._zero) if self._zero else None
+            layout = dict(self._layout) if self._layout else None
         mean = {k: (v / steps if steps else 0.0) for k, v in sums.items()}
         decomposition = {
             "compute_s": mean["compute"],
@@ -298,7 +321,88 @@ class PerfLedger:
                 report["memory"] = mem
         except Exception:
             pass  # the memory leg must never break the perf report
+        if layout is not None:
+            # The ranked "which (dp, tp, pp) should this topology run"
+            # table (docs/parallelism.md): candidates from
+            # costmodel.solve_layout under the measured memory cap
+            # (memory.measured.headroom_bytes is the default cap — the
+            # PR-16 ledger's answer to 'how much state still fits'),
+            # beside the MEASURED decomposition so the chosen layout's
+            # predicted step is confronted with the wall clock exactly
+            # like the ZeRO table above.
+            try:
+                report["layout"] = self._layout_section(
+                    layout, report, chip, link, flops, mean["step"])
+            except Exception:
+                pass  # the layout leg must never break the perf report
         return report
+
+    @staticmethod
+    def _layout_section(layout: Dict[str, Any], report: Dict[str, Any],
+                        chip: str, link: str, flops: Optional[float],
+                        mean_step: float) -> Dict[str, Any]:
+        from .costmodel import solve_layout
+        world = int(layout["world"])
+        cap = layout.get("mem_cap_bytes")
+        if cap is None:
+            cap = (report.get("memory") or {}).get(
+                "measured", {}).get("headroom_bytes")
+        n_heads = int(layout.get("n_heads", world))
+        model = {
+            "n_params": layout["n_params"],
+            "dim": int(layout.get("dim", 0)),
+            "n_layers": int(layout.get("n_layers", world)),
+            "n_heads": n_heads,
+            "n_kv_heads": int(layout.get("n_kv_heads", n_heads)),
+            "batch": int(layout.get("batch", world)),
+            "seq": int(layout.get("seq", 1)),
+            "itemsize": float(layout.get("itemsize", 4.0)),
+            "flops_per_step": float(layout.get("flops_per_step",
+                                               flops or 0.0)),
+        }
+        sol = solve_layout(
+            model, world, mem_cap_bytes=cap,
+            levels=tuple(layout.get("levels", (1, 2, 3))),
+            wires=tuple(layout.get("wires", ("none",))),
+            overlap_depths=tuple(layout.get("overlap_depths", (0,))),
+            k=int(layout.get("k", 1)),
+            n_micro=int(layout.get("n_micro", 4)),
+            chip=chip, link=link, ef=bool(layout.get("ef", False)))
+        # The ACTIVE row: what this rank actually trains with (bench /
+        # HOROVOD_LAYOUT set it) — may rank below the unconstrained
+        # winner; its prediction is the one drift is judged against.
+        active_req = layout.get("active")
+        active = None
+        if isinstance(active_req, dict):
+            for row in sol["candidates"]:
+                if all(row["layout"].get(a) == active_req.get(a)
+                       for a in ("dp", "tp", "pp")) and \
+                   (active_req.get("zero_level") is None or
+                        row["zero_level"] == active_req["zero_level"]):
+                    active = row
+                    break
+        judged = active or sol["chosen"]
+        section: Dict[str, Any] = {
+            "model": model,
+            "world": world,
+            "mem_cap_bytes": cap,
+            "n_candidates": sol["n_candidates"],
+            "chosen": sol["chosen"],
+            "active": active,
+            "candidates": sol["candidates"][:16],
+            "candidates_truncated": sol["n_candidates"] > 16,
+        }
+        if mean_step > 0:
+            section["predicted_vs_measured"] = {
+                "step_delta_s": judged["step_s"] - mean_step,
+                "step_ratio": (judged["step_s"] / mean_step
+                               if mean_step else None),
+            }
+        from ..utils import metrics as M
+        M.LAYOUT_CANDIDATES.set(sol["n_candidates"])
+        M.LAYOUT_CHOSEN_RANK.set(judged["rank"])
+        M.LAYOUT_PREDICTED_STEP.set(judged["step_s"])
+        return section
 
 
 def local_verdict(fractions: Dict[str, float]) -> str:

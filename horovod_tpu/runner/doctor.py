@@ -332,6 +332,15 @@ def render_perf(view: Dict[str, Any]) -> str:
                 f"{_fmt_bytes(row.get('comm', {}).get('total_bytes')):<16} "
                 f"{_fmt_ms(row.get('exposed_comm_s'))}")
         break
+    # Layout solver table (docs/parallelism.md): like the ZeRO table an
+    # analytical function of (workload, topology) — first rank carrying
+    # it renders the ranked candidates and the active row's drift.
+    for r in sorted(ranks):
+        lay = ranks[r].get("layout")
+        if not lay:
+            continue
+        lines.extend(_render_perf_layout(lay))
+        break
     for r in sorted(ranks):
         ops = ranks[r].get("native_ops")
         if not ops:
@@ -344,6 +353,51 @@ def render_perf(view: Dict[str, Any]) -> str:
                 f"mean={op.get('mean_us', 0):.0f}us "
                 f"max={op.get('max_us')}us bytes={op.get('bytes')}")
     return "\n".join(lines)
+
+
+def _render_perf_layout(lay: Dict[str, Any]) -> List[str]:
+    """The 3D-layout candidate table of one rank's layout section
+    (docs/parallelism.md): rank-ordered (dp, tp, pp) factorizations with
+    predicted step / bubble / per-chip memory, the memory cap, and the
+    active row's predicted-vs-measured drift."""
+    lines: List[str] = [""]
+    chosen = lay.get("chosen") or {}
+    cl = chosen.get("layout", {})
+    cap = lay.get("mem_cap_bytes")
+    lines.append(
+        f"-- layout solver ({lay.get('n_candidates')} candidates at "
+        f"world={lay.get('world')}; cap "
+        f"{_fmt_bytes(cap) if cap else 'none'}; "
+        "docs/parallelism.md) --")
+    active = lay.get("active") or {}
+    al = active.get("layout", {})
+    lines.append("  rank  dp x tp x pp  zero  wire    bubble  "
+                 "step(pred)  mem/chip  fits")
+    for row in lay.get("candidates", [])[:8]:
+        l = row.get("layout", {})
+        is_active = l and l == al
+        mark = "*" if is_active else ("+" if l == cl else " ")
+        lines.append(
+            f"  {mark}{row.get('rank'):<4} "
+            f"{l.get('dp')} x {l.get('tp')} x {l.get('pp')}       "
+            f"{row.get('zero_level')}     "
+            f"{str(row.get('wire_format')):<7} "
+            f"{row.get('bubble_fraction', 0.0):.2f}    "
+            f"{_fmt_ms(row.get('step_s')):<11} "
+            f"{_fmt_bytes(row.get('memory', {}).get('total_bytes')):<9} "
+            f"{'yes' if row.get('fits', True) else 'NO'}")
+    if lay.get("candidates_truncated"):
+        lines.append(f"  ... ({lay.get('n_candidates')} total; "
+                     "GET /perf serves the full table)")
+    pvm = lay.get("predicted_vs_measured")
+    if pvm and pvm.get("step_ratio") is not None:
+        which = "active" if active else "chosen"
+        lines.append(
+            f"  {which} layout predicted/measured step ratio: "
+            f"{pvm['step_ratio']:.2f}x "
+            "(drift bound proven by bench --layout; CPU-virtual "
+            "numbers are NOT TPU predictions)")
+    return lines
 
 
 # ----------------------------------------------------------- watch plane

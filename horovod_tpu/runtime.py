@@ -99,6 +99,18 @@ class Runtime:
         self._process_count = jax.process_count()
 
         spec = mesh_spec if mesh_spec is not None else self.knobs["HOROVOD_TPU_MESH"]
+        # 3D layout plane (parallel/layout.py; docs/parallelism.md):
+        # HOROVOD_LAYOUT owns the mesh when set — validated BEFORE mesh
+        # construction (the layout IS the mesh), 'auto' ranks the
+        # factorizations with perf/costmodel.solve_layout under any
+        # HOROVOD_TP / HOROVOD_PP constraints.
+        from .parallel.layout import (validate_layout_knobs,
+                                      resolve_layout, layout_mesh_spec)
+        validate_layout_knobs(self.knobs, world=len(self.devices),
+                              mesh_spec=str(spec))
+        self.layout = resolve_layout(len(self.devices), self.knobs)
+        if self.layout is not None:
+            spec = layout_mesh_spec(*self.layout)
         self.mesh = self._build_mesh(spec)
         # Canonical worker numbering = flattened *mesh* position, which is
         # what lax.axis_index sees inside collectives.  create_device_mesh

@@ -392,6 +392,26 @@ ZERO_AG_PREFETCH = REGISTRY.gauge(
     "hvd_zero_ag_prefetch_depth",
     "ZeRO-3 parameter all-gather prefetch depth of the last traced "
     "zero chain (0 below level 3; HOROVOD_ZERO_AG_PREFETCH).")
+# 3D layout solver (parallel/layout.py + perf/costmodel.solve_layout;
+# docs/parallelism.md).  Set when a layout solve runs — at init under
+# HOROVOD_LAYOUT=auto and on every perf_report() with a configured
+# layout model — from the ANALYTICAL candidate table, like the ZeRO
+# families above.
+LAYOUT_CANDIDATES = REGISTRY.gauge(
+    "hvd_layout_candidates",
+    "Candidate (dp, tp, pp, zero_level, wire, overlap_depth) rows the "
+    "layout solver enumerated for the topology in its last solve "
+    "(perf/costmodel.solve_layout; docs/parallelism.md).")
+LAYOUT_CHOSEN_RANK = REGISTRY.gauge(
+    "hvd_layout_chosen_rank",
+    "Rank (1 = fastest fitting candidate) of the layout the last solve "
+    "selected — > 1 means HOROVOD_TP/HOROVOD_PP constraints or the "
+    "memory cap displaced the unconstrained winner.")
+LAYOUT_PREDICTED_STEP = REGISTRY.gauge(
+    "hvd_layout_predicted_step_seconds",
+    "Cost-model predicted step time of the chosen layout (roofline "
+    "compute + TP/PP/ZeRO comm + pipeline bubble; the ledger bounds "
+    "its drift against measured steps like the ZeRO table).")
 
 # Serving plane (serve/engine.py; docs/serving.md).  SLO telemetry for
 # the continuous-batching engine: latency distributions per REQUEST

@@ -49,6 +49,10 @@ SUITES = {
         "tests/test_wire.py", "tests/test_overlap.py",
         "tests/test_tracing.py",
     ],
+    # The 3D-parallelism unit tier (docs/parallelism.md): mesh/knob
+    # resolution, the TP/PP realizations' bit-near composition proofs
+    # against pure dp, the layout cost model and the solver's ranking.
+    "layout": ["tests/test_layout.py"],
     "models-kernels": [
         "tests/test_models.py", "tests/test_flash_attention.py",
         "tests/test_sequence_parallel.py", "tests/test_pipeline.py",
@@ -109,6 +113,14 @@ KNOB_DIMS = [
     # with params sharded and a deeper AG prefetch window.
     ("zero-3", {"HOROVOD_ZERO_LEVEL": "3", "HOROVOD_ZERO_AG_PREFETCH": "4"},
      ["jax-core"]),
+    # The session mesh resolved as a 3-axis (dp,tp,pp) layout instead of
+    # the legacy single axis (docs/parallelism.md): the core suites must
+    # stay green when init hands back a layout mesh — unit tests that
+    # pin the legacy DP path's semantics build their own ("hvd",) mesh,
+    # and tests claiming an explicit mesh spec clear these knobs.
+    ("layout-tp-pp", {"HOROVOD_LAYOUT": "auto", "HOROVOD_TP": "2",
+                      "HOROVOD_PP": "2"},
+     ["jax-core", "layout"]),
     ("tf-join", {"HOROVOD_TF_JOIN": "1"},
      ["tensorflow-keras"]),
     # serve-redrive off = degraded mode: the router stops journaling,
@@ -348,6 +360,17 @@ def build_steps():
         f"{full}",
         env={"JAX_PLATFORMS": "cpu"}, timeout=15))
     steps.append(_step(
+        # auto-layout smoke: HOROVOD_LAYOUT=auto under the real launcher
+        # at np=2 resolves the constrained (2,2,2) mesh on both
+        # processes; the composed TP+PP+ZeRO chain lands bit-near the
+        # dp-only reference across REAL cross-process collectives, and
+        # the solver's candidate table rides GET /perf with the chosen
+        # layout's predicted-vs-measured ratio (docs/parallelism.md).
+        "layout: 2-process auto-layout (2,2,2) smoke",
+        f"{py} -m pytest tests/integration/test_layout_integration.py "
+        f"{full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=15))
+    steps.append(_step(
         # ZeRO sweep smoke: levels 0-3 on the quadratic toy +
         # llama-tiny with level 1/2/3 equivalence asserted in-bench,
         # the analytical memory columns and the ledger drift riding
@@ -355,6 +378,15 @@ def build_steps():
         # CPU-virtual.
         "bench: zero sweep smoke",
         f"{py} bench.py --zero --cpu", timeout=15))
+    steps.append(_step(
+        # layout sweep smoke: the solver's candidate table measured on
+        # llama-tiny — every feasible (dp,tp,pp) trains with params
+        # equivalence-asserted against dp-only in-bench, and the chosen
+        # layout's calibrated predicted-vs-measured drift gates the run
+        # and rides the artifact for the perf gate
+        # (docs/parallelism.md) — all CPU-virtual.
+        "bench: layout sweep smoke",
+        f"{py} bench.py --layout --cpu", timeout=15))
     steps.append(_step(
         # serving load-gen + raw-speed smoke: closed-loop and Poisson
         # load emit plausible SLO rows, AND the three speed legs

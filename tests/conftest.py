@@ -82,7 +82,7 @@ _SLOW_TESTS = {  # file::test (param ids stripped), >= ~8 s measured
         "test_bench_scaling_cpu_contract", "test_bench_wire_cpu_contract",
         "test_bench_overlap_cpu_contract", "test_bench_serve_cpu_contract",
         "test_bench_serve_users_cpu_contract",
-        "test_bench_zero_cpu_contract",
+        "test_bench_zero_cpu_contract", "test_bench_layout_cpu_contract",
     },
     "test_zero.py": {
         # the full level x wire x EF x k acceptance matrix (~18 combos x
@@ -97,6 +97,14 @@ _SLOW_TESTS = {  # file::test (param ids stripped), >= ~8 s measured
         "test_resnet_forward_shape", "test_master_weights_bf16_compute",
         "test_llama_chunked_ce_matches", "test_vgg_apply_adaptive_resolution",
         "test_llama_fused_projections_match",
+    },
+    "test_layout.py": {
+        # the full mesh x level composition matrix (12 jitted chains)
+        # and the lossy-wire level-equivalence proof; the fast tier
+        # keeps a (2,2,2)-vs-reference slice + the gauge pin, and the
+        # CI layout leg (-m "") runs the whole matrix
+        "test_composed_matrix_all_meshes_levels",
+        "test_composed_lossy_wire_levels_agree",
     },
     "test_pipeline.py": {
         "test_pipelined_llama_matches_sequential",

@@ -1,0 +1,207 @@
+"""Per-rank worker for the 3D-layout integration test.
+
+Launched by hvdrun with -np 2 on localhost (4 virtual CPU chips each,
+the 8-chip cross-process mesh) and HOROVOD_LAYOUT=auto + HOROVOD_TP=2 +
+HOROVOD_PP=2: init must resolve the training mesh to the solver-chosen
+(2, 2, 2) factorization (parallel/layout.py; docs/parallelism.md), the
+generic composed path must train the quadratic toy to the exact optax
+trajectory, the llama-tiny composed chain on the resolved mesh must land
+bit-near the dp-only composed reference — every TP psum, GPipe ppermute
+and ZeRO reduce_scatter riding REAL cross-process XLA collectives here,
+not the single-process loopback of the unit tier — and the ledger's
+ranked layout table must come back through the launcher's merged
+``GET /perf`` view with the active (2, 2, 2) row judged against the
+wall clock.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+import _env_setup  # noqa: F401  (must run before other jax imports)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+STEPS = 3
+THRESH = 32 * 1024
+
+
+def main() -> int:
+    hvd.init()
+    assert hvd.process_size() == 2, hvd.process_size()
+    n = hvd.size()
+    assert n == 8, n
+
+    import jax  # noqa: E402
+    import jax.numpy as jnp  # noqa: E402
+    import optax  # noqa: E402
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.models import llama as llama_mod
+    from horovod_tpu.parallel import layout as L
+    from horovod_tpu.perf import costmodel as cm
+    from horovod_tpu.utils import metrics as M
+
+    rt = hvd.runtime.get()
+    assert rt.perf_publisher is not None, \
+        "HOROVOD_PERF=1 did not wire the perf publisher"
+
+    # --- init resolved the knobs to the solver's (2, 2, 2) mesh
+    mesh = hvd.mesh()
+    assert mesh.axis_names == ("dp", "tp", "pp"), mesh.axis_names
+    assert rt.layout == (2, 2, 2), rt.layout
+    assert L.layout_of_mesh(mesh) == (2, 2, 2)
+    assert M.LAYOUT_CANDIDATES.value() > 0  # the solver actually ran
+
+    def replicate(tree, mesh_):
+        """Multi-process-safe replicate: materialize the (identical)
+        host constants INSIDE one jitted program instead of device_put
+        from host (see zero_worker.py)."""
+        repl = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh_, P()),
+            jax.eval_shape(lambda: tree))
+        return jax.jit(lambda: tree, out_shardings=repl)()
+
+    def dp_put(arr, mesh_):
+        """Full host batch -> global array split over dp (every process
+        generates the identical batch; the callback serves only the
+        addressable row blocks)."""
+        arr = np.asarray(arr)
+        sh = NamedSharding(mesh_, P("dp"))
+        return jax.make_array_from_callback(arr.shape, sh,
+                                            lambda idx: arr[idx])
+
+    # --- leg 1: the generic (replicated-params) composed path trains
+    # the quadratic toy on the resolved 3D mesh to the exact host-optax
+    # trajectory (docs/parallelism.md#generic)
+    tparams = {"w": jnp.linspace(-1.0, 1.0, 5), "b": jnp.float32(0.1)}
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 5).astype(np.float32)
+    y = rng.randn(16).astype(np.float32)
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
+
+    opt = optax.adam(0.1)
+    p = replicate(tparams, mesh)
+    st = L.init_layout_state(opt, p, P(), mesh, zero_level=2)
+    step = L.make_layout_train_step(loss_fn, opt, mesh, zero_level=2,
+                                    donate=False)
+    batch = (dp_put(x, mesh), dp_put(y, mesh))
+    for _ in range(4):
+        p, st, loss = step(p, st, batch)
+    assert np.isfinite(float(loss))
+    ref_p, ref_st = tparams, opt.init(tparams)
+    for _ in range(4):
+        g = jax.grad(loss_fn)(ref_p, (jnp.asarray(x), jnp.asarray(y)))
+        updates, ref_st = opt.update(g, ref_st, ref_p)
+        ref_p = optax.apply_updates(ref_p, updates)
+    np.testing.assert_allclose(np.asarray(p["w"]), np.asarray(ref_p["w"]),
+                               atol=1e-4)
+    np.testing.assert_allclose(float(p["b"]), float(ref_p["b"]), atol=1e-4)
+
+    # --- leg 2: llama-tiny through the composed TP x PP x ZeRO chain on
+    # the resolved mesh, bit-near the dp-only composed reference
+    cfg = llama_mod.CONFIGS["tiny"]
+    B, S = 8, 16
+    lparams = llama_mod.init(jax.random.PRNGKey(0), cfg)
+    ids = np.random.RandomState(1).randint(0, cfg.vocab, (B, S + 1),
+                                           dtype=np.int32)
+
+    def run_llama(mesh_, pp, timed):
+        import horovod_tpu.perf as perf
+        stacked = replicate(L.llama_layout_params(lparams, pp), mesh_)
+        specs = L.llama_layout_specs(stacked)
+        opt2 = optax.adam(1e-2)
+        st2 = L.init_layout_state(opt2, stacked, specs, mesh_,
+                                  zero_level=1,
+                                  fusion_threshold_bytes=THRESH)
+        step2 = L.make_llama_layout_train_step(
+            cfg, opt2, mesh_, n_micro=2, zero_level=1,
+            fusion_threshold_bytes=THRESH, donate=False)
+        lids = dp_put(ids, mesh_)
+        p2, s2 = stacked, st2
+        for _ in range(STEPS):
+            if timed:
+                with perf.timed_step():
+                    p2, s2, loss2 = step2(p2, s2, lids)
+                    jax.block_until_ready(loss2)
+            else:
+                p2, s2, loss2 = step2(p2, s2, lids)
+        assert np.isfinite(float(loss2))
+        return p2
+
+    def flat(p2):
+        stages = jax.tree_util.tree_map(
+            lambda a: np.asarray(a).reshape((-1,) + a.shape[2:]),
+            p2["stages"])
+        return jax.tree_util.tree_leaves(
+            {"embed": p2["embed"], "final_norm": p2["final_norm"],
+             "lm_head": p2["lm_head"], "stages": stages})
+
+    ref_mesh = Mesh(np.array(jax.devices()).reshape(n, 1, 1),
+                    ("dp", "tp", "pp"))
+    ref = run_llama(ref_mesh, pp=1, timed=False)
+
+    # The ACTIVE run wears the ledger: the layout table GET /perf serves
+    # must judge the (2, 2, 2) row this fleet actually trains with.
+    hvd.perf.reset()
+    model = cm.llama_layout_model(
+        vocab=cfg.vocab, dim=cfg.dim, n_layers=cfg.n_layers,
+        n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
+        ffn_dim=cfg.ffn_dim, batch=B, seq=S)
+    hvd.perf.configure(layout_model=dict(
+        model, world=n, levels=(1,), n_micro=2,
+        active={"dp": 2, "tp": 2, "pp": 2, "zero_level": 1}))
+    act = run_llama(mesh, pp=2, timed=True)
+
+    # 5e-4: cross-process gloo reductions reorder the float32 psums one
+    # more time than the single-process unit tier (which proves <= 1e-4
+    # — tests/test_layout.py); the bound is accumulation noise after 3
+    # adam steps, not a different optimizer.
+    for a, b in zip(flat(act), flat(ref)):
+        err = float(np.abs(a - b).max())
+        assert err <= 5e-4, \
+            f"(2,2,2) composed chain diverges from dp-only by {err}"
+
+    rep = hvd.perf_report()
+    lay = rep.get("layout")
+    assert lay is not None, sorted(rep)
+    assert lay["n_candidates"] >= 4, lay["n_candidates"]
+    assert lay["active"] is not None \
+        and lay["active"]["layout"] == {"dp": 2, "tp": 2, "pp": 2}
+    assert lay["predicted_vs_measured"]["step_ratio"] > 0
+    assert M.LAYOUT_CHOSEN_RANK.value() >= 1
+    assert M.LAYOUT_PREDICTED_STEP.value() > 0
+
+    # Publish, then fence so BOTH ranks' PUTs precede rank 0's read.
+    assert rt.perf_publisher.publish_now()
+    hvd.allreduce(np.ones(1, np.float32), name="pub.barrier", op=hvd.Sum)
+
+    if hvd.process_rank() == 0:
+        addr = rt.knobs["HOROVOD_RENDEZVOUS_ADDR"]
+        port = rt.knobs["HOROVOD_RENDEZVOUS_PORT"]
+        with urllib.request.urlopen(f"http://{addr}:{port}/perf",
+                                    timeout=10) as resp:
+            view = json.loads(resp.read())
+        assert set(view["ranks"]) == {"0", "1"}, sorted(view["ranks"])
+        served = view["ranks"]["0"]["layout"]
+        # The fleet view serves the SAME table this rank computed.
+        assert served["n_candidates"] == lay["n_candidates"]
+        assert served["active"]["layout"] == {"dp": 2, "tp": 2, "pp": 2}
+        assert served["chosen"]["layout"] == lay["chosen"]["layout"]
+        out_path = os.environ.get("LAYOUT_IT_OUT")
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(view, f)
+
+    print(f"LAYOUT-OK process {hvd.process_rank()}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,43 @@
+"""3D-layout integration tier: HOROVOD_LAYOUT=auto under the real
+launcher — 2 processes x 4 virtual chips, real cross-process XLA
+collectives — init resolves the solver-chosen (2, 2, 2) mesh from the
+knobs, the composed chain lands bit-near the dp-only reference, and the
+ledger's ranked layout table is served through the launcher's merged
+``GET /perf`` view and rendered by ``hvdrun doctor --perf``
+(docs/parallelism.md)."""
+
+import json
+
+import pytest
+
+from test_multiprocess import run_hvdrun
+
+
+@pytest.mark.integration
+def test_layout_auto_two_processes(tmp_path):
+    out = tmp_path / "layout_view.json"
+    proc = run_hvdrun("layout_worker.py", extra_env={
+        "HOROVOD_LAYOUT": "auto",
+        "HOROVOD_TP": "2",
+        "HOROVOD_PP": "2",
+        "HOROVOD_PERF": "1",
+        "HOROVOD_PERF_INTERVAL": "0.5",
+        "LAYOUT_IT_OUT": str(out)})
+    assert proc.stdout.count("LAYOUT-OK") >= 2, proc.stdout
+
+    # The fleet view rank 0 fetched from GET /perf: the ranked candidate
+    # table with the active (2, 2, 2) row the fleet actually trained.
+    view = json.loads(out.read_text())
+    lay = view["ranks"]["0"]["layout"]
+    assert lay["n_candidates"] >= 4
+    assert lay["active"]["layout"] == {"dp": 2, "tp": 2, "pp": 2}
+    assert lay["predicted_vs_measured"]["step_ratio"] > 0
+    ranks = [r["rank"] for r in lay["candidates"]]
+    assert ranks == sorted(ranks) and ranks[0] == 1
+
+    # doctor --perf renders the same payload's layout table.
+    from horovod_tpu.runner.doctor import render_perf
+    txt = render_perf(view)
+    assert "layout solver" in txt
+    assert "2 x 2 x 2" in txt
+    assert "predicted/measured step ratio" in txt

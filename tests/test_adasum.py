@@ -15,6 +15,17 @@ from horovod_tpu.ops._compat import shard_map
 from horovod_tpu.parallel.adasum import adasum_allreduce
 
 
+def _data_mesh():
+    """The legacy single-axis data mesh these tests' shard_maps hardcode
+    ("hvd") — built directly from the devices, independent of the
+    runtime's resolved training mesh, so the CI layout knob dimension
+    (HOROVOD_LAYOUT=auto; docs/parallelism.md) keeps this suite green."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh as _Mesh
+    return _Mesh(_np.array(jax.devices()), ("hvd",))
+
+
 def _adasum_pair_np(a, b):
     dot = float(np.sum(a * b))
     na = float(np.sum(a * a))
@@ -38,7 +49,7 @@ def _adasum_np(vectors):
 
 
 def test_adasum_matches_numpy_model(hvd):
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     rng = np.random.RandomState(0)
     xs = rng.randn(n, 16).astype(np.float32)
@@ -54,7 +65,7 @@ def test_adasum_matches_numpy_model(hvd):
 def test_adasum_identical_vectors_sum_like_average(hvd):
     """Adasum of n identical vectors v yields v (scale-invariance property:
     parallel gradients are averaged; reference adasum.h docstring)."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     v = np.random.RandomState(1).randn(8).astype(np.float32)
     xs = np.broadcast_to(v, (n, 8)).copy()
@@ -66,7 +77,7 @@ def test_adasum_identical_vectors_sum_like_average(hvd):
 
 def test_adasum_orthogonal_vectors_sum(hvd):
     """Orthogonal gradients add (the other end of the Adasum interpolation)."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     xs = np.zeros((n, n), np.float32)
     for i in range(n):

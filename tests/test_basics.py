@@ -1,6 +1,8 @@
 """Init/topology API tests (reference analog: test/parallel/test_torch.py
 rank/size sanity via mpi_env_rank_and_size, test/utils/common.py:32-70)."""
 
+import os
+
 import numpy as np
 
 
@@ -30,7 +32,12 @@ def test_built_flags(hvd):
 
 def test_mesh_shape(hvd):
     assert hvd.mesh().devices.size == 8
-    assert hvd.mesh().axis_names == ("hvd",)
+    if os.environ.get("HOROVOD_LAYOUT"):
+        # CI layout knob dim (docs/parallelism.md): init resolved the
+        # 3-axis training mesh instead of the legacy single axis.
+        assert hvd.mesh().axis_names == ("dp", "tp", "pp")
+    else:
+        assert hvd.mesh().axis_names == ("hvd",)
 
 
 def test_reduce_op_constants(hvd):

@@ -187,6 +187,11 @@ def test_mesh_spec_and_train_step(monkeypatch):
     from horovod_tpu.parallel.data_parallel import (make_train_step,
                                                     replicate, shard_batch)
 
+    # Standalone Runtime with an explicit mesh spec — clear the layout
+    # knobs so the CI layout knob dim does not contest the mesh
+    # (docs/parallelism.md#knobs).
+    for k in ("HOROVOD_LAYOUT", "HOROVOD_TP", "HOROVOD_PP"):
+        monkeypatch.delenv(k, raising=False)
     rt = Runtime(knobs=Knobs(), mesh_spec="dcn.data=2,ici.data=4")
     assert rt.mesh.axis_names == (DCN, ICI)
     assert dict(rt.mesh.shape) == {DCN: 2, ICI: 4}

@@ -17,13 +17,24 @@ from horovod_tpu.ops.compression import Compression
 from horovod_tpu.ops._compat import shard_map
 
 
+def _data_mesh():
+    """The legacy single-axis data mesh these tests' shard_maps hardcode
+    ("hvd") — built directly from the devices, independent of the
+    runtime's resolved training mesh, so the CI layout knob dimension
+    (HOROVOD_LAYOUT=auto; docs/parallelism.md) keeps this suite green."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh as _Mesh
+    return _Mesh(_np.array(jax.devices()), ("hvd",))
+
+
 def _shmap(fn, mesh, n_in, n_out=1):
     return shard_map(fn, mesh=mesh, in_specs=(P("hvd"),) * n_in,
                      out_specs=(P("hvd"),) * n_out if n_out > 1 else P("hvd"))
 
 
 def test_sync_gradients_mean(hvd):
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     grads = {"w": np.random.RandomState(0).randn(n, 4).astype(np.float32),
              "b": np.random.RandomState(1).randn(n, 2).astype(np.float32)}
@@ -42,7 +53,7 @@ def test_sync_gradients_mean(hvd):
 
 def test_sync_gradients_fusion_matches_unfused(hvd):
     """Bucketed (fused) sync must be numerically identical to per-tensor."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     rng = np.random.RandomState(42)
     gs = [rng.randn(n, k + 1).astype(np.float32) for k in range(6)]
@@ -62,7 +73,7 @@ def test_sync_gradients_fusion_matches_unfused(hvd):
 
 
 def test_sync_gradients_compression_fp16(hvd):
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     g = np.random.RandomState(3).randn(n, 32).astype(np.float32)
 
@@ -77,7 +88,7 @@ def test_sync_gradients_compression_fp16(hvd):
 def test_distributed_optimizer_end_to_end(hvd):
     """Data-parallel SGD: one step with per-chip different grads must equal
     single-worker SGD on the mean gradient."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     w0 = np.ones(4, np.float32)
     lr = 0.1
@@ -105,7 +116,7 @@ def test_distributed_optimizer_end_to_end(hvd):
 def test_backward_passes_per_step(hvd):
     """Local aggregation (reference: gradient_aggregation.py): updates apply
     only every Nth micro-batch, using the averaged accumulated gradient."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     lr = 1.0
     opt = distributed_optimizer(optax.sgd(lr), axis_name="hvd",
@@ -132,7 +143,7 @@ def test_backward_passes_per_step(hvd):
 
 def test_distributed_grad(hvd):
     """DistributedGradientTape analog."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     xs = np.random.RandomState(5).randn(n, 4).astype(np.float32)
 

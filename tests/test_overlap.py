@@ -25,7 +25,7 @@ from horovod_tpu.optimizer import _AccState, distributed_optimizer
 # ------------------------------------------------- scheduling equivalence
 def _run_cycle(hvd, opt, grads_per_mb, w0):
     """One full optimizer cycle: k update calls in one trace."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     k = len(grads_per_mb)
 
     def body(w, *gr):
@@ -39,6 +39,17 @@ def _run_cycle(hvd, opt, grads_per_mb, w0):
                           in_specs=(P(),) + (P("hvd"),) * k,
                           out_specs=P(), check_vma=False))
     return np.asarray(f(w0, *[jnp.asarray(g) for g in grads_per_mb]))
+
+
+def _data_mesh():
+    """The legacy single-axis data mesh these tests' shard_maps hardcode
+    ("hvd") — built directly from the devices, independent of the
+    runtime's resolved training mesh, so the CI layout knob dimension
+    (HOROVOD_LAYOUT=auto; docs/parallelism.md) keeps this suite green."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh as _Mesh
+    return _Mesh(_np.array(jax.devices()), ("hvd",))
 
 
 @pytest.mark.parametrize("policy", ["none", "bf16", "int8_ring"])
@@ -135,7 +146,7 @@ def test_zero1_interleaved_matches_monolithic(hvd):
                                            make_zero1_train_step,
                                            _bucket_plan)
 
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     params, loss_fn = _toy_model()
     opt = optax.adam(1e-2)
@@ -373,7 +384,7 @@ def test_microbatched_scan_step_matches_unpipelined(hvd):
     from horovod_tpu.parallel.data_parallel import (
         make_microbatched_train_step, replicate, shard_batch)
 
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     params, loss_fn = _toy_model()
     k = 3

@@ -13,6 +13,17 @@ from horovod_tpu.ops._compat import shard_map
 from horovod_tpu.ops.quantized import quantized_ring_allreduce
 
 
+def _data_mesh():
+    """The legacy single-axis data mesh these tests' shard_maps hardcode
+    ("hvd") — built directly from the devices, independent of the
+    runtime's resolved training mesh, so the CI layout knob dimension
+    (HOROVOD_LAYOUT=auto; docs/parallelism.md) keeps this suite green."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh as _Mesh
+    return _Mesh(_np.array(jax.devices()), ("hvd",))
+
+
 def _run(x_per_rank, mesh, average=True):
     f = shard_map(
         functools.partial(quantized_ring_allreduce, axis_name="hvd",
@@ -23,7 +34,7 @@ def _run(x_per_rank, mesh, average=True):
 
 
 def test_quantized_allreduce_matches_mean(hvd):
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     rng = np.random.RandomState(0)
     # per-rank values; stacked on axis 0 -> one row per chip
@@ -41,7 +52,7 @@ def test_quantized_allreduce_matches_mean(hvd):
 
 
 def test_quantized_allreduce_sum_and_dtype(hvd):
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     x = jnp.ones((n, 16), jnp.bfloat16)
     out = _run(x, mesh, average=False)
@@ -54,7 +65,7 @@ def test_quantized_allreduce_sum_error_bound(hvd):
     """Requantization noise grows linearly in N (module docstring): the
     summed result must stay within a few percent of the exact sum's
     scale — the EQuARX operating regime for gradient reduction."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     rng = np.random.RandomState(3)
     x = jnp.asarray(
@@ -69,7 +80,7 @@ def test_quantized_allreduce_sum_error_bound(hvd):
 
 def test_quantized_allreduce_ragged_sizes(hvd):
     """Payload not divisible by the ring size exercises the padding."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     x = jnp.asarray(np.random.RandomState(5).randn(n, 13), np.float32)
     out = _run(x, mesh)
@@ -85,7 +96,7 @@ def test_distributed_optimizer_quantized_wire_trains(hvd):
 
     import horovod_tpu as h
 
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     rng = np.random.RandomState(0)
     W = jnp.asarray(rng.randn(12, 3), jnp.float32)
     X = jnp.asarray(rng.randn(64, 12), jnp.float32)
@@ -128,17 +139,21 @@ def test_quantized_wire_rejects_min_max(hvd):
     with pytest.raises(ValueError, match="Average/Sum"):
         opt = h.DistributedOptimizer(optax.sgd(0.1), axis_name="hvd",
                                      op=h.Min, quantized_wire=True)
-        mesh = hvd.mesh()
+        mesh = _data_mesh()
         f = shard_map(
             lambda w: opt.update({"w": w}, opt.init({"w": w}))[0]["w"],
             mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)
         jax.jit(f)(jnp.ones((8,)))
 
 
-def test_quantized_allreduce_two_level_axes(hvd):
+def test_quantized_allreduce_two_level_axes(hvd, monkeypatch):
     """Tuple axes ring PER AXIS (big ring on ICI, small on DCN) and the
     result equals the global mean within quantization noise."""
     import horovod_tpu as h
+    # Claims the mesh with an explicit spec — incompatible with the CI
+    # layout knob dim (docs/parallelism.md#knobs); clear for the duration.
+    for k in ("HOROVOD_LAYOUT", "HOROVOD_TP", "HOROVOD_PP"):
+        monkeypatch.delenv(k, raising=False)
     h.shutdown()
     h.init(mesh_spec="dcn.d=2,ici.d=4")
     try:
@@ -157,6 +172,7 @@ def test_quantized_allreduce_two_level_axes(hvd):
             np.testing.assert_allclose(out[r], out[0], atol=1e-6)
     finally:
         h.shutdown()
+        monkeypatch.undo()
         h.init()
 
 
@@ -167,7 +183,7 @@ def test_quantized_wire_with_compression_resolves_to_int8(hvd):
     the int8 ring wins, matching a pure quantized_wire sync exactly."""
     from horovod_tpu.ops.compression import Compression
     from horovod_tpu.optimizer import sync_gradients
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     g = jnp.asarray(np.random.RandomState(11).randn(n, 48), jnp.float32)
 

@@ -30,6 +30,17 @@ def test_policy_name_validation():
         wire.validate_policy_name("gzip")
 
 
+def _data_mesh():
+    """The legacy single-axis data mesh these tests' shard_maps hardcode
+    ("hvd") — built directly from the devices, independent of the
+    runtime's resolved training mesh, so the CI layout knob dimension
+    (HOROVOD_LAYOUT=auto; docs/parallelism.md) keeps this suite green."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh as _Mesh
+    return _Mesh(_np.array(jax.devices()), ("hvd",))
+
+
 def test_unknown_policy_fails_loudly_at_init(hvd, monkeypatch):
     import horovod_tpu as h
     monkeypatch.setenv("HOROVOD_WIRE_POLICY", "int9")
@@ -80,7 +91,7 @@ def test_resolve_format_degradations():
 
 # ------------------------------------------------------- decode determinism
 def _sync_rows(hvd, g, **kw):
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     f = shard_map(lambda x: sync_gradients(x, "hvd", **kw), mesh=mesh,
                   in_specs=P("hvd"), out_specs=P("hvd"), check_vma=False)
     return np.asarray(jax.jit(f)(g))
@@ -100,10 +111,15 @@ def test_wire_paths_decode_bit_identical_across_ranks(hvd, policy):
     assert np.abs(rows[0] - exact).max() < tol
 
 
-def test_dcn_int8_two_level_mesh(hvd):
+def test_dcn_int8_two_level_mesh(hvd, monkeypatch):
     """dcn_int8 on a real (dcn, ici) mesh: quantizes only the DCN leg,
     matches the global mean within ring noise, decodes bit-identically."""
     import horovod_tpu as h
+    # This test claims the mesh with an explicit spec, which validation
+    # rejects alongside the CI layout knob dim (docs/parallelism.md#knobs)
+    # — clear the knobs for the duration, restore before the re-init.
+    for k in ("HOROVOD_LAYOUT", "HOROVOD_TP", "HOROVOD_PP"):
+        monkeypatch.delenv(k, raising=False)
     h.shutdown()
     h.init(mesh_spec="dcn.wd=2,ici.wd=4")
     try:
@@ -120,6 +136,7 @@ def test_dcn_int8_two_level_mesh(hvd):
             np.testing.assert_array_equal(out[r], out[0])
     finally:
         h.shutdown()
+        monkeypatch.undo()
         h.init()
 
 
@@ -131,7 +148,7 @@ def test_error_feedback_rescues_biased_int8_descent(hvd):
     convergence.  With EF the untransmitted error re-enters the next step,
     making the time-averaged wire unbiased: the EF run tracks the fp32
     optimum several times closer than int8-without-EF."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     d, lr, steps = 32, 0.05, 400
     rng = np.random.RandomState(0)
@@ -181,7 +198,7 @@ def test_distributed_optimizer_carries_ef_state(hvd):
     once a lossy bucket runs."""
     import optax
 
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     opt = distributed_optimizer(optax.sgd(0.1), axis_name="hvd",
                                 wire_policy="int8_ring")
@@ -234,7 +251,7 @@ def test_spmd_sync_routes_through_plan_cache(hvd):
     import horovod_tpu.runtime as hrt
 
     rt = hrt.get()
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     gs = jnp.asarray(np.random.RandomState(9).randn(n, 17), jnp.float32)
     h0, m0 = rt.plan_cache.hits, rt.plan_cache.misses

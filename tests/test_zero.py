@@ -27,6 +27,17 @@ from horovod_tpu.parallel.zero import (init_sharded_opt_state,
                                        gather_zero3_params,
                                        shard_zero3_params)
 
+def _data_mesh():
+    """The legacy single-axis data mesh these tests' shard_maps hardcode
+    ("hvd") — built directly from the devices, independent of the
+    runtime's resolved training mesh, so the CI layout knob dimension
+    (HOROVOD_LAYOUT=auto; docs/parallelism.md) keeps this suite green."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh as _Mesh
+    return _Mesh(_np.array(jax.devices()), ("hvd",))
+
+
 THRESH = 64  # tiny fusion threshold -> several buckets on the toy
 
 
@@ -54,7 +65,7 @@ def _run_chain(hvd, level, wire, ef, k, steps=2, ag_prefetch=None,
                opt=None):
     """Run `steps` optimizer steps of the bucketed chain at `level`;
     returns (final replicated params as numpy, final state)."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     params, loss_fn = _model()
     opt = opt or optax.adamw(1e-2, weight_decay=0.01)
@@ -107,7 +118,7 @@ def _assert_levels_agree(ref, got, tag):
 
 # ----------------------------------------------------------- level-1 legacy
 def test_zero1_matches_replicated_update(hvd):
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     params, loss_fn = _model()
     opt = optax.adamw(1e-2, weight_decay=0.01)
@@ -134,7 +145,7 @@ def test_zero1_matches_replicated_update(hvd):
 
 
 def test_zero1_state_is_sharded(hvd):
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     params, _ = _model()
     opt = optax.adam(1e-3)
@@ -153,11 +164,11 @@ def test_zero1_rejects_non_average(hvd):
     from horovod_tpu.common.reduce_op import Sum
     params, loss_fn = _model()
     with pytest.raises(ValueError, match="Average"):
-        make_zero1_train_step(loss_fn, optax.sgd(0.1), hvd.mesh(), op=Sum)
+        make_zero1_train_step(loss_fn, optax.sgd(0.1), _data_mesh(), op=Sum)
 
 
 def test_zero1_loss_decreases(hvd):
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     params, loss_fn = _model()
     opt = optax.sgd(0.05, momentum=0.9)
@@ -205,7 +216,7 @@ def test_zero_levels_equivalent_matrix(hvd):
 def test_zero_interleaved_level1_matches_monolithic_anchor(hvd):
     """The bucketed chain's anchor: level 1 interleaved (k=1, lossless)
     lands the same params as the legacy monolithic flat-vector chain."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     params, loss_fn = _model()
     opt = optax.adam(1e-2)
@@ -248,7 +259,7 @@ def test_zero_ag_prefetch_is_scheduling_only(hvd):
 
 # ----------------------------------------------------- level-3 param story
 def test_zero3_shard_gather_roundtrip_and_shapes(hvd):
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     params, _ = _model()
     from horovod_tpu.parallel.zero import _bucket_plan
@@ -274,7 +285,7 @@ def test_zero3_geometry_rederives_for_new_world_size(hvd):
     is a pure function of (plan, world size) — gather at the old mesh,
     re-shard at a DIFFERENT world size, values survive bit-exact."""
     from jax.sharding import Mesh
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     params, _ = _model()
     small = Mesh(np.array(jax.devices()[:2]), ("hvd",))
     big_shards = shard_zero3_params(replicate(params, mesh), mesh,
@@ -298,7 +309,7 @@ def test_zero_ef_residual_sharded_with_buckets(hvd):
     [n, bucket] row block per bucket (docs/zero.md#wire-composition),
     nonzero after lossy syncs."""
     from horovod_tpu.parallel.zero import _ZeroEFBlock, _bucket_plan
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     params, _ = _model()
     plan = _bucket_plan(params, THRESH)
@@ -332,7 +343,7 @@ def test_zero_mismatched_state_layout_raises(hvd):
     """The satellite fix: state inited interleaved=True consumed by a
     monolithic step builder must RAISE, not mis-slice — and the
     converse."""
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     n = hvd.size()
     params, loss_fn = _model()
     opt = optax.adam(1e-2)
@@ -356,7 +367,7 @@ def test_zero_mismatched_state_layout_raises(hvd):
 
 
 def test_zero_builder_argument_validation(hvd):
-    mesh = hvd.mesh()
+    mesh = _data_mesh()
     params, loss_fn = _model()
     opt = optax.sgd(0.1)
     with pytest.raises(ValueError, match="zero_level=0"):
