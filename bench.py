@@ -2017,7 +2017,27 @@ def serve_bench(args) -> int:
         tokens = engine._tokens_prefill + engine._tokens_decode - tok0
         ttfts = [r.ttft() for r in done]
         tpots = [r.tpot() for r in done if r.tpot() is not None]
+        # Per-component TTFT breakdown through serve/trace.py
+        # ``attribute`` — the request-lifecycle components, summing
+        # exactly to each request's TTFT.  Engine-direct (no router),
+        # so placement/handoff/stream are structurally zero and the
+        # queue and prefill legs carry the whole story.
+        from horovod_tpu.serve import trace as serve_trace
+        comp_vals = {c: [] for c in serve_trace.COMPONENTS}
+        for r in done:
+            measured = {}
+            if r.admitted_t is not None:
+                measured["queue"] = r.admitted_t - r.submitted_t
+                if r.first_token_t is not None:
+                    measured["prefill"] = \
+                        r.first_token_t - r.admitted_t
+            comps, _ = serve_trace.attribute(r.ttft() or 0.0, measured)
+            for c, v in comps.items():
+                comp_vals[c].append(v)
+        breakdown = {c: round(float(np.percentile(vs, 50)), 5)
+                     for c, vs in comp_vals.items() if vs}
         return {
+            "ttft_breakdown": breakdown,
             "requests": len(done),
             "wall_s": round(wall, 4),
             "throughput_tok_s": round(tokens / wall, 2),
@@ -2058,6 +2078,18 @@ def serve_bench(args) -> int:
     # bench supervisor forwards only the last stdout line);
     # perf/gate.py load_artifacts expands them into standalone rows.
     sub_rows = legs.pop("gate_rows")
+    # Per-component TTFT breakdown rides the same artifact as gate-able
+    # sub_rows: the gate watches the queue and prefill legs of the
+    # closed-loop TTFT independently (a scheduler regression can hide
+    # in one leg while the blended p50 stays flat).
+    for comp in ("queue", "prefill"):
+        sub_rows.append({
+            "metric": f"serve closed-loop ttft {comp} p50",
+            "value": round(
+                closed["ttft_breakdown"].get(comp, 0.0) * 1e3, 3),
+            "unit": "ms",
+            "higher_is_better": False,
+            "label": label})
 
     print(json.dumps({
         "sub_rows": sub_rows,

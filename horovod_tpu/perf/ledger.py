@@ -18,6 +18,13 @@ as ``model_drift_ratio`` (> 1 = the model over-predicts) — predicted vs
 measured deltas are first-class outputs, so cost-model drift is itself
 observable rather than silently corrupting the attribution.
 
+The serving tier's per-request SLO attribution (serve/trace.py
+``attribute``) follows the same discipline for request wall time:
+measured lifecycle components, a residual leg absorbing the
+unattributed remainder, rescale-to-fit on overshoot with the ratio kept
+observable (``hvd_serve_trace_overattribution_ratio``) — one
+attribution contract across the training and serving planes.
+
 The module-global ledger backs ``hvd.perf_report()`` and the new
 ``hvd_perf_*`` metric families; :class:`PerfPublisher` PUTs per-rank
 reports to the rendezvous KV scope ``perf`` (MetricsPublisher's pattern),

@@ -26,6 +26,15 @@ and top native ops behind it.
 shed/drain state, journal depth and the engine's self-published stats —
 what an on-call reader checks when the fleet restarted mid-stream.
 
+``--request RID`` is the request-lifecycle forensics view
+(docs/serving.md#request-lifecycle): given a ``GET /serve/trace`` URL
+(or a saved payload) it reconstructs one request root-cause-first —
+status and worst component, the SLO attribution whose components sum
+exactly to the measured wall time, every placement attempt with its
+affinity-vs-least-loaded verdict, any re-dispatch with the
+delivered-prefix suppression boundary, and the deterministic causal
+span ids that link the merged Perfetto timeline.
+
 ``--watch`` is the watch plane's live follow mode (docs/watch.md): it
 re-renders ``GET /alerts`` + ``GET /series`` every ``--interval``
 seconds — firing alerts first (severity-ordered, with rule context like
@@ -40,6 +49,7 @@ Usage:
   hvdrun doctor --perf http://127.0.0.1:8080/perf
   hvdrun doctor --perf saved_perf.json
   hvdrun doctor --serve http://127.0.0.1:9000/serve/stats
+  hvdrun doctor --request req.000003 http://127.0.0.1:9000
   hvdrun doctor --watch http://127.0.0.1:9090 --interval 2
   hvdrun doctor --watch saved_alerts.json --once
 """
@@ -50,7 +60,7 @@ import argparse
 import json
 import sys
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from ..postmortem import load_postmortem
 
@@ -710,6 +720,158 @@ def render_serve(view: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# --------------------------------------------------- request forensics
+def load_trace_view(source: str) -> Dict[str, Any]:
+    """Resolve a ``--request`` source to the ``GET /serve/trace``
+    payload (which carries the raw per-request records alongside the
+    rollup): an http URL or bare host:port fetches the live route;
+    anything else is a saved JSON file — either the route payload or a
+    single trace record."""
+    import json as _json
+    import os
+    import urllib.request
+    if source.startswith(("http://", "https://")) or (
+            ":" in source and not os.path.exists(source)
+            and "/" not in source):
+        url = source if source.startswith("http") else f"http://{source}"
+        if not url.rstrip("/").endswith("/serve/trace"):
+            url = url.rstrip("/") + "/serve/trace"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return _json.loads(resp.read())
+    with open(source) as f:
+        return _json.load(f)
+
+
+def find_request(view: Dict[str, Any], rid: str) -> Optional[Dict[str, Any]]:
+    """The trace record for ``rid`` inside a /serve/trace payload — or
+    the payload itself when it IS one saved record."""
+    if view.get("rid") == rid:
+        return view
+    for rec in view.get("records") or []:
+        if isinstance(rec, dict) and rec.get("rid") == rid:
+            return rec
+    return None
+
+
+# Lifecycle hops in causal order, with the lane each span lands on in
+# the merged timeline (docs/serving.md#request-lifecycle).  HANDOFF and
+# SPILL_RELOAD only appear on disaggregated / spilling fleets, REDRIVE
+# only after a fleet reset — the renderer marks them conditional.
+_TRACE_HOPS = (
+    ("ROUTE", "router", ""),
+    ("NEGOTIATE", "engine", ""),
+    ("PREFILL", "engine", ""),
+    ("HANDOFF", "engine", " [disaggregated only]"),
+    ("SPILL_RELOAD", "engine", " [on spill reload]"),
+    ("DECODE", "engine", ""),
+    ("STREAM", "stream", ""),
+)
+
+
+def render_request(rec: Dict[str, Any]) -> str:
+    """``hvdrun doctor --request RID``: one request's lifecycle,
+    root-cause-first — status and the worst component up top, then the
+    exact SLO attribution (components sum to the measured wall time —
+    serve/trace.py ``attribute``), the placement attempts with their
+    affinity-vs-least-loaded verdicts and any re-dispatch suppression
+    boundary, and the deterministic causal span ids (re-minted here via
+    ``span_id``, so they MATCH what every hop emitted into the merged
+    timeline).  A pure function of the record: the live route and the
+    post-exit KV render byte-identically."""
+    from ..serve import trace as trace_mod
+    lines: List[str] = []
+    rid = str(rec.get("rid", "?"))
+    status = str(rec.get("status", "?"))
+    comps = rec.get("components") or {}
+    wall = rec.get("wall_s")
+    attempts = [a for a in (rec.get("attempts") or [])
+                if isinstance(a, dict)]
+    lines.append(f"== hvdrun doctor --request {rid} ==")
+    # 1. Root cause line first: what happened, and what it cost.
+    if status == "done" and comps:
+        worst = max(comps, key=lambda c: (float(comps[c] or 0.0), c))
+        lines.append(
+            f"STATUS: done ({rec.get('finish_reason', '?')}) in "
+            f"{float(wall or 0.0):.6f}s — worst component "
+            f"{worst} {float(comps[worst] or 0.0):.6f}s")
+    elif status == "shed":
+        lines.append(
+            "STATUS: SHED — rejected 429 before a sequence number was "
+            "claimed (no lifecycle to attribute; the rid names the "
+            "shed slot)")
+    elif status in ("timeout", "running"):
+        last = attempts[-1] if attempts else {}
+        lines.append(
+            f"STATUS: {status.upper()} — the stream never delivered "
+            f".done (died mid-flight on replica "
+            f"{last.get('replica', '?')} after {len(attempts)} "
+            "placement attempt(s); no components to attribute)")
+    else:
+        lines.append(f"STATUS: {status}")
+    lines.append(
+        f"REQUEST: prompt {rec.get('prompt_tokens', '?')} tokens, "
+        f"max_new {rec.get('max_new_tokens', '?')}"
+        + (f", generated {rec.get('n_tokens')}"
+           if rec.get("n_tokens") is not None else "")
+        + (f", ttft {float(rec['ttft_s']):.6f}s"
+           if isinstance(rec.get("ttft_s"), (int, float)) else ""))
+    # 2. The exact attribution (sums to wall; over-attribution visible).
+    if comps and isinstance(wall, (int, float)):
+        ratio = rec.get("overattribution", 1.0)
+        over = ("" if not isinstance(ratio, (int, float)) or ratio <= 1.0
+                else f"; OVER-ATTRIBUTED x{ratio:.3f}, parts rescaled")
+        lines.append(f"ATTRIBUTION (components sum exactly to wall "
+                     f"{wall:.6f}s{over}):")
+        for c in trace_mod.COMPONENTS:
+            v = float(comps.get(c, 0.0) or 0.0)
+            pct = (100.0 * v / wall) if wall > 0 else 0.0
+            bar = "#" * int(round(pct / 4))
+            lines.append(f"  {c:<10} {v:10.6f}s  {pct:5.1f}%  {bar}")
+    # 3. Placement: every attempt, verdict, re-dispatch boundary.
+    if attempts:
+        lines.append(f"PLACEMENT: {len(attempts)} attempt(s), "
+                     f"{float(rec.get('placement_s') or 0.0):.6f}s "
+                     "spent placing:")
+        for i, at in enumerate(attempts):
+            v = at.get("verdict") or {}
+            kind = v.get("kind", "single-fleet")
+            line = (f"  attempt {i}: replica {at.get('replica', '?')} "
+                    f"[{kind}]")
+            if at.get("affinity_blocks"):
+                line += f", {at['affinity_blocks']} affinity blocks"
+            if at.get("redispatched_from") is not None:
+                line += (
+                    f" — RE-DISPATCHED off dark replica "
+                    f"{at['redispatched_from']}: suppressing "
+                    f"{at.get('suppressed_tokens', '?')} already-"
+                    f"delivered token(s), publishing resumes at part "
+                    f"{at.get('resume_part', '?')}")
+            lines.append(line)
+            for cand in v.get("candidates") or []:
+                mark = (" <- winner"
+                        if cand.get("replica") == v.get("winner") else "")
+                lines.append(
+                    f"    candidate replica {cand.get('replica', '?')}: "
+                    f"prefix depth {cand.get('depth', '?')}, queue "
+                    f"{cand.get('queue_depth', '?')}"
+                    + (" [shedding]" if cand.get("shed") else "")
+                    + mark)
+    # 4. The causal span chain — ids recomputed from the determinism
+    #    contract, so grepping the merged Perfetto trace for them finds
+    #    the exact slices this request produced.
+    ctx = rec.get("trace") or {}
+    if ctx.get("rid"):
+        root = ctx.get("span") or trace_mod.span_id(rid, "admit")
+        lines.append("SPANS (deterministic ids — serve/trace.py; grep "
+                     "the merged timeline for them):")
+        lines.append(f"  admit        {root}  (root, minted at router "
+                     "admission)")
+        for hop, lane, note in _TRACE_HOPS:
+            lines.append(f"  {hop:<12} {trace_mod.span_id(rid, hop)}  "
+                         f"(lane {lane}, parent {root}){note}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="hvdrun doctor",
@@ -730,6 +892,11 @@ def main(argv=None) -> int:
                     help="render the serving fleet's operational view "
                          "(GET /serve/stats URL, host:port, or a saved "
                          "JSON; docs/serving.md)")
+    ap.add_argument("--request", metavar="RID", default=None,
+                    help="render one request's lifecycle forensics from "
+                         "the trace plane (path = GET /serve/trace URL, "
+                         "host:port, or a saved JSON; "
+                         "docs/serving.md#request-lifecycle)")
     ap.add_argument("--watch", action="store_true",
                     help="live watch-plane follow mode (docs/watch.md): "
                          "re-render GET /alerts + /series every "
@@ -773,6 +940,25 @@ def main(argv=None) -> int:
                 print(render_watch(view))
         except KeyboardInterrupt:
             return 0
+    if args.request:
+        try:
+            view = load_trace_view(args.path)
+        except Exception as e:
+            print(f"hvdrun doctor: {e}", file=sys.stderr)
+            return 2
+        rec = find_request(view, args.request)
+        if rec is None:
+            print(f"hvdrun doctor: no trace record for "
+                  f"{args.request!r} — retention is bounded "
+                  "(serve/trace.py TRACE_RETAIN), or the rid never "
+                  "passed this router", file=sys.stderr)
+            return 2
+        if args.json:
+            json.dump(rec, sys.stdout, indent=1)
+            print()
+        else:
+            print(render_request(rec))
+        return 0
     if args.serve:
         try:
             view = load_serve_view(args.path)

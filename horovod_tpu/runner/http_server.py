@@ -162,6 +162,33 @@ def store_for(server, scope: str):
     return stores[shard_for_scope(scope, len(stores))]
 
 
+_TRACE_SEQ = itertools.count()
+
+
+def trace_span(server, lane: str, name: str, start_t: float,
+               dur_s: float, args: Optional[Dict] = None) -> None:
+    """Router-side request span as a synthetic timeline chunk on rank
+    0's process lane (the alert_instant pattern): worker chunks stamp
+    absolute aligned µs measured against THIS server, so the server's
+    own wall clock is on the same epoch by construction
+    (docs/serving.md#request-lifecycle).  Best-effort — tracing must
+    never take the front door down."""
+    try:
+        chunk = {"rank": 0, "seq": -1, "events": [
+            {"name": name, "ph": "X", "ts": float(start_t) * 1e6,
+             "dur": max(0.0, float(dur_s)) * 1e6, "lane": lane,
+             "args": args or {}}]}
+        tl = store_for(server, TIMELINE_SCOPE)
+        key = f"trace.0.{next(_TRACE_SEQ):06d}"
+        with tl.kv_lock:  # type: ignore[attr-defined]
+            tl.kv.setdefault(TIMELINE_SCOPE, {})[key] = \
+                json.dumps(chunk).encode()  # type: ignore[attr-defined]
+            tl.kv_times.setdefault(TIMELINE_SCOPE, {})[key] = \
+                time.time()  # type: ignore[attr-defined]
+    except Exception:
+        pass
+
+
 def watch_state_for(server):
     """The watch plane's server-side state (series store + alert
     engine; docs/watch.md), installed on the ``metrics``-owning shard
@@ -262,6 +289,16 @@ class _KVHandler(BaseHTTPRequestHandler):
             from ..serve import router as serve_router
             self._serve_body(
                 _json.dumps(serve_router.render_stats(self.server)
+                            ).encode(), "application/json")
+            return
+        if scope == SERVE_SCOPE and key == "trace":
+            # Tail analytics over per-request trace records
+            # (docs/serving.md#request-lifecycle): slowest-requests
+            # table + per-component p50/p99 fleet rollup.
+            import json as _json
+            from ..serve import router as serve_router
+            self._serve_body(
+                _json.dumps(serve_router.render_trace(self.server)
                             ).encode(), "application/json")
             return
         if scope == METRICS_SCOPE and not key:

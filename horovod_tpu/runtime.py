@@ -654,11 +654,18 @@ class Runtime:
         if not (addr and port) or self.timeline is None:
             return
         from .utils.timeline import TimelinePublisher
+        try:
+            # Replica-fleet lane namespacing (docs/timeline.md): a
+            # nonzero serving replica id stamps the chunks so the merged
+            # view renders replica{K}.rank{N} lanes.
+            replica = int(self.knobs["HOROVOD_SERVE_REPLICA_ID"])
+        except Exception:
+            replica = 0
         self.timeline_publisher = TimelinePublisher(
             addr=addr, port=port, rank=self._process_index,
             timeline=self.timeline,
             interval=self.knobs["HOROVOD_TIMELINE_MERGE_INTERVAL"],
-            clock=self.clock_sync)
+            clock=self.clock_sync, replica=replica)
 
     def _attach_native_trace(self) -> None:
         """Pump the native core's span ring into the timeline (idempotent;

@@ -50,6 +50,10 @@ ENGINE_UP_FAMILY = "hvd_scenario_engine_up"
 SHED_FAMILY = "hvd_scenario_shed_total"
 TTFT_P99_FAMILY = "hvd_scenario_ttft_p99_ms"
 DELIVERED_FAMILY = "hvd_scenario_delivered_total"
+
+# Lifecycle spans kept in the report (docs/serving.md#request-lifecycle);
+# beyond the cap only the count grows — bounded reports, no silent drop.
+SPAN_CAP = 256
 REPLICAS_UP_FAMILY = "hvd_scenario_replicas_up"
 
 # Watch-feed cadence in logical seconds: fine enough that a sub-second
@@ -272,6 +276,25 @@ class ScenarioHarness:
         ai = ti = 0
         tick = 0
         per_rank: List[int] = [0] * self.nranks
+        # Virtual-clock lifecycle spans with the REAL deterministic ids
+        # (serve/trace.py is clock/RNG-free, so importing it keeps the
+        # scenario-determinism contract): a replay with the same seed
+        # emits byte-identical spans, and the ids MATCH what a live
+        # fleet serving the same rids would put in the merged timeline.
+        from ..serve import trace as trace_mod
+        spans: List[Dict[str, Any]] = []
+        span_total = 0
+
+        def span(hop: str, rid: str, start_s: float,
+                 dur_s: float) -> None:
+            nonlocal span_total
+            span_total += 1
+            if len(spans) >= SPAN_CAP:
+                return  # bounded report; span_total records the drop
+            rec = {"name": hop, "lane": "scenario",
+                   "ts_s": round(start_s, 9), "dur_s": round(dur_s, 9)}
+            rec.update(trace_mod.span_args(trace_mod.mint(rid), hop))
+            spans.append(rec)
 
         def deliver(rid: str, tok: int) -> None:
             nonlocal delivered_total
@@ -282,6 +305,8 @@ class ScenarioHarness:
                 rec["first_tick"] = tick
                 ttft_ms_done.append(
                     (tick * tick_s - rec["arrive_t"]) * 1000.0)
+                start = max(0, rec["submit_tick"]) * tick_s
+                span("PREFILL", rid, start, tick * tick_s - start)
             rec["last_tick"] = tick
             if not delivery_ticks or delivery_ticks[-1] != tick:
                 delivery_ticks.append(tick)
@@ -289,6 +314,8 @@ class ScenarioHarness:
                 rec["finished"] = True
                 unfinished.pop(rid, None)
                 router.finish_stream()
+                start = max(0, rec["first_tick"]) * tick_s
+                span("DECODE", rid, start, tick * tick_s - start)
 
         def _qdepth(e) -> int:
             if e is None:
@@ -312,6 +339,7 @@ class ScenarioHarness:
             rec["submit_tick"] = tick
             admitted.append(rid)
             unfinished[rid] = True
+            span("ROUTE", rid, tick * tick_s, 0.0)
             if replicas == 1:
                 if engines[0] is not None:
                     engines[0].submit(list(ev["prompt"]), ev["max_new"],
@@ -377,6 +405,7 @@ class ScenarioHarness:
                             new_r = placed[0]
                             rr.note_redispatch()
                             redispatched += 1
+                            span("REDISPATCH", rid, now, 0.0)
                             rec["replica"] = new_r
                             replay_skip[rid] = rec["delivered"]
                             engines[new_r].submit(list(rec["prompt"]),
@@ -468,7 +497,8 @@ class ScenarioHarness:
                             delivery_ticks, shed, trains_done, restarts,
                             tick, len(unfinished) + len(buffered),
                             per_rank, final_now, rr=rr,
-                            redispatched=redispatched)
+                            redispatched=redispatched,
+                            spans=spans, span_total=span_total)
 
     # --------------------------------------------------------- watch feed
     def _feed(self, now: float, depth: int, up: bool, shed: int,
@@ -489,7 +519,8 @@ class ScenarioHarness:
     def _report(self, events, digest, wins, recs, admitted,
                 delivery_ticks, shed, trains_done, restarts, ticks,
                 backlog, per_rank, final_now, rr=None,
-                redispatched=0) -> Dict[str, Any]:
+                redispatched=0, spans=None,
+                span_total=0) -> Dict[str, Any]:
         spec = self.spec
         tick_s = spec.tick_s
         done = [r for r in recs.values() if r["finished"]]
@@ -563,6 +594,8 @@ class ScenarioHarness:
                        "missing": missing,
                        "ok": not missing},
             **({"replica_tier": replica_tier} if replica_tier else {}),
+            "trace_spans": {"emitted": span_total, "cap": SPAN_CAP,
+                            "spans": list(spans or [])},
         }
 
 

@@ -416,6 +416,15 @@ class Request:
         self.first_token_t: Optional[float] = None
         self.done_t: Optional[float] = None
         self.finish_reason: Optional[str] = None
+        # Causal trace context (serve/trace.py): minted by the router at
+        # admission, attached at submit, rides the handoff record to the
+        # decode side so both fleets' spans link on the same rid.
+        self.trace: Optional[Dict[str, Any]] = None
+        self.handoff_s: float = 0.0  # decode-side measured export->import
+        # Prefill-side component durations that rode the handoff record
+        # (queue_s/prefill_s): the decode fleet cannot recompute them —
+        # perf_counter stamps are process-local.
+        self.upstream: Optional[Dict[str, float]] = None
 
     @property
     def prompt_len(self) -> int:
@@ -917,10 +926,12 @@ class ServeEngine:
     # ------------------------------------------------------------ intake
     def submit(self, tokens, max_new_tokens: int,
                req_id: Optional[str] = None,
-               eos_id: Optional[int] = None) -> Request:
+               eos_id: Optional[int] = None,
+               trace: Optional[Dict[str, Any]] = None) -> Request:
         req = Request(tokens, max_new_tokens, req_id=req_id,
                       eos_id=eos_id if eos_id is not None
                       else self.cfg.eos_id)
+        req.trace = trace
         return self.scheduler.submit(req)
 
     def has_work(self) -> bool:
@@ -968,6 +979,15 @@ class ServeEngine:
             "max_new_tokens": req.max_new_tokens,
             "eos_id": req.eos_id,
             "first_token": int(first_token),
+            "trace": req.trace,
+            "queue_s": (req.admitted_t - req.submitted_t
+                        if req.admitted_t is not None else None),
+            "prefill_s": (time.perf_counter() - req.admitted_t
+                          if req.admitted_t is not None else None),
+            # Wall clock, not perf_counter: the export/import stamps
+            # cross process boundaries (the handoff component of the
+            # per-request SLO attribution is their difference).
+            "exported_t": time.time(),
             "blocks": [encode_block_payload(self._read_block(b))
                        for b in req.blocks[:n_blocks]],
         }
@@ -982,11 +1002,21 @@ class ServeEngine:
                       eos_id=(handoff.get("eos_id")
                               if handoff.get("eos_id") is not None
                               else self.cfg.eos_id))
+        req.trace = handoff.get("trace")
+        req.upstream = {k: float(handoff[k])
+                        for k in ("queue_s", "prefill_s")
+                        if handoff.get(k) is not None} or None
+        exported_t = handoff.get("exported_t")
+        if exported_t is not None:
+            req.handoff_s = max(0.0, time.time() - float(exported_t))
         payloads = [decode_block_payload(p) for p in handoff["blocks"]]
         self.scheduler.queue_import(req, payloads,
                                     int(handoff["first_token"]))
         from ..utils import metrics as M
         M.SERVE_IMPORTS.inc()
+        self._span("HANDOFF", req, req.handoff_s,
+                   end_t=time.perf_counter(),
+                   extra={"blocks": len(handoff["blocks"])})
         return req
 
     def prefix_fps(self) -> Tuple[List[str], str]:
@@ -1025,6 +1055,9 @@ class ServeEngine:
         return out
 
     def _dispatch(self) -> None:
+        prefix = self.scheduler.prefix
+        spill = prefix.spill if prefix is not None else None
+        reloads0 = spill.reloaded_total if spill is not None else 0
         work = self.scheduler.plan()
         # Handoff imports staged by the plan: land the prompt KV in the
         # pool BEFORE this tick's step reads it (functional .at writes,
@@ -1038,6 +1071,15 @@ class ServeEngine:
                 self._span("NEGOTIATE", req,
                            req.admitted_t - req.submitted_t,
                            end_t=req.admitted_t)
+        if spill is not None:
+            delta = spill.reloaded_total - reloads0
+            if delta > 0:
+                for slot, req, n in work:
+                    if req.state == "prefill":
+                        self._span("SPILL_RELOAD", req, 0.0,
+                                   end_t=time.perf_counter(),
+                                   extra={"reloads": delta})
+                        break
         if not work:
             return
         cfg = self.cfg
@@ -1197,7 +1239,9 @@ class ServeEngine:
             tl = getattr(_rt.get(), "timeline", None)
             if tl is None:
                 return
-            args = {"req": req.req_id}
+            from . import trace as _trace
+            args = _trace.span_args(getattr(req, "trace", None), phase,
+                                    rid=req.req_id, req=req.req_id)
             if extra:
                 args.update(extra)
             lag_us = (time.perf_counter() - end_t) * 1e6

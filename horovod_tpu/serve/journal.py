@@ -87,4 +87,11 @@ def redrive_plan(get: KVGet) -> Tuple[List[Dict[str, Any]], int]:
         emitted, part = emitted_prefix(get, rid)
         entry["resume_emitted"] = emitted
         entry["resume_part"] = part
+        if entry.get("trace"):
+            # Redrive hop: derive a child context so the resumed
+            # fleet's spans link under the original admission
+            # (serve/trace.py — pure, so recomputing the same journal
+            # entry re-mints identical span ids).
+            from . import trace as trace_mod
+            entry["trace"] = trace_mod.child(entry["trace"], "redrive")
         entries.append(entry)

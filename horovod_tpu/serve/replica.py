@@ -142,6 +142,11 @@ class ReplicaRouter:
         self.affinity_hits = 0
         self.affinity_misses = 0
         self.redispatches = 0
+        # The last route() call's full placement verdict — candidate
+        # scores plus the affinity-vs-least-loaded decision — for the
+        # request-trace record (docs/serving.md#request-lifecycle).
+        # Pure derived state: replaying the same calls rebuilds it.
+        self.last_verdict: Optional[Dict[str, Any]] = None
 
     # ---------------------------------------------------------- intake
     def register(self, replica_id: int,
@@ -216,8 +221,13 @@ class ReplicaRouter:
         dropped = sorted(set(int(r) for r in (exclude or [])))
         rids = [r for r in self.live(now) if r not in dropped]
         if not rids:
+            self.last_verdict = {"kind": "no_live_replica",
+                                 "winner": None, "hit_blocks": 0,
+                                 "excluded": dropped, "candidates": []}
             return None
         best_rid, best_depth = None, 0
+        depths = {rid: 0 for rid in rids}
+        fps: List[str] = []
         if self.affinity:
             fps = prompt_fingerprints(tokens, self.block_size)
             for rid in rids:
@@ -230,6 +240,7 @@ class ReplicaRouter:
                         depth = i + 1
                     else:
                         break
+                depths[rid] = depth
                 if depth > best_depth:
                     best_rid, best_depth = rid, depth
                 elif depth == best_depth and best_rid is not None \
@@ -243,10 +254,21 @@ class ReplicaRouter:
             best_rid = self._least_loaded(rids)
             best_depth = 0
             self.affinity_misses += 1
+            kind = "least_loaded"
         else:
             self.affinity_hits += 1
             self.replicas[best_rid]["hits"] += 1
+            kind = "affinity"
         self.replicas[best_rid]["routed"] += 1
+        self.last_verdict = {
+            "kind": kind, "winner": best_rid, "hit_blocks": best_depth,
+            "prompt_blocks": len(fps), "excluded": dropped,
+            "candidates": [
+                {"replica": rid, "depth": depths[rid],
+                 "queue_depth": self.replicas[rid]["queue_depth"],
+                 "shed": self.replicas[rid]["shed"]}
+                for rid in rids],
+        }
         return best_rid, best_depth
 
     def note_redispatch(self) -> None:

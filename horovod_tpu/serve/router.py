@@ -56,6 +56,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import trace as trace_mod
 from .journal import JOURNAL_SCOPE
 from .replica import REPLICA_SCOPE, ReplicaRouter, scoped
 
@@ -369,6 +370,98 @@ def _enqueue_request(server, state: RouterState, rid: int,
             jn.kv_times.setdefault(jn_scope, {})[key] = now
 
 
+# -------------------------------------------------------- trace records
+def _trace_key(replica_id: int, rid: str) -> str:
+    """serve_trace store key: replica-prefixed so two replicas' dense
+    rid spaces (both mint req.000000) cannot collide; within a replica
+    sorted order stays admission order (trace.prune_keys)."""
+    return f"r{int(replica_id):02d}.{rid}"
+
+
+def _trace_put(server, tkey: str, rec: Dict[str, Any]) -> None:
+    """Write one request's serve_trace record (in-process store) and
+    enforce the bounded retention (serve/trace.py TRACE_RETAIN)."""
+    from ..utils import metrics as M
+    store = _store(server, trace_mod.TRACE_SCOPE)
+    with store.kv_lock:
+        scope = store.kv.setdefault(trace_mod.TRACE_SCOPE, {})
+        times = store.kv_times.setdefault(trace_mod.TRACE_SCOPE, {})
+        fresh = tkey not in scope
+        scope[tkey] = json.dumps(rec).encode()
+        times[tkey] = time.time()
+        pruned = trace_mod.prune_keys(list(scope))
+        for k in pruned:
+            scope.pop(k, None)
+            times.pop(k, None)
+    try:
+        if fresh:
+            M.SERVE_TRACE_RECORDS.inc()
+        if pruned:
+            M.SERVE_TRACE_PRUNED.inc(len(pruned))
+    except Exception:
+        pass  # telemetry must never take the front door down
+
+
+def _finalize_trace(server, trace_rec: Dict[str, Any], tkey: str,
+                    done_rec: Optional[Dict[str, Any]],
+                    status: str) -> None:
+    """Close one request's trace record at stream end: decompose the
+    measured wall time into lifecycle components that sum EXACTLY to it
+    (serve/trace.py ``attribute`` — over-attribution rescaled with the
+    ratio kept observable), persist, export the component histograms,
+    and emit the router-side STREAM span.  A timed-out request keeps
+    its record (status ``timeout``, no components) — forensics must
+    cover requests that died mid-flight."""
+    from ..utils import metrics as M
+    now = time.time()
+    wall = max(0.0, now - float(trace_rec.get("submitted_t") or now))
+    trace_rec["status"] = status
+    trace_rec["wall_s"] = wall
+    if done_rec is not None:
+        measured = dict(done_rec.get("timing") or {})
+        measured["placement"] = trace_rec.get("placement_s")
+        comps, ratio = trace_mod.attribute(wall, measured)
+        trace_rec["components"] = comps
+        trace_rec["overattribution"] = ratio
+        trace_rec["finish_reason"] = done_rec.get("finish_reason")
+        trace_rec["n_tokens"] = len(done_rec.get("tokens") or ())
+        trace_rec["ttft_s"] = done_rec.get("ttft_s")
+        trace_rec["tpot_s"] = done_rec.get("tpot_s")
+        try:
+            for c, v in comps.items():
+                M.SERVE_COMPONENT_SECONDS.observe(v, component=c)
+            M.SERVE_TRACE_OVERATTRIBUTION.set(ratio)
+        except Exception:
+            pass  # telemetry must never take the front door down
+        from ..runner.http_server import trace_span
+        ctx = trace_rec.get("trace") or {}
+        trace_span(server, "stream", "STREAM",
+                   start_t=now - comps["stream"], dur_s=comps["stream"],
+                   args=trace_mod.span_args(ctx, "STREAM"))
+    _trace_put(server, tkey, trace_rec)
+
+
+def render_trace(server) -> Dict[str, Any]:
+    """GET /serve/trace (docs/serving.md#request-lifecycle): tail
+    analytics over the bounded per-request trace records — per-component
+    p50/p99 fleet rollup plus the slowest-requests table."""
+    store = _store(server, trace_mod.TRACE_SCOPE)
+    with store.kv_lock:
+        raw = dict(store.kv.get(trace_mod.TRACE_SCOPE, {}))
+    records = []
+    for k in sorted(raw):
+        try:
+            records.append(json.loads(raw[k]))
+        except (ValueError, TypeError):
+            continue  # a torn record must not 500 the analytics view
+    out = trace_mod.rollup(records)
+    # The raw records ride the payload (bounded by TRACE_RETAIN) so
+    # `hvdrun doctor --request RID` reconstructs a lifecycle from the
+    # same fetch the rollup came from.
+    out["records"] = records
+    return out
+
+
 def handle_generate(handler) -> None:
     """POST /generate on the rendezvous server: place the request on a
     replica fleet (prefix affinity when replicas are registered —
@@ -387,10 +480,13 @@ def handle_generate(handler) -> None:
         _json_response(handler, 400, {"error": str(e)})
         return
     rr = get_replica_router(server)
+    place_t0 = time.perf_counter()
     replicated = refresh_replicas(server, rr) > 0
     rid_replica, hit_blocks = 0, 0
+    verdict = None
     if replicated:
         placed = rr.route(req["tokens"], time.time())
+        verdict = rr.last_verdict
         if placed is None:
             _json_response(handler, 503, {
                 "error": "no live serving replica (all heartbeats "
@@ -405,6 +501,7 @@ def handle_generate(handler) -> None:
             M.ROUTER_REPLICAS_UP.set(len(rr.live(time.time())))
         except Exception:
             pass  # telemetry must never take the front door down
+    placement_s = time.perf_counter() - place_t0
     state = get_router_state(server, rid_replica)
     seq = state.try_claim()
     if seq is None:
@@ -414,17 +511,53 @@ def handle_generate(handler) -> None:
                          "next fleet",
                 **state.counters()})
         else:
+            # Shed forensics: no sequence number is claimed, so mint a
+            # shed-marker rid — the 429 response and its trace record
+            # name the request they acted on.
+            shed_rid = f"shed.{rid_replica}.{state.shed}"
             _json_response(handler, 429, {
                 "error": "serving queue full (load shed)",
+                "rid": shed_rid,
                 **state.counters()},
-                extra_headers={"Retry-After":
-                               str(state.retry_after_s())})
+                extra_headers={
+                    "Retry-After": str(state.retry_after_s()),
+                    "X-Serve-Request-Id": shed_rid})
+            _trace_put(server, _trace_key(rid_replica, shed_rid), {
+                "rid": shed_rid, "status": "shed",
+                "submitted_t": time.time(),
+                "placement_s": placement_s,
+                "attempts": [{"replica": rid_replica,
+                              "verdict": verdict}]})
         return
     key = req_key(seq)
     req["id"] = key
     req["submitted_t"] = time.time()
+    # Causal trace context (serve/trace.py): minted ONCE here, then
+    # propagated through the journal entry, the plan stream, the engine,
+    # the prefill->decode handoff, and back on the done record.
+    ctx = trace_mod.mint(key)
+    req["trace"] = ctx
+    tkey = _trace_key(rid_replica, key)
+    trec: Dict[str, Any] = {
+        "rid": key, "status": "running",
+        "submitted_t": req["submitted_t"],
+        "trace": ctx,
+        "prompt_tokens": len(req["tokens"]),
+        "max_new_tokens": req["max_new_tokens"],
+        "placement_s": placement_s,
+        "attempts": [{"replica": rid_replica, "rid": key,
+                      "affinity_blocks": hit_blocks,
+                      "verdict": verdict}],
+    }
     try:
         _enqueue_request(server, state, rid_replica, req, key)
+        _trace_put(server, tkey, trec)
+        from ..runner.http_server import trace_span
+        trace_span(server, "router", "ROUTE",
+                   start_t=req["submitted_t"] - placement_s,
+                   dur_s=placement_s,
+                   args=trace_mod.span_args(ctx, "ROUTE",
+                                            replica=rid_replica))
         handler.send_response(200)
         handler.send_header("Content-Type", "application/x-ndjson")
         handler.send_header("X-Serve-Request-Id", key)
@@ -435,7 +568,8 @@ def handle_generate(handler) -> None:
         handler.end_headers()
         _stream_results(handler, server, key, state,
                         replica_id=rid_replica,
-                        rr=rr if replicated else None, req=req)
+                        rr=rr if replicated else None, req=req,
+                        trace_rec=trec, trace_key=tkey)
     finally:
         state.finish_stream()
 
@@ -481,7 +615,9 @@ def _redispatch(server, rr: ReplicaRouter, req: Dict[str, Any],
 def _stream_results(handler, server, key: str, state: RouterState,
                     replica_id: int = 0,
                     rr: Optional[ReplicaRouter] = None,
-                    req: Optional[Dict[str, Any]] = None) -> None:
+                    req: Optional[Dict[str, Any]] = None,
+                    trace_rec: Optional[Dict[str, Any]] = None,
+                    trace_key: Optional[str] = None) -> None:
     """Drain ``serve_out`` parts for one request to the client as they
     arrive; ends with the ``.done`` record (or a timeout record).  Reads
     are in-process dict lookups — a fleet reset stalls the stream (no
@@ -537,12 +673,17 @@ def _stream_results(handler, server, key: str, state: RouterState,
             if done is not None:
                 handler.wfile.write(done + b"\n")
                 handler.wfile.flush()
+                rec: Optional[Dict[str, Any]] = None
                 try:
                     rec = json.loads(done)
                     state.observe_done(rec.get("tpot_s"),
                                        len(rec.get("tokens") or ()))
                 except (ValueError, TypeError):
-                    pass  # a torn done record still ends the stream
+                    rec = None  # a torn done record still ends the stream
+                if trace_rec is not None and trace_key is not None:
+                    _finalize_trace(server, trace_rec, trace_key,
+                                    rec if isinstance(rec, dict) else None,
+                                    status="done")
                 _collect_consumed(store, key, part, out_scope)
                 return
             if time.time() >= deadline:
@@ -550,6 +691,11 @@ def _stream_results(handler, server, key: str, state: RouterState,
                     {"error": "timed out after "
                               f"{state.stream_timeout_s:.0f}s "
                               f"waiting for {key}"}).encode() + b"\n")
+                if trace_rec is not None and trace_key is not None:
+                    # Died mid-flight: the record survives for doctor
+                    # --request, status says where the lifecycle ended.
+                    _finalize_trace(server, trace_rec, trace_key, None,
+                                    status="timeout")
                 return
             if rr is not None and req is not None and \
                     time.time() >= next_dark_check:
@@ -567,7 +713,18 @@ def _stream_results(handler, server, key: str, state: RouterState,
                     if moved is not None:
                         if keyed is not None:
                             drop_stream_waiter(server, out_scope, key)
+                        prev_replica = replica_id
                         replica_id, key, new_state = moved
+                        if trace_rec is not None and trace_key is not None:
+                            # Forensics: both replica attempts, with the
+                            # delivered-prefix suppression boundary.
+                            trace_rec["attempts"].append({
+                                "replica": replica_id, "rid": key,
+                                "redispatched_from": prev_replica,
+                                "resume_part": part,
+                                "suppressed_tokens": len(streamed),
+                                "verdict": rr.last_verdict})
+                            _trace_put(server, trace_key, trace_rec)
                         extra_states.append(new_state)
                         out_scope = scoped(OUT_SCOPE, replica_id)
                         store = _store(server, out_scope)

@@ -120,6 +120,18 @@ def ingest_record(server, rec: Dict[str, Any]) -> bool:
                 json.dumps(payload).encode()
             store.kv_times.setdefault(kv_scope, {})[key] = now
         wake_stream(server, kv_scope, key)
+        if isinstance(payload, dict) and payload.get("trace"):
+            # The handoff's router transit, on the merged timeline: one
+            # instant-like span linking the prefill fleet's export to
+            # the decode fleet's import (docs/serving.md
+            # #request-lifecycle).
+            from ..runner.http_server import trace_span
+            from . import trace as trace_mod
+            trace_span(server, "handoff", "KV_HANDOFF",
+                       start_t=now, dur_s=0.0,
+                       args=trace_mod.span_args(
+                           payload["trace"], "KV_HANDOFF",
+                           rid=str(payload.get("req_id") or "")))
         return True
     rid = rec.get("rid")
     if not rid or not isinstance(rid, str):

@@ -273,8 +273,11 @@ class FleetFrontend:
             # requests accepted before the fleet was ready, not replays.
             from ..utils import metrics as M
             M.SERVE_REDRIVES.inc(len(entries))
+            # Redrive forensics (doctor --request): every log line that
+            # acts on a request names its rid.
+            rids = ", ".join(str(e.get("id")) for e in entries)
             print(f"[hvd.serve] rank 0 epoch {self.epoch}: redriving "
-                  f"{len(entries)} journaled request(s) "
+                  f"{len(entries)} journaled request(s) [{rids}] "
                   f"({sum(len(e['resume_emitted']) for e in entries)} "
                   "already-streamed tokens suppressed)", flush=True)
         return entries
@@ -355,9 +358,46 @@ class FleetFrontend:
                 "finish_reason": req.finish_reason,
                 "ttft_s": req.ttft(),
                 "tpot_s": req.tpot(),
+                "timing": self._req_timing(req),
+                "trace": getattr(req, "trace", None),
             })
             self._parts.pop(req.req_id, None)
             self._suppress.pop(req.req_id, None)
+
+    @staticmethod
+    def _req_timing(req) -> Dict[str, float]:
+        """Engine-measured component durations for the router's SLO
+        attribution (serve/trace.py ``attribute``): perf_counter stamps
+        are process-local, so the done record ships DURATIONS.  Getattr-
+        defensive — scripted test engines finish bare stubs without the
+        Request timing fields."""
+        sub = getattr(req, "submitted_t", None)
+        adm = getattr(req, "admitted_t", None)
+        ftt = getattr(req, "first_token_t", None)
+        done = getattr(req, "done_t", None)
+        up = getattr(req, "upstream", None) or {}
+        t: Dict[str, float] = {}
+        if up:
+            # Disaggregated: the queue/prefill legs ran on the prefill
+            # sub-fleet and rode the handoff record; the decode-side
+            # import-to-admission wait belongs to the handoff leg.
+            if up.get("queue_s") is not None:
+                t["queue"] = max(0.0, float(up["queue_s"]))
+            if up.get("prefill_s") is not None:
+                t["prefill"] = max(0.0, float(up["prefill_s"]))
+            hand = float(getattr(req, "handoff_s", 0.0) or 0.0)
+            if sub is not None and adm is not None:
+                hand += max(0.0, adm - sub)
+            if hand > 0.0:
+                t["handoff"] = hand
+        else:
+            if sub is not None and adm is not None:
+                t["queue"] = max(0.0, adm - sub)
+            if adm is not None and ftt is not None:
+                t["prefill"] = max(0.0, ftt - adm)
+        if ftt is not None and done is not None:
+            t["decode"] = max(0.0, done - ftt)
+        return t
 
     def _publish_stats(self, force: bool = False) -> None:
         now = time.monotonic()
@@ -533,10 +573,14 @@ class FleetFrontend:
                                 "resume_emitted": r["resume_emitted"],
                                 "resume_part": r.get("resume_part", 0)}
                     try:
-                        self.engine.submit(r["tokens"],
-                                           r["max_new_tokens"],
-                                           req_id=r.get("id"),
-                                           eos_id=r.get("eos_id"))
+                        req = self.engine.submit(r["tokens"],
+                                                 r["max_new_tokens"],
+                                                 req_id=r.get("id"),
+                                                 eos_id=r.get("eos_id"))
+                        # Guarded attach, not a submit kwarg: scripted
+                        # test engines return None and predate trace.
+                        if req is not None and r.get("trace") is not None:
+                            req.trace = r["trace"]
                     except ValueError as e:
                         # invalid per the engine's limits: answer it so
                         # the router stream doesn't hang to timeout

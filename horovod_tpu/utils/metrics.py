@@ -550,6 +550,31 @@ SERVE_SPILL_RELOADS = REGISTRY.counter(
     "Spilled KV blocks reloaded into fresh device blocks on a prefix "
     "hit (the spill tier's payoff: a host copy instead of a prefill "
     "recompute).")
+# Request-lifecycle tracing plane (serve/trace.py, serve/router.py;
+# docs/serving.md#request-lifecycle): per-request SLO attribution —
+# each completed request's measured wall time decomposed into
+# queue/placement/prefill/handoff/decode/stream components that sum
+# exactly to the measurement, plus the serve_trace record accounting.
+SERVE_COMPONENT_SECONDS = REGISTRY.histogram(
+    "hvd_serve_component_seconds",
+    "Per-request lifecycle component durations (labeled component = "
+    "queue / placement / prefill / handoff / decode / stream), observed "
+    "at stream completion; per request the components sum exactly to "
+    "the router-measured wall time (over-attribution rescaled).")
+SERVE_TRACE_RECORDS = REGISTRY.counter(
+    "hvd_serve_trace_records_total",
+    "Per-request trace records written to the serve_trace KV scope "
+    "(admission + completion + re-dispatch updates each count once).")
+SERVE_TRACE_PRUNED = REGISTRY.counter(
+    "hvd_serve_trace_pruned_total",
+    "serve_trace records dropped by the bounded-retention prune "
+    "(oldest-first once the scope exceeds the retention cap).")
+SERVE_TRACE_OVERATTRIBUTION = REGISTRY.gauge(
+    "hvd_serve_trace_overattribution_ratio",
+    "Last completed request's modeled-components / measured-wall ratio "
+    "before the ledger-style rescale (1.0 = the measured hop durations "
+    "fit the wall exactly; > 1.0 = clock skew made them overshoot and "
+    "they were rescaled to fit — the overshoot stays observable here).")
 
 # Perf-attribution plane (horovod_tpu/perf/; docs/profiling.md).  The
 # step-time decomposition ledger records here: measured step times, the

@@ -41,7 +41,7 @@ import json
 import queue
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 TIMELINE_KV_SCOPE = "timeline"
 
@@ -327,46 +327,58 @@ def load_trace_events(path: str) -> List[dict]:
     return events
 
 
+# pid namespacing for replica fleets: replica 0 keeps pid == rank (the
+# single-fleet byte-compat contract), replica K's rank N renders at
+# K * _REPLICA_PID_STRIDE + N — disjoint for any realistic fleet size.
+_REPLICA_PID_STRIDE = 10000
+
+
 def merge_timeline_chunks(items: Dict[str, bytes]) -> dict:
     """Render KV scope ``timeline`` chunks as one Chrome/Perfetto JSON
-    object: each rank becomes a pid lane ("rank N"), each event lane a
-    tid within it, all timestamps on the shared aligned epoch normalized
-    to the earliest event.  Per-rank clock offset/uncertainty ride the
-    metadata so readers know how much cross-rank skew to trust."""
-    per_rank: Dict[int, List[dict]] = {}
-    clocks: Dict[int, dict] = {}
+    object: each (replica, rank) becomes a pid process lane — bare
+    ``rank N`` for replica 0 (single-fleet byte-compat),
+    ``replica{K}.rank{N}`` for replica K's chunks (docs/timeline.md) —
+    each event lane a tid within it, all timestamps on the shared
+    aligned epoch normalized to the earliest event.  Per-rank clock
+    offset/uncertainty ride the metadata so readers know how much
+    cross-rank skew to trust."""
+    per_rank: Dict[Tuple[int, int], List[dict]] = {}
+    clocks: Dict[Tuple[int, int], dict] = {}
     for key in sorted(items):
         try:
             chunk = json.loads(items[key])
         except (ValueError, TypeError):
             continue  # a torn PUT must not break the whole merge
         r = int(chunk.get("rank", -1))
-        per_rank.setdefault(r, []).extend(chunk.get("events", []))
+        rep = int(chunk.get("replica", 0) or 0)
+        per_rank.setdefault((rep, r), []).extend(chunk.get("events", []))
         if isinstance(chunk.get("clock"), dict):
-            clocks[r] = chunk["clock"]
+            clocks[(rep, r)] = chunk["clock"]
     all_ts = [ev["ts"] for evs in per_rank.values() for ev in evs
               if isinstance(ev.get("ts"), (int, float))]
     t0 = min(all_ts) if all_ts else 0.0
     meta_events: List[dict] = []
     events: List[dict] = []
-    for r in sorted(per_rank):
-        meta_events.append({"name": "process_name", "ph": "M", "pid": r,
-                            "args": {"name": f"rank {r}"}})
-        if r in clocks:
-            meta_events.append({"name": "clock_sync", "ph": "M", "pid": r,
-                                "args": clocks[r]})
+    for rep, r in sorted(per_rank):
+        pid = r if rep == 0 else rep * _REPLICA_PID_STRIDE + r
+        lane = f"rank {r}" if rep == 0 else f"replica{rep}.rank{r}"
+        meta_events.append({"name": "process_name", "ph": "M", "pid": pid,
+                            "args": {"name": lane}})
+        if (rep, r) in clocks:
+            meta_events.append({"name": "clock_sync", "ph": "M",
+                                "pid": pid, "args": clocks[(rep, r)]})
         tids: Dict[str, int] = {}
-        for ev in per_rank[r]:
-            lane = str(ev.get("lane", "misc"))
-            tid = tids.get(lane)
+        for ev in per_rank[(rep, r)]:
+            ev_lane = str(ev.get("lane", "misc"))
+            tid = tids.get(ev_lane)
             if tid is None:
                 tid = len(tids)
-                tids[lane] = tid
+                tids[ev_lane] = tid
                 meta_events.append({"name": "thread_name", "ph": "M",
-                                    "pid": r, "tid": tid,
-                                    "args": {"name": lane}})
+                                    "pid": pid, "tid": tid,
+                                    "args": {"name": ev_lane}})
             out = {k: v for k, v in ev.items() if k != "lane"}
-            out["pid"] = r
+            out["pid"] = pid
             out["tid"] = tid
             if isinstance(out.get("ts"), (int, float)):
                 out["ts"] = out["ts"] - t0
@@ -374,8 +386,9 @@ def merge_timeline_chunks(items: Dict[str, bytes]) -> dict:
     events.sort(key=lambda e: e.get("ts", 0.0))
     return {"traceEvents": meta_events + events,
             "metadata": {"epoch_us": t0,
-                         "clock_sync": {str(r): c
-                                        for r, c in sorted(clocks.items())}}}
+                         "clock_sync": {
+                             (str(r) if rep == 0 else f"r{rep}.{r}"): c
+                             for (rep, r), c in sorted(clocks.items())}}}
 
 
 # --------------------------------------------------------------- publishing
@@ -390,10 +403,15 @@ class TimelinePublisher:
     SCOPE = TIMELINE_KV_SCOPE
 
     def __init__(self, addr: str, port: int, rank: int, timeline: Timeline,
-                 interval: float = 5.0, clock: Optional[Any] = None):
+                 interval: float = 5.0, clock: Optional[Any] = None,
+                 replica: int = 0):
         self.addr = addr
         self.port = int(port)
         self.rank = int(rank)
+        # Replica-fleet lane namespacing (docs/timeline.md): nonzero
+        # replica ids stamp the chunks so merge_timeline_chunks renders
+        # replica{K}.rank{N} process lanes instead of colliding pids.
+        self.replica = int(replica)
         self.interval = max(0.1, float(interval))
         self.timeline = timeline
         self.clock = clock
@@ -417,9 +435,12 @@ class TimelinePublisher:
             chunk = {"rank": self.rank, "seq": self._seq,
                      "clock": self.timeline.clock_meta(),
                      "events": events}
+            key = f"rank.{self.rank}.{self._seq:06d}"
+            if self.replica:
+                chunk["replica"] = self.replica
+                key = f"r{self.replica:02d}.{key}"
             from ..runner.http_client import put_kv
-            put_kv(self.addr, self.port, self.SCOPE,
-                   f"rank.{self.rank}.{self._seq:06d}",
+            put_kv(self.addr, self.port, self.SCOPE, key,
                    json.dumps(chunk).encode())
             self._seq += 1
             return True

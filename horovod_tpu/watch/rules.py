@@ -271,6 +271,18 @@ DEFAULT_RULES: List[AlertRule] = parse_rules({"rules": [
     {"name": "kv-pool-dry", "family": "hvd_mem_kv_util",
      "kind": "threshold", "op": ">=", "value": 1.0, "for": 10,
      "severity": "warning", "context_family": "hvd_mem_kv_blocks_used"},
+    # Request-lifecycle component regressions (docs/serving.md#request-
+    # lifecycle): the series store derives per-component p99 gauges from
+    # the hvd_serve_component_seconds histogram.  A sustained handoff
+    # p99 means the prefill->decode KV transfer (or the router transit
+    # under it) is the tail — the disaggregation tax made visible; a
+    # sustained queue p99 is admission backlog ahead of any engine work.
+    {"name": "serve-handoff-p99", "family": "hvd_serve_handoff_p99_seconds",
+     "kind": "threshold", "op": ">=", "value": 0.5, "for": 10,
+     "severity": "warning"},
+    {"name": "serve-queue-p99", "family": "hvd_serve_queue_p99_seconds",
+     "kind": "threshold", "op": ">=", "value": 2.0, "for": 10,
+     "severity": "warning"},
     # Memory model self-assessment: measured residency 2x away from the
     # zero_memory_bytes prediction for 15 s means the attribution (and
     # the layout solver consuming its headroom number) is off the rails

